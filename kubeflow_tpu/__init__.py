@@ -1,0 +1,20 @@
+"""kubeflow_tpu — a TPU-native ML-platform framework.
+
+A ground-up rebuild of the capabilities of the kubeflow/kubeflow platform
+(reference: ODH fork v1.7.0), designed TPU-first:
+
+- ``core``: document-store + level-triggered reconcile runtime (the
+  kube-apiserver/controller-runtime boundary, in-process).
+- ``api``: CR schemas (Notebook, Profile, Tensorboard, PodDefault, TpuSlice,
+  StudyJob) and builtin workload object helpers.
+- ``controllers``: reconcile loops (notebook, profile, tensorboard, culling,
+  admission webhook, odh add-ons).
+- ``parallel`` / ``ops`` / ``models`` / ``training`` / ``serving``: the new
+  JAX/XLA/Pallas compute layer (device meshes over ICI, pjit-sharded steps,
+  ring attention, orbax checkpointing, REST serving) that the reference
+  delegated to out-of-tree NCCL/CUDA operators.
+- ``web``: REST backends (crud lib, jupyter/volumes/tensorboards apps, kfam,
+  central dashboard).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,24 @@
+"""API object schemas.
+
+CRDs (Notebook, Profile, Tensorboard, PodDefault, TpuSlice, StudyJob) plus
+constructors for the builtin workload kinds the controllers generate.
+All objects are unstructured dicts; this package provides constructors,
+defaulting, validation and version conversion.
+"""
+
+from . import builtin, notebook, poddefault, profile, tensorboard, tpuslice
+
+GROUP = "kubeflow.org"
+
+
+def register_all(store):
+    """Install every kind's store-level config (scoping + converters)."""
+    notebook.register(store)
+    profile.register(store)
+    tensorboard.register(store)
+    poddefault.register(store)
+    tpuslice.register(store)
+
+
+__all__ = ["GROUP", "builtin", "notebook", "poddefault", "profile",
+           "tensorboard", "tpuslice", "register_all"]

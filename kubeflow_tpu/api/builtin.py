@@ -1,0 +1,178 @@
+"""Constructors for builtin workload objects (Pod, StatefulSet, Service,
+Deployment, PVC, Namespace, RBAC, Istio VirtualService/AuthorizationPolicy,
+Route, NetworkPolicy) — the kinds the reference controllers emit."""
+
+
+def _meta(name, namespace=None, labels=None, annotations=None):
+    md = {"name": name}
+    if namespace is not None:
+        md["namespace"] = namespace
+    if labels:
+        md["labels"] = dict(labels)
+    if annotations:
+        md["annotations"] = dict(annotations)
+    return md
+
+
+def pod(name, namespace, spec, labels=None, annotations=None):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": _meta(name, namespace, labels, annotations),
+            "spec": spec, "status": {}}
+
+
+def stateful_set(name, namespace, replicas, selector_labels, template_labels,
+                 pod_spec, labels=None, annotations=None):
+    return {
+        "apiVersion": "apps/v1", "kind": "StatefulSet",
+        "metadata": _meta(name, namespace, labels, annotations),
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": dict(selector_labels)},
+            "template": {
+                "metadata": {"labels": dict(template_labels)},
+                "spec": pod_spec,
+            },
+        },
+        "status": {},
+    }
+
+
+def deployment(name, namespace, replicas, selector_labels, template_labels,
+               pod_spec, labels=None, annotations=None):
+    d = stateful_set(name, namespace, replicas, selector_labels,
+                     template_labels, pod_spec, labels, annotations)
+    d["kind"] = "Deployment"
+    return d
+
+
+def service(name, namespace, selector, ports, svc_type="ClusterIP",
+            labels=None, annotations=None):
+    return {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": _meta(name, namespace, labels, annotations),
+        "spec": {"type": svc_type, "selector": dict(selector),
+                 "ports": list(ports)},
+    }
+
+
+def pvc(name, namespace, size, storage_class=None, access_modes=None,
+        labels=None, annotations=None):
+    spec = {
+        "accessModes": list(access_modes or ["ReadWriteOnce"]),
+        "resources": {"requests": {"storage": size}},
+    }
+    if storage_class is not None:
+        spec["storageClassName"] = storage_class
+    return {"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+            "metadata": _meta(name, namespace, labels, annotations),
+            "spec": spec, "status": {"phase": "Bound"}}
+
+
+def namespace(name, labels=None, annotations=None):
+    return {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": _meta(name, labels=labels, annotations=annotations),
+            "status": {"phase": "Active"}}
+
+
+def service_account(name, namespace, annotations=None):
+    return {"apiVersion": "v1", "kind": "ServiceAccount",
+            "metadata": _meta(name, namespace, annotations=annotations)}
+
+
+def role_binding(name, namespace, role_kind, role_name, subjects,
+                 annotations=None):
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "RoleBinding",
+        "metadata": _meta(name, namespace, annotations=annotations),
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                    "kind": role_kind, "name": role_name},
+        "subjects": list(subjects),
+    }
+
+
+def cluster_role_binding(name, role_name, subjects, annotations=None):
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": _meta(name, annotations=annotations),
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "ClusterRole", "name": role_name},
+        "subjects": list(subjects),
+    }
+
+
+def resource_quota(name, namespace, hard):
+    return {"apiVersion": "v1", "kind": "ResourceQuota",
+            "metadata": _meta(name, namespace), "spec": {"hard": dict(hard)}}
+
+
+def virtual_service(name, namespace, spec):
+    return {"apiVersion": "networking.istio.io/v1alpha3",
+            "kind": "VirtualService",
+            "metadata": _meta(name, namespace), "spec": spec}
+
+
+def authorization_policy(name, namespace, spec):
+    return {"apiVersion": "security.istio.io/v1beta1",
+            "kind": "AuthorizationPolicy",
+            "metadata": _meta(name, namespace), "spec": spec}
+
+
+def network_policy(name, namespace, spec):
+    return {"apiVersion": "networking.k8s.io/v1", "kind": "NetworkPolicy",
+            "metadata": _meta(name, namespace), "spec": spec}
+
+
+def route(name, namespace, to_service, port, tls=None, labels=None):
+    """OpenShift-Route equivalent (reference
+    odh-notebook-controller/controllers/notebook_route.go:34)."""
+    spec = {"to": {"kind": "Service", "name": to_service,
+                   "weight": 100},
+            "port": {"targetPort": port},
+            "wildcardPolicy": "None"}
+    if tls:
+        spec["tls"] = tls
+    return {"apiVersion": "route.openshift.io/v1", "kind": "Route",
+            "metadata": _meta(name, namespace, labels), "spec": spec}
+
+
+def secret(name, namespace, data=None, string_data=None, secret_type="Opaque",
+           labels=None, annotations=None):
+    out = {"apiVersion": "v1", "kind": "Secret",
+           "metadata": _meta(name, namespace, labels, annotations),
+           "type": secret_type}
+    if data:
+        out["data"] = dict(data)
+    if string_data:
+        out["stringData"] = dict(string_data)
+    return out
+
+
+def config_map(name, namespace, data, labels=None, annotations=None):
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": _meta(name, namespace, labels, annotations),
+            "data": dict(data)}
+
+
+def node(name, capacity, labels=None):
+    """Node with capacity map — TPU nodes carry ``google.com/tpu`` capacity
+    and topology labels, replacing the reference's nvidia.com/gpu world
+    (SURVEY.md §2 parallelism table, GPU-discovery row)."""
+    return {"apiVersion": "v1", "kind": "Node",
+            "metadata": _meta(name, labels=labels),
+            "status": {"capacity": dict(capacity),
+                       "allocatable": dict(capacity)}}
+
+
+def container_resources(container):
+    return container.get("resources") or {}
+
+
+def get_container(pod_spec, name=None, index=0):
+    containers = pod_spec.get("containers") or []
+    if name is not None:
+        for c in containers:
+            if c.get("name") == name:
+                return c
+        return None
+    return containers[index] if containers else None

@@ -1,0 +1,93 @@
+"""Notebook CRD — kubeflow.org, versions v1alpha1 / v1beta1 / v1.
+
+Shape parity with the reference CRD (components/notebook-controller/api/
+v1beta1/notebook_types.go:27-44): ``spec.template.spec`` is a full PodSpec;
+``status`` carries conditions, readyReplicas and the mirrored
+containerState. v1beta1 is the hub (storage) version; the spokes convert
+through it (notebook_conversion.go).
+"""
+
+from ..core import meta as m
+
+GROUP = "kubeflow.org"
+KIND = "Notebook"
+HUB_VERSION = "v1beta1"
+VERSIONS = ("v1alpha1", "v1beta1", "v1")
+
+# Annotation / label contract (culling_controller.go:50-52,
+# notebook_controller.go constants)
+STOP_ANNOTATION = "kubeflow-resource-stopped"
+LAST_ACTIVITY_ANNOTATION = "notebooks.kubeflow.org/last-activity"
+LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION = \
+    "notebooks.kubeflow.org/last_activity_check_timestamp"
+RESTART_ANNOTATION = "notebooks.kubeflow.org/notebook-restart"
+REWRITE_URI_ANNOTATION = "notebooks.kubeflow.org/http-rewrite-uri"
+HEADERS_REQUEST_SET_ANNOTATION = "notebooks.kubeflow.org/http-headers-request-set"
+
+# TPU-native additions: how a Notebook asks for accelerator topology.
+# Replaces the reference's bare nvidia.com/gpu limits with an explicit
+# slice request (SURVEY.md §2 "GPU discovery" row re-target).
+TPU_RESOURCE_KEY = "google.com/tpu"
+TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+TPU_TOPOLOGY_ANNOTATION = "notebooks.kubeflow.org/tpu-topology"
+TPU_ACCELERATOR_ANNOTATION = "notebooks.kubeflow.org/tpu-accelerator"
+
+DEFAULT_CONTAINER_PORT = 8888
+DEFAULT_SERVING_PORT = 80
+PREFIX_ENV_VAR = "NB_PREFIX"
+DEFAULT_FS_GROUP = 100
+
+
+def new(name, namespace, pod_spec, version=HUB_VERSION, labels=None,
+        annotations=None):
+    md = {"name": name, "namespace": namespace}
+    if labels:
+        md["labels"] = dict(labels)
+    if annotations:
+        md["annotations"] = dict(annotations)
+    return {
+        "apiVersion": f"{GROUP}/{version}",
+        "kind": KIND,
+        "metadata": md,
+        "spec": {"template": {"spec": pod_spec}},
+        "status": {"conditions": [], "readyReplicas": 0,
+                   "containerState": {}},
+    }
+
+
+def convert(obj, to_version):
+    """Hub-and-spoke conversion. The three versions share the
+    spec.template.spec shape (the reference's conversion functions are
+    likewise structural no-ops across its served versions), so conversion
+    is an apiVersion rewrite with status-field normalization."""
+    if to_version not in VERSIONS:
+        raise ValueError(f"unknown Notebook version {to_version!r}")
+    out = m.deep_copy(obj)
+    out["apiVersion"] = f"{GROUP}/{to_version}"
+    status = out.setdefault("status", {})
+    status.setdefault("conditions", [])
+    status.setdefault("readyReplicas", 0)
+    status.setdefault("containerState", {})
+    return out
+
+
+def is_stopped(nb):
+    return STOP_ANNOTATION in m.annotations_of(nb)
+
+
+def tpu_request(nb):
+    """(chip_count, accelerator, topology) requested by the notebook's
+    first container, or (0, None, None)."""
+    containers = m.deep_get(nb, "spec", "template", "spec", "containers") or []
+    if not containers:
+        return 0, None, None
+    limits = m.deep_get(containers[0], "resources", "limits") or {}
+    chips = int(limits.get(TPU_RESOURCE_KEY, 0) or 0)
+    ann = m.annotations_of(nb)
+    return (chips, ann.get(TPU_ACCELERATOR_ANNOTATION),
+            ann.get(TPU_TOPOLOGY_ANNOTATION))
+
+
+def register(store):
+    store.register_converter(GROUP, KIND, convert)

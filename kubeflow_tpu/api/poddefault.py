@@ -1,0 +1,65 @@
+"""PodDefault CRD — declarative pod mutation.
+
+Parity with components/admission-webhook/pkg/apis/settings/v1alpha1/
+poddefault_types.go:27-90: a namespaced CR with a label ``selector`` and
+the fields to inject: env, envFrom, volumes, volumeMounts, initContainers,
+sidecars, tolerations, serviceAccountName, automountServiceAccountToken,
+imagePullSecrets, annotations, labels, command, args.
+
+TPU-native role: this is the mechanism that injects ``TPU_WORKER_ID``,
+``TPU_WORKER_HOSTNAMES`` and mesh-coordinate env into multi-host training
+pods (SURVEY.md §5 "Distributed communication backend" row) —
+``tpu_worker_pod_default`` builds that CR.
+"""
+
+GROUP = "kubeflow.org"
+KIND = "PodDefault"
+VERSION = "v1alpha1"
+
+ANNOTATION_PREFIX = "poddefault.admission.kubeflow.org/poddefault-"
+
+MUTATE_FIELDS = ("env", "envFrom", "volumes", "volumeMounts",
+                 "initContainers", "sidecars", "tolerations",
+                 "serviceAccountName", "automountServiceAccountToken",
+                 "imagePullSecrets", "annotations", "labels",
+                 "command", "args")
+
+
+def new(name, namespace, selector, desc="", **fields):
+    spec = {"selector": selector, "desc": desc or name}
+    for k, v in fields.items():
+        if k not in MUTATE_FIELDS:
+            raise ValueError(f"unknown PodDefault field {k!r}")
+        spec[k] = v
+    return {"apiVersion": f"{GROUP}/{VERSION}", "kind": KIND,
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": spec}
+
+
+def tpu_worker_pod_default(namespace, slice_name, num_workers,
+                           chips_per_host=4, topology="2x2x1"):
+    """PodDefault that wires a pod into a TPU pod-slice: worker identity via
+    the downward API ordinal, peer discovery via the slice headless
+    service. Pods opt in with label ``tpu-slice: <slice_name>``."""
+    hostnames = ",".join(
+        f"{slice_name}-{i}.{slice_name}.{namespace}.svc" for i in range(num_workers))
+    return new(
+        f"tpu-worker-{slice_name}", namespace,
+        selector={"matchLabels": {"tpu-slice": slice_name}},
+        desc=f"TPU slice wiring for {slice_name}",
+        env=[
+            {"name": "TPU_WORKER_HOSTNAMES", "value": hostnames},
+            {"name": "TPU_WORKER_ID", "valueFrom": {"fieldRef": {
+                "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"}}},
+            {"name": "TPU_CHIPS_PER_HOST_BOUNDS",
+             "value": f"{chips_per_host}"},
+            {"name": "TPU_SLICE_TOPOLOGY", "value": topology},
+            {"name": "JAX_COORDINATOR_ADDRESS",
+             "value": f"{slice_name}-0.{slice_name}.{namespace}.svc:8476"},
+            {"name": "JAX_NUM_PROCESSES", "value": str(num_workers)},
+        ],
+    )
+
+
+def register(store):
+    pass

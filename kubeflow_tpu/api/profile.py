@@ -1,0 +1,39 @@
+"""Profile CRD — cluster-scoped multi-tenancy unit.
+
+Shape parity with components/profile-controller/api/v1/profile_types.go:36-55:
+``spec.owner`` (rbac Subject), ``spec.plugins`` (typed raw extensions),
+``spec.resourceQuotaSpec``. TPU-native addition: quota specs may carry
+``google.com/tpu`` hard limits so tenants are budgeted in chips.
+"""
+
+GROUP = "kubeflow.org"
+KIND = "Profile"
+VERSION = "v1"
+
+USERID_HEADER_DEFAULT = "kubeflow-userid"
+OWNER_ANNOTATION = "owner"
+QUOTA_NAME = "kf-resource-quota"
+AUTHZ_POLICY_NAME = "ns-owner-access-istio"
+EDITOR_SA = "default-editor"
+VIEWER_SA = "default-viewer"
+FINALIZER = "profile-finalizer"
+
+PLUGIN_WORKLOAD_IDENTITY = "WorkloadIdentity"
+PLUGIN_AWS_IAM = "AwsIamForServiceAccount"
+
+
+def new(name, owner_name, owner_kind="User", plugins=None, quota=None):
+    spec = {"owner": {"kind": owner_kind,
+                      "apiGroup": "rbac.authorization.k8s.io",
+                      "name": owner_name}}
+    if plugins:
+        spec["plugins"] = list(plugins)
+    if quota:
+        spec["resourceQuotaSpec"] = {"hard": dict(quota)}
+    return {"apiVersion": f"{GROUP}/{VERSION}", "kind": KIND,
+            "metadata": {"name": name}, "spec": spec,
+            "status": {"conditions": []}}
+
+
+def register(store):
+    store.register_cluster_scoped(GROUP, KIND)

@@ -1,0 +1,44 @@
+"""Tensorboard CRD.
+
+Parity with components/tensorboard-controller/api/v1alpha1/
+tensorboard_types.go:31: spec is a single ``logspath``. Log path schemes
+(tensorboard_controller.go:375-407): cloud paths (``gs://…``) served
+directly; ``pvc://<name>/<subpath>`` mounts the PVC. The TPU-native
+deployment serves JAX profiler dumps written by the compute layer's
+profiler hook (kubeflow_tpu/training/profiler.py) from the same logs path.
+"""
+
+GROUP = "kubeflow.org"
+KIND = "Tensorboard"
+VERSION = "v1alpha1"
+
+PVC_SCHEME = "pvc://"
+DEFAULT_IMAGE = "tensorflow/tensorflow:2.5.1"
+
+
+def new(name, namespace, logspath):
+    return {"apiVersion": f"{GROUP}/{VERSION}", "kind": KIND,
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {"logspath": logspath},
+            "status": {"conditions": []}}
+
+
+def is_cloud_path(path):
+    """gs:// s3:// etc (tensorboard_controller.go:375-388)."""
+    return "://" in path and not path.startswith(PVC_SCHEME)
+
+
+def parse_pvc_path(path):
+    """'pvc://claim/sub/dir' -> ('claim', 'sub/dir');
+    tensorboard_controller.go:390-407."""
+    if not path.startswith(PVC_SCHEME):
+        return None, None
+    rest = path[len(PVC_SCHEME):]
+    parts = rest.split("/", 1)
+    claim = parts[0]
+    sub = parts[1] if len(parts) > 1 else ""
+    return claim, sub
+
+
+def register(store):
+    pass

@@ -1,0 +1,84 @@
+"""TpuSlice and StudyJob CRDs — the new, TPU-first workload kinds.
+
+No reference counterpart exists in-tree (SURVEY.md §2 parallelism table:
+multi-worker training was delegated to out-of-tree tf-operator, HPO to
+Katib — testing/katib_studyjob_test.py:39-43 shows the StudyJob CR shape
+this framework re-homes).
+
+- ``TpuSlice``: a gang of N TPU worker pods forming one ICI-connected
+  slice. The controller materializes a headless Service + StatefulSet
+  (stable `<slice>-<i>` hostnames = JAX coordinator discovery) and a
+  PodDefault that injects TPU_WORKER_* / JAX_COORDINATOR_ADDRESS env.
+- ``StudyJob``: hyperparameter sweep that fans trials out one-per-chip
+  (or one-per-slice) and tracks best objective value.
+"""
+
+GROUP = "kubeflow.org"
+SLICE_KIND = "TpuSlice"
+STUDY_KIND = "StudyJob"
+VERSION = "v1alpha1"
+
+# accelerator type -> (chips_per_host, default ici topology for one host)
+ACCELERATOR_HOSTS = {
+    "tpu-v4-podslice": (4, "2x2x1"),
+    "tpu-v5-lite-podslice": (4, "2x2"),
+    "tpu-v5p-slice": (4, "2x2x1"),
+    "tpu-v6e-slice": (4, "2x2"),
+}
+
+
+def topology_chips(topology):
+    """'4x4' or '2x2x4' -> total chip count."""
+    n = 1
+    for d in topology.lower().split("x"):
+        n *= int(d)
+    return n
+
+
+def workers_for(accelerator, topology):
+    chips_per_host = ACCELERATOR_HOSTS.get(accelerator, (4, None))[0]
+    total = topology_chips(topology)
+    return max(1, total // chips_per_host)
+
+
+def new_slice(name, namespace, accelerator, topology, pod_spec,
+              labels=None):
+    md = {"name": name, "namespace": namespace}
+    if labels:
+        md["labels"] = dict(labels)
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}", "kind": SLICE_KIND,
+        "metadata": md,
+        "spec": {
+            "accelerator": accelerator,
+            "topology": topology,
+            "template": {"spec": pod_spec},
+        },
+        "status": {"conditions": [], "readyWorkers": 0, "phase": "Pending"},
+    }
+
+
+def new_study(name, namespace, objective, parameters, trial_template,
+              max_trials=10, parallelism=None, algorithm="random",
+              seed=0):
+    """parameters: list of {name, type: double|int|categorical, min, max,
+    values}; trial_template: pod spec template whose container args may use
+    ``{{param}}`` placeholders (katib_studyjob_test.py idiom)."""
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}", "kind": STUDY_KIND,
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "objective": objective,      # {type: maximize|minimize, metricName}
+            "algorithm": {"name": algorithm, "seed": seed},
+            "parameters": list(parameters),
+            "trialTemplate": trial_template,
+            "maxTrialCount": max_trials,
+            "parallelTrialCount": parallelism or max_trials,
+        },
+        "status": {"conditions": [], "trials": [], "phase": "Created",
+                   "completedTrials": 0},
+    }
+
+
+def register(store):
+    pass

@@ -1,0 +1,1 @@
+"""Reconcile controllers (the reference's L2/L3 planes, SURVEY.md §1)."""

@@ -1,0 +1,282 @@
+"""Idle-notebook culling controller.
+
+Behavioral parity with components/notebook-controller/controllers/
+culling_controller.go: every IDLENESS_CHECK_PERIOD minutes, poll the
+notebook server's /api/kernels and /api/terminals, maintain the
+last-activity annotation, and set ``kubeflow-resource-stopped`` once idle
+longer than CULL_IDLE_TIME. The notebook controller then scales the
+StatefulSet to 0 (generate_statefulset).
+
+Idiomatic fix over the reference (SURVEY.md §7 hard part (d)): the
+reference blocks its reconcile worker on O(notebooks) sequential HTTP
+GETs with 10s timeouts. Here probing goes through ``ActivityProber``, a
+cached async pool — reconcile never blocks on the network; it consumes
+the latest probe result and triggers a refresh.
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from datetime import datetime, timedelta, timezone
+
+from ..api import notebook as nbapi
+from ..core import meta as m
+from ..core.manager import Reconciler, Result
+
+log = logging.getLogger("kubeflow_tpu.controllers.culling")
+
+KERNEL_EXECUTION_STATE_IDLE = "idle"
+KERNEL_EXECUTION_STATE_BUSY = "busy"
+
+DEFAULT_CULL_IDLE_TIME_MIN = 1440   # culling_controller.go:30
+DEFAULT_IDLENESS_CHECK_PERIOD_MIN = 1
+
+
+def _now():
+    return datetime.now(timezone.utc)
+
+
+def timestamp(dt=None):
+    return (dt or _now()).strftime("%Y-%m-%dT%H:%M:%S%z").replace("+0000", "Z")
+
+
+def parse_time(s):
+    if not s:
+        return None
+    try:
+        s = s.replace("Z", "+00:00")
+        dt = datetime.fromisoformat(s)
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=timezone.utc)
+        return dt
+    except ValueError:
+        return None
+
+
+class ActivityProber:
+    """Fetches kernel/terminal activity off the reconcile thread.
+
+    get() returns the freshest cached (kernels, terminals) tuple — each
+    element a list or None on fetch failure — and schedules a background
+    refresh. URL layout matches culler.go:155
+    (http://<nb>.<ns>.svc.<domain>/notebook/<ns>/<nb>/api/kernels)."""
+
+    def __init__(self, max_workers=8, timeout=10.0, fetcher=None):
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix="nb-probe")
+        self._cache = {}
+        self._inflight = set()
+        self._lock = threading.Lock()
+        self._timeout = timeout
+        self._fetch = fetcher or self._http_fetch
+
+    def _url(self, name, ns, resource):
+        domain = os.environ.get("CLUSTER_DOMAIN", "cluster.local")
+        if os.environ.get("DEV", "false") != "false":
+            return (f"http://localhost:8001/api/v1/namespaces/{ns}/services/"
+                    f"{name}:http-{name}/proxy/notebook/{ns}/{name}/api/{resource}")
+        return f"http://{name}.{ns}.svc.{domain}/notebook/{ns}/{name}/api/{resource}"
+
+    def _http_fetch(self, name, ns):
+        out = []
+        for resource in ("kernels", "terminals"):
+            try:
+                with urllib.request.urlopen(self._url(name, ns, resource),
+                                            timeout=self._timeout) as resp:
+                    if resp.status != 200:
+                        out.append(None)
+                        continue
+                    out.append(json.loads(resp.read().decode()))
+            except Exception:
+                out.append(None)
+        return tuple(out)
+
+    def _refresh(self, key):
+        try:
+            result = self._fetch(*key)
+            with self._lock:
+                self._cache[key] = (result, time.time())
+        finally:
+            with self._lock:
+                self._inflight.discard(key)
+
+    def get(self, name, ns, max_age=30.0):
+        key = (name, ns)
+        with self._lock:
+            cached = self._cache.get(key)
+            fresh = cached is not None and time.time() - cached[1] < max_age
+            if not fresh and key not in self._inflight:
+                self._inflight.add(key)
+                self._pool.submit(self._refresh, key)
+        return cached[0] if cached else (None, None)
+
+
+class SyncProber:
+    """Deterministic prober for tests: calls fetcher inline."""
+
+    def __init__(self, fetcher):
+        self._fetch = fetcher
+
+    def get(self, name, ns, max_age=None):
+        return self._fetch(name, ns)
+
+
+def all_kernels_idle(kernels):
+    return all(k.get("execution_state") == KERNEL_EXECUTION_STATE_IDLE
+               for k in kernels)
+
+
+def most_recent(times):
+    """Latest parseable RFC3339 time among ``times`` (culling_controller.go
+    getNotebookRecentTime), or None."""
+    best = None
+    for t in times:
+        dt = parse_time(t)
+        if dt is None:
+            return None
+        if best is None or dt > best:
+            best = dt
+    return best
+
+
+def update_last_activity(annotations, kernels, terminals):
+    """Merge kernel/terminal activity into LAST_ACTIVITY_ANNOTATION
+    (culling_controller.go:318-371). Returns True if updated."""
+    if kernels is None and terminals is None:
+        return False
+    updated = False
+    current = parse_time(annotations.get(nbapi.LAST_ACTIVITY_ANNOTATION))
+
+    if kernels:
+        if not all_kernels_idle(kernels):
+            # busy kernel ⇒ active right now
+            annotations[nbapi.LAST_ACTIVITY_ANNOTATION] = timestamp()
+            return True
+        recent = most_recent([k.get("last_activity") for k in kernels])
+        if recent is not None and (current is None or recent >= current):
+            annotations[nbapi.LAST_ACTIVITY_ANNOTATION] = timestamp(recent)
+            current = recent
+            updated = True
+
+    if terminals:
+        recent = most_recent([t.get("last_activity") for t in terminals])
+        if recent is not None and (current is None or recent >= current):
+            annotations[nbapi.LAST_ACTIVITY_ANNOTATION] = timestamp(recent)
+            updated = True
+
+    return updated
+
+
+def notebook_is_idle(annotations, idle_minutes):
+    """culling_controller.go:185-208 notebookIsIdle."""
+    if nbapi.STOP_ANNOTATION in annotations:
+        return False
+    last = parse_time(annotations.get(nbapi.LAST_ACTIVITY_ANNOTATION))
+    if last is None:
+        return False
+    return _now() > last + timedelta(minutes=idle_minutes)
+
+
+def set_stop_annotation(annotations, metrics=None, namespace="", name=""):
+    now = _now()
+    annotations[nbapi.STOP_ANNOTATION] = timestamp(now)
+    if metrics is not None:
+        metrics.culling_total.labels(namespace, name).inc()
+        metrics.last_culling_timestamp.labels(namespace, name).set(
+            now.timestamp())
+
+
+class CullingReconciler(Reconciler):
+    name = "culling-controller"
+    API = f"{nbapi.GROUP}/{nbapi.HUB_VERSION}"
+
+    def __init__(self, prober=None, metrics=None):
+        self.prober = prober or ActivityProber()
+        self.metrics = metrics
+
+    def setup(self, builder):
+        builder.watch_for(self.API, nbapi.KIND)
+
+    @property
+    def enabled(self):
+        return os.environ.get("ENABLE_CULLING", "false") == "true"
+
+    @property
+    def idle_minutes(self):
+        try:
+            return int(os.environ.get("CULL_IDLE_TIME",
+                                      DEFAULT_CULL_IDLE_TIME_MIN))
+        except ValueError:
+            return DEFAULT_CULL_IDLE_TIME_MIN
+
+    @property
+    def check_period_minutes(self):
+        try:
+            return int(os.environ.get("IDLENESS_CHECK_PERIOD",
+                                      DEFAULT_IDLENESS_CHECK_PERIOD_MIN))
+        except ValueError:
+            return DEFAULT_IDLENESS_CHECK_PERIOD_MIN
+
+    def _requeue(self):
+        return Result(requeue_after=self.check_period_minutes * 60.0)
+
+    def _check_period_passed(self, annotations):
+        stored = parse_time(annotations.get(
+            nbapi.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION))
+        if stored is None:
+            return False
+        return _now() > stored + timedelta(minutes=self.check_period_minutes)
+
+    def reconcile(self, req):
+        if not self.enabled:
+            return Result()
+        nb = self.store.try_get(self.API, nbapi.KIND, req.name, req.namespace)
+        if nb is None:
+            return Result()
+        annotations = dict(m.annotations_of(nb))
+
+        # stopped notebooks drop their activity annotations
+        # (culling_controller.go:120-139)
+        if nbapi.STOP_ANNOTATION in annotations:
+            removed = False
+            for key in (nbapi.LAST_ACTIVITY_ANNOTATION,
+                        nbapi.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION):
+                if key in annotations:
+                    annotations.pop(key)
+                    removed = True
+            if removed:
+                self._write_annotations(nb, annotations)
+            return self._requeue()
+
+        if (nbapi.LAST_ACTIVITY_ANNOTATION not in annotations or
+                nbapi.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION
+                not in annotations):
+            now = timestamp()
+            annotations[nbapi.LAST_ACTIVITY_ANNOTATION] = now
+            annotations[nbapi.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION] = now
+            self._write_annotations(nb, annotations)
+            return self._requeue()
+
+        if not self._check_period_passed(annotations):
+            return self._requeue()
+
+        kernels, terminals = self.prober.get(req.name, req.namespace)
+        update_last_activity(annotations, kernels, terminals)
+        annotations[nbapi.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION] = \
+            timestamp()
+
+        if notebook_is_idle(annotations, self.idle_minutes):
+            log.info("culling idle notebook %s/%s", req.namespace, req.name)
+            set_stop_annotation(annotations, self.metrics,
+                                req.namespace, req.name)
+
+        self._write_annotations(nb, annotations)
+        return self._requeue()
+
+    def _write_annotations(self, nb, annotations):
+        nb.setdefault("metadata", {})["annotations"] = annotations
+        self.store.update(nb)

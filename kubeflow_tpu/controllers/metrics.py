@@ -1,0 +1,136 @@
+"""Prometheus-style metrics, dependency-free.
+
+Counter/Gauge with label values and a text-format exposition, matching the
+metric families the reference exports (components/notebook-controller/pkg/
+metrics/metrics.go:27-56: notebook_create_total, notebook_create_failed_total,
+notebook_culling_total, last_notebook_culling_timestamp_seconds, and the
+scrape-time notebook_running gauge computed from live StatefulSets
+metrics.go:74-99).
+"""
+
+import threading
+
+
+class _Metric:
+    def __init__(self, name, help_text, label_names):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._values = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values):
+        if len(values) != len(self.label_names):
+            raise ValueError(f"{self.name}: expected labels "
+                             f"{self.label_names}, got {values}")
+        return _Child(self, tuple(str(v) for v in values))
+
+    def value(self, *values):
+        return self._values.get(tuple(str(v) for v in values), 0.0)
+
+    def samples(self):
+        with self._lock:
+            return dict(self._values)
+
+
+class _Child:
+    def __init__(self, metric, key):
+        self._m = metric
+        self._key = key
+
+    def inc(self, amount=1.0):
+        with self._m._lock:
+            self._m._values[self._key] = \
+                self._m._values.get(self._key, 0.0) + amount
+
+    def set(self, value):
+        with self._m._lock:
+            self._m._values[self._key] = float(value)
+
+
+class Counter(_Metric):
+    type_name = "counter"
+
+
+class Gauge(_Metric):
+    type_name = "gauge"
+
+
+class Registry:
+    def __init__(self):
+        self._metrics = []
+        self._collect_hooks = []
+
+    def counter(self, name, help_text, label_names=()):
+        c = Counter(name, help_text, label_names)
+        self._metrics.append(c)
+        return c
+
+    def gauge(self, name, help_text, label_names=()):
+        g = Gauge(name, help_text, label_names)
+        self._metrics.append(g)
+        return g
+
+    def add_collect_hook(self, fn):
+        """fn() runs before exposition — used for scrape-time gauges like
+        notebook_running (reference metrics.go:74-99)."""
+        self._collect_hooks.append(fn)
+
+    def exposition(self):
+        for fn in self._collect_hooks:
+            fn()
+        lines = []
+        for metric in self._metrics:
+            lines.append(f"# HELP {metric.name} {metric.help}")
+            lines.append(f"# TYPE {metric.name} {metric.type_name}")
+            samples = metric.samples()
+            if not samples and not metric.label_names:
+                lines.append(f"{metric.name} 0")
+            for key, value in sorted(samples.items()):
+                if metric.label_names:
+                    labels = ",".join(
+                        f'{n}="{v}"' for n, v in zip(metric.label_names, key))
+                    lines.append(f"{metric.name}{{{labels}}} {value:g}")
+                else:
+                    lines.append(f"{metric.name} {value:g}")
+        return "\n".join(lines) + "\n"
+
+
+class NotebookMetrics:
+    """The notebook-controller metric family (metrics.go:22-56)."""
+
+    def __init__(self, registry, store=None):
+        self.registry = registry
+        self.store = store
+        self.running = registry.gauge(
+            "notebook_running", "Current running notebooks in the cluster",
+            ("namespace",))
+        self.create_total = registry.counter(
+            "notebook_create_total", "Total times of creating notebooks",
+            ("namespace",))
+        self.create_failed_total = registry.counter(
+            "notebook_create_failed_total",
+            "Total failure times of creating notebooks", ("namespace",))
+        self.culling_total = registry.counter(
+            "notebook_culling_total", "Total times of culling notebooks",
+            ("namespace", "name"))
+        self.last_culling_timestamp = registry.gauge(
+            "last_notebook_culling_timestamp_seconds",
+            "Timestamp of the last notebook culling in seconds",
+            ("namespace", "name"))
+        registry.add_collect_hook(self._scrape_running)
+
+    def _scrape_running(self):
+        """Scrape-time gauge: count StatefulSets carrying the notebook-name
+        template label, per namespace (metrics.go:82-99)."""
+        if self.store is None:
+            return
+        counts = {}
+        for sts in self.store.list("apps/v1", "StatefulSet"):
+            tpl_labels = (sts.get("spec", {}).get("template", {})
+                          .get("metadata", {}).get("labels") or {})
+            if "notebook-name" in tpl_labels:
+                ns = sts["metadata"].get("namespace", "default")
+                counts[ns] = counts.get(ns, 0) + 1
+        for ns, n in counts.items():
+            self.running.labels(ns).set(n)

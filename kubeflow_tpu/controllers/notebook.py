@@ -1,0 +1,307 @@
+"""Notebook controller.
+
+Behavioral parity with components/notebook-controller/controllers/
+notebook_controller.go: Notebook CR → StatefulSet (+pod) + Service +
+optional Istio VirtualService; pod/sts events re-emitted onto the CR;
+pod status mirrored into CR status; restart-annotation pod bounce.
+
+TPU-first deltas (SURVEY.md §2 parallelism table):
+- ``google.com/tpu`` container limits schedule chips; the generator adds
+  TPU node selectors (accelerator type + topology) from the Notebook's
+  tpu annotations — the re-target of the reference's nvidia.com/gpu
+  plumbing (jupyter .../form.py:226-250).
+- TPU notebooks get ``TPU_PREMAPPED_BUFFER_SIZE``-free, libtpu-ready env:
+  the heavy env injection lives in the PodDefault plane (api/poddefault.py
+  tpu_worker_pod_default), keeping this controller workload-agnostic.
+"""
+
+import json
+import logging
+import os
+import re
+
+from ..api import builtin, notebook as nbapi
+from ..core import meta as m
+from ..core import reconcilehelper as helper
+from ..core.errors import NotFoundError
+from ..core.manager import EventRecorder, Reconciler, Request, Result
+
+log = logging.getLogger("kubeflow_tpu.controllers.notebook")
+
+_POD_ORDINAL_RE = re.compile(r"^(.+)-(\d+)$")
+
+
+def nb_name_from_involved_object(store, involved):
+    """Map an event's involvedObject to the owning Notebook name
+    (notebook_controller.go:612-651 nbNameFromInvolvedObject: pods are
+    looked up and resolved via their notebook-name label)."""
+    kind = involved.get("kind")
+    name = involved.get("name", "")
+    namespace = involved.get("namespace", "")
+    if kind == "StatefulSet":
+        return name
+    if kind == "Pod":
+        pod = store.try_get("v1", "Pod", name, namespace)
+        if pod is not None:
+            label = m.labels_of(pod).get("notebook-name")
+            if label:
+                return label
+        match = _POD_ORDINAL_RE.match(name)
+        if match:
+            return match.group(1)
+    return None
+
+
+def generate_statefulset(nb):
+    """notebook_controller.go:408 generateStatefulSet."""
+    name, ns = m.name_of(nb), m.namespace_of(nb)
+    replicas = 0 if nbapi.is_stopped(nb) else 1
+
+    pod_spec = m.deep_copy(m.deep_get(nb, "spec", "template", "spec") or {})
+    template_labels = {"statefulset": name, "notebook-name": name,
+                       "opendatahub.io/odh-managed": "true"}
+    # Notebook labels are copied onto the pod (incl. poddefault selectors,
+    # notebook_controller.go:436-440)
+    template_labels.update(m.labels_of(nb))
+
+    containers = pod_spec.setdefault("containers", [{}])
+    container = containers[0]
+    container.setdefault("name", name)
+    if not container.get("workingDir"):
+        container["workingDir"] = "/home/jovyan"
+    if not container.get("ports"):
+        container["ports"] = [{
+            "containerPort": nbapi.DEFAULT_CONTAINER_PORT,
+            "name": "notebook-port", "protocol": "TCP"}]
+
+    prefix = f"/notebook/{ns}/{name}"
+    env = container.setdefault("env", [])
+    for var in env:
+        if var.get("name") == nbapi.PREFIX_ENV_VAR:
+            var["value"] = prefix
+            break
+    else:
+        env.append({"name": nbapi.PREFIX_ENV_VAR, "value": prefix})
+
+    if os.environ.get("ADD_FSGROUP", "true") != "false":
+        if not pod_spec.get("securityContext"):
+            pod_spec["securityContext"] = {"fsGroup": nbapi.DEFAULT_FS_GROUP}
+
+    # --- TPU-native scheduling: chips → node selectors ---
+    chips, accelerator, topology = nbapi.tpu_request(nb)
+    if chips > 0:
+        selector = pod_spec.setdefault("nodeSelector", {})
+        if accelerator:
+            selector.setdefault(nbapi.TPU_ACCELERATOR_LABEL, accelerator)
+        if topology:
+            selector.setdefault(nbapi.TPU_TOPOLOGY_LABEL, topology)
+
+    return builtin.stateful_set(
+        name, ns, replicas,
+        selector_labels={"statefulset": name},
+        template_labels=template_labels,
+        pod_spec=pod_spec)
+
+
+def generate_service(nb):
+    """notebook_controller.go:474 generateService: port 80 → container
+    port, istio-friendly port name."""
+    name, ns = m.name_of(nb), m.namespace_of(nb)
+    port = nbapi.DEFAULT_CONTAINER_PORT
+    containers = m.deep_get(nb, "spec", "template", "spec", "containers") or []
+    if containers and containers[0].get("ports"):
+        port = containers[0]["ports"][0].get("containerPort", port)
+    return builtin.service(
+        name, ns, selector={"statefulset": name},
+        ports=[{"name": f"http-{name}", "port": nbapi.DEFAULT_SERVING_PORT,
+                "targetPort": port, "protocol": "TCP"}])
+
+
+def virtual_service_name(name, namespace):
+    return f"notebook-{namespace}-{name}"
+
+
+def generate_virtual_service(nb):
+    """notebook_controller.go:507 generateVirtualService: route
+    /notebook/<ns>/<name>/ through the gateway, honoring the rewrite-uri
+    and request-headers annotations."""
+    name, ns = m.name_of(nb), m.namespace_of(nb)
+    annotations = m.annotations_of(nb)
+    prefix = f"/notebook/{ns}/{name}/"
+    rewrite = annotations.get(nbapi.REWRITE_URI_ANNOTATION) or prefix
+    cluster_domain = os.environ.get("CLUSTER_DOMAIN", "cluster.local")
+    gateway = os.environ.get("ISTIO_GATEWAY") or "kubeflow/kubeflow-gateway"
+    host = f"{name}.{ns}.svc.{cluster_domain}"
+
+    headers_set = {}
+    raw = annotations.get(nbapi.HEADERS_REQUEST_SET_ANNOTATION)
+    if raw:
+        try:
+            headers_set = json.loads(raw)
+        except (ValueError, TypeError):
+            headers_set = {}
+
+    spec = {
+        "hosts": ["*"],
+        "gateways": [gateway],
+        "http": [{
+            "headers": {"request": {"set": headers_set}},
+            "match": [{"uri": {"prefix": prefix}}],
+            "rewrite": {"uri": rewrite},
+            "route": [{"destination": {
+                "host": host,
+                "port": {"number": nbapi.DEFAULT_SERVING_PORT}}}],
+        }],
+    }
+    return builtin.virtual_service(virtual_service_name(name, ns), ns, spec)
+
+
+def pod_cond_to_notebook_cond(pod_cond):
+    """notebook_controller.go:351 PodCondToNotebookCond."""
+    cond = {}
+    for src, dst in (("type", "type"), ("status", "status"),
+                     ("reason", "reason"), ("message", "message"),
+                     ("lastProbeTime", "lastProbeTime"),
+                     ("lastTransitionTime", "lastTransitionTime")):
+        if pod_cond.get(src):
+            cond[dst] = pod_cond[src]
+    cond.setdefault("lastTransitionTime", m.now_iso())
+    return cond
+
+
+def create_notebook_status(nb, sts, pod):
+    """notebook_controller.go:290 createNotebookStatus: readyReplicas from
+    the sts, containerState from the same-named container, conditions
+    mirrored from the pod."""
+    status = {
+        "conditions": [],
+        "readyReplicas": m.deep_get(sts, "status", "readyReplicas",
+                                    default=0) if sts else 0,
+        "containerState": {},
+    }
+    if not pod or not pod.get("status"):
+        return status
+    for cs in m.deep_get(pod, "status", "containerStatuses", default=[]) or []:
+        if cs.get("name") == m.name_of(nb):
+            status["containerState"] = m.deep_copy(cs.get("state") or {})
+            break
+    status["conditions"] = [
+        pod_cond_to_notebook_cond(c)
+        for c in m.deep_get(pod, "status", "conditions", default=[]) or []]
+    return status
+
+
+class NotebookReconciler(Reconciler):
+    name = "notebook-controller"
+    API = f"{nbapi.GROUP}/{nbapi.HUB_VERSION}"
+
+    def __init__(self, metrics=None):
+        self.metrics = metrics
+        self.recorder = None
+
+    def setup(self, builder):
+        self.recorder = EventRecorder(self.store, self.name)
+        builder.watch_for(self.API, nbapi.KIND)
+        builder.watch_owned("apps/v1", "StatefulSet", nbapi.KIND)
+        builder.watch_owned("v1", "Service", nbapi.KIND)
+        builder.watch_owned("networking.istio.io/v1alpha3", "VirtualService",
+                            nbapi.KIND)
+        builder.watch_owned("v1", "Pod", nbapi.KIND)
+        builder.watch_mapped("v1", "Event", self._map_event,
+                             predicate=self._event_predicate)
+
+    # --- event re-emission plumbing (notebook_controller.go:95-119) ---
+
+    def _event_predicate(self, ev):
+        involved = ev.object.get("involvedObject") or {}
+        if involved.get("kind") not in ("Pod", "StatefulSet"):
+            return False
+        # don't re-emit our own re-emissions
+        src = (ev.object.get("source") or {}).get("component", "")
+        return src != self.name
+
+    def _map_event(self, ev):
+        involved = ev.object.get("involvedObject") or {}
+        nb_name = nb_name_from_involved_object(self.store, involved)
+        if not nb_name:
+            return
+        if self.store.try_get(self.API, nbapi.KIND, nb_name,
+                              m.namespace_of(ev.object)) is None:
+            return
+        yield Request(m.name_of(ev.object), m.namespace_of(ev.object))
+
+    def _try_reemit_event(self, req):
+        event = self.store.try_get("v1", "Event", req.name, req.namespace)
+        if event is None:
+            return False
+        involved = event.get("involvedObject") or {}
+        nb_name = nb_name_from_involved_object(self.store, involved)
+        if not nb_name:
+            return True
+        nb = self.store.try_get(self.API, nbapi.KIND, nb_name, req.namespace)
+        if nb is None:
+            return True
+        kind = involved.get("kind", "").lower()
+        self.recorder.event(
+            nb, event.get("type", "Normal"), event.get("reason", ""),
+            f"Reissued from {kind}/{involved.get('name')}: "
+            f"{event.get('message', '')}")
+        return True
+
+    # ------------------------------------------------------ reconcile
+
+    def reconcile(self, req):
+        if self._try_reemit_event(req):
+            return Result()
+
+        nb = self.store.try_get(self.API, nbapi.KIND, req.name, req.namespace)
+        if nb is None:
+            return Result()
+        # foreground deletion: do nothing while terminating
+        # (notebook_controller.go:131-137)
+        if m.deep_get(nb, "metadata", "deletionTimestamp"):
+            return Result()
+
+        name, ns = req.name, req.namespace
+
+        sts = generate_statefulset(nb)
+        m.set_controller_reference(sts, nb)
+        created = self.store.try_get("apps/v1", "StatefulSet", name, ns) is None
+        if created and self.metrics:
+            self.metrics.create_total.labels(ns).inc()
+        try:
+            live_sts = helper.statefulset(self.store, sts)
+        except Exception:
+            if created and self.metrics:
+                self.metrics.create_failed_total.labels(ns).inc()
+            raise
+
+        svc = generate_service(nb)
+        m.set_controller_reference(svc, nb)
+        helper.service(self.store, svc)
+
+        if os.environ.get("USE_ISTIO") == "true":
+            vs = generate_virtual_service(nb)
+            m.set_controller_reference(vs, nb)
+            helper.virtual_service(self.store, vs)
+
+        pod = self.store.try_get("v1", "Pod", f"{name}-0", ns)
+
+        status = create_notebook_status(nb, live_sts, pod)
+        if status != nb.get("status"):
+            nb["status"] = status
+            nb = self.store.update_status(nb)
+
+        # restart annotation → bounce the pod once
+        # (notebook_controller.go:234-269)
+        annotations = m.annotations_of(nb)
+        if annotations.get(nbapi.RESTART_ANNOTATION) == "true":
+            if pod is not None:
+                try:
+                    self.store.delete("v1", "Pod", f"{name}-0", ns)
+                except NotFoundError:
+                    pass
+            self.store.patch(self.API, nbapi.KIND, name, ns, {
+                "metadata": {"annotations": {nbapi.RESTART_ANNOTATION: None}}})
+
+        return Result()

@@ -1,0 +1,23 @@
+"""Core reconcile runtime: document store, watches, workqueue, controllers.
+
+The architectural invariant inherited from the reference platform
+(SURVEY.md §1): all cross-component communication flows through an API
+server as documents — desired state as objects, level-triggered
+reconciliation, idempotent generators. ``ObjectStore`` is that boundary,
+playing the role controller-runtime's envtest plays in the reference
+(reference: components/notebook-controller/controllers/suite_test.go:56).
+"""
+
+from .errors import (ApiError, NotFoundError, AlreadyExistsError,
+                     ConflictError, InvalidError, ForbiddenError)
+from .store import ObjectStore, WatchEvent
+from .workqueue import RateLimitingQueue
+from .manager import Manager, Reconciler, Request, Result
+from . import reconcilehelper
+
+__all__ = [
+    "ApiError", "NotFoundError", "AlreadyExistsError", "ConflictError",
+    "InvalidError", "ForbiddenError", "ObjectStore", "WatchEvent",
+    "RateLimitingQueue", "Manager", "Reconciler", "Request", "Result",
+    "reconcilehelper",
+]

@@ -1,0 +1,68 @@
+"""API error taxonomy, mirroring the k8s apimachinery StatusError reasons
+the reference controllers branch on (e.g. apierrs.IsNotFound at
+reference components/common/reconcilehelper/util.go:22)."""
+
+
+class ApiError(Exception):
+    """Base class for API-server errors."""
+
+    code = 500
+    reason = "InternalError"
+
+    def __init__(self, message="", details=None):
+        super().__init__(message or self.reason)
+        self.message = message or self.reason
+        self.details = details or {}
+
+    def to_status(self):
+        return {
+            "kind": "Status",
+            "apiVersion": "v1",
+            "status": "Failure",
+            "message": self.message,
+            "reason": self.reason,
+            "code": self.code,
+            "details": self.details,
+        }
+
+
+class NotFoundError(ApiError):
+    code = 404
+    reason = "NotFound"
+
+
+class AlreadyExistsError(ApiError):
+    code = 409
+    reason = "AlreadyExists"
+
+
+class ConflictError(ApiError):
+    """Optimistic-concurrency (resourceVersion) conflict."""
+
+    code = 409
+    reason = "Conflict"
+
+
+class InvalidError(ApiError):
+    code = 422
+    reason = "Invalid"
+
+
+class ForbiddenError(ApiError):
+    code = 403
+    reason = "Forbidden"
+
+
+class AdmissionDeniedError(ApiError):
+    """A mutating/validating admission hook rejected the request."""
+
+    code = 400
+    reason = "AdmissionDenied"
+
+
+def is_not_found(err):
+    return isinstance(err, NotFoundError)
+
+
+def is_conflict(err):
+    return isinstance(err, ConflictError)

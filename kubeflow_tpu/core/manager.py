@@ -1,0 +1,305 @@
+"""Controller manager: watch → workqueue → Reconcile.
+
+The runtime the reference gets from controller-runtime (SetupWithManager,
+For/Owns/Watches, predicates, leader election — reference
+components/notebook-controller/controllers/notebook_controller.go:721-754),
+rebuilt for the in-process store. Two execution modes:
+
+- ``start()``: real threaded mode — one pump thread per watch source plus a
+  worker pool per controller.
+- ``run_sync()``: deterministic single-threaded pump used by the
+  envtest-style integration suites (drain events, reconcile until the
+  system is quiescent) — removing the sleep/poll flakiness the reference's
+  Eventually() specs tolerate.
+"""
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from . import meta as m
+from .errors import ConflictError, NotFoundError
+from .store import DELETED
+from .workqueue import RateLimitingQueue
+
+log = logging.getLogger("kubeflow_tpu.core")
+
+
+@dataclass(frozen=True)
+class Request:
+    name: str
+    namespace: str = ""
+
+    def __repr__(self):
+        return f"{self.namespace}/{self.name}" if self.namespace else self.name
+
+
+@dataclass
+class Result:
+    requeue: bool = False
+    requeue_after: float = 0.0
+
+
+class Reconciler:
+    """Base class for controllers. Subclasses implement reconcile() and
+    setup(), which declares watch sources on the builder."""
+
+    name = "reconciler"
+
+    def reconcile(self, req):  # -> Result | None
+        raise NotImplementedError
+
+    def setup(self, builder):
+        raise NotImplementedError
+
+
+class _Source:
+    def __init__(self, api_version, kind, namespace, mapper, predicate):
+        self.api_version = api_version
+        self.kind = kind
+        self.namespace = namespace
+        self.mapper = mapper          # fn(WatchEvent) -> iterable[Request]
+        self.predicate = predicate    # fn(WatchEvent) -> bool
+        self.watch = None
+
+
+class ControllerBuilder:
+    """Fluent watch registration, mirroring controller-runtime's builder."""
+
+    def __init__(self, controller):
+        self._c = controller
+
+    def watch_for(self, api_version, kind, namespace=None, predicate=None):
+        """Primary resource: events map to the object's own Request."""
+        def mapper(ev):
+            yield Request(m.name_of(ev.object), m.namespace_of(ev.object))
+        self._c.sources.append(
+            _Source(api_version, kind, namespace, mapper, predicate))
+        return self
+
+    def watch_owned(self, api_version, kind, owner_kind, namespace=None,
+                    predicate=None):
+        """Dependent resource: events map to the controlling owner of
+        ``owner_kind`` (controller-runtime Owns())."""
+        def mapper(ev):
+            ref = m.controller_owner(ev.object)
+            if ref and ref.get("kind") == owner_kind:
+                yield Request(ref["name"], m.namespace_of(ev.object))
+        self._c.sources.append(
+            _Source(api_version, kind, namespace, mapper, predicate))
+        return self
+
+    def watch_mapped(self, api_version, kind, mapper, namespace=None,
+                     predicate=None):
+        """Arbitrary mapping (controller-runtime Watches + handler.MapFunc,
+        e.g. event→notebook-name at notebook_controller.go:612-681)."""
+        self._c.sources.append(
+            _Source(api_version, kind, namespace, mapper, predicate))
+        return self
+
+
+class _Controller:
+    def __init__(self, reconciler, workers=1):
+        self.reconciler = reconciler
+        self.name = reconciler.name
+        self.queue = RateLimitingQueue()
+        self.sources = []
+        self.workers = workers
+        self.inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    def enqueue_event(self, source, ev):
+        if source.predicate and not source.predicate(ev):
+            return
+        for req in source.mapper(ev):
+            self.queue.add(req)
+
+    def process_one(self, req):
+        try:
+            result = self.reconciler.reconcile(req)
+        except ConflictError:
+            # stale cache write — requeue immediately; the standard
+            # optimistic-concurrency dance (SURVEY.md §5)
+            self.queue.add_rate_limited(req)
+            return
+        except NotFoundError:
+            self.queue.forget(req)
+            return
+        except Exception:
+            log.exception("[%s] reconcile %s failed", self.name, req)
+            self.queue.add_rate_limited(req)
+            return
+        self.queue.forget(req)
+        if result is not None:
+            if result.requeue_after and result.requeue_after > 0:
+                self.queue.add_after(req, result.requeue_after)
+            elif result.requeue:
+                self.queue.add_rate_limited(req)
+
+
+class Manager:
+    def __init__(self, store):
+        self.store = store
+        self.controllers = []
+        self._threads = []
+        self._stop = threading.Event()
+        self._leader_elected = threading.Event()
+        self._leader_elected.set()  # single-process: we are always leader
+
+    def add(self, reconciler, workers=1):
+        c = _Controller(reconciler, workers=workers)
+        reconciler.store = self.store
+        reconciler.manager = self
+        reconciler.setup(ControllerBuilder(c))
+        self.controllers.append(c)
+        return c
+
+    # ----------------------------------------------------------- threaded
+
+    def start(self):
+        for c in self.controllers:
+            for src in c.sources:
+                src.watch = self.store.watch(src.api_version, src.kind,
+                                             src.namespace)
+                t = threading.Thread(target=self._pump, args=(c, src),
+                                     daemon=True,
+                                     name=f"{c.name}-watch-{src.kind}")
+                t.start()
+                self._threads.append(t)
+            for i in range(c.workers):
+                t = threading.Thread(target=self._work, args=(c,),
+                                     daemon=True, name=f"{c.name}-worker-{i}")
+                t.start()
+                self._threads.append(t)
+
+    def _pump(self, controller, src):
+        for ev in src.watch:
+            if self._stop.is_set():
+                return
+            controller.enqueue_event(src, ev)
+
+    def _work(self, controller):
+        while not self._stop.is_set():
+            req = controller.queue.get(timeout=0.2)
+            if req is None:
+                continue
+            with controller._inflight_lock:
+                controller.inflight += 1
+            try:
+                controller.process_one(req)
+            finally:
+                controller.queue.done(req)
+                with controller._inflight_lock:
+                    controller.inflight -= 1
+
+    def stop(self):
+        self._stop.set()
+        for c in self.controllers:
+            c.queue.shutdown()
+            for src in c.sources:
+                if src.watch is not None:
+                    src.watch.stop()
+
+    def wait_idle(self, timeout=10.0, settle=0.05):
+        """Block until every watch queue and workqueue is drained and no
+        reconcile is in flight, stable for ``settle`` seconds."""
+        deadline = time.time() + timeout
+        stable_since = None
+        while time.time() < deadline:
+            busy = False
+            for c in self.controllers:
+                if not c.queue.empty() or c.inflight:
+                    busy = True
+                    break
+                for src in c.sources:
+                    if src.watch is not None and not src.watch.q.empty():
+                        busy = True
+                        break
+                if busy:
+                    break
+            if busy:
+                stable_since = None
+            else:
+                if stable_since is None:
+                    stable_since = time.time()
+                elif time.time() - stable_since >= settle:
+                    return True
+            time.sleep(0.005)
+        return False
+
+    # ---------------------------------------------------------- sync mode
+
+    def start_sync(self):
+        """Open watches without threads; drive with run_sync()."""
+        for c in self.controllers:
+            for src in c.sources:
+                src.watch = self.store.watch(src.api_version, src.kind,
+                                             src.namespace)
+
+    def run_sync(self, max_rounds=200):
+        """Deterministically pump events + reconcile until quiescent.
+        Returns number of reconcile invocations performed."""
+        total = 0
+        for _ in range(max_rounds):
+            progressed = False
+            for c in self.controllers:
+                for src in c.sources:
+                    while src.watch is not None and not src.watch.q.empty():
+                        ev = src.watch.q.get()
+                        if ev is None:
+                            break
+                        c.enqueue_event(src, ev)
+                        progressed = True
+                while c.queue.has_ready():
+                    req = c.queue.get(block=False)
+                    if req is None:
+                        break
+                    try:
+                        c.process_one(req)
+                    finally:
+                        c.queue.done(req)
+                    total += 1
+                    progressed = True
+            if not progressed:
+                return total
+        return total
+
+
+class EventRecorder:
+    """Records v1 Events against an object (controller-runtime
+    record.EventRecorder; the reference re-emits pod/sts events onto the
+    Notebook CR, notebook_controller.go:95-119)."""
+
+    def __init__(self, store, component):
+        self.store = store
+        self.component = component
+        self._seq = 0
+
+    def event(self, obj, event_type, reason, message):
+        self._seq += 1
+        name = f"{m.name_of(obj)}.{self.component}.{self._seq:08x}"
+        ev = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"name": name, "namespace": m.namespace_of(obj) or "default"},
+            "type": event_type,
+            "reason": reason,
+            "message": message,
+            "source": {"component": self.component},
+            "involvedObject": {
+                "apiVersion": obj.get("apiVersion"),
+                "kind": obj.get("kind"),
+                "name": m.name_of(obj),
+                "namespace": m.namespace_of(obj),
+                "uid": m.uid_of(obj),
+            },
+            "firstTimestamp": m.now_iso(),
+            "lastTimestamp": m.now_iso(),
+            "count": 1,
+        }
+        try:
+            return self.store.create(ev)
+        except Exception:
+            log.debug("failed to record event", exc_info=True)
+            return None

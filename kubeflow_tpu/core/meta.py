@@ -1,0 +1,163 @@
+"""Object-metadata helpers for unstructured (dict) API objects.
+
+Objects are plain JSON-style dicts with apiVersion/kind/metadata/spec/status,
+the same document model the reference exchanges through the kube-apiserver.
+"""
+
+import copy
+import time
+import uuid
+
+
+def api_group(api_version):
+    """'kubeflow.org/v1' -> 'kubeflow.org'; 'v1' -> '' (core group)."""
+    if "/" in api_version:
+        return api_version.split("/", 1)[0]
+    return ""
+
+
+def api_ver(api_version):
+    """'kubeflow.org/v1' -> 'v1'."""
+    return api_version.split("/")[-1]
+
+
+def gvk(obj):
+    return (api_group(obj.get("apiVersion", "")), obj.get("kind", ""))
+
+
+def name_of(obj):
+    return obj.get("metadata", {}).get("name", "")
+
+
+def namespace_of(obj):
+    return obj.get("metadata", {}).get("namespace", "")
+
+
+def uid_of(obj):
+    return obj.get("metadata", {}).get("uid", "")
+
+
+def labels_of(obj):
+    return obj.get("metadata", {}).get("labels") or {}
+
+
+def annotations_of(obj):
+    return obj.get("metadata", {}).get("annotations") or {}
+
+
+def set_label(obj, key, value):
+    obj.setdefault("metadata", {}).setdefault("labels", {})[key] = value
+
+
+def set_annotation(obj, key, value):
+    obj.setdefault("metadata", {}).setdefault("annotations", {})[key] = value
+
+
+def new_uid():
+    return str(uuid.uuid4())
+
+
+def now_iso():
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def owner_reference(owner, controller=True, block_owner_deletion=True):
+    """Build an ownerReference to ``owner`` (used for GC + Owns() watches)."""
+    return {
+        "apiVersion": owner["apiVersion"],
+        "kind": owner["kind"],
+        "name": name_of(owner),
+        "uid": uid_of(owner),
+        "controller": controller,
+        "blockOwnerDeletion": block_owner_deletion,
+    }
+
+
+def set_controller_reference(obj, owner):
+    refs = obj.setdefault("metadata", {}).setdefault("ownerReferences", [])
+    for ref in refs:
+        if ref.get("uid") == uid_of(owner):
+            return
+    refs.append(owner_reference(owner))
+
+
+def controller_owner(obj):
+    """The controlling ownerReference, or None."""
+    for ref in obj.get("metadata", {}).get("ownerReferences", []) or []:
+        if ref.get("controller"):
+            return ref
+    return None
+
+
+def is_owned_by_uid(obj, uid):
+    for ref in obj.get("metadata", {}).get("ownerReferences", []) or []:
+        if ref.get("uid") == uid:
+            return True
+    return False
+
+
+def match_labels(labels, match):
+    for k, v in (match or {}).items():
+        if labels.get(k) != v:
+            return False
+    return True
+
+
+def match_selector(selector, labels):
+    """K8s LabelSelector semantics: matchLabels AND matchExpressions.
+
+    Empty/None selector matches everything (reference:
+    components/admission-webhook/main.go:70-96 filterPodDefaults).
+    """
+    if not selector:
+        return True
+    if not match_labels(labels, selector.get("matchLabels")):
+        return False
+    for expr in selector.get("matchExpressions") or []:
+        key = expr.get("key")
+        op = expr.get("operator")
+        values = expr.get("values") or []
+        if op == "In":
+            if labels.get(key) not in values:
+                return False
+        elif op == "NotIn":
+            if key in labels and labels[key] in values:
+                return False
+        elif op == "Exists":
+            if key not in labels:
+                return False
+        elif op == "DoesNotExist":
+            if key in labels:
+                return False
+        else:
+            return False
+    return True
+
+
+def deep_get(obj, *path, default=None):
+    cur = obj
+    for p in path:
+        if not isinstance(cur, dict) or p not in cur:
+            return default
+        cur = cur[p]
+    return cur
+
+
+def deep_set(obj, value, *path):
+    cur = obj
+    for p in path[:-1]:
+        cur = cur.setdefault(p, {})
+    cur[path[-1]] = value
+
+
+def deep_copy(obj):
+    return copy.deepcopy(obj)
+
+
+def strip_managed_meta(obj):
+    """Remove server-managed metadata (for round-trip comparisons)."""
+    meta = obj.get("metadata", {})
+    for k in ("uid", "resourceVersion", "creationTimestamp", "generation",
+              "deletionTimestamp"):
+        meta.pop(k, None)
+    return obj

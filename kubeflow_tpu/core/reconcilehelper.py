@@ -1,0 +1,106 @@
+"""Create-or-update helpers with owned-field diff predicates.
+
+Behavioral parity with the reference's shared reconcile helpers
+(components/common/reconcilehelper/util.go:18-219): create if absent;
+otherwise copy only the *owned* fields (labels, annotations, replicas,
+pod template / selector+ports / spec) onto the live object and write back
+only when something actually changed — keeping reconciles idempotent and
+conflict-cheap.
+"""
+
+import logging
+
+from . import meta as m
+from .errors import NotFoundError
+
+log = logging.getLogger("kubeflow_tpu.core.reconcilehelper")
+
+
+def _copy_meta_maps(desired, live):
+    """Labels/annotations: desired wins; report True if live differed on any
+    key it had (util.go:107-121 semantics)."""
+    changed = False
+    for field in ("labels", "annotations"):
+        want = m.deep_get(desired, "metadata", field) or {}
+        have = m.deep_get(live, "metadata", field) or {}
+        for k, v in have.items():
+            if want.get(k) != v:
+                changed = True
+        live.setdefault("metadata", {})[field] = dict(want)
+    return changed
+
+
+def copy_statefulset_fields(desired, live):
+    """util.go:107 CopyStatefulSetFields: labels, annotations, replicas,
+    pod-template spec."""
+    changed = _copy_meta_maps(desired, live)
+    want_repl = m.deep_get(desired, "spec", "replicas")
+    have_repl = m.deep_get(live, "spec", "replicas")
+    if want_repl != have_repl:
+        m.deep_set(live, want_repl, "spec", "replicas")
+        changed = True
+    want_tpl = m.deep_get(desired, "spec", "template", "spec")
+    have_tpl = m.deep_get(live, "spec", "template", "spec")
+    if want_tpl != have_tpl:
+        changed = True
+    m.deep_set(live, m.deep_copy(want_tpl), "spec", "template", "spec")
+    return changed
+
+
+copy_deployment_fields = copy_statefulset_fields  # identical owned fields
+
+
+def copy_service_fields(desired, live):
+    """util.go:166 CopyServiceFields: never touch clusterIP — only
+    selector and ports (plus meta maps)."""
+    changed = _copy_meta_maps(desired, live)
+    for field in ("selector", "ports"):
+        want = m.deep_get(desired, "spec", field)
+        have = m.deep_get(live, "spec", field)
+        if want != have:
+            changed = True
+        m.deep_set(live, m.deep_copy(want), "spec", field)
+    return changed
+
+
+def copy_spec(desired, live):
+    """util.go:199 CopyVirtualService: whole-spec ownership."""
+    want = desired.get("spec")
+    if want is None:
+        return False
+    if live.get("spec") != want:
+        live["spec"] = m.deep_copy(want)
+        return True
+    return False
+
+
+def create_or_update(store, desired, copy_fields=copy_spec):
+    """Get-or-create then copy-and-update-if-changed (util.go:18-101).
+    Returns the live object."""
+    api_version, kind = desired["apiVersion"], desired["kind"]
+    name, ns = m.name_of(desired), m.namespace_of(desired) or None
+    try:
+        live = store.get(api_version, kind, name, ns)
+    except NotFoundError:
+        log.info("creating %s %s/%s", kind, ns, name)
+        return store.create(desired)
+    if copy_fields(desired, live):
+        log.info("updating %s %s/%s", kind, ns, name)
+        return store.update(live)
+    return live
+
+
+def statefulset(store, desired):
+    return create_or_update(store, desired, copy_statefulset_fields)
+
+
+def deployment(store, desired):
+    return create_or_update(store, desired, copy_deployment_fields)
+
+
+def service(store, desired):
+    return create_or_update(store, desired, copy_service_fields)
+
+
+def virtual_service(store, desired):
+    return create_or_update(store, desired, copy_spec)

@@ -1,0 +1,358 @@
+"""In-process API server: a versioned, watchable document store.
+
+This is the process boundary everything else talks through — controllers,
+web backends, and tests. It reproduces the kube-apiserver semantics the
+reference platform is built on (SURVEY.md §1 "control flow between layers
+is always through the Kubernetes API server"):
+
+- monotonically increasing ``resourceVersion`` with optimistic concurrency
+  on update (Conflict on stale writes),
+- ``generation`` bumped on spec changes,
+- ADDED/MODIFIED/DELETED watch streams per (group, kind),
+- mutating/validating admission hooks on create/update (the reference's
+  admission chain, SURVEY.md §3.5),
+- finalizers (deletionTimestamp is set, object removed once finalizers
+  drain) and ownerReference cascade GC,
+- label-selector list filtering,
+- multi-version kinds via registered converters (the reference Notebook
+  CRD serves v1alpha1/v1beta1/v1 via hub-and-spoke conversion,
+  components/notebook-controller/api/v1beta1/notebook_conversion.go).
+
+Single-writer-per-object is achieved with a global lock; watch dispatch is
+lock-free copies into per-watcher queues so a slow consumer can't block a
+reconcile (the reference gets the same property from etcd + client-go
+informers).
+"""
+
+import queue
+import threading
+from dataclasses import dataclass
+
+from . import meta as m
+from .errors import (AlreadyExistsError, ConflictError, InvalidError,
+                     NotFoundError)
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: str          # ADDED | MODIFIED | DELETED
+    object: dict
+
+
+class _Watch:
+    """One subscriber's event stream."""
+
+    def __init__(self, store, gk, namespace):
+        self._store = store
+        self.gk = gk
+        self.namespace = namespace
+        self.q = queue.Queue()
+        self.closed = False
+
+    def deliver(self, event):
+        if not self.closed:
+            self.q.put(event)
+
+    def __iter__(self):
+        while True:
+            ev = self.q.get()
+            if ev is None:
+                return
+            yield ev
+
+    def get(self, timeout=None):
+        ev = self.q.get(timeout=timeout)
+        if ev is None:
+            raise StopIteration
+        return ev
+
+    def stop(self):
+        self.closed = True
+        self.q.put(None)
+        self._store._remove_watch(self)
+
+
+class ObjectStore:
+    """Thread-safe versioned object store with watches and admission."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        # (group, kind) -> {(namespace, name) -> object}
+        self._objects = {}
+        self._rv = 0
+        self._watches = []
+        # ordered list of (match_fn, hook_fn) — mutating admission
+        self._mutating_hooks = []
+        self._validating_hooks = []
+        # (group, kind) -> converter fn(obj, to_version) -> obj
+        self._converters = {}
+        # kinds that are cluster-scoped (no namespace)
+        self._cluster_scoped = {("", "Namespace"), ("", "Node"),
+                                ("", "PersistentVolume")}
+
+    # ------------------------------------------------------------- scoping
+
+    def register_cluster_scoped(self, group, kind):
+        with self._lock:
+            self._cluster_scoped.add((group, kind))
+
+    def is_cluster_scoped(self, group, kind):
+        return (group, kind) in self._cluster_scoped
+
+    def register_converter(self, group, kind, fn):
+        """fn(obj, to_version) -> converted obj (hub-and-spoke)."""
+        self._converters[(group, kind)] = fn
+
+    # ----------------------------------------------------------- admission
+
+    def register_mutating_hook(self, hook, match=None):
+        """hook(operation, obj, old) -> obj (may mutate); match(group, kind,
+        namespace) -> bool gates which requests the hook sees. Raising
+        AdmissionDeniedError rejects the request — mirroring the reference's
+        webhook admission chain (admission-webhook/main.go:597)."""
+        self._mutating_hooks.append((match or (lambda g, k, ns: True), hook))
+
+    def register_validating_hook(self, hook, match=None):
+        self._validating_hooks.append((match or (lambda g, k, ns: True), hook))
+
+    def _run_admission(self, operation, obj, old):
+        g, k = m.gvk(obj)
+        ns = m.namespace_of(obj)
+        for match, hook in self._mutating_hooks:
+            if match(g, k, ns):
+                result = hook(operation, obj, old)
+                if result is not None:
+                    obj = result
+        for match, hook in self._validating_hooks:
+            if match(g, k, ns):
+                hook(operation, obj, old)
+        return obj
+
+    # ------------------------------------------------------------- helpers
+
+    def _bucket(self, group, kind):
+        return self._objects.setdefault((group, kind), {})
+
+    def _key(self, group, kind, namespace, name):
+        if self.is_cluster_scoped(group, kind):
+            return ("", name)
+        return (namespace or "default", name)
+
+    def _next_rv(self):
+        self._rv += 1
+        return str(self._rv)
+
+    def _dispatch(self, event_type, obj):
+        ev = WatchEvent(event_type, m.deep_copy(obj))
+        gk = m.gvk(obj)
+        ns = m.namespace_of(obj)
+        for w in list(self._watches):
+            if w.gk != gk:
+                continue
+            if w.namespace and w.namespace != ns:
+                continue
+            w.deliver(ev)
+
+    def _remove_watch(self, w):
+        with self._lock:
+            if w in self._watches:
+                self._watches.remove(w)
+
+    def _maybe_convert(self, obj, api_version):
+        """Serve the object at the requested apiVersion if a converter exists."""
+        if api_version and obj.get("apiVersion") != api_version:
+            conv = self._converters.get(m.gvk(obj))
+            if conv is not None:
+                return conv(m.deep_copy(obj), m.api_ver(api_version))
+        return m.deep_copy(obj)
+
+    # ----------------------------------------------------------------- api
+
+    def create(self, obj):
+        obj = m.deep_copy(obj)
+        if not obj.get("apiVersion") or not obj.get("kind"):
+            raise InvalidError("apiVersion and kind are required")
+        name = m.name_of(obj)
+        if not name:
+            raise InvalidError("metadata.name is required")
+        g, k = m.gvk(obj)
+        with self._lock:
+            key = self._key(g, k, m.namespace_of(obj), name)
+            if not self.is_cluster_scoped(g, k):
+                obj.setdefault("metadata", {})["namespace"] = key[0]
+            bucket = self._bucket(g, k)
+            if key in bucket:
+                raise AlreadyExistsError(f"{k} {key[1]!r} already exists")
+            obj = self._run_admission("CREATE", obj, None)
+            md = obj.setdefault("metadata", {})
+            md["uid"] = m.new_uid()
+            md["creationTimestamp"] = m.now_iso()
+            md["generation"] = 1
+            md["resourceVersion"] = self._next_rv()
+            bucket[key] = obj
+            self._dispatch(ADDED, obj)
+            return m.deep_copy(obj)
+
+    def get(self, api_version, kind, name, namespace=None):
+        g = m.api_group(api_version)
+        with self._lock:
+            bucket = self._bucket(g, kind)
+            key = self._key(g, kind, namespace, name)
+            obj = bucket.get(key)
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace or ''}/{name} not found")
+            return self._maybe_convert(obj, api_version)
+
+    def try_get(self, api_version, kind, name, namespace=None):
+        try:
+            return self.get(api_version, kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def list(self, api_version, kind, namespace=None, label_selector=None,
+             field_match=None):
+        """List objects; label_selector is a dict of exact-match labels or a
+        full LabelSelector; field_match is {dotted.path: value}."""
+        g = m.api_group(api_version)
+        out = []
+        with self._lock:
+            for (ns, _), obj in sorted(self._bucket(g, kind).items()):
+                if namespace and not self.is_cluster_scoped(g, kind) \
+                        and ns != namespace:
+                    continue
+                if label_selector:
+                    sel = label_selector
+                    if "matchLabels" not in sel and "matchExpressions" not in sel:
+                        sel = {"matchLabels": sel}
+                    if not m.match_selector(sel, m.labels_of(obj)):
+                        continue
+                if field_match:
+                    ok = True
+                    for path, want in field_match.items():
+                        if m.deep_get(obj, *path.split(".")) != want:
+                            ok = False
+                            break
+                    if not ok:
+                        continue
+                out.append(self._maybe_convert(obj, api_version))
+        return out
+
+    def update(self, obj):
+        """Full update with optimistic concurrency: metadata.resourceVersion
+        must match the stored object or ConflictError is raised — the
+        single-writer invariant the reference controllers rely on
+        (SURVEY.md §5 race-detection notes)."""
+        obj = m.deep_copy(obj)
+        g, k = m.gvk(obj)
+        with self._lock:
+            bucket = self._bucket(g, k)
+            key = self._key(g, k, m.namespace_of(obj), m.name_of(obj))
+            old = bucket.get(key)
+            if old is None:
+                raise NotFoundError(f"{k} {key} not found")
+            rv = m.deep_get(obj, "metadata", "resourceVersion")
+            if rv is not None and rv != old["metadata"]["resourceVersion"]:
+                raise ConflictError(
+                    f"{k} {key[1]!r}: resourceVersion {rv} is stale "
+                    f"(current {old['metadata']['resourceVersion']})")
+            if obj.get("apiVersion") != old.get("apiVersion"):
+                conv = self._converters.get((g, k))
+                if conv is not None:
+                    obj = conv(obj, m.api_ver(old.get("apiVersion")))
+            obj = self._run_admission("UPDATE", obj, m.deep_copy(old))
+            md = obj.setdefault("metadata", {})
+            # server-managed fields are immutable
+            md["uid"] = old["metadata"]["uid"]
+            md["creationTimestamp"] = old["metadata"]["creationTimestamp"]
+            if old["metadata"].get("deletionTimestamp"):
+                md["deletionTimestamp"] = old["metadata"]["deletionTimestamp"]
+            gen = old["metadata"].get("generation", 1)
+            if obj.get("spec") != old.get("spec"):
+                gen += 1
+            md["generation"] = gen
+            md["resourceVersion"] = self._next_rv()
+            # deletion completes when the last finalizer is removed
+            if md.get("deletionTimestamp") and not md.get("finalizers"):
+                del bucket[key]
+                self._dispatch(DELETED, obj)
+                return m.deep_copy(obj)
+            bucket[key] = obj
+            self._dispatch(MODIFIED, obj)
+            return m.deep_copy(obj)
+
+    def update_status(self, obj):
+        """Status-subresource update: only .status is applied."""
+        with self._lock:
+            cur = self.get(obj["apiVersion"], obj["kind"], m.name_of(obj),
+                           m.namespace_of(obj))
+            cur["status"] = m.deep_copy(obj.get("status", {}))
+            return self.update(cur)
+
+    def patch(self, api_version, kind, name, namespace=None, patch=None):
+        """Strategic-merge-ish patch: dicts merge recursively, None deletes,
+        lists replace (matches how the reference web apps PATCH annotations,
+        crud-web-apps/jupyter/backend/apps/common/routes/patch.py:44)."""
+        with self._lock:
+            cur = self.get(api_version, kind, name, namespace)
+            _merge_patch(cur, patch or {})
+            return self.update(cur)
+
+    def delete(self, api_version, kind, name, namespace=None):
+        g = m.api_group(api_version)
+        with self._lock:
+            bucket = self._bucket(g, kind)
+            key = self._key(g, kind, namespace, name)
+            obj = bucket.get(key)
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace or ''}/{name} not found")
+            if m.deep_get(obj, "metadata", "finalizers"):
+                if not obj["metadata"].get("deletionTimestamp"):
+                    obj["metadata"]["deletionTimestamp"] = m.now_iso()
+                    obj["metadata"]["resourceVersion"] = self._next_rv()
+                    self._dispatch(MODIFIED, obj)
+                return m.deep_copy(obj)
+            del bucket[key]
+            self._dispatch(DELETED, obj)
+            self._cascade_delete(m.uid_of(obj))
+            return m.deep_copy(obj)
+
+    def _cascade_delete(self, owner_uid):
+        """Background-GC equivalent: delete dependents of a removed owner."""
+        doomed = []
+        for (g, k), bucket in list(self._objects.items()):
+            for (ns, name), obj in list(bucket.items()):
+                if m.is_owned_by_uid(obj, owner_uid):
+                    doomed.append((obj.get("apiVersion"), k, name, ns))
+        for api_version, kind, name, ns in doomed:
+            try:
+                self.delete(api_version, kind, name, ns or None)
+            except NotFoundError:
+                pass
+
+    # --------------------------------------------------------------- watch
+
+    def watch(self, api_version, kind, namespace=None, send_initial=True):
+        """Subscribe to events. With send_initial, current objects are
+        replayed as ADDED first (client-go informer ListAndWatch)."""
+        g = m.api_group(api_version)
+        with self._lock:
+            w = _Watch(self, (g, kind), namespace)
+            if send_initial:
+                for obj in self.list(api_version, kind, namespace):
+                    w.deliver(WatchEvent(ADDED, obj))
+            self._watches.append(w)
+            return w
+
+
+def _merge_patch(target, patch):
+    for k, v in patch.items():
+        if v is None:
+            target.pop(k, None)
+        elif isinstance(v, dict) and isinstance(target.get(k), dict):
+            _merge_patch(target[k], v)
+        else:
+            target[k] = m.deep_copy(v)

@@ -1,0 +1,109 @@
+"""Rate-limited, deduplicating work queue.
+
+Same contract as client-go's workqueue the reference controllers sit on:
+an item present in the queue is not added twice; items being processed
+that are re-added get re-queued after processing finishes; failed items
+back off exponentially per key.
+"""
+
+import heapq
+import threading
+import time
+
+
+class RateLimitingQueue:
+    def __init__(self, base_delay=0.005, max_delay=16.0):
+        self._cond = threading.Condition()
+        self._queue = []          # FIFO of ready items
+        self._dirty = set()       # items waiting or needing reprocess
+        self._processing = set()  # items currently being processed
+        self._delayed = []        # heap of (ready_time, seq, item)
+        self._seq = 0
+        self._failures = {}       # item -> consecutive failure count
+        self._base_delay = base_delay
+        self._max_delay = max_delay
+        self._shutdown = False
+
+    def add(self, item):
+        with self._cond:
+            if self._shutdown or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item not in self._processing:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def add_after(self, item, delay):
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._cond:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (time.time() + delay, self._seq, item))
+            self._cond.notify()
+
+    def add_rate_limited(self, item):
+        fails = self._failures.get(item, 0)
+        self._failures[item] = fails + 1
+        self.add_after(item, min(self._base_delay * (2 ** fails),
+                                 self._max_delay))
+
+    def forget(self, item):
+        self._failures.pop(item, None)
+
+    def _promote_delayed(self):
+        now = time.time()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, item = heapq.heappop(self._delayed)
+            if item not in self._dirty:
+                self._dirty.add(item)
+                if item not in self._processing:
+                    self._queue.append(item)
+
+    def get(self, block=True, timeout=None):
+        """Pop the next ready item; returns None on shutdown/timeout."""
+        deadline = None if timeout is None else time.time() + timeout
+        with self._cond:
+            while True:
+                self._promote_delayed()
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._dirty.discard(item)
+                    self._processing.add(item)
+                    return item
+                if self._shutdown or not block:
+                    return None
+                wait = None
+                if self._delayed:
+                    wait = max(0.0, self._delayed[0][0] - time.time())
+                if deadline is not None:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._cond.wait(wait)
+
+    def done(self, item):
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def empty(self):
+        """No ready or in-flight work (delayed items don't count)."""
+        with self._cond:
+            self._promote_delayed()
+            return not self._queue and not self._processing
+
+    def has_ready(self):
+        with self._cond:
+            self._promote_delayed()
+            return bool(self._queue)
+
+    def shutdown(self):
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()

@@ -1,0 +1,44 @@
+"""Test harness config.
+
+Compute-layer tests run on a virtual 8-device CPU mesh (multi-chip
+shardings validated without TPU hardware, per the envtest philosophy the
+reference applies to its control plane: fake the boundary, keep the
+semantics). Must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from kubeflow_tpu import api  # noqa: E402
+from kubeflow_tpu.core import Manager, ObjectStore  # noqa: E402
+
+
+@pytest.fixture()
+def store():
+    s = ObjectStore()
+    api.register_all(s)
+    return s
+
+
+@pytest.fixture()
+def manager(store):
+    mgr = Manager(store)
+    yield mgr
+    mgr.stop()
+
+
+@pytest.fixture()
+def clean_env(monkeypatch):
+    """Controllers read env at call time; keep tests hermetic."""
+    for var in ("USE_ISTIO", "ISTIO_GATEWAY", "CLUSTER_DOMAIN", "ADD_FSGROUP",
+                "ENABLE_CULLING", "CULL_IDLE_TIME", "IDLENESS_CHECK_PERIOD",
+                "DEV", "RWO_PVC_SCHEDULING"):
+        monkeypatch.delenv(var, raising=False)
+    return monkeypatch

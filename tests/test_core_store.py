@@ -1,0 +1,180 @@
+"""Unit tests for the document store — the apiserver semantics everything
+else relies on (optimistic concurrency, watches, finalizers, GC,
+conversion)."""
+
+import pytest
+
+from kubeflow_tpu.api import builtin, notebook as nbapi
+from kubeflow_tpu.core import (AlreadyExistsError, ConflictError,
+                               NotFoundError, ObjectStore)
+from kubeflow_tpu.core.store import ADDED, DELETED, MODIFIED
+
+
+def make_pod(name="p1", ns="default"):
+    return builtin.pod(name, ns, {"containers": [{"name": "c",
+                                                  "image": "img"}]})
+
+
+class TestCrud:
+    def test_create_get(self, store):
+        store.create(make_pod())
+        pod = store.get("v1", "Pod", "p1", "default")
+        assert pod["metadata"]["uid"]
+        assert pod["metadata"]["resourceVersion"]
+        assert pod["metadata"]["generation"] == 1
+
+    def test_create_duplicate(self, store):
+        store.create(make_pod())
+        with pytest.raises(AlreadyExistsError):
+            store.create(make_pod())
+
+    def test_get_missing(self, store):
+        with pytest.raises(NotFoundError):
+            store.get("v1", "Pod", "nope", "default")
+
+    def test_update_bumps_generation_on_spec_change(self, store):
+        pod = store.create(make_pod())
+        pod["spec"]["containers"][0]["image"] = "img2"
+        updated = store.update(pod)
+        assert updated["metadata"]["generation"] == 2
+
+    def test_status_update_keeps_generation(self, store):
+        pod = store.create(make_pod())
+        pod["status"] = {"phase": "Running"}
+        updated = store.update_status(pod)
+        assert updated["metadata"]["generation"] == 1
+        assert updated["status"]["phase"] == "Running"
+
+    def test_stale_update_conflicts(self, store):
+        pod = store.create(make_pod())
+        stale = dict(pod, metadata=dict(pod["metadata"]))
+        pod["spec"]["x"] = 1
+        store.update(pod)
+        stale["spec"] = {"y": 2}
+        with pytest.raises(ConflictError):
+            store.update(stale)
+
+    def test_patch_merges_and_none_deletes(self, store):
+        store.create(make_pod())
+        store.patch("v1", "Pod", "p1", "default",
+                    {"metadata": {"annotations": {"a": "1"}}})
+        pod = store.get("v1", "Pod", "p1", "default")
+        assert pod["metadata"]["annotations"] == {"a": "1"}
+        store.patch("v1", "Pod", "p1", "default",
+                    {"metadata": {"annotations": {"a": None}}})
+        pod = store.get("v1", "Pod", "p1", "default")
+        assert pod["metadata"]["annotations"] == {}
+
+    def test_deepcopy_isolation(self, store):
+        pod = store.create(make_pod())
+        pod["spec"]["containers"][0]["image"] = "mutated"
+        assert store.get("v1", "Pod", "p1", "default")["spec"]["containers"][
+            0]["image"] == "img"
+
+
+class TestListAndSelectors:
+    def test_label_selector(self, store):
+        a = make_pod("a")
+        a["metadata"]["labels"] = {"app": "x"}
+        b = make_pod("b")
+        b["metadata"]["labels"] = {"app": "y"}
+        store.create(a)
+        store.create(b)
+        got = store.list("v1", "Pod", "default", label_selector={"app": "x"})
+        assert [p["metadata"]["name"] for p in got] == ["a"]
+
+    def test_match_expressions(self, store):
+        a = make_pod("a")
+        a["metadata"]["labels"] = {"tier": "web"}
+        store.create(a)
+        sel = {"matchExpressions": [
+            {"key": "tier", "operator": "In", "values": ["web", "api"]}]}
+        assert len(store.list("v1", "Pod", "default",
+                              label_selector=sel)) == 1
+        sel = {"matchExpressions": [
+            {"key": "tier", "operator": "DoesNotExist"}]}
+        assert len(store.list("v1", "Pod", "default",
+                              label_selector=sel)) == 0
+
+    def test_namespace_isolation(self, store):
+        store.create(make_pod("a", "ns1"))
+        store.create(make_pod("a", "ns2"))
+        assert len(store.list("v1", "Pod", "ns1")) == 1
+        assert len(store.list("v1", "Pod")) == 2
+
+
+class TestWatch:
+    def test_watch_stream(self, store):
+        store.create(make_pod("before"))
+        w = store.watch("v1", "Pod")
+        ev = w.get(timeout=1)
+        assert ev.type == ADDED and ev.object["metadata"]["name"] == "before"
+        store.create(make_pod("after"))
+        ev = w.get(timeout=1)
+        assert ev.type == ADDED and ev.object["metadata"]["name"] == "after"
+        pod = store.get("v1", "Pod", "after", "default")
+        pod["spec"]["z"] = 1
+        store.update(pod)
+        assert w.get(timeout=1).type == MODIFIED
+        store.delete("v1", "Pod", "after", "default")
+        assert w.get(timeout=1).type == DELETED
+        w.stop()
+
+    def test_watch_namespace_filter(self, store):
+        w = store.watch("v1", "Pod", namespace="ns1", send_initial=False)
+        store.create(make_pod("a", "ns2"))
+        store.create(make_pod("b", "ns1"))
+        ev = w.get(timeout=1)
+        assert ev.object["metadata"]["name"] == "b"
+        w.stop()
+
+
+class TestFinalizersAndGC:
+    def test_finalizer_blocks_deletion(self, store):
+        pod = make_pod()
+        pod["metadata"]["finalizers"] = ["test/finalizer"]
+        store.create(pod)
+        store.delete("v1", "Pod", "p1", "default")
+        live = store.get("v1", "Pod", "p1", "default")
+        assert live["metadata"]["deletionTimestamp"]
+        live["metadata"]["finalizers"] = []
+        store.update(live)
+        with pytest.raises(NotFoundError):
+            store.get("v1", "Pod", "p1", "default")
+
+    def test_owner_cascade(self, store):
+        from kubeflow_tpu.core import meta as m
+        owner = store.create(make_pod("owner"))
+        child = make_pod("child")
+        m.set_controller_reference(child, owner)
+        store.create(child)
+        store.delete("v1", "Pod", "owner", "default")
+        with pytest.raises(NotFoundError):
+            store.get("v1", "Pod", "child", "default")
+
+
+class TestConversion:
+    def test_notebook_served_at_requested_version(self, store):
+        nb = nbapi.new("nb", "default",
+                       {"containers": [{"name": "nb", "image": "img"}]},
+                       version="v1beta1")
+        store.create(nb)
+        v1 = store.get("kubeflow.org/v1", "Notebook", "nb", "default")
+        assert v1["apiVersion"] == "kubeflow.org/v1"
+        v1a = store.get("kubeflow.org/v1alpha1", "Notebook", "nb", "default")
+        assert v1a["apiVersion"] == "kubeflow.org/v1alpha1"
+        # same underlying object
+        assert v1["spec"] == v1a["spec"]
+
+
+class TestClusterScoped:
+    def test_namespace_objects_have_no_namespace(self, store):
+        store.create(builtin.namespace("team-a"))
+        ns = store.get("v1", "Namespace", "team-a")
+        assert "namespace" not in ns["metadata"] or \
+            not ns["metadata"].get("namespace")
+
+    def test_profile_cluster_scoped(self, store):
+        from kubeflow_tpu.api import profile
+        store.create(profile.new("team-a", "alice@example.com"))
+        assert store.get("kubeflow.org/v1", "Profile", "team-a")

@@ -1,0 +1,188 @@
+"""Culling controller tests — parity with
+culling_controller_test.go:14-143 (stop annotation, idleness math) plus
+the full poll→annotate→cull loop against the store."""
+
+from datetime import timedelta
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.controllers import culling
+from kubeflow_tpu.controllers.culling import (
+    CullingReconciler, SyncProber, all_kernels_idle, notebook_is_idle,
+    set_stop_annotation, timestamp, update_last_activity, _now)
+from kubeflow_tpu.controllers.metrics import NotebookMetrics, Registry
+from kubeflow_tpu.core import meta as m
+
+
+def ago(minutes):
+    return timestamp(_now() - timedelta(minutes=minutes))
+
+
+def kernel(state="idle", last_activity=None):
+    return {"id": "k", "name": "python3",
+            "execution_state": state,
+            "last_activity": last_activity or ago(60),
+            "connections": 0}
+
+
+class TestIdlenessMath:
+    def test_all_kernels_idle(self):
+        assert all_kernels_idle([kernel(), kernel()])
+        assert not all_kernels_idle([kernel(), kernel("busy")])
+        assert all_kernels_idle([])
+
+    def test_notebook_is_idle_past_cap(self):
+        ann = {nbapi.LAST_ACTIVITY_ANNOTATION: ago(120)}
+        assert notebook_is_idle(ann, idle_minutes=60)
+        assert not notebook_is_idle(ann, idle_minutes=240)
+
+    def test_stopped_notebook_never_idle(self):
+        ann = {nbapi.LAST_ACTIVITY_ANNOTATION: ago(9999),
+               nbapi.STOP_ANNOTATION: timestamp()}
+        assert not notebook_is_idle(ann, idle_minutes=1)
+
+    def test_unparseable_last_activity(self):
+        assert not notebook_is_idle(
+            {nbapi.LAST_ACTIVITY_ANNOTATION: "garbage"}, 1)
+
+    def test_missing_annotation(self):
+        assert not notebook_is_idle({}, 1)
+
+
+class TestLastActivityUpdate:
+    def test_busy_kernel_sets_now(self):
+        ann = {nbapi.LAST_ACTIVITY_ANNOTATION: ago(120)}
+        update_last_activity(ann, [kernel("busy")], None)
+        last = culling.parse_time(ann[nbapi.LAST_ACTIVITY_ANNOTATION])
+        assert (_now() - last).total_seconds() < 5
+
+    def test_idle_kernels_take_most_recent(self):
+        ann = {nbapi.LAST_ACTIVITY_ANNOTATION: ago(600)}
+        update_last_activity(
+            ann, [kernel(last_activity=ago(300)),
+                  kernel(last_activity=ago(100))], None)
+        last = culling.parse_time(ann[nbapi.LAST_ACTIVITY_ANNOTATION])
+        assert abs((_now() - last).total_seconds() - 100 * 60) < 120
+
+    def test_older_resource_does_not_regress(self):
+        recent = ago(5)
+        ann = {nbapi.LAST_ACTIVITY_ANNOTATION: recent}
+        update_last_activity(ann, [kernel(last_activity=ago(500))], None)
+        assert ann[nbapi.LAST_ACTIVITY_ANNOTATION] == recent
+
+    def test_terminal_activity_considered(self):
+        ann = {nbapi.LAST_ACTIVITY_ANNOTATION: ago(600)}
+        update_last_activity(ann, None, [{"name": "t1",
+                                          "last_activity": ago(10)}])
+        last = culling.parse_time(ann[nbapi.LAST_ACTIVITY_ANNOTATION])
+        assert abs((_now() - last).total_seconds() - 10 * 60) < 120
+
+    def test_unreachable_server_no_update(self):
+        ann = {nbapi.LAST_ACTIVITY_ANNOTATION: ago(600)}
+        assert update_last_activity(dict(ann), None, None) is False
+
+
+class TestStopAnnotation:
+    def test_set_stop_annotation_and_metrics(self):
+        reg = Registry()
+        metrics = NotebookMetrics(reg)
+        ann = {}
+        set_stop_annotation(ann, metrics, "ns1", "nb1")
+        assert nbapi.STOP_ANNOTATION in ann
+        assert metrics.culling_total.value("ns1", "nb1") == 1
+        assert metrics.last_culling_timestamp.value("ns1", "nb1") > 0
+
+
+class TestCullingLoop:
+    def _setup(self, store, manager, clean_env, fetcher, idle_time="60"):
+        clean_env.setenv("ENABLE_CULLING", "true")
+        clean_env.setenv("CULL_IDLE_TIME", idle_time)
+        clean_env.setenv("IDLENESS_CHECK_PERIOD", "0")  # always check
+        rec = CullingReconciler(prober=SyncProber(fetcher))
+        manager.add(rec)
+        manager.start_sync()
+        return rec
+
+    def test_initializes_annotations(self, store, manager, clean_env):
+        self._setup(store, manager, clean_env, lambda n, ns: (None, None))
+        store.create(nbapi.new("nb1", "default", {"containers": [{}]}))
+        manager.run_sync()
+        nb = store.get("kubeflow.org/v1beta1", "Notebook", "nb1", "default")
+        ann = m.annotations_of(nb)
+        assert nbapi.LAST_ACTIVITY_ANNOTATION in ann
+        assert nbapi.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION in ann
+
+    def test_culls_idle_notebook(self, store, manager, clean_env):
+        self._setup(store, manager, clean_env,
+                    lambda n, ns: ([kernel(last_activity=ago(600))], []))
+        nb = nbapi.new("nb1", "default", {"containers": [{}]},
+                       annotations={
+                           nbapi.LAST_ACTIVITY_ANNOTATION: ago(600),
+                           nbapi.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION:
+                               ago(10)})
+        store.create(nb)
+        manager.run_sync()
+        nb = store.get("kubeflow.org/v1beta1", "Notebook", "nb1", "default")
+        assert nbapi.STOP_ANNOTATION in m.annotations_of(nb)
+
+    def test_busy_notebook_not_culled(self, store, manager, clean_env):
+        self._setup(store, manager, clean_env,
+                    lambda n, ns: ([kernel("busy")], []))
+        nb = nbapi.new("nb1", "default", {"containers": [{}]},
+                       annotations={
+                           nbapi.LAST_ACTIVITY_ANNOTATION: ago(600),
+                           nbapi.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION:
+                               ago(10)})
+        store.create(nb)
+        manager.run_sync()
+        nb = store.get("kubeflow.org/v1beta1", "Notebook", "nb1", "default")
+        assert nbapi.STOP_ANNOTATION not in m.annotations_of(nb)
+
+    def test_disabled_culling_noop(self, store, manager, clean_env):
+        rec = CullingReconciler(prober=SyncProber(
+            lambda n, ns: ([kernel(last_activity=ago(9999))], [])))
+        manager.add(rec)
+        manager.start_sync()
+        nb = nbapi.new("nb1", "default", {"containers": [{}]},
+                       annotations={nbapi.LAST_ACTIVITY_ANNOTATION: ago(9999)})
+        store.create(nb)
+        manager.run_sync()
+        nb = store.get("kubeflow.org/v1beta1", "Notebook", "nb1", "default")
+        assert nbapi.STOP_ANNOTATION not in m.annotations_of(nb)
+
+    def test_stopped_notebook_annotations_removed(self, store, manager,
+                                                  clean_env):
+        self._setup(store, manager, clean_env, lambda n, ns: (None, None))
+        nb = nbapi.new("nb1", "default", {"containers": [{}]},
+                       annotations={
+                           nbapi.STOP_ANNOTATION: timestamp(),
+                           nbapi.LAST_ACTIVITY_ANNOTATION: ago(10),
+                           nbapi.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION:
+                               ago(10)})
+        store.create(nb)
+        manager.run_sync()
+        ann = m.annotations_of(
+            store.get("kubeflow.org/v1beta1", "Notebook", "nb1", "default"))
+        assert nbapi.LAST_ACTIVITY_ANNOTATION not in ann
+        assert nbapi.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION not in ann
+        assert nbapi.STOP_ANNOTATION in ann
+
+    def test_check_period_gate(self, store, manager, clean_env):
+        calls = []
+
+        def fetcher(n, ns):
+            calls.append(n)
+            return ([kernel()], [])
+
+        clean_env.setenv("ENABLE_CULLING", "true")
+        clean_env.setenv("IDLENESS_CHECK_PERIOD", "60")
+        rec = CullingReconciler(prober=SyncProber(fetcher))
+        manager.add(rec)
+        manager.start_sync()
+        nb = nbapi.new("nb1", "default", {"containers": [{}]},
+                       annotations={
+                           nbapi.LAST_ACTIVITY_ANNOTATION: ago(5),
+                           nbapi.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION:
+                               ago(5)})
+        store.create(nb)
+        manager.run_sync()
+        assert calls == []  # 5 min < 60 min period ⇒ no probe
