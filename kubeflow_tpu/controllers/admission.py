@@ -1,0 +1,273 @@
+"""PodDefault mutating admission webhook.
+
+Behavioral parity with components/admission-webhook/main.go: every pod
+CREATE in a namespace is matched against that namespace's PodDefault CRs
+by label selector (main.go:70 filterPodDefaults); matched defaults are
+merge-checked for conflicts (main.go:99 safeToApplyPodDefaultsOnPod — a
+conflict REJECTS the pod, main.go:669-678) and then merged
+(main.go:478 applyPodDefaultsOnPod), recording an annotation
+``poddefault.admission.kubeflow.org/poddefault-<name> = <rv>`` per
+applied default.
+
+Merge rules (main.go:168-473):
+- env / volumes / volumeMounts / initContainers / sidecars /
+  imagePullSecrets: keyed by name — new entries append, same-name entries
+  must be identical or it's a conflict. volumeMounts additionally conflict
+  on differing entries sharing a mountPath.
+- tolerations: keyed by toleration key.
+- envFrom: plain append, never conflicts.
+- annotations / labels: map union; differing values conflict.
+- serviceAccountName / automountServiceAccountToken: last default wins.
+- command / args: only set if the container has none (never overwrite);
+  the istio-proxy sidecar is exempt.
+
+This is the injection point for TPU pod-slice wiring: a
+``tpu_worker_pod_default`` (api/poddefault.py) rides this exact mechanism
+to hand TPU_WORKER_ID / JAX_COORDINATOR_ADDRESS env to training pods —
+the TPU-native replacement for the reference's GPU env plumbing
+(SURVEY.md §2#14, §5 comm-backend row).
+"""
+
+import logging
+
+from ..api import poddefault as pdapi
+from ..core import meta as m
+from ..core.errors import AdmissionDeniedError
+
+log = logging.getLogger("kubeflow_tpu.controllers.admission")
+
+EXCLUDE_ANNOTATION = "poddefault.admission.kubeflow.org/exclude"
+ISTIO_PROXY_CONTAINER = "istio-proxy"
+
+
+class MergeConflict(Exception):
+    pass
+
+
+def filter_pod_defaults(pod_defaults, pod):
+    """main.go:70: namespace + label-selector match."""
+    matched = []
+    pod_labels = m.labels_of(pod)
+    pod_ns = m.namespace_of(pod)
+    for pd in pod_defaults:
+        if m.namespace_of(pd) != pod_ns:
+            continue
+        if m.match_selector(m.deep_get(pd, "spec", "selector"), pod_labels):
+            matched.append(pd)
+    return matched
+
+
+def _merge_named(existing, injected_lists, what, key="name"):
+    """Shared append-or-must-match merge (mergeEnv/mergeVolumes/
+    mergeContainers/mergeImagePullSecrets pattern)."""
+    by_key = {e.get(key): e for e in existing}
+    merged = list(existing)
+    errs = []
+    for pd_name, items in injected_lists:
+        for item in items:
+            k = item.get(key)
+            found = by_key.get(k)
+            if found is None:
+                by_key[k] = item
+                merged.append(item)
+            elif found != item:
+                errs.append(f"merging {what} for {pd_name} has a conflict "
+                            f"on {k}")
+    if errs:
+        raise MergeConflict("; ".join(errs))
+    return merged
+
+
+def _spec_lists(pod_defaults, field):
+    return [(m.name_of(pd), m.deep_get(pd, "spec", field, default=[]) or [])
+            for pd in pod_defaults]
+
+
+def merge_env(env, pod_defaults):
+    return _merge_named(env or [], _spec_lists(pod_defaults, "env"), "env")
+
+
+def merge_env_from(env_from, pod_defaults):
+    """mergeEnvFrom: append-only, no conflict possible."""
+    out = list(env_from or [])
+    for _, items in _spec_lists(pod_defaults, "envFrom"):
+        out.extend(items)
+    return out
+
+
+def merge_volumes(volumes, pod_defaults):
+    return _merge_named(volumes or [], _spec_lists(pod_defaults, "volumes"),
+                        "volumes")
+
+
+def merge_volume_mounts(mounts, pod_defaults):
+    """mergeVolumeMounts: name-keyed merge PLUS mountPath conflict check."""
+    merged = _merge_named(mounts or [],
+                          _spec_lists(pod_defaults, "volumeMounts"),
+                          "volume mounts")
+    by_path = {}
+    errs = []
+    for mount in merged:
+        path = mount.get("mountPath")
+        found = by_path.get(path)
+        if found is None:
+            by_path[path] = mount
+        elif found != mount:
+            errs.append(f"conflict on mount path {path}")
+    if errs:
+        raise MergeConflict("; ".join(errs))
+    return merged
+
+
+def merge_tolerations(tolerations, pod_defaults):
+    return _merge_named(tolerations or [],
+                        _spec_lists(pod_defaults, "tolerations"),
+                        "tolerations", key="key")
+
+
+def merge_image_pull_secrets(secrets, pod_defaults):
+    return _merge_named(secrets or [],
+                        _spec_lists(pod_defaults, "imagePullSecrets"),
+                        "imagePullSecret")
+
+
+def merge_containers(containers, pod_defaults, sidecar):
+    field = "sidecars" if sidecar else "initContainers"
+    return _merge_named(containers or [], _spec_lists(pod_defaults, field),
+                        "containers")
+
+
+def merge_map(existing, pod_defaults, field):
+    """mergeMap: union; differing values conflict."""
+    out = dict(existing or {})
+    errs = []
+    for pd in pod_defaults:
+        for k, v in (m.deep_get(pd, "spec", field) or {}).items():
+            if k not in out:
+                out[k] = v
+            elif out[k] != v:
+                errs.append(f"merging has conflict on {k}")
+    if errs:
+        raise MergeConflict("; ".join(errs))
+    return out
+
+
+def safe_to_apply(pod, pod_defaults):
+    """main.go:99: dry-run every merge; collect conflicts."""
+    errs = []
+    spec = pod.get("spec", {})
+
+    def check(fn, *args):
+        try:
+            fn(*args)
+        except MergeConflict as e:
+            errs.append(str(e))
+
+    check(merge_volumes, spec.get("volumes"), pod_defaults)
+    check(merge_tolerations, spec.get("tolerations"), pod_defaults)
+    check(merge_image_pull_secrets, spec.get("imagePullSecrets"),
+          pod_defaults)
+    for c in spec.get("containers") or []:
+        check(merge_env, c.get("env"), pod_defaults)
+        check(merge_volume_mounts, c.get("volumeMounts"), pod_defaults)
+    check(merge_map, m.annotations_of(pod), pod_defaults, "annotations")
+    check(merge_map, m.labels_of(pod), pod_defaults, "labels")
+    check(merge_containers, spec.get("initContainers"), pod_defaults, False)
+    check(merge_containers, spec.get("containers"), pod_defaults, True)
+    if errs:
+        raise MergeConflict("; ".join(errs))
+
+
+def _set_command_and_args(container, pod_defaults):
+    """main.go:577-595 setCommandAndArgs: never overwrite."""
+    if container.get("name") == ISTIO_PROXY_CONTAINER:
+        return
+    for pd in pod_defaults:
+        cmd = m.deep_get(pd, "spec", "command")
+        args = m.deep_get(pd, "spec", "args")
+        if container.get("command") is None and cmd is not None:
+            container["command"] = m.deep_copy(cmd)
+        if container.get("args") is None and args is not None:
+            container["args"] = m.deep_copy(args)
+
+
+def apply_pod_defaults(pod, pod_defaults):
+    """main.go:478 applyPodDefaultsOnPod (caller has checked safety)."""
+    if not pod_defaults:
+        return pod
+    spec = pod.setdefault("spec", {})
+    spec["volumes"] = merge_volumes(spec.get("volumes"), pod_defaults) or None
+    if spec["volumes"] is None:
+        spec.pop("volumes")
+    merged_tolerations = merge_tolerations(spec.get("tolerations"),
+                                           pod_defaults)
+    if merged_tolerations:
+        spec["tolerations"] = merged_tolerations
+    merged_ips = merge_image_pull_secrets(spec.get("imagePullSecrets"),
+                                          pod_defaults)
+    if merged_ips:
+        spec["imagePullSecrets"] = merged_ips
+
+    for pd in pod_defaults:
+        auto = m.deep_get(pd, "spec", "automountServiceAccountToken")
+        if auto is not None:
+            spec["automountServiceAccountToken"] = auto
+        sa = m.deep_get(pd, "spec", "serviceAccountName")
+        if sa:
+            spec["serviceAccountName"] = sa
+
+    md = pod.setdefault("metadata", {})
+    md["annotations"] = merge_map(md.get("annotations"), pod_defaults,
+                                  "annotations")
+    md["labels"] = merge_map(md.get("labels"), pod_defaults, "labels")
+
+    for container in spec.get("containers") or []:
+        container["env"] = merge_env(container.get("env"), pod_defaults)
+        container["volumeMounts"] = merge_volume_mounts(
+            container.get("volumeMounts"), pod_defaults)
+        env_from = merge_env_from(container.get("envFrom"), pod_defaults)
+        if env_from:
+            container["envFrom"] = env_from
+        _set_command_and_args(container, pod_defaults)
+
+    init = merge_containers(spec.get("initContainers"), pod_defaults, False)
+    if init:
+        spec["initContainers"] = init
+    spec["containers"] = merge_containers(spec.get("containers"),
+                                          pod_defaults, True)
+
+    for pd in pod_defaults:
+        rv = m.deep_get(pd, "metadata", "resourceVersion", default="")
+        md["annotations"][pdapi.ANNOTATION_PREFIX + m.name_of(pd)] = rv
+    return pod
+
+
+class PodDefaultWebhook:
+    """The /apply-poddefault endpoint as a store admission hook."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def install(self):
+        self.store.register_mutating_hook(
+            self, match=lambda g, k, ns: (g, k) == ("", "Pod"))
+
+    def __call__(self, operation, pod, old):
+        if operation != "CREATE":
+            return pod
+        annotations = m.annotations_of(pod)
+        if annotations.get(EXCLUDE_ANNOTATION) == "true":
+            return pod
+        all_pds = self.store.list(f"{pdapi.GROUP}/{pdapi.VERSION}",
+                                  pdapi.KIND, m.namespace_of(pod))
+        matched = filter_pod_defaults(all_pds, pod)
+        if not matched:
+            return pod
+        try:
+            safe_to_apply(pod, matched)
+        except MergeConflict as e:
+            names = ",".join(m.name_of(pd) for pd in matched)
+            raise AdmissionDeniedError(
+                f"conflict occurred while applying poddefaults: {names} on "
+                f"pod: {m.name_of(pod)} err: {e}")
+        return apply_pod_defaults(pod, matched)

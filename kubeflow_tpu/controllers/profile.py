@@ -1,0 +1,315 @@
+"""Profile controller — multi-tenant namespace provisioning.
+
+Behavioral parity with components/profile-controller/controllers/
+profile_controller.go:105-331: a cluster-scoped Profile materializes
+
+- a Namespace named after the profile, owner annotation + istio sidecar
+  injection label + operator-configured default labels (:127-198, :740-775),
+- Istio AuthorizationPolicy ``ns-owner-access-istio`` granting the owner
+  (by identity header), intra-namespace traffic, probe paths, and the
+  notebook controller's kernels probe (:419-537),
+- ServiceAccounts ``default-editor``/``default-viewer`` with ClusterRole
+  RoleBindings, and the owner's ``namespaceAdmin`` RoleBinding (:572-653),
+- ResourceQuota ``kf-resource-quota`` from spec.resourceQuotaSpec —
+  created when hard limits exist, deleted when emptied (:253-280). In the
+  TPU build quotas budget ``google.com/tpu`` chips per tenant,
+- plugin apply on reconcile / revoke on deletion guarded by a finalizer
+  (:281-331; plugin_iam.go, plugin_workload_identity.go).
+"""
+
+import logging
+
+from ..api import builtin, profile as papi
+from ..core import meta as m
+from ..core import reconcilehelper as helper
+from ..core.errors import NotFoundError
+from ..core.manager import Reconciler, Result
+
+log = logging.getLogger("kubeflow_tpu.controllers.profile")
+
+ISTIO_INJECTION_LABEL = "istio-injection"
+KUBEFLOW_ADMIN = "kubeflow-admin"
+KUBEFLOW_EDIT = "kubeflow-edit"
+KUBEFLOW_VIEW = "kubeflow-view"
+USER_ANNOTATION = "user"
+ROLE_ANNOTATION = "role"
+
+
+def generate_namespace(profile, default_labels=None):
+    """profile_controller.go:127-160: owner annotation, istio injection,
+    operator default labels (empty value ⇒ label removed)."""
+    name = m.name_of(profile)
+    owner = m.deep_get(profile, "spec", "owner", "name", default="")
+    labels = {ISTIO_INJECTION_LABEL: "enabled"}
+    for k, v in (default_labels or {}).items():
+        if v:
+            labels[k] = v
+    return builtin.namespace(
+        name, labels=labels,
+        annotations={papi.OWNER_ANNOTATION: owner})
+
+
+def generate_authorization_policy(profile, userid_header, userid_prefix,
+                                  controller_namespace="kubeflow"):
+    """profile_controller.go:419-487 getAuthorizationPolicy."""
+    name = m.name_of(profile)
+    owner = m.deep_get(profile, "spec", "owner", "name", default="")
+    spec = {
+        "action": "ALLOW",
+        "rules": [
+            {"when": [{
+                "key": f"request.headers[{userid_header}]",
+                "values": [userid_prefix + owner]}]},
+            {"when": [{
+                "key": "source.namespace",
+                "values": [name]}]},
+            {"to": [{"operation": {
+                "paths": ["/healthz", "/metrics", "/wait-for-drain"]}}]},
+            {"from": [{"source": {"principals": [
+                f"cluster.local/ns/{controller_namespace}/sa/"
+                f"notebook-controller-service-account"]}}],
+             "to": [{"operation": {"methods": ["GET"],
+                                   "paths": ["*/api/kernels"]}}]},
+        ],
+    }
+    ap = builtin.authorization_policy(papi.AUTHZ_POLICY_NAME, name, spec)
+    ap["metadata"]["annotations"] = {USER_ANNOTATION: owner,
+                                     ROLE_ANNOTATION: "admin"}
+    return ap
+
+
+def generate_owner_rolebinding(profile):
+    """profile_controller.go:230-251."""
+    owner = m.deep_get(profile, "spec", "owner") or {}
+    rb = builtin.role_binding(
+        "namespaceAdmin", m.name_of(profile), "ClusterRole", KUBEFLOW_ADMIN,
+        [owner],
+        annotations={USER_ANNOTATION: owner.get("name", ""),
+                     ROLE_ANNOTATION: "admin"})
+    return rb
+
+
+class ProfilePlugin:
+    """Plugin contract (profile_controller.go GetPluginSpec/ApplyPlugin/
+    RevokePlugin). Subclasses bind tenant ServiceAccounts to cloud IAM."""
+
+    kind = ""
+
+    def apply(self, store, profile, spec):
+        raise NotImplementedError
+
+    def revoke(self, store, profile, spec):
+        raise NotImplementedError
+
+
+class WorkloadIdentityPlugin(ProfilePlugin):
+    """GCP workload identity: annotate default-editor with the GSA
+    (plugin_workload_identity.go:39-44 binds KSA↔GSA; the IAM policy call
+    goes through an injectable ``iam_client``)."""
+
+    kind = papi.PLUGIN_WORKLOAD_IDENTITY
+    GSA_ANNOTATION = "iam.gke.io/gcp-service-account"
+
+    def __init__(self, iam_client=None):
+        self.iam_client = iam_client
+
+    def apply(self, store, profile, spec):
+        gsa = spec.get("gcpServiceAccount", "")
+        ns = m.name_of(profile)
+        try:
+            sa = store.get("v1", "ServiceAccount", papi.EDITOR_SA, ns)
+        except NotFoundError:
+            return
+        annotations = sa.setdefault("metadata", {}).setdefault(
+            "annotations", {})
+        if annotations.get(self.GSA_ANNOTATION) != gsa:
+            annotations[self.GSA_ANNOTATION] = gsa
+            store.update(sa)
+        if self.iam_client is not None:
+            self.iam_client.bind(ns, papi.EDITOR_SA, gsa)
+
+    def revoke(self, store, profile, spec):
+        gsa = spec.get("gcpServiceAccount", "")
+        if self.iam_client is not None:
+            self.iam_client.unbind(m.name_of(profile), papi.EDITOR_SA, gsa)
+
+
+class AwsIamPlugin(ProfilePlugin):
+    """AWS IRSA: role-arn annotation on tenant SAs (plugin_iam.go:36-119;
+    trust-policy editing goes through an injectable ``iam_client``)."""
+
+    kind = papi.PLUGIN_AWS_IAM
+    ARN_ANNOTATION = "eks.amazonaws.com/role-arn"
+
+    def __init__(self, iam_client=None):
+        self.iam_client = iam_client
+
+    def apply(self, store, profile, spec):
+        arn = spec.get("awsIamRole", "")
+        ns = m.name_of(profile)
+        for sa_name in (papi.EDITOR_SA, papi.VIEWER_SA):
+            try:
+                sa = store.get("v1", "ServiceAccount", sa_name, ns)
+            except NotFoundError:
+                continue
+            annotations = sa.setdefault("metadata", {}).setdefault(
+                "annotations", {})
+            if annotations.get(self.ARN_ANNOTATION) != arn:
+                annotations[self.ARN_ANNOTATION] = arn
+                store.update(sa)
+        if self.iam_client is not None:
+            self.iam_client.attach_trust(ns, arn)
+
+    def revoke(self, store, profile, spec):
+        if self.iam_client is not None:
+            self.iam_client.detach_trust(m.name_of(profile),
+                                         spec.get("awsIamRole", ""))
+
+
+class ProfileReconciler(Reconciler):
+    name = "profile-controller"
+    API = f"{papi.GROUP}/{papi.VERSION}"
+
+    def __init__(self, userid_header=papi.USERID_HEADER_DEFAULT,
+                 userid_prefix="", default_namespace_labels=None,
+                 plugins=None):
+        self.userid_header = userid_header
+        self.userid_prefix = userid_prefix
+        self.default_namespace_labels = dict(default_namespace_labels or {
+            "katib.kubeflow.org/metrics-collector-injection": "enabled",
+            "serving.kubeflow.org/inferenceservice": "enabled",
+            "pipelines.kubeflow.org/enabled": "true",
+            "app.kubernetes.io/part-of": "kubeflow-profile",
+        })
+        self._plugins = {p.kind: p for p in
+                         (plugins or [WorkloadIdentityPlugin(),
+                                      AwsIamPlugin()])}
+
+    def setup(self, builder):
+        builder.watch_for(self.API, papi.KIND)
+        builder.watch_mapped("v1", "Namespace", self._map_namespace)
+
+    def _map_namespace(self, ev):
+        from ..core.manager import Request
+        if self.store.try_get(self.API, papi.KIND,
+                              m.name_of(ev.object)) is not None:
+            yield Request(m.name_of(ev.object))
+
+    def _plugin_specs(self, profile):
+        for p in m.deep_get(profile, "spec", "plugins", default=[]) or []:
+            plugin = self._plugins.get(p.get("kind"))
+            if plugin is not None:
+                yield plugin, (p.get("spec") or {})
+
+    def reconcile(self, req):
+        profile = self.store.try_get(self.API, papi.KIND, req.name)
+        if profile is None:
+            return Result()
+
+        # deletion: revoke plugins, drop finalizer (go:296-331)
+        if m.deep_get(profile, "metadata", "deletionTimestamp"):
+            for plugin, spec in self._plugin_specs(profile):
+                plugin.revoke(self.store, profile, spec)
+            finalizers = m.deep_get(profile, "metadata", "finalizers",
+                                    default=[]) or []
+            if papi.FINALIZER in finalizers:
+                finalizers.remove(papi.FINALIZER)
+                profile["metadata"]["finalizers"] = finalizers
+                self.store.update(profile)
+            return Result()
+
+        name = req.name
+
+        # namespace (go:127-198)
+        desired_ns = generate_namespace(profile,
+                                        self.default_namespace_labels)
+        m.set_controller_reference(desired_ns, profile)
+        live_ns = self.store.try_get("v1", "Namespace", name)
+        if live_ns is None:
+            self.store.create(desired_ns)
+        else:
+            changed = False
+            annotations = live_ns.setdefault("metadata", {}).setdefault(
+                "annotations", {})
+            owner = m.deep_get(profile, "spec", "owner", "name", default="")
+            if annotations.get(papi.OWNER_ANNOTATION) != owner:
+                annotations[papi.OWNER_ANNOTATION] = owner
+                changed = True
+            labels = live_ns["metadata"].setdefault("labels", {})
+            if labels.get(ISTIO_INJECTION_LABEL) != "enabled":
+                labels[ISTIO_INJECTION_LABEL] = "enabled"
+                changed = True
+            # default labels: add-if-absent; empty value removes (go:740-760)
+            for k, v in self.default_namespace_labels.items():
+                if not v:
+                    if k in labels:
+                        del labels[k]
+                        changed = True
+                elif k not in labels:
+                    labels[k] = v
+                    changed = True
+            if changed:
+                self.store.update(live_ns)
+
+        # authorization policy (go:200-206, :419-537)
+        ap = generate_authorization_policy(profile, self.userid_header,
+                                           self.userid_prefix)
+        m.set_controller_reference(ap, profile)
+        helper.create_or_update(self.store, ap)
+
+        # service accounts + rolebindings (go:208-224, :572-653)
+        for sa_name, role in ((papi.EDITOR_SA, KUBEFLOW_EDIT),
+                              (papi.VIEWER_SA, KUBEFLOW_VIEW)):
+            sa = builtin.service_account(sa_name, name)
+            m.set_controller_reference(sa, profile)
+            if self.store.try_get("v1", "ServiceAccount", sa_name,
+                                  name) is None:
+                self.store.create(sa)
+            rb = builtin.role_binding(
+                sa_name, name, "ClusterRole", role,
+                [{"kind": "ServiceAccount", "name": sa_name,
+                  "namespace": name}])
+            m.set_controller_reference(rb, profile)
+            helper.create_or_update(self.store, rb, self._copy_rolebinding)
+
+        # owner rolebinding (go:230-251)
+        owner_rb = generate_owner_rolebinding(profile)
+        m.set_controller_reference(owner_rb, profile)
+        helper.create_or_update(self.store, owner_rb, self._copy_rolebinding)
+
+        # resource quota (go:253-280) — TPU chips budget rides this
+        hard = m.deep_get(profile, "spec", "resourceQuotaSpec", "hard") or {}
+        if hard:
+            quota = builtin.resource_quota(papi.QUOTA_NAME, name, hard)
+            m.set_controller_reference(quota, profile)
+            helper.create_or_update(self.store, quota)
+        else:
+            try:
+                self.store.delete("v1", "ResourceQuota", papi.QUOTA_NAME,
+                                  name)
+            except NotFoundError:
+                pass
+
+        # plugins (go:281-294)
+        for plugin, spec in self._plugin_specs(profile):
+            plugin.apply(self.store, profile, spec)
+
+        # finalizer registration (go:296-310)
+        finalizers = m.deep_get(profile, "metadata", "finalizers",
+                                default=[]) or []
+        if papi.FINALIZER not in finalizers:
+            finalizers.append(papi.FINALIZER)
+            profile["metadata"]["finalizers"] = finalizers
+            self.store.update(profile)
+
+        return Result()
+
+    @staticmethod
+    def _copy_rolebinding(desired, live):
+        """updateRoleBinding diff predicate (go:625-653): roleRef+subjects."""
+        changed = False
+        for field in ("roleRef", "subjects"):
+            if live.get(field) != desired.get(field):
+                live[field] = m.deep_copy(desired.get(field))
+                changed = True
+        return changed

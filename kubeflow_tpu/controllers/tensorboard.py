@@ -1,0 +1,174 @@
+"""Tensorboard controller.
+
+Behavioral parity with components/tensorboard-controller/controllers/
+tensorboard_controller.go:67-471: Tensorboard CR → Deployment + Service +
+VirtualService at ``/tensorboard/<ns>/<name>/``. Log path schemes
+(:375-407): cloud paths (gs://…) passed straight to --logdir;
+``pvc://<claim>/<sub>`` mounts the claim at /tensorboard_logs. RWO PVCs
+get node affinity pinning the server to the node of a running pod that
+already mounts the claim (:423-469), gated by env RWO_PVC_SCHEDULING
+(:471).
+
+TPU-native: the logs path is where the compute layer's profiler hook
+(kubeflow_tpu/training/profiler.py) writes JAX/XLA profile dumps, so this
+deployment doubles as the TPU profiling surface (SURVEY.md §5 tracing
+row); the default image is overridable via TENSORBOARD_IMAGE for a
+tensorboard-plugin-profile build.
+"""
+
+import logging
+import os
+
+from ..api import builtin, tensorboard as tbapi
+from ..core import meta as m
+from ..core import reconcilehelper as helper
+from ..core.manager import Reconciler, Result
+
+log = logging.getLogger("kubeflow_tpu.controllers.tensorboard")
+
+TB_PORT = 6006
+
+
+def _rwo_pvc_affinity(store, claim, namespace):
+    """tensorboard_controller.go:423-469 generateNodeAffinity: find a
+    running pod mounting the claim and pin to its node."""
+    for pod in store.list("v1", "Pod", namespace):
+        if m.deep_get(pod, "status", "phase") != "Running":
+            continue
+        for vol in m.deep_get(pod, "spec", "volumes", default=[]) or []:
+            if m.deep_get(vol, "persistentVolumeClaim",
+                          "claimName") == claim:
+                node = m.deep_get(pod, "spec", "nodeName")
+                if node:
+                    return {"nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": [{"matchExpressions": [{
+                                "key": "kubernetes.io/hostname",
+                                "operator": "In",
+                                "values": [node]}]}]}}}
+    return None
+
+
+def generate_deployment(tb, store=None):
+    """tensorboard_controller.go:167 generateDeployment."""
+    name, ns = m.name_of(tb), m.namespace_of(tb)
+    logspath = m.deep_get(tb, "spec", "logspath", default="")
+    image = os.environ.get("TENSORBOARD_IMAGE", tbapi.DEFAULT_IMAGE)
+
+    volumes = []
+    volume_mounts = []
+    affinity = None
+    logdir = logspath
+    if tbapi.is_cloud_path(logspath):
+        pass  # cloud storage read directly
+    else:
+        claim, sub = tbapi.parse_pvc_path(logspath)
+        if claim is not None:
+            volumes.append({"name": "tbpd", "persistentVolumeClaim": {
+                "claimName": claim, "readOnly": True}})
+            volume_mounts.append({"name": "tbpd",
+                                  "mountPath": "/tensorboard_logs"})
+            logdir = "/tensorboard_logs"
+            if sub:
+                logdir = f"/tensorboard_logs/{sub}"
+            if store is not None and \
+                    os.environ.get("RWO_PVC_SCHEDULING", "false") == "true":
+                if _pvc_is_rwo(store, claim, ns):
+                    affinity = _rwo_pvc_affinity(store, claim, ns)
+
+    pod_spec = {
+        "containers": [{
+            "name": name,
+            "image": image,
+            "command": ["/usr/local/bin/tensorboard"],
+            "args": [f"--logdir={logdir}", "--bind_all"],
+            "ports": [{"containerPort": TB_PORT}],
+            "volumeMounts": volume_mounts,
+        }],
+        "volumes": volumes,
+    }
+    if affinity:
+        pod_spec["affinity"] = affinity
+
+    return builtin.deployment(
+        name, ns, 1,
+        selector_labels={"app": name},
+        template_labels={"app": name},
+        pod_spec=pod_spec)
+
+
+def _pvc_is_rwo(store, claim, namespace):
+    pvc = store.try_get("v1", "PersistentVolumeClaim", claim, namespace)
+    if pvc is None:
+        return False
+    modes = m.deep_get(pvc, "spec", "accessModes", default=[]) or []
+    return modes == ["ReadWriteOnce"]
+
+
+def generate_service(tb):
+    name, ns = m.name_of(tb), m.namespace_of(tb)
+    return builtin.service(
+        name, ns, selector={"app": name},
+        ports=[{"name": f"http-{name}", "port": 80,
+                "targetPort": TB_PORT, "protocol": "TCP"}])
+
+
+def generate_virtual_service(tb):
+    """tensorboard_controller.go:321-373: /tensorboard/<ns>/<name>/."""
+    name, ns = m.name_of(tb), m.namespace_of(tb)
+    prefix = f"/tensorboard/{ns}/{name}/"
+    gateway = os.environ.get("ISTIO_GATEWAY") or "kubeflow/kubeflow-gateway"
+    spec = {
+        "hosts": ["*"],
+        "gateways": [gateway],
+        "http": [{
+            "match": [{"uri": {"prefix": prefix}}],
+            "rewrite": {"uri": "/"},
+            "route": [{"destination": {
+                "host": f"{name}.{ns}.svc.cluster.local",
+                "port": {"number": 80}}}],
+            "timeout": "300s",
+        }],
+    }
+    return builtin.virtual_service(f"tensorboard-{name}", ns, spec)
+
+
+class TensorboardReconciler(Reconciler):
+    name = "tensorboard-controller"
+    API = f"{tbapi.GROUP}/{tbapi.VERSION}"
+
+    def setup(self, builder):
+        builder.watch_for(self.API, tbapi.KIND)
+        builder.watch_owned("apps/v1", "Deployment", tbapi.KIND)
+        builder.watch_owned("v1", "Service", tbapi.KIND)
+        builder.watch_owned("networking.istio.io/v1alpha3", "VirtualService",
+                            tbapi.KIND)
+
+    def reconcile(self, req):
+        tb = self.store.try_get(self.API, tbapi.KIND, req.name,
+                                req.namespace)
+        if tb is None:
+            return Result()
+
+        dep = generate_deployment(tb, self.store)
+        m.set_controller_reference(dep, tb)
+        live_dep = helper.deployment(self.store, dep)
+
+        svc = generate_service(tb)
+        m.set_controller_reference(svc, tb)
+        helper.service(self.store, svc)
+
+        vs = generate_virtual_service(tb)
+        m.set_controller_reference(vs, tb)
+        helper.virtual_service(self.store, vs)
+
+        # status from deployment conditions (go:121-156)
+        conditions = m.deep_get(live_dep, "status", "conditions",
+                                default=[]) or []
+        ready = int(m.deep_get(live_dep, "status", "readyReplicas",
+                               default=0) or 0)
+        status = {"conditions": conditions, "readyReplicas": ready}
+        if status != tb.get("status"):
+            tb["status"] = status
+            self.store.update_status(tb)
+        return Result()

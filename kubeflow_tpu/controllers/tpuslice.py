@@ -1,0 +1,291 @@
+"""TpuSlice and StudyJob controllers — the TPU-native workload plane.
+
+No in-tree reference counterpart (SURVEY.md §2 parallelism table): the
+reference delegated multi-worker training to out-of-tree tf-operator and
+HPO to Katib (testing/katib_studyjob_test.py is the CR-shape spec these
+re-home). Design:
+
+- ``TpuSlice`` → headless Service (stable ``<slice>-<i>.<slice>`` worker
+  DNS) + StatefulSet sized to the slice topology + a PodDefault that
+  injects TPU_WORKER_* / JAX_COORDINATOR_ADDRESS env through the
+  admission plane. Worker 0 is the JAX coordinator; slice failure
+  handling is level-triggered: a deleted/failed worker pod is recreated
+  by the StatefulSet runtime and rejoins via the same stable address
+  (the "mesh (re)formation" hard part, SURVEY.md §7).
+- ``StudyJob`` → N trial pods fanned out (one per chip by default),
+  parameters sampled per spec.algorithm; trial pods report their
+  objective in a ``<trial>-metrics`` ConfigMap (the in-cluster metrics-
+  collector contract); status tracks per-trial results and the best
+  objective, with Katib-style conditions
+  (katib_studyjob_test.py wait_for_condition:128-193 polls exactly such
+  conditions).
+"""
+
+import logging
+
+from ..api import builtin, poddefault as pdapi, tpuslice as tsapi
+from ..core import meta as m
+from ..core import reconcilehelper as helper
+from ..core.manager import Reconciler, Result
+
+log = logging.getLogger("kubeflow_tpu.controllers.tpuslice")
+
+
+def generate_headless_service(ts):
+    name, ns = m.name_of(ts), m.namespace_of(ts)
+    svc = builtin.service(
+        name, ns, selector={"tpu-slice": name},
+        ports=[{"name": "coordinator", "port": 8476, "protocol": "TCP"}])
+    svc["spec"]["clusterIP"] = "None"
+    return svc
+
+
+def generate_statefulset(ts):
+    name, ns = m.name_of(ts), m.namespace_of(ts)
+    accelerator = m.deep_get(ts, "spec", "accelerator", default="")
+    topology = m.deep_get(ts, "spec", "topology", default="2x2")
+    workers = tsapi.workers_for(accelerator, topology)
+    chips_per_host = tsapi.ACCELERATOR_HOSTS.get(accelerator, (4, None))[0]
+
+    pod_spec = m.deep_copy(
+        m.deep_get(ts, "spec", "template", "spec") or {})
+    containers = pod_spec.setdefault("containers", [{}])
+    container = containers[0]
+    container.setdefault("name", "worker")
+    resources = container.setdefault("resources", {})
+    limits = resources.setdefault("limits", {})
+    limits.setdefault("google.com/tpu", str(chips_per_host))
+    selector = pod_spec.setdefault("nodeSelector", {})
+    if accelerator:
+        selector.setdefault("cloud.google.com/gke-tpu-accelerator",
+                            accelerator)
+    selector.setdefault("cloud.google.com/gke-tpu-topology", topology)
+
+    template_labels = {"tpu-slice": name}
+    template_labels.update(m.labels_of(ts))
+    sts = builtin.stateful_set(
+        name, ns, workers,
+        selector_labels={"tpu-slice": name},
+        template_labels=template_labels,
+        pod_spec=pod_spec)
+    sts["spec"]["serviceName"] = name
+    return sts
+
+
+class TpuSliceReconciler(Reconciler):
+    name = "tpuslice-controller"
+    API = f"{tsapi.GROUP}/{tsapi.VERSION}"
+
+    def setup(self, builder):
+        builder.watch_for(self.API, tsapi.SLICE_KIND)
+        builder.watch_owned("apps/v1", "StatefulSet", tsapi.SLICE_KIND)
+        builder.watch_owned("v1", "Pod", tsapi.SLICE_KIND)
+
+    def reconcile(self, req):
+        ts = self.store.try_get(self.API, tsapi.SLICE_KIND, req.name,
+                                req.namespace)
+        if ts is None:
+            return Result()
+
+        accelerator = m.deep_get(ts, "spec", "accelerator", default="")
+        topology = m.deep_get(ts, "spec", "topology", default="2x2")
+        workers = tsapi.workers_for(accelerator, topology)
+        chips_per_host = tsapi.ACCELERATOR_HOSTS.get(
+            accelerator, (4, None))[0]
+
+        # PodDefault must exist before pods are admitted
+        pd = pdapi.tpu_worker_pod_default(
+            req.namespace, req.name, workers,
+            chips_per_host=chips_per_host, topology=topology)
+        m.set_controller_reference(pd, ts)
+        helper.create_or_update(self.store, pd)
+
+        svc = generate_headless_service(ts)
+        m.set_controller_reference(svc, ts)
+        helper.service(self.store, svc)
+
+        sts = generate_statefulset(ts)
+        m.set_controller_reference(sts, ts)
+        live = helper.statefulset(self.store, sts)
+
+        ready = int(m.deep_get(live, "status", "readyReplicas",
+                               default=0) or 0)
+        phase = "Running" if ready >= workers else "Pending"
+        status = {
+            "readyWorkers": ready,
+            "workers": workers,
+            "phase": phase,
+            "conditions": [{
+                "type": "Ready",
+                "status": "True" if phase == "Running" else "False",
+                "lastTransitionTime": m.now_iso(),
+            }],
+        }
+        old_status = dict(ts.get("status") or {})
+        old_status.pop("conditions", None)
+        new_cmp = dict(status)
+        new_cmp.pop("conditions", None)
+        if new_cmp != old_status:
+            ts["status"] = status
+            self.store.update_status(ts)
+        return Result()
+
+
+# --------------------------------------------------------------- StudyJob
+
+def sample_parameters(parameters, trial_index, seed=0):
+    """Deterministic per-trial parameter sampling (seeded — reproducible
+    sweeps; the reference's Katib test uses random-search,
+    katib_studyjob_test.py)."""
+    import hashlib
+    values = {}
+    for p in parameters:
+        h = hashlib.sha256(
+            f"{seed}:{trial_index}:{p['name']}".encode()).digest()
+        u = int.from_bytes(h[:8], "big") / float(1 << 64)
+        ptype = p.get("type", "double")
+        if ptype == "double":
+            lo, hi = float(p.get("min", 0)), float(p.get("max", 1))
+            values[p["name"]] = lo + u * (hi - lo)
+        elif ptype == "int":
+            lo, hi = int(p.get("min", 0)), int(p.get("max", 1))
+            values[p["name"]] = lo + int(u * (hi - lo + 1))
+        elif ptype == "categorical":
+            choices = p.get("values") or [""]
+            values[p["name"]] = choices[int(u * len(choices)) % len(choices)]
+        else:
+            raise ValueError(f"unknown parameter type {ptype!r}")
+    return values
+
+
+def render_template(template, values):
+    out = m.deep_copy(template)
+
+    def subst(x):
+        if isinstance(x, str):
+            for k, v in values.items():
+                x = x.replace("{{" + k + "}}", str(v))
+            return x
+        if isinstance(x, list):
+            return [subst(i) for i in x]
+        if isinstance(x, dict):
+            return {k: subst(v) for k, v in x.items()}
+        return x
+
+    return subst(out)
+
+
+class StudyJobReconciler(Reconciler):
+    name = "studyjob-controller"
+    API = f"{tsapi.GROUP}/{tsapi.VERSION}"
+
+    def setup(self, builder):
+        builder.watch_for(self.API, tsapi.STUDY_KIND)
+        builder.watch_owned("v1", "Pod", tsapi.STUDY_KIND)
+        builder.watch_mapped("v1", "ConfigMap", self._map_metrics_cm)
+
+    def _map_metrics_cm(self, ev):
+        from ..core.manager import Request
+        name = m.name_of(ev.object)
+        if not name.endswith("-metrics"):
+            return
+        labels = m.labels_of(ev.object)
+        study = labels.get("studyjob")
+        if study:
+            yield Request(study, m.namespace_of(ev.object))
+
+    def _trial_name(self, study_name, i):
+        return f"{study_name}-trial-{i}"
+
+    def reconcile(self, req):
+        study = self.store.try_get(self.API, tsapi.STUDY_KIND, req.name,
+                                   req.namespace)
+        if study is None:
+            return Result()
+        spec = study.get("spec", {})
+        max_trials = int(spec.get("maxTrialCount", 0))
+        parallelism = int(spec.get("parallelTrialCount", max_trials))
+        parameters = spec.get("parameters") or []
+        seed = int(m.deep_get(spec, "algorithm", "seed", default=0) or 0)
+        objective = spec.get("objective") or {}
+        metric_name = objective.get("metricName", "objective")
+        maximize = objective.get("type", "maximize") == "maximize"
+
+        trials = {t["index"]: t
+                  for t in m.deep_get(study, "status", "trials",
+                                      default=[]) or []}
+
+        # collect results for running trials
+        for i, trial in trials.items():
+            if trial.get("state") in ("Succeeded", "Failed"):
+                continue
+            tname = self._trial_name(req.name, i)
+            pod = self.store.try_get("v1", "Pod", tname, req.namespace)
+            cm = self.store.try_get("v1", "ConfigMap", f"{tname}-metrics",
+                                    req.namespace)
+            if cm is not None and metric_name in (cm.get("data") or {}):
+                trial["state"] = "Succeeded"
+                trial["objectiveValue"] = float(cm["data"][metric_name])
+            elif pod is not None and \
+                    m.deep_get(pod, "status", "phase") == "Failed":
+                trial["state"] = "Failed"
+
+        # launch trials up to parallelism
+        active = sum(1 for t in trials.values()
+                     if t.get("state") == "Running")
+        next_index = len(trials)
+        while next_index < max_trials and active < parallelism:
+            values = sample_parameters(parameters, next_index, seed)
+            tname = self._trial_name(req.name, next_index)
+            template = render_template(
+                spec.get("trialTemplate") or {"spec": {"containers": [{}]}},
+                values)
+            pod = builtin.pod(
+                tname, req.namespace,
+                m.deep_copy(template.get("spec") or {}),
+                labels={"studyjob": req.name,
+                        "studyjob-trial": str(next_index)})
+            m.set_controller_reference(pod, study)
+            if self.store.try_get("v1", "Pod", tname,
+                                  req.namespace) is None:
+                self.store.create(pod)
+            trials[next_index] = {"index": next_index,
+                                  "parameters": values,
+                                  "state": "Running"}
+            active += 1
+            next_index += 1
+
+        completed = sum(1 for t in trials.values()
+                        if t.get("state") in ("Succeeded", "Failed"))
+        done = [t for t in trials.values() if t.get("state") == "Succeeded"
+                and "objectiveValue" in t]
+        best = None
+        if done:
+            best = (max if maximize else min)(
+                done, key=lambda t: t["objectiveValue"])
+
+        finished = completed >= max_trials
+        prior = m.deep_get(study, "status", "conditions", default=[]) or []
+        cond_type = "Completed" if finished else "Running"
+        if prior and prior[-1].get("type") == cond_type:
+            transition = prior[-1].get("lastTransitionTime") or m.now_iso()
+        else:
+            transition = m.now_iso()
+        status = {
+            "trials": [trials[i] for i in sorted(trials)],
+            "completedTrials": completed,
+            "phase": "Completed" if finished else "Running",
+            "conditions": [{
+                "type": cond_type,
+                "status": "True",
+                "lastTransitionTime": transition,
+            }],
+        }
+        if best is not None:
+            status["bestTrial"] = {"index": best["index"],
+                                   "parameters": best["parameters"],
+                                   "objectiveValue": best["objectiveValue"]}
+        if status != study.get("status"):
+            study["status"] = status
+            self.store.update_status(study)
+        return Result()

@@ -136,17 +136,24 @@ class DeploymentReconciler(Reconciler):
             and m.controller_owner(pod).get("uid") == m.uid_of(dep)
             and m.deep_get(pod, "status", "phase") == "Running")
         available = ready >= want and want > 0
+        prior = m.deep_get(dep, "status", "conditions", default=[]) or []
+        prior_available = next((c for c in prior
+                                if c.get("type") == "Available"), {})
+        new_status = "True" if available else "False"
+        if prior_available.get("status") == new_status:
+            transition = prior_available.get("lastTransitionTime") or \
+                m.now_iso()
+        else:
+            transition = m.now_iso()
         status = {
             "replicas": want, "readyReplicas": ready,
             "availableReplicas": ready,
             "conditions": [{
                 "type": "Available",
-                "status": "True" if available else "False",
+                "status": new_status,
                 "reason": "MinimumReplicasAvailable" if available
                           else "MinimumReplicasUnavailable",
-                "lastTransitionTime": m.deep_get(
-                    dep, "status", "conditions", default=[{}])[0].get(
-                        "lastTransitionTime") or m.now_iso(),
+                "lastTransitionTime": transition,
             }],
         }
         if status != dep.get("status"):
@@ -169,7 +176,12 @@ class PodRuntimeReconciler(Reconciler):
         selector = m.deep_get(pod, "spec", "nodeSelector") or {}
         if not selector:
             return True
-        for node in self.store.list("v1", "Node"):
+        nodes = self.store.list("v1", "Node")
+        if not nodes:
+            # no Node inventory registered — scheduling constraints are
+            # opt-in in the in-process runtime
+            return True
+        for node in nodes:
             labels = m.labels_of(node)
             if all(labels.get(k) == v for k, v in selector.items()):
                 return True
@@ -182,12 +194,20 @@ class PodRuntimeReconciler(Reconciler):
         if m.deep_get(pod, "status", "phase") == "Running":
             return Result()
         if not self._schedulable(pod):
-            pod["status"] = {
+            prior = m.deep_get(pod, "status", "conditions", default=[]) or []
+            prior_sched = next((c for c in prior
+                                if c.get("type") == "PodScheduled"), {})
+            transition = prior_sched.get("lastTransitionTime") \
+                if prior_sched.get("status") == "False" else None
+            status = {
                 "phase": "Pending",
                 "conditions": [{"type": "PodScheduled", "status": "False",
                                 "reason": "Unschedulable",
-                                "lastTransitionTime": m.now_iso()}]}
-            self.store.update_status(pod)
+                                "lastTransitionTime":
+                                    transition or m.now_iso()}]}
+            if status != pod.get("status"):
+                pod["status"] = status
+                self.store.update_status(pod)
             return Result()
         now = m.now_iso()
         container_statuses = []

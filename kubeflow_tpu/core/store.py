@@ -279,6 +279,7 @@ class ObjectStore:
             if md.get("deletionTimestamp") and not md.get("finalizers"):
                 del bucket[key]
                 self._dispatch(DELETED, obj)
+                self._cascade_delete(md["uid"])
                 return m.deep_copy(obj)
             bucket[key] = obj
             self._dispatch(MODIFIED, obj)

@@ -1,0 +1,210 @@
+"""PodDefault webhook tests — merge/conflict semantics parity with
+admission-webhook/main_test.go:12-254."""
+
+import pytest
+
+from kubeflow_tpu.api import builtin, poddefault as pdapi
+from kubeflow_tpu.controllers import admission
+from kubeflow_tpu.controllers.admission import (
+    MergeConflict, PodDefaultWebhook, apply_pod_defaults,
+    filter_pod_defaults, merge_env, merge_env_from, merge_map,
+    merge_tolerations, merge_volume_mounts, merge_volumes, safe_to_apply)
+from kubeflow_tpu.core.errors import AdmissionDeniedError
+
+
+def pd(name="pd1", ns="default", selector=None, **fields):
+    if selector is None:
+        selector = {"matchLabels": {"inject": "yes"}}
+    return pdapi.new(name, ns, selector, **fields)
+
+
+def make_pod(labels=None, ns="default", **spec_extra):
+    spec = {"containers": [{"name": "main", "image": "img"}]}
+    spec.update(spec_extra)
+    return builtin.pod("p1", ns, spec, labels=labels or {"inject": "yes"})
+
+
+class TestFilter:
+    def test_label_match(self):
+        assert filter_pod_defaults([pd()], make_pod())
+        assert not filter_pod_defaults([pd()], make_pod(labels={"x": "y"}))
+
+    def test_namespace_mismatch(self):
+        assert not filter_pod_defaults([pd(ns="other")], make_pod())
+
+    def test_empty_selector_matches_all(self):
+        assert filter_pod_defaults([pd(selector={})],
+                                   make_pod(labels={"anything": "1"}))
+
+
+class TestMergeEnv:
+    def test_append_new(self):
+        merged = merge_env([{"name": "A", "value": "1"}],
+                           [pd(env=[{"name": "B", "value": "2"}])])
+        assert [e["name"] for e in merged] == ["A", "B"]
+
+    def test_identical_ok(self):
+        merged = merge_env([{"name": "A", "value": "1"}],
+                           [pd(env=[{"name": "A", "value": "1"}])])
+        assert len(merged) == 1
+
+    def test_conflict(self):
+        with pytest.raises(MergeConflict):
+            merge_env([{"name": "A", "value": "1"}],
+                      [pd(env=[{"name": "A", "value": "other"}])])
+
+    def test_two_defaults_conflicting(self):
+        with pytest.raises(MergeConflict):
+            merge_env([], [pd("a", env=[{"name": "X", "value": "1"}]),
+                           pd("b", env=[{"name": "X", "value": "2"}])])
+
+
+class TestMergeVolumeMounts:
+    def test_mountpath_conflict(self):
+        with pytest.raises(MergeConflict):
+            merge_volume_mounts(
+                [{"name": "v1", "mountPath": "/data"}],
+                [pd(volumeMounts=[{"name": "v2", "mountPath": "/data"}])])
+
+    def test_same_name_different_path_conflict(self):
+        with pytest.raises(MergeConflict):
+            merge_volume_mounts(
+                [{"name": "v1", "mountPath": "/a"}],
+                [pd(volumeMounts=[{"name": "v1", "mountPath": "/b"}])])
+
+    def test_clean_merge(self):
+        merged = merge_volume_mounts(
+            [{"name": "v1", "mountPath": "/a"}],
+            [pd(volumeMounts=[{"name": "v2", "mountPath": "/b"}])])
+        assert len(merged) == 2
+
+
+class TestOtherMerges:
+    def test_env_from_append_only(self):
+        merged = merge_env_from(
+            [{"configMapRef": {"name": "a"}}],
+            [pd(envFrom=[{"configMapRef": {"name": "a"}}])])
+        assert len(merged) == 2  # duplicates allowed, no conflict
+
+    def test_tolerations_keyed_by_key(self):
+        merged = merge_tolerations(
+            [{"key": "k1", "operator": "Exists"}],
+            [pd(tolerations=[{"key": "k2", "operator": "Exists"}])])
+        assert len(merged) == 2
+        with pytest.raises(MergeConflict):
+            merge_tolerations(
+                [{"key": "k1", "operator": "Exists"}],
+                [pd(tolerations=[{"key": "k1", "operator": "Equal",
+                                  "value": "x"}])])
+
+    def test_merge_map_conflict(self):
+        with pytest.raises(MergeConflict):
+            merge_map({"a": "1"}, [pd(labels={"a": "2"})], "labels")
+
+    def test_volumes(self):
+        merged = merge_volumes(
+            [{"name": "v1", "emptyDir": {}}],
+            [pd(volumes=[{"name": "v2", "emptyDir": {}}])])
+        assert len(merged) == 2
+
+
+class TestApply:
+    def test_full_apply(self):
+        pod = make_pod()
+        d = pd(env=[{"name": "TPU_WORKER_ID", "value": "0"}],
+               volumes=[{"name": "shm", "emptyDir": {"medium": "Memory"}}],
+               volumeMounts=[{"name": "shm", "mountPath": "/dev/shm"}],
+               sidecars=[{"name": "proxy", "image": "proxy:1"}],
+               initContainers=[{"name": "init", "image": "init:1"}],
+               labels={"injected": "true"},
+               annotations={"note": "hi"},
+               serviceAccountName="editor")
+        d["metadata"]["resourceVersion"] = "42"
+        safe_to_apply(pod, [d])
+        apply_pod_defaults(pod, [d])
+        spec = pod["spec"]
+        c = spec["containers"][0]
+        assert {"name": "TPU_WORKER_ID", "value": "0"} in c["env"]
+        assert {"name": "shm", "mountPath": "/dev/shm"} in c["volumeMounts"]
+        assert spec["volumes"][0]["name"] == "shm"
+        assert [x["name"] for x in spec["containers"]] == ["main", "proxy"]
+        assert spec["initContainers"][0]["name"] == "init"
+        assert spec["serviceAccountName"] == "editor"
+        assert pod["metadata"]["labels"]["injected"] == "true"
+        assert pod["metadata"]["annotations"][
+            pdapi.ANNOTATION_PREFIX + "pd1"] == "42"
+
+    def test_command_args_not_overwritten(self):
+        pod = make_pod()
+        pod["spec"]["containers"][0]["command"] = ["existing"]
+        d = pd(command=["new"], args=["--flag"])
+        apply_pod_defaults(pod, [d])
+        c = pod["spec"]["containers"][0]
+        assert c["command"] == ["existing"]
+        assert c["args"] == ["--flag"]  # args was unset ⇒ injected
+
+    def test_istio_proxy_exempt_from_command(self):
+        pod = make_pod()
+        pod["spec"]["containers"][0]["name"] = admission.ISTIO_PROXY_CONTAINER
+        apply_pod_defaults(pod, [pd(command=["x"])])
+        assert "command" not in pod["spec"]["containers"][0]
+
+
+class TestWebhookIntegration:
+    def _install(self, store):
+        PodDefaultWebhook(store).install()
+
+    def test_pod_mutated_on_create(self, store):
+        self._install(store)
+        store.create(pd(env=[{"name": "INJECTED", "value": "1"}]))
+        store.create(make_pod())
+        pod = store.get("v1", "Pod", "p1", "default")
+        env = pod["spec"]["containers"][0]["env"]
+        assert {"name": "INJECTED", "value": "1"} in env
+        assert pdapi.ANNOTATION_PREFIX + "pd1" in \
+            pod["metadata"]["annotations"]
+
+    def test_non_matching_pod_untouched(self, store):
+        self._install(store)
+        store.create(pd())
+        store.create(make_pod(labels={"other": "1"}))
+        pod = store.get("v1", "Pod", "p1", "default")
+        assert "env" not in pod["spec"]["containers"][0]
+
+    def test_conflict_rejects_pod(self, store):
+        """main.go:669-678: conflicts reject the admission."""
+        self._install(store)
+        store.create(pd(env=[{"name": "A", "value": "pd"}]))
+        pod = make_pod()
+        pod["spec"]["containers"][0]["env"] = [{"name": "A", "value": "pod"}]
+        with pytest.raises(AdmissionDeniedError):
+            store.create(pod)
+
+    def test_exclude_annotation(self, store):
+        self._install(store)
+        store.create(pd(env=[{"name": "A", "value": "1"}]))
+        pod = make_pod()
+        pod["metadata"]["annotations"] = {
+            admission.EXCLUDE_ANNOTATION: "true"}
+        store.create(pod)
+        assert "env" not in store.get("v1", "Pod", "p1",
+                                      "default")["spec"]["containers"][0]
+
+    def test_tpu_worker_pod_default_injection(self, store):
+        """The TPU-native use: slice wiring env rides the PodDefault
+        mechanism (SURVEY.md §5 comm-backend row)."""
+        self._install(store)
+        store.create(pdapi.tpu_worker_pod_default(
+            "default", "bert-slice", num_workers=4, topology="4x4"))
+        pod = builtin.pod("bert-slice-0", "default",
+                          {"containers": [{"name": "worker"}]},
+                          labels={"tpu-slice": "bert-slice"})
+        store.create(pod)
+        env = {e["name"]: e.get("value")
+               for e in store.get("v1", "Pod", "bert-slice-0", "default")
+               ["spec"]["containers"][0]["env"]}
+        assert env["JAX_COORDINATOR_ADDRESS"] == \
+            "bert-slice-0.bert-slice.default.svc:8476"
+        assert env["TPU_SLICE_TOPOLOGY"] == "4x4"
+        assert "bert-slice-0.bert-slice.default.svc" in \
+            env["TPU_WORKER_HOSTNAMES"]

@@ -1,0 +1,155 @@
+"""Profile controller tests — namespace/RBAC/policy/quota/plugins/finalizer
+parity with profile_controller.go and plugin_*_test.go."""
+
+from kubeflow_tpu.api import profile as papi
+from kubeflow_tpu.controllers.profile import (
+    AwsIamPlugin, ProfileReconciler, WorkloadIdentityPlugin,
+    generate_authorization_policy, generate_namespace)
+from kubeflow_tpu.core import meta as m
+
+
+def make_profile(name="team-a", owner="alice@example.com", **kw):
+    return papi.new(name, owner, **kw)
+
+
+class TestGenerators:
+    def test_namespace_shape(self):
+        ns = generate_namespace(make_profile(), {"extra": "1", "drop": ""})
+        assert ns["metadata"]["name"] == "team-a"
+        assert ns["metadata"]["annotations"]["owner"] == "alice@example.com"
+        labels = ns["metadata"]["labels"]
+        assert labels["istio-injection"] == "enabled"
+        assert labels["extra"] == "1"
+        assert "drop" not in labels
+
+    def test_authorization_policy_shape(self):
+        ap = generate_authorization_policy(make_profile(), "kubeflow-userid",
+                                           "prefix:")
+        assert ap["metadata"]["name"] == papi.AUTHZ_POLICY_NAME
+        rules = ap["spec"]["rules"]
+        assert rules[0]["when"][0]["key"] == \
+            "request.headers[kubeflow-userid]"
+        assert rules[0]["when"][0]["values"] == ["prefix:alice@example.com"]
+        assert rules[1]["when"][0]["values"] == ["team-a"]
+        # kernels probe rule for the culler
+        assert rules[3]["to"][0]["operation"]["paths"] == ["*/api/kernels"]
+
+
+class FakeIam:
+    def __init__(self):
+        self.bound = []
+        self.unbound = []
+
+    def bind(self, ns, sa, gsa):
+        self.bound.append((ns, sa, gsa))
+
+    def unbind(self, ns, sa, gsa):
+        self.unbound.append((ns, sa, gsa))
+
+
+def setup_manager(store, manager, **kw):
+    rec = ProfileReconciler(**kw)
+    manager.add(rec)
+    manager.start_sync()
+    return rec
+
+
+class TestReconcile:
+    def test_full_provisioning(self, store, manager):
+        setup_manager(store, manager)
+        store.create(make_profile(quota={"cpu": "16",
+                                         "google.com/tpu": "8"}))
+        manager.run_sync()
+
+        ns = store.get("v1", "Namespace", "team-a")
+        assert ns["metadata"]["annotations"]["owner"] == "alice@example.com"
+        assert ns["metadata"]["labels"]["istio-injection"] == "enabled"
+
+        ap = store.get("security.istio.io/v1beta1", "AuthorizationPolicy",
+                       papi.AUTHZ_POLICY_NAME, "team-a")
+        assert ap["spec"]["rules"]
+
+        for sa in (papi.EDITOR_SA, papi.VIEWER_SA):
+            assert store.get("v1", "ServiceAccount", sa, "team-a")
+            rb = store.get("rbac.authorization.k8s.io/v1", "RoleBinding",
+                           sa, "team-a")
+            assert rb["subjects"][0]["name"] == sa
+
+        owner_rb = store.get("rbac.authorization.k8s.io/v1", "RoleBinding",
+                             "namespaceAdmin", "team-a")
+        assert owner_rb["subjects"][0]["name"] == "alice@example.com"
+
+        quota = store.get("v1", "ResourceQuota", papi.QUOTA_NAME, "team-a")
+        assert quota["spec"]["hard"]["google.com/tpu"] == "8"
+
+        profile = store.get("kubeflow.org/v1", "Profile", "team-a")
+        assert papi.FINALIZER in profile["metadata"]["finalizers"]
+
+    def test_quota_removed_when_emptied(self, store, manager):
+        setup_manager(store, manager)
+        store.create(make_profile(quota={"cpu": "1"}))
+        manager.run_sync()
+        assert store.try_get("v1", "ResourceQuota", papi.QUOTA_NAME,
+                             "team-a")
+        profile = store.get("kubeflow.org/v1", "Profile", "team-a")
+        del profile["spec"]["resourceQuotaSpec"]
+        store.update(profile)
+        manager.run_sync()
+        assert store.try_get("v1", "ResourceQuota", papi.QUOTA_NAME,
+                             "team-a") is None
+
+    def test_owner_annotation_repaired(self, store, manager):
+        setup_manager(store, manager)
+        store.create(make_profile())
+        manager.run_sync()
+        ns = store.get("v1", "Namespace", "team-a")
+        ns["metadata"]["annotations"]["owner"] = "intruder@example.com"
+        store.update(ns)
+        manager.run_sync()
+        assert store.get("v1", "Namespace", "team-a")["metadata"][
+            "annotations"]["owner"] == "alice@example.com"
+
+    def test_workload_identity_plugin(self, store, manager):
+        iam = FakeIam()
+        setup_manager(store, manager,
+                      plugins=[WorkloadIdentityPlugin(iam_client=iam)])
+        store.create(make_profile(plugins=[{
+            "kind": papi.PLUGIN_WORKLOAD_IDENTITY,
+            "spec": {"gcpServiceAccount": "gsa@proj.iam.gserviceaccount.com"},
+        }]))
+        manager.run_sync()
+        sa = store.get("v1", "ServiceAccount", papi.EDITOR_SA, "team-a")
+        assert sa["metadata"]["annotations"][
+            WorkloadIdentityPlugin.GSA_ANNOTATION] == \
+            "gsa@proj.iam.gserviceaccount.com"
+        # apply runs per-reconcile (reference ApplyPlugin semantics) —
+        # the cloud call must be idempotent, not unique
+        assert set(iam.bound) == {("team-a", papi.EDITOR_SA,
+                                   "gsa@proj.iam.gserviceaccount.com")}
+
+    def test_aws_iam_plugin(self, store, manager):
+        setup_manager(store, manager, plugins=[AwsIamPlugin()])
+        store.create(make_profile(plugins=[{
+            "kind": papi.PLUGIN_AWS_IAM,
+            "spec": {"awsIamRole": "arn:aws:iam::1:role/r"},
+        }]))
+        manager.run_sync()
+        sa = store.get("v1", "ServiceAccount", papi.EDITOR_SA, "team-a")
+        assert sa["metadata"]["annotations"][AwsIamPlugin.ARN_ANNOTATION] == \
+            "arn:aws:iam::1:role/r"
+
+    def test_deletion_revokes_plugins_and_finishes(self, store, manager):
+        iam = FakeIam()
+        setup_manager(store, manager,
+                      plugins=[WorkloadIdentityPlugin(iam_client=iam)])
+        store.create(make_profile(plugins=[{
+            "kind": papi.PLUGIN_WORKLOAD_IDENTITY,
+            "spec": {"gcpServiceAccount": "g@p.iam"},
+        }]))
+        manager.run_sync()
+        store.delete("kubeflow.org/v1", "Profile", "team-a")
+        manager.run_sync()
+        assert iam.unbound == [("team-a", papi.EDITOR_SA, "g@p.iam")]
+        assert store.try_get("kubeflow.org/v1", "Profile", "team-a") is None
+        # owned namespace GC'd with the profile
+        assert store.try_get("v1", "Namespace", "team-a") is None

@@ -1,0 +1,109 @@
+"""Tensorboard controller tests — scheme parsing, deployment/VS shape,
+RWO scheduling; parity with tensorboard_controller.go."""
+
+from kubeflow_tpu.api import builtin, tensorboard as tbapi
+from kubeflow_tpu.controllers.tensorboard import (
+    TensorboardReconciler, generate_deployment, generate_virtual_service)
+from kubeflow_tpu.controllers.workload_runtime import (
+    DeploymentReconciler, PodRuntimeReconciler)
+
+
+class TestPathSchemes:
+    def test_cloud_path(self):
+        assert tbapi.is_cloud_path("gs://bucket/logs")
+        assert tbapi.is_cloud_path("s3://bucket/logs")
+        assert not tbapi.is_cloud_path("pvc://claim/sub")
+        assert not tbapi.is_cloud_path("/plain/path")
+
+    def test_pvc_parse(self):
+        assert tbapi.parse_pvc_path("pvc://claim/a/b") == ("claim", "a/b")
+        assert tbapi.parse_pvc_path("pvc://claim") == ("claim", "")
+        assert tbapi.parse_pvc_path("gs://x") == (None, None)
+
+
+class TestGenerateDeployment:
+    def test_cloud_logdir(self, clean_env):
+        tb = tbapi.new("tb1", "default", "gs://bucket/logs")
+        dep = generate_deployment(tb)
+        c = dep["spec"]["template"]["spec"]["containers"][0]
+        assert "--logdir=gs://bucket/logs" in c["args"]
+        assert not dep["spec"]["template"]["spec"]["volumes"]
+
+    def test_pvc_logdir_mounts_claim(self, clean_env):
+        tb = tbapi.new("tb1", "default", "pvc://myclaim/run1")
+        dep = generate_deployment(tb)
+        spec = dep["spec"]["template"]["spec"]
+        assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == \
+            "myclaim"
+        c = spec["containers"][0]
+        assert c["volumeMounts"][0]["mountPath"] == "/tensorboard_logs"
+        assert "--logdir=/tensorboard_logs/run1" in c["args"]
+
+    def test_image_override(self, clean_env):
+        clean_env.setenv("TENSORBOARD_IMAGE", "custom/tb:1")
+        tb = tbapi.new("tb1", "default", "gs://b/l")
+        assert generate_deployment(tb)["spec"]["template"]["spec"][
+            "containers"][0]["image"] == "custom/tb:1"
+
+    def test_rwo_pvc_node_affinity(self, store, clean_env):
+        """tensorboard_controller.go:423-471: pin to the node of a running
+        pod mounting the RWO claim, gated by RWO_PVC_SCHEDULING."""
+        clean_env.setenv("RWO_PVC_SCHEDULING", "true")
+        store.create(builtin.pvc("myclaim", "default", "1Gi",
+                                 access_modes=["ReadWriteOnce"]))
+        pod = builtin.pod("user-pod", "default", {
+            "nodeName": "node-7",
+            "containers": [{"name": "c"}],
+            "volumes": [{"name": "v", "persistentVolumeClaim": {
+                "claimName": "myclaim"}}]})
+        pod["status"] = {"phase": "Running"}
+        store.create(pod)
+        tb = tbapi.new("tb1", "default", "pvc://myclaim")
+        dep = generate_deployment(tb, store)
+        terms = dep["spec"]["template"]["spec"]["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"][
+            "nodeSelectorTerms"]
+        assert terms[0]["matchExpressions"][0]["values"] == ["node-7"]
+
+    def test_no_affinity_when_gate_off(self, store, clean_env):
+        store.create(builtin.pvc("myclaim", "default", "1Gi"))
+        tb = tbapi.new("tb1", "default", "pvc://myclaim")
+        dep = generate_deployment(tb, store)
+        assert "affinity" not in dep["spec"]["template"]["spec"]
+
+
+class TestVirtualService:
+    def test_prefix(self, clean_env):
+        vs = generate_virtual_service(tbapi.new("tb1", "team-a", "gs://b"))
+        http = vs["spec"]["http"][0]
+        assert http["match"][0]["uri"]["prefix"] == "/tensorboard/team-a/tb1/"
+        assert http["rewrite"]["uri"] == "/"
+        assert http["timeout"] == "300s"
+
+
+class TestReconcile:
+    def test_end_to_end(self, store, manager, clean_env):
+        manager.add(TensorboardReconciler())
+        manager.add(DeploymentReconciler())
+        manager.add(PodRuntimeReconciler())
+        manager.start_sync()
+        store.create(tbapi.new("tb1", "default", "gs://bucket/logs"))
+        manager.run_sync()
+        dep = store.get("apps/v1", "Deployment", "tb1", "default")
+        assert dep["status"]["readyReplicas"] == 1
+        assert store.get("v1", "Service", "tb1", "default")
+        assert store.get("networking.istio.io/v1alpha3", "VirtualService",
+                         "tensorboard-tb1", "default")
+        tb = store.get("kubeflow.org/v1alpha1", "Tensorboard", "tb1",
+                       "default")
+        assert tb["status"]["readyReplicas"] == 1
+        assert tb["status"]["conditions"][0]["type"] == "Available"
+
+    def test_deployment_recreated(self, store, manager, clean_env):
+        manager.add(TensorboardReconciler())
+        manager.start_sync()
+        store.create(tbapi.new("tb1", "default", "gs://b"))
+        manager.run_sync()
+        store.delete("apps/v1", "Deployment", "tb1", "default")
+        manager.run_sync()
+        assert store.get("apps/v1", "Deployment", "tb1", "default")

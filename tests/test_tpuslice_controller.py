@@ -1,0 +1,184 @@
+"""TpuSlice + StudyJob controller tests: slice gang scheduling, worker
+env injection via the admission plane, failure recovery, HPO fan-out."""
+
+from kubeflow_tpu.api import builtin, tpuslice as tsapi
+from kubeflow_tpu.controllers.admission import PodDefaultWebhook
+from kubeflow_tpu.controllers.tpuslice import (
+    StudyJobReconciler, TpuSliceReconciler, render_template,
+    sample_parameters)
+from kubeflow_tpu.controllers.workload_runtime import (
+    PodRuntimeReconciler, StatefulSetReconciler)
+
+
+def slice_manager(store, manager):
+    PodDefaultWebhook(store).install()
+    manager.add(TpuSliceReconciler())
+    manager.add(StatefulSetReconciler())
+    manager.add(PodRuntimeReconciler())
+    manager.start_sync()
+    return manager
+
+
+def make_slice(name="s1", topology="4x4",
+               accelerator="tpu-v5-lite-podslice"):
+    return tsapi.new_slice(name, "default", accelerator, topology,
+                           {"containers": [{"name": "worker",
+                                            "image": "jax-tpu:latest"}]})
+
+
+class TestTopologyMath:
+    def test_chips(self):
+        assert tsapi.topology_chips("4x4") == 16
+        assert tsapi.topology_chips("2x2x4") == 16
+        assert tsapi.topology_chips("2x2") == 4
+
+    def test_workers(self):
+        assert tsapi.workers_for("tpu-v5-lite-podslice", "4x4") == 4
+        assert tsapi.workers_for("tpu-v5-lite-podslice", "2x2") == 1
+        assert tsapi.workers_for("tpu-v4-podslice", "2x2x4") == 4
+
+
+class TestTpuSlice:
+    def test_slice_materializes(self, store, manager):
+        slice_manager(store, manager)
+        store.create(make_slice("s1", topology="4x4"))
+        manager.run_sync()
+
+        sts = store.get("apps/v1", "StatefulSet", "s1", "default")
+        assert sts["spec"]["replicas"] == 4
+        assert sts["spec"]["serviceName"] == "s1"
+        tpl_spec = sts["spec"]["template"]["spec"]
+        assert tpl_spec["containers"][0]["resources"]["limits"][
+            "google.com/tpu"] == "4"
+        assert tpl_spec["nodeSelector"][
+            "cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+
+        svc = store.get("v1", "Service", "s1", "default")
+        assert svc["spec"]["clusterIP"] == "None"
+
+        # pods got TPU env through the PodDefault admission chain
+        pod = store.get("v1", "Pod", "s1-0", "default")
+        env = {e["name"]: e.get("value")
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["JAX_COORDINATOR_ADDRESS"] == \
+            "s1-0.s1.default.svc:8476"
+        assert env["JAX_NUM_PROCESSES"] == "4"
+
+        ts = store.get("kubeflow.org/v1alpha1", "TpuSlice", "s1", "default")
+        assert ts["status"]["phase"] == "Running"
+        assert ts["status"]["readyWorkers"] == 4
+
+    def test_worker_failure_recovers(self, store, manager):
+        """Slice failure → level-triggered replacement (SURVEY.md §5
+        failure-detection row; the TPU 'mesh reformation' path)."""
+        slice_manager(store, manager)
+        store.create(make_slice("s1", topology="4x4"))
+        manager.run_sync()
+        store.delete("v1", "Pod", "s1-2", "default")
+        manager.run_sync()
+        pod = store.get("v1", "Pod", "s1-2", "default")
+        assert pod["status"]["phase"] == "Running"
+        assert store.get("kubeflow.org/v1alpha1", "TpuSlice", "s1",
+                         "default")["status"]["phase"] == "Running"
+
+    def test_single_host_slice(self, store, manager):
+        slice_manager(store, manager)
+        store.create(make_slice("tiny", topology="2x2"))
+        manager.run_sync()
+        assert store.get("apps/v1", "StatefulSet", "tiny",
+                         "default")["spec"]["replicas"] == 1
+
+
+class TestSampling:
+    def test_deterministic(self):
+        params = [{"name": "lr", "type": "double", "min": 0.001, "max": 0.1}]
+        a = sample_parameters(params, 3, seed=7)
+        b = sample_parameters(params, 3, seed=7)
+        assert a == b
+        c = sample_parameters(params, 4, seed=7)
+        assert a != c
+
+    def test_types(self):
+        params = [
+            {"name": "lr", "type": "double", "min": 0.0, "max": 1.0},
+            {"name": "bs", "type": "int", "min": 8, "max": 64},
+            {"name": "opt", "type": "categorical",
+             "values": ["sgd", "adam"]},
+        ]
+        v = sample_parameters(params, 0, seed=1)
+        assert 0.0 <= v["lr"] <= 1.0
+        assert 8 <= v["bs"] <= 64
+        assert v["opt"] in ("sgd", "adam")
+
+    def test_render_template(self):
+        t = {"spec": {"containers": [{"args": ["--lr={{lr}}"]}]}}
+        out = render_template(t, {"lr": 0.5})
+        assert out["spec"]["containers"][0]["args"] == ["--lr=0.5"]
+
+
+class TestStudyJob:
+    def _mgr(self, store, manager):
+        manager.add(StudyJobReconciler())
+        manager.add(PodRuntimeReconciler())
+        manager.start_sync()
+        return manager
+
+    def _study(self, max_trials=4, parallelism=2):
+        return tsapi.new_study(
+            "study1", "default",
+            objective={"type": "maximize", "metricName": "accuracy"},
+            parameters=[{"name": "lr", "type": "double",
+                         "min": 0.01, "max": 0.1}],
+            trial_template={"spec": {"containers": [{
+                "name": "trial", "image": "trial:1",
+                "args": ["--lr={{lr}}"]}]}},
+            max_trials=max_trials, parallelism=parallelism, seed=11)
+
+    def _report(self, store, trial_index, value):
+        cm = builtin.config_map(
+            f"study1-trial-{trial_index}-metrics", "default",
+            {"accuracy": str(value)},
+            labels={"studyjob": "study1"})
+        store.create(cm)
+
+    def test_fan_out_respects_parallelism(self, store, manager):
+        self._mgr(store, manager)
+        store.create(self._study(max_trials=4, parallelism=2))
+        manager.run_sync()
+        pods = [p for p in store.list("v1", "Pod", "default")
+                if p["metadata"]["name"].startswith("study1-trial")]
+        assert len(pods) == 2
+
+    def test_trial_args_rendered(self, store, manager):
+        self._mgr(store, manager)
+        store.create(self._study())
+        manager.run_sync()
+        pod = store.get("v1", "Pod", "study1-trial-0", "default")
+        arg = pod["spec"]["containers"][0]["args"][0]
+        assert arg.startswith("--lr=0.0") or arg.startswith("--lr=0.1")
+
+    def test_completion_and_best_trial(self, store, manager):
+        self._mgr(store, manager)
+        store.create(self._study(max_trials=3, parallelism=3))
+        manager.run_sync()
+        self._report(store, 0, 0.7)
+        self._report(store, 1, 0.9)
+        self._report(store, 2, 0.8)
+        manager.run_sync()
+        study = store.get("kubeflow.org/v1alpha1", "StudyJob", "study1",
+                          "default")
+        assert study["status"]["phase"] == "Completed"
+        assert study["status"]["completedTrials"] == 3
+        assert study["status"]["bestTrial"]["index"] == 1
+        assert study["status"]["bestTrial"]["objectiveValue"] == 0.9
+        assert study["status"]["conditions"][0]["type"] == "Completed"
+
+    def test_rolling_launch_after_completion(self, store, manager):
+        self._mgr(store, manager)
+        store.create(self._study(max_trials=4, parallelism=2))
+        manager.run_sync()
+        self._report(store, 0, 0.5)
+        manager.run_sync()
+        names = [p["metadata"]["name"]
+                 for p in store.list("v1", "Pod", "default")]
+        assert "study1-trial-2" in names
