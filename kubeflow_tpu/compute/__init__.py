@@ -1,0 +1,23 @@
+"""TPU compute layer: JAX/XLA/pjit/Pallas training + serving substrate.
+
+This is the layer the reference platform delegates to out-of-tree
+NCCL/CUDA operators (SURVEY.md §2 "Parallelism & distributed-communication
+components — explicit accounting": no in-tree DP/TP/PP/SP implementation,
+no NCCL/MPI binding). Here it is first-class and TPU-native:
+
+- ``mesh``      — device meshes from TPU slice topology; multi-host init
+                  from the ``TPU_WORKER_*`` env the TpuSlice controller's
+                  PodDefault injects (the platform contract).
+- ``sharding``  — logical-axis partition rules → ``NamedSharding``.
+- ``models``    — functional model zoo (TransformerLM, ResNet-50, MLP).
+- ``attention`` — ring attention (sequence parallelism over ICI) and a
+                  Pallas flash-attention kernel for the hot path.
+- ``train``     — pjit-sharded train step: bf16 compute, fp32 master
+                  weights, gradient accumulation, rematerialisation.
+- ``checkpoint``— orbax-backed save/resume.
+- ``data``      — per-host sharded global batches.
+- ``serving``   — jitted predict behind the reference's TF-Serving REST
+                  contract (testing/test_tf_serving.py:108-111).
+"""
+
+from . import attention, mesh, models, ops, sharding, train  # noqa: F401

@@ -1,0 +1,122 @@
+"""Attention: dense reference, ring (sequence-parallel) and flash (Pallas).
+
+Long context is first-class (SURVEY.md §5 "Long-context / sequence
+parallelism — absent in the reference; new compute-layer feature"):
+``ring_attention`` shards the sequence over a mesh axis and rotates K/V
+blocks around the ICI ring with ``lax.ppermute``, accumulating blockwise
+softmax in fp32 — O(S/n) activation memory per chip and compute/comm
+overlap on the ring. The algorithm is the public blockwise/ring-attention
+recipe (Liu et al.), built from scratch on XLA collectives.
+
+All functions take q,k,v as [batch, seq, heads, head_dim].
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _scale(q, scale):
+    return q * (scale if scale is not None else q.shape[-1] ** -0.5)
+
+
+def repeat_kv(k, n_rep):
+    """GQA: repeat kv heads to match query heads."""
+    if n_rep == 1:
+        return k
+    return jnp.repeat(k, n_rep, axis=2)
+
+
+def dense_attention(q, k, v, causal=True, scale=None, q_offset=0,
+                    k_offset=0):
+    """Reference attention; fp32 softmax. Offsets give global positions
+    so blockwise callers (ring) can reuse the same masking logic."""
+    q = _scale(q, scale)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])[:, None]
+        k_pos = k_offset + jnp.arange(k.shape[1])[None, :]
+        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block(carry, kv, q, q_offset, k_offset, causal, scale):
+    """One blockwise-softmax accumulation step (fp32 state)."""
+    o, m, l = carry
+    k, v = kv
+    logits = jnp.einsum("bqhd,bkhd->bhqk", _scale(q, scale), k,
+                        preferred_element_type=jnp.float32)
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])[:, None]
+        k_pos = k_offset + jnp.arange(k.shape[1])[None, :]
+        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(logits - m_new[..., None])
+    l = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return o, m_new, l
+
+
+def ring_attention(q, k, v, axis_name, causal=True, scale=None):
+    """Sequence-parallel attention over a ring. Call inside shard_map
+    with q,k,v sharded on seq along ``axis_name``.
+
+    Each of the n devices holds one S/n-length block; K/V rotate n times
+    around the ring (`lax.ppermute` rides ICI neighbor links), each hop
+    folding one block into the running blockwise softmax. Differentiable
+    by construction (autodiff through scan+ppermute gives the reverse
+    ring for the backward pass).
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    chunk = q.shape[1]
+    q_offset = idx * chunk
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    o = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+    m = jnp.full((q.shape[0], q.shape[2], q.shape[1]), NEG_INF, jnp.float32)
+    l = jnp.zeros_like(m)
+
+    def step(carry, s):
+        o, m, l, k, v = carry
+        # after s hops we hold the block that started on shard idx - s
+        k_offset = ((idx - s) % n) * chunk
+        o, m, l = _block((o, m, l), (k, v), q, q_offset, k_offset,
+                         causal, scale)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        return (o, m, l, k, v), None
+
+    (o, m, l, _, _), _ = lax.scan(step, (o, m, l, k, v), jnp.arange(n))
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, seq_axis="sequence", causal=True,
+                           scale=None, mesh=None):
+    """shard_map wrapper: manual over the sequence axis only; batch/head
+    sharding stays automatic so tensor/data parallelism compose.
+
+    Partial-manual shard_map needs an ambient mesh: call under
+    ``jax.set_mesh(mesh)`` (the train step does this), or pass ``mesh``
+    to have this wrapper set it.
+    """
+    fn = functools.partial(ring_attention, axis_name=seq_axis,
+                           causal=causal, scale=scale)
+    spec = P(None, seq_axis, None, None)
+    sm = jax.shard_map(fn, in_specs=(spec, spec, spec), out_specs=spec,
+                       axis_names={seq_axis}, check_vma=False)
+    if mesh is not None:
+        # partial-manual shard_map only traces under jit + ambient mesh;
+        # convenience path for eager callers (tests, notebooks)
+        with jax.set_mesh(mesh):
+            return jax.jit(sm)(q, k, v)
+    return sm(q, k, v)

@@ -1,0 +1,151 @@
+"""Device meshes from TPU slice topology, and multi-host initialization.
+
+The reference has no comm backend in-tree (SURVEY.md §5 "Distributed
+communication backend — absent"); its GPU-era assumption is NCCL inside
+user images. The TPU-native design scales through a single abstraction:
+a ``jax.sharding.Mesh`` whose axes name the parallelism strategy, with
+XLA inserting the collectives (psum/all-gather/reduce-scatter over ICI
+within a slice, DCN across slices).
+
+Axis conventions (outer → inner, slowest → fastest varying):
+
+    ``data``     pure data parallelism (gradients psum'd)
+    ``fsdp``     data parallelism with parameter sharding (ZeRO-3 style:
+                 params all-gathered per layer, grads reduce-scattered)
+    ``sequence`` sequence/context parallelism (ring attention)
+    ``tensor``   megatron-style tensor parallelism inside a layer
+    ``expert``   expert parallelism for MoE layers
+
+ICI is fastest on the innermost mesh axes (adjacent device ids share a
+link), so ``tensor`` — the axis with per-layer all-reduces on the
+critical path — is innermost; ``data``, which communicates once per step,
+is outermost and is the axis to span DCN when running multi-slice.
+
+Platform contract: the TpuSlice controller (controllers/tpuslice.py)
+injects ``TPU_WORKER_ID``, ``TPU_WORKER_HOSTNAMES`` and
+``JAX_COORDINATOR_ADDRESS`` through the PodDefault admission plane —
+the TPU-native re-keying of the reference's GPU env plumbing
+(reference components/crud-web-apps/jupyter/backend/apps/common/
+form.py:226-250 is the function this contract re-targets).
+"""
+
+import dataclasses
+import math
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA = "data"
+FSDP = "fsdp"
+SEQUENCE = "sequence"
+TENSOR = "tensor"
+EXPERT = "expert"
+
+#: canonical axis order, outermost (DCN-friendly) → innermost (ICI-hot)
+AXIS_ORDER = (DATA, FSDP, EXPERT, SEQUENCE, TENSOR)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Sizes per parallelism axis; -1 on at most one axis means "fill with
+    the remaining devices" (like a reshape wildcard)."""
+
+    data: int = 1
+    fsdp: int = 1
+    sequence: int = 1
+    tensor: int = 1
+    expert: int = 1
+
+    def resolved(self, n_devices):
+        sizes = {a: getattr(self, a) for a in AXIS_ORDER}
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis, got {wild}")
+        known = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % known:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by {known}")
+            sizes[wild[0]] = n_devices // known
+        elif known != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {known} devices, have {n_devices}")
+        return sizes
+
+    @property
+    def axis_names(self):
+        return AXIS_ORDER
+
+
+def make_mesh(spec=None, devices=None, **axis_sizes):
+    """Build a Mesh from a MeshSpec (or axis sizes as kwargs).
+
+    Axes of size 1 are kept in the mesh: partition specs can then name
+    any canonical axis unconditionally and XLA drops the no-op
+    collectives, which keeps one set of sharding rules valid across
+    every mesh shape (single chip included).
+    """
+    if spec is None:
+        spec = MeshSpec(**axis_sizes)
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    sizes = spec.resolved(devices.size)
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    return Mesh(devices.reshape(shape), AXIS_ORDER)
+
+
+# single source of truth for topology parsing, shared with the TpuSlice
+# controller so worker counts and chip counts can't diverge
+from ..api.tpuslice import topology_chips  # noqa: E402,F401
+
+
+def mesh_for_slice(accelerator="", topology="", tensor=1, sequence=1,
+                   fsdp=1, expert=1, devices=None):
+    """Mesh for one TPU slice: explicit inner axes, data fills the rest.
+
+    ``topology`` is advisory (the slice controller schedules it); the
+    actual device count comes from the runtime, so a notebook on a
+    partial slice still gets a valid mesh.
+    """
+    return make_mesh(
+        MeshSpec(data=-1, fsdp=fsdp, sequence=sequence, tensor=tensor,
+                 expert=expert),
+        devices=devices)
+
+
+def distributed_env():
+    """Read the TpuSlice/PodDefault-injected worker env. Returns
+    (coordinator, num_processes, process_id) or None when not in a
+    multi-worker slice."""
+    worker_id = os.environ.get("TPU_WORKER_ID")
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if worker_id is None or not hostnames:
+        return None
+    hosts = [h.strip() for h in hostnames.split(",") if h.strip()]
+    if coordinator is None:
+        coordinator = f"{hosts[0]}:8476"
+    return coordinator, len(hosts), int(worker_id)
+
+
+def initialize_distributed():
+    """jax.distributed.initialize from the platform-injected env.
+
+    Safe to call unconditionally in workload entrypoints: a single-host
+    notebook (no TPU_WORKER_* env) is a no-op. Worker 0 is the
+    coordinator — its stable DNS name comes from the TpuSlice headless
+    Service (`<slice>-0.<slice>`), so a restarted worker rejoins the
+    same address (mesh re-formation, SURVEY.md §7 hard part (a)).
+    """
+    env = distributed_env()
+    if env is None:
+        return False
+    coordinator, num_processes, process_id = env
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id)
+    return True

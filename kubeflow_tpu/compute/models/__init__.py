@@ -1,0 +1,15 @@
+"""Functional model zoo. Each model module exposes the same surface:
+
+- ``Config`` dataclass (static hyperparameters),
+- ``init_params(config, key)`` → pytree of fp32 arrays,
+- ``logical_axes(config)`` → same-structure pytree of logical axis
+  tuples (consumed by compute.sharding),
+- ``apply(params, inputs, config)`` → outputs,
+- ``loss_fn(params, batch, config)`` → (loss, metrics).
+
+Models are plain pytrees + pure functions rather than a module
+framework: every transform (jit/grad/scan/shard_map) composes without
+indirection, and the partition layout lives in one visible tree.
+"""
+
+from . import mlp, resnet, transformer  # noqa: F401

@@ -1,0 +1,57 @@
+"""MNIST MLP — the smallest end-to-end workload (BASELINE.json config #1,
+"MNIST notebook"), and the default StudyJob trial objective."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .. import sharding
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    in_dim: int = 784
+    hidden: int = 512
+    n_classes: int = 10
+    n_layers: int = 2
+    dtype: str = "float32"
+
+
+def logical_axes(config):
+    layers = []
+    for _ in range(config.n_layers + 1):
+        layers.append({"w": ("embed", "mlp"), "b": ("mlp",)})
+    return {"layers": layers}
+
+
+def init_params(config, key):
+    dims = ([config.in_dim] + [config.hidden] * config.n_layers
+            + [config.n_classes])
+    layers = []
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        k = jax.random.fold_in(key, i)
+        layers.append({
+            "w": jax.random.normal(k, (d_in, d_out)) * d_in ** -0.5,
+            "b": jnp.zeros((d_out,)),
+        })
+    return {"layers": layers}
+
+
+def apply(params, x, config):
+    dt = jnp.dtype(config.dtype)
+    x = x.reshape(x.shape[0], -1).astype(dt)
+    x = sharding.constrain(x, ("batch", None))
+    *hidden, last = params["layers"]
+    for lp in hidden:
+        x = jax.nn.relu(x @ lp["w"].astype(dt) + lp["b"].astype(dt))
+    return x @ last["w"].astype(dt) + last["b"].astype(dt)
+
+
+def loss_fn(params, batch, config):
+    logits = apply(params, batch["image"], config).astype(jnp.float32)
+    labels = batch["label"]
+    nll = -jax.nn.log_softmax(logits)[jnp.arange(labels.shape[0]), labels]
+    loss = nll.mean()
+    acc = (logits.argmax(-1) == labels).mean()
+    return loss, {"loss": loss, "accuracy": acc}

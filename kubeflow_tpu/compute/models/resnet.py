@@ -1,0 +1,191 @@
+"""ResNet-50 — the BASELINE.md headline workload ("ResNet-50 ImageNet in
+a Notebook CR, samples/sec"). Functional NHWC implementation.
+
+TPU notes: NHWC is XLA-TPU's preferred conv layout; batch norm reduces
+over a *logical* (global) batch, so under a data-sharded mesh the batch
+stats are cross-replica (sync-BN) for free — XLA inserts the psum.
+bf16 conv compute with fp32 BN statistics and master weights.
+"""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import sharding
+
+STAGE_BLOCKS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3),
+                101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+BOTTLENECK = {50, 101, 152}
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    depth: int = 50
+    n_classes: int = 1000
+    width: int = 64
+    dtype: str = "bfloat16"
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def _conv_init(key, shape):
+    fan_in = shape[0] * shape[1] * shape[2]
+    return jax.random.normal(key, shape, jnp.float32) * (2 / fan_in) ** 0.5
+
+
+def _bn_init(ch, zero_scale=False):
+    return {"scale": (jnp.zeros if zero_scale else jnp.ones)((ch,)),
+            "bias": jnp.zeros((ch,))}
+
+
+def _bn_stats(ch):
+    return {"mean": jnp.zeros((ch,)), "var": jnp.ones((ch,))}
+
+
+def _block_channels(config, stage):
+    base = config.width * (2 ** stage)
+    if config.depth in BOTTLENECK:
+        return base, base * 4
+    return base, base
+
+
+def init_params(config, key):
+    """Returns (params, batch_stats)."""
+    blocks_per_stage = STAGE_BLOCKS[config.depth]
+    bottleneck = config.depth in BOTTLENECK
+    params = {"stem": {"conv": _conv_init(key, (7, 7, 3, config.width)),
+                       "bn": _bn_init(config.width)}}
+    stats = {"stem": {"bn": _bn_stats(config.width)}}
+    in_ch = config.width
+    stages, sstages = [], []
+    for stage, n_blocks in enumerate(blocks_per_stage):
+        mid, out = _block_channels(config, stage)
+        blocks, sblocks = [], []
+        for b in range(n_blocks):
+            k = jax.random.fold_in(key, stage * 100 + b + 1)
+            bp, bs = {}, {}
+            if bottleneck:
+                shapes = [(1, 1, in_ch, mid), (3, 3, mid, mid),
+                          (1, 1, mid, out)]
+            else:
+                shapes = [(3, 3, in_ch, mid), (3, 3, mid, out)]
+            for i, shape in enumerate(shapes):
+                bp[f"conv{i}"] = _conv_init(jax.random.fold_in(k, i), shape)
+                bp[f"bn{i}"] = _bn_init(shape[-1],
+                                        zero_scale=(i == len(shapes) - 1))
+                bs[f"bn{i}"] = _bn_stats(shape[-1])
+            if b == 0 and (in_ch != out or stage > 0):
+                bp["proj"] = _conv_init(
+                    jax.random.fold_in(k, 9), (1, 1, in_ch, out))
+                bp["proj_bn"] = _bn_init(out)
+                bs["proj_bn"] = _bn_stats(out)
+            blocks.append(bp)
+            sblocks.append(bs)
+            in_ch = out
+        stages.append(blocks)
+        sstages.append(sblocks)
+    params["stages"] = stages
+    stats["stages"] = sstages
+    params["fc"] = {
+        "w": jax.random.normal(jax.random.fold_in(key, 7777),
+                               (in_ch, config.n_classes)) * in_ch ** -0.5,
+        "b": jnp.zeros((config.n_classes,))}
+    return params, stats
+
+
+def logical_axes(config):
+    """Weights replicated (they're small next to activations); batch
+    sharded on (data, fsdp). FSDP over conv kernels is a later knob."""
+    params, stats = init_params(config, jax.random.PRNGKey(0))
+    rep = jax.tree.map(lambda x: tuple([None] * x.ndim), params)
+    return rep, jax.tree.map(lambda x: tuple([None] * x.ndim), stats)
+
+
+def _conv(x, w, stride=1, dtype=None):
+    return lax.conv_general_dilated(
+        x.astype(dtype), w.astype(dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _bn(x, bp, bs, config, train):
+    xf = x.astype(jnp.float32)
+    if train:
+        mean = xf.mean(axis=(0, 1, 2))
+        var = xf.var(axis=(0, 1, 2))
+        mom = config.bn_momentum
+        new = {"mean": mom * bs["mean"] + (1 - mom) * mean,
+               "var": mom * bs["var"] + (1 - mom) * var}
+    else:
+        mean, var = bs["mean"], bs["var"]
+        new = bs
+    y = (xf - mean) * lax.rsqrt(var + config.bn_eps)
+    y = y * bp["scale"] + bp["bias"]
+    return y.astype(x.dtype), new
+
+
+def _block(x, bp, bs, config, stride, train):
+    dt = config.compute_dtype
+    bottleneck = config.depth in BOTTLENECK
+    new_bs = {}
+    residual = x
+    n_convs = 3 if bottleneck else 2
+    h = x
+    for i in range(n_convs):
+        s = stride if i == (1 if bottleneck else 0) else 1
+        h = _conv(h, bp[f"conv{i}"], s, dt)
+        h, new_bs[f"bn{i}"] = _bn(h, bp[f"bn{i}"], bs[f"bn{i}"], config,
+                                  train)
+        if i < n_convs - 1:
+            h = jax.nn.relu(h)
+    if "proj" in bp:
+        residual = _conv(x, bp["proj"], stride, dt)
+        residual, new_bs["proj_bn"] = _bn(
+            residual, bp["proj_bn"], bs["proj_bn"], config, train)
+    return jax.nn.relu(h + residual.astype(h.dtype)), new_bs
+
+
+def apply(params, stats, x, config, train=True):
+    """x [B,H,W,3] → (logits fp32 [B,n_classes], new_stats)."""
+    dt = config.compute_dtype
+    x = sharding.constrain(x, ("batch", None, None, None))
+    h = _conv(x, params["stem"]["conv"], 2, dt)
+    h, stem_bn = _bn(h, params["stem"]["bn"], stats["stem"]["bn"], config,
+                     train)
+    h = jax.nn.relu(h)
+    h = lax.reduce_window(h, -jnp.inf, lax.max, (1, 3, 3, 1),
+                          (1, 2, 2, 1), "SAME")
+    new_stats = {"stem": {"bn": stem_bn}, "stages": []}
+    for stage, blocks in enumerate(params["stages"]):
+        sblocks = []
+        for b, bp in enumerate(blocks):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            h, nbs = _block(h, bp, stats["stages"][stage][b], config,
+                            stride, train)
+            sblocks.append(nbs)
+        new_stats["stages"].append(sblocks)
+    h = h.astype(jnp.float32).mean(axis=(1, 2))
+    h = sharding.constrain(h, ("batch", None))
+    logits = h @ params["fc"]["w"] + params["fc"]["b"]
+    return logits, new_stats
+
+
+def loss_fn(params, stats, batch, config, train=True):
+    logits, new_stats = apply(params, stats, batch["image"], config, train)
+    labels = batch["label"]
+    nll = -jax.nn.log_softmax(logits)[jnp.arange(labels.shape[0]), labels]
+    loss = nll.mean()
+    acc = (logits.argmax(-1) == labels).mean()
+    return loss, ({"loss": loss, "accuracy": acc}, new_stats)
+
+
+@functools.lru_cache()
+def flops_per_sample(depth=50, image=224):
+    """Rough analytic fwd+bwd FLOPs per 224px sample (for MFU)."""
+    return {50: 3 * 4.1e9}.get(depth, 3 * 4.1e9)

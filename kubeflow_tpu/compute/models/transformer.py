@@ -1,0 +1,258 @@
+"""TransformerLM — the flagship decoder-only model (llama-family shape).
+
+TPU-first design choices:
+- bf16 compute / fp32 master weights (MXU-native dtype),
+- scan-over-layers: one traced layer, O(1) compile time in depth,
+- ``jax.checkpoint`` per layer: activation memory ∝ sqrt-depth,
+- all parallelism expressed as logical axes (compute.sharding):
+  megatron tensor parallelism over heads/mlp, fsdp over embed, data
+  over batch, ring attention over sequence — the mesh decides which
+  are real; the model never changes.
+
+The reference platform has no model code (it schedules containers);
+this is the compute substrate its GPU world delegated to out-of-tree
+frameworks (SURVEY.md §2 parallelism table, BASELINE.json BERT-base
+pjit-over-ICI config).
+"""
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import attention as attn_lib
+from .. import sharding
+from ..ops import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 0          # 0 → = n_heads (no GQA)
+    d_ff: int = 0                # 0 → swiglu default, rounded to 256
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    dtype: str = "bfloat16"
+    attention: str = "flash"     # dense | flash | ring
+    remat: bool = True
+    scan_layers: bool = True
+
+    @property
+    def kv_heads(self):
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    @property
+    def ff_dim(self):
+        if self.d_ff:
+            return self.d_ff
+        return ((8 * self.d_model // 3) + 255) // 256 * 256
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+# ---------------------------------------------------------------- params
+
+def _layer_shapes(c):
+    h, kv, d, f = c.n_heads, c.kv_heads, c.d_model, c.ff_dim
+    hd = c.head_dim
+    return {
+        "attn_norm": ((d,), (None,)),
+        "wq": ((d, h, hd), ("embed", "heads", None)),
+        "wk": ((d, kv, hd), ("embed", "heads", None)),
+        "wv": ((d, kv, hd), ("embed", "heads", None)),
+        "wo": ((h, hd, d), ("heads", None, "embed")),
+        "mlp_norm": ((d,), (None,)),
+        "w_gate": ((d, f), ("embed", "mlp")),
+        "w_up": ((d, f), ("embed", "mlp")),
+        "w_down": ((f, d), ("mlp", "embed")),
+    }
+
+
+def _shapes(c):
+    return {
+        "embed": ((c.vocab_size, c.d_model), ("vocab", "embed")),
+        "final_norm": ((c.d_model,), (None,)),
+        "head": ((c.d_model, c.vocab_size), ("embed", "vocab")),
+        "layers": _layer_shapes(c),
+    }
+
+
+def logical_axes(config):
+    tree = {}
+    for name, v in _shapes(config).items():
+        if name == "layers":
+            prefix = ("layers",) if config.scan_layers else ()
+            tree["layers"] = {k: prefix + ax for k, (_, ax) in v.items()}
+            if not config.scan_layers:
+                tree["layers"] = [tree["layers"]] * config.n_layers
+        else:
+            tree[name] = v[1]
+    return tree
+
+
+def init_params(config, key):
+    def init_one(key, shape, fan_in):
+        if len(shape) == 1:
+            return jnp.ones(shape, jnp.float32)
+        std = fan_in ** -0.5
+        return jax.random.normal(key, shape, jnp.float32) * std
+
+    params = {}
+    k_embed, k_head, k_layers = jax.random.split(key, 3)
+    params["embed"] = jax.random.normal(
+        k_embed, (config.vocab_size, config.d_model), jnp.float32)
+    params["embed"] = params["embed"] * config.d_model ** -0.5
+    params["final_norm"] = jnp.ones((config.d_model,), jnp.float32)
+    params["head"] = init_one(
+        k_head, (config.d_model, config.vocab_size), config.d_model)
+
+    def layer_params(key):
+        out = {}
+        for i, (name, (shape, _)) in enumerate(_layer_shapes(config).items()):
+            out[name] = init_one(jax.random.fold_in(key, i), shape, shape[0])
+        return out
+
+    if config.scan_layers:
+        keys = jax.random.split(k_layers, config.n_layers)
+        params["layers"] = jax.vmap(layer_params)(keys)
+    else:
+        params["layers"] = [
+            layer_params(jax.random.fold_in(k_layers, i))
+            for i in range(config.n_layers)]
+    return params
+
+
+# ---------------------------------------------------------------- forward
+
+def _rmsnorm(x, scale, eps=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+    return (x * lax.rsqrt(var + eps).astype(x.dtype)) * scale
+
+
+def rope_tables(config, positions):
+    """cos/sin tables for rotary embedding at the given positions."""
+    hd = config.head_dim
+    freqs = config.rope_theta ** (
+        -jnp.arange(0, hd, 2, dtype=jnp.float32) / hd)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [S, hd/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin):
+    """x: [B, S, H, D]; rotate pairs (even, odd)."""
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    c = cos[None, :, None, :].astype(x.dtype)
+    s = sin[None, :, None, :].astype(x.dtype)
+    out = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.reshape(x.shape)
+
+
+def _attention(q, k, v, config):
+    n_rep = config.n_heads // config.kv_heads
+    k = attn_lib.repeat_kv(k, n_rep)
+    v = attn_lib.repeat_kv(v, n_rep)
+    if config.attention == "ring":
+        return attn_lib.ring_attention_sharded(q, k, v, causal=True)
+    if config.attention == "flash":
+        return flash_attention(q, k, v, causal=True)
+    return attn_lib.dense_attention(q, k, v, causal=True)
+
+
+def _layer(lp, x, rope, config):
+    cos, sin = rope
+    dt = config.compute_dtype
+    h = _rmsnorm(x, lp["attn_norm"].astype(dt))
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(dt))
+    q = sharding.constrain(apply_rope(q, cos, sin),
+                           ("batch", "seq", "act_heads", None))
+    k = sharding.constrain(apply_rope(k, cos, sin),
+                           ("batch", "seq", "act_heads", None))
+    o = _attention(q, k, v, config)
+    o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(dt))
+    x = sharding.constrain(x + o, ("batch", "seq", "act_embed"))
+
+    h = _rmsnorm(x, lp["mlp_norm"].astype(dt))
+    gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(dt))
+    up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(dt))
+    down = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                      lp["w_down"].astype(dt))
+    return sharding.constrain(x + down, ("batch", "seq", "act_embed"))
+
+
+def apply(params, tokens, config):
+    """tokens [B, S] int32 → logits [B, S, vocab] fp32."""
+    dt = config.compute_dtype
+    x = jnp.take(params["embed"].astype(dt), tokens, axis=0)
+    x = sharding.constrain(x, ("batch", "seq", "act_embed"))
+    positions = jnp.arange(tokens.shape[1])
+    rope = rope_tables(config, positions)
+
+    layer = lambda lp, x: _layer(lp, x, rope, config)  # noqa: E731
+    if config.remat:
+        layer = jax.checkpoint(layer)
+    if config.scan_layers:
+        x, _ = lax.scan(lambda c, lp: (layer(lp, c), None),
+                        x, params["layers"])
+    else:
+        for lp in params["layers"]:
+            x = layer(lp, x)
+
+    x = _rmsnorm(x, params["final_norm"].astype(dt))
+    logits = jnp.einsum("bsd,dv->bsv", x, params["head"].astype(dt),
+                        preferred_element_type=jnp.float32)
+    return sharding.constrain(logits, ("batch", "seq", None))
+
+
+def loss_fn(params, batch, config):
+    """batch: {tokens [B,S], targets [B,S], mask [B,S] optional}.
+    Cross entropy in fp32 with z-loss 1e-4 for logit drift control."""
+    logits = apply(params, batch["tokens"], config)
+    targets = batch["targets"]
+    mask = batch.get("mask")
+    if mask is None:
+        mask = jnp.ones(targets.shape, jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - label_logits
+    z_loss = 1e-4 * jnp.square(logz)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = ((nll + z_loss) * mask).sum() / denom
+    acc = ((logits.argmax(-1) == targets) * mask).sum() / denom
+    return loss, {"loss": loss, "accuracy": acc,
+                  "perplexity": jnp.exp((nll * mask).sum() / denom)}
+
+
+def flops_per_token(config):
+    """Analytic 6ND forward+backward FLOPs/token (for MFU accounting)."""
+    c = config
+    n_params = (
+        c.vocab_size * c.d_model * 2
+        + c.n_layers * (
+            c.d_model * (c.n_heads + 2 * c.kv_heads) * c.head_dim
+            + c.n_heads * c.head_dim * c.d_model
+            + 3 * c.d_model * c.ff_dim
+            + 2 * c.d_model))
+    attn = 12 * c.n_layers * c.d_model * c.max_seq  # per-token attn matmuls
+    return 6 * n_params + attn
+
+
+def param_count(config):
+    return sum(
+        math.prod(s) for s, _ in
+        [v for v in _shapes(config).values() if not isinstance(v, dict)]
+    ) + config.n_layers * sum(
+        math.prod(s) for s, _ in _layer_shapes(config).values())

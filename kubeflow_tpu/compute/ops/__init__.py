@@ -1,0 +1,4 @@
+"""Pallas TPU kernels for the hot ops (flash attention first; the MXU
+matmul path itself is XLA's job and is already optimal there)."""
+
+from .flash_attention import flash_attention  # noqa: F401

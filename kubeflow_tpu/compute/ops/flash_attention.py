@@ -1,0 +1,178 @@
+"""Flash attention forward as a Pallas TPU kernel.
+
+Tiled online-softmax attention: Q blocks stream through VMEM, K/V blocks
+stream through the inner loop, the (S×S) score matrix never materializes
+in HBM. fp32 accumulation on the MXU via ``preferred_element_type``.
+Causal kernels skip fully-masked K blocks (dynamic inner trip count), so
+causal costs ~half of full.
+
+The backward pass is an exact XLA recompute from the saved (out, lse)
+residuals (standard memory-efficient attention gradient) — O(S²) compute
+but O(S) HBM residuals, and XLA fuses it well; a Pallas backward kernel
+is a later optimization.
+
+No reference counterpart (the reference has no attention code at all —
+SURVEY.md §2); written from the public flash-attention recipe against
+/opt/skills/guides/pallas_guide.md.
+
+Interpret mode runs the same kernel on CPU for the virtual-mesh test
+tier (tests/conftest.py), mirroring how the reference tests controllers
+against envtest instead of a real cluster.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k, seq_k):
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    jq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    q_pos = jq * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(kb, carry):
+        o, m, l = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * corr + p.sum(axis=1, keepdims=True)
+        o = o * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return o, m_new, l
+
+    # running stats kept 2D [bq, 1] (Mosaic wants >=2D vectors)
+    o = jnp.zeros((bq, d), jnp.float32)
+    m = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    if causal:
+        # K blocks past this Q block's last row are fully masked
+        n_kb = lax.div(jq * bq + bq + block_k - 1, block_k)
+    else:
+        n_kb = seq_k // block_k
+    o, m, l = lax.fori_loop(0, n_kb, body, (o, m, l))
+    o_ref[0] = (o / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    grid = (b * h, sq // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_k=block_k, seq_k=sk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, sq)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal=True, scale=None, block_q=128,
+                    block_k=128, interpret=None):
+    """Fused attention. q,k,v: [batch, seq, heads, head_dim] (same head
+    count — GQA callers repeat kv first). Falls back to the exact XLA
+    path when the sequence doesn't tile."""
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                      interpret)[0]
+
+
+def _resolve(q, scale, block_q, block_k, interpret):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    block_q = min(block_q, q.shape[1])
+    block_k = min(block_k, q.shape[1])
+    return scale, block_q, block_k, interpret
+
+
+def _dense_fwd(q, k, v, scale, causal):
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    if causal:
+        q_pos = jnp.arange(q.shape[1])[:, None]
+        k_pos = jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    lse = jax.nn.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype), lse
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    scale, block_q, block_k, interpret = _resolve(
+        q, scale, block_q, block_k, interpret)
+    if q.shape[1] % block_q or k.shape[1] % block_k:
+        out, lse = _dense_fwd(q, k, v, scale, causal)
+    else:
+        out, lse = _fwd(q, k, v, scale, causal, block_q, block_k,
+                        interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, res = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+    return out, res
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    scale, _, _, _ = _resolve(q, scale, block_q, block_k, interpret)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf * scale, kf)
+    if causal:
+        q_pos = jnp.arange(q.shape[1])[:, None]
+        k_pos = jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse[..., None])
+    delta = jnp.einsum("bqhd,bqhd->bhq", dof, out.astype(jnp.float32))
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf)
+    ds = p * (dp - delta[..., None])
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -1,0 +1,80 @@
+"""Logical-axis partition rules → NamedSharding.
+
+Models annotate parameters/activations with *logical* axis names
+("embed", "heads", "batch", …); a rule table maps logical → mesh axes.
+Changing the parallelism layout (tp↔fsdp↔dp) is a rule-table edit, not a
+model edit — the property that lets one model definition serve the
+single-chip notebook path and the multi-host TpuSlice path unchanged.
+
+Design follows the public JAX idiom (scaling-book / t5x-style logical
+axis rules), not any reference code — the reference has no sharding
+layer at all (SURVEY.md §2 parallelism table).
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+#: logical axis → mesh axis (or tuple of mesh axes, or None=replicated).
+#: One table serves every mesh shape because size-1 mesh axes are no-ops.
+DEFAULT_RULES = {
+    # activations
+    "batch": (mesh_lib.DATA, mesh_lib.FSDP),
+    "seq": mesh_lib.SEQUENCE,
+    "act_embed": None,
+    "act_heads": mesh_lib.TENSOR,
+    # parameters
+    "embed": mesh_lib.FSDP,         # fsdp shards the non-tensor dim
+    "vocab": mesh_lib.TENSOR,
+    "mlp": mesh_lib.TENSOR,
+    "heads": mesh_lib.TENSOR,
+    "kv": None,
+    "expert": mesh_lib.EXPERT,
+    "layers": None,                  # scan-over-layers leading dim
+}
+
+
+def spec_for(logical_axes, rules=None):
+    """('embed','mlp') → PartitionSpec(fsdp_axis, tensor_axis).
+    ``None`` (whole-array) → fully replicated."""
+    if logical_axes is None:
+        return P()
+    rules = rules or DEFAULT_RULES
+    parts = []
+    for ax in logical_axes:
+        if ax is None:
+            parts.append(None)
+        else:
+            parts.append(rules[ax])
+    return P(*parts)
+
+
+def tree_specs(logical_tree, rules=None):
+    """Map a pytree of logical-axis tuples to PartitionSpecs."""
+    return jax.tree.map(
+        lambda axes: spec_for(axes, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) or x is None)
+
+
+def tree_shardings(mesh, logical_tree, rules=None):
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        tree_specs(logical_tree, rules),
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def constrain(x, logical_axes, rules=None):
+    """with_sharding_constraint by logical axes (no-op outside jit/mesh)."""
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, spec_for(logical_axes, rules))
+    except (ValueError, RuntimeError):
+        return x
+
+
+def shard_tree(tree, mesh, logical_tree, rules=None):
+    """Device-put a pytree onto the mesh per its logical axes."""
+    shardings = tree_shardings(mesh, logical_tree, rules)
+    return jax.device_put(tree, shardings)

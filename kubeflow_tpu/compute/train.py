@@ -1,0 +1,143 @@
+"""Sharded training engine: init → pjit train step → metrics.
+
+One step function serves every mesh shape (single chip → multi-host
+slice): parallelism is carried entirely by the params' logical-axis
+shardings plus activation constraints inside the models. XLA inserts the
+collectives — gradient psum over ``data``, reduce-scatter/all-gather
+over ``fsdp``, per-layer all-reduce over ``tensor``, ppermute rings over
+``sequence`` — there is no hand-written communication here (the design
+SURVEY.md §2 calls for in place of the reference's out-of-tree NCCL
+world).
+
+Mixed precision: fp32 master weights (params pytree), bf16 compute
+(models cast at use), fp32 loss/grad accumulation.
+"""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from . import sharding as sharding_lib
+
+
+@functools.partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["step", "params", "opt_state", "extra"],
+    meta_fields=[])
+@dataclasses.dataclass
+class TrainState:
+    step: jax.Array
+    params: dict
+    opt_state: tuple
+    extra: dict  # mutable model state (e.g. BN batch_stats); {} if none
+
+
+def make_optimizer(learning_rate=3e-4, warmup_steps=100,
+                   total_steps=100_000, weight_decay=0.01, b1=0.9,
+                   b2=0.95, clip_norm=1.0):
+    """AdamW + global-norm clip + warmup-cosine — the standard recipe."""
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(clip_norm),
+        optax.adamw(sched, b1=b1, b2=b2, weight_decay=weight_decay))
+
+
+def init_state(init_params_fn, optimizer, mesh, logical_axes, key,
+               extra=None, rules=None):
+    """Initialize a TrainState already sharded onto the mesh: params are
+    jit-initialized straight into their NamedShardings (no host-side
+    full copy), opt_state inherits the params sharding by propagation."""
+    shardings = sharding_lib.tree_shardings(mesh, logical_axes, rules)
+    with jax.set_mesh(mesh):
+        params = jax.jit(init_params_fn, out_shardings=shardings)(key)
+        opt_state = jax.jit(optimizer.init)(params)
+        step = jnp.zeros((), jnp.int32)
+    return TrainState(step=step, params=params, opt_state=opt_state,
+                      extra=extra if extra is not None else {})
+
+
+def make_train_step(loss_fn, optimizer, mesh, accum_steps=1):
+    """Build the jitted train step.
+
+    ``loss_fn(params, extra, batch) -> (loss, (metrics, new_extra))``.
+
+    With ``accum_steps > 1`` every batch leaf must have a leading
+    [accum_steps, ...] dim; gradients average over microbatches via
+    ``lax.scan`` (sequential — activation memory of one microbatch).
+    """
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def one(params, extra, batch):
+        (loss, (metrics, new_extra)), grads = grad_fn(params, extra, batch)
+        return loss, metrics, new_extra, grads
+
+    def step_fn(state, batch):
+        if accum_steps == 1:
+            loss, metrics, new_extra, grads = one(
+                state.params, state.extra, batch)
+        else:
+            def micro(carry, mb):
+                grads_acc, extra = carry
+                loss, metrics, extra, grads = one(state.params, extra, mb)
+                grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+                return (grads_acc, extra), (loss, metrics)
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            (grads, new_extra), (losses, metricses) = jax.lax.scan(
+                micro, (zeros, state.extra), batch)
+            grads = jax.tree.map(lambda g: g / accum_steps, grads)
+            loss = losses.mean()
+            metrics = jax.tree.map(lambda m: m.mean(), metricses)
+
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        new_state = TrainState(step=state.step + 1, params=params,
+                               opt_state=opt_state, extra=new_extra)
+        return new_state, metrics
+
+    jitted = jax.jit(step_fn, donate_argnums=(0,))
+
+    def run(state, batch):
+        with jax.set_mesh(mesh):
+            return jitted(state, batch)
+
+    run.lower = lambda state, batch: jitted.lower(state, batch)
+    return run
+
+
+def make_eval_step(loss_fn, mesh):
+    jitted = jax.jit(
+        lambda params, extra, batch: loss_fn(params, extra, batch)[1][0])
+
+    def run(state, batch):
+        with jax.set_mesh(mesh):
+            return jitted(state.params, state.extra, batch)
+    return run
+
+
+# Adapters: models expose loss(params, batch) or loss(params, stats, ...).
+
+def plain_loss(model_loss, config):
+    """For stateless models (transformer, mlp)."""
+    def loss(params, extra, batch):
+        l, metrics = model_loss(params, batch, config)
+        return l, (metrics, extra)
+    return loss
+
+
+def stateful_loss(model_loss, config, train=True):
+    """For models with mutable state (resnet batch_stats in extra)."""
+    def loss(params, extra, batch):
+        l, (metrics, new_extra) = model_loss(params, extra, batch, config,
+                                             train)
+        return l, (metrics, new_extra)
+    return loss

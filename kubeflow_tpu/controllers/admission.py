@@ -221,7 +221,15 @@ def apply_pod_defaults(pod, pod_defaults):
                                   "annotations")
     md["labels"] = merge_map(md.get("labels"), pod_defaults, "labels")
 
-    for container in spec.get("containers") or []:
+    # merge sidecars against the *pristine* containers first (same state
+    # safe_to_apply dry-ran against — mutating env before the container
+    # merge could surface a conflict safe_to_apply never saw), then
+    # inject env/mounts into the original containers only (sidecars
+    # arrive fully specified, reference main.go:478 semantics).
+    containers = spec.get("containers") or []
+    n_original = len(containers)
+    containers = merge_containers(containers, pod_defaults, True)
+    for container in containers[:n_original]:
         container["env"] = merge_env(container.get("env"), pod_defaults)
         container["volumeMounts"] = merge_volume_mounts(
             container.get("volumeMounts"), pod_defaults)
@@ -233,8 +241,7 @@ def apply_pod_defaults(pod, pod_defaults):
     init = merge_containers(spec.get("initContainers"), pod_defaults, False)
     if init:
         spec["initContainers"] = init
-    spec["containers"] = merge_containers(spec.get("containers"),
-                                          pod_defaults, True)
+    spec["containers"] = containers
 
     for pd in pod_defaults:
         rv = m.deep_get(pd, "metadata", "resourceVersion", default="")

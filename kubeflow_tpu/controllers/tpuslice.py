@@ -22,6 +22,7 @@ re-home). Design:
 """
 
 import logging
+import re
 
 from ..api import builtin, poddefault as pdapi, tpuslice as tsapi
 from ..core import meta as m
@@ -61,8 +62,10 @@ def generate_statefulset(ts):
                             accelerator)
     selector.setdefault("cloud.google.com/gke-tpu-topology", topology)
 
-    template_labels = {"tpu-slice": name}
-    template_labels.update(m.labels_of(ts))
+    # user labels first; the controller-owned selector label must win or
+    # the selector/template pair diverges (rejected by real Kubernetes)
+    template_labels = dict(m.labels_of(ts))
+    template_labels["tpu-slice"] = name
     sts = builtin.stateful_set(
         name, ns, workers,
         selector_labels={"tpu-slice": name},
@@ -189,8 +192,12 @@ class StudyJobReconciler(Reconciler):
         name = m.name_of(ev.object)
         if not name.endswith("-metrics"):
             return
-        labels = m.labels_of(ev.object)
-        study = labels.get("studyjob")
+        # trial contract: the CM is named <study>-trial-<i>-metrics; a
+        # studyjob label is honored too but not required of trial code
+        study = m.labels_of(ev.object).get("studyjob")
+        if not study:
+            match = re.match(r"^(.+)-trial-\d+-metrics$", name)
+            study = match.group(1) if match else None
         if study:
             yield Request(study, m.namespace_of(ev.object))
 

@@ -8,11 +8,18 @@ semantics). Must be set before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The image's axon TPU plugin overrides JAX_PLATFORMS at import; the
+# config knob is authoritative. Tests always run on the virtual 8-device
+# CPU mesh (multi-chip semantics without hardware — envtest philosophy).
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

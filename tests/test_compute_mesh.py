@@ -1,0 +1,88 @@
+"""Mesh/topology/sharding unit tier (reference model: the table-driven
+Go unit tests, SURVEY.md §4 tier 1)."""
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.compute import mesh as M
+from kubeflow_tpu.compute import sharding as S
+
+
+def test_mesh_axis_order_is_canonical():
+    mesh = M.make_mesh(data=2, tensor=2, sequence=2)
+    assert mesh.axis_names == M.AXIS_ORDER
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["tensor"] == 2
+    assert mesh.devices.size == 8
+
+
+def test_mesh_wildcard_fills_remaining():
+    sizes = M.MeshSpec(data=-1, tensor=4).resolved(8)
+    assert sizes["data"] == 2 and sizes["tensor"] == 4
+
+
+def test_mesh_two_wildcards_rejected():
+    with pytest.raises(ValueError):
+        M.MeshSpec(data=-1, fsdp=-1).resolved(8)
+
+
+def test_mesh_size_mismatch_rejected():
+    with pytest.raises(ValueError):
+        M.MeshSpec(data=3).resolved(8)
+    with pytest.raises(ValueError):
+        M.MeshSpec(data=-1, tensor=3).resolved(8)
+
+
+def test_topology_chips():
+    assert M.topology_chips("2x2") == 4
+    assert M.topology_chips("2x2x4") == 16
+
+
+def test_mesh_for_slice_fills_data_axis():
+    mesh = M.mesh_for_slice("tpu-v5-lite-podslice", "4x4", tensor=2)
+    assert mesh.shape["tensor"] == 2
+    assert mesh.shape["data"] == 4
+
+
+def test_distributed_env_contract(monkeypatch):
+    # the env the TpuSlice PodDefault injects (controllers/tpuslice.py)
+    monkeypatch.setenv("TPU_WORKER_ID", "2")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "ts-0.ts,ts-1.ts,ts-2.ts")
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    coordinator, n, pid = M.distributed_env()
+    assert coordinator == "ts-0.ts:8476"
+    assert (n, pid) == (3, 2)
+
+
+def test_distributed_env_absent_means_single_host(monkeypatch):
+    monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+    assert M.distributed_env() is None
+    assert M.initialize_distributed() is False
+
+
+def test_spec_for_maps_logical_axes():
+    assert S.spec_for(("embed", "mlp")) == P("fsdp", "tensor")
+    assert S.spec_for(("batch", None)) == P(("data", "fsdp"), None)
+
+
+def test_tree_shardings_match_structure():
+    mesh = M.make_mesh(data=2, fsdp=2, tensor=2)
+    tree = {"w": ("embed", "mlp"), "b": ("mlp",)}
+    sh = S.tree_shardings(mesh, tree)
+    assert sh["w"].spec == P("fsdp", "tensor")
+    assert sh["b"].spec == P("tensor")
+
+
+def test_constrain_is_noop_outside_jit():
+    import jax.numpy as jnp
+    x = jnp.ones((4, 4))
+    y = S.constrain(x, ("batch", None))
+    assert (y == x).all()
+
+
+def test_canonical_axes_cover_all_strategies():
+    # dp/fsdp/sp/tp/ep all first-class (SURVEY.md §2 parallelism table)
+    assert M.AXIS_ORDER == ("data", "fsdp", "expert", "sequence", "tensor")
+    devices = jax.devices()
+    assert len(devices) == 8, "tests require the virtual 8-device mesh"
