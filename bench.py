@@ -1,0 +1,138 @@
+"""Benchmark: ResNet-50 training throughput (BASELINE.md headline metric).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md: "published": {}), so
+vs_baseline is measured against BASELINE.json's stand-in target for a
+single TPU host: 1000 samples/sec ResNet-50 — the figure a well-tuned
+GPU-era Kubeflow notebook pod (V100, the reference's CUDA image target)
+delivers. Beating 1.0 means the TPU-native stack beats the stack the
+reference platform was built to schedule.
+
+Flags via env: BENCH_MODEL=resnet50|lm, BENCH_STEPS, BENCH_BATCH.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.compute import mesh as mesh_lib
+from kubeflow_tpu.compute import train
+from kubeflow_tpu.compute.models import resnet, transformer
+
+def _drain(metrics):
+    """Force the full step pipeline to complete: host-readback of a value
+    that depends on the step (block_until_ready is not reliable through
+    the axon tunnel)."""
+    return float(metrics["loss"])
+
+
+# GPU-era stand-in baseline (see module docstring)
+RESNET50_BASELINE_SPS = 1000.0
+LM_BASELINE_TOKENS = 1.0e5
+
+
+def bench_resnet(steps, batch):
+    cfg = resnet.Config(depth=50, n_classes=1000, dtype="bfloat16")
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=-1))
+    opt = train.make_optimizer(learning_rate=1e-3, warmup_steps=10,
+                               total_steps=10_000)
+    params, stats = resnet.init_params(cfg, jax.random.PRNGKey(0))
+    p_axes, _ = resnet.logical_axes(cfg)
+    state = train.init_state(
+        lambda k: resnet.init_params(cfg, k)[0], opt, mesh, p_axes,
+        jax.random.PRNGKey(0), extra=stats)
+    step = train.make_train_step(
+        train.stateful_loss(resnet.loss_fn, cfg), opt, mesh)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, 224, 224, 3),
+                          jnp.bfloat16)
+    batch_data = {"image": x,
+                  "label": jax.random.randint(jax.random.PRNGKey(2),
+                                              (batch,), 0, 1000)}
+    for _ in range(3):                          # compile + warm paths
+        state, metrics = step(state, batch_data)
+        _drain(metrics)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_data)
+    _drain(metrics)
+    dt = time.perf_counter() - t0
+    sps = steps * batch / dt
+    return {"metric": "resnet50_train_samples_per_sec", "value": round(sps, 1),
+            "unit": "samples/sec",
+            "vs_baseline": round(sps / RESNET50_BASELINE_SPS, 3),
+            "detail": {"batch": batch, "steps": steps,
+                       "step_ms": round(1000 * dt / steps, 2),
+                       "device": str(jax.devices()[0]),
+                       "mfu": round(
+                           steps * batch * resnet.flops_per_sample() / dt
+                           / _peak_flops(), 3)}}
+
+
+def bench_lm(steps, batch):
+    cfg = transformer.Config(
+        vocab_size=32768, d_model=1024, n_layers=12, n_heads=16,
+        max_seq=1024, dtype="bfloat16", attention="flash")
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=-1))
+    opt = train.make_optimizer(learning_rate=3e-4, warmup_steps=10,
+                               total_steps=10_000)
+    state = train.init_state(
+        lambda k: transformer.init_params(cfg, k), opt, mesh,
+        transformer.logical_axes(cfg), jax.random.PRNGKey(0))
+    step = train.make_train_step(
+        train.plain_loss(transformer.loss_fn, cfg), opt, mesh)
+    toks = jax.random.randint(jax.random.PRNGKey(1),
+                              (batch, cfg.max_seq), 0, cfg.vocab_size)
+    data = {"tokens": toks, "targets": jnp.roll(toks, -1, axis=1)}
+    for _ in range(3):                          # compile + warm paths
+        state, metrics = step(state, data)
+        _drain(metrics)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, data)
+    _drain(metrics)
+    dt = time.perf_counter() - t0
+    tps = steps * batch * cfg.max_seq / dt
+    return {"metric": "lm_train_tokens_per_sec", "value": round(tps, 0),
+            "unit": "tokens/sec",
+            "vs_baseline": round(tps / LM_BASELINE_TOKENS, 3),
+            "detail": {"params": transformer.param_count(cfg),
+                       "batch": batch, "seq": cfg.max_seq,
+                       "step_ms": round(1000 * dt / steps, 2),
+                       "mfu": round(
+                           tps * transformer.flops_per_token(cfg)
+                           / _peak_flops(), 3)}}
+
+
+def _peak_flops():
+    """bf16 peak per chip: v5e 197 TFLOPs, v4 275, v5p 459."""
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v4" in kind:
+        return 275e12
+    if "v5" in kind or "v5p" in kind:
+        return 459e12
+    if "v6" in kind:
+        return 918e12
+    return 197e12
+
+
+def main():
+    model = os.environ.get("BENCH_MODEL", "resnet50")
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    if model == "lm":
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        result = bench_lm(steps, batch)
+    else:
+        batch = int(os.environ.get("BENCH_BATCH", "256"))
+        result = bench_resnet(steps, batch)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

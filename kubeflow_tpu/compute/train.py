@@ -19,6 +19,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import optax
+from jax.sharding import NamedSharding, PartitionSpec
 
 from . import sharding as sharding_lib
 
@@ -52,12 +53,20 @@ def init_state(init_params_fn, optimizer, mesh, logical_axes, key,
     jit-initialized straight into their NamedShardings (no host-side
     full copy), opt_state inherits the params sharding by propagation."""
     shardings = sharding_lib.tree_shardings(mesh, logical_axes, rules)
+    replicated = NamedSharding(mesh, PartitionSpec())
     with jax.set_mesh(mesh):
         params = jax.jit(init_params_fn, out_shardings=shardings)(key)
         opt_state = jax.jit(optimizer.init)(params)
         step = jnp.zeros((), jnp.int32)
+    # put extra on the mesh (replicated) unless the caller pre-sharded it
+    extra = jax.tree.map(
+        lambda x: x if (isinstance(getattr(x, "sharding", None),
+                                   NamedSharding)
+                        and x.sharding.mesh == mesh)
+        else jax.device_put(x, replicated),
+        extra if extra is not None else {})
     return TrainState(step=step, params=params, opt_state=opt_state,
-                      extra=extra if extra is not None else {})
+                      extra=extra)
 
 
 def make_train_step(loss_fn, optimizer, mesh, accum_steps=1):
@@ -104,13 +113,42 @@ def make_train_step(loss_fn, optimizer, mesh, accum_steps=1):
                                opt_state=opt_state, extra=new_extra)
         return new_state, metrics
 
-    jitted = jax.jit(step_fn, donate_argnums=(0,))
+    # Pin the output state's shardings to the input state's when the
+    # state is fully NamedSharded on this mesh: without the pin, XLA may
+    # choose different output shardings on the first call, and the
+    # second call (new input shardings) silently recompiles — a 30s+
+    # stall on real models. Cache is keyed by the input sharding
+    # signature so a differently-sharded state (numpy leaves, abstract
+    # AOT args, foreign mesh) gets a plain unpinned jit instead of
+    # poisoning the pinned entry.
+    box = {}
+
+    def _signature(state):
+        specs = []
+        for x in jax.tree.leaves(state):
+            sh = getattr(x, "sharding", None)
+            if not isinstance(sh, NamedSharding) or sh.mesh != mesh:
+                return None
+            specs.append(sh.spec)
+        return tuple(specs)
+
+    def jitted_for(state):
+        sig = _signature(state)
+        if sig not in box:
+            if sig is None:
+                box[sig] = jax.jit(step_fn, donate_argnums=(0,))
+            else:
+                state_sh = jax.tree.map(lambda x: x.sharding, state)
+                box[sig] = jax.jit(step_fn, donate_argnums=(0,),
+                                   out_shardings=(state_sh, None))
+        return box[sig]
 
     def run(state, batch):
         with jax.set_mesh(mesh):
-            return jitted(state, batch)
+            return jitted_for(state)(state, batch)
 
-    run.lower = lambda state, batch: jitted.lower(state, batch)
+    run.lower = (lambda state, batch:
+                 jitted_for(state).lower(state, batch))
     return run
 
 
