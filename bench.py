@@ -41,7 +41,9 @@ def bench_resnet(steps, batch):
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=-1))
     opt = train.make_optimizer(learning_rate=1e-3, warmup_steps=10,
                                total_steps=10_000)
-    params, stats = resnet.init_params(cfg, jax.random.PRNGKey(0))
+    # jit lets XLA DCE the params half; no host-side full init
+    stats = jax.jit(lambda k: resnet.init_params(cfg, k)[1])(
+        jax.random.PRNGKey(0))
     p_axes, _ = resnet.logical_axes(cfg)
     state = train.init_state(
         lambda k: resnet.init_params(cfg, k)[0], opt, mesh, p_axes,

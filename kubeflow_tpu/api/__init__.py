@@ -18,6 +18,7 @@ def register_all(store):
     tensorboard.register(store)
     poddefault.register(store)
     tpuslice.register(store)
+    store.register_cluster_scoped("storage.k8s.io", "StorageClass")
 
 
 __all__ = ["GROUP", "builtin", "notebook", "poddefault", "profile",

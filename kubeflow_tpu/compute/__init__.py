@@ -20,4 +20,17 @@ no NCCL/MPI binding). Here it is first-class and TPU-native:
                   contract (testing/test_tf_serving.py:108-111).
 """
 
-from . import attention, mesh, models, ops, sharding, train  # noqa: F401
+import importlib
+
+from . import (attention, data, mesh, models, ops,  # noqa: F401
+               profiler, serving, sharding, train, trial)
+
+_LAZY = ("checkpoint",)  # orbax is optional in slim images
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        module = importlib.import_module(f".{name}", __name__)
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

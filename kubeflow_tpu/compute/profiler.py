@@ -1,0 +1,66 @@
+"""Profiling: JAX/XLA traces written to the Tensorboard logs path.
+
+The reference's user-facing profiling surface is the tensorboard
+controller serving a Deployment pointed at ``spec.logspath``
+(tensorboard_controller.go:167,375-407). The TPU-native story (SURVEY.md
+§5 "Tracing / profiling"): workloads write JAX profiler traces (which
+include TPU device traces via libtpu) under that same logs path, so the
+existing Tensorboard CR + profile plugin renders them with no new
+plumbing.
+"""
+
+import contextlib
+import os
+import time
+
+import jax
+
+
+def trace_dir(base=None):
+    """Resolve the logs path: explicit arg > TENSORBOARD_LOGDIR (the env
+    the PodDefault injects) > ./logs."""
+    base = base or os.environ.get("TENSORBOARD_LOGDIR", "./logs")
+    return os.path.join(base, "plugins", "profile")
+
+
+@contextlib.contextmanager
+def trace(logdir=None, host_profiling=True):
+    """Capture a profiler trace for the enclosed steps:
+
+        with profiler.trace("/workspace/logs"):
+            for _ in range(10):
+                state, _ = step(state, batch)
+    """
+    base = logdir or os.environ.get("TENSORBOARD_LOGDIR", "./logs")
+    os.makedirs(base, exist_ok=True)
+    jax.profiler.start_trace(
+        base, create_perfetto_link=False, create_perfetto_trace=False)
+    try:
+        yield base
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Lightweight per-step wall-time metrics (no trace overhead):
+    throughput + EMA step time, for the metrics endpoint / logs."""
+
+    def __init__(self, ema=0.9):
+        self._ema = ema
+        self.step_time = None
+        self.last = None
+
+    def tick(self):
+        now = time.perf_counter()
+        if self.last is not None:
+            dt = now - self.last
+            self.step_time = (dt if self.step_time is None
+                              else self._ema * self.step_time
+                              + (1 - self._ema) * dt)
+        self.last = now
+        return self.step_time
+
+    def throughput(self, items_per_step):
+        if not self.step_time:
+            return None
+        return items_per_step / self.step_time

@@ -1,0 +1,124 @@
+"""Model serving: jitted predict behind the TF-Serving REST contract.
+
+The reference's serving story is an out-of-tree TF-Serving deployment
+exercised by testing/test_tf_serving.py:108-111 — POST
+``http://<svc>:8500/v1/models/<name>:predict`` with ``{"instances":
+[...]}``, compare ``predictions`` with tolerance. This module keeps that
+exact wire contract (drop-in for the reference's clients) on a JAX/TPU
+substrate:
+
+- per-model jitted predict fn (bf16 on MXU, donation-free, batched),
+- dynamic-batch bucketing to a few padded sizes so XLA compiles a
+  handful of programs instead of one per request shape,
+- ``/v1/models/<name>`` status endpoint for readiness probes.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import numpy as np
+
+#: pad request batches up to one of these (one XLA program each)
+BATCH_BUCKETS = (1, 8, 64, 256)
+
+
+class ServedModel:
+    def __init__(self, name, predict_fn, version=1):
+        self.name = name
+        self.version = version
+        self._fn = jax.jit(predict_fn)
+
+    def predict(self, instances):
+        x = np.asarray(instances)
+        n = x.shape[0]
+        bucket = next((b for b in BATCH_BUCKETS if b >= n), n)
+        if bucket > n:
+            pad = np.zeros((bucket - n,) + x.shape[1:], x.dtype)
+            x = np.concatenate([x, pad], axis=0)
+        out = np.asarray(self._fn(x))[:n]
+        return out.tolist()
+
+
+class ModelServer:
+    """Registry + HTTP server. ``server.register("mnist", fn)`` then
+    ``server.start(port)``; reference clients work unchanged."""
+
+    def __init__(self):
+        self._models = {}
+        self._httpd = None
+        self._thread = None
+
+    def register(self, name, predict_fn, version=1):
+        self._models[name] = ServedModel(name, predict_fn, version)
+
+    def models(self):
+        return dict(self._models)
+
+    # -------------------------------------------------------- HTTP
+
+    def _handler(self):
+        models = self._models
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                # /v1/models/<name> → model version status
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 3 and parts[:2] == ["v1", "models"]:
+                    model = models.get(parts[2])
+                    if model is None:
+                        return self._send(404, {"error": "model not found"})
+                    return self._send(200, {"model_version_status": [{
+                        "version": str(model.version),
+                        "state": "AVAILABLE",
+                        "status": {"error_code": "OK", "error_message": ""},
+                    }]})
+                if parts == ["healthz"]:
+                    return self._send(200, {"status": "ok"})
+                self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                parts = self.path.strip("/").split("/")
+                if (len(parts) != 3 or parts[:2] != ["v1", "models"]
+                        or ":" not in parts[2]):
+                    return self._send(404, {"error": "not found"})
+                name, verb = parts[2].rsplit(":", 1)
+                model = models.get(name)
+                if model is None:
+                    return self._send(404, {"error": "model not found"})
+                if verb != "predict":
+                    return self._send(400, {"error": f"verb {verb}"})
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    instances = req["instances"]
+                    predictions = model.predict(instances)
+                    self._send(200, {"predictions": predictions})
+                except Exception as e:  # noqa: BLE001 — wire boundary
+                    self._send(400, {"error": str(e)})
+
+        return Handler
+
+    def start(self, port=8500, host="0.0.0.0"):
+        self._httpd = ThreadingHTTPServer((host, port), self._handler())
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd = None

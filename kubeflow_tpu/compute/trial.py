@@ -1,0 +1,99 @@
+"""StudyJob trial entrypoint — the workload side of the HPO contract.
+
+Controller side (controllers/tpuslice.py StudyJobReconciler): parameters
+are substituted into the trial template as ``{{name}}``, and a trial
+completes when a ConfigMap ``<study>-trial-<i>-metrics`` carries the
+objective metric. Workload side (this module):
+
+- ``params()``: read hyperparameters from TRIAL_PARAMETERS (JSON env,
+  the idiomatic injection) or individual TRIAL_PARAM_<NAME> vars,
+- ``report(value)``: write the objective where the collector looks —
+  a JSON file at METRICS_PATH plus a parseable stdout line
+  (``trial-metric {"name": ..., "value": ...}``, the log-scrape
+  contract; reference Katib's metrics-collector idiom,
+  testing/katib_studyjob_test.py polls the resulting CR condition),
+- ``run_mnist_trial()``: the default objective used by the trials/hr
+  benchmark (BASELINE.md "Katib StudyJob random-search sweep").
+"""
+
+import json
+import os
+
+METRIC_LINE_PREFIX = "trial-metric "
+
+
+def params(defaults=None):
+    out = dict(defaults or {})
+    blob = os.environ.get("TRIAL_PARAMETERS")
+    if blob:
+        out.update(json.loads(blob))
+    for key, value in os.environ.items():
+        if key.startswith("TRIAL_PARAM_"):
+            name = key[len("TRIAL_PARAM_"):].lower()
+            try:
+                out[name] = json.loads(value)
+            except (ValueError, TypeError):
+                out[name] = value
+    return out
+
+
+def report(value, name=None, extra=None):
+    name = name or os.environ.get("TRIAL_OBJECTIVE_NAME", "objective")
+    payload = {"name": name, "value": float(value)}
+    if extra:
+        payload["extra"] = {k: float(v) for k, v in extra.items()}
+    print(METRIC_LINE_PREFIX + json.dumps(payload), flush=True)
+    path = os.environ.get("METRICS_PATH", "/tmp/trial-metrics.json")
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({name: float(value),
+                       **(payload.get("extra") or {})}, f)
+    except OSError:
+        pass  # read-only fs: the stdout line remains authoritative
+    return payload
+
+
+def parse_metric_line(line):
+    """Collector side of the stdout contract; None if not a metric."""
+    line = line.strip()
+    if not line.startswith(METRIC_LINE_PREFIX):
+        return None
+    try:
+        return json.loads(line[len(METRIC_LINE_PREFIX):])
+    except ValueError:
+        return None
+
+
+def run_mnist_trial(hp=None, steps=30):
+    """Default objective: MLP on synthetic MNIST; returns final loss."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import mesh as mesh_lib
+    from . import train
+    from .models import mlp
+
+    hp = params(dict({"lr": 1e-2, "hidden": 64}, **(hp or {})))
+    cfg = mlp.Config(in_dim=784, hidden=int(hp["hidden"]), n_classes=10)
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=-1))
+    opt = train.make_optimizer(learning_rate=float(hp["lr"]),
+                               warmup_steps=2, total_steps=steps)
+    state = train.init_state(lambda k: mlp.init_params(cfg, k), opt, mesh,
+                             mlp.logical_axes(cfg), jax.random.PRNGKey(0))
+    step = train.make_train_step(train.plain_loss(mlp.loss_fn, cfg), opt,
+                                 mesh)
+    key = jax.random.PRNGKey(1)
+    x = jax.random.normal(key, (64, 28, 28, 1))
+    y = jax.random.randint(key, (64,), 0, 10)
+    batch = {"image": x, "label": y}
+    metrics = {}
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    loss = float(metrics["loss"])
+    report(loss, extra={"accuracy": float(metrics["accuracy"])})
+    return loss
+
+
+if __name__ == "__main__":
+    run_mnist_trial()

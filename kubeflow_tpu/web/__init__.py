@@ -1,0 +1,18 @@
+"""Web layer: REST backends for the platform UIs (SURVEY.md §1 L4).
+
+Reference components and their TPU-native counterparts here:
+
+- crud_backend (shared Flask lib, §2#17)  → ``crud_backend``
+- jupyter-web-app backend (§2#18)         → ``jupyter``
+- volumes-web-app backend (§2#19)         → ``volumes``
+- tensorboards-web-app backend (§2#20)    → ``tensorboards``
+- access-management / kfam (§2#16)        → ``kfam``
+- centraldashboard backend (§2#22)        → ``dashboard``
+
+Built on a dependency-free stdlib HTTP core (``http``) instead of
+Flask/Express — same route shapes, same JSON envelopes, same
+header-identity + SubjectAccessReview chain, one in-process test client.
+"""
+
+from . import (crud_backend, dashboard, http, jupyter, kfam,  # noqa: F401
+               tensorboards, volumes)

@@ -1,0 +1,232 @@
+"""Shared CRUD backend lib — the reference's
+crud-web-apps/common/backend/kubeflow/kubeflow/crud_backend (SURVEY.md
+§2#17), rebuilt on the in-process store:
+
+- header authn: user from ``USERID_HEADER`` (default kubeflow-userid)
+  with ``USERID_PREFIX`` strip (reference authn.py:12-34),
+- authz: SubjectAccessReview against the store's RoleBindings /
+  ClusterRoleBindings + the kubeflow ClusterRole rule table
+  (reference authz.py:46-110 delegates the same decision to the
+  apiserver's RBAC evaluator),
+- CSRF double-submit cookie (reference csrf.py),
+- JSON success/error envelopes ({"success": ..., "log": ...}),
+- base routes every app shares: /api/namespaces, /api/storageclasses,
+  and liveness/readiness probes (reference routes/get.py:10-26,
+  probes.py).
+"""
+
+import os
+import secrets
+
+from ..core import meta as m
+from .http import App, HTTPError, Response
+
+# ------------------------------------------------------------------ authn
+
+AUTHN_DISABLED_ENV = "APP_DISABLE_AUTH"
+
+
+def userid_header():
+    return os.environ.get("USERID_HEADER", "kubeflow-userid")
+
+
+def userid_prefix():
+    return os.environ.get("USERID_PREFIX", "")
+
+
+def get_username(request):
+    raw = request.header(userid_header())
+    if raw is None:
+        return None
+    prefix = userid_prefix()
+    if prefix and raw.startswith(prefix):
+        raw = raw[len(prefix):]
+    return raw
+
+
+def check_authentication(request):
+    """reference authn.py:34 before_app_request: every request must
+    carry the identity header (the mesh's authn proxy sets it)."""
+    if os.environ.get(AUTHN_DISABLED_ENV, "").lower() == "true":
+        request.user = request.user or "anonymous@kubeflow.org"
+        return
+    user = get_username(request)
+    if not user:
+        raise HTTPError(
+            401, f"No user detected: header '{userid_header()}' missing")
+    request.user = user
+
+
+# ------------------------------------------------------------------ authz
+#
+# ClusterRole rule table: what the kubeflow-{admin,edit,view} roles grant
+# (the reference ships these as aggregated ClusterRoles in manifests;
+# kubeflow-admin aggregates edit, edit aggregates view).
+
+_EDIT_VERBS = {"create", "update", "patch", "delete", "get", "list",
+               "watch"}
+_VIEW_VERBS = {"get", "list", "watch"}
+
+CLUSTER_ROLES = {
+    "kubeflow-admin": {"verbs": _EDIT_VERBS, "resources": {"*"}},
+    "kubeflow-edit": {"verbs": _EDIT_VERBS, "resources": {
+        "notebooks", "tensorboards", "persistentvolumeclaims",
+        "poddefaults", "tpuslices", "studyjobs", "pods", "pods/log",
+        "events", "configmaps", "secrets", "services"}},
+    "kubeflow-view": {"verbs": _VIEW_VERBS, "resources": {
+        "notebooks", "tensorboards", "persistentvolumeclaims",
+        "poddefaults", "tpuslices", "studyjobs", "pods", "pods/log",
+        "events", "configmaps", "services"}},
+    "cluster-admin": {"verbs": _EDIT_VERBS | {"*"}, "resources": {"*"}},
+}
+
+
+def _role_allows(role_name, verb, resource):
+    rule = CLUSTER_ROLES.get(role_name)
+    if rule is None:
+        return False
+    verbs = rule["verbs"]
+    resources = rule["resources"]
+    return (("*" in verbs or verb in verbs)
+            and ("*" in resources or resource in resources))
+
+
+def _subject_matches(subject, user):
+    return (subject.get("kind") in ("User", None)
+            and subject.get("name") == user)
+
+
+def is_authorized(store, user, verb, resource, namespace=None):
+    """The SubjectAccessReview decision (reference authz.py:46): RBAC
+    evaluation over RoleBindings in the namespace + ClusterRoleBindings."""
+    if user is None:
+        return False
+    for crb in store.list("rbac.authorization.k8s.io/v1",
+                          "ClusterRoleBinding"):
+        if any(_subject_matches(s, user)
+               for s in crb.get("subjects") or []):
+            if _role_allows(m.deep_get(crb, "roleRef", "name"),
+                            verb, resource):
+                return True
+    if namespace:
+        for rb in store.list("rbac.authorization.k8s.io/v1",
+                             "RoleBinding", namespace):
+            if any(_subject_matches(s, user)
+                   for s in rb.get("subjects") or []):
+                if _role_allows(m.deep_get(rb, "roleRef", "name"),
+                                verb, resource):
+                    return True
+    return False
+
+
+def ensure_authorized(store, request, verb, resource, namespace=None):
+    if os.environ.get(AUTHN_DISABLED_ENV, "").lower() == "true":
+        return
+    if not is_authorized(store, request.user, verb, resource, namespace):
+        raise HTTPError(
+            403,
+            f"User '{request.user}' is not authorized to {verb} "
+            f"{resource}" + (f" in namespace '{namespace}'"
+                             if namespace else ""))
+
+
+# ------------------------------------------------------------------- csrf
+
+CSRF_COOKIE = "XSRF-TOKEN"
+CSRF_HEADER = "X-XSRF-TOKEN"
+_SAFE_METHODS = {"GET", "HEAD", "OPTIONS"}
+
+
+def check_csrf(request):
+    """Double-submit cookie (reference csrf.py): mutating requests must
+    echo the cookie token in the header."""
+    if os.environ.get("APP_SECURE_COOKIES", "true").lower() != "true":
+        return
+    if request.method in _SAFE_METHODS:
+        return
+    cookie = request.cookies.get(CSRF_COOKIE)
+    header = request.header(CSRF_HEADER)
+    if not cookie or cookie != header:
+        raise HTTPError(403, "CSRF token missing or invalid")
+
+
+def issue_csrf_cookie(response):
+    token = secrets.token_urlsafe(32)
+    response.headers["Set-Cookie"] = (
+        f"{CSRF_COOKIE}={token}; Path=/; SameSite=Strict")
+    return token
+
+
+def install_security(app):
+    """authn + CSRF on every app (the privilege-granting kfam/dashboard
+    endpoints need the double-submit protection just as much as the
+    CRUD apps — identity is only a proxy-attached header)."""
+    app.before_request(check_authentication)
+    app.before_request(check_csrf)
+
+    @app.after_request
+    def set_csrf_cookie(request, response):
+        # browser obtains the token from any (GET) response
+        if (os.environ.get("APP_SECURE_COOKIES", "true").lower()
+                == "true" and CSRF_COOKIE not in request.cookies):
+            issue_csrf_cookie(response)
+        return response
+
+    return app
+
+
+# -------------------------------------------------------------- envelopes
+
+def success(extra=None, status=200):
+    payload = {"success": True, "status": status}
+    payload.update(extra or {})
+    return Response(payload, status=status)
+
+
+# ------------------------------------------------------------ app factory
+
+def create_app(name, store):
+    app = App(name)
+    app.store = store
+    install_security(app)
+
+    @app.get("/healthz")
+    def healthz(request):
+        return {"status": "ok"}
+
+    @app.get("/apidocs")
+    def apidocs(request):
+        return {"routes": sorted(
+            {f"{method} {regex.pattern}"
+             for method, regex, _ in app._routes})}
+
+    @app.get("/api/namespaces")
+    def namespaces(request):
+        # reference routes/get.py:10 — every authenticated user may list
+        names = [m.name_of(ns) for ns in store.list("v1", "Namespace")]
+        return success({"namespaces": names})
+
+    @app.get("/api/storageclasses")
+    def storageclasses(request):
+        scs = [m.name_of(sc)
+               for sc in store.list("storage.k8s.io/v1", "StorageClass")]
+        return success({"storageClasses": scs})
+
+    @app.get("/api/config")
+    def config_route(request):
+        return success({"config": getattr(app, "config", {})})
+
+    return app
+
+
+# ---------------------------------------------------------- store helpers
+
+def events_for(store, namespace, involved_name):
+    """Events whose involvedObject.name matches (reference
+    api/events.py filtering idiom)."""
+    out = []
+    for ev in store.list("v1", "Event", namespace):
+        if m.deep_get(ev, "involvedObject", "name") == involved_name:
+            out.append(ev)
+    out.sort(key=lambda e: e.get("lastTimestamp") or "")
+    return out

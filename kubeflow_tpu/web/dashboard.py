@@ -1,0 +1,124 @@
+"""Central dashboard backend.
+
+Reference: components/centraldashboard/app (SURVEY.md §2#22): Express
+``/api`` (env-info, metrics passthrough) + ``/api/workgroup`` (profile
+self-service onboarding) with identity from the header middleware. The
+Angular rewrite (§2#23) mirrors it 1:1 — as does this.
+
+MetricsService is the reference's pluggable interface
+(metrics_service.ts:20-42) whose only impl was Stackdriver; here the
+default impl reads the in-store metrics the controllers publish, and a
+TPU utilization source can be plugged the same way.
+"""
+
+from ..api import profile as papi
+from ..core import meta as m
+from . import crud_backend as cb
+from . import kfam as kfam_lib
+from .http import App, HTTPError
+
+PROFILE_API = f"{papi.GROUP}/{papi.VERSION}"
+
+
+class MetricsService:
+    """Interface: node CPU / pod CPU / pod memory time series
+    (reference metrics_service.ts). Implementations override query()."""
+
+    def available(self):
+        return True
+
+    def query(self, metric, namespace=None, interval="15m"):
+        raise NotImplementedError
+
+
+class StoreMetricsService(MetricsService):
+    """Default impl: derives utilization proxies from the store (pod
+    counts, notebook states) — enough for the dashboard cards without a
+    cloud monitoring dependency."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def query(self, metric, namespace=None, interval="15m"):
+        pods = self.store.list("v1", "Pod", namespace)
+        running = [p for p in pods
+                   if m.deep_get(p, "status", "phase") == "Running"]
+        series = {"podcount": len(pods), "runningpods": len(running)}
+        return [{"timestamp": m.now_iso(),
+                 "value": series.get(metric, 0)}]
+
+
+def create_app(store, metrics_service=None):
+    app = App("centraldashboard")
+    app.store = store
+    cb.install_security(app)
+    metrics = metrics_service or StoreMetricsService(store)
+
+    @app.get("/healthz")
+    def healthz(request):
+        return {"status": "ok"}
+
+    @app.get("/api/env-info")
+    def env_info(request):
+        user = request.user
+        profiles = store.list(PROFILE_API, papi.KIND)
+        namespaces = []
+        for p in profiles:
+            ns = m.name_of(p)
+            owner = m.deep_get(p, "spec", "owner", "name")
+            if owner == user:
+                role = "owner"
+            elif any(store.try_get(
+                    "rbac.authorization.k8s.io/v1", "RoleBinding",
+                    kfam_lib.binding_name(user, cr), ns) is not None
+                    for cr in ("kubeflow-admin", "kubeflow-edit",
+                               "kubeflow-view")):
+                role = "contributor"
+            else:
+                continue
+            namespaces.append({"namespace": ns, "role": role})
+        return {
+            "user": user,
+            "platform": {"provider": "tpu", "providerName": "tpu",
+                         "kubeflowVersion": "1.7.0"},
+            "namespaces": namespaces,
+            "isClusterAdmin": user == kfam_lib.cluster_admin(),
+        }
+
+    @app.get("/api/workgroup/exists")
+    def workgroup_exists(request):
+        user = request.user
+        owned = [p for p in store.list(PROFILE_API, papi.KIND)
+                 if m.deep_get(p, "spec", "owner", "name") == user]
+        return {"hasAuth": True, "user": user,
+                "hasWorkgroup": bool(owned)}
+
+    @app.post("/api/workgroup/create")
+    def workgroup_create(request):
+        user = request.user
+        name = (request.json.get("namespace")
+                or user.split("@")[0].replace(".", "-"))
+        if any(m.name_of(p) == name
+               for p in store.list(PROFILE_API, papi.KIND)):
+            raise HTTPError(409, f"profile {name} already exists")
+        store.create(papi.new(name, user))
+        return {"message": f"Created profile {name}"}
+
+    @app.get("/api/namespaces")
+    def namespaces(request):
+        return [m.name_of(ns) for ns in store.list("v1", "Namespace")]
+
+    @app.get("/api/activities/<ns>")
+    def activities(request, ns):
+        events = store.list("v1", "Event", ns)
+        events.sort(key=lambda e: e.get("lastTimestamp") or "",
+                    reverse=True)
+        return events
+
+    @app.get("/api/metrics/<metric>")
+    def get_metrics(request, metric):
+        if not metrics.available():
+            raise HTTPError(405, "metrics service not configured")
+        return metrics.query(metric, request.query.get("namespace"))
+
+    return app

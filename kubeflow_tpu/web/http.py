@@ -1,0 +1,225 @@
+"""Minimal HTTP app framework (stdlib-only) for the REST backends.
+
+Provides what the reference gets from Flask (app factory, routing with
+path params, before-request hooks, JSON bodies, error handlers) and from
+its test setups (an in-process client, no sockets), in ~200 lines. Real
+serving rides ThreadingHTTPServer; in-cluster deployments front it with
+the mesh exactly like the reference fronts gunicorn.
+"""
+
+import json
+import re
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+
+class HTTPError(Exception):
+    def __init__(self, status, message):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class Request:
+    def __init__(self, method, path, headers=None, body=b"", query=None):
+        self.method = method.upper()
+        self.path = path
+        self.headers = {k.lower(): v for k, v in (headers or {}).items()}
+        self.body = body or b""
+        self.query = query or {}
+        self.params = {}
+        self.user = None  # set by authn middleware
+        self.context = {}
+
+    @property
+    def json(self):
+        if not self.body:
+            return {}
+        try:
+            return json.loads(self.body)
+        except ValueError:
+            raise HTTPError(400, "invalid JSON body")
+
+    def header(self, name, default=None):
+        return self.headers.get(name.lower(), default)
+
+    @property
+    def cookies(self):
+        out = {}
+        for part in (self.header("cookie") or "").split(";"):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                out[k.strip()] = v.strip()
+        return out
+
+
+class Response:
+    def __init__(self, payload=None, status=200, headers=None):
+        self.status = status
+        self.headers = dict(headers or {})
+        if isinstance(payload, (bytes, str)):
+            self.body = (payload.encode()
+                         if isinstance(payload, str) else payload)
+            self.headers.setdefault("Content-Type", "text/plain")
+        else:
+            self.body = json.dumps(payload).encode()
+            self.headers.setdefault("Content-Type", "application/json")
+
+    @property
+    def json(self):
+        return json.loads(self.body)
+
+
+_PARAM = re.compile(r"<([a-zA-Z_][a-zA-Z0-9_]*)>")
+
+
+def _compile(pattern):
+    regex = _PARAM.sub(r"(?P<\1>[^/]+)", pattern.rstrip("/") or "/")
+    return re.compile(f"^{regex}$")
+
+
+class App:
+    def __init__(self, name):
+        self.name = name
+        self._routes = []  # (method, regex, fn)
+        self._before = []
+        self._after = []
+
+    def route(self, method, pattern):
+        compiled = _compile(pattern)
+
+        def deco(fn):
+            self._routes.append((method.upper(), compiled, fn))
+            return fn
+
+        return deco
+
+    def get(self, p):
+        return self.route("GET", p)
+
+    def post(self, p):
+        return self.route("POST", p)
+
+    def patch(self, p):
+        return self.route("PATCH", p)
+
+    def delete(self, p):
+        return self.route("DELETE", p)
+
+    def before_request(self, fn):
+        self._before.append(fn)
+        return fn
+
+    def after_request(self, fn):
+        """fn(request, response) -> response (may mutate headers)."""
+        self._after.append(fn)
+        return fn
+
+    # ------------------------------------------------------- dispatch
+
+    def handle(self, request):
+        response = self._dispatch(request)
+        for hook in self._after:
+            response = hook(request, response) or response
+        return response
+
+    def _dispatch(self, request):
+        try:
+            match = None
+            path_matched = False
+            for method, regex, fn in self._routes:
+                mo = regex.match(request.path.rstrip("/") or "/")
+                if mo:
+                    path_matched = True
+                    if method == request.method:
+                        match = (fn, mo.groupdict())
+                        break
+            if match is None:
+                raise HTTPError(
+                    405 if path_matched else 404,
+                    "method not allowed" if path_matched else
+                    f"{request.path} not found")
+            fn, params = match
+            request.params = params
+            for hook in self._before:
+                out = hook(request)
+                if isinstance(out, Response):
+                    return out
+            out = fn(request, **params)
+            return out if isinstance(out, Response) else Response(out)
+        except HTTPError as e:
+            return Response(
+                {"success": False, "status": e.status, "log": e.message},
+                status=e.status)
+        except Exception as e:  # noqa: BLE001 — service boundary
+            traceback.print_exc()
+            return Response(
+                {"success": False, "status": 500,
+                 "log": f"{type(e).__name__}: {e}"},
+                status=500)
+
+    # ---------------------------------------------------------- serve
+
+    def serve(self, port=0, host="0.0.0.0"):
+        app = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _run(self):
+                split = urlsplit(self.path)
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                query = {k: v[-1]
+                         for k, v in parse_qs(split.query).items()}
+                request = Request(self.command, split.path,
+                                  dict(self.headers), body, query)
+                response = app.handle(request)
+                self.send_response(response.status)
+                for k, v in response.headers.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length",
+                                 str(len(response.body)))
+                self.end_headers()
+                self.wfile.write(response.body)
+
+            do_GET = do_POST = do_PATCH = do_DELETE = do_PUT = _run
+
+        httpd = ThreadingHTTPServer((host, port), Handler)
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        return httpd
+
+
+class TestClient:
+    """In-process client (the reference's Flask test_client analogue)."""
+
+    def __init__(self, app, default_headers=None):
+        self.app = app
+        self.default_headers = dict(default_headers or {})
+
+    def open(self, method, path, json_body=None, headers=None, body=b""):
+        split = urlsplit(path)
+        hdrs = dict(self.default_headers)
+        hdrs.update(headers or {})
+        if json_body is not None:
+            body = json.dumps(json_body).encode()
+            hdrs.setdefault("Content-Type", "application/json")
+        query = {k: v[-1] for k, v in parse_qs(split.query).items()}
+        return self.app.handle(
+            Request(method, split.path, hdrs, body, query))
+
+    def get(self, path, **kw):
+        return self.open("GET", path, **kw)
+
+    def post(self, path, **kw):
+        return self.open("POST", path, **kw)
+
+    def patch(self, path, **kw):
+        return self.open("PATCH", path, **kw)
+
+    def delete(self, path, **kw):
+        return self.open("DELETE", path, **kw)

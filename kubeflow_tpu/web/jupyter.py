@@ -1,0 +1,408 @@
+"""Jupyter web app (JWA) backend — the notebook spawner API.
+
+Reference: components/crud-web-apps/jupyter/backend (SURVEY.md §2#18,
+call stack §3.1). Same route shapes and form semantics, re-keyed from
+GPUs to TPUs:
+
+- the form's ``gpus`` vendor picker becomes an ``accelerators`` picker
+  of TPU types + ICI topology; limits go to ``google.com/tpu`` and the
+  topology lands in nodeSelector ``cloud.google.com/gke-tpu-topology``
+  (the reference's form.py:226-250 GPU limit injection, re-targeted per
+  SURVEY.md §2 parallelism table),
+- ``/api/gpus`` becomes ``/api/accelerators`` (alias kept): TPU types
+  present on cluster nodes, from node capacity + topology labels
+  (reference get.py:99-120 intersected node capacity with vendor
+  limitsKeys the same way).
+"""
+
+import os
+
+import yaml
+
+from ..api import builtin, notebook as nbapi
+from ..core import meta as m
+from ..core.errors import NotFoundError
+from . import crud_backend as cb
+from .http import HTTPError
+
+STOP_ANNOTATION = "kubeflow-resource-stopped"
+
+#: deploy-time config (the reference's spawner_ui_config.yaml, re-keyed
+#: for TPU accelerators). Override file via SPAWNER_CONFIG_PATH.
+DEFAULT_CONFIG = {
+    "image": {
+        "value": "kubeflownotebookswg/jupyter-jax-tpu:latest",
+        "options": [
+            "kubeflownotebookswg/jupyter-scipy:latest",
+            "kubeflownotebookswg/jupyter-jax-tpu:latest",
+            "kubeflownotebookswg/jupyter-jax-tpu-full:latest",
+            "kubeflownotebookswg/jupyter-pytorch-xla-tpu:latest",
+        ],
+    },
+    "cpu": {"value": "0.5", "limitFactor": "1.2"},
+    "memory": {"value": "1.0Gi", "limitFactor": "1.2"},
+    "accelerators": {
+        "value": "none",
+        "limitsKey": "google.com/tpu",
+        "vendors": [
+            {"limitsKey": "google.com/tpu", "uiName": "TPU"},
+        ],
+        "types": [
+            {"id": "tpu-v5-lite-podslice", "uiName": "TPU v5e",
+             "topologies": ["1x1", "2x2", "2x4", "4x4", "4x8", "8x8"]},
+            {"id": "tpu-v5p-slice", "uiName": "TPU v5p",
+             "topologies": ["2x2x1", "2x2x2", "2x4x4"]},
+            {"id": "tpu-v6e-slice", "uiName": "TPU v6e (Trillium)",
+             "topologies": ["1x1", "2x2", "2x4", "4x4", "8x8"]},
+        ],
+    },
+    "workspaceVolume": {
+        "value": {"mount": "/home/jovyan",
+                  "newPvc": {"metadata": {"name": "{notebook-name}-workspace"},
+                             "spec": {"resources": {"requests": {
+                                 "storage": "10Gi"}},
+                                 "accessModes": ["ReadWriteOnce"]}}},
+    },
+    "dataVolumes": {"value": []},
+    "tolerationGroup": {"value": "none", "groups": [
+        {"groupKey": "tpu-preemptible", "displayName": "Preemptible TPU",
+         "tolerations": [{"key": "cloud.google.com/gke-preemptible",
+                          "operator": "Equal", "value": "true",
+                          "effect": "NoSchedule"}]},
+    ]},
+    "affinityConfig": {"value": "none", "options": []},
+    "configurations": {"value": []},
+    "shm": {"value": True},
+    "culling": {"idleTime": 1440, "checkPeriod": 1},
+}
+
+
+def load_config():
+    path = os.environ.get("SPAWNER_CONFIG_PATH")
+    if path and os.path.exists(path):
+        with open(path) as f:
+            loaded = yaml.safe_load(f) or {}
+        cfg = dict(DEFAULT_CONFIG)
+        cfg.update(loaded.get("spawnerFormDefaults", loaded))
+        return cfg
+    return DEFAULT_CONFIG
+
+
+# ------------------------------------------------------------ form logic
+
+def _quantity(x):
+    return str(x)
+
+
+def _scaled(value, factor):
+    """cpu '0.5' * 1.2 → '0.6'; memory '1.0Gi' * 1.2 → '1.2Gi'."""
+    value = str(value)
+    suffix = ""
+    num = value
+    for s in ("Gi", "Mi", "Ki", "G", "M", "K", "m"):
+        if value.endswith(s):
+            suffix = s
+            num = value[: -len(s)]
+            break
+    return f"{round(float(num) * float(factor), 3):g}{suffix}"
+
+
+def form_to_notebook(body, namespace, config):
+    """reference form.py:75-290: build the Notebook CR from the form +
+    config defaults. Returns (notebook, new_pvcs)."""
+    name = body.get("name")
+    if not name:
+        raise HTTPError(400, "form field 'name' is required")
+    image = (body.get("customImage") or body.get("image")
+             or config["image"]["value"]).strip()
+
+    cpu = str(body.get("cpu") or config["cpu"]["value"])
+    memory = str(body.get("memory") or config["memory"]["value"])
+    requests = {"cpu": _quantity(cpu), "memory": _quantity(memory)}
+    limits = {}
+    cpu_factor = str(body.get("cpuLimit")
+                     or config["cpu"].get("limitFactor", "none"))
+    mem_factor = str(body.get("memoryLimit")
+                     or config["memory"].get("limitFactor", "none"))
+    if body.get("cpuLimit"):
+        limits["cpu"] = _quantity(body["cpuLimit"])
+    elif cpu_factor != "none":
+        limits["cpu"] = _scaled(cpu, cpu_factor)
+    if body.get("memoryLimit"):
+        limits["memory"] = _quantity(body["memoryLimit"])
+    elif mem_factor != "none":
+        limits["memory"] = _scaled(memory, mem_factor)
+
+    container = {
+        "name": name,
+        "image": image,
+        "resources": {"requests": requests, "limits": limits},
+        "volumeMounts": [],
+    }
+    pod_spec = {"containers": [container], "volumes": []}
+    labels = {}
+
+    # ---- accelerators (reference form.py:226-250 set_notebook_gpus,
+    # re-keyed from nvidia.com/gpu to TPU pod-slice resources)
+    acc = body.get("accelerators") or body.get("gpus") or {}
+    num = str(acc.get("num", "none"))
+    if num != "none":
+        vendor = acc.get("vendor") or config["accelerators"]["limitsKey"]
+        limits[vendor] = num
+        requests[vendor] = num
+        selector = pod_spec.setdefault("nodeSelector", {})
+        if acc.get("type"):
+            selector["cloud.google.com/gke-tpu-accelerator"] = acc["type"]
+        if acc.get("topology"):
+            selector["cloud.google.com/gke-tpu-topology"] = (
+                acc["topology"])
+
+    # ---- tolerations group (form.py:178)
+    group = body.get("tolerationGroup",
+                     config["tolerationGroup"]["value"])
+    if group != "none":
+        for g in config["tolerationGroup"]["groups"]:
+            if g["groupKey"] == group:
+                pod_spec["tolerations"] = m.deep_copy(g["tolerations"])
+
+    # ---- affinity config (form.py:202)
+    affinity = body.get("affinityConfig",
+                        config["affinityConfig"]["value"])
+    if affinity != "none":
+        for opt in config["affinityConfig"]["options"]:
+            if opt.get("configKey") == affinity:
+                pod_spec["affinity"] = m.deep_copy(opt["affinity"])
+
+    # ---- poddefaults: selected configurations become labels the
+    # admission plane matches on (form.py set_notebook_configurations)
+    for conf in body.get("configurations",
+                         config["configurations"]["value"]):
+        labels[conf] = "true"
+
+    # ---- volumes (volumes.py): workspace + data
+    new_pvcs = []
+
+    def add_volume(vol, default_mount):
+        vol_name = None
+        mount = vol.get("mount", default_mount)
+        if "newPvc" in vol:
+            pvc = m.deep_copy(vol["newPvc"])
+            pvc_name = m.deep_get(pvc, "metadata", "name") or ""
+            pvc_name = pvc_name.replace("{notebook-name}", name)
+            pvc.setdefault("apiVersion", "v1")
+            pvc.setdefault("kind", "PersistentVolumeClaim")
+            pvc["metadata"]["name"] = pvc_name
+            pvc["metadata"]["namespace"] = namespace
+            new_pvcs.append(pvc)
+            vol_name = pvc_name
+        elif "existingSource" in vol:
+            src = vol["existingSource"]
+            vol_name = m.deep_get(src, "persistentVolumeClaim",
+                                  "claimName")
+            pod_spec["volumes"].append({"name": vol_name, **src})
+            container["volumeMounts"].append(
+                {"name": vol_name, "mountPath": mount})
+            return
+        if vol_name:
+            pod_spec["volumes"].append({
+                "name": vol_name,
+                "persistentVolumeClaim": {"claimName": vol_name}})
+            container["volumeMounts"].append(
+                {"name": vol_name, "mountPath": mount})
+
+    ws = body.get("workspace",
+                  m.deep_copy(config["workspaceVolume"]["value"]))
+    if ws and not body.get("noWorkspace"):
+        add_volume(ws, "/home/jovyan")
+    for vol in body.get("datavols", config["dataVolumes"]["value"]):
+        add_volume(vol, vol.get("mount", "/data"))
+
+    # ---- shared memory (form.py:264)
+    if body.get("shm", config["shm"]["value"]):
+        pod_spec["volumes"].append(
+            {"name": "dshm", "emptyDir": {"medium": "Memory"}})
+        container["volumeMounts"].append(
+            {"name": "dshm", "mountPath": "/dev/shm"})
+
+    nb = nbapi.new(name, namespace, pod_spec, labels=labels)
+    return nb, new_pvcs
+
+
+# ------------------------------------------------------ status translation
+
+def notebook_status(nb):
+    """reference status.py: phase + user-facing message from the CR
+    status the controller mirrored off the pod."""
+    if m.annotations_of(nb).get(STOP_ANNOTATION):
+        return {"phase": "stopped", "message": "Notebook is stopped"}
+    cs = m.deep_get(nb, "status", "containerState", default={}) or {}
+    if "running" in cs:
+        return {"phase": "ready", "message": "Running"}
+    if "waiting" in cs:
+        reason = m.deep_get(cs, "waiting", "reason", default="")
+        phase = ("warning" if reason in ("CrashLoopBackOff",
+                                         "ImagePullBackOff",
+                                         "ErrImagePull") else "waiting")
+        return {"phase": phase, "message": reason or "Starting"}
+    if "terminated" in cs:
+        return {"phase": "warning", "message": "Terminated"}
+    return {"phase": "waiting", "message": "Scheduling"}
+
+
+def _notebook_summary(nb):
+    container = builtin.get_container(
+        m.deep_get(nb, "spec", "template", "spec", default={}))
+    resources = (container or {}).get("resources", {})
+    limits = resources.get("limits", {})
+    return {
+        "name": m.name_of(nb),
+        "namespace": m.namespace_of(nb),
+        "image": (container or {}).get("image", ""),
+        "shortImage": ((container or {}).get("image", "")
+                       .rsplit("/", 1)[-1]),
+        "cpu": m.deep_get(resources, "requests", "cpu", default=""),
+        "memory": m.deep_get(resources, "requests", "memory",
+                             default=""),
+        "accelerators": {k: v for k, v in limits.items()
+                         if k == "google.com/tpu"
+                         or k.endswith("/gpu")},
+        "status": notebook_status(nb),
+        "age": m.deep_get(nb, "metadata", "creationTimestamp",
+                          default=""),
+        "serverType": m.annotations_of(nb).get(
+            "notebooks.kubeflow.org/server-type", "jupyter"),
+    }
+
+
+# ------------------------------------------------------------------ app
+
+def create_app(store):
+    app = cb.create_app("jupyter-web-app", store)
+    app.config = load_config()
+    NB_API = f"{nbapi.GROUP}/{nbapi.HUB_VERSION}"
+
+    # GET /api/config is served by the crud_backend base route, which
+    # reads app.config set above.
+
+    @app.get("/api/accelerators")
+    @app.get("/api/gpus")
+    def accelerators(request):
+        # node capacity scan (reference get.py:99-120): TPU types
+        # actually present in the cluster, with their topologies
+        found = {}
+        for node in store.list("v1", "Node"):
+            capacity = m.deep_get(node, "status", "capacity",
+                                  default={}) or {}
+            if "google.com/tpu" not in capacity:
+                continue
+            labels = m.labels_of(node)
+            acc = labels.get("cloud.google.com/gke-tpu-accelerator",
+                             "tpu")
+            topo = labels.get("cloud.google.com/gke-tpu-topology")
+            entry = found.setdefault(
+                acc, {"id": acc, "chipsPerHost":
+                      capacity["google.com/tpu"], "topologies": []})
+            if topo and topo not in entry["topologies"]:
+                entry["topologies"].append(topo)
+        return cb.success({"accelerators": sorted(
+            found.values(), key=lambda e: e["id"]),
+            "vendors": [v["limitsKey"] for v in
+                        app.config["accelerators"]["vendors"]]})
+
+    @app.get("/api/namespaces/<ns>/notebooks")
+    def list_notebooks(request, ns):
+        cb.ensure_authorized(store, request, "list", "notebooks", ns)
+        nbs = store.list(NB_API, nbapi.KIND, ns)
+        return cb.success(
+            {"notebooks": [_notebook_summary(nb) for nb in nbs]})
+
+    @app.get("/api/namespaces/<ns>/notebooks/<name>")
+    def get_notebook(request, ns, name):
+        cb.ensure_authorized(store, request, "get", "notebooks", ns)
+        nb = store.try_get(NB_API, nbapi.KIND, name, ns)
+        if nb is None:
+            raise HTTPError(404, f"notebook {ns}/{name} not found")
+        return cb.success({"notebook": nb})
+
+    @app.get("/api/namespaces/<ns>/notebooks/<name>/pod")
+    def get_pod(request, ns, name):
+        cb.ensure_authorized(store, request, "list", "pods", ns)
+        for pod in store.list("v1", "Pod", ns,
+                              label_selector={"notebook-name": name}):
+            return cb.success({"pod": pod})
+        raise HTTPError(404, f"no pod for notebook {ns}/{name}")
+
+    @app.get("/api/namespaces/<ns>/notebooks/<name>/pod/<pod>/logs")
+    def get_logs(request, ns, name, pod):
+        cb.ensure_authorized(store, request, "get", "pods/log", ns)
+        p = store.try_get("v1", "Pod", pod, ns)
+        if p is None:
+            raise HTTPError(404, f"pod {ns}/{pod} not found")
+        logs = m.annotations_of(p).get("kubeflow.org/pod-logs", "")
+        return cb.success({"logs": logs.splitlines()})
+
+    @app.get("/api/namespaces/<ns>/notebooks/<name>/events")
+    def get_events(request, ns, name):
+        cb.ensure_authorized(store, request, "list", "events", ns)
+        return cb.success(
+            {"events": cb.events_for(store, ns, name)})
+
+    @app.get("/api/namespaces/<ns>/poddefaults")
+    def list_poddefaults(request, ns):
+        cb.ensure_authorized(store, request, "list", "poddefaults", ns)
+        pds = store.list("kubeflow.org/v1alpha1", "PodDefault", ns)
+        return cb.success({"poddefaults": [
+            {"label": next(iter(m.deep_get(
+                pd, "spec", "selector", "matchLabels",
+                default={"": ""}))),
+             "desc": m.deep_get(pd, "spec", "desc",
+                                default=m.name_of(pd)),
+             "name": m.name_of(pd)} for pd in pds]})
+
+    @app.get("/api/namespaces/<ns>/pvcs")
+    def list_pvcs(request, ns):
+        cb.ensure_authorized(store, request, "list",
+                             "persistentvolumeclaims", ns)
+        pvcs = store.list("v1", "PersistentVolumeClaim", ns)
+        return cb.success({"pvcs": pvcs})
+
+    @app.post("/api/namespaces/<ns>/notebooks")
+    def post_notebook(request, ns):
+        cb.ensure_authorized(store, request, "create", "notebooks", ns)
+        nb, new_pvcs = form_to_notebook(request.json, ns, app.config)
+        for pvc in new_pvcs:
+            cb.ensure_authorized(store, request, "create",
+                                 "persistentvolumeclaims", ns)
+            if store.try_get("v1", "PersistentVolumeClaim",
+                             m.name_of(pvc), ns) is None:
+                store.create(pvc)
+        store.create(nb)
+        return cb.success(status=200)
+
+    @app.patch("/api/namespaces/<ns>/notebooks/<name>")
+    def patch_notebook(request, ns, name):
+        # reference patch.py:18-69 start/stop via the stop annotation
+        cb.ensure_authorized(store, request, "patch", "notebooks", ns)
+        nb = store.try_get(NB_API, nbapi.KIND, name, ns)
+        if nb is None:
+            raise HTTPError(404, f"notebook {ns}/{name} not found")
+        body = request.json
+        if "stopped" not in body:
+            raise HTTPError(400, "body must contain 'stopped'")
+        if body["stopped"]:
+            m.set_annotation(nb, STOP_ANNOTATION, m.now_iso())
+        else:
+            m.annotations_of(nb).pop(STOP_ANNOTATION, None)
+        store.update(nb)
+        return cb.success()
+
+    @app.delete("/api/namespaces/<ns>/notebooks/<name>")
+    def delete_notebook(request, ns, name):
+        cb.ensure_authorized(store, request, "delete", "notebooks", ns)
+        try:
+            store.delete(NB_API, nbapi.KIND, name, ns)
+        except NotFoundError:
+            raise HTTPError(404, f"notebook {ns}/{name} not found")
+        return cb.success()
+
+    return app

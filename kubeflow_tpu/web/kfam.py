@@ -1,0 +1,195 @@
+"""kfam — profile & contributor access management REST service.
+
+Reference: components/access-management (SURVEY.md §2#16; routes
+kfam/routers.go:32-106, binding logic bindings.go:61-94, authz
+api_default.go:303 isOwnerOrAdmin). Same API:
+
+- GET/POST/DELETE ``/kfam/v1/bindings``   (contributor RoleBindings +
+  matching Istio AuthorizationPolicies, names
+  ``user-<safe-email>-clusterrole-<role>``)
+- POST ``/kfam/v1/profiles``, DELETE ``/kfam/v1/profiles/<name>``
+- GET ``/kfam/v1/role/clusteradmin``
+"""
+
+import os
+import re
+
+from ..api import builtin, profile as papi
+from ..core import meta as m
+from ..core.errors import AlreadyExistsError, NotFoundError
+from . import crud_backend as cb
+from .http import App, HTTPError, Response
+
+PROFILE_API = f"{papi.GROUP}/{papi.VERSION}"
+RBAC_API = "rbac.authorization.k8s.io/v1"
+ISTIO_API = "security.istio.io/v1beta1"
+
+_ROLES = {"admin": "kubeflow-admin", "edit": "kubeflow-edit",
+          "view": "kubeflow-view"}
+
+
+def binding_name(user, role):
+    """bindings.go:61-77 name encoding: lowercase, specials → dashes."""
+    safe = re.sub(r"[^a-z0-9]", "-", user.lower())
+    return f"user-{safe}-clusterrole-{role}"
+
+
+def cluster_admin():
+    return os.environ.get("CLUSTER_ADMIN", "")
+
+
+def is_owner_or_admin(store, user, namespace):
+    """api_default.go:303: cluster-admin, or owner of the profile that
+    owns the namespace, or an admin contributor of it."""
+    if not user:
+        return False
+    if user == cluster_admin():
+        return True
+    for profile in store.list(PROFILE_API, papi.KIND):
+        if m.name_of(profile) != namespace:
+            continue
+        if m.deep_get(profile, "spec", "owner", "name") == user:
+            return True
+    rb = store.try_get(RBAC_API, "RoleBinding",
+                       binding_name(user, "kubeflow-admin"), namespace)
+    return rb is not None
+
+
+def _authorization_policy(user, role, namespace):
+    """bindings.go:79-94: allow the contributor's header principal
+    through the mesh into the namespace."""
+    header = os.environ.get("USERID_HEADER", "kubeflow-userid")
+    prefix = os.environ.get("USERID_PREFIX", "")
+    return builtin.authorization_policy(
+        binding_name(user, role), namespace, {
+            "action": "ALLOW",
+            "rules": [{
+                "when": [{
+                    "key": f"request.headers[{header}]",
+                    "values": [f"{prefix}{user}"],
+                }],
+            }],
+        })
+
+
+def create_app(store):
+    app = App("kfam")
+    app.store = store
+    cb.install_security(app)
+
+    request_count = {"count": 0}
+
+    @app.before_request
+    def count(request):
+        request_count["count"] += 1
+
+    @app.get("/metrics")
+    def metrics(request):
+        return Response(
+            "# TYPE kfam_requests_total counter\n"
+            f"kfam_requests_total {request_count['count']}\n",
+            headers={"Content-Type": "text/plain; version=0.0.4"})
+
+    @app.get("/kfam/v1/role/clusteradmin")
+    def clusteradmin(request):
+        return request.user == cluster_admin()
+
+    @app.get("/kfam/v1/bindings")
+    def list_bindings(request):
+        namespace = request.query.get("namespace")
+        bindings = []
+        namespaces = ([namespace] if namespace else
+                      [m.name_of(p) for p in
+                       store.list(PROFILE_API, papi.KIND)])
+        for ns in namespaces:
+            for rb in store.list(RBAC_API, "RoleBinding", ns):
+                role = m.deep_get(rb, "metadata", "annotations", "role")
+                user = m.deep_get(rb, "metadata", "annotations", "user")
+                if not role or not user:
+                    continue
+                bindings.append({
+                    "user": {"kind": "User", "name": user},
+                    "referredNamespace": ns,
+                    "RoleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                                "kind": "ClusterRole",
+                                "name": _ROLES.get(role, role)},
+                })
+        return {"bindings": bindings}
+
+    @app.post("/kfam/v1/bindings")
+    def create_binding(request):
+        body = request.json
+        user = m.deep_get(body, "user", "name")
+        ns = body.get("referredNamespace")
+        role_ref = m.deep_get(body, "RoleRef", "name", default="edit")
+        role_key = next((k for k, v in _ROLES.items()
+                         if v == role_ref or k == role_ref), "edit")
+        cluster_role = _ROLES[role_key]
+        if not user or not ns:
+            raise HTTPError(400, "user.name and referredNamespace "
+                                 "are required")
+        if not is_owner_or_admin(store, request.user, ns):
+            raise HTTPError(
+                403, f"user {request.user} is neither owner of "
+                     f"{ns} nor cluster admin")
+        name = binding_name(user, cluster_role)
+        rb = builtin.role_binding(
+            name, ns, "ClusterRole", cluster_role,
+            [{"kind": "User", "name": user,
+              "apiGroup": "rbac.authorization.k8s.io"}],
+            annotations={"role": role_key, "user": user})
+        try:
+            store.create(rb)
+        except AlreadyExistsError:
+            raise HTTPError(409, f"binding {name} already exists")
+        try:
+            store.create(_authorization_policy(user, cluster_role, ns))
+        except AlreadyExistsError:
+            pass
+        return {"success": True}
+
+    @app.delete("/kfam/v1/bindings")
+    def delete_binding(request):
+        body = request.json
+        user = m.deep_get(body, "user", "name")
+        ns = body.get("referredNamespace")
+        role_ref = m.deep_get(body, "RoleRef", "name", default="edit")
+        role_key = next((k for k, v in _ROLES.items()
+                         if v == role_ref or k == role_ref), "edit")
+        cluster_role = _ROLES[role_key]
+        if not is_owner_or_admin(store, request.user, ns):
+            raise HTTPError(403, "not owner or admin")
+        name = binding_name(user, cluster_role)
+        for api, kind in ((RBAC_API, "RoleBinding"),
+                          (ISTIO_API, "AuthorizationPolicy")):
+            try:
+                store.delete(api, kind, name, ns)
+            except NotFoundError:
+                pass
+        return {"success": True}
+
+    @app.post("/kfam/v1/profiles")
+    def create_profile(request):
+        body = request.json
+        name = m.deep_get(body, "metadata", "name") or body.get("name")
+        owner = (m.deep_get(body, "spec", "owner", "name")
+                 or request.user)
+        if not name:
+            raise HTTPError(400, "profile name is required")
+        try:
+            store.create(papi.new(name, owner))
+        except AlreadyExistsError:
+            raise HTTPError(409, f"profile {name} already exists")
+        return {"success": True}
+
+    @app.delete("/kfam/v1/profiles/<name>")
+    def delete_profile(request, name):
+        if not is_owner_or_admin(store, request.user, name):
+            raise HTTPError(403, "not owner or admin")
+        try:
+            store.delete(PROFILE_API, papi.KIND, name)
+        except NotFoundError:
+            raise HTTPError(404, f"profile {name} not found")
+        return {"success": True}
+
+    return app

@@ -1,0 +1,74 @@
+"""Tensorboards web app (TWA) backend.
+
+Reference: components/crud-web-apps/tensorboards/backend (SURVEY.md
+§2#20; routes get.py:9-32, post.py:14, delete.py:8). ``logspath``
+accepts the reference's schemes (``gs://...``, ``pvc://name/subpath``) —
+the TPU-native twist is that workloads drop JAX profiler traces under
+the same path (compute/profiler.py), so "Tensorboard on my run" shows
+device traces with zero extra config.
+"""
+
+from ..api import tensorboard as tbapi
+from ..core import meta as m
+from ..core.errors import NotFoundError
+from . import crud_backend as cb
+from .http import HTTPError
+
+TB_API = f"{tbapi.GROUP}/{tbapi.VERSION}"
+
+
+def _summary(tb):
+    ready = any(
+        c.get("type") in ("Available", "Ready")
+        and c.get("status") == "True"
+        for c in m.deep_get(tb, "status", "conditions", default=[]) or [])
+    return {
+        "name": m.name_of(tb),
+        "namespace": m.namespace_of(tb),
+        "logspath": m.deep_get(tb, "spec", "logspath", default=""),
+        "status": {"phase": "ready" if ready else "waiting"},
+        "age": m.deep_get(tb, "metadata", "creationTimestamp",
+                          default=""),
+    }
+
+
+def create_app(store):
+    app = cb.create_app("tensorboards-web-app", store)
+
+    @app.get("/api/namespaces/<ns>/tensorboards")
+    def list_tbs(request, ns):
+        cb.ensure_authorized(store, request, "list", "tensorboards", ns)
+        tbs = store.list(TB_API, tbapi.KIND, ns)
+        return cb.success({"tensorboards": [_summary(t) for t in tbs]})
+
+    @app.get("/api/namespaces/<ns>/tensorboards/<name>")
+    def get_tb(request, ns, name):
+        cb.ensure_authorized(store, request, "get", "tensorboards", ns)
+        tb = store.try_get(TB_API, tbapi.KIND, name, ns)
+        if tb is None:
+            raise HTTPError(404, f"tensorboard {ns}/{name} not found")
+        return cb.success({"tensorboard": tb})
+
+    @app.post("/api/namespaces/<ns>/tensorboards")
+    def post_tb(request, ns):
+        cb.ensure_authorized(store, request, "create", "tensorboards",
+                             ns)
+        body = request.json
+        if not body.get("name"):
+            raise HTTPError(400, "form field 'name' is required")
+        if not body.get("logspath"):
+            raise HTTPError(400, "form field 'logspath' is required")
+        store.create(tbapi.new(body["name"], ns, body["logspath"]))
+        return cb.success()
+
+    @app.delete("/api/namespaces/<ns>/tensorboards/<name>")
+    def delete_tb(request, ns, name):
+        cb.ensure_authorized(store, request, "delete", "tensorboards",
+                             ns)
+        try:
+            store.delete(TB_API, tbapi.KIND, name, ns)
+        except NotFoundError:
+            raise HTTPError(404, f"tensorboard {ns}/{name} not found")
+        return cb.success()
+
+    return app

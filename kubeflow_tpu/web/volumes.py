@@ -1,0 +1,105 @@
+"""Volumes web app (VWA) backend — PVC CRUD.
+
+Reference: components/crud-web-apps/volumes/backend (SURVEY.md §2#19;
+routes get.py:9-32, post.py:11, delete.py:11). Adds the pods-using-pvc
+view the UI uses to warn before deletion.
+"""
+
+from ..core import meta as m
+from ..core.errors import NotFoundError
+from . import crud_backend as cb
+from .http import HTTPError
+
+
+def _pvc_summary(pvc, store):
+    return {
+        "name": m.name_of(pvc),
+        "namespace": m.namespace_of(pvc),
+        "capacity": m.deep_get(pvc, "spec", "resources", "requests",
+                               "storage", default=""),
+        "modes": m.deep_get(pvc, "spec", "accessModes", default=[]),
+        "class": m.deep_get(pvc, "spec", "storageClassName",
+                            default=""),
+        "status": m.deep_get(pvc, "status", "phase", default="Bound"),
+        "age": m.deep_get(pvc, "metadata", "creationTimestamp",
+                          default=""),
+        "usedBy": pods_using_pvc(store, pvc),
+    }
+
+
+def pods_using_pvc(store, pvc):
+    name, ns = m.name_of(pvc), m.namespace_of(pvc)
+    out = []
+    for pod in store.list("v1", "Pod", ns):
+        for vol in m.deep_get(pod, "spec", "volumes", default=[]) or []:
+            if m.deep_get(vol, "persistentVolumeClaim",
+                          "claimName") == name:
+                out.append(m.name_of(pod))
+    return out
+
+
+def create_app(store):
+    app = cb.create_app("volumes-web-app", store)
+
+    @app.get("/api/namespaces/<ns>/pvcs")
+    def list_pvcs(request, ns):
+        cb.ensure_authorized(store, request, "list",
+                             "persistentvolumeclaims", ns)
+        pvcs = store.list("v1", "PersistentVolumeClaim", ns)
+        return cb.success(
+            {"pvcs": [_pvc_summary(p, store) for p in pvcs]})
+
+    @app.get("/api/namespaces/<ns>/pvcs/<name>")
+    def get_pvc(request, ns, name):
+        cb.ensure_authorized(store, request, "get",
+                             "persistentvolumeclaims", ns)
+        pvc = store.try_get("v1", "PersistentVolumeClaim", name, ns)
+        if pvc is None:
+            raise HTTPError(404, f"pvc {ns}/{name} not found")
+        return cb.success({"pvc": pvc})
+
+    @app.get("/api/namespaces/<ns>/pvcs/<name>/pods")
+    def get_pvc_pods(request, ns, name):
+        cb.ensure_authorized(store, request, "list", "pods", ns)
+        pvc = store.try_get("v1", "PersistentVolumeClaim", name, ns)
+        if pvc is None:
+            raise HTTPError(404, f"pvc {ns}/{name} not found")
+        return cb.success({"pods": pods_using_pvc(store, pvc)})
+
+    @app.get("/api/namespaces/<ns>/pvcs/<name>/events")
+    def get_pvc_events(request, ns, name):
+        cb.ensure_authorized(store, request, "list", "events", ns)
+        return cb.success({"events": cb.events_for(store, ns, name)})
+
+    @app.post("/api/namespaces/<ns>/pvcs")
+    def post_pvc(request, ns):
+        cb.ensure_authorized(store, request, "create",
+                             "persistentvolumeclaims", ns)
+        body = request.json
+        if "metadata" in body:  # full PVC object
+            pvc = m.deep_copy(body)
+            pvc.setdefault("apiVersion", "v1")
+            pvc.setdefault("kind", "PersistentVolumeClaim")
+            pvc["metadata"]["namespace"] = ns
+        else:  # simple form {name, size, class, mode}
+            from ..api import builtin
+            if not body.get("name"):
+                raise HTTPError(400, "form field 'name' is required")
+            pvc = builtin.pvc(
+                body["name"], ns, body.get("size", "10Gi"),
+                storage_class=body.get("class"),
+                access_modes=[body.get("mode", "ReadWriteOnce")])
+        store.create(pvc)
+        return cb.success()
+
+    @app.delete("/api/namespaces/<ns>/pvcs/<name>")
+    def delete_pvc(request, ns, name):
+        cb.ensure_authorized(store, request, "delete",
+                             "persistentvolumeclaims", ns)
+        try:
+            store.delete("v1", "PersistentVolumeClaim", name, ns)
+        except NotFoundError:
+            raise HTTPError(404, f"pvc {ns}/{name} not found")
+        return cb.success()
+
+    return app

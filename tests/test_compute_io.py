@@ -1,0 +1,177 @@
+"""Checkpoint/resume, data pipeline, serving REST contract, trial
+contract — the compute layer's IO surfaces."""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.compute import checkpoint as ckpt_lib
+from kubeflow_tpu.compute import data as data_lib
+from kubeflow_tpu.compute import mesh as M
+from kubeflow_tpu.compute import serving, train, trial
+from kubeflow_tpu.compute.models import mlp, transformer
+
+
+def make_state(mesh, cfg, seed=0):
+    opt = train.make_optimizer(learning_rate=1e-2, warmup_steps=1,
+                               total_steps=20)
+    state = train.init_state(
+        lambda k: transformer.init_params(cfg, k), opt, mesh,
+        transformer.logical_axes(cfg), jax.random.PRNGKey(seed))
+    return opt, state
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip_sharded(self, tmp_path):
+        cfg = transformer.Config(vocab_size=64, d_model=32, n_layers=2,
+                                 n_heads=2, max_seq=16, dtype="float32",
+                                 attention="dense")
+        mesh = M.make_mesh(data=2, fsdp=2, tensor=2)
+        opt, state = make_state(mesh, cfg)
+        step = train.make_train_step(
+            train.plain_loss(transformer.loss_fn, cfg), opt, mesh)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+        batch = {"tokens": toks, "targets": jnp.roll(toks, -1, 1)}
+        for _ in range(3):
+            state, _ = step(state, batch)
+
+        ckpt = ckpt_lib.Checkpointer(tmp_path / "ckpt", async_save=False)
+        assert ckpt.save(state)
+        ckpt.wait()
+        assert ckpt.latest_step() == 3
+
+        # restore into a freshly initialized (different) state
+        _, fresh = make_state(mesh, cfg, seed=9)
+        restored = ckpt.restore(fresh)
+        assert int(restored.step) == 3
+        for a, b in zip(jax.tree.leaves(state.params),
+                        jax.tree.leaves(restored.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        # shardings survive restore
+        spec = restored.params["layers"]["w_gate"].sharding.spec
+        assert tuple(spec) == (None, "fsdp", "tensor")
+        ckpt.close()
+
+    def test_restore_or_init(self, tmp_path):
+        cfg = transformer.Config(vocab_size=64, d_model=32, n_layers=1,
+                                 n_heads=2, max_seq=16, dtype="float32",
+                                 attention="dense")
+        mesh = M.make_mesh(data=8)
+
+        def init():
+            return make_state(mesh, cfg)[1]
+
+        ckpt, state, resumed = ckpt_lib.restore_or_init(
+            tmp_path / "c", init, async_save=False)
+        assert not resumed
+        state = dataclass_replace_step(state, 7)
+        ckpt.save(state)
+        ckpt.wait()
+        ckpt.close()
+
+        ckpt2, state2, resumed2 = ckpt_lib.restore_or_init(
+            tmp_path / "c", init, async_save=False)
+        assert resumed2 and int(state2.step) == 7
+        ckpt2.close()
+
+
+def dataclass_replace_step(state, step):
+    import dataclasses
+    return dataclasses.replace(state, step=jnp.asarray(step, jnp.int32))
+
+
+class TestData:
+    def test_shard_batch_global_shape(self):
+        mesh = M.make_mesh(data=4, fsdp=2)
+        batch = {"x": np.ones((16, 8), np.float32)}
+        out = data_lib.shard_batch(batch, mesh)
+        assert out["x"].shape == (16, 8)
+        assert out["x"].sharding.spec == data_lib.BATCH_SPEC
+
+    def test_prefetcher_preserves_order_and_count(self):
+        mesh = M.make_mesh(data=8)
+        it = data_lib.synthetic_lm(8, 16, 32, steps=5)
+        batches = list(data_lib.Prefetcher(it, mesh))
+        assert len(batches) == 5
+        assert batches[0]["tokens"].shape == (8, 16)
+
+    def test_prefetcher_propagates_errors(self):
+        mesh = M.make_mesh(data=8)
+
+        def bad():
+            yield {"x": np.ones((8, 2), np.float32)}
+            raise RuntimeError("source died")
+
+        pf = data_lib.Prefetcher(bad(), mesh)
+        next(pf)
+        with pytest.raises(RuntimeError, match="source died"):
+            next(pf)
+
+    def test_mnist_synthetic_fallback(self):
+        batch = next(data_lib.mnist(None))
+        assert batch["image"].shape == (128, 28, 28, 1)
+
+
+class TestServing:
+    def test_rest_predict_contract(self):
+        # the exact client flow of reference testing/test_tf_serving.py
+        cfg = mlp.Config(in_dim=16, hidden=8, n_classes=4)
+        params = mlp.init_params(cfg, jax.random.PRNGKey(0))
+        server = serving.ModelServer()
+        server.register("mnist",
+                        lambda x: jax.nn.softmax(
+                            mlp.apply(params, x, cfg), axis=-1))
+        port = server.start(port=0, host="127.0.0.1")
+        try:
+            url = f"http://127.0.0.1:{port}/v1/models/mnist"
+            status = json.load(urllib.request.urlopen(url))
+            assert status["model_version_status"][0]["state"] == "AVAILABLE"
+
+            req = urllib.request.Request(
+                url + ":predict",
+                data=json.dumps(
+                    {"instances": np.zeros((3, 16)).tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            resp = json.load(urllib.request.urlopen(req))
+            preds = np.asarray(resp["predictions"])
+            assert preds.shape == (3, 4)
+            np.testing.assert_allclose(preds.sum(-1), 1.0, atol=1e-5)
+
+            # unknown model -> 404 (reference retries on this)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/models/nope")
+            assert e.value.code == 404
+        finally:
+            server.stop()
+
+
+class TestTrial:
+    def test_params_from_env(self, monkeypatch):
+        monkeypatch.setenv("TRIAL_PARAMETERS", '{"lr": 0.5}')
+        monkeypatch.setenv("TRIAL_PARAM_HIDDEN", "32")
+        p = trial.params({"lr": 1.0, "other": "x"})
+        assert p["lr"] == 0.5 and p["hidden"] == 32 and p["other"] == "x"
+
+    def test_report_writes_file_and_line(self, tmp_path, monkeypatch,
+                                         capsys):
+        monkeypatch.setenv("METRICS_PATH", str(tmp_path / "m.json"))
+        trial.report(0.25, name="loss", extra={"accuracy": 0.9})
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        parsed = trial.parse_metric_line(line)
+        assert parsed == {"name": "loss", "value": 0.25,
+                          "extra": {"accuracy": 0.9}}
+        assert json.load(open(tmp_path / "m.json")) == {
+            "loss": 0.25, "accuracy": 0.9}
+
+    def test_run_mnist_trial_reports(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("METRICS_PATH", str(tmp_path / "m.json"))
+        monkeypatch.setenv("TRIAL_PARAMETERS",
+                           '{"lr": 0.01, "hidden": 16}')
+        loss = trial.run_mnist_trial(steps=5)
+        data = json.load(open(tmp_path / "m.json"))
+        assert data["objective"] == loss

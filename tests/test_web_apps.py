@@ -1,0 +1,335 @@
+"""Web/REST layer tests: authn/authz chain, JWA spawn flow (the §3.1
+call stack through the real controllers), VWA/TWA CRUD, kfam, dashboard.
+
+Reference test models: jupyter backend unittest (volumes_test.py),
+centraldashboard api_test.ts (boot app, assert routes), kfam
+bindings_test.go (binding-name encoding).
+"""
+
+import pytest
+
+from kubeflow_tpu import api
+from kubeflow_tpu.controllers import (admission, notebook as nbctl,
+                                      profile as profctl,
+                                      tensorboard as tbctl,
+                                      workload_runtime)
+from kubeflow_tpu.core import Manager, ObjectStore
+from kubeflow_tpu.core import meta as m
+from kubeflow_tpu.web import (crud_backend as cb, dashboard, http,
+                              jupyter, kfam, tensorboards, volumes)
+
+ALICE = {"kubeflow-userid": "alice@example.com"}
+MALLORY = {"kubeflow-userid": "mallory@example.com"}
+
+
+@pytest.fixture()
+def platform(store, manager, clean_env, monkeypatch):
+    """Store + controllers + alice's profile reconciled."""
+    monkeypatch.delenv("APP_DISABLE_AUTH", raising=False)
+    monkeypatch.setenv("APP_SECURE_COOKIES", "false")  # csrf off in tests
+    admission.PodDefaultWebhook(store).install()
+    manager.add(profctl.ProfileReconciler())
+    manager.add(nbctl.NotebookReconciler())
+    manager.add(tbctl.TensorboardReconciler())
+    manager.add(workload_runtime.StatefulSetReconciler())
+    manager.add(workload_runtime.DeploymentReconciler())
+    manager.add(workload_runtime.PodRuntimeReconciler())
+    manager.start_sync()
+    store.create({"apiVersion": "kubeflow.org/v1", "kind": "Profile",
+                  "metadata": {"name": "team-a"},
+                  "spec": {"owner": {"kind": "User",
+                                     "name": "alice@example.com"}}})
+    manager.run_sync()
+    return store, manager
+
+
+def client(app, headers=ALICE):
+    return http.TestClient(app, default_headers=headers)
+
+
+class TestAuthnAuthz:
+    def test_missing_header_is_401(self, platform):
+        store, _ = platform
+        c = http.TestClient(jupyter.create_app(store))
+        assert c.get("/api/namespaces/team-a/notebooks").status == 401
+
+    def test_owner_is_authorized(self, platform):
+        store, _ = platform
+        c = client(jupyter.create_app(store))
+        assert c.get("/api/namespaces/team-a/notebooks").status == 200
+
+    def test_stranger_is_403(self, platform):
+        store, _ = platform
+        c = client(jupyter.create_app(store), MALLORY)
+        r = c.get("/api/namespaces/team-a/notebooks")
+        assert r.status == 403
+        assert "not authorized" in r.json["log"]
+
+    def test_contributor_gains_access_via_kfam(self, platform):
+        store, _ = platform
+        kc = client(kfam.create_app(store))
+        r = kc.post("/kfam/v1/bindings", json_body={
+            "user": {"kind": "User", "name": "mallory@example.com"},
+            "referredNamespace": "team-a",
+            "RoleRef": {"kind": "ClusterRole", "name": "edit"}})
+        assert r.status == 200
+        c = client(jupyter.create_app(store), MALLORY)
+        assert c.get("/api/namespaces/team-a/notebooks").status == 200
+        # and the mesh policy was written (bindings.go:79-94 parity)
+        ap = store.try_get(
+            "security.istio.io/v1beta1", "AuthorizationPolicy",
+            kfam.binding_name("mallory@example.com", "kubeflow-edit"),
+            "team-a")
+        assert ap is not None
+
+    def test_csrf_blocks_when_enabled(self, platform, monkeypatch):
+        store, _ = platform
+        monkeypatch.setenv("APP_SECURE_COOKIES", "true")
+        c = client(jupyter.create_app(store))
+        r = c.post("/api/namespaces/team-a/notebooks",
+                   json_body={"name": "nb"})
+        assert r.status == 403 and "CSRF" in r.json["log"]
+
+
+class TestJWA:
+    def test_config_has_tpu_accelerators(self, platform):
+        store, _ = platform
+        c = client(jupyter.create_app(store))
+        cfg = c.get("/api/config").json["config"]
+        assert cfg["accelerators"]["limitsKey"] == "google.com/tpu"
+
+    def test_accelerators_from_node_capacity(self, platform):
+        store, _ = platform
+        from kubeflow_tpu.api import builtin
+        store.create(builtin.node(
+            "tpu-node-1", {"google.com/tpu": "4", "cpu": "32"},
+            labels={"cloud.google.com/gke-tpu-accelerator":
+                    "tpu-v5-lite-podslice",
+                    "cloud.google.com/gke-tpu-topology": "2x2"}))
+        c = client(jupyter.create_app(store))
+        accs = c.get("/api/accelerators").json["accelerators"]
+        assert accs == [{"id": "tpu-v5-lite-podslice",
+                         "chipsPerHost": "4", "topologies": ["2x2"]}]
+
+    def test_spawn_flow_end_to_end(self, platform):
+        """§3.1: POST form → CR + PVC → controller → STS/pod → status."""
+        store, manager = platform
+        c = client(jupyter.create_app(store))
+        r = c.post("/api/namespaces/team-a/notebooks", json_body={
+            "name": "mynb",
+            "image": "kubeflownotebookswg/jupyter-jax-tpu:latest",
+            "cpu": "1", "memory": "2Gi",
+            "accelerators": {"num": "4",
+                             "type": "tpu-v5-lite-podslice",
+                             "topology": "2x2"},
+        })
+        assert r.status == 200, r.json
+        # PVC created from workspace default
+        pvc = store.try_get("v1", "PersistentVolumeClaim",
+                            "mynb-workspace", "team-a")
+        assert pvc is not None
+        nb = store.get("kubeflow.org/v1beta1", "Notebook", "mynb",
+                       "team-a")
+        container = m.deep_get(nb, "spec", "template", "spec",
+                               "containers")[0]
+        assert container["resources"]["limits"]["google.com/tpu"] == "4"
+        assert container["resources"]["limits"]["cpu"] == "1.2"
+        sel = m.deep_get(nb, "spec", "template", "spec", "nodeSelector")
+        assert sel["cloud.google.com/gke-tpu-topology"] == "2x2"
+
+        manager.run_sync()
+        listed = c.get("/api/namespaces/team-a/notebooks").json
+        (summary,) = listed["notebooks"]
+        assert summary["status"]["phase"] == "ready"
+        assert summary["accelerators"] == {"google.com/tpu": "4"}
+
+        # stop → sts to 0 → status stopped
+        r = c.patch("/api/namespaces/team-a/notebooks/mynb",
+                    json_body={"stopped": True})
+        assert r.status == 200
+        manager.run_sync()
+        sts = store.get("apps/v1", "StatefulSet", "mynb", "team-a")
+        assert m.deep_get(sts, "spec", "replicas") == 0
+        summary = c.get(
+            "/api/namespaces/team-a/notebooks").json["notebooks"][0]
+        assert summary["status"]["phase"] == "stopped"
+
+        # restart
+        c.patch("/api/namespaces/team-a/notebooks/mynb",
+                json_body={"stopped": False})
+        manager.run_sync()
+        sts = store.get("apps/v1", "StatefulSet", "mynb", "team-a")
+        assert m.deep_get(sts, "spec", "replicas") == 1
+
+        # delete
+        assert c.delete(
+            "/api/namespaces/team-a/notebooks/mynb").status == 200
+        manager.run_sync()
+        assert store.try_get("kubeflow.org/v1beta1", "Notebook",
+                             "mynb", "team-a") is None
+
+    def test_form_limit_factor_none(self, platform):
+        store, _ = platform
+        config = dict(jupyter.DEFAULT_CONFIG)
+        config["cpu"] = {"value": "0.5", "limitFactor": "none"}
+        config["memory"] = {"value": "1.0Gi", "limitFactor": "none"}
+        nb, _ = jupyter.form_to_notebook({"name": "x"}, "team-a", config)
+        res = m.deep_get(nb, "spec", "template", "spec",
+                         "containers")[0]["resources"]
+        assert "cpu" not in res["limits"]
+
+    def test_poddefaults_listing(self, platform):
+        store, _ = platform
+        store.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "PodDefault",
+            "metadata": {"name": "tpu-env", "namespace": "team-a"},
+            "spec": {"selector": {"matchLabels": {"use-tpu": "yes"}},
+                     "desc": "Attach TPU env"}})
+        c = client(jupyter.create_app(store))
+        pds = c.get("/api/namespaces/team-a/poddefaults").json[
+            "poddefaults"]
+        assert pds == [{"label": "use-tpu", "desc": "Attach TPU env",
+                        "name": "tpu-env"}]
+
+
+class TestVWA:
+    def test_pvc_crud_and_used_by(self, platform):
+        store, manager = platform
+        c = client(volumes.create_app(store))
+        r = c.post("/api/namespaces/team-a/pvcs",
+                   json_body={"name": "data", "size": "5Gi",
+                              "mode": "ReadWriteOnce"})
+        assert r.status == 200
+        pvcs = c.get("/api/namespaces/team-a/pvcs").json["pvcs"]
+        assert pvcs[0]["name"] == "data"
+        assert pvcs[0]["capacity"] == "5Gi"
+        assert pvcs[0]["usedBy"] == []
+
+        # a notebook mounting it shows up in usedBy
+        jc = client(jupyter.create_app(store))
+        jc.post("/api/namespaces/team-a/notebooks", json_body={
+            "name": "nb2", "noWorkspace": True,
+            "datavols": [{"existingSource": {"persistentVolumeClaim":
+                          {"claimName": "data"}}, "mount": "/data"}]})
+        manager.run_sync()
+        pvcs = c.get("/api/namespaces/team-a/pvcs").json["pvcs"]
+        assert pvcs[0]["usedBy"] == ["nb2-0"]
+
+        assert c.delete(
+            "/api/namespaces/team-a/pvcs/data").status == 200
+        assert c.get(
+            "/api/namespaces/team-a/pvcs/data").status == 404
+
+
+class TestTWA:
+    def test_tensorboard_crud(self, platform):
+        store, manager = platform
+        c = client(tensorboards.create_app(store))
+        r = c.post("/api/namespaces/team-a/tensorboards",
+                   json_body={"name": "tb1",
+                              "logspath": "pvc://data/logs"})
+        assert r.status == 200
+        manager.run_sync()
+        tbs = c.get(
+            "/api/namespaces/team-a/tensorboards").json["tensorboards"]
+        assert tbs[0]["name"] == "tb1"
+        assert tbs[0]["logspath"] == "pvc://data/logs"
+        assert c.delete(
+            "/api/namespaces/team-a/tensorboards/tb1").status == 200
+
+    def test_missing_logspath_is_400(self, platform):
+        store, _ = platform
+        c = client(tensorboards.create_app(store))
+        assert c.post("/api/namespaces/team-a/tensorboards",
+                      json_body={"name": "tb"}).status == 400
+
+
+class TestKfam:
+    def test_binding_name_encoding(self):
+        # bindings_test.go:25 parity
+        assert (kfam.binding_name("User@Example.Com", "kubeflow-edit")
+                == "user-user-example-com-clusterrole-kubeflow-edit")
+
+    def test_profile_lifecycle(self, platform):
+        store, manager = platform
+        c = client(kfam.create_app(store))
+        assert c.post("/kfam/v1/profiles",
+                      json_body={"metadata": {"name": "team-b"},
+                                 "spec": {"owner": {
+                                     "name": "alice@example.com"}}}
+                      ).status == 200
+        manager.run_sync()
+        assert store.try_get("v1", "Namespace", "team-b") is not None
+        assert c.delete("/kfam/v1/profiles/team-b").status == 200
+
+    def test_non_owner_cannot_bind(self, platform):
+        store, _ = platform
+        c = client(kfam.create_app(store), MALLORY)
+        r = c.post("/kfam/v1/bindings", json_body={
+            "user": {"kind": "User", "name": "mallory@example.com"},
+            "referredNamespace": "team-a",
+            "RoleRef": {"kind": "ClusterRole", "name": "admin"}})
+        assert r.status == 403
+
+    def test_clusteradmin_route(self, platform, monkeypatch):
+        store, _ = platform
+        monkeypatch.setenv("CLUSTER_ADMIN", "alice@example.com")
+        c = client(kfam.create_app(store))
+        assert c.get("/kfam/v1/role/clusteradmin").json is True
+
+
+class TestDashboard:
+    def test_env_info_roles(self, platform):
+        store, _ = platform
+        c = client(dashboard.create_app(store))
+        info = c.get("/api/env-info").json
+        assert info["namespaces"] == [{"namespace": "team-a",
+                                       "role": "owner"}]
+        assert info["platform"]["provider"] == "tpu"
+
+    def test_workgroup_onboarding(self, platform):
+        store, manager = platform
+        c = client(dashboard.create_app(store), MALLORY)
+        assert c.get("/api/workgroup/exists").json["hasWorkgroup"] \
+            is False
+        r = c.post("/api/workgroup/create", json_body={})
+        assert r.status == 200
+        manager.run_sync()
+        assert c.get("/api/workgroup/exists").json["hasWorkgroup"] \
+            is True
+        assert store.try_get("v1", "Namespace", "mallory") is not None
+
+    def test_metrics_service(self, platform):
+        store, _ = platform
+        c = client(dashboard.create_app(store))
+        series = c.get("/api/metrics/podcount").json
+        assert series[0]["value"] == 0
+
+
+class TestCsrfCookieFlow:
+    def test_get_issues_cookie_then_post_succeeds(self, platform,
+                                                  monkeypatch):
+        """The browser flow: GET hands out XSRF-TOKEN, echoing it in the
+        header authorizes the mutation (double-submit contract)."""
+        store, _ = platform
+        monkeypatch.setenv("APP_SECURE_COOKIES", "true")
+        app = jupyter.create_app(store)
+        c = client(app)
+        r = c.get("/api/namespaces/team-a/notebooks")
+        cookie = r.headers.get("Set-Cookie", "")
+        assert cookie.startswith(cb.CSRF_COOKIE + "=")
+        token = cookie.split(";")[0].split("=", 1)[1]
+        r = c.post("/api/namespaces/team-a/notebooks",
+                   json_body={"name": "csrf-nb", "noWorkspace": True},
+                   headers={"Cookie": f"{cb.CSRF_COOKIE}={token}",
+                            cb.CSRF_HEADER: token})
+        assert r.status == 200, r.json
+
+    def test_kfam_mutations_require_csrf(self, platform, monkeypatch):
+        store, _ = platform
+        monkeypatch.setenv("APP_SECURE_COOKIES", "true")
+        c = client(kfam.create_app(store))
+        r = c.post("/kfam/v1/bindings", json_body={
+            "user": {"kind": "User", "name": "x@example.com"},
+            "referredNamespace": "team-a"})
+        assert r.status == 403 and "CSRF" in r.json["log"]
