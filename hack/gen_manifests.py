@@ -1,0 +1,316 @@
+#!/usr/bin/env python3
+"""Generate the kustomize manifest tree (reference: per-component
+manifests/ dirs, SURVEY.md §2#25). Deterministic output, committed —
+re-run after editing: python hack/gen_manifests.py"""
+
+import os
+
+import yaml
+
+ROOT = os.path.join(os.path.dirname(__file__), "..", "manifests")
+
+APP_GROUP = "kubeflow.org"
+NS = "kubeflow"
+
+# component -> (image, port, extra env, needs webhook cert)
+CONTROLLERS = {
+    "notebook-controller": {
+        "image": "kubeflowtpu/notebook-controller:latest",
+        "env": {"USE_ISTIO": "true", "ISTIO_GATEWAY":
+                "kubeflow/kubeflow-gateway", "ENABLE_CULLING": "true"},
+    },
+    "secure-notebook-controller": {
+        "image": "kubeflowtpu/secure-notebook-controller:latest",
+        "env": {"OAUTH_PROXY_IMAGE":
+                "kubeflowtpu/auth-proxy:latest"},
+        "webhook": {"path": "/mutate-notebook-v1",
+                    "rules": [{"apiGroups": [APP_GROUP],
+                               "apiVersions": ["v1", "v1beta1"],
+                               "operations": ["CREATE", "UPDATE"],
+                               "resources": ["notebooks"]}]},
+    },
+    "profile-controller": {
+        "image": "kubeflowtpu/profile-controller:latest",
+        "env": {"USERID_HEADER": "kubeflow-userid",
+                "USERID_PREFIX": ""},
+        "cluster_scope": True,
+    },
+    "tensorboard-controller": {
+        "image": "kubeflowtpu/tensorboard-controller:latest",
+        "env": {"RWO_PVC_SCHEDULING": "true"},
+    },
+    "tpuslice-controller": {
+        "image": "kubeflowtpu/tpuslice-controller:latest",
+        "env": {},
+    },
+    "admission-webhook": {
+        "image": "kubeflowtpu/admission-webhook:latest",
+        "env": {},
+        "webhook": {"path": "/apply-poddefault",
+                    "rules": [{"apiGroups": [""],
+                               "apiVersions": ["v1"],
+                               "operations": ["CREATE"],
+                               "resources": ["pods"]}]},
+    },
+}
+
+WEB_APPS = {
+    "jupyter-web-app": {"image": "kubeflowtpu/jupyter-web-app:latest",
+                        "port": 5000, "prefix": "/jupyter"},
+    "volumes-web-app": {"image": "kubeflowtpu/volumes-web-app:latest",
+                        "port": 5000, "prefix": "/volumes"},
+    "tensorboards-web-app": {
+        "image": "kubeflowtpu/tensorboards-web-app:latest",
+        "port": 5000, "prefix": "/tensorboards"},
+    "access-management": {"image": "kubeflowtpu/access-management:latest",
+                          "port": 8081, "prefix": "/kfam"},
+    "centraldashboard": {"image": "kubeflowtpu/centraldashboard:latest",
+                         "port": 8082, "prefix": "/"},
+}
+
+CRDS = [
+    ("notebooks", "Notebook", ["v1alpha1", "v1beta1", "v1"], "v1beta1",
+     "Namespaced"),
+    ("profiles", "Profile", ["v1", "v1beta1"], "v1", "Cluster"),
+    ("tensorboards", "Tensorboard", ["v1alpha1"], "v1alpha1",
+     "Namespaced"),
+    ("poddefaults", "PodDefault", ["v1alpha1"], "v1alpha1",
+     "Namespaced"),
+    ("tpuslices", "TpuSlice", ["v1alpha1"], "v1alpha1", "Namespaced"),
+    ("studyjobs", "StudyJob", ["v1alpha1"], "v1alpha1", "Namespaced"),
+]
+
+
+def dump(path, docs):
+    full = os.path.join(ROOT, path)
+    os.makedirs(os.path.dirname(full), exist_ok=True)
+    with open(full, "w") as f:
+        yaml.safe_dump_all([d for d in docs if d], f, sort_keys=False)
+
+
+def kustomization(path, resources, namespace=NS):
+    dump(os.path.join(path, "kustomization.yaml"), [{
+        "apiVersion": "kustomize.config.k8s.io/v1beta1",
+        "kind": "Kustomization",
+        "namespace": namespace,
+        "resources": resources,
+    }])
+
+
+def crd(plural, kind, versions, storage, scope):
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{APP_GROUP}"},
+        "spec": {
+            "group": APP_GROUP,
+            "names": {"kind": kind, "plural": plural,
+                      "singular": kind.lower()},
+            "scope": scope,
+            "versions": [{
+                "name": v,
+                "served": True,
+                "storage": v == storage,
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "x-kubernetes-preserve-unknown-fields": True}},
+                "subresources": {"status": {}},
+            } for v in versions],
+        },
+    }
+
+
+def deployment(name, image, env=None, port=None, args=None,
+               sa=None):
+    container = {
+        "name": name,
+        "image": image,
+        "env": [{"name": k, "value": v}
+                for k, v in sorted((env or {}).items())],
+        "resources": {"requests": {"cpu": "100m", "memory": "128Mi"},
+                      "limits": {"cpu": "1", "memory": "1Gi"}},
+        "livenessProbe": {"httpGet": {"path": "/healthz",
+                                      "port": port or 8080}},
+    }
+    if port:
+        container["ports"] = [{"containerPort": port}]
+    if args:
+        container["args"] = args
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "labels": {"app": name}},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {"serviceAccountName": sa or name,
+                         "containers": [container]},
+            },
+        },
+    }
+
+
+def service(name, port, target=None):
+    return {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": name, "labels": {"app": name}},
+        "spec": {"selector": {"app": name},
+                 "ports": [{"port": port,
+                            "targetPort": target or port}]},
+    }
+
+
+def rbac(name, cluster=True):
+    kind = "ClusterRole" if cluster else "Role"
+    return [
+        {"apiVersion": "v1", "kind": "ServiceAccount",
+         "metadata": {"name": name}},
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": kind,
+         "metadata": {"name": name},
+         "rules": [
+             {"apiGroups": ["*"], "resources": ["*"],
+              "verbs": ["get", "list", "watch"]},
+             {"apiGroups": ["", "apps", APP_GROUP,
+                            "networking.istio.io",
+                            "security.istio.io", "networking.k8s.io",
+                            "route.openshift.io",
+                            "rbac.authorization.k8s.io"],
+              "resources": ["*"],
+              "verbs": ["*"]},
+         ]},
+        {"apiVersion": "rbac.authorization.k8s.io/v1",
+         "kind": f"{kind}Binding",
+         "metadata": {"name": name},
+         "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                     "kind": kind, "name": name},
+         "subjects": [{"kind": "ServiceAccount", "name": name,
+                       "namespace": NS}]},
+    ]
+
+
+def webhook_config(name, spec):
+    return {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "MutatingWebhookConfiguration",
+        "metadata": {"name": name,
+                     "annotations": {
+                         "cert-manager.io/inject-ca-from":
+                             f"{NS}/{name}-cert"}},
+        "webhooks": [{
+            "name": f"{name}.{APP_GROUP}",
+            "admissionReviewVersions": ["v1"],
+            "sideEffects": "None",
+            "clientConfig": {"service": {
+                "name": name, "namespace": NS,
+                "path": spec["path"], "port": 443}},
+            "rules": spec["rules"],
+            "failurePolicy": "Fail",
+        }],
+    }
+
+
+def certificate(name):
+    return [
+        {"apiVersion": "cert-manager.io/v1", "kind": "Certificate",
+         "metadata": {"name": f"{name}-cert"},
+         "spec": {"secretName": f"{name}-tls",
+                  "dnsNames": [f"{name}.{NS}.svc",
+                               f"{name}.{NS}.svc.cluster.local"],
+                  "issuerRef": {"kind": "Issuer",
+                                "name": "kubeflow-self-signing"}}},
+    ]
+
+
+def virtual_service(name, prefix, port):
+    return {
+        "apiVersion": "networking.istio.io/v1alpha3",
+        "kind": "VirtualService",
+        "metadata": {"name": name},
+        "spec": {
+            "gateways": ["kubeflow/kubeflow-gateway"],
+            "hosts": ["*"],
+            "http": [{
+                "match": [{"uri": {"prefix": f"{prefix}/"}}]
+                if prefix != "/" else [{"uri": {"prefix": "/"}}],
+                "rewrite": ({"uri": "/"} if prefix != "/" else None),
+                "route": [{"destination": {
+                    "host": f"{name}.{NS}.svc.cluster.local",
+                    "port": {"number": port}}}],
+            }],
+        },
+    }
+
+
+def main():
+    all_dirs = []
+
+    dump("crds/crds.yaml",
+         [crd(*args) for args in CRDS])
+    kustomization("crds", ["crds.yaml"], namespace=None)
+    all_dirs.append("crds")
+
+    for name, spec in CONTROLLERS.items():
+        docs = rbac(name)
+        docs.append(deployment(name, spec["image"], spec["env"],
+                               port=8443 if "webhook" in spec else None))
+        if "webhook" in spec:
+            docs.append(service(name, 443, target=8443))
+            docs.append(webhook_config(name, spec["webhook"]))
+            docs.extend(certificate(name))
+        dump(f"{name}/resources.yaml", docs)
+        kustomization(name, ["resources.yaml"])
+        all_dirs.append(name)
+
+    for name, spec in WEB_APPS.items():
+        docs = rbac(name)
+        docs.append(deployment(name, spec["image"],
+                               {"USERID_HEADER": "kubeflow-userid"},
+                               port=spec["port"]))
+        docs.append(service(name, 80, target=spec["port"]))
+        docs.append(virtual_service(name, spec["prefix"], 80))
+        dump(f"{name}/resources.yaml", docs)
+        kustomization(name, ["resources.yaml"])
+        all_dirs.append(name)
+
+    # jupyter spawner config lives in a ConfigMap, mirroring
+    # jupyter/manifests/base/configs/spawner_ui_config.yaml
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from kubeflow_tpu.web.jupyter import DEFAULT_CONFIG
+    dump("jupyter-web-app/spawner-config.yaml", [{
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "jupyter-web-app-config"},
+        "data": {"spawner_ui_config.yaml": yaml.safe_dump(
+            {"spawnerFormDefaults": DEFAULT_CONFIG},
+            sort_keys=False)},
+    }])
+    kustomization("jupyter-web-app",
+                  ["resources.yaml", "spawner-config.yaml"])
+
+    # istio gateway + namespace + self-signing issuer
+    dump("istio/gateway.yaml", [
+        {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": NS,
+                      "labels": {"istio-injection": "enabled"}}},
+        {"apiVersion": "networking.istio.io/v1alpha3", "kind": "Gateway",
+         "metadata": {"name": "kubeflow-gateway", "namespace": NS},
+         "spec": {"selector": {"istio": "ingressgateway"},
+                  "servers": [{"hosts": ["*"],
+                               "port": {"name": "http", "number": 80,
+                                        "protocol": "HTTP"}}]}},
+        {"apiVersion": "cert-manager.io/v1", "kind": "Issuer",
+         "metadata": {"name": "kubeflow-self-signing", "namespace": NS},
+         "spec": {"selfSigned": {}}},
+    ])
+    kustomization("istio", ["gateway.yaml"], namespace=None)
+    all_dirs.insert(0, "istio")
+
+    kustomization("", all_dirs, namespace=None)
+    print(f"wrote manifests for {len(all_dirs)} components under "
+          f"{os.path.abspath(ROOT)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Identity-header auth proxy — the sidecar the secure-notebook
+controller injects (reference: openshift/oauth-proxy in
+odh notebook_webhook.go:73; rebuilt as a header-identity gate for the
+mesh-neutral deployment).
+
+Behavior: reverse-proxies :8443 → upstream :8888. Requests must carry
+the identity header (set by the cluster's authenticating ingress); if
+ALLOWED_USERS is set, the identity must be in that comma-separated list
+(the notebook owner + contributors, rendered by the controller).
+Everything else gets 403. /oauth/healthz serves the liveness probe.
+
+Stdlib-only so the image is a few MB of python:slim.
+"""
+
+import os
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+UPSTREAM = os.environ.get("UPSTREAM", "http://127.0.0.1:8888")
+PORT = int(os.environ.get("PORT", "8443"))
+USERID_HEADER = os.environ.get("USERID_HEADER", "kubeflow-userid")
+ALLOWED_USERS = [u.strip() for u in
+                 os.environ.get("ALLOWED_USERS", "").split(",")
+                 if u.strip()]
+HOP_HEADERS = {"connection", "keep-alive", "proxy-authenticate",
+               "proxy-authorization", "te", "trailers",
+               "transfer-encoding", "upgrade", "host",
+               "content-length"}
+
+
+class ProxyHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    def _deny(self, code, msg):
+        body = msg.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _authorize(self):
+        user = self.headers.get(USERID_HEADER)
+        if not user:
+            self._deny(401, f"missing identity header {USERID_HEADER}")
+            return None
+        if ALLOWED_USERS and user not in ALLOWED_USERS:
+            self._deny(403, f"user {user} not allowed")
+            return None
+        return user
+
+    def _proxy(self):
+        if self.path == "/oauth/healthz":
+            return self._deny(200, "ok")
+        user = self._authorize()
+        if user is None:
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else None
+        headers = {k: v for k, v in self.headers.items()
+                   if k.lower() not in HOP_HEADERS}
+        headers["X-Forwarded-User"] = user
+        req = urllib.request.Request(
+            UPSTREAM + self.path, data=body, headers=headers,
+            method=self.command)
+        try:
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                payload = resp.read()
+                self.send_response(resp.status)
+                for k, v in resp.headers.items():
+                    if k.lower() not in HOP_HEADERS:
+                        self.send_header(k, v)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            self.send_response(e.code)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        except (urllib.error.URLError, OSError):
+            self._deny(502, "upstream unavailable")
+
+    do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = do_HEAD = _proxy
+
+
+def serve(port=PORT, background=False):
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), ProxyHandler)
+    if background:
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd
+    httpd.serve_forever()
+
+
+if __name__ == "__main__":
+    serve()

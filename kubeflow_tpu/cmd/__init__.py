@@ -1,0 +1,172 @@
+"""Process entrypoints — ``python -m kubeflow_tpu.cmd <component>``.
+
+One image per component (manifests/); each main wires the component to
+the real cluster through core.kubestore.KubeStore (or to an in-process
+store with ``--dev`` for local hacking). Flags mirror the reference's
+(SURVEY.md §5 config system): env vars are the primary surface.
+"""
+
+import logging
+import os
+import signal
+import threading
+
+
+def _store(dev=False):
+    if dev or os.environ.get("DEV", "").lower() == "true":
+        from .. import api
+        from ..core import ObjectStore
+        store = ObjectStore()
+        api.register_all(store)
+        return store
+    from ..core.kubestore import KubeStore
+    return KubeStore(
+        insecure=os.environ.get("KUBE_INSECURE", "").lower() == "true")
+
+
+def _run_manager(reconcilers, store=None):
+    from ..core import Manager
+    store = store or _store()
+    mgr = Manager(store)
+    for r in reconcilers:
+        mgr.add(r)
+    mgr.start()
+    return mgr, store
+
+
+def _serve_health(port=8080):
+    from ..web.http import App
+    app = App("health")
+
+    @app.get("/healthz")
+    def healthz(request):
+        return {"status": "ok"}
+
+    @app.get("/readyz")
+    def readyz(request):
+        return {"status": "ok"}
+
+    return app.serve(port=port)
+
+
+def _block():
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+
+
+def notebook_controller():
+    from ..controllers import culling, notebook
+    _serve_health(int(os.environ.get("METRICS_PORT", "8080")))
+    reconcilers = [notebook.NotebookReconciler()]
+    if os.environ.get("ENABLE_CULLING", "").lower() == "true":
+        reconcilers.append(culling.CullingReconciler())
+    _run_manager(reconcilers)
+    _block()
+
+
+def secure_notebook_controller():
+    from ..controllers import secure_notebook, webhook_server
+    store = _store()
+    hook = secure_notebook.SecureNotebookWebhook(store)
+    server = webhook_server.WebhookServer(
+        {"/mutate-notebook-v1": hook})
+    server.start(int(os.environ.get("WEBHOOK_PORT", "8443")))
+    _run_manager([secure_notebook.SecureNotebookReconciler(
+        controller_namespace=os.environ.get("POD_NAMESPACE", "kubeflow"),
+        ca_bundle=os.environ.get("CA_BUNDLE", ""))], store=store)
+    _block()
+
+
+def profile_controller():
+    from ..controllers import profile
+    _serve_health()
+    _run_manager([profile.ProfileReconciler(
+        userid_header=os.environ.get("USERID_HEADER", "kubeflow-userid"),
+        userid_prefix=os.environ.get("USERID_PREFIX", ""))])
+    _block()
+
+
+def tensorboard_controller():
+    from ..controllers import tensorboard
+    _serve_health()
+    _run_manager([tensorboard.TensorboardReconciler()])
+    _block()
+
+
+def tpuslice_controller():
+    from ..controllers import tpuslice
+    _serve_health()
+    _run_manager([tpuslice.TpuSliceReconciler(),
+                  tpuslice.StudyJobReconciler()])
+    _block()
+
+
+def admission_webhook():
+    from ..controllers import admission, webhook_server
+    store = _store()
+    hook = admission.PodDefaultWebhook(store)
+    server = webhook_server.WebhookServer({"/apply-poddefault": hook})
+    server.start(int(os.environ.get("WEBHOOK_PORT", "8443")))
+    _block()
+
+
+def _web(create_app, default_port):
+    store = _store()
+    app = create_app(store)
+    httpd = app.serve(port=int(os.environ.get("PORT", default_port)))
+    logging.info("%s serving on %s", app.name, httpd.server_address)
+    _block()
+
+
+def jupyter_web_app():
+    from ..web import jupyter
+    _web(jupyter.create_app, 5000)
+
+
+def volumes_web_app():
+    from ..web import volumes
+    _web(volumes.create_app, 5000)
+
+
+def tensorboards_web_app():
+    from ..web import tensorboards
+    _web(tensorboards.create_app, 5000)
+
+
+def access_management():
+    from ..web import kfam
+    _web(kfam.create_app, 8081)
+
+
+def centraldashboard():
+    from ..web import dashboard
+    _web(dashboard.create_app, 8082)
+
+
+COMPONENTS = {
+    "notebook-controller": notebook_controller,
+    "secure-notebook-controller": secure_notebook_controller,
+    "profile-controller": profile_controller,
+    "tensorboard-controller": tensorboard_controller,
+    "tpuslice-controller": tpuslice_controller,
+    "admission-webhook": admission_webhook,
+    "jupyter-web-app": jupyter_web_app,
+    "volumes-web-app": volumes_web_app,
+    "tensorboards-web-app": tensorboards_web_app,
+    "access-management": access_management,
+    "centraldashboard": centraldashboard,
+}
+
+
+def main(argv):
+    logging.basicConfig(
+        level=os.environ.get("LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    if len(argv) < 1 or argv[0] not in COMPONENTS:
+        names = "\n  ".join(sorted(COMPONENTS))
+        raise SystemExit(
+            f"usage: python -m kubeflow_tpu.cmd <component>\n"
+            f"components:\n  {names}")
+    COMPONENTS[argv[0]]()

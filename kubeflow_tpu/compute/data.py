@@ -121,6 +121,6 @@ def mnist(path=None, split="train"):
             path, f"{prefix}-labels-idx1-ubyte.gz")) as f:
         labels = np.frombuffer(f.read(), np.uint8, offset=8).astype(
             np.int32)
-    for start in range(0, len(images) - 127, 128):
+    for start in range(0, len(images), 128):
         sl = slice(start, start + 128)
         yield {"image": images[sl], "label": labels[sl]}

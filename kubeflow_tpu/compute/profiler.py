@@ -24,12 +24,15 @@ def trace_dir(base=None):
 
 
 @contextlib.contextmanager
-def trace(logdir=None, host_profiling=True):
+def trace(logdir=None):
     """Capture a profiler trace for the enclosed steps:
 
         with profiler.trace("/workspace/logs"):
             for _ in range(10):
                 state, _ = step(state, batch)
+
+    jax writes under <base>/plugins/profile/... itself, which is where
+    trace_dir() points the Tensorboard profile plugin.
     """
     base = logdir or os.environ.get("TENSORBOARD_LOGDIR", "./logs")
     os.makedirs(base, exist_ok=True)

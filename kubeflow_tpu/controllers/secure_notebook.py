@@ -1,0 +1,288 @@
+"""Secure-notebook add-on controller + webhook (the odh-notebook-controller
+equivalent, reference components/odh-notebook-controller — SURVEY.md §2#5-8).
+
+Watches the same ``Notebook`` CR as the core controller and adds the
+security perimeter the ODH fork adds on OpenShift:
+
+- auth-proxy sidecar injection via a mutating webhook on the Notebook
+  (reference notebook_webhook.go:231 Handle / :73 InjectOAuthProxy),
+  gated by annotation ``notebooks.kubeflow.org/inject-oauth: "true"``,
+- per-notebook OAuth objects: ServiceAccount, ``<nb>-tls`` Service,
+  session-secret Secret, reencrypt Route (notebook_oauth.go:46-250),
+- plain edge Route when OAuth is disabled (notebook_route.go:34),
+- NetworkPolicies ``<nb>-ctrl-np`` (webhook port from controller ns) and
+  ``<nb>-oauth-np`` (oauth port 8443) (notebook_network.go:132,177),
+- trusted-CA bundle ConfigMap mirrored into the notebook namespace and
+  mounted (notebook_controller.go:239 CreateNotebookCertConfigMap),
+- image resolution from a registry ConfigMap — the ImageStream
+  equivalent (notebook_webhook.go:458 SetContainerImageFromRegistry),
+- reconciliation-lock annotation on create, removed once the perimeter
+  objects exist (notebook_controller.go:112-140).
+"""
+
+import base64
+import logging
+import os
+import secrets
+
+from ..api import builtin, notebook as nbapi
+from ..core import meta as m
+from ..core import reconcilehelper as helper
+from ..core.manager import Reconciler, Result
+
+log = logging.getLogger("kubeflow_tpu.controllers.secure_notebook")
+
+NB_API = f"{nbapi.GROUP}/{nbapi.HUB_VERSION}"
+
+OAUTH_ANNOTATION = "notebooks.kubeflow.org/inject-oauth"
+LOCK_ANNOTATION = "kubeflow-resource-locked"
+CA_CONFIGMAP = "trusted-ca-bundle"
+OAUTH_PORT = 8443
+OAUTH_PROXY_IMAGE = os.environ.get(
+    "OAUTH_PROXY_IMAGE", "kubeflownotebookswg/auth-proxy:latest")
+
+
+def oauth_enabled(nb):
+    return m.annotations_of(nb).get(OAUTH_ANNOTATION) == "true"
+
+
+# ------------------------------------------------------------- generators
+
+def generate_service_account(nb):
+    name, ns = m.name_of(nb), m.namespace_of(nb)
+    sa = builtin.service_account(name, ns, annotations={
+        "serviceaccounts.openshift.io/oauth-redirectreference.first":
+            f'{{"kind":"OAuthRedirectReference","apiVersion":"v1",'
+            f'"reference":{{"kind":"Route","name":"{name}"}}}}'})
+    return sa
+
+
+def generate_tls_service(nb):
+    """notebook_oauth.go:113: the `-tls` Service fronting the proxy."""
+    name, ns = m.name_of(nb), m.namespace_of(nb)
+    svc = builtin.service(
+        f"{name}-tls", ns, selector={"statefulset": name},
+        ports=[{"name": "oauth-proxy", "port": OAUTH_PORT,
+                "targetPort": OAUTH_PORT, "protocol": "TCP"}])
+    m.set_annotation(svc, "service.beta.openshift.io/serving-cert-secret-name",
+                     f"{name}-tls")
+    return svc
+
+
+def generate_session_secret(nb):
+    name, ns = m.name_of(nb), m.namespace_of(nb)
+    cookie = base64.b64encode(secrets.token_bytes(32)).decode()
+    return builtin.secret(f"{name}-oauth-config", ns,
+                          data={"cookie_secret": cookie})
+
+
+def generate_route(nb, to_tls):
+    """Reencrypt route to the proxy, or plain edge route to the notebook
+    Service (notebook_route.go:34 NewNotebookRoute)."""
+    name, ns = m.name_of(nb), m.namespace_of(nb)
+    if to_tls:
+        return builtin.route(name, ns, f"{name}-tls", OAUTH_PORT,
+                             tls={"termination": "reencrypt"})
+    return builtin.route(name, ns, name, 80, tls={"termination": "edge"})
+
+
+def generate_ctrl_network_policy(nb, controller_namespace):
+    name, ns = m.name_of(nb), m.namespace_of(nb)
+    return builtin.network_policy(f"{name}-ctrl-np", ns, {
+        "podSelector": {"matchLabels": {"statefulset": name}},
+        "policyTypes": ["Ingress"],
+        "ingress": [{
+            "from": [{"namespaceSelector": {"matchLabels": {
+                "kubernetes.io/metadata.name": controller_namespace}}}],
+            "ports": [{"protocol": "TCP", "port": 8443}],
+        }],
+    })
+
+
+def generate_oauth_network_policy(nb):
+    name, ns = m.name_of(nb), m.namespace_of(nb)
+    return builtin.network_policy(f"{name}-oauth-np", ns, {
+        "podSelector": {"matchLabels": {"statefulset": name}},
+        "policyTypes": ["Ingress"],
+        "ingress": [{"ports": [{"protocol": "TCP",
+                                "port": OAUTH_PORT}]}],
+    })
+
+
+def generate_ca_configmap(nb, bundle):
+    return builtin.config_map(
+        CA_CONFIGMAP, m.namespace_of(nb),
+        {"ca-bundle.crt": bundle},
+        labels={"config.openshift.io/inject-trusted-cabundle": "true"})
+
+
+def oauth_proxy_container(nb):
+    name, ns = m.name_of(nb), m.namespace_of(nb)
+    return {
+        "name": "oauth-proxy",
+        "image": OAUTH_PROXY_IMAGE,
+        "args": [
+            f"--provider=openshift",
+            f"--https-address=:{OAUTH_PORT}",
+            "--http-address=",
+            f"--openshift-service-account={name}",
+            f"--upstream=http://localhost:8888",
+            "--cookie-secret-file=/etc/oauth/config/cookie_secret",
+            f"--openshift-sar={{\"verb\":\"get\",\"resource\":"
+            f"\"notebooks\",\"resourceAPIGroup\":\"kubeflow.org\","
+            f"\"resourceName\":\"{name}\",\"namespace\":\"{ns}\"}}",
+        ],
+        "ports": [{"name": "oauth-proxy", "containerPort": OAUTH_PORT,
+                   "protocol": "TCP"}],
+        "livenessProbe": {"httpGet": {"path": "/oauth/healthz",
+                                      "port": OAUTH_PORT,
+                                      "scheme": "HTTPS"}},
+        "volumeMounts": [
+            {"name": "oauth-config", "mountPath": "/etc/oauth/config"},
+            {"name": "tls-certificates",
+             "mountPath": "/etc/tls/private"},
+        ],
+    }
+
+
+# --------------------------------------------------------------- webhook
+
+class SecureNotebookWebhook:
+    """Mutating webhook on Notebook CREATE/UPDATE (the reference's
+    /mutate-notebook-v1, notebook_webhook.go:231)."""
+
+    def __init__(self, store, registry_configmap="notebook-image-registry",
+                 namespace="kubeflow"):
+        self.store = store
+        self.registry_configmap = registry_configmap
+        self.namespace = namespace
+
+    def install(self):
+        self.store.register_mutating_hook(
+            self,
+            match=lambda g, k, ns: (g, k) == (nbapi.GROUP, nbapi.KIND))
+
+    def __call__(self, operation, nb, old):
+        if operation not in ("CREATE", "UPDATE"):
+            return nb
+        if operation == "CREATE":
+            # reconciliation lock until the perimeter exists (:244)
+            m.set_annotation(nb, LOCK_ANNOTATION, "true")
+        self.resolve_image(nb)
+        self.mount_ca_bundle(nb)
+        if oauth_enabled(nb):
+            self.inject_oauth_proxy(nb)
+        return nb
+
+    def resolve_image(self, nb):
+        """notebook_webhook.go:458: image `name:tag` resolved through
+        the registry ConfigMap (ImageStream equivalent)."""
+        registry = self.store.try_get("v1", "ConfigMap",
+                                      self.registry_configmap,
+                                      self.namespace)
+        if registry is None:
+            return
+        data = registry.get("data") or {}
+        container = builtin.get_container(
+            m.deep_get(nb, "spec", "template", "spec", default={}),
+            name=m.name_of(nb))
+        if container is None:
+            return
+        image = container.get("image", "")
+        if image in data:
+            container["image"] = data[image]
+
+    def mount_ca_bundle(self, nb):
+        """notebook_webhook.go:251: mount the trusted-CA ConfigMap."""
+        spec = m.deep_get(nb, "spec", "template", "spec", default={})
+        container = builtin.get_container(spec, name=m.name_of(nb))
+        if container is None:
+            return
+        volumes = spec.setdefault("volumes", [])
+        if not any(v.get("name") == "trusted-ca" for v in volumes):
+            volumes.append({
+                "name": "trusted-ca",
+                "configMap": {"name": CA_CONFIGMAP, "optional": True,
+                              "items": [{"key": "ca-bundle.crt",
+                                         "path": "tls-ca-bundle.pem"}]}})
+        mounts = container.setdefault("volumeMounts", [])
+        if not any(vm.get("name") == "trusted-ca" for vm in mounts):
+            mounts.append({"name": "trusted-ca", "readOnly": True,
+                           "mountPath": "/etc/pki/tls/certs"})
+
+    def inject_oauth_proxy(self, nb):
+        """notebook_webhook.go:73 InjectOAuthProxy (idempotent)."""
+        name = m.name_of(nb)
+        spec = m.deep_get(nb, "spec", "template", "spec", default={})
+        containers = spec.setdefault("containers", [])
+        proxy = oauth_proxy_container(nb)
+        for i, c in enumerate(containers):
+            if c.get("name") == "oauth-proxy":
+                containers[i] = proxy
+                break
+        else:
+            containers.append(proxy)
+        volumes = spec.setdefault("volumes", [])
+        for vol in ({"name": "oauth-config",
+                     "secret": {"secretName": f"{name}-oauth-config"}},
+                    {"name": "tls-certificates",
+                     "secret": {"secretName": f"{name}-tls"}}):
+            if not any(v.get("name") == vol["name"] for v in volumes):
+                volumes.append(vol)
+        spec.setdefault("serviceAccountName", name)
+
+
+# ------------------------------------------------------------- controller
+
+class SecureNotebookReconciler(Reconciler):
+    name = "secure-notebook-controller"
+
+    def __init__(self, controller_namespace="kubeflow", ca_bundle=""):
+        self.controller_namespace = controller_namespace
+        self.ca_bundle = ca_bundle
+
+    def setup(self, builder):
+        builder.watch_for(NB_API, nbapi.KIND)
+        builder.watch_owned("route.openshift.io/v1", "Route", nbapi.KIND)
+        builder.watch_owned("networking.k8s.io/v1", "NetworkPolicy",
+                            nbapi.KIND)
+        builder.watch_owned("v1", "Service", nbapi.KIND)
+        builder.watch_owned("v1", "Secret", nbapi.KIND)
+
+    def reconcile(self, req):
+        nb = self.store.try_get(NB_API, nbapi.KIND, req.name,
+                                req.namespace)
+        if nb is None or m.deep_get(nb, "metadata", "deletionTimestamp"):
+            return Result()
+
+        # trusted CA bundle available in the namespace (:239)
+        ca = generate_ca_configmap(nb, self.ca_bundle)
+        existing = self.store.try_get("v1", "ConfigMap", CA_CONFIGMAP,
+                                      req.namespace)
+        if existing is None:
+            self.store.create(ca)
+
+        def owned(desired):
+            m.set_controller_reference(desired, nb)
+            helper.create_or_update(self.store, desired)
+
+        owned(generate_ctrl_network_policy(nb, self.controller_namespace))
+        if oauth_enabled(nb):
+            owned(generate_service_account(nb))
+            owned(generate_tls_service(nb))
+            if self.store.try_get("v1", "Secret",
+                                  f"{req.name}-oauth-config",
+                                  req.namespace) is None:
+                sec = generate_session_secret(nb)
+                m.set_controller_reference(sec, nb)
+                self.store.create(sec)
+            owned(generate_oauth_network_policy(nb))
+            owned(generate_route(nb, to_tls=True))
+        else:
+            owned(generate_route(nb, to_tls=False))
+
+        # perimeter exists → release the reconciliation lock (:112-140)
+        if m.annotations_of(nb).get(LOCK_ANNOTATION):
+            m.annotations_of(nb).pop(LOCK_ANNOTATION, None)
+            self.store.update(nb)
+        return Result()

@@ -1,0 +1,117 @@
+"""AdmissionReview HTTPS server — the in-cluster face of the mutating
+webhooks.
+
+In-process, webhooks run as store admission hooks (store.py). In a real
+cluster, the kube-apiserver POSTs an ``AdmissionReview`` and expects a
+JSONPatch response — this adapter wraps the same hook callables
+(PodDefaultWebhook, SecureNotebookWebhook) behind that wire contract
+(reference admission-webhook/main.go:706 serve/:762 HandleFunc, TLS via
+certwatcher — here the cert files are re-read on change, same effect).
+"""
+
+import base64
+import copy
+import json
+import logging
+import os
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+log = logging.getLogger("kubeflow_tpu.webhook_server")
+
+
+def json_patch(original, mutated):
+    """Top-level-field JSONPatch ops turning original into mutated."""
+    ops = []
+    for key, value in mutated.items():
+        if key not in original:
+            ops.append({"op": "add", "path": f"/{key}", "value": value})
+        elif original[key] != value:
+            ops.append({"op": "replace", "path": f"/{key}",
+                        "value": value})
+    for key in original:
+        if key not in mutated:
+            ops.append({"op": "remove", "path": f"/{key}"})
+    return ops
+
+
+def review_response(review, hook):
+    request = review.get("request") or {}
+    uid = request.get("uid", "")
+    obj = request.get("object") or {}
+    old = request.get("oldObject")
+    operation = request.get("operation", "CREATE")
+    response = {"uid": uid, "allowed": True}
+    try:
+        original = copy.deepcopy(obj)
+        mutated = hook(operation, obj, old)
+        if mutated is not None and mutated != original:
+            patch = json_patch(original, mutated)
+            response["patchType"] = "JSONPatch"
+            response["patch"] = base64.b64encode(
+                json.dumps(patch).encode()).decode()
+    except Exception as e:  # denial, not crash (main.go:745 semantics)
+        response["allowed"] = False
+        response["status"] = {"message": str(e)}
+    return {"apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview", "response": response}
+
+
+class WebhookServer:
+    """Route path → hook callable; serves HTTPS when cert files exist
+    (plain HTTP for tests/dev)."""
+
+    def __init__(self, hooks, cert_file=None, key_file=None):
+        self.hooks = dict(hooks)  # {"/apply-poddefault": hook, ...}
+        self.cert_file = cert_file or os.environ.get("TLS_CERT_FILE")
+        self.key_file = key_file or os.environ.get("TLS_KEY_FILE")
+        self._httpd = None
+
+    def _handler(self):
+        hooks = self.hooks
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                body = b'{"status":"ok"}'
+                self.send_response(
+                    200 if self.path in ("/healthz", "/readyz")
+                    else 404)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                hook = hooks.get(self.path)
+                if hook is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                review = json.loads(self.rfile.read(length) or b"{}")
+                out = json.dumps(review_response(review, hook)).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+        return Handler
+
+    def start(self, port=8443, host="0.0.0.0"):
+        self._httpd = ThreadingHTTPServer((host, port), self._handler())
+        if self.cert_file and os.path.exists(self.cert_file):
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self.cert_file, self.key_file)
+            self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
+                                                 server_side=True)
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        return self._httpd.server_address[1]
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()

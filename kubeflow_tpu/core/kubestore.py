@@ -1,0 +1,266 @@
+"""KubeStore: the real-cluster adapter with the ObjectStore surface.
+
+The same Manager/Reconciler code drives either backend (the reference
+gets this duality from controller-runtime's client + envtest; here the
+seam is the store interface):
+
+- in-process ``ObjectStore``   → unit/integration tests, local dev
+- ``KubeStore`` (this module)  → a real kube-apiserver, in-cluster
+
+Stdlib-only REST client: in-cluster config (service-account token + CA
+at /var/run/secrets/kubernetes.io/serviceaccount), or env overrides
+``KUBE_API_SERVER`` / ``KUBE_TOKEN`` / ``KUBE_CA_CERT`` for dev
+clusters. Watches are the apiserver's ``?watch=true`` chunked streams
+pumped into the same queue shape Manager expects; they auto-resume from
+the last resourceVersion on disconnect (client-go ListWatch semantics).
+"""
+
+import json
+import queue
+import ssl
+import threading
+import urllib.error
+import urllib.request
+
+from . import meta as m
+from .errors import (AlreadyExistsError, ConflictError, InvalidError,
+                     NotFoundError)
+from .store import WatchEvent
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+#: kind → REST plural for everything the framework touches
+PLURALS = {
+    "Notebook": "notebooks", "Profile": "profiles",
+    "Tensorboard": "tensorboards", "PodDefault": "poddefaults",
+    "TpuSlice": "tpuslices", "StudyJob": "studyjobs",
+    "Pod": "pods", "Service": "services", "Secret": "secrets",
+    "ConfigMap": "configmaps", "Event": "events",
+    "Namespace": "namespaces", "Node": "nodes",
+    "ServiceAccount": "serviceaccounts",
+    "PersistentVolumeClaim": "persistentvolumeclaims",
+    "ResourceQuota": "resourcequotas",
+    "StatefulSet": "statefulsets", "Deployment": "deployments",
+    "RoleBinding": "rolebindings",
+    "ClusterRoleBinding": "clusterrolebindings",
+    "NetworkPolicy": "networkpolicies",
+    "VirtualService": "virtualservices",
+    "AuthorizationPolicy": "authorizationpolicies",
+    "Gateway": "gateways", "Route": "routes",
+    "StorageClass": "storageclasses",
+}
+
+CLUSTER_SCOPED = {"Namespace", "Node", "Profile", "ClusterRoleBinding",
+                  "StorageClass"}
+
+
+class KubeStore:
+    def __init__(self, base_url=None, token=None, ca_cert=None,
+                 insecure=False):
+        import os
+        self.base_url = (base_url or os.environ.get("KUBE_API_SERVER")
+                         or "https://kubernetes.default.svc")
+        self.token = token or os.environ.get("KUBE_TOKEN")
+        if self.token is None and os.path.exists(f"{SA_DIR}/token"):
+            with open(f"{SA_DIR}/token") as f:
+                self.token = f.read().strip()
+        ca = ca_cert or os.environ.get("KUBE_CA_CERT")
+        if ca is None and os.path.exists(f"{SA_DIR}/ca.crt"):
+            ca = f"{SA_DIR}/ca.crt"
+        if insecure:
+            self._ctx = ssl.create_default_context()
+            self._ctx.check_hostname = False
+            self._ctx.verify_mode = ssl.CERT_NONE
+        else:
+            self._ctx = ssl.create_default_context(cafile=ca)
+        self._watches = []
+
+    # ------------------------------------------------------------ REST
+
+    def _path(self, api_version, kind, namespace=None, name=None,
+              subresource=None):
+        plural = PLURALS.get(kind, kind.lower() + "s")
+        if "/" in api_version:
+            base = f"/apis/{api_version}"
+        else:
+            base = f"/api/{api_version}"
+        parts = [base]
+        if namespace and kind not in CLUSTER_SCOPED:
+            parts.append(f"namespaces/{namespace}")
+        parts.append(plural)
+        if name:
+            parts.append(name)
+        if subresource:
+            parts.append(subresource)
+        return "/".join(parts)
+
+    def _request(self, method, path, body=None, stream=False,
+                 timeout=30):
+        headers = {"Accept": "application/json",
+                   "Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(self.base_url + path, data=data,
+                                     headers=headers, method=method)
+        try:
+            resp = urllib.request.urlopen(req, context=self._ctx,
+                                          timeout=timeout)
+        except urllib.error.HTTPError as e:
+            payload = e.read().decode(errors="replace")
+            if e.code == 404:
+                raise NotFoundError(payload)
+            if e.code == 409:
+                try:
+                    reason = json.loads(payload).get("reason")
+                except ValueError:
+                    reason = None
+                if reason == "AlreadyExists":
+                    raise AlreadyExistsError(payload)
+                raise ConflictError(payload)
+            if e.code in (400, 422):
+                raise InvalidError(payload)
+            raise
+        if stream:
+            return resp
+        return json.loads(resp.read() or b"{}")
+
+    # --------------------------------------------------- store surface
+
+    def get(self, api_version, kind, name, namespace=None):
+        return self._request(
+            "GET", self._path(api_version, kind, namespace, name))
+
+    def try_get(self, api_version, kind, name, namespace=None):
+        try:
+            return self.get(api_version, kind, name, namespace)
+        except NotFoundError:
+            return None
+
+    def list(self, api_version, kind, namespace=None,
+             label_selector=None, field_match=None):
+        path = self._path(api_version, kind, namespace)
+        if label_selector and "matchLabels" not in label_selector:
+            sel = ",".join(f"{k}={v}"
+                           for k, v in sorted(label_selector.items()))
+            path += f"?labelSelector={sel}"
+        items = self._request("GET", path).get("items", [])
+        for obj in items:
+            obj.setdefault("apiVersion", api_version)
+            obj.setdefault("kind", kind)
+        if field_match:
+            items = [o for o in items
+                     if all(m.deep_get(o, *p.split(".")) == v
+                            for p, v in field_match.items())]
+        return items
+
+    def create(self, obj):
+        api_version, kind = obj["apiVersion"], obj["kind"]
+        ns = m.namespace_of(obj)
+        return self._request(
+            "POST", self._path(api_version, kind, ns), body=obj)
+
+    def update(self, obj):
+        api_version, kind = obj["apiVersion"], obj["kind"]
+        return self._request(
+            "PUT", self._path(api_version, kind, m.namespace_of(obj),
+                              m.name_of(obj)), body=obj)
+
+    def update_status(self, obj):
+        api_version, kind = obj["apiVersion"], obj["kind"]
+        return self._request(
+            "PUT", self._path(api_version, kind, m.namespace_of(obj),
+                              m.name_of(obj), subresource="status"),
+            body=obj)
+
+    def delete(self, api_version, kind, name, namespace=None):
+        return self._request(
+            "DELETE", self._path(api_version, kind, namespace, name))
+
+    # ----------------------------------------------------------- watch
+
+    def watch(self, api_version, kind, namespace=None,
+              send_initial=True):
+        w = _KubeWatch(self, api_version, kind, namespace, send_initial)
+        self._watches.append(w)
+        return w
+
+
+class _KubeWatch:
+    """Queue-backed watch matching the in-process _Watch shape
+    (iterable, .q, .get(timeout), .stop()); resumes on disconnect."""
+
+    def __init__(self, store, api_version, kind, namespace,
+                 send_initial):
+        self.store = store
+        self.api_version = api_version
+        self.kind = kind
+        self.namespace = namespace
+        self.q = queue.Queue()
+        self.closed = False
+        self._rv = None
+        self._thread = threading.Thread(
+            target=self._run, args=(send_initial,), daemon=True,
+            name=f"kubewatch-{kind}")
+        self._thread.start()
+
+    def _run(self, send_initial):
+        path = self.store._path(self.api_version, self.kind,
+                                self.namespace)
+        listing = self.store._request("GET", path)
+        self._rv = m.deep_get(listing, "metadata", "resourceVersion")
+        if send_initial:
+            for obj in listing.get("items", []):
+                obj.setdefault("apiVersion", self.api_version)
+                obj.setdefault("kind", self.kind)
+                self.q.put(WatchEvent("ADDED", obj))
+        while not self.closed:
+            try:
+                self._stream(path)
+            except Exception:
+                if self.closed:
+                    return
+                import time
+                time.sleep(1)  # reconnect backoff, then re-list
+                try:
+                    listing = self.store._request("GET", path)
+                    self._rv = m.deep_get(listing, "metadata",
+                                          "resourceVersion")
+                except Exception:
+                    pass
+
+    def _stream(self, path):
+        sep = "&" if "?" in path else "?"
+        url = f"{path}{sep}watch=true"
+        if self._rv:
+            url += f"&resourceVersion={self._rv}"
+        resp = self.store._request("GET", url, stream=True,
+                                   timeout=330)
+        for line in resp:
+            if self.closed:
+                return
+            if not line.strip():
+                continue
+            ev = json.loads(line)
+            obj = ev.get("object") or {}
+            self._rv = m.deep_get(obj, "metadata", "resourceVersion",
+                                  default=self._rv)
+            if ev.get("type") in ("ADDED", "MODIFIED", "DELETED"):
+                self.q.put(WatchEvent(ev["type"], obj))
+
+    def __iter__(self):
+        while True:
+            ev = self.q.get()
+            if ev is None:
+                return
+            yield ev
+
+    def get(self, timeout=None):
+        ev = self.q.get(timeout=timeout)
+        if ev is None:
+            raise StopIteration
+        return ev
+
+    def stop(self):
+        self.closed = True
+        self.q.put(None)

@@ -152,8 +152,10 @@ def check_csrf(request):
 
 def issue_csrf_cookie(response):
     token = secrets.token_urlsafe(32)
-    response.headers["Set-Cookie"] = (
-        f"{CSRF_COOKIE}={token}; Path=/; SameSite=Strict")
+    attrs = f"{CSRF_COOKIE}={token}; Path=/; SameSite=Strict"
+    if os.environ.get("APP_SECURE_COOKIES", "true").lower() == "true":
+        attrs += "; Secure"
+    response.headers["Set-Cookie"] = attrs
     return token
 
 

@@ -110,6 +110,7 @@ def create_app(store, metrics_service=None):
 
     @app.get("/api/activities/<ns>")
     def activities(request, ns):
+        cb.ensure_authorized(store, request, "list", "events", ns)
         events = store.list("v1", "Event", ns)
         events.sort(key=lambda e: e.get("lastTimestamp") or "",
                     reverse=True)
@@ -119,6 +120,12 @@ def create_app(store, metrics_service=None):
     def get_metrics(request, metric):
         if not metrics.available():
             raise HTTPError(405, "metrics service not configured")
-        return metrics.query(metric, request.query.get("namespace"))
+        ns = request.query.get("namespace")
+        if ns:
+            cb.ensure_authorized(store, request, "list", "pods", ns)
+        elif request.user != kfam_lib.cluster_admin():
+            raise HTTPError(403, "cluster-wide metrics are "
+                                 "cluster-admin only")
+        return metrics.query(metric, ns)
 
     return app

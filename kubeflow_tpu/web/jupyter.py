@@ -370,9 +370,10 @@ def create_app(store):
     def post_notebook(request, ns):
         cb.ensure_authorized(store, request, "create", "notebooks", ns)
         nb, new_pvcs = form_to_notebook(request.json, ns, app.config)
-        for pvc in new_pvcs:
+        if new_pvcs:
             cb.ensure_authorized(store, request, "create",
                                  "persistentvolumeclaims", ns)
+        for pvc in new_pvcs:
             if store.try_get("v1", "PersistentVolumeClaim",
                              m.name_of(pvc), ns) is None:
                 store.create(pvc)

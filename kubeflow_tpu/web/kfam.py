@@ -101,6 +101,12 @@ def create_app(store):
         namespaces = ([namespace] if namespace else
                       [m.name_of(p) for p in
                        store.list(PROFILE_API, papi.KIND)])
+        # contributor emails are visible only to each namespace's
+        # owner/admin (or the cluster admin)
+        namespaces = [ns for ns in namespaces
+                      if is_owner_or_admin(store, request.user, ns)]
+        if namespace and not namespaces:
+            raise HTTPError(403, f"not owner or admin of {namespace}")
         for ns in namespaces:
             for rb in store.list(RBAC_API, "RoleBinding", ns):
                 role = m.deep_get(rb, "metadata", "annotations", "role")
@@ -116,18 +122,24 @@ def create_app(store):
                 })
         return {"bindings": bindings}
 
-    @app.post("/kfam/v1/bindings")
-    def create_binding(request):
-        body = request.json
+    def _binding_args(body):
         user = m.deep_get(body, "user", "name")
         ns = body.get("referredNamespace")
-        role_ref = m.deep_get(body, "RoleRef", "name", default="edit")
-        role_key = next((k for k, v in _ROLES.items()
-                         if v == role_ref or k == role_ref), "edit")
-        cluster_role = _ROLES[role_key]
         if not user or not ns:
             raise HTTPError(400, "user.name and referredNamespace "
                                  "are required")
+        role_ref = m.deep_get(body, "RoleRef", "name", default="edit")
+        role_key = next((k for k, v in _ROLES.items()
+                         if v == role_ref or k == role_ref), None)
+        if role_key is None:
+            raise HTTPError(
+                400, f"unknown RoleRef.name {role_ref!r}; expected one "
+                     f"of {sorted(_ROLES) + sorted(_ROLES.values())}")
+        return user, ns, role_key, _ROLES[role_key]
+
+    @app.post("/kfam/v1/bindings")
+    def create_binding(request):
+        user, ns, role_key, cluster_role = _binding_args(request.json)
         if not is_owner_or_admin(store, request.user, ns):
             raise HTTPError(
                 403, f"user {request.user} is neither owner of "
@@ -150,13 +162,7 @@ def create_app(store):
 
     @app.delete("/kfam/v1/bindings")
     def delete_binding(request):
-        body = request.json
-        user = m.deep_get(body, "user", "name")
-        ns = body.get("referredNamespace")
-        role_ref = m.deep_get(body, "RoleRef", "name", default="edit")
-        role_key = next((k for k, v in _ROLES.items()
-                         if v == role_ref or k == role_ref), "edit")
-        cluster_role = _ROLES[role_key]
+        user, ns, role_key, cluster_role = _binding_args(request.json)
         if not is_owner_or_admin(store, request.user, ns):
             raise HTTPError(403, "not owner or admin")
         name = binding_name(user, cluster_role)

@@ -1,0 +1,149 @@
+"""Deployment-infrastructure tests: AdmissionReview wire contract,
+auth-proxy sidecar, entrypoint registry vs manifests."""
+
+import base64
+import importlib.util
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.controllers import admission, webhook_server
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestWebhookServer:
+    def test_json_patch_ops(self):
+        original = {"a": 1, "b": {"x": 1}, "c": 3}
+        mutated = {"a": 1, "b": {"x": 2}, "d": 4}
+        ops = webhook_server.json_patch(original, mutated)
+        assert {"op": "replace", "path": "/b",
+                "value": {"x": 2}} in ops
+        assert {"op": "add", "path": "/d", "value": 4} in ops
+        assert {"op": "remove", "path": "/c"} in ops
+
+    def test_admission_review_round_trip(self, store):
+        store.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "PodDefault",
+            "metadata": {"name": "add-env", "namespace": "ns1"},
+            "spec": {"selector": {"matchLabels": {"inject": "yes"}},
+                     "env": [{"name": "FOO", "value": "bar"}]}})
+        hook = admission.PodDefaultWebhook(store)
+        server = webhook_server.WebhookServer({"/apply-poddefault": hook})
+        port = server.start(port=0, host="127.0.0.1")
+        try:
+            review = {"apiVersion": "admission.k8s.io/v1",
+                      "kind": "AdmissionReview",
+                      "request": {
+                          "uid": "u1", "operation": "CREATE",
+                          "object": {
+                              "apiVersion": "v1", "kind": "Pod",
+                              "metadata": {"name": "p", "namespace":
+                                           "ns1",
+                                           "labels": {"inject": "yes"}},
+                              "spec": {"containers": [{"name": "c"}]},
+                          }}}
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/apply-poddefault",
+                data=json.dumps(review).encode(),
+                headers={"Content-Type": "application/json"})
+            resp = json.load(urllib.request.urlopen(req))
+            r = resp["response"]
+            assert r["uid"] == "u1" and r["allowed"] is True
+            patch = json.loads(base64.b64decode(r["patch"]))
+            spec_ops = [op for op in patch if op["path"] == "/spec"]
+            assert spec_ops, patch
+            env = spec_ops[0]["value"]["containers"][0]["env"]
+            assert {"name": "FOO", "value": "bar"} in env
+            # healthz for the probe
+            ok = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz"))
+            assert ok["status"] == "ok"
+        finally:
+            server.stop()
+
+
+def _load_proxy():
+    spec = importlib.util.spec_from_file_location(
+        "auth_proxy", os.path.join(REPO, "images", "auth-proxy",
+                                   "proxy.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+class TestAuthProxy:
+    @pytest.fixture()
+    def rig(self, monkeypatch):
+        from http.server import BaseHTTPRequestHandler
+        from http.server import ThreadingHTTPServer
+        import threading
+
+        class Upstream(BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = json.dumps(
+                    {"path": self.path,
+                     "user": self.headers.get("X-Forwarded-User")}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        upstream = ThreadingHTTPServer(("127.0.0.1", 0), Upstream)
+        threading.Thread(target=upstream.serve_forever,
+                         daemon=True).start()
+        proxy_mod = _load_proxy()
+        proxy_mod.UPSTREAM = f"http://127.0.0.1:{upstream.server_address[1]}"
+        proxy_mod.ALLOWED_USERS = ["alice@example.com"]
+        proxy = proxy_mod.serve(port=0, background=True)
+        yield proxy_mod, proxy.server_address[1]
+        proxy.shutdown()
+        upstream.shutdown()
+
+    def test_healthz_open(self, rig):
+        _, port = rig
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/oauth/healthz")
+        assert resp.status == 200
+
+    def test_missing_header_401(self, rig):
+        _, port = rig
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/lab")
+        assert e.value.code == 401
+
+    def test_wrong_user_403(self, rig):
+        _, port = rig
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/lab",
+            headers={"kubeflow-userid": "mallory@example.com"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 403
+
+    def test_allowed_user_proxied_with_identity(self, rig):
+        _, port = rig
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/lab/tree",
+            headers={"kubeflow-userid": "alice@example.com"})
+        body = json.load(urllib.request.urlopen(req))
+        assert body == {"path": "/lab/tree",
+                        "user": "alice@example.com"}
+
+
+class TestCmdRegistry:
+    def test_every_manifest_component_has_an_entrypoint(self):
+        from kubeflow_tpu import cmd
+        manifest_dirs = {
+            d for d in os.listdir(os.path.join(REPO, "manifests"))
+            if os.path.isdir(os.path.join(REPO, "manifests", d))
+            and d not in ("crds", "istio")}
+        missing = manifest_dirs - set(cmd.COMPONENTS)
+        assert not missing, f"no entrypoint for {missing}"

@@ -1,0 +1,140 @@
+"""Secure-notebook (ODH-equivalent) controller + webhook tests.
+
+Reference specs: odh-notebook-controller notebook_controller_test.go:43
+("The Openshift Notebook controller": Route create/reconcile/recreate/
+delete :88-134, trusted-CA mount :162, network policies :307-330) and
+notebook_webhook.go behaviors.
+"""
+
+import pytest
+
+from kubeflow_tpu.api import notebook as nbapi
+from kubeflow_tpu.controllers import secure_notebook as sn
+from kubeflow_tpu.controllers import workload_runtime
+from kubeflow_tpu.core import meta as m
+
+NB_API = "kubeflow.org/v1beta1"
+
+
+def make_notebook(name="nb", ns="default", oauth=False, image=None):
+    nb = nbapi.new(name, ns, {"containers": [{
+        "name": name, "image": image or "jupyter-jax-tpu:latest"}]})
+    if oauth:
+        m.set_annotation(nb, sn.OAUTH_ANNOTATION, "true")
+    return nb
+
+
+@pytest.fixture()
+def rig(store, manager, clean_env):
+    sn.SecureNotebookWebhook(store).install()
+    manager.add(sn.SecureNotebookReconciler(ca_bundle="FAKE-CA"))
+    manager.add(workload_runtime.StatefulSetReconciler())
+    manager.start_sync()
+    return store, manager
+
+
+class TestWebhook:
+    def test_create_sets_lock_and_ca_mount(self, rig):
+        store, manager = rig
+        store.create(make_notebook())
+        nb = store.get(NB_API, nbapi.KIND, "nb", "default")
+        spec = m.deep_get(nb, "spec", "template", "spec")
+        assert any(v["name"] == "trusted-ca"
+                   for v in spec.get("volumes", []))
+        mounts = spec["containers"][0]["volumeMounts"]
+        assert any(vm["name"] == "trusted-ca" for vm in mounts)
+        # lock released after reconcile builds the perimeter
+        manager.run_sync()
+        nb = store.get(NB_API, nbapi.KIND, "nb", "default")
+        assert sn.LOCK_ANNOTATION not in m.annotations_of(nb)
+
+    def test_image_resolved_from_registry_configmap(self, rig):
+        store, manager = rig
+        store.create({"apiVersion": "v1", "kind": "ConfigMap",
+                      "metadata": {"name": "notebook-image-registry",
+                                   "namespace": "kubeflow"},
+                      "data": {"jupyter-jax-tpu:latest":
+                               "registry.local/jax-tpu@sha256:abc"}})
+        store.create(make_notebook(name="nb2"))
+        nb = store.get(NB_API, nbapi.KIND, "nb2", "default")
+        image = m.deep_get(nb, "spec", "template", "spec",
+                           "containers")[0]["image"]
+        assert image == "registry.local/jax-tpu@sha256:abc"
+
+    def test_oauth_sidecar_injected_idempotently(self, rig):
+        store, manager = rig
+        store.create(make_notebook(name="nb3", oauth=True))
+        nb = store.get(NB_API, nbapi.KIND, "nb3", "default")
+        spec = m.deep_get(nb, "spec", "template", "spec")
+        proxies = [c for c in spec["containers"]
+                   if c["name"] == "oauth-proxy"]
+        assert len(proxies) == 1
+        assert spec["serviceAccountName"] == "nb3"
+        # update round-trips without duplicating the sidecar
+        m.set_annotation(nb, "touch", "1")
+        store.update(nb)
+        nb = store.get(NB_API, nbapi.KIND, "nb3", "default")
+        proxies = [c for c in m.deep_get(nb, "spec", "template", "spec",
+                                         "containers")
+                   if c["name"] == "oauth-proxy"]
+        assert len(proxies) == 1
+
+
+class TestReconciler:
+    def test_oauth_objects_created(self, rig):
+        store, manager = rig
+        store.create(make_notebook(name="nb4", oauth=True))
+        manager.run_sync()
+        assert store.try_get("v1", "ServiceAccount", "nb4", "default")
+        assert store.try_get("v1", "Service", "nb4-tls", "default")
+        assert store.try_get("v1", "Secret", "nb4-oauth-config",
+                             "default")
+        route = store.get("route.openshift.io/v1", "Route", "nb4",
+                          "default")
+        assert route["spec"]["tls"]["termination"] == "reencrypt"
+        assert route["spec"]["to"]["name"] == "nb4-tls"
+        for np_name in ("nb4-ctrl-np", "nb4-oauth-np"):
+            assert store.try_get("networking.k8s.io/v1",
+                                 "NetworkPolicy", np_name, "default")
+
+    def test_plain_route_without_oauth(self, rig):
+        store, manager = rig
+        store.create(make_notebook(name="nb5"))
+        manager.run_sync()
+        route = store.get("route.openshift.io/v1", "Route", "nb5",
+                          "default")
+        assert route["spec"]["tls"]["termination"] == "edge"
+        assert route["spec"]["to"]["name"] == "nb5"
+        assert store.try_get("v1", "Service", "nb5-tls",
+                             "default") is None
+
+    def test_route_recreated_when_deleted(self, rig):
+        # "Should recreate the Route when deleted" (:121)
+        store, manager = rig
+        store.create(make_notebook(name="nb6"))
+        manager.run_sync()
+        store.delete("route.openshift.io/v1", "Route", "nb6", "default")
+        manager.run_sync()
+        assert store.try_get("route.openshift.io/v1", "Route", "nb6",
+                             "default") is not None
+
+    def test_ca_configmap_mirrored_into_namespace(self, rig):
+        store, manager = rig
+        store.create(make_notebook(name="nb7"))
+        manager.run_sync()
+        cm = store.get("v1", "ConfigMap", sn.CA_CONFIGMAP, "default")
+        assert cm["data"]["ca-bundle.crt"] == "FAKE-CA"
+
+    def test_session_secret_not_regenerated(self, rig):
+        store, manager = rig
+        store.create(make_notebook(name="nb8", oauth=True))
+        manager.run_sync()
+        first = store.get("v1", "Secret", "nb8-oauth-config",
+                          "default")["data"]["cookie_secret"]
+        nb = store.get(NB_API, nbapi.KIND, "nb8", "default")
+        m.set_annotation(nb, "touch", "1")
+        store.update(nb)
+        manager.run_sync()
+        second = store.get("v1", "Secret", "nb8-oauth-config",
+                           "default")["data"]["cookie_secret"]
+        assert first == second

@@ -302,7 +302,10 @@ class TestDashboard:
     def test_metrics_service(self, platform):
         store, _ = platform
         c = client(dashboard.create_app(store))
-        series = c.get("/api/metrics/podcount").json
+        # cluster-wide metrics are cluster-admin only
+        assert c.get("/api/metrics/podcount").status == 403
+        series = c.get(
+            "/api/metrics/podcount?namespace=team-a").json
         assert series[0]["value"] == 0
 
 
