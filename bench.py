@@ -10,7 +10,8 @@ GPU-era Kubeflow notebook pod (V100, the reference's CUDA image target)
 delivers. Beating 1.0 means the TPU-native stack beats the stack the
 reference platform was built to schedule.
 
-Flags via env: BENCH_MODEL=resnet50|lm, BENCH_STEPS, BENCH_BATCH.
+Flags via env: BENCH_MODEL=resnet50|lm|bert|serving|study,
+BENCH_STEPS, BENCH_BATCH (and BENCH_REMAT for bert).
 """
 
 import json
@@ -124,15 +125,138 @@ def _peak_flops():
     return 197e12
 
 
+def bench_bert(steps, batch):
+    """BASELINE config #5: BERT-base pretraining throughput."""
+    import numpy as np
+
+    from kubeflow_tpu.compute.models import bert
+
+    remat = os.environ.get("BENCH_REMAT", "false").lower() == "true"
+    cfg = bert.Config(remat=remat)  # bert-base (fits HBM without remat)
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=-1))
+    opt = train.make_optimizer(learning_rate=1e-4, warmup_steps=10,
+                               total_steps=100_000)
+    state = train.init_state(
+        lambda k: bert.init_params(cfg, k), opt, mesh,
+        bert.logical_axes(cfg), jax.random.PRNGKey(0))
+    step = train.make_train_step(
+        train.plain_loss(bert.loss_fn, cfg), opt, mesh)
+    data = bert.mlm_batch(np.random.default_rng(0), batch, cfg)
+    for _ in range(3):
+        state, metrics = step(state, data)
+        _drain(metrics)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, data)
+    _drain(metrics)
+    dt = time.perf_counter() - t0
+    tps = steps * batch * cfg.max_seq / dt
+    return {"metric": "bert_base_pretrain_tokens_per_sec",
+            "value": round(tps, 0), "unit": "tokens/sec",
+            "vs_baseline": round(tps / LM_BASELINE_TOKENS, 3),
+            "detail": {"params": bert.param_count(cfg), "batch": batch,
+                       "seq": cfg.max_seq,
+                       "samples_per_sec": round(steps * batch / dt, 1),
+                       "step_ms": round(1000 * dt / steps, 2),
+                       "mfu": round(tps * bert.flops_per_token(cfg)
+                                    / _peak_flops(), 3)}}
+
+
+def bench_serving(steps, batch):
+    """BASELINE config #3: REST predict path (test_tf_serving contract).
+    ResNet-50 eval over HTTP on localhost."""
+    import json as _json
+    import urllib.request
+
+    import numpy as np
+
+    from kubeflow_tpu.compute import serving
+    from kubeflow_tpu.compute.models import resnet
+
+    cfg = resnet.Config(depth=50, n_classes=1000, dtype="bfloat16")
+    params, stats = resnet.init_params(cfg, jax.random.PRNGKey(0))
+
+    def predict(x):
+        logits, _ = resnet.apply(params, stats, x.astype(jnp.bfloat16),
+                                 cfg, train=False)
+        return jax.nn.softmax(logits, axis=-1).astype(jnp.float32)
+
+    server = serving.ModelServer()
+    server.register("resnet50", predict)
+    port = server.start(port=0, host="127.0.0.1")
+    url = f"http://127.0.0.1:{port}/v1/models/resnet50:predict"
+    instances = np.random.default_rng(0).standard_normal(
+        (batch, 224, 224, 3)).astype(np.float32).tolist()
+    payload = _json.dumps({"instances": instances}).encode()
+
+    def post():
+        req = urllib.request.Request(
+            url, data=payload,
+            headers={"Content-Type": "application/json"})
+        return _json.load(urllib.request.urlopen(req))
+
+    post(); post()  # compile + warm
+    lat = []
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        t1 = time.perf_counter()
+        post()
+        lat.append(time.perf_counter() - t1)
+    dt = time.perf_counter() - t0
+    server.stop()
+    lat.sort()
+    pps = steps * batch / dt
+    return {"metric": "resnet50_serving_predictions_per_sec",
+            "value": round(pps, 1), "unit": "predictions/sec",
+            "vs_baseline": 1.0,
+            "detail": {"batch": batch,
+                       "p50_ms": round(1000 * lat[len(lat) // 2], 1),
+                       "p99_ms": round(1000 * lat[min(
+                           len(lat) - 1, int(len(lat) * 0.99))], 1),
+                       "max_ms": round(1000 * lat[-1], 1)}}
+
+
+def bench_study(steps, batch):
+    """BASELINE config #4: StudyJob trial throughput, one trial per chip
+    (this host has one chip; trials/hr scales linearly per chip)."""
+    from kubeflow_tpu.compute import trial as trial_lib
+
+    n_trials = max(4, min(steps, 8))
+    t0 = time.perf_counter()
+    for i in range(n_trials):
+        os.environ["TRIAL_PARAMETERS"] = json.dumps(
+            {"lr": 10 ** (-2 - i % 3), "hidden": 64 * (1 + i % 2)})
+        trial_lib.run_mnist_trial(steps=30)
+    dt = time.perf_counter() - t0
+    os.environ.pop("TRIAL_PARAMETERS", None)
+    per_hr = n_trials / dt * 3600
+    return {"metric": "studyjob_trials_per_hour_per_chip",
+            "value": round(per_hr, 0), "unit": "trials/hr",
+            "vs_baseline": 1.0,
+            "detail": {"trials": n_trials,
+                       "trial_s": round(dt / n_trials, 2),
+                       "v5e32_extrapolated_trials_per_hr":
+                           round(per_hr * 32, 0)}}
+
+
+BENCHES = {
+    "resnet50": (bench_resnet, 256),
+    "lm": (bench_lm, 8),
+    "bert": (bench_bert, 16),
+    "serving": (bench_serving, 8),
+    "study": (bench_study, 8),
+}
+
+
 def main():
     model = os.environ.get("BENCH_MODEL", "resnet50")
     steps = int(os.environ.get("BENCH_STEPS", "20"))
-    if model == "lm":
-        batch = int(os.environ.get("BENCH_BATCH", "8"))
-        result = bench_lm(steps, batch)
-    else:
-        batch = int(os.environ.get("BENCH_BATCH", "256"))
-        result = bench_resnet(steps, batch)
+    if model not in BENCHES:
+        raise SystemExit(f"unknown BENCH_MODEL {model!r}; expected one "
+                         f"of {sorted(BENCHES)}")
+    fn, default_batch = BENCHES[model]
+    batch = int(os.environ.get("BENCH_BATCH", str(default_batch)))
+    result = fn(steps, batch)
     print(json.dumps(result))
 
 

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# reference gh-actions/install_cert_manager.sh (v1.10.1 → current)
+set -euo pipefail
+CM_VERSION="${CM_VERSION:-v1.14.4}"
+kubectl apply -f \
+  "https://github.com/cert-manager/cert-manager/releases/download/${CM_VERSION}/cert-manager.yaml"
+kubectl -n cert-manager wait deploy --all \
+  --for=condition=Available --timeout=300s

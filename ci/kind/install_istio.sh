@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# reference gh-actions/install_istio.sh (v1.16 → current LTS)
+set -euo pipefail
+ISTIO_VERSION="${ISTIO_VERSION:-1.20.3}"
+curl -fsSL https://istio.io/downloadIstio | \
+  ISTIO_VERSION="${ISTIO_VERSION}" sh -
+"istio-${ISTIO_VERSION}/bin/istioctl" install -y --set profile=minimal
+kubectl -n istio-system wait deploy/istiod --for=condition=Available \
+  --timeout=300s

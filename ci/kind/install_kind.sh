@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Bootstrap a KinD cluster for E2E (reference gh-actions/install_kind.sh)
+set -euo pipefail
+
+KIND_VERSION="${KIND_VERSION:-v0.22.0}"
+CLUSTER_NAME="${CLUSTER_NAME:-kubeflow-tpu}"
+HERE="$(cd "$(dirname "$0")" && pwd)"
+
+if ! command -v kind >/dev/null; then
+  curl -fsSLo /usr/local/bin/kind \
+    "https://kind.sigs.k8s.io/dl/${KIND_VERSION}/kind-linux-amd64"
+  chmod +x /usr/local/bin/kind
+fi
+
+kind create cluster --name "${CLUSTER_NAME}" \
+  --config "${HERE}/kind-config.yaml" --wait 120s
+
+# advertise fake TPU capacity on the worker for /api/accelerators tests
+WORKER="$(kubectl get nodes -o name | grep worker | head -1)"
+kubectl patch "${WORKER}" --subresource=status --type=merge \
+  -p '{"status":{"capacity":{"google.com/tpu":"4"}}}' || true
+
+kubectl apply -k manifests/crds
+echo "kind cluster ${CLUSTER_NAME} ready"

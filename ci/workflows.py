@@ -1,0 +1,108 @@
+"""CI workflow builders — Argo Workflow YAML generators.
+
+Reference: py/kubeflow/kubeflow/ci (SURVEY.md §2#26): ArgoTestBuilder
+(workflow_utils.py:30) builds per-component DAGs of checkout → unit
+tests → kaniko image builds (no-push for PR validation). Same model:
+
+    python -m ci.workflows notebook-controller > wf.yaml
+
+Components and their images come from the manifests generator, so CI
+coverage can't drift from what ships.
+"""
+
+import sys
+
+import yaml
+
+CHECKOUT_IMAGE = "alpine/git:2.43.0"
+PYTHON_IMAGE = "python:3.12-slim"
+KANIKO_IMAGE = "gcr.io/kaniko-project/executor:v1.21.0"
+
+#: component → (test command, image build context)
+COMPONENTS = {
+    "notebook-controller": ("python -m pytest tests/ -q -k 'notebook or culling'", "."),
+    "secure-notebook-controller": ("python -m pytest tests/test_secure_notebook.py -q", "."),
+    "profile-controller": ("python -m pytest tests/test_profile_controller.py -q", "."),
+    "tensorboard-controller": ("python -m pytest tests/test_tensorboard_controller.py -q", "."),
+    "tpuslice-controller": ("python -m pytest tests/test_tpuslice_controller.py -q", "."),
+    "admission-webhook": ("python -m pytest tests/test_admission_webhook.py -q", "."),
+    "web-apps": ("python -m pytest tests/test_web_apps.py -q", "."),
+    "compute": ("python -m pytest tests/ -q -k 'compute'", "."),
+    "notebook-servers": (None, "images"),
+}
+
+
+def _task(name, template, dependencies=()):
+    task = {"name": name, "template": template}
+    if dependencies:
+        task["dependencies"] = list(dependencies)
+    return task
+
+
+def build_workflow(component, repo_url="https://example.com/repo.git",
+                   branch="main", no_push=True):
+    """One E2E DAG per component (ArgoTestBuilder._build_workflow
+    equivalent): checkout → unit tests → image build."""
+    test_cmd, context = COMPONENTS[component]
+    templates = [
+        {"name": "checkout",
+         "container": {"image": CHECKOUT_IMAGE,
+                       "command": ["git", "clone", "--depth=1",
+                                   f"--branch={branch}", repo_url,
+                                   "/src"],
+                       "volumeMounts": [{"name": "src",
+                                         "mountPath": "/src"}]}},
+    ]
+    tasks = [_task("checkout", "checkout")]
+    if test_cmd:
+        templates.append(
+            {"name": "unit-tests",
+             "container": {"image": PYTHON_IMAGE,
+                           "workingDir": "/src",
+                           "command": ["sh", "-c",
+                                       "pip install -q pytest pyyaml "
+                                       "optax flax && " + test_cmd],
+                           "env": [{"name": "JAX_PLATFORMS",
+                                    "value": "cpu"}],
+                           "volumeMounts": [{"name": "src",
+                                             "mountPath": "/src"}]}})
+        tasks.append(_task("unit-tests", "unit-tests", ["checkout"]))
+    kaniko_args = [f"--context=/src/{context}",
+                   f"--destination=kubeflowtpu/{component}:$(TAG)"]
+    if no_push:
+        kaniko_args.append("--no-push")
+    templates.append(
+        {"name": "build-image",
+         "container": {"image": KANIKO_IMAGE, "args": kaniko_args,
+                       "volumeMounts": [{"name": "src",
+                                         "mountPath": "/src"}]}})
+    tasks.append(_task("build-image", "build-image",
+                       ["unit-tests"] if test_cmd else ["checkout"]))
+
+    return {
+        "apiVersion": "argoproj.io/v1alpha1",
+        "kind": "Workflow",
+        "metadata": {"generateName": f"{component}-ci-"},
+        "spec": {
+            "entrypoint": "e2e",
+            "volumeClaimTemplates": [{
+                "metadata": {"name": "src"},
+                "spec": {"accessModes": ["ReadWriteOnce"],
+                         "resources": {"requests": {
+                             "storage": "2Gi"}}}}],
+            "templates": templates + [
+                {"name": "e2e", "dag": {"tasks": tasks}}],
+        },
+    }
+
+
+def main(argv):
+    if not argv or argv[0] not in COMPONENTS:
+        raise SystemExit("usage: python -m ci.workflows <component>\n"
+                         "components: " + ", ".join(sorted(COMPONENTS)))
+    yaml.safe_dump(build_workflow(argv[0]), sys.stdout,
+                   sort_keys=False)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# reference conformance/1.5/report-pod.sh: wait for the run, fetch report
+set -euo pipefail
+kubectl -n conformance-test wait pod/conformance-run \
+  --for=jsonpath='{.status.phase}'=Succeeded --timeout=300s || true
+kubectl -n conformance-test get configmap conformance-report \
+  -o jsonpath='{.data.report\.xml}' > /tmp/report.xml
+echo "report written to /tmp/report.xml"
+kubectl -n conformance-test logs conformance-run

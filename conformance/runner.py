@@ -1,0 +1,140 @@
+"""In-cluster conformance runner (reference conformance/1.5
+kfp-conformance.yaml + report-pod.sh shape).
+
+Exercises the platform's public contracts against the cluster it runs
+in (or the in-process store with --dev for CI smoke) and emits a junit
+XML report.
+"""
+
+import os
+import sys
+import time
+import xml.etree.ElementTree as ET
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class Suite:
+    def __init__(self, name):
+        self.name = name
+        self.cases = []
+
+    def case(self, name, fn):
+        t0 = time.perf_counter()
+        err = None
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — report, don't abort
+            err = f"{type(e).__name__}: {e}"
+        self.cases.append((name, time.perf_counter() - t0, err))
+
+    def junit(self):
+        suite = ET.Element(
+            "testsuite", name=self.name,
+            tests=str(len(self.cases)),
+            failures=str(sum(1 for *_, e in self.cases if e)))
+        for name, dt, err in self.cases:
+            case = ET.SubElement(suite, "testcase", name=name,
+                                 time=f"{dt:.3f}")
+            if err:
+                ET.SubElement(case, "failure", message=err)
+        return ET.tostring(suite, encoding="unicode")
+
+    @property
+    def failed(self):
+        return [name for name, _, e in self.cases if e]
+
+
+def run(store, dev=False):
+    from kubeflow_tpu.core import meta as m
+
+    suite = Suite("kubeflow-tpu-conformance")
+    ns = "conformance-test"
+
+    def notebooks_crd():
+        nb = {"apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+              "metadata": {"name": "conf-nb", "namespace": ns},
+              "spec": {"template": {"spec": {"containers": [
+                  {"name": "conf-nb", "image": "img"}]}}}}
+        store.create(nb)
+        got = store.get("kubeflow.org/v1beta1", "Notebook", "conf-nb",
+                        ns)
+        assert m.name_of(got) == "conf-nb"
+        store.delete("kubeflow.org/v1beta1", "Notebook", "conf-nb", ns)
+
+    def notebook_version_conversion():
+        nb = {"apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+              "metadata": {"name": "conf-conv", "namespace": ns},
+              "spec": {"template": {"spec": {"containers": [
+                  {"name": "conf-conv", "image": "img"}]}}}}
+        store.create(nb)
+        got = store.get("kubeflow.org/v1alpha1", "Notebook",
+                        "conf-conv", ns)
+        assert got["apiVersion"] == "kubeflow.org/v1alpha1"
+        store.delete("kubeflow.org/v1", "Notebook", "conf-conv", ns)
+
+    def poddefault_crd():
+        pd = {"apiVersion": "kubeflow.org/v1alpha1",
+              "kind": "PodDefault",
+              "metadata": {"name": "conf-pd", "namespace": ns},
+              "spec": {"selector": {"matchLabels": {"x": "y"}},
+                       "env": [{"name": "A", "value": "1"}]}}
+        store.create(pd)
+        store.delete("kubeflow.org/v1alpha1", "PodDefault", "conf-pd",
+                     ns)
+
+    def tpuslice_crd():
+        ts = {"apiVersion": "kubeflow.org/v1alpha1", "kind": "TpuSlice",
+              "metadata": {"name": "conf-ts", "namespace": ns},
+              "spec": {"accelerator": "tpu-v5-lite-podslice",
+                       "topology": "2x2",
+                       "template": {"spec": {"containers": [
+                           {"name": "w", "image": "img"}]}}}}
+        store.create(ts)
+        store.delete("kubeflow.org/v1alpha1", "TpuSlice", "conf-ts", ns)
+
+    if dev:
+        # namespace exists implicitly in the in-process store
+        pass
+    else:
+        try:
+            store.create({"apiVersion": "v1", "kind": "Namespace",
+                          "metadata": {"name": ns}})
+        except Exception:
+            pass
+
+    suite.case("notebook-crd-roundtrip", notebooks_crd)
+    suite.case("notebook-version-conversion", notebook_version_conversion)
+    suite.case("poddefault-crd", poddefault_crd)
+    suite.case("tpuslice-crd", tpuslice_crd)
+    return suite
+
+
+def main(argv):
+    dev = "--dev" in argv
+    if dev:
+        from kubeflow_tpu import api
+        from kubeflow_tpu.core import ObjectStore
+        store = ObjectStore()
+        api.register_all(store)
+    else:
+        from kubeflow_tpu.core.kubestore import KubeStore
+        store = KubeStore()
+    suite = run(store, dev=dev)
+    report = suite.junit()
+    print(report)
+    if not dev:
+        try:
+            store.create({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "conformance-report",
+                             "namespace": "conformance-test"},
+                "data": {"report.xml": report}})
+        except Exception:
+            pass
+    return 1 if suite.failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

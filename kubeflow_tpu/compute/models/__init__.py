@@ -12,4 +12,4 @@ framework: every transform (jit/grad/scan/shard_map) composes without
 indirection, and the partition layout lives in one visible tree.
 """
 
-from . import mlp, resnet, transformer  # noqa: F401
+from . import bert, mlp, resnet, transformer  # noqa: F401

@@ -1,0 +1,246 @@
+"""BERT — bidirectional encoder with MLM pretraining loss.
+
+BASELINE.md config #5 is "BERT-base pretraining, multi-worker JAX pjit
+over ICI"; this is that model. Same functional conventions and logical
+axes as transformer.py, differences: bidirectional (non-causal) flash
+attention, learned position embeddings, GELU MLP, LayerNorm (not RMS),
+tied MLM head over the embedding table.
+
+bert-base = Config(vocab_size=30522, d_model=768, n_layers=12,
+n_heads=12, d_ff=3072, max_seq=512).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import attention as attn_lib
+from .. import sharding
+from ..ops import flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq: int = 512
+    type_vocab: int = 2
+    dtype: str = "bfloat16"
+    attention: str = "flash"    # dense | flash | ring
+    remat: bool = True
+    scan_layers: bool = True
+    ln_eps: float = 1e-12
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def _layer_shapes(c):
+    d, h, hd, f = c.d_model, c.n_heads, c.head_dim, c.d_ff
+    return {
+        "ln1_scale": ((d,), (None,)), "ln1_bias": ((d,), (None,)),
+        "wq": ((d, h, hd), ("embed", "heads", None)),
+        "wk": ((d, h, hd), ("embed", "heads", None)),
+        "wv": ((d, h, hd), ("embed", "heads", None)),
+        "bq": ((h, hd), ("heads", None)),
+        "bk": ((h, hd), ("heads", None)),
+        "bv": ((h, hd), ("heads", None)),
+        "wo": ((h, hd, d), ("heads", None, "embed")),
+        "bo": ((d,), (None,)),
+        "ln2_scale": ((d,), (None,)), "ln2_bias": ((d,), (None,)),
+        "w_up": ((d, f), ("embed", "mlp")),
+        "b_up": ((f,), ("mlp",)),
+        "w_down": ((f, d), ("mlp", "embed")),
+        "b_down": ((d,), (None,)),
+    }
+
+
+def logical_axes(config):
+    prefix = ("layers",) if config.scan_layers else ()
+    layers = {k: prefix + ax for k, (_, ax) in
+              _layer_shapes(config).items()}
+    if not config.scan_layers:
+        layers = [layers] * config.n_layers
+    return {
+        "embed": ("vocab", "embed"),
+        "pos_embed": (None, "embed"),
+        "type_embed": (None, "embed"),
+        "embed_ln_scale": (None,), "embed_ln_bias": (None,),
+        "layers": layers,
+        "mlm_ln_scale": (None,), "mlm_ln_bias": (None,),
+        "mlm_dense": ("embed", None),
+        "mlm_bias": ("vocab",),
+    }
+
+
+def init_params(config, key):
+    c = config
+    keys = jax.random.split(key, 8)
+    params = {
+        "embed": jax.random.normal(
+            keys[0], (c.vocab_size, c.d_model), jnp.float32) * 0.02,
+        "pos_embed": jax.random.normal(
+            keys[1], (c.max_seq, c.d_model), jnp.float32) * 0.02,
+        "type_embed": jax.random.normal(
+            keys[2], (c.type_vocab, c.d_model), jnp.float32) * 0.02,
+        "embed_ln_scale": jnp.ones((c.d_model,)),
+        "embed_ln_bias": jnp.zeros((c.d_model,)),
+        "mlm_ln_scale": jnp.ones((c.d_model,)),
+        "mlm_ln_bias": jnp.zeros((c.d_model,)),
+        "mlm_dense": jax.random.normal(
+            keys[3], (c.d_model, c.d_model),
+            jnp.float32) * c.d_model ** -0.5,
+        "mlm_bias": jnp.zeros((c.vocab_size,)),
+    }
+
+    def layer_params(k):
+        out = {}
+        for i, (name, (shape, _)) in enumerate(_layer_shapes(c).items()):
+            ki = jax.random.fold_in(k, i)
+            if name.startswith(("ln", "b")) or len(shape) == 1:
+                init = (jnp.ones if "scale" in name else jnp.zeros)
+                out[name] = init(shape, jnp.float32)
+            else:
+                out[name] = jax.random.normal(
+                    ki, shape, jnp.float32) * shape[0] ** -0.5
+        return out
+
+    if c.scan_layers:
+        params["layers"] = jax.vmap(layer_params)(
+            jax.random.split(keys[4], c.n_layers))
+    else:
+        params["layers"] = [
+            layer_params(jax.random.fold_in(keys[4], i))
+            for i in range(c.n_layers)]
+    return params
+
+
+def _ln(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    out = (xf - mu) * lax.rsqrt(var + eps)
+    return (out * scale + bias).astype(x.dtype)
+
+
+def _attention(q, k, v, config):
+    if config.attention == "ring":
+        return attn_lib.ring_attention_sharded(q, k, v, causal=False)
+    if config.attention == "flash":
+        return flash_attention(q, k, v, causal=False)
+    return attn_lib.dense_attention(q, k, v, causal=False)
+
+
+def _layer(lp, x, config):
+    dt = config.compute_dtype
+    h = x
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(dt)) + \
+        lp["bq"].astype(dt)
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(dt)) + \
+        lp["bk"].astype(dt)
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(dt)) + \
+        lp["bv"].astype(dt)
+    q = sharding.constrain(q, ("batch", "seq", "act_heads", None))
+    o = _attention(q, k, v, config)
+    o = jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(dt)) + \
+        lp["bo"].astype(dt)
+    x = _ln(x + o, lp["ln1_scale"].astype(dt), lp["ln1_bias"].astype(dt),
+            config.ln_eps)
+
+    up = jnp.einsum("bsd,df->bsf", x, lp["w_up"].astype(dt)) + \
+        lp["b_up"].astype(dt)
+    down = jnp.einsum("bsf,fd->bsd", jax.nn.gelu(up),
+                      lp["w_down"].astype(dt)) + lp["b_down"].astype(dt)
+    x = _ln(x + down, lp["ln2_scale"].astype(dt),
+            lp["ln2_bias"].astype(dt), config.ln_eps)
+    return sharding.constrain(x, ("batch", "seq", "act_embed"))
+
+
+def encode(params, tokens, config, token_types=None):
+    """tokens [B,S] → hidden states [B,S,D]."""
+    dt = config.compute_dtype
+    x = jnp.take(params["embed"].astype(dt), tokens, axis=0)
+    x = x + params["pos_embed"][: tokens.shape[1]].astype(dt)
+    if token_types is not None:
+        x = x + jnp.take(params["type_embed"].astype(dt), token_types,
+                         axis=0)
+    x = _ln(x, params["embed_ln_scale"].astype(dt),
+            params["embed_ln_bias"].astype(dt), config.ln_eps)
+    x = sharding.constrain(x, ("batch", "seq", "act_embed"))
+
+    layer = lambda lp, x: _layer(lp, x, config)  # noqa: E731
+    if config.remat:
+        layer = jax.checkpoint(layer)
+    if config.scan_layers:
+        x, _ = lax.scan(lambda c_, lp: (layer(lp, c_), None),
+                        x, params["layers"])
+    else:
+        for lp in params["layers"]:
+            x = layer(lp, x)
+    return x
+
+
+def apply(params, tokens, config, token_types=None):
+    """MLM logits [B,S,vocab] fp32 (tied to the embedding table)."""
+    dt = config.compute_dtype
+    x = encode(params, tokens, config, token_types)
+    x = jax.nn.gelu(
+        jnp.einsum("bsd,de->bse", x, params["mlm_dense"].astype(dt)))
+    x = _ln(x, params["mlm_ln_scale"].astype(dt),
+            params["mlm_ln_bias"].astype(dt), config.ln_eps)
+    logits = jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(dt),
+                        preferred_element_type=jnp.float32)
+    return logits + params["mlm_bias"]
+
+
+def loss_fn(params, batch, config):
+    """batch: tokens (with [MASK] substitutions applied), targets
+    (original ids), mask (1.0 where a token was masked-out for MLM)."""
+    logits = apply(params, batch["tokens"], config,
+                   batch.get("token_types"))
+    targets = batch["targets"]
+    weights = batch["mask"].astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - label_logits
+    denom = jnp.maximum(weights.sum(), 1.0)
+    loss = (nll * weights).sum() / denom
+    acc = ((logits.argmax(-1) == targets) * weights).sum() / denom
+    return loss, {"loss": loss, "mlm_accuracy": acc}
+
+
+def param_count(config):
+    params = jax.eval_shape(
+        lambda k: init_params(config, k), jax.random.PRNGKey(0))
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+def flops_per_token(config):
+    """6ND + attention matmul fwd+bwd FLOPs/token."""
+    n = param_count(config)
+    attn = 12 * config.n_layers * config.d_model * config.max_seq
+    return 6 * n + attn
+
+
+def mlm_batch(rng, batch_size, config, mask_prob=0.15, mask_id=103):
+    """Synthetic MLM batch (benchmark/data-pipeline contract)."""
+    import numpy as np
+
+    low = min(1000, config.vocab_size // 2)  # skip special-token range
+    toks = rng.integers(low, config.vocab_size,
+                        (batch_size, config.max_seq), dtype=np.int32)
+    mask = rng.random((batch_size, config.max_seq)) < mask_prob
+    inputs = np.where(mask, mask_id, toks).astype(np.int32)
+    return {"tokens": inputs, "targets": toks,
+            "mask": mask.astype(np.float32)}

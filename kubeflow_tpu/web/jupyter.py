@@ -406,4 +406,6 @@ def create_app(store):
             raise HTTPError(404, f"notebook {ns}/{name} not found")
         return cb.success()
 
+    from . import frontend
+    frontend.install(app, "Notebooks", "Notebook", frontend.JUPYTER_UI)
     return app

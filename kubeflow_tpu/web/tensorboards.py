@@ -71,4 +71,6 @@ def create_app(store):
             raise HTTPError(404, f"tensorboard {ns}/{name} not found")
         return cb.success()
 
+    from . import frontend
+    frontend.install(app, "Tensorboards", "Tensorboard", frontend.TENSORBOARDS_UI)
     return app

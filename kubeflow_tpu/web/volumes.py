@@ -102,4 +102,6 @@ def create_app(store):
             raise HTTPError(404, f"pvc {ns}/{name} not found")
         return cb.success()
 
+    from . import frontend
+    frontend.install(app, "Volumes", "Volume", frontend.VOLUMES_UI)
     return app

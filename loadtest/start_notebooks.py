@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Notebook-controller load test.
+
+Reference: components/notebook-controller/loadtest/start_notebooks.py
+(spawn N Notebook CRs via kubectl, no recorded numbers). This version
+drives the in-process control plane by default (measuring the reconcile
+pipeline itself: CR create → webhook → STS → pod → Ready status) and
+reports creation-to-ready latency percentiles + reconciles/sec — the
+numbers the reference harness never recorded.
+
+    python loadtest/start_notebooks.py --count 500
+    python loadtest/start_notebooks.py --count 50 --real   # via KubeStore
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_inprocess(count):
+    from kubeflow_tpu import api
+    from kubeflow_tpu.controllers import (admission, notebook,
+                                          workload_runtime)
+    from kubeflow_tpu.core import Manager, ObjectStore
+    from kubeflow_tpu.core import meta as m
+
+    store = ObjectStore()
+    api.register_all(store)
+    admission.PodDefaultWebhook(store).install()
+    mgr = Manager(store)
+    mgr.add(notebook.NotebookReconciler(), workers=4)
+    mgr.add(workload_runtime.StatefulSetReconciler(), workers=4)
+    mgr.add(workload_runtime.PodRuntimeReconciler(), workers=4)
+    mgr.start()
+
+    created = {}
+    t0 = time.perf_counter()
+    for i in range(count):
+        name = f"load-{i}"
+        store.create({
+            "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"template": {"spec": {"containers": [
+                {"name": name, "image": "jupyter-jax-tpu:latest"}]}}}})
+        created[name] = time.perf_counter()
+    create_dt = time.perf_counter() - t0
+
+    ready = {}
+    deadline = time.time() + max(60, count / 10)
+    while len(ready) < count and time.time() < deadline:
+        for nb in store.list("kubeflow.org/v1beta1", "Notebook",
+                             "default"):
+            name = m.name_of(nb)
+            if name in ready:
+                continue
+            if m.deep_get(nb, "status", "readyReplicas") == 1:
+                ready[name] = time.perf_counter()
+        time.sleep(0.01)
+    mgr.stop()
+
+    lats = sorted(ready[n] - created[n] for n in ready)
+    if not lats:
+        raise SystemExit("no notebook became ready")
+
+    def pct(p):
+        return round(1000 * lats[min(len(lats) - 1,
+                                     int(p * len(lats)))], 1)
+
+    return {
+        "metric": "notebook_reconcile_latency_p50_ms",
+        "value": pct(0.5),
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "detail": {
+            "count": count,
+            "ready": len(ready),
+            "p90_ms": pct(0.9), "p99_ms": pct(0.99),
+            "create_rate_per_sec": round(count / create_dt, 1),
+            "end_to_end_s": round(lats[-1], 2),
+        },
+    }
+
+
+def run_real(count):
+    """Against a live cluster through KubeStore (KinD or real)."""
+    from kubeflow_tpu.core.kubestore import KubeStore
+
+    store = KubeStore(insecure=os.environ.get("KUBE_INSECURE") == "true")
+    t0 = time.perf_counter()
+    for i in range(count):
+        store.create({
+            "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+            "metadata": {"name": f"load-{i}", "namespace": "default"},
+            "spec": {"template": {"spec": {"containers": [
+                {"name": f"load-{i}",
+                 "image": "kubeflownotebookswg/jupyter-jax-tpu:latest"}
+            ]}}}})
+    return {"metric": "notebook_create_rate_per_sec",
+            "value": round(count / (time.perf_counter() - t0), 1),
+            "unit": "creates/sec", "vs_baseline": 1.0,
+            "detail": {"count": count}}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--count", type=int, default=100)
+    parser.add_argument("--real", action="store_true")
+    args = parser.parse_args()
+    result = (run_real if args.real else run_inprocess)(args.count)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
