@@ -113,13 +113,13 @@ def flash_attention(q, k, v, causal=True, scale=None, block_q=128,
                       interpret)[0]
 
 
-def _resolve(q, scale, block_q, block_k, interpret):
+def _resolve(q, k, scale, block_q, block_k, interpret):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if scale is None:
         scale = q.shape[-1] ** -0.5
     block_q = min(block_q, q.shape[1])
-    block_k = min(block_k, q.shape[1])
+    block_k = min(block_k, k.shape[1])
     return scale, block_q, block_k, interpret
 
 
@@ -138,8 +138,11 @@ def _dense_fwd(q, k, v, scale, causal):
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     scale, block_q, block_k, interpret = _resolve(
-        q, scale, block_q, block_k, interpret)
-    if q.shape[1] % block_q or k.shape[1] % block_k:
+        q, k, scale, block_q, block_k, interpret)
+    # causal with sq != sk has no well-defined block skip count
+    # (the kernel derives n_kb from q positions) → dense fallback
+    if (q.shape[1] % block_q or k.shape[1] % block_k
+            or (causal and q.shape[1] != k.shape[1])):
         out, lse = _dense_fwd(q, k, v, scale, causal)
     else:
         out, lse = _fwd(q, k, v, scale, causal, block_q, block_k,
@@ -155,7 +158,7 @@ def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
     q, k, v, out, lse = res
-    scale, _, _, _ = _resolve(q, scale, block_q, block_k, interpret)
+    scale, _, _, _ = _resolve(q, k, scale, block_q, block_k, interpret)
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)

@@ -40,6 +40,9 @@ CA_CONFIGMAP = "trusted-ca-bundle"
 OAUTH_PORT = 8443
 OAUTH_PROXY_IMAGE = os.environ.get(
     "OAUTH_PROXY_IMAGE", "kubeflownotebookswg/auth-proxy:latest")
+#: rendered when the allowed set is empty so the proxy fails CLOSED
+#: (an empty ALLOWED_USERS means "no restriction" to proxy.py)
+DENY_ALL_SENTINEL = "__deny-all__"
 
 
 def oauth_enabled(nb):
@@ -99,13 +102,22 @@ def generate_ctrl_network_policy(nb, controller_namespace):
     })
 
 
-def generate_oauth_network_policy(nb):
+def generate_oauth_network_policy(nb, ingress_namespace=None):
+    """notebook_network.go:177 — but unlike the reference (whose proxy
+    performs real OAuth + SAR, so open ingress is safe) our proxy trusts
+    the identity header, so ingress to the oauth port is restricted to
+    the authenticating ingress namespace."""
     name, ns = m.name_of(nb), m.namespace_of(nb)
+    ingress_namespace = ingress_namespace or os.environ.get(
+        "AUTH_INGRESS_NAMESPACE", "istio-system")
     return builtin.network_policy(f"{name}-oauth-np", ns, {
         "podSelector": {"matchLabels": {"statefulset": name}},
         "policyTypes": ["Ingress"],
-        "ingress": [{"ports": [{"protocol": "TCP",
-                                "port": OAUTH_PORT}]}],
+        "ingress": [{
+            "from": [{"namespaceSelector": {"matchLabels": {
+                "kubernetes.io/metadata.name": ingress_namespace}}}],
+            "ports": [{"protocol": "TCP", "port": OAUTH_PORT}],
+        }],
     })
 
 
@@ -116,11 +128,23 @@ def generate_ca_configmap(nb, bundle):
         labels={"config.openshift.io/inject-trusted-cabundle": "true"})
 
 
-def oauth_proxy_container(nb):
+def oauth_proxy_container(nb, allowed_users=()):
+    """The sidecar spec. images/auth-proxy/proxy.py is configured via
+    env (UPSTREAM/PORT/USERID_HEADER/ALLOWED_USERS) — the reference's
+    openshift/oauth-proxy flags (notebook_webhook.go:73) are kept as
+    args for spec parity but the env is what enforces access; the
+    reconciler keeps ALLOWED_USERS = owner + contributors in sync."""
     name, ns = m.name_of(nb), m.namespace_of(nb)
     return {
         "name": "oauth-proxy",
         "image": OAUTH_PROXY_IMAGE,
+        "env": [
+            {"name": "UPSTREAM", "value": "http://127.0.0.1:8888"},
+            {"name": "PORT", "value": str(OAUTH_PORT)},
+            {"name": "USERID_HEADER",
+             "value": os.environ.get("USERID_HEADER", "kubeflow-userid")},
+            {"name": "ALLOWED_USERS", "value": _render(allowed_users)},
+        ],
         "args": [
             f"--provider=openshift",
             f"--https-address=:{OAUTH_PORT}",
@@ -143,6 +167,29 @@ def oauth_proxy_container(nb):
              "mountPath": "/etc/tls/private"},
         ],
     }
+
+
+def _render(allowed_users):
+    return (",".join(sorted(allowed_users)) if allowed_users
+            else DENY_ALL_SENTINEL)
+
+
+def allowed_users_for(store, namespace):
+    """Owner of the Profile that owns the namespace plus every
+    contributor with a kfam RoleBinding (annotations user/role — the
+    web/kfam.py convention, reference bindings.go:61-94)."""
+    users = set()
+    profile = store.try_get("kubeflow.org/v1", "Profile", namespace)
+    if profile is not None:
+        owner = m.deep_get(profile, "spec", "owner", "name")
+        if owner:
+            users.add(owner)
+    for rb in store.list("rbac.authorization.k8s.io/v1", "RoleBinding",
+                         namespace):
+        user = m.deep_get(rb, "metadata", "annotations", "user")
+        if user:
+            users.add(user)
+    return users
 
 
 # --------------------------------------------------------------- webhook
@@ -215,7 +262,8 @@ class SecureNotebookWebhook:
         name = m.name_of(nb)
         spec = m.deep_get(nb, "spec", "template", "spec", default={})
         containers = spec.setdefault("containers", [])
-        proxy = oauth_proxy_container(nb)
+        proxy = oauth_proxy_container(
+            nb, allowed_users_for(self.store, m.namespace_of(nb)))
         for i, c in enumerate(containers):
             if c.get("name") == "oauth-proxy":
                 containers[i] = proxy
@@ -248,6 +296,24 @@ class SecureNotebookReconciler(Reconciler):
                             nbapi.KIND)
         builder.watch_owned("v1", "Service", nbapi.KIND)
         builder.watch_owned("v1", "Secret", nbapi.KIND)
+        # contributor changes re-render ALLOWED_USERS on oauth sidecars
+        builder.watch_mapped("rbac.authorization.k8s.io/v1",
+                             "RoleBinding", self._map_to_oauth_notebooks)
+        builder.watch_mapped("kubeflow.org/v1", "Profile",
+                             self._map_profile_to_oauth_notebooks)
+
+    def _oauth_notebooks_in(self, namespace):
+        from ..core.manager import Request
+        for nb in self.store.list(NB_API, nbapi.KIND, namespace):
+            if oauth_enabled(nb):
+                yield Request(m.name_of(nb), namespace)
+
+    def _map_to_oauth_notebooks(self, ev):
+        yield from self._oauth_notebooks_in(m.namespace_of(ev.object))
+
+    def _map_profile_to_oauth_notebooks(self, ev):
+        # Profile is cluster-scoped; its name is the namespace it owns
+        yield from self._oauth_notebooks_in(m.name_of(ev.object))
 
     def reconcile(self, req):
         nb = self.store.try_get(NB_API, nbapi.KIND, req.name,
@@ -278,6 +344,8 @@ class SecureNotebookReconciler(Reconciler):
                 self.store.create(sec)
             owned(generate_oauth_network_policy(nb))
             owned(generate_route(nb, to_tls=True))
+            if self.sync_allowed_users(nb):
+                return Result()  # updated CR re-triggers reconcile
         else:
             owned(generate_route(nb, to_tls=False))
 
@@ -286,3 +354,25 @@ class SecureNotebookReconciler(Reconciler):
             m.annotations_of(nb).pop(LOCK_ANNOTATION, None)
             self.store.update(nb)
         return Result()
+
+    def sync_allowed_users(self, nb):
+        """Keep the sidecar's ALLOWED_USERS env equal to the namespace's
+        owner + contributors (ADVICE r1: the proxy enforces env, not
+        the oauth-proxy CLI args). Returns True if the CR was updated."""
+        spec = m.deep_get(nb, "spec", "template", "spec", default={})
+        proxy = next((c for c in spec.get("containers", [])
+                      if c.get("name") == "oauth-proxy"), None)
+        if proxy is None:
+            return False
+        want = _render(allowed_users_for(self.store, m.namespace_of(nb)))
+        env = proxy.setdefault("env", [])
+        entry = next((e for e in env
+                      if e.get("name") == "ALLOWED_USERS"), None)
+        if entry is None:
+            entry = {"name": "ALLOWED_USERS", "value": None}
+            env.append(entry)
+        if entry.get("value") == want:
+            return False
+        entry["value"] = want
+        self.store.update(nb)
+        return True

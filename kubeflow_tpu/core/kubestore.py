@@ -20,6 +20,7 @@ import queue
 import ssl
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 
 from . import meta as m
@@ -95,8 +96,9 @@ class KubeStore:
         return "/".join(parts)
 
     def _request(self, method, path, body=None, stream=False,
-                 timeout=30):
-        headers = {"Accept": "application/json",
+                 timeout=30, raw=False):
+        headers = {"Accept": "text/plain" if raw
+                   else "application/json",
                    "Content-Type": "application/json"}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
@@ -123,6 +125,8 @@ class KubeStore:
             raise
         if stream:
             return resp
+        if raw:
+            return resp.read().decode(errors="replace")
         return json.loads(resp.read() or b"{}")
 
     # --------------------------------------------------- store surface
@@ -137,14 +141,31 @@ class KubeStore:
         except NotFoundError:
             return None
 
+    def _list_all(self, path):
+        """Follow metadata.continue pagination; returns (items, rv)."""
+        items, rv, cont = [], None, None
+        sep = "&" if "?" in path else "?"
+        while True:
+            url = path if cont is None else (
+                f"{path}{sep}continue={urllib.parse.quote(cont)}")
+            page = self._request("GET", url)
+            items.extend(page.get("items", []))
+            if rv is None:
+                rv = m.deep_get(page, "metadata", "resourceVersion")
+            cont = m.deep_get(page, "metadata", "continue")
+            if not cont:
+                return items, rv
+
     def list(self, api_version, kind, namespace=None,
              label_selector=None, field_match=None):
         path = self._path(api_version, kind, namespace)
-        if label_selector and "matchLabels" not in label_selector:
-            sel = ",".join(f"{k}={v}"
-                           for k, v in sorted(label_selector.items()))
-            path += f"?labelSelector={sel}"
-        items = self._request("GET", path).get("items", [])
+        if label_selector:
+            # accept both the flat form and the {'matchLabels': …}
+            # wrapper the in-process ObjectStore takes (store.py)
+            flat = label_selector.get("matchLabels", label_selector)
+            sel = ",".join(f"{k}={v}" for k, v in sorted(flat.items()))
+            path += "?labelSelector=" + urllib.parse.quote(sel)
+        items, _ = self._list_all(path)
         for obj in items:
             obj.setdefault("apiVersion", api_version)
             obj.setdefault("kind", kind)
@@ -177,11 +198,56 @@ class KubeStore:
         return self._request(
             "DELETE", self._path(api_version, kind, namespace, name))
 
+    # ------------------------------------------------- cluster services
+
+    def read_pod_log(self, name, namespace, container=None,
+                     tail_lines=None):
+        """GET /api/v1/namespaces/<ns>/pods/<p>/log — the real kubelet
+        log path (reference crud_backend api/pod.py get_pod_logs)."""
+        path = self._path("v1", "Pod", namespace, name,
+                          subresource="log")
+        params = {}
+        if container:
+            params["container"] = container
+        if tail_lines:
+            params["tailLines"] = str(tail_lines)
+        if params:
+            path += "?" + urllib.parse.urlencode(params)
+        return self._request("GET", path, raw=True)
+
+    def subject_access_review(self, user, verb, group, resource,
+                              namespace=None, subresource=""):
+        """POST a real SubjectAccessReview and return status.allowed
+        (reference crud_backend/authz.py:25-79) — on a live cluster the
+        apiserver, not a local table, is the RBAC oracle."""
+        body = {
+            "apiVersion": "authorization.k8s.io/v1",
+            "kind": "SubjectAccessReview",
+            "spec": {
+                "user": user,
+                "resourceAttributes": {
+                    "group": "" if group in ("v1", "") else group,
+                    "resource": resource,
+                    "verb": verb,
+                    "namespace": namespace or "",
+                    "subresource": subresource,
+                },
+            },
+        }
+        resp = self._request(
+            "POST", "/apis/authorization.k8s.io/v1/subjectaccessreviews",
+            body=body)
+        return bool(m.deep_get(resp, "status", "allowed"))
+
     # ----------------------------------------------------------- watch
+
+    #: reconnect backoff for watches (tests shorten it)
+    watch_backoff = 1.0
 
     def watch(self, api_version, kind, namespace=None,
               send_initial=True):
-        w = _KubeWatch(self, api_version, kind, namespace, send_initial)
+        w = _KubeWatch(self, api_version, kind, namespace, send_initial,
+                       reconnect_backoff=self.watch_backoff)
         self._watches.append(w)
         return w
 
@@ -191,7 +257,7 @@ class _KubeWatch:
     (iterable, .q, .get(timeout), .stop()); resumes on disconnect."""
 
     def __init__(self, store, api_version, kind, namespace,
-                 send_initial):
+                 send_initial, reconnect_backoff=1.0):
         self.store = store
         self.api_version = api_version
         self.kind = kind
@@ -199,21 +265,42 @@ class _KubeWatch:
         self.q = queue.Queue()
         self.closed = False
         self._rv = None
+        self._known = {}   # (ns, name) -> last seen object
+        self._backoff = reconnect_backoff
         self._thread = threading.Thread(
             target=self._run, args=(send_initial,), daemon=True,
             name=f"kubewatch-{kind}")
         self._thread.start()
 
+    @staticmethod
+    def _key(obj):
+        return (m.namespace_of(obj), m.name_of(obj))
+
+    def _relist(self, path, emit):
+        """List, remember state, and (when ``emit``) replay the delta to
+        the queue — client-go's informer replays the relist so events
+        missed during a disconnect are never lost (ADVICE r1)."""
+        items, self._rv = self.store._list_all(path)
+        seen = set()
+        for obj in items:
+            obj.setdefault("apiVersion", self.api_version)
+            obj.setdefault("kind", self.kind)
+            key = self._key(obj)
+            seen.add(key)
+            event_type = "MODIFIED" if key in self._known else "ADDED"
+            self._known[key] = obj
+            if emit:
+                self.q.put(WatchEvent(event_type, obj))
+        for key in list(self._known):
+            if key not in seen:
+                gone = self._known.pop(key)
+                if emit:
+                    self.q.put(WatchEvent("DELETED", gone))
+
     def _run(self, send_initial):
         path = self.store._path(self.api_version, self.kind,
                                 self.namespace)
-        listing = self.store._request("GET", path)
-        self._rv = m.deep_get(listing, "metadata", "resourceVersion")
-        if send_initial:
-            for obj in listing.get("items", []):
-                obj.setdefault("apiVersion", self.api_version)
-                obj.setdefault("kind", self.kind)
-                self.q.put(WatchEvent("ADDED", obj))
+        self._relist(path, emit=send_initial)
         while not self.closed:
             try:
                 self._stream(path)
@@ -221,11 +308,11 @@ class _KubeWatch:
                 if self.closed:
                     return
                 import time
-                time.sleep(1)  # reconnect backoff, then re-list
+                time.sleep(self._backoff)
+                # reconnect: re-list and replay the delta so nothing
+                # that happened during the disconnect is dropped
                 try:
-                    listing = self.store._request("GET", path)
-                    self._rv = m.deep_get(listing, "metadata",
-                                          "resourceVersion")
+                    self._relist(path, emit=True)
                 except Exception:
                     pass
 
@@ -243,9 +330,19 @@ class _KubeWatch:
                 continue
             ev = json.loads(line)
             obj = ev.get("object") or {}
+            if ev.get("type") == "ERROR":
+                # typically 410 Gone: the resourceVersion expired.
+                # Drop it and raise so _run backs off + relists —
+                # otherwise re-dialing with the stale rv hot-loops.
+                self._rv = None
+                raise RuntimeError(f"watch ERROR event: {obj}")
             self._rv = m.deep_get(obj, "metadata", "resourceVersion",
                                   default=self._rv)
             if ev.get("type") in ("ADDED", "MODIFIED", "DELETED"):
+                if ev["type"] == "DELETED":
+                    self._known.pop(self._key(obj), None)
+                else:
+                    self._known[self._key(obj)] = obj
                 self.q.put(WatchEvent(ev["type"], obj))
 
     def __iter__(self):

@@ -182,6 +182,12 @@ def create_app(store):
                  or request.user)
         if not name:
             raise HTTPError(400, "profile name is required")
+        # only the cluster admin may create a profile owned by someone
+        # else (ADVICE r1: self-service pins owner to the caller)
+        if owner != request.user and request.user != cluster_admin():
+            raise HTTPError(
+                403, f"user {request.user} may not create a profile "
+                     f"owned by {owner}")
         try:
             store.create(papi.new(name, owner))
         except AlreadyExistsError:

@@ -1,0 +1,308 @@
+"""A stdlib fake kube-apiserver for KubeStore tests.
+
+Speaks just enough of the Kubernetes REST dialect to exercise the
+real-cluster adapter the way envtest exercises controller-runtime
+(reference notebook-controller/controllers/suite_test.go:56-58):
+typed list/get/create/update/delete with resourceVersion conflicts,
+labelSelector filtering, chunked ``?watch=true`` streams that the
+server can drop on command (to test reconnect/relist), paginated
+lists, SubjectAccessReview, and the pod-log subresource.
+"""
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+CLUSTER_SCOPED_PLURALS = {"namespaces", "nodes", "profiles",
+                          "clusterrolebindings", "storageclasses"}
+
+# /api/v1/... or /apis/group/version/...
+_LIST_RE = re.compile(
+    r"^/(?:api/(?P<core>v1)|apis/(?P<group>[^/]+)/(?P<version>[^/]+))"
+    r"(?:/namespaces/(?P<ns>[^/]+))?/(?P<plural>[^/?]+)"
+    r"(?:/(?P<name>[^/?]+))?(?:/(?P<sub>[^/?]+))?$")
+
+
+class FakeApiServer:
+    """In-memory object store keyed (plural, ns, name) with a global
+    monotonically increasing resourceVersion and an event log for
+    watch replay."""
+
+    def __init__(self):
+        self.objects = {}          # (plural, ns, name) -> obj
+        self.rv = 0
+        self.events = []           # (rv, type, obj-copy)
+        self.lock = threading.RLock()
+        self.drop_watch_after = None   # close stream after N events
+        self.watch_error_410 = False   # next watch: ERROR event, close
+        self.sar_allow = set()         # {(user, verb, resource, ns)}
+        self.pod_logs = {}             # (ns, name) -> str
+        self.requests = []             # (method, path) log
+        self.list_page_size = None     # enable pagination when set
+        self._watch_wakeups = []
+        server = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        server.fake = self
+        self.server = server
+        self.port = server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+
+    # ------------------------------------------------------- mutation
+
+    def _bump(self, event_type, obj):
+        self.rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+        self.events.append((self.rv, event_type,
+                            json.loads(json.dumps(obj))))
+        for wake in self._watch_wakeups:
+            wake.set()
+
+    def put_object(self, plural, obj, ns=None):
+        """Test-side direct injection (bypasses HTTP)."""
+        with self.lock:
+            name = obj["metadata"]["name"]
+            ns = ns or obj["metadata"].get("namespace")
+            key = (plural, ns, name)
+            event_type = "MODIFIED" if key in self.objects else "ADDED"
+            self._bump(event_type, obj)
+            self.objects[key] = obj
+
+    def delete_object(self, plural, name, ns=None):
+        with self.lock:
+            obj = self.objects.pop((plural, ns, name))
+            self._bump("DELETED", obj)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    @property
+    def fake(self):
+        return self.server.fake
+
+    def _send_json(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _status(self, code, reason, message=""):
+        self._send_json(code, {"kind": "Status", "apiVersion": "v1",
+                               "status": "Failure", "reason": reason,
+                               "message": message, "code": code})
+
+    def _parse(self):
+        parsed = urllib.parse.urlparse(self.path)
+        match = _LIST_RE.match(parsed.path)
+        query = dict(urllib.parse.parse_qsl(parsed.query))
+        return match, query
+
+    def _read_body(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        return json.loads(self.rfile.read(length)) if length else {}
+
+    # ------------------------------------------------------------ GET
+
+    def do_GET(self):
+        self.fake.requests.append(("GET", self.path))
+        match, query = self._parse()
+        if match is None:
+            return self._status(404, "NotFound", self.path)
+        plural, ns, name = (match["plural"], match["ns"], match["name"])
+        if name and match["sub"] == "log":
+            return self._pod_log(ns, name, query)
+        if name:
+            with self.fake.lock:
+                obj = self.fake.objects.get((plural, ns, name))
+            if obj is None:
+                return self._status(404, "NotFound", name)
+            return self._send_json(200, obj)
+        if query.get("watch") == "true":
+            return self._watch(plural, ns, query)
+        return self._list(plural, ns, query)
+
+    def _match_selector(self, obj, selector):
+        labels = obj.get("metadata", {}).get("labels") or {}
+        for pair in selector.split(","):
+            k, _, v = pair.partition("=")
+            if labels.get(k) != v:
+                return False
+        return True
+
+    def _list(self, plural, ns, query):
+        with self.fake.lock:
+            items = [o for (p, n, _), o in
+                     sorted(self.fake.objects.items(),
+                            key=lambda kv: kv[0])
+                     if p == plural and (ns is None or n == ns)]
+            rv = str(self.fake.rv)
+        selector = query.get("labelSelector")
+        if selector:
+            items = [o for o in items
+                     if self._match_selector(o, selector)]
+        meta = {"resourceVersion": rv}
+        page = self.fake.list_page_size
+        if page:
+            start = int(query.get("continue") or 0)
+            chunk = items[start:start + page]
+            if start + page < len(items):
+                meta["continue"] = str(start + page)
+            items = chunk
+        return self._send_json(200, {"kind": "List", "metadata": meta,
+                                     "items": items})
+
+    def _watch(self, plural, ns, query):
+        since = int(query.get("resourceVersion") or 0)
+        wake = threading.Event()
+        self.fake._watch_wakeups.append(wake)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        if self.fake.watch_error_410:
+            self.fake.watch_error_410 = False
+            line = json.dumps({"type": "ERROR", "object": {
+                "kind": "Status", "code": 410,
+                "reason": "Expired"}}) + "\n"
+            data = line.encode()
+            self.wfile.write(
+                f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.write(b"0\r\n\r\n")
+            self.fake._watch_wakeups.remove(wake)
+            return
+        sent = 0
+        try:
+            while True:
+                batch = []
+                with self.fake.lock:
+                    for rv, etype, obj in self.fake.events:
+                        if rv <= since:
+                            continue
+                        if obj["metadata"].get("namespace") != ns \
+                                and ns is not None:
+                            continue
+                        key_plural = _plural_of(obj)
+                        if key_plural != plural:
+                            continue
+                        batch.append((rv, etype, obj))
+                    limit = self.fake.drop_watch_after
+                for rv, etype, obj in batch:
+                    line = json.dumps({"type": etype,
+                                       "object": obj}) + "\n"
+                    data = line.encode()
+                    self.wfile.write(
+                        f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                    self.wfile.flush()
+                    since = rv
+                    sent += 1
+                    if limit is not None and sent >= limit:
+                        self.wfile.write(b"0\r\n\r\n")
+                        return
+                wake.clear()
+                if not wake.wait(timeout=10):
+                    self.wfile.write(b"0\r\n\r\n")
+                    return
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            self.fake._watch_wakeups.remove(wake)
+
+    def _pod_log(self, ns, name, query):
+        text = self.fake.pod_logs.get((ns, name))
+        if text is None:
+            return self._status(404, "NotFound", name)
+        if query.get("tailLines"):
+            lines = text.splitlines(keepends=True)
+            text = "".join(lines[-int(query["tailLines"]):])
+        body = text.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # ----------------------------------------------------------- POST
+
+    def do_POST(self):
+        self.fake.requests.append(("POST", self.path))
+        if self.path == ("/apis/authorization.k8s.io/v1/"
+                         "subjectaccessreviews"):
+            return self._sar()
+        match, _ = self._parse()
+        if match is None:
+            return self._status(404, "NotFound", self.path)
+        obj = self._read_body()
+        plural, ns = match["plural"], match["ns"]
+        name = obj.get("metadata", {}).get("name")
+        with self.fake.lock:
+            key = (plural, ns, name)
+            if key in self.fake.objects:
+                return self._status(409, "AlreadyExists", name)
+            self.fake._bump("ADDED", obj)
+            self.fake.objects[key] = obj
+        return self._send_json(201, obj)
+
+    def _sar(self):
+        body = self._read_body()
+        spec = body.get("spec", {})
+        attrs = spec.get("resourceAttributes", {})
+        allowed = (spec.get("user"), attrs.get("verb"),
+                   attrs.get("resource"),
+                   attrs.get("namespace") or "") in self.fake.sar_allow
+        body["status"] = {"allowed": allowed}
+        return self._send_json(201, body)
+
+    # ------------------------------------------------------ PUT/DELETE
+
+    def do_PUT(self):
+        self.fake.requests.append(("PUT", self.path))
+        match, _ = self._parse()
+        if match is None:
+            return self._status(404, "NotFound", self.path)
+        obj = self._read_body()
+        plural, ns = match["plural"], match["ns"]
+        name = match["name"]
+        with self.fake.lock:
+            key = (plural, ns, name)
+            current = self.fake.objects.get(key)
+            if current is None:
+                return self._status(404, "NotFound", name)
+            sent_rv = obj.get("metadata", {}).get("resourceVersion")
+            cur_rv = current["metadata"].get("resourceVersion")
+            if sent_rv is not None and sent_rv != cur_rv:
+                return self._status(409, "Conflict",
+                                    f"rv {sent_rv} != {cur_rv}")
+            self.fake._bump("MODIFIED", obj)
+            self.fake.objects[key] = obj
+        return self._send_json(200, obj)
+
+    def do_DELETE(self):
+        self.fake.requests.append(("DELETE", self.path))
+        match, _ = self._parse()
+        if match is None:
+            return self._status(404, "NotFound", self.path)
+        plural, ns, name = (match["plural"], match["ns"], match["name"])
+        with self.fake.lock:
+            key = (plural, ns, name)
+            obj = self.fake.objects.pop(key, None)
+            if obj is None:
+                return self._status(404, "NotFound", name)
+            self.fake._bump("DELETED", obj)
+        return self._send_json(200, obj)
+
+
+def _plural_of(obj):
+    kind = obj.get("kind", "")
+    from kubeflow_tpu.core.kubestore import PLURALS
+    return PLURALS.get(kind, kind.lower() + "s")

@@ -138,3 +138,69 @@ class TestReconciler:
         second = store.get("v1", "Secret", "nb8-oauth-config",
                            "default")["data"]["cookie_secret"]
         assert first == second
+
+
+class TestAllowedUsers:
+    """ADVICE r1 (high): the proxy enforces env, so the controller must
+    render ALLOWED_USERS = owner + contributors and keep it in sync."""
+
+    def _proxy_env(self, store, name, ns="default"):
+        nb = store.get(NB_API, nbapi.KIND, name, ns)
+        proxy = next(c for c in m.deep_get(nb, "spec", "template",
+                                           "spec", "containers")
+                     if c["name"] == "oauth-proxy")
+        return {e["name"]: e.get("value") for e in proxy.get("env", [])}
+
+    def test_env_rendered_with_owner(self, rig):
+        store, manager = rig
+        store.create({"apiVersion": "kubeflow.org/v1", "kind": "Profile",
+                      "metadata": {"name": "default"},
+                      "spec": {"owner": {"kind": "User",
+                                         "name": "owner@example.com"}}})
+        store.create(make_notebook(name="nb9", oauth=True))
+        manager.run_sync()
+        env = self._proxy_env(store, "nb9")
+        assert env["UPSTREAM"] == "http://127.0.0.1:8888"
+        assert env["ALLOWED_USERS"] == "owner@example.com"
+
+    def test_contributor_sync(self, rig):
+        store, manager = rig
+        store.create({"apiVersion": "kubeflow.org/v1", "kind": "Profile",
+                      "metadata": {"name": "default"},
+                      "spec": {"owner": {"kind": "User",
+                                         "name": "owner@example.com"}}})
+        store.create(make_notebook(name="nb10", oauth=True))
+        manager.run_sync()
+        # kfam-style contributor RoleBinding appears → env re-rendered
+        store.create({
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": {"name": "user-bob-example-com-clusterrole-"
+                                 "kubeflow-edit",
+                         "namespace": "default",
+                         "annotations": {"role": "edit",
+                                         "user": "bob@example.com"}},
+            "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+            "subjects": [{"kind": "User", "name": "bob@example.com"}]})
+        manager.run_sync()
+        env = self._proxy_env(store, "nb10")
+        assert env["ALLOWED_USERS"] == "bob@example.com,owner@example.com"
+
+    def test_empty_allowed_set_fails_closed(self, rig):
+        # no Profile owner + no contributors → deny-all sentinel, not
+        # the fail-open empty string (code-review r2)
+        store, manager = rig
+        store.create(make_notebook(name="nb12", oauth=True))
+        manager.run_sync()
+        env = self._proxy_env(store, "nb12")
+        assert env["ALLOWED_USERS"] == sn.DENY_ALL_SENTINEL
+
+    def test_oauth_np_restricted_to_ingress_namespace(self, rig):
+        store, manager = rig
+        store.create(make_notebook(name="nb11", oauth=True))
+        manager.run_sync()
+        np = store.get("networking.k8s.io/v1", "NetworkPolicy",
+                       "nb11-oauth-np", "default")
+        frm = np["spec"]["ingress"][0]["from"]
+        assert frm == [{"namespaceSelector": {"matchLabels": {
+            "kubernetes.io/metadata.name": "istio-system"}}}]

@@ -262,6 +262,18 @@ class TestKfam:
         assert store.try_get("v1", "Namespace", "team-b") is not None
         assert c.delete("/kfam/v1/profiles/team-b").status == 200
 
+    def test_cannot_create_profile_for_other_user(self, platform):
+        # ADVICE r1: only the cluster admin may set a foreign owner
+        store, _ = platform
+        c = client(kfam.create_app(store))
+        r = c.post("/kfam/v1/profiles",
+                   json_body={"metadata": {"name": "team-x"},
+                              "spec": {"owner": {
+                                  "name": "mallory@example.com"}}})
+        assert r.status == 403
+        assert store.try_get("kubeflow.org/v1", "Profile",
+                             "team-x") is None
+
     def test_non_owner_cannot_bind(self, platform):
         store, _ = platform
         c = client(kfam.create_app(store), MALLORY)
