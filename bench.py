@@ -131,7 +131,7 @@ def bench_bert(steps, batch):
 
     from kubeflow_tpu.compute.models import bert
 
-    remat = os.environ.get("BENCH_REMAT", "false").lower() == "true"
+    remat = os.environ.get("BENCH_REMAT", "true").lower() == "true"
     cfg = bert.Config(remat=remat)  # bert-base (fits HBM without remat)
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=-1))
     opt = train.make_optimizer(learning_rate=1e-4, warmup_steps=10,

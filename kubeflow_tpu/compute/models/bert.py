@@ -35,6 +35,10 @@ class Config:
     remat: bool = True
     scan_layers: bool = True
     ln_eps: float = 1e-12
+    #: MLM head evaluated only at up to this many masked positions per
+    #: sequence (standard max_predictions_per_seq) — the [B,S,vocab]
+    #: logits tensor never materializes
+    max_predictions: int = 80
 
     @property
     def head_dim(self):
@@ -190,10 +194,10 @@ def encode(params, tokens, config, token_types=None):
     return x
 
 
-def apply(params, tokens, config, token_types=None):
-    """MLM logits [B,S,vocab] fp32 (tied to the embedding table)."""
+def mlm_head(params, x, config):
+    """Vocab logits for selected hidden states [B,P,d] → [B,P,vocab]
+    fp32 (tied to the embedding table)."""
     dt = config.compute_dtype
-    x = encode(params, tokens, config, token_types)
     x = jax.nn.gelu(
         jnp.einsum("bsd,de->bse", x, params["mlm_dense"].astype(dt)))
     x = _ln(x, params["mlm_ln_scale"].astype(dt),
@@ -203,16 +207,35 @@ def apply(params, tokens, config, token_types=None):
     return logits + params["mlm_bias"]
 
 
+def apply(params, tokens, config, token_types=None):
+    """Full-sequence MLM logits [B,S,vocab] fp32 (inference surface;
+    training gathers masked positions first — see loss_fn)."""
+    return mlm_head(params, encode(params, tokens, config, token_types),
+                    config)
+
+
 def loss_fn(params, batch, config):
     """batch: tokens (with [MASK] substitutions applied), targets
-    (original ids), mask (1.0 where a token was masked-out for MLM)."""
-    logits = apply(params, batch["tokens"], config,
-                   batch.get("token_types"))
-    targets = batch["targets"]
+    (original ids), mask (1.0 where a token was masked-out for MLM).
+
+    The MLM head runs only on the (up to max_predictions) masked
+    positions per sequence — the [B,S,vocab] tensor never exists, which
+    is both the published BERT recipe (max_predictions_per_seq) and the
+    difference between HBM-bound and MXU-bound pretraining at batch
+    sizes that saturate a v5e chip."""
+    x = encode(params, batch["tokens"], config,
+               batch.get("token_types"))
     weights = batch["mask"].astype(jnp.float32)
+    p = min(config.max_predictions, config.max_seq)
+    # indices of masked positions, padded with weight-0 positions
+    idx = jnp.argsort(-weights, axis=1)[:, :p]                  # [B,P]
+    sel = jnp.take_along_axis                                   # alias
+    x = sel(x, idx[..., None], axis=1)                          # [B,P,d]
+    targets = sel(batch["targets"], idx, axis=1)                # [B,P]
+    weights = sel(weights, idx, axis=1)                         # [B,P]
+    logits = mlm_head(params, x, config)                        # [B,P,V]
     logz = jax.nn.logsumexp(logits, axis=-1)
-    label_logits = jnp.take_along_axis(
-        logits, targets[..., None], axis=-1)[..., 0]
+    label_logits = sel(logits, targets[..., None], axis=-1)[..., 0]
     nll = logz - label_logits
     denom = jnp.maximum(weights.sum(), 1.0)
     loss = (nll * weights).sum() / denom

@@ -116,19 +116,28 @@ def _conv(x, w, stride=1, dtype=None):
 
 
 def _bn(x, bp, bs, config, train):
-    xf = x.astype(jnp.float32)
+    """HBM-lean batch norm: one-pass fp32 stats (E[x], E[x²] fuse into
+    a single read of x — jnp.var would serialize two passes), then the
+    normalize folded to one bf16 fused multiply-add ``x*scale'+bias'``
+    so XLA fuses it with the surrounding residual add / relu instead of
+    materializing fp32 copies of the activation."""
     if train:
-        mean = xf.mean(axis=(0, 1, 2))
-        var = xf.var(axis=(0, 1, 2))
+        xf = x.astype(jnp.float32)
+        n = x.shape[0] * x.shape[1] * x.shape[2]
+        m1 = xf.sum(axis=(0, 1, 2)) / n
+        m2 = (xf * xf).sum(axis=(0, 1, 2)) / n
+        mean = m1
+        var = jnp.maximum(m2 - m1 * m1, 0.0)
         mom = config.bn_momentum
         new = {"mean": mom * bs["mean"] + (1 - mom) * mean,
                "var": mom * bs["var"] + (1 - mom) * var}
     else:
         mean, var = bs["mean"], bs["var"]
         new = bs
-    y = (xf - mean) * lax.rsqrt(var + config.bn_eps)
-    y = y * bp["scale"] + bp["bias"]
-    return y.astype(x.dtype), new
+    scale = bp["scale"] * lax.rsqrt(var + config.bn_eps)   # [C] fp32
+    bias = bp["bias"] - mean * scale
+    y = x * scale.astype(x.dtype) + bias.astype(x.dtype)
+    return y, new
 
 
 def _block(x, bp, bs, config, stride, train):

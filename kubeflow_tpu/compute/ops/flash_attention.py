@@ -1,21 +1,24 @@
-"""Flash attention forward as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — forward AND backward.
 
-Tiled online-softmax attention: Q blocks stream through VMEM, K/V blocks
-stream through the inner loop, the (S×S) score matrix never materializes
-in HBM. fp32 accumulation on the MXU via ``preferred_element_type``.
-Causal kernels skip fully-masked K blocks (dynamic inner trip count), so
-causal costs ~half of full.
+Tiled online-softmax attention: Q blocks stream through VMEM, K/V
+blocks stream through the inner loop, the (S×S) score matrix never
+materializes in HBM — in either direction. fp32 accumulation on the
+MXU via ``preferred_element_type``; causal kernels skip fully-masked
+blocks (dynamic inner trip counts), so causal costs ~half of full.
 
-The backward pass is an exact XLA recompute from the saved (out, lse)
-residuals (standard memory-efficient attention gradient) — O(S²) compute
-but O(S) HBM residuals, and XLA fuses it well; a Pallas backward kernel
-is a later optimization.
+Backward follows the standard two-kernel split:
+- ``_bwd_dq_kernel``:  per Q block, loop over K/V blocks → dQ
+- ``_bwd_dkv_kernel``: per K/V block, loop over Q blocks → dK, dV
+with the O(S) residuals (lse = m + log l from the forward, and
+delta = rowsum(dO ⊙ O) computed in one fused XLA pass). HBM residual
+memory stays O(S); an 8k-sequence train step fits where the dense
+recompute backward (O(S²) scores in HBM) blows up.
 
 No reference counterpart (the reference has no attention code at all —
 SURVEY.md §2); written from the public flash-attention recipe against
 /opt/skills/guides/pallas_guide.md.
 
-Interpret mode runs the same kernel on CPU for the virtual-mesh test
+Interpret mode runs the same kernels on CPU for the virtual-mesh test
 tier (tests/conftest.py), mirroring how the reference tests controllers
 against envtest instead of a real cluster.
 """
@@ -71,12 +74,104 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[0] = m + jnp.log(l)
 
 
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, scale, causal, block_k, seq_k):
+    """dQ = scale · Σ_kb [p ⊙ (dO·Vᵀ − delta)] · K."""
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    jq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]           # [bq, 1] fp32
+    delta = delta_ref[0]       # [bq, 1] fp32
+    q_pos = jq * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        n_kb = lax.div(jq * bq + bq + block_k - 1, block_k)
+    else:
+        n_kb = seq_k // block_k
+    dq = lax.fori_loop(0, n_kb,
+                       body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (scale * dq).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, seq_q):
+    """dV = Σ_qb pᵀ·dO ;  dK = scale · Σ_qb [p ⊙ (dO·Vᵀ − delta)]ᵀ·Q."""
+    bk, d = k_ref.shape[1], k_ref.shape[2]
+    jk = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    k_pos = jk * bk + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+
+    def body(qb, carry):
+        dk, dv = carry
+        qb_start = qb * block_q
+        q = q_ref[0, pl.ds(qb_start, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb_start, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb_start, block_q), :]
+        delta = delta_ref[0, pl.ds(qb_start, block_q), :]
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qb_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # [block_q, bk]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    n_qb = seq_q // block_q
+    if causal:
+        # Q blocks strictly before this K block's first row are fully
+        # masked: start at floor(jk*bk / block_q)
+        qb0 = lax.div(jk * bk, block_q)
+    else:
+        qb0 = 0
+    dk, dv = lax.fori_loop(
+        qb0, n_qb, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[0] = (scale * dk).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _reshape_heads(x):
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
 def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    qr = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kr = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vr = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    qr, kr, vr = map(_reshape_heads, (q, k, v))
 
     grid = (b * h, sq // block_q)
     out, lse = pl.pallas_call(
@@ -103,9 +198,66 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     return out, lse
 
 
+def _bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k,
+         interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qr, kr, vr, dor = map(_reshape_heads, (q, k, v, do))
+    # delta = rowsum(dO ⊙ O): one fused elementwise+reduce pass in XLA
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                     # [b, sq, h]
+    delta = delta.transpose(0, 2, 1).reshape(b * h, sq, 1)
+    lse_r = lse.reshape(b, h, sq).reshape(b * h, sq, 1)
+
+    common = dict(interpret=interpret)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, seq_k=sk),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        **common,
+    )(qr, kr, vr, dor, lse_r, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, seq_q=sq),
+        grid=(b * h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq, 1), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        **common,
+    )(qr, kr, vr, dor, lse_r, delta)
+
+    def back(x):
+        return x.reshape(b, h, -1, d).transpose(0, 2, 1, 3)
+    return back(dq), back(dk), back(dv)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention(q, k, v, causal=True, scale=None, block_q=128,
-                    block_k=128, interpret=None):
+def flash_attention(q, k, v, causal=True, scale=None, block_q=256,
+                    block_k=512, interpret=None):
     """Fused attention. q,k,v: [batch, seq, heads, head_dim] (same head
     count — GQA callers repeat kv first). Falls back to the exact XLA
     path when the sequence doesn't tile."""
@@ -136,13 +288,17 @@ def _dense_fwd(q, k, v, scale, causal):
     return out.astype(q.dtype), lse
 
 
+def _use_dense(q, k, causal, block_q, block_k):
+    # causal with sq != sk has no well-defined block skip count
+    # (the kernels derive trip counts from q positions)
+    return (q.shape[1] % block_q or k.shape[1] % block_k
+            or (causal and q.shape[1] != k.shape[1]))
+
+
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     scale, block_q, block_k, interpret = _resolve(
         q, k, scale, block_q, block_k, interpret)
-    # causal with sq != sk has no well-defined block skip count
-    # (the kernel derives n_kb from q positions) → dense fallback
-    if (q.shape[1] % block_q or k.shape[1] % block_k
-            or (causal and q.shape[1] != k.shape[1])):
+    if _use_dense(q, k, causal, block_q, block_k):
         out, lse = _dense_fwd(q, k, v, scale, causal)
     else:
         out, lse = _fwd(q, k, v, scale, causal, block_q, block_k,
@@ -156,9 +312,7 @@ def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
     return out, res
 
 
-def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
-    q, k, v, out, lse = res
-    scale, _, _, _ = _resolve(q, k, scale, block_q, block_k, interpret)
+def _dense_bwd(q, k, v, out, lse, do, scale, causal):
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
@@ -176,6 +330,16 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
     dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * scale
     dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    scale, block_q, block_k, interpret = _resolve(
+        q, k, scale, block_q, block_k, interpret)
+    if _use_dense(q, k, causal, block_q, block_k):
+        return _dense_bwd(q, k, v, out, lse, do, scale, causal)
+    return _bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k,
+                interpret)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
