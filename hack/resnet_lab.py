@@ -1,0 +1,93 @@
+"""Perf lab for the ResNet-50 headline bench (not shipped in bench.py).
+
+Usage: python hack/resnet_lab.py [fwd|step] [batch] [--profile DIR]
+
+Prints step time, analytic MFU, and XLA cost-analysis FLOPs so the
+analytic flops_per_sample model can be cross-checked.
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.compute import mesh as mesh_lib
+from kubeflow_tpu.compute import train
+from kubeflow_tpu.compute.models import resnet
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "step"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    profile_dir = None
+    if "--profile" in sys.argv:
+        profile_dir = sys.argv[sys.argv.index("--profile") + 1]
+
+    import os
+    cfg = resnet.Config(depth=50, n_classes=1000, dtype="bfloat16")
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=-1))
+    if os.environ.get("LAB_SGD"):
+        import optax
+        opt = optax.sgd(1e-3, momentum=0.9)
+    else:
+        opt = train.make_optimizer(learning_rate=1e-3, warmup_steps=10,
+                                   total_steps=10_000)
+    stats = jax.jit(lambda k: resnet.init_params(cfg, k)[1])(
+        jax.random.PRNGKey(0))
+    p_axes, _ = resnet.logical_axes(cfg)
+    state = train.init_state(
+        lambda k: resnet.init_params(cfg, k)[0], opt, mesh, p_axes,
+        jax.random.PRNGKey(0), extra=stats)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, 224, 224, 3),
+                          jnp.bfloat16)
+    batch_data = {"image": x,
+                  "label": jax.random.randint(jax.random.PRNGKey(2),
+                                              (batch,), 0, 1000)}
+
+    if mode == "fwd":
+        fwd = jax.jit(lambda p, s, bx: resnet.apply(p, s, bx, cfg)[0])
+        def run():
+            return fwd(state.params, state.extra, x)
+    else:
+        step = train.make_train_step(
+            train.stateful_loss(resnet.loss_fn, cfg), opt, mesh)
+        compiled = step.lower(state, batch_data).compile()
+        ca = compiled.cost_analysis()
+        flops = ca.get("flops", 0.0)
+        print(f"xla_cost_flops_per_step={flops:.3e} "
+              f"per_sample={flops/batch:.3e}")
+        ms = compiled.memory_analysis()
+        print(f"peak_hbm={getattr(ms, 'temp_size_in_bytes', 0)/1e9:.2f}GB "
+              f"args={getattr(ms, 'argument_size_in_bytes', 0)/1e9:.2f}GB")
+        holder = [state]
+        def run():
+            s, m = step(holder[0], batch_data)
+            holder[0] = s
+            return m["loss"]
+
+    for _ in range(3):
+        out = run()
+        jax.block_until_ready(out)
+        float(jnp.sum(out)) if hasattr(out, "shape") else None
+
+    steps = 20
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
+    t0 = time.perf_counter()
+    last = None
+    for _ in range(steps):
+        last = run()
+    jax.block_until_ready(last)
+    dt = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
+    step_ms = 1000 * dt / steps
+    sps = steps * batch / dt
+    analytic = resnet.flops_per_sample() if mode == "step" else 4.1e9
+    print(f"mode={mode} batch={batch} step_ms={step_ms:.2f} "
+          f"samples_per_sec={sps:.1f} "
+          f"mfu_analytic={sps*analytic/197e12:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -173,7 +173,7 @@ def _layer(lp, x, config):
 def encode(params, tokens, config, token_types=None):
     """tokens [B,S] → hidden states [B,S,D]."""
     dt = config.compute_dtype
-    x = jnp.take(params["embed"].astype(dt), tokens, axis=0)
+    x = sharding.embed_lookup(params["embed"].astype(dt), tokens)
     x = x + params["pos_embed"][: tokens.shape[1]].astype(dt)
     if token_types is not None:
         x = x + jnp.take(params["type_embed"].astype(dt), token_types,

@@ -195,8 +195,7 @@ def _layer(lp, x, rope, config):
 def apply(params, tokens, config):
     """tokens [B, S] int32 → logits [B, S, vocab] fp32."""
     dt = config.compute_dtype
-    x = jnp.take(params["embed"].astype(dt), tokens, axis=0)
-    x = sharding.constrain(x, ("batch", "seq", "act_embed"))
+    x = sharding.embed_lookup(params["embed"].astype(dt), tokens)
     positions = jnp.arange(tokens.shape[1])
     rope = rope_tables(config, positions)
 

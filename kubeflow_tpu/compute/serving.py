@@ -31,14 +31,22 @@ class ServedModel:
         self._fn = jax.jit(predict_fn)
 
     def predict(self, instances):
+        return self.predict_timed(instances)[0]
+
+    def predict_timed(self, instances):
+        """→ (predictions, device_ms). Timing returned per-call (no
+        shared state: the HTTP server is threaded)."""
+        import time
         x = np.asarray(instances)
         n = x.shape[0]
         bucket = next((b for b in BATCH_BUCKETS if b >= n), n)
         if bucket > n:
             pad = np.zeros((bucket - n,) + x.shape[1:], x.dtype)
             x = np.concatenate([x, pad], axis=0)
+        t0 = time.perf_counter()
         out = np.asarray(self._fn(x))[:n]
-        return out.tolist()
+        infer_ms = 1000 * (time.perf_counter() - t0)
+        return out.tolist(), infer_ms
 
 
 class ModelServer:
@@ -65,11 +73,13 @@ class ModelServer:
             def log_message(self, *args):
                 pass
 
-            def _send(self, code, payload):
+            def _send(self, code, payload, extra_headers=()):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in extra_headers:
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -104,8 +114,11 @@ class ModelServer:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length) or b"{}")
                     instances = req["instances"]
-                    predictions = model.predict(instances)
-                    self._send(200, {"predictions": predictions})
+                    predictions, infer = model.predict_timed(instances)
+                    # device-time breakdown (harmless extension header:
+                    # JSON transport dominates at image sizes)
+                    self._send(200, {"predictions": predictions},
+                               (("X-Inference-Time-Ms", f"{infer:.1f}"),))
                 except Exception as e:  # noqa: BLE001 — wire boundary
                     self._send(400, {"error": str(e)})
 

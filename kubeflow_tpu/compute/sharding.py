@@ -12,6 +12,7 @@ layer at all (SURVEY.md §2 parallelism table).
 """
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_lib
@@ -78,3 +79,46 @@ def shard_tree(tree, mesh, logical_tree, rules=None):
     """Device-put a pytree onto the mesh per its logical axes."""
     shardings = tree_shardings(mesh, logical_tree, rules)
     return jax.device_put(tree, shardings)
+
+
+def _axis_shards(logical_axis, rules):
+    """Product of mesh-axis sizes a logical axis maps to under the
+    ambient (abstract) mesh — 1 when unmapped or outside a mesh."""
+    mapped = (rules or DEFAULT_RULES).get(logical_axis)
+    if mapped is None:
+        return 1
+    names = (mapped,) if isinstance(mapped, str) else tuple(mapped)
+    mesh = jax.sharding.get_abstract_mesh()
+    sizes = dict(getattr(mesh, "shape_tuple", ()) or ())
+    n = 1
+    for name in names:
+        n *= sizes.get(name, 1)
+    return n
+
+
+def embed_lookup(table, tokens, rules=None):
+    """Sharded embedding lookup, [V,D] table × [B,S] int ids → [B,S,D].
+
+    A plain gather from a tensor-sharded vocab dim makes the SPMD
+    partitioner fall back to "involuntary full rematerialization"
+    (all-gather the table, gather, full-reshard the output — the exact
+    warning the r1 multichip dryrun logged). Two TPU-clean paths
+    instead, chosen at trace time from the ambient mesh:
+
+    - vocab genuinely sharded → one-hot matmul (MaxText's iota-embed
+      idiom): contraction over the sharded vocab dim lowers to a local
+      matmul + psum on the MXU; the backward is likewise a clean
+      matmul + reduce-scatter.
+    - vocab unsharded → explicitly lift the (fsdp-sharded) table to
+      replicated first, so the gather emits the (batch, seq, ·) layout
+      directly instead of inheriting the table's embed-dim sharding
+      and resharding after.
+    """
+    if _axis_shards("vocab", rules) > 1:
+        onehot = jax.nn.one_hot(tokens, table.shape[0],
+                                dtype=table.dtype)
+        onehot = constrain(onehot, ("batch", "seq", "vocab"), rules)
+        out = jnp.einsum("bsv,vd->bsd", onehot, table)
+    else:
+        out = jnp.take(constrain(table, None, rules), tokens, axis=0)
+    return constrain(out, ("batch", "seq", "act_embed"), rules)

@@ -147,8 +147,14 @@ def make_train_step(loss_fn, optimizer, mesh, accum_steps=1):
         with jax.set_mesh(mesh):
             return jitted_for(state)(state, batch)
 
-    run.lower = (lambda state, batch:
-                 jitted_for(state).lower(state, batch))
+    def lower(state, batch):
+        # same ambient mesh as execution: constraints/mesh-dependent
+        # paths (e.g. sharding.embed_lookup) trace identically, so
+        # cost/memory analysis describes the program that actually runs
+        with jax.set_mesh(mesh):
+            return jitted_for(state).lower(state, batch)
+
+    run.lower = lower
     return run
 
 
