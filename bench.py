@@ -44,13 +44,17 @@ RESNET50_BASELINE_SPS = 1000.0
 LM_BASELINE_TOKENS = 1.0e5
 
 
-def _xla_step_flops(step, state, batch):
-    """FLOPs of the compiled train step per XLA cost analysis (2/MAC)."""
+def _compile_step(step, state, batch):
+    """AOT-compile the train step once: returns (callable, xla_flops).
+    The same executable serves cost analysis AND the timed loop, so the
+    bench never compiles twice. Falls back to the plain jit path when
+    AOT isn't available."""
     try:
-        ca = step.lower(state, batch).compile().cost_analysis()
-        return float(ca.get("flops", 0.0)) or None
+        compiled = step.lower(state, batch).compile()
+        ca = compiled.cost_analysis() or {}
+        return compiled, (float(ca.get("flops", 0.0)) or None)
     except Exception:
-        return None
+        return step, None
 
 
 def bench_resnet(steps, batch):
@@ -72,8 +76,8 @@ def bench_resnet(steps, batch):
     batch_data = {"image": x,
                   "label": jax.random.randint(jax.random.PRNGKey(2),
                                               (batch,), 0, 1000)}
-    xla_flops = _xla_step_flops(step, state, batch_data)
-    for _ in range(3):                          # compile + warm paths
+    step, xla_flops = _compile_step(step, state, batch_data)
+    for _ in range(3):                          # warm paths
         state, metrics = step(state, batch_data)
         _drain(metrics)
     t0 = time.perf_counter()
@@ -210,6 +214,8 @@ def bench_serving(steps, batch):
     server.register("resnet50", predict)
     port = server.start(port=0, host="127.0.0.1")
     url = f"http://127.0.0.1:{port}/v1/models/resnet50:predict"
+    # (stop() in finally: under BENCH_MODEL=all a leaked server would
+    # hold the jitted model in device memory through later benches)
     instances = np.random.default_rng(0).standard_normal(
         (batch, 224, 224, 3)).astype(np.float32).tolist()
     payload = _json.dumps({"instances": instances}).encode()
@@ -226,16 +232,18 @@ def bench_serving(steps, batch):
             infer_ms.append(float(hdr))
         return _json.load(resp)
 
-    post(); post()  # compile + warm
-    infer_ms.clear()
-    lat = []
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        t1 = time.perf_counter()
-        post()
-        lat.append(time.perf_counter() - t1)
-    dt = time.perf_counter() - t0
-    server.stop()
+    try:
+        post(); post()  # compile + warm
+        infer_ms.clear()
+        lat = []
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            t1 = time.perf_counter()
+            post()
+            lat.append(time.perf_counter() - t1)
+        dt = time.perf_counter() - t0
+    finally:
+        server.stop()
     lat.sort()
     infer_ms.sort()
     pps = steps * batch / dt
@@ -302,18 +310,21 @@ def main():
         import sys
         print("bench: BENCH_BATCH ignored with BENCH_MODEL=all "
               "(per-mode defaults apply)", file=sys.stderr)
-    lines, failed = [], False
+    failed = False
     for m in modes:
         fn, default_batch = BENCHES[m]
         batch = int(os.environ.get("BENCH_BATCH", str(default_batch))
                     if model != "all" else default_batch)
         try:
-            lines.append(json.dumps(fn(steps, batch)))
+            line = json.dumps(fn(steps, batch))
         except Exception as e:  # keep the suite going; record the failure
             failed = True
-            lines.append(json.dumps(
-                {"metric": m, "error": f"{type(e).__name__}: {e}"[:300]}))
-    print("\n".join(lines), flush=True)
+            line = json.dumps(
+                {"metric": m, "error": f"{type(e).__name__}: {e}"[:300]})
+        # stream each line as its mode completes (a crash in a later
+        # mode must not lose earlier results); headline stays last via
+        # ALL_ORDER
+        print(line, flush=True)
     if failed:
         raise SystemExit(1)
 

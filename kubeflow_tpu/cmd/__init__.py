@@ -9,6 +9,7 @@ store with ``--dev`` for local hacking). Flags mirror the reference's
 import logging
 import os
 import signal
+import sys
 import threading
 
 
@@ -145,7 +146,13 @@ def centraldashboard():
     _web(dashboard.create_app, 8082)
 
 
+def slice_worker():
+    from ..compute import slice_worker as sw
+    raise SystemExit(sw.main(sys.argv[2:]))
+
+
 COMPONENTS = {
+    "slice-worker": slice_worker,
     "notebook-controller": notebook_controller,
     "secure-notebook-controller": secure_notebook_controller,
     "profile-controller": profile_controller,

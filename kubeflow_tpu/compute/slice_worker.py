@@ -1,0 +1,153 @@
+"""Slice worker — the training-loop entrypoint a TpuSlice pod runs.
+
+This is the executable half of the platform contract described in
+``compute/mesh.py``: the TpuSlice controller (controllers/tpuslice.py)
+schedules one pod per TPU worker host and injects ``TPU_WORKER_ID``,
+``TPU_WORKER_HOSTNAMES`` and ``JAX_COORDINATOR_ADDRESS`` (the
+TPU-native re-keying of the reference's GPU env plumbing,
+components/crud-web-apps/jupyter/backend/apps/common/form.py:226-250).
+Every pod runs this module:
+
+1. ``initialize_distributed()`` — join the gang at the coordinator
+   (worker 0's stable headless-Service DNS name),
+2. build one global mesh over every chip in the slice,
+3. ``restore_or_init`` from the workspace-PVC checkpoint dir,
+4. train, checkpointing on an interval; on any worker failure the
+   controller restarts the *gang* (gang semantics — a dead worker
+   leaves XLA collectives unservicable), and the restarted gang resumes
+   from the last durable step. SURVEY.md §7 hard part (a) — mesh
+   (re)formation — is exactly steps 1+4.
+
+Deterministic fault injection for tests/e2e: set
+``SLICE_WORKER_FAULT_AT_STEP=<n>`` on one worker and it dies with
+exit code 17 *before* executing step n — the restart path is then
+byte-for-byte the normal resume path.
+
+Run: ``python -m kubeflow_tpu.cmd slice-worker --ckpt-dir ... --steps N``
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def build_argparser():
+    ap = argparse.ArgumentParser(prog="slice-worker")
+    ap.add_argument("--steps", type=int, default=10,
+                    help="train to this global step count")
+    ap.add_argument("--ckpt-dir", required=True,
+                    help="checkpoint dir (workspace PVC path)")
+    ap.add_argument("--ckpt-every", type=int, default=2)
+    ap.add_argument("--log", default="",
+                    help="append one JSON line per step here")
+    ap.add_argument("--batch-per-process", type=int, default=4)
+    ap.add_argument("--fsdp", type=int, default=1)
+    ap.add_argument("--tensor", type=int, default=1)
+    ap.add_argument("--seq", type=int, default=32)
+    return ap
+
+
+def main(argv=None):
+    args = build_argparser().parse_args(argv)
+
+    # platform override must land before the backend initializes
+    # (tests force cpu; the axon TPU plugin overrides JAX_PLATFORMS env)
+    import jax
+    forced = os.environ.get("SLICE_WORKER_PLATFORM")
+    if forced:
+        jax.config.update("jax_platforms", forced)
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from . import checkpoint as ckpt_lib
+    from . import mesh as mesh_lib
+    from . import sharding as sharding_lib
+    from . import train
+    from .models import transformer
+
+    joined = mesh_lib.initialize_distributed()
+    pid = jax.process_index()
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(
+        data=-1, fsdp=args.fsdp, tensor=args.tensor))
+
+    cfg = transformer.Config(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+        max_seq=args.seq, dtype="float32", attention="dense")
+    opt = train.make_optimizer(learning_rate=3e-3, warmup_steps=1,
+                               total_steps=max(args.steps, 2))
+
+    def init():
+        return train.init_state(
+            lambda k: transformer.init_params(cfg, k), opt, mesh,
+            transformer.logical_axes(cfg), jax.random.PRNGKey(0))
+
+    # synchronous saves: a step's checkpoint is durable before the next
+    # step runs, so fault-at-step-n always resumes from the latest
+    # completed interval (deterministic for the gang-restart e2e)
+    ckpt, state, resumed = ckpt_lib.restore_or_init(
+        args.ckpt_dir, init, save_interval_steps=args.ckpt_every,
+        async_save=False)
+    step_fn = train.make_train_step(
+        train.plain_loss(transformer.loss_fn, cfg), opt, mesh)
+
+    batch_sharding = NamedSharding(
+        mesh, sharding_lib.spec_for(("batch", "seq")))
+
+    def global_batch(step):
+        """Deterministic per-step batch, assembled from process-local
+        shards (the data-pipeline contract: every process feeds only
+        its own chips)."""
+        rng = np.random.default_rng(1000 + step)
+        n_proc = jax.process_count()
+        full = rng.integers(
+            0, cfg.vocab_size,
+            (args.batch_per_process * n_proc, args.seq), dtype=np.int32)
+        local = full[pid * args.batch_per_process:
+                     (pid + 1) * args.batch_per_process]
+        toks = jax.make_array_from_process_local_data(
+            batch_sharding, local)
+        tgt = jax.make_array_from_process_local_data(
+            batch_sharding, np.roll(local, -1, axis=1))
+        return {"tokens": toks, "targets": tgt}
+
+    fault_at = int(os.environ.get("SLICE_WORKER_FAULT_AT_STEP", "-1"))
+    log_f = open(args.log, "a") if args.log else None
+
+    def log(**kw):
+        kw.update(process=pid, t=time.time())
+        line = json.dumps(kw)
+        if log_f:
+            log_f.write(line + "\n")
+            log_f.flush()
+        print(line, flush=True)
+
+    log(event="joined", joined=joined, resumed=resumed,
+        start_step=int(state.step), processes=jax.process_count(),
+        devices=len(jax.devices()), mesh=str(dict(
+            zip(mesh.axis_names, mesh.devices.shape))))
+
+    while int(state.step) < args.steps:
+        step_no = int(state.step)
+        if step_no == fault_at:
+            log(event="fault-injected", step=step_no)
+            os._exit(17)
+        state, metrics = step_fn(state, global_batch(step_no))
+        ckpt.save(state)
+        log(event="step", step=int(state.step),
+            loss=float(metrics["loss"]))
+
+    if int(state.step) not in ckpt.all_steps():
+        ckpt.save(state, force=True)
+    ckpt.close()
+    log(event="done", step=int(state.step))
+    if log_f:
+        log_f.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

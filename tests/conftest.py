@@ -27,6 +27,11 @@ from kubeflow_tpu import api  # noqa: E402
 from kubeflow_tpu.core import Manager, ObjectStore  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running e2e tests")
+
+
 @pytest.fixture()
 def store():
     s = ObjectStore()
