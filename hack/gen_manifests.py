@@ -179,6 +179,11 @@ def rbac(name, cluster=True):
                             "rbac.authorization.k8s.io"],
               "resources": ["*"],
               "verbs": ["*"]},
+             # leader-election leases (core.leader, enabled via
+             # ENABLE_LEADER_ELECTION)
+             {"apiGroups": ["coordination.k8s.io"],
+              "resources": ["leases"],
+              "verbs": ["get", "create", "update"]},
          ]},
         {"apiVersion": "rbac.authorization.k8s.io/v1",
          "kind": f"{kind}Binding",

@@ -9,7 +9,6 @@ store with ``--dev`` for local hacking). Flags mirror the reference's
 import logging
 import os
 import signal
-import sys
 import threading
 
 
@@ -25,10 +24,26 @@ def _store(dev=False):
         insecure=os.environ.get("KUBE_INSECURE", "").lower() == "true")
 
 
-def _run_manager(reconcilers, store=None):
-    from ..core import Manager
+def _run_manager(reconcilers, store=None, election_id=None):
+    """ENABLE_LEADER_ELECTION=true turns on Lease-based election (the
+    reference's --enable-leader-election + LeaderElectionID flags,
+    notebook-controller/main.go:68-93); LEADER_ELECTION_ID overrides the
+    per-component default lease name. On a lost lease the process exits
+    1 so the pod restarts and re-campaigns."""
+    from ..core import LeaderElector, Manager
     store = store or _store()
-    mgr = Manager(store)
+    elector = None
+    if os.environ.get("ENABLE_LEADER_ELECTION", "").lower() == "true":
+        lease = os.environ.get("LEADER_ELECTION_ID") or election_id \
+            or f"kubeflow-tpu-{reconcilers[0].name}"
+        elector = LeaderElector(
+            store, lease,
+            namespace=os.environ.get("POD_NAMESPACE", "kubeflow-system"),
+            lease_duration=float(os.environ.get("LEASE_DURATION", "15")),
+            renew_deadline=float(os.environ.get("RENEW_DEADLINE", "10")),
+            retry_period=float(os.environ.get("RETRY_PERIOD", "2")))
+    mgr = Manager(store, leader_elector=elector,
+                  on_leadership_lost=lambda: os._exit(1))
     for r in reconcilers:
         mgr.add(r)
     mgr.start()
@@ -50,61 +65,69 @@ def _serve_health(port=8080):
     return app.serve(port=port)
 
 
-def _block():
+def _block(*cleanup):
+    """Wait for SIGTERM/SIGINT, then run cleanup callbacks (managers
+    pass mgr.stop so a graceful shutdown releases the election lease —
+    fast failover instead of waiting out lease_duration)."""
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
+    for fn in cleanup:
+        try:
+            fn()
+        except Exception:
+            logging.exception("shutdown cleanup failed")
 
 
-def notebook_controller():
+def notebook_controller(argv=()):
     from ..controllers import culling, notebook
     _serve_health(int(os.environ.get("METRICS_PORT", "8080")))
     reconcilers = [notebook.NotebookReconciler()]
     if os.environ.get("ENABLE_CULLING", "").lower() == "true":
         reconcilers.append(culling.CullingReconciler())
-    _run_manager(reconcilers)
-    _block()
+    mgr, _ = _run_manager(reconcilers)
+    _block(mgr.stop)
 
 
-def secure_notebook_controller():
+def secure_notebook_controller(argv=()):
     from ..controllers import secure_notebook, webhook_server
     store = _store()
     hook = secure_notebook.SecureNotebookWebhook(store)
     server = webhook_server.WebhookServer(
         {"/mutate-notebook-v1": hook})
     server.start(int(os.environ.get("WEBHOOK_PORT", "8443")))
-    _run_manager([secure_notebook.SecureNotebookReconciler(
+    mgr, _ = _run_manager([secure_notebook.SecureNotebookReconciler(
         controller_namespace=os.environ.get("POD_NAMESPACE", "kubeflow"),
         ca_bundle=os.environ.get("CA_BUNDLE", ""))], store=store)
-    _block()
+    _block(mgr.stop)
 
 
-def profile_controller():
+def profile_controller(argv=()):
     from ..controllers import profile
     _serve_health()
-    _run_manager([profile.ProfileReconciler(
+    mgr, _ = _run_manager([profile.ProfileReconciler(
         userid_header=os.environ.get("USERID_HEADER", "kubeflow-userid"),
         userid_prefix=os.environ.get("USERID_PREFIX", ""))])
-    _block()
+    _block(mgr.stop)
 
 
-def tensorboard_controller():
+def tensorboard_controller(argv=()):
     from ..controllers import tensorboard
     _serve_health()
-    _run_manager([tensorboard.TensorboardReconciler()])
-    _block()
+    mgr, _ = _run_manager([tensorboard.TensorboardReconciler()])
+    _block(mgr.stop)
 
 
-def tpuslice_controller():
+def tpuslice_controller(argv=()):
     from ..controllers import tpuslice
     _serve_health()
-    _run_manager([tpuslice.TpuSliceReconciler(),
-                  tpuslice.StudyJobReconciler()])
-    _block()
+    mgr, _ = _run_manager([tpuslice.TpuSliceReconciler(),
+                           tpuslice.StudyJobReconciler()])
+    _block(mgr.stop)
 
 
-def admission_webhook():
+def admission_webhook(argv=()):
     from ..controllers import admission, webhook_server
     store = _store()
     hook = admission.PodDefaultWebhook(store)
@@ -121,34 +144,34 @@ def _web(create_app, default_port):
     _block()
 
 
-def jupyter_web_app():
+def jupyter_web_app(argv=()):
     from ..web import jupyter
     _web(jupyter.create_app, 5000)
 
 
-def volumes_web_app():
+def volumes_web_app(argv=()):
     from ..web import volumes
     _web(volumes.create_app, 5000)
 
 
-def tensorboards_web_app():
+def tensorboards_web_app(argv=()):
     from ..web import tensorboards
     _web(tensorboards.create_app, 5000)
 
 
-def access_management():
+def access_management(argv=()):
     from ..web import kfam
     _web(kfam.create_app, 8081)
 
 
-def centraldashboard():
+def centraldashboard(argv=()):
     from ..web import dashboard
     _web(dashboard.create_app, 8082)
 
 
-def slice_worker():
+def slice_worker(argv=()):
     from ..compute import slice_worker as sw
-    raise SystemExit(sw.main(sys.argv[2:]))
+    raise SystemExit(sw.main(list(argv)))
 
 
 COMPONENTS = {
@@ -176,4 +199,4 @@ def main(argv):
         raise SystemExit(
             f"usage: python -m kubeflow_tpu.cmd <component>\n"
             f"components:\n  {names}")
-    COMPONENTS[argv[0]]()
+    COMPONENTS[argv[0]](argv[1:])

@@ -13,11 +13,12 @@ from .errors import (ApiError, NotFoundError, AlreadyExistsError,
 from .store import ObjectStore, WatchEvent
 from .workqueue import RateLimitingQueue
 from .manager import Manager, Reconciler, Request, Result
+from .leader import LeaderElector
 from . import reconcilehelper
 
 __all__ = [
     "ApiError", "NotFoundError", "AlreadyExistsError", "ConflictError",
     "InvalidError", "ForbiddenError", "ObjectStore", "WatchEvent",
     "RateLimitingQueue", "Manager", "Reconciler", "Request", "Result",
-    "reconcilehelper",
+    "LeaderElector", "reconcilehelper",
 ]

@@ -1,0 +1,173 @@
+"""Lease-based leader election — HA for controller entrypoints.
+
+The reference gets this from controller-runtime
+(components/notebook-controller/main.go:68-93: ``--enable-leader-election``,
+``LeaderElectionID "kubeflow-notebook-controller"``); the semantics
+rebuilt here are client-go's leaderelection package over a
+``coordination.k8s.io/v1 Lease``:
+
+- acquire: create the Lease, or take it over when the previous holder's
+  ``renewTime + leaseDurationSeconds`` has passed (incrementing
+  ``leaseTransitions``),
+- renew every ``retry_period`` while leading,
+- lose leadership when renewal hasn't succeeded within
+  ``renew_deadline`` — the callback should stop the manager (the cmd
+  entrypoints exit nonzero so the pod restarts and re-campaigns,
+  client-go's default).
+
+Works against both stores: the in-process ObjectStore (optimistic
+resourceVersion conflicts arbitrate concurrent acquires) and KubeStore
+(the apiserver does).
+"""
+
+import logging
+import os
+import random
+import socket
+import threading
+import time
+import uuid
+from datetime import datetime, timezone
+
+from .errors import AlreadyExistsError, ConflictError, NotFoundError
+
+log = logging.getLogger("kubeflow_tpu.core.leader")
+
+LEASE_API = "coordination.k8s.io/v1"
+
+
+def default_identity():
+    return f"{socket.gethostname()}_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+
+
+def _parse_time(s):
+    if not s:
+        return None
+    try:
+        return datetime.fromisoformat(s.replace("Z", "+00:00")).timestamp()
+    except ValueError:
+        return None
+
+
+def _iso(ts):
+    return datetime.fromtimestamp(ts, timezone.utc).isoformat() \
+        .replace("+00:00", "Z")
+
+
+class LeaderElector:
+    def __init__(self, store, lease_name, namespace="kubeflow-system",
+                 identity=None, lease_duration=15.0, renew_deadline=10.0,
+                 retry_period=2.0, clock=time.time):
+        if renew_deadline >= lease_duration:
+            raise ValueError("renew_deadline must be < lease_duration")
+        self.store = store
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.identity = identity or default_identity()
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.clock = clock
+        self.is_leader = threading.Event()
+
+    # ------------------------------------------------------------ lease ops
+
+    def _get(self):
+        try:
+            return self.store.get(LEASE_API, "Lease", self.lease_name,
+                                  self.namespace)
+        except NotFoundError:
+            return None
+
+    def try_acquire_or_renew(self):
+        """One acquire/renew attempt. True iff we hold the lease after
+        the call. Losing a write race (conflict on update, already-exists
+        on create) or ANY transient store error is a clean False — the
+        campaign retries next period instead of dying (client-go
+        tolerates apiserver hiccups the same way)."""
+        try:
+            return self._acquire_or_renew_once()
+        except (ConflictError, AlreadyExistsError, NotFoundError):
+            return False
+        except Exception:
+            log.warning("leader election: %s attempt failed (will retry)",
+                        self.identity, exc_info=True)
+            return False
+
+    def _acquire_or_renew_once(self):
+        now = self.clock()
+        lease = self._get()
+        if lease is None:
+            self.store.create({
+                "apiVersion": LEASE_API, "kind": "Lease",
+                "metadata": {"name": self.lease_name,
+                             "namespace": self.namespace},
+                "spec": {
+                    "holderIdentity": self.identity,
+                    "leaseDurationSeconds": int(self.lease_duration),
+                    "acquireTime": _iso(now),
+                    "renewTime": _iso(now),
+                    "leaseTransitions": 0,
+                }})
+            return True
+        spec = lease.setdefault("spec", {})
+        holder = spec.get("holderIdentity")
+        renew = _parse_time(spec.get("renewTime"))
+        duration = float(spec.get("leaseDurationSeconds")
+                         or self.lease_duration)
+        if holder != self.identity:
+            if renew is not None and now < renew + duration:
+                return False                        # held and fresh
+            spec["leaseTransitions"] = \
+                int(spec.get("leaseTransitions") or 0) + 1
+            spec["acquireTime"] = _iso(now)
+            spec["holderIdentity"] = self.identity
+        spec["renewTime"] = _iso(now)
+        self.store.update(lease)
+        return True
+
+    def release(self):
+        """Voluntarily drop the lease (graceful shutdown → fast failover:
+        client-go's ReleaseOnCancel). Best-effort: shutdown must not
+        fail on a flaky store."""
+        try:
+            lease = self._get()
+            if lease and lease.get("spec", {}).get("holderIdentity") \
+                    == self.identity:
+                lease["spec"]["renewTime"] = _iso(0.0)
+                self.store.update(lease)
+        except Exception:
+            log.debug("leader election: release failed", exc_info=True)
+        self.is_leader.clear()
+
+    # ------------------------------------------------------------ campaign
+
+    def run(self, on_started_leading, on_stopped_leading, stop_event):
+        """Campaign until elected, lead until lost or stopped. Returns
+        after leadership ends (stop or renewal failure)."""
+        while not stop_event.is_set():
+            if self.try_acquire_or_renew():
+                break
+            stop_event.wait(self.retry_period
+                            * (0.8 + 0.4 * random.random()))
+        if stop_event.is_set():
+            return
+        self.is_leader.set()
+        log.info("leader election: %s acquired %s/%s", self.identity,
+                 self.namespace, self.lease_name)
+        on_started_leading()
+
+        last_renew = self.clock()
+        while not stop_event.is_set():
+            stop_event.wait(self.retry_period)
+            if stop_event.is_set():
+                break
+            if self.try_acquire_or_renew():
+                last_renew = self.clock()
+            elif self.clock() - last_renew > self.renew_deadline:
+                self.is_leader.clear()
+                log.error("leader election: %s lost %s/%s", self.identity,
+                          self.namespace, self.lease_name)
+                on_stopped_leading()
+                return
+        self.release()

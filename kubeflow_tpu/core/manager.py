@@ -139,13 +139,28 @@ class _Controller:
 
 
 class Manager:
-    def __init__(self, store):
+    def __init__(self, store, leader_elector=None,
+                 on_leadership_lost=None):
+        """``leader_elector``: a core.leader.LeaderElector; when set,
+        start() campaigns first and controllers only run while this
+        replica holds the lease (reference: controller-runtime
+        --enable-leader-election, notebook-controller/main.go:68-93).
+        ``on_leadership_lost`` is called after the manager stops itself
+        on a lost lease — entrypoints exit nonzero there so the pod
+        restarts and re-campaigns (client-go's default)."""
         self.store = store
         self.controllers = []
         self._threads = []
         self._stop = threading.Event()
+        self.elector = leader_elector
+        self.on_leadership_lost = on_leadership_lost
         self._leader_elected = threading.Event()
-        self._leader_elected.set()  # single-process: we are always leader
+        if leader_elector is None:
+            self._leader_elected.set()  # election disabled: always leader
+
+    @property
+    def is_leader(self):
+        return self._leader_elected.is_set()
 
     def add(self, reconciler, workers=1):
         c = _Controller(reconciler, workers=workers)
@@ -158,6 +173,33 @@ class Manager:
     # ----------------------------------------------------------- threaded
 
     def start(self):
+        """Start controllers — after winning the election when an
+        elector is configured. Non-blocking either way: the campaign
+        runs in a thread and watches open on ``on_started_leading``
+        (both stores replay current objects as initial ADDED events, so
+        a late start observes full state — level-triggered semantics)."""
+        if self.elector is None:
+            self._start_controllers()
+            return
+        t = threading.Thread(
+            target=self.elector.run,
+            args=(self._on_started_leading, self._on_stopped_leading,
+                  self._stop),
+            daemon=True, name="leader-elector")
+        t.start()
+        self._threads.append(t)
+
+    def _on_started_leading(self):
+        self._leader_elected.set()
+        self._start_controllers()
+
+    def _on_stopped_leading(self):
+        self._leader_elected.clear()
+        self.stop()
+        if self.on_leadership_lost is not None:
+            self.on_leadership_lost()
+
+    def _start_controllers(self):
         for c in self.controllers:
             for src in c.sources:
                 src.watch = self.store.watch(src.api_version, src.kind,
@@ -195,6 +237,9 @@ class Manager:
 
     def stop(self):
         self._stop.set()
+        if self.elector is not None and self.is_leader:
+            self.elector.release()      # fast failover on graceful stop
+            self._leader_elected.clear()
         for c in self.controllers:
             c.queue.shutdown()
             for src in c.sources:

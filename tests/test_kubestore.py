@@ -251,3 +251,31 @@ class TestWebOnKubeStore:
         r = c.get("/api/namespaces/team-a/notebooks/nb/pod/nb-0/logs")
         assert r.status == 200
         assert r.json["logs"] == ["booted", "serving"]
+
+
+class TestLeaderElectionOverKubeStore:
+    """The election path against the k8s REST dialect: Lease CRUD via
+    /apis/coordination.k8s.io/v1/namespaces/<ns>/leases, conflicts
+    arbitrating concurrent campaigners (real-cluster analogue of
+    tests/test_leader_election.py)."""
+
+    def test_acquire_renew_takeover(self, rig):
+        from kubeflow_tpu.core.leader import LEASE_API, LeaderElector
+        _, store = rig
+        now = [50.0]
+        a = LeaderElector(store, "ctl", identity="a", lease_duration=15,
+                          renew_deadline=10, clock=lambda: now[0])
+        b = LeaderElector(store, "ctl", identity="b", lease_duration=15,
+                          renew_deadline=10, clock=lambda: now[0])
+        assert a.try_acquire_or_renew() is True
+        assert b.try_acquire_or_renew() is False
+        lease = store.get(LEASE_API, "Lease", "ctl", "kubeflow-system")
+        assert lease["spec"]["holderIdentity"] == "a"
+        now[0] += 20
+        assert b.try_acquire_or_renew() is True
+        lease = store.get(LEASE_API, "Lease", "ctl", "kubeflow-system")
+        assert lease["spec"]["holderIdentity"] == "b"
+        assert lease["spec"]["leaseTransitions"] == 1
+        a.release()  # not holder: must be a no-op
+        assert store.get(LEASE_API, "Lease", "ctl",
+                         "kubeflow-system")["spec"]["holderIdentity"] == "b"

@@ -1,0 +1,166 @@
+"""Leader election: Lease semantics + manager HA behavior.
+
+Reference: controller-runtime election enabled by
+components/notebook-controller/main.go:68-93 (--enable-leader-election,
+LeaderElectionID); semantics under test are client-go's leaderelection
+(acquire/renew/takeover-on-expiry/release) over a coordination.k8s.io
+Lease, arbitrated by the store's optimistic concurrency.
+"""
+
+import threading
+import time
+
+from kubeflow_tpu import api
+from kubeflow_tpu.core import LeaderElector, Manager, ObjectStore, Request, Result
+from kubeflow_tpu.core.leader import LEASE_API
+from kubeflow_tpu.core.manager import Reconciler
+
+
+class Counting(Reconciler):
+    def __init__(self, name):
+        self.name = name
+        self.count = 0
+        self.seen = threading.Event()
+
+    def reconcile(self, req):
+        self.count += 1
+        self.seen.set()
+        return Result()
+
+    def setup(self, builder):
+        builder.watch_for("v1", "ConfigMap")
+
+
+def _store():
+    s = ObjectStore()
+    api.register_all(s)
+    return s
+
+
+def _cm(name):
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": "default"},
+            "data": {}}
+
+
+# ------------------------------------------------------------- lease unit
+
+def test_acquire_renew_takeover_with_fake_clock():
+    store = _store()
+    now = [100.0]
+    e1 = LeaderElector(store, "l", identity="a", lease_duration=15,
+                       renew_deadline=10, clock=lambda: now[0])
+    e2 = LeaderElector(store, "l", identity="b", lease_duration=15,
+                       renew_deadline=10, clock=lambda: now[0])
+
+    assert e1.try_acquire_or_renew() is True          # create
+    assert e2.try_acquire_or_renew() is False         # held + fresh
+    now[0] += 5
+    assert e1.try_acquire_or_renew() is True          # renew
+    lease = store.get(LEASE_API, "Lease", "l", "kubeflow-system")
+    assert lease["spec"]["holderIdentity"] == "a"
+    assert lease["spec"]["leaseTransitions"] == 0
+
+    now[0] += 16                                      # a's renew expired
+    assert e2.try_acquire_or_renew() is True          # takeover
+    lease = store.get(LEASE_API, "Lease", "l", "kubeflow-system")
+    assert lease["spec"]["holderIdentity"] == "b"
+    assert lease["spec"]["leaseTransitions"] == 1
+    assert e1.try_acquire_or_renew() is False         # a lost it
+
+
+def test_release_enables_immediate_takeover():
+    store = _store()
+    e1 = LeaderElector(store, "l", identity="a")
+    e2 = LeaderElector(store, "l", identity="b")
+    assert e1.try_acquire_or_renew()
+    assert not e2.try_acquire_or_renew()
+    e1.release()
+    assert e2.try_acquire_or_renew()
+
+
+# --------------------------------------------------------- manager threaded
+
+def _managers(store, fast=True):
+    kw = dict(lease_duration=1.0, renew_deadline=0.6,
+              retry_period=0.05) if fast else {}
+    out = []
+    for ident in ("a", "b"):
+        el = LeaderElector(store, "mgr-lease", identity=ident, **kw)
+        mgr = Manager(store, leader_elector=el)
+        rec = Counting(f"rec-{ident}")
+        mgr.add(rec)
+        out.append((mgr, el, rec))
+    return out
+
+
+def test_only_leader_reconciles_and_failover():
+    store = _store()
+    (m1, e1, r1), (m2, e2, r2) = _managers(store)
+    m1.start()
+    m2.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not (m1.is_leader or m2.is_leader):
+            time.sleep(0.01)
+        assert m1.is_leader != m2.is_leader, "exactly one leader"
+        leader, lrec = (m1, r1) if m1.is_leader else (m2, r2)
+        standby, srec = (m2, r2) if m1.is_leader else (m1, r1)
+
+        store.create(_cm("one"))
+        assert lrec.seen.wait(5), "leader reconciles"
+        time.sleep(0.2)
+        assert srec.count == 0, "standby runs no controllers"
+
+        # graceful stop releases the lease → standby takes over fast
+        leader.stop()
+        deadline = time.time() + 5
+        while time.time() < deadline and not standby.is_leader:
+            time.sleep(0.01)
+        assert standby.is_leader, "failover"
+        store.create(_cm("two"))
+        assert srec.seen.wait(5), "new leader reconciles"
+        # initial-list replay also delivered 'one' to the new leader —
+        # level-triggered catch-up after late watch start
+        deadline = time.time() + 5
+        while time.time() < deadline and srec.count < 2:
+            time.sleep(0.01)
+        assert srec.count >= 2
+    finally:
+        m1.stop()
+        m2.stop()
+
+
+def test_lost_lease_stops_manager_and_fires_callback():
+    store = _store()
+    lost = threading.Event()
+    el = LeaderElector(store, "mgr-lease", identity="a",
+                       lease_duration=0.5, renew_deadline=0.3,
+                       retry_period=0.05)
+    mgr = Manager(store, leader_elector=el,
+                  on_leadership_lost=lost.set)
+    rec = Counting("rec")
+    mgr.add(rec)
+    mgr.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and not mgr.is_leader:
+            time.sleep(0.01)
+        assert mgr.is_leader
+
+        # usurp the lease (simulates e.g. apiserver partition: renewals
+        # start failing as conflicts / foreign holder)
+        lease = store.get(LEASE_API, "Lease", "mgr-lease",
+                          "kubeflow-system")
+        lease["spec"]["holderIdentity"] = "z"
+        lease["spec"]["renewTime"] = lease["spec"]["acquireTime"]
+        lease["spec"]["leaseDurationSeconds"] = 3600
+        store.update(lease)
+
+        assert lost.wait(5), "on_leadership_lost fires"
+        assert not mgr.is_leader
+        lease = store.get(LEASE_API, "Lease", "mgr-lease",
+                          "kubeflow-system")
+        assert lease["spec"]["holderIdentity"] == "z"
+    finally:
+        mgr.stop()
