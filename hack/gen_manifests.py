@@ -12,17 +12,32 @@ ROOT = os.path.join(os.path.dirname(__file__), "..", "manifests")
 APP_GROUP = "kubeflow.org"
 NS = "kubeflow"
 
+
+def _release_tag():
+    """Image tag = releasing/version/VERSION (release.sh bumps it and
+    regenerates); IMAGE_TAG env overrides for dev builds."""
+    override = os.environ.get("IMAGE_TAG")
+    if override:
+        return override
+    path = os.path.join(os.path.dirname(__file__), "..", "releasing",
+                        "version", "VERSION")
+    with open(path) as f:
+        return f.read().strip()
+
+
+TAG = _release_tag()
+
 # component -> (image, port, extra env, needs webhook cert)
 CONTROLLERS = {
     "notebook-controller": {
-        "image": "kubeflowtpu/notebook-controller:latest",
+        "image": "kubeflowtpu/notebook-controller:" + TAG,
         "env": {"USE_ISTIO": "true", "ISTIO_GATEWAY":
                 "kubeflow/kubeflow-gateway", "ENABLE_CULLING": "true"},
     },
     "secure-notebook-controller": {
-        "image": "kubeflowtpu/secure-notebook-controller:latest",
+        "image": "kubeflowtpu/secure-notebook-controller:" + TAG,
         "env": {"OAUTH_PROXY_IMAGE":
-                "kubeflowtpu/auth-proxy:latest"},
+                "kubeflowtpu/auth-proxy:" + TAG},
         "webhook": {"path": "/mutate-notebook-v1",
                     "rules": [{"apiGroups": [APP_GROUP],
                                "apiVersions": ["v1", "v1beta1"],
@@ -30,21 +45,21 @@ CONTROLLERS = {
                                "resources": ["notebooks"]}]},
     },
     "profile-controller": {
-        "image": "kubeflowtpu/profile-controller:latest",
+        "image": "kubeflowtpu/profile-controller:" + TAG,
         "env": {"USERID_HEADER": "kubeflow-userid",
                 "USERID_PREFIX": ""},
         "cluster_scope": True,
     },
     "tensorboard-controller": {
-        "image": "kubeflowtpu/tensorboard-controller:latest",
+        "image": "kubeflowtpu/tensorboard-controller:" + TAG,
         "env": {"RWO_PVC_SCHEDULING": "true"},
     },
     "tpuslice-controller": {
-        "image": "kubeflowtpu/tpuslice-controller:latest",
+        "image": "kubeflowtpu/tpuslice-controller:" + TAG,
         "env": {},
     },
     "admission-webhook": {
-        "image": "kubeflowtpu/admission-webhook:latest",
+        "image": "kubeflowtpu/admission-webhook:" + TAG,
         "env": {},
         "webhook": {"path": "/apply-poddefault",
                     "rules": [{"apiGroups": [""],
@@ -55,16 +70,16 @@ CONTROLLERS = {
 }
 
 WEB_APPS = {
-    "jupyter-web-app": {"image": "kubeflowtpu/jupyter-web-app:latest",
+    "jupyter-web-app": {"image": "kubeflowtpu/jupyter-web-app:" + TAG,
                         "port": 5000, "prefix": "/jupyter"},
-    "volumes-web-app": {"image": "kubeflowtpu/volumes-web-app:latest",
+    "volumes-web-app": {"image": "kubeflowtpu/volumes-web-app:" + TAG,
                         "port": 5000, "prefix": "/volumes"},
     "tensorboards-web-app": {
-        "image": "kubeflowtpu/tensorboards-web-app:latest",
+        "image": "kubeflowtpu/tensorboards-web-app:" + TAG,
         "port": 5000, "prefix": "/tensorboards"},
-    "access-management": {"image": "kubeflowtpu/access-management:latest",
+    "access-management": {"image": "kubeflowtpu/access-management:" + TAG,
                           "port": 8081, "prefix": "/kfam"},
-    "centraldashboard": {"image": "kubeflowtpu/centraldashboard:latest",
+    "centraldashboard": {"image": "kubeflowtpu/centraldashboard:" + TAG,
                          "port": 8082, "prefix": "/"},
 }
 
@@ -162,29 +177,32 @@ def service(name, port, target=None):
     }
 
 
-def rbac(name, cluster=True):
+def rbac(name, cluster=True, election=False):
     kind = "ClusterRole" if cluster else "Role"
+    rules = [
+        {"apiGroups": ["*"], "resources": ["*"],
+         "verbs": ["get", "list", "watch"]},
+        {"apiGroups": ["", "apps", APP_GROUP,
+                       "networking.istio.io",
+                       "security.istio.io", "networking.k8s.io",
+                       "route.openshift.io",
+                       "rbac.authorization.k8s.io"],
+         "resources": ["*"],
+         "verbs": ["*"]},
+    ]
+    if election:
+        # leader-election leases (core.leader, ENABLE_LEADER_ELECTION) —
+        # only the Manager-based controllers elect; web apps and the
+        # webhook get no Lease write access
+        rules.append({"apiGroups": ["coordination.k8s.io"],
+                      "resources": ["leases"],
+                      "verbs": ["get", "create", "update"]})
     return [
         {"apiVersion": "v1", "kind": "ServiceAccount",
          "metadata": {"name": name}},
         {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": kind,
          "metadata": {"name": name},
-         "rules": [
-             {"apiGroups": ["*"], "resources": ["*"],
-              "verbs": ["get", "list", "watch"]},
-             {"apiGroups": ["", "apps", APP_GROUP,
-                            "networking.istio.io",
-                            "security.istio.io", "networking.k8s.io",
-                            "route.openshift.io",
-                            "rbac.authorization.k8s.io"],
-              "resources": ["*"],
-              "verbs": ["*"]},
-             # leader-election leases (core.leader, enabled via
-             # ENABLE_LEADER_ELECTION)
-             {"apiGroups": ["coordination.k8s.io"],
-              "resources": ["leases"],
-              "verbs": ["get", "create", "update"]},
-         ]},
+         "rules": rules},
         {"apiVersion": "rbac.authorization.k8s.io/v1",
          "kind": f"{kind}Binding",
          "metadata": {"name": name},
@@ -257,7 +275,8 @@ def main():
     all_dirs.append("crds")
 
     for name, spec in CONTROLLERS.items():
-        docs = rbac(name)
+        # admission-webhook runs no Manager (cmd/__init__.py) → no lease
+        docs = rbac(name, election=(name != "admission-webhook"))
         docs.append(deployment(name, spec["image"], spec["env"],
                                port=8443 if "webhook" in spec else None))
         if "webhook" in spec:

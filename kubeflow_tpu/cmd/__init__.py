@@ -38,7 +38,10 @@ def _run_manager(reconcilers, store=None, election_id=None):
             or f"kubeflow-tpu-{reconcilers[0].name}"
         elector = LeaderElector(
             store, lease,
-            namespace=os.environ.get("POD_NAMESPACE", "kubeflow-system"),
+            # default matches the shipped manifests' namespace (NS in
+            # hack/gen_manifests.py) — a missing lease namespace would
+            # make every replica a silent permanent standby
+            namespace=os.environ.get("POD_NAMESPACE", "kubeflow"),
             lease_duration=float(os.environ.get("LEASE_DURATION", "15")),
             renew_deadline=float(os.environ.get("RENEW_DEADLINE", "10")),
             retry_period=float(os.environ.get("RETRY_PERIOD", "2")))
@@ -104,11 +107,16 @@ def secure_notebook_controller(argv=()):
 
 
 def profile_controller(argv=()):
-    from ..controllers import profile
+    from ..controllers import cloud_iam, profile
     _serve_health()
+    # concrete IAM clients when the platform env enables them
+    # (GCP_WORKLOAD_IDENTITY_POOL / AWS_OIDC_PROVIDER_ARN+AWS_OIDC_ISSUER)
+    gcp, aws = cloud_iam.clients_from_env()
     mgr, _ = _run_manager([profile.ProfileReconciler(
         userid_header=os.environ.get("USERID_HEADER", "kubeflow-userid"),
-        userid_prefix=os.environ.get("USERID_PREFIX", ""))])
+        userid_prefix=os.environ.get("USERID_PREFIX", ""),
+        plugins=[profile.WorkloadIdentityPlugin(iam_client=gcp),
+                 profile.AwsIamPlugin(iam_client=aws)])])
     _block(mgr.stop)
 
 

@@ -1,0 +1,295 @@
+"""Concrete cloud IAM clients for the Profile plugins — stdlib HTTP only.
+
+The reference ships real cloud SDK calls behind its plugins:
+- GCP workload identity: binds ``roles/iam.workloadIdentityUser`` on the
+  GSA for member ``serviceAccount:<pool>[<ns>/<ksa>]`` via the IAM
+  policy API (profile-controller/controllers/plugin_workload_identity.go:39-44,
+  revoke at :156).
+- AWS IRSA: edits the IAM role's assume-role (trust) policy so the
+  cluster's OIDC provider may issue ``system:serviceaccount:<ns>:<sa>``
+  subjects (profile-controller/controllers/plugin_iam.go:36-121).
+
+These clients plug into the existing ``iam_client`` seams on
+``WorkloadIdentityPlugin`` / ``AwsIamPlugin`` (controllers/profile.py).
+No cloud SDKs: GCP speaks the IAM REST/JSON API with a bearer token
+(metadata server or injected provider); AWS speaks the IAM Query API
+with a from-scratch SigV4 signer. Both take ``base_url`` overrides so
+tests run them against local fakes.
+"""
+
+import datetime
+import hashlib
+import hmac
+import json
+import logging
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+
+log = logging.getLogger("kubeflow_tpu.cloud_iam")
+
+
+class CloudIamError(RuntimeError):
+    pass
+
+
+def _http(req, timeout=30):
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read()
+    except urllib.error.HTTPError as e:
+        raise CloudIamError(
+            f"{req.get_method()} {req.full_url} -> {e.code}: "
+            f"{e.read()[:500]!r}") from e
+    except urllib.error.URLError as e:
+        raise CloudIamError(f"{req.full_url}: {e.reason}") from e
+
+
+# --------------------------------------------------------------------- GCP
+
+METADATA_TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                      "instance/service-accounts/default/token")
+
+
+def metadata_token():
+    """Access token from the GCE/GKE metadata server (the in-cluster
+    default — the controller pod's own service account)."""
+    req = urllib.request.Request(
+        METADATA_TOKEN_URL, headers={"Metadata-Flavor": "Google"})
+    return json.loads(_http(req, timeout=5))["access_token"]
+
+
+class GcpIamClient:
+    """Binds/unbinds ``roles/iam.workloadIdentityUser`` on a GSA.
+
+    ``pool`` is the workload-identity pool, ``<project>.svc.id.goog``;
+    member format per plugin_workload_identity.go:39-44.
+    """
+
+    ROLE = "roles/iam.workloadIdentityUser"
+
+    def __init__(self, pool, base_url="https://iam.googleapis.com",
+                 token_provider=None):
+        self.pool = pool
+        self.base_url = base_url.rstrip("/")
+        self.token_provider = token_provider or metadata_token
+
+    def member(self, namespace, ksa):
+        return f"serviceAccount:{self.pool}[{namespace}/{ksa}]"
+
+    def _call(self, gsa, verb, body=None):
+        url = (f"{self.base_url}/v1/projects/-/serviceAccounts/"
+               f"{urllib.parse.quote(gsa)}:{verb}")
+        req = urllib.request.Request(
+            url, method="POST",
+            data=json.dumps(body or {}).encode(),
+            headers={
+                "Authorization": f"Bearer {self.token_provider()}",
+                "Content-Type": "application/json",
+            })
+        return json.loads(_http(req) or b"{}")
+
+    def bind(self, namespace, ksa, gsa):
+        if not gsa:
+            return
+        policy = self._call(gsa, "getIamPolicy")
+        member = self.member(namespace, ksa)
+        bindings = policy.setdefault("bindings", [])
+        binding = next((b for b in bindings if b.get("role") == self.ROLE),
+                       None)
+        if binding is None:
+            binding = {"role": self.ROLE, "members": []}
+            bindings.append(binding)
+        if member in binding.setdefault("members", []):
+            return
+        binding["members"].append(member)
+        self._call(gsa, "setIamPolicy", {"policy": policy})
+        log.info("gcp iam: bound %s on %s", member, gsa)
+
+    def unbind(self, namespace, ksa, gsa):
+        if not gsa:
+            return
+        policy = self._call(gsa, "getIamPolicy")
+        member = self.member(namespace, ksa)
+        changed = False
+        bindings = policy.get("bindings", [])
+        for b in bindings:
+            if b.get("role") == self.ROLE and member in b.get("members",
+                                                             []):
+                b["members"].remove(member)
+                changed = True
+        policy["bindings"] = [b for b in bindings if b.get("members")]
+        if changed:
+            self._call(gsa, "setIamPolicy", {"policy": policy})
+            log.info("gcp iam: unbound %s from %s", member, gsa)
+
+
+# --------------------------------------------------------------------- AWS
+
+def _sigv4_headers(method, url, body, service, region, access_key,
+                   secret_key, session_token=None, now=None):
+    """Minimal-but-real AWS Signature V4 (stdlib hmac/hashlib)."""
+    parsed = urllib.parse.urlsplit(url)
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+
+    payload_hash = hashlib.sha256(body).hexdigest()
+    headers = {
+        "host": parsed.netloc,
+        "x-amz-date": amz_date,
+        "content-type": "application/x-www-form-urlencoded",
+    }
+    if session_token:
+        headers["x-amz-security-token"] = session_token
+    signed_headers = ";".join(sorted(headers))
+    canonical = "\n".join([
+        method, parsed.path or "/", parsed.query,
+        "".join(f"{k}:{headers[k]}\n" for k in sorted(headers)),
+        signed_headers, payload_hash])
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical.encode()).hexdigest()])
+
+    def _hmac(key, msg):
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = _hmac(("AWS4" + secret_key).encode(), datestamp)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    k = _hmac(k, "aws4_request")
+    signature = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+
+    out = {k.title(): v for k, v in headers.items() if k != "host"}
+    out["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed_headers}, Signature={signature}")
+    return out
+
+
+class AwsIamClient:
+    """Edits a role's assume-role (trust) policy for IRSA.
+
+    A statement with ``Sid kubeflow-<ns>`` lets the cluster's OIDC
+    provider assume the role for that namespace's tenant service
+    accounts (plugin_iam.go:36-121 semantics; sub format
+    ``system:serviceaccount:<ns>:<sa>``).
+    """
+
+    def __init__(self, oidc_provider_arn, issuer,
+                 base_url="https://iam.amazonaws.com", region="us-east-1",
+                 access_key=None, secret_key=None, session_token=None,
+                 service_accounts=("default-editor", "default-viewer")):
+        self.oidc_provider_arn = oidc_provider_arn
+        self.issuer = issuer.removeprefix("https://")
+        self.base_url = base_url.rstrip("/")
+        self.region = region
+        self.access_key = access_key or os.environ.get(
+            "AWS_ACCESS_KEY_ID", "")
+        self.secret_key = secret_key or os.environ.get(
+            "AWS_SECRET_ACCESS_KEY", "")
+        self.session_token = session_token or os.environ.get(
+            "AWS_SESSION_TOKEN")
+        self.service_accounts = tuple(service_accounts)
+
+    # ------------------------------------------------------------ wire
+
+    def _call(self, action, params):
+        body = urllib.parse.urlencode(
+            {"Action": action, "Version": "2010-05-08", **params}).encode()
+        headers = _sigv4_headers(
+            "POST", self.base_url + "/", body, "iam", self.region,
+            self.access_key, self.secret_key, self.session_token)
+        req = urllib.request.Request(self.base_url + "/", data=body,
+                                     headers=headers, method="POST")
+        return _http(req)
+
+    @staticmethod
+    def role_name(arn):
+        # arn:aws:iam::<acct>:role/<path...>/<name>
+        return arn.rsplit("/", 1)[-1]
+
+    def _get_trust_policy(self, role_name):
+        xml_body = self._call("GetRole", {"RoleName": role_name})
+        root = ET.fromstring(xml_body)
+        doc = root.find(".//{*}AssumeRolePolicyDocument")
+        if doc is None or not doc.text:
+            return {"Version": "2012-10-17", "Statement": []}
+        return json.loads(urllib.parse.unquote(doc.text))
+
+    def _put_trust_policy(self, role_name, policy):
+        self._call("UpdateAssumeRolePolicy", {
+            "RoleName": role_name,
+            "PolicyDocument": json.dumps(policy)})
+
+    # ------------------------------------------------------------ seam
+
+    def _sid(self, namespace):
+        return f"kubeflow-{namespace}"
+
+    def _statement(self, namespace):
+        subs = [f"system:serviceaccount:{namespace}:{sa}"
+                for sa in self.service_accounts]
+        return {
+            "Sid": self._sid(namespace),
+            "Effect": "Allow",
+            "Principal": {"Federated": self.oidc_provider_arn},
+            "Action": "sts:AssumeRoleWithWebIdentity",
+            "Condition": {"StringEquals": {f"{self.issuer}:sub": subs}},
+        }
+
+    def attach_trust(self, namespace, role_arn):
+        if not role_arn:
+            return
+        name = self.role_name(role_arn)
+        policy = self._get_trust_policy(name)
+        stmts = policy.setdefault("Statement", [])
+        wanted = self._statement(namespace)
+        existing = next((s for s in stmts
+                         if s.get("Sid") == wanted["Sid"]), None)
+        if existing == wanted:
+            return
+        if existing is not None:
+            stmts.remove(existing)
+        stmts.append(wanted)
+        self._put_trust_policy(name, policy)
+        log.info("aws iam: trust for ns %s attached to %s", namespace,
+                 role_arn)
+
+    def detach_trust(self, namespace, role_arn):
+        if not role_arn:
+            return
+        name = self.role_name(role_arn)
+        policy = self._get_trust_policy(name)
+        stmts = policy.get("Statement", [])
+        kept = [s for s in stmts if s.get("Sid") != self._sid(namespace)]
+        if len(kept) != len(stmts):
+            policy["Statement"] = kept
+            self._put_trust_policy(name, policy)
+            log.info("aws iam: trust for ns %s detached from %s",
+                     namespace, role_arn)
+
+
+def clients_from_env():
+    """Build the clients the profile-controller entrypoint wires in when
+    the platform env enables them:
+
+    - ``GCP_WORKLOAD_IDENTITY_POOL=<project>.svc.id.goog`` → GcpIamClient
+    - ``AWS_OIDC_PROVIDER_ARN`` + ``AWS_OIDC_ISSUER`` → AwsIamClient
+      (region via ``AWS_REGION``)
+    Returns (gcp_client_or_None, aws_client_or_None).
+    """
+    gcp = aws = None
+    pool = os.environ.get("GCP_WORKLOAD_IDENTITY_POOL")
+    if pool:
+        gcp = GcpIamClient(pool)
+    provider = os.environ.get("AWS_OIDC_PROVIDER_ARN")
+    issuer = os.environ.get("AWS_OIDC_ISSUER")
+    if provider and issuer:
+        aws = AwsIamClient(provider, issuer,
+                           region=os.environ.get("AWS_REGION",
+                                                 "us-east-1"))
+    return gcp, aws

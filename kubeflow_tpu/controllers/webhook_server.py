@@ -62,11 +62,15 @@ class WebhookServer:
     """Route path → hook callable; serves HTTPS when cert files exist
     (plain HTTP for tests/dev)."""
 
-    def __init__(self, hooks, cert_file=None, key_file=None):
+    def __init__(self, hooks, cert_file=None, key_file=None,
+                 cert_reload_interval=30.0):
         self.hooks = dict(hooks)  # {"/apply-poddefault": hook, ...}
         self.cert_file = cert_file or os.environ.get("TLS_CERT_FILE")
         self.key_file = key_file or os.environ.get("TLS_KEY_FILE")
+        self.cert_reload_interval = cert_reload_interval
         self._httpd = None
+        self._ssl_ctx = None
+        self._stop = threading.Event()
 
     def _handler(self):
         hooks = self.hooks
@@ -106,12 +110,48 @@ class WebhookServer:
         if self.cert_file and os.path.exists(self.cert_file):
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(self.cert_file, self.key_file)
+            self._ssl_ctx = ctx
             self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
                                                  server_side=True)
+            # cert hot-reload: cert-manager rotates the mounted secret;
+            # new handshakes must pick up the new chain without a pod
+            # restart (reference certwatcher,
+            # admission-webhook/config.go:42-60 — fsnotify there, mtime
+            # polling here: dependency-free, same effect at rotation
+            # timescales)
+            threading.Thread(target=self._watch_certs, daemon=True,
+                             name="webhook-certwatcher").start()
         threading.Thread(target=self._httpd.serve_forever,
                          daemon=True).start()
         return self._httpd.server_address[1]
 
+    def _cert_mtimes(self):
+        out = []
+        for path in (self.cert_file, self.key_file):
+            try:
+                out.append(os.stat(path).st_mtime_ns)
+            except OSError:
+                out.append(None)
+        return tuple(out)
+
+    def _watch_certs(self):
+        last = self._cert_mtimes()
+        while not self._stop.wait(self.cert_reload_interval):
+            current = self._cert_mtimes()
+            if current == last or None in current:
+                continue
+            try:
+                # live reload: subsequent handshakes serve the new chain
+                self._ssl_ctx.load_cert_chain(self.cert_file,
+                                              self.key_file)
+                last = current
+                log.info("webhook TLS certificate reloaded")
+            except (ssl.SSLError, OSError):
+                # half-written during rotation — retry next tick
+                log.warning("webhook TLS reload failed; will retry",
+                            exc_info=True)
+
     def stop(self):
+        self._stop.set()
         if self._httpd:
             self._httpd.shutdown()

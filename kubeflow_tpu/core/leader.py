@@ -55,7 +55,7 @@ def _iso(ts):
 
 
 class LeaderElector:
-    def __init__(self, store, lease_name, namespace="kubeflow-system",
+    def __init__(self, store, lease_name, namespace="kubeflow",
                  identity=None, lease_duration=15.0, renew_deadline=10.0,
                  retry_period=2.0, clock=time.time):
         if renew_deadline >= lease_duration:
@@ -87,12 +87,28 @@ class LeaderElector:
         tolerates apiserver hiccups the same way)."""
         try:
             return self._acquire_or_renew_once()
-        except (ConflictError, AlreadyExistsError, NotFoundError):
+        except (ConflictError, AlreadyExistsError):
+            return False                    # lost a write race: normal
+        except NotFoundError:
+            # likely a missing lease namespace (bad POD_NAMESPACE):
+            # retrying is correct but must not be silent — a permanent
+            # standby with healthy probes is an unlogged outage
+            self._log_throttled(
+                "leader election: lease write NotFound in namespace %r "
+                "(check POD_NAMESPACE); retrying" % self.namespace)
             return False
         except Exception:
             log.warning("leader election: %s attempt failed (will retry)",
                         self.identity, exc_info=True)
             return False
+
+    _last_throttled_log = 0.0
+
+    def _log_throttled(self, msg, interval=60.0):
+        now = self.clock()
+        if now - self._last_throttled_log >= interval:
+            self._last_throttled_log = now
+            log.warning(msg)
 
     def _acquire_or_renew_once(self):
         now = self.clock()
@@ -151,6 +167,10 @@ class LeaderElector:
             stop_event.wait(self.retry_period
                             * (0.8 + 0.4 * random.random()))
         if stop_event.is_set():
+            # an acquire may have raced the stop: release (no-op when
+            # not holder) so the replacement isn't stuck for a full
+            # lease_duration
+            self.release()
             return
         self.is_leader.set()
         log.info("leader election: %s acquired %s/%s", self.identity,

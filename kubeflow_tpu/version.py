@@ -1,0 +1,5 @@
+"""Package version — kept in sync with releasing/version/VERSION by
+releasing/release.sh (reference: releasing/version/VERSION v1.7.0);
+tests/test_releasing.py gates the sync."""
+
+__version__ = "0.2.0"

@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# Build the images/ tree in dependency order with release tags.
+#
+# TPU-native analogue of the reference's image release pipeline
+# (py/kubeflow/kubeflow/ci/notebook_servers/* kaniko DAGs): parents
+# before children, every child pinned to the parent tag via BASE_IMAGE.
+#
+# Usage:
+#   releasing/build_images.sh [--push] [--dry-run] [--registry ORG]
+#
+# --dry-run prints the exact build/push plan and exits 0 without a
+# container engine — the CI sanity path in environments without docker.
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+VERSION="$(cat "$REPO/releasing/version/VERSION")"
+REGISTRY="${REGISTRY:-kubeflowtpu}"
+PUSH=false
+DRY=false
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --push) PUSH=true ;;
+    --dry-run) DRY=true ;;
+    --registry) REGISTRY="$2"; shift ;;
+    *) echo "unknown flag $1" >&2; exit 2 ;;
+  esac
+  shift
+done
+
+# dependency order: parents first; "child parent" pairs
+ORDER=(
+  "base -"
+  "auth-proxy -"
+  "jupyter base"
+  "codeserver base"
+  "jupyter-jax-tpu jupyter"
+  "jupyter-pytorch-xla-tpu jupyter"
+  "jupyter-jax-tpu-full jupyter-jax-tpu"
+)
+
+ENGINE=""
+for candidate in docker podman; do
+  if command -v "$candidate" >/dev/null 2>&1; then ENGINE="$candidate"; break; fi
+done
+
+run() {
+  echo "+ $*"
+  if ! $DRY; then "$@"; fi
+}
+
+if ! $DRY && [[ -z "$ENGINE" ]]; then
+  echo "no container engine (docker/podman) found; use --dry-run" >&2
+  exit 3
+fi
+
+for entry in "${ORDER[@]}"; do
+  name="${entry% *}"
+  parent="${entry#* }"
+  tag="$REGISTRY/$name:$VERSION"
+  args=(build -t "$tag" -t "$REGISTRY/$name:latest")
+  if [[ "$parent" != "-" ]]; then
+    args+=(--build-arg "BASE_IMAGE=$REGISTRY/$parent:$VERSION")
+  fi
+  args+=("$REPO/images/$name")
+  run ${ENGINE:-docker} "${args[@]}"
+  if $PUSH; then
+    run ${ENGINE:-docker} push "$tag"
+  fi
+done
+
+echo "built ${#ORDER[@]} images at $REGISTRY/*:$VERSION (push=$PUSH)"

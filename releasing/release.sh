@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Cut a release: bump VERSION, regenerate manifests with the new tag,
+# commit, and git-tag. (Reference: releasing/version/VERSION + release
+# scripts; the tag triggers .github/workflows/release.yaml which builds
+# and pushes the image tree.)
+#
+# Usage: releasing/release.sh v0.3.0
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+NEW="${1:?usage: release.sh vX.Y.Z}"
+[[ "$NEW" =~ ^v[0-9]+\.[0-9]+\.[0-9]+$ ]] || {
+  echo "version must look like vX.Y.Z, got '$NEW'" >&2; exit 2; }
+
+OLD="$(cat "$REPO/releasing/version/VERSION")"
+echo "$NEW" > "$REPO/releasing/version/VERSION"
+
+# keep the package's importable version in sync (tested in CI)
+sed -i "s/^__version__ = .*/__version__ = \"${NEW#v}\"/" \
+  "$REPO/kubeflow_tpu/version.py"
+
+python "$REPO/hack/gen_manifests.py"
+
+git -C "$REPO" add releasing/version/VERSION kubeflow_tpu/version.py manifests
+git -C "$REPO" commit -m "Release $NEW (was $OLD)"
+git -C "$REPO" tag -a "$NEW" -m "kubeflow-tpu $NEW"
+
+cat <<EOF
+Release $NEW prepared.
+  push:   git push origin main $NEW
+  images: built+pushed by CI on the tag, or locally:
+          releasing/build_images.sh --push
+EOF

@@ -1,0 +1,256 @@
+"""Cloud IAM clients against local fakes (plugin_iam.go /
+plugin_workload_identity.go behavior parity, no cloud SDKs)."""
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from kubeflow_tpu.controllers.cloud_iam import (
+    AwsIamClient, CloudIamError, GcpIamClient)
+
+
+class FakeGcpIam:
+    """getIamPolicy/setIamPolicy for service accounts, in memory."""
+
+    def __init__(self):
+        self.policies = {}
+        self.auth_headers = []
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                fake.auth_headers.append(
+                    self.headers.get("Authorization", ""))
+                path = urllib.parse.unquote(self.path)
+                gsa, verb = path.rsplit(":", 1)
+                gsa = gsa.rsplit("/", 1)[-1]
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if verb == "getIamPolicy":
+                    out = fake.policies.get(gsa, {"etag": "e0"})
+                elif verb == "setIamPolicy":
+                    fake.policies[gsa] = out = body["policy"]
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                data = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+class FakeAwsIam:
+    """IAM Query API: GetRole / UpdateAssumeRolePolicy, XML responses."""
+
+    def __init__(self):
+        self.trust = {}            # role name -> policy dict
+        self.auth_headers = []
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                fake.auth_headers.append(
+                    self.headers.get("Authorization", ""))
+                length = int(self.headers.get("Content-Length") or 0)
+                params = dict(urllib.parse.parse_qsl(
+                    self.rfile.read(length).decode()))
+                action = params.get("Action")
+                if action == "GetRole":
+                    name = params["RoleName"]
+                    if name not in fake.trust:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    doc = urllib.parse.quote(
+                        json.dumps(fake.trust[name]))
+                    body = (
+                        "<GetRoleResponse><GetRoleResult><Role>"
+                        f"<RoleName>{name}</RoleName>"
+                        f"<AssumeRolePolicyDocument>{doc}"
+                        "</AssumeRolePolicyDocument>"
+                        "</Role></GetRoleResult></GetRoleResponse>"
+                    ).encode()
+                elif action == "UpdateAssumeRolePolicy":
+                    fake.trust[params["RoleName"]] = json.loads(
+                        params["PolicyDocument"])
+                    body = b"<UpdateAssumeRolePolicyResponse/>"
+                else:
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+# ----------------------------------------------------------------- GCP
+
+@pytest.fixture()
+def gcp():
+    fake = FakeGcpIam()
+    client = GcpIamClient("proj.svc.id.goog", base_url=fake.url,
+                          token_provider=lambda: "tok-123")
+    yield fake, client
+    fake.close()
+
+
+class TestGcpIamClient:
+    def test_bind_creates_binding_and_is_idempotent(self, gcp):
+        fake, client = gcp
+        client.bind("team-a", "default-editor", "gsa@proj.iam")
+        pol = fake.policies["gsa@proj.iam"]
+        assert pol["bindings"] == [{
+            "role": "roles/iam.workloadIdentityUser",
+            "members":
+                ["serviceAccount:proj.svc.id.goog[team-a/default-editor]"],
+        }]
+        n_calls = len(fake.auth_headers)
+        client.bind("team-a", "default-editor", "gsa@proj.iam")
+        # second bind: read-only (no setIamPolicy)
+        assert len(fake.auth_headers) == n_calls + 1
+        assert all(h == "Bearer tok-123" for h in fake.auth_headers)
+
+    def test_bind_appends_to_existing_binding(self, gcp):
+        fake, client = gcp
+        client.bind("a", "default-editor", "g@x")
+        client.bind("b", "default-editor", "g@x")
+        members = fake.policies["g@x"]["bindings"][0]["members"]
+        assert len(members) == 2
+
+    def test_unbind_removes_and_drops_empty_binding(self, gcp):
+        fake, client = gcp
+        client.bind("a", "default-editor", "g@x")
+        client.unbind("a", "default-editor", "g@x")
+        assert fake.policies["g@x"]["bindings"] == []
+
+    def test_empty_gsa_is_noop(self, gcp):
+        fake, client = gcp
+        client.bind("a", "default-editor", "")
+        assert fake.auth_headers == []
+
+
+# ----------------------------------------------------------------- AWS
+
+ROLE_ARN = "arn:aws:iam::123456789012:role/kf-notebooks"
+
+
+@pytest.fixture()
+def aws():
+    fake = FakeAwsIam()
+    fake.trust["kf-notebooks"] = {"Version": "2012-10-17", "Statement": []}
+    client = AwsIamClient(
+        "arn:aws:iam::123456789012:oidc-provider/oidc.eks.example",
+        "https://oidc.eks.example", base_url=fake.url,
+        access_key="AKIAFAKE", secret_key="secretfake")
+    yield fake, client
+    fake.close()
+
+
+class TestAwsIamClient:
+    def test_attach_adds_irsa_statement(self, aws):
+        fake, client = aws
+        client.attach_trust("team-a", ROLE_ARN)
+        stmts = fake.trust["kf-notebooks"]["Statement"]
+        assert len(stmts) == 1
+        s = stmts[0]
+        assert s["Sid"] == "kubeflow-team-a"
+        assert s["Principal"]["Federated"].endswith("oidc.eks.example")
+        assert s["Action"] == "sts:AssumeRoleWithWebIdentity"
+        assert s["Condition"]["StringEquals"]["oidc.eks.example:sub"] == [
+            "system:serviceaccount:team-a:default-editor",
+            "system:serviceaccount:team-a:default-viewer"]
+
+    def test_attach_idempotent_and_updates_stale(self, aws):
+        fake, client = aws
+        client.attach_trust("team-a", ROLE_ARN)
+        n = len(fake.auth_headers)
+        client.attach_trust("team-a", ROLE_ARN)   # identical: GetRole only
+        assert len(fake.auth_headers) == n + 1
+        # stale statement (different subs) is replaced, not duplicated
+        fake.trust["kf-notebooks"]["Statement"][0]["Condition"] = {}
+        client.attach_trust("team-a", ROLE_ARN)
+        stmts = fake.trust["kf-notebooks"]["Statement"]
+        assert len(stmts) == 1 and stmts[0]["Condition"]
+
+    def test_detach_removes_only_this_namespace(self, aws):
+        fake, client = aws
+        client.attach_trust("team-a", ROLE_ARN)
+        client.attach_trust("team-b", ROLE_ARN)
+        client.detach_trust("team-a", ROLE_ARN)
+        sids = [s["Sid"] for s in fake.trust["kf-notebooks"]["Statement"]]
+        assert sids == ["kubeflow-team-b"]
+
+    def test_requests_are_sigv4_signed(self, aws):
+        fake, client = aws
+        client.attach_trust("team-a", ROLE_ARN)
+        for h in fake.auth_headers:
+            assert h.startswith("AWS4-HMAC-SHA256 Credential=AKIAFAKE/")
+            assert "SignedHeaders=" in h and "Signature=" in h
+
+    def test_missing_role_raises(self, aws):
+        fake, client = aws
+        with pytest.raises(CloudIamError):
+            client.attach_trust(
+                "x", "arn:aws:iam::123456789012:role/doesnotexist")
+
+
+# --------------------------------------------------- plugin integration
+
+def test_plugins_drive_real_clients(gcp, aws):
+    """ProfilePlugin seams + concrete clients + ObjectStore end to end."""
+    from kubeflow_tpu import api
+    from kubeflow_tpu.controllers import profile as prof
+    from kubeflow_tpu.core import ObjectStore
+
+    store = ObjectStore()
+    api.register_all(store)
+    store.create({"apiVersion": "v1", "kind": "ServiceAccount",
+                  "metadata": {"name": "default-editor",
+                               "namespace": "team-a"}})
+    profile_obj = {"apiVersion": "kubeflow.org/v1", "kind": "Profile",
+                   "metadata": {"name": "team-a"}}
+
+    gcp_fake, gcp_client = gcp
+    plugin = prof.WorkloadIdentityPlugin(iam_client=gcp_client)
+    plugin.apply(store, profile_obj, {"gcpServiceAccount": "g@x"})
+    sa = store.get("v1", "ServiceAccount", "default-editor", "team-a")
+    assert sa["metadata"]["annotations"][
+        "iam.gke.io/gcp-service-account"] == "g@x"
+    assert gcp_fake.policies["g@x"]["bindings"][0]["members"] == [
+        "serviceAccount:proj.svc.id.goog[team-a/default-editor]"]
+
+    aws_fake, aws_client = aws
+    aplugin = prof.AwsIamPlugin(iam_client=aws_client)
+    aplugin.apply(store, profile_obj, {"awsIamRole": ROLE_ARN})
+    assert aws_fake.trust["kf-notebooks"]["Statement"][0][
+        "Sid"] == "kubeflow-team-a"
+    aplugin.revoke(store, profile_obj, {"awsIamRole": ROLE_ARN})
+    assert aws_fake.trust["kf-notebooks"]["Statement"] == []

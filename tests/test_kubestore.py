@@ -269,13 +269,13 @@ class TestLeaderElectionOverKubeStore:
                           renew_deadline=10, clock=lambda: now[0])
         assert a.try_acquire_or_renew() is True
         assert b.try_acquire_or_renew() is False
-        lease = store.get(LEASE_API, "Lease", "ctl", "kubeflow-system")
+        lease = store.get(LEASE_API, "Lease", "ctl", "kubeflow")
         assert lease["spec"]["holderIdentity"] == "a"
         now[0] += 20
         assert b.try_acquire_or_renew() is True
-        lease = store.get(LEASE_API, "Lease", "ctl", "kubeflow-system")
+        lease = store.get(LEASE_API, "Lease", "ctl", "kubeflow")
         assert lease["spec"]["holderIdentity"] == "b"
         assert lease["spec"]["leaseTransitions"] == 1
         a.release()  # not holder: must be a no-op
         assert store.get(LEASE_API, "Lease", "ctl",
-                         "kubeflow-system")["spec"]["holderIdentity"] == "b"
+                         "kubeflow")["spec"]["holderIdentity"] == "b"

@@ -57,13 +57,13 @@ def test_acquire_renew_takeover_with_fake_clock():
     assert e2.try_acquire_or_renew() is False         # held + fresh
     now[0] += 5
     assert e1.try_acquire_or_renew() is True          # renew
-    lease = store.get(LEASE_API, "Lease", "l", "kubeflow-system")
+    lease = store.get(LEASE_API, "Lease", "l", "kubeflow")
     assert lease["spec"]["holderIdentity"] == "a"
     assert lease["spec"]["leaseTransitions"] == 0
 
     now[0] += 16                                      # a's renew expired
     assert e2.try_acquire_or_renew() is True          # takeover
-    lease = store.get(LEASE_API, "Lease", "l", "kubeflow-system")
+    lease = store.get(LEASE_API, "Lease", "l", "kubeflow")
     assert lease["spec"]["holderIdentity"] == "b"
     assert lease["spec"]["leaseTransitions"] == 1
     assert e1.try_acquire_or_renew() is False         # a lost it
@@ -151,7 +151,7 @@ def test_lost_lease_stops_manager_and_fires_callback():
         # usurp the lease (simulates e.g. apiserver partition: renewals
         # start failing as conflicts / foreign holder)
         lease = store.get(LEASE_API, "Lease", "mgr-lease",
-                          "kubeflow-system")
+                          "kubeflow")
         lease["spec"]["holderIdentity"] = "z"
         lease["spec"]["renewTime"] = lease["spec"]["acquireTime"]
         lease["spec"]["leaseDurationSeconds"] = 3600
@@ -160,7 +160,7 @@ def test_lost_lease_stops_manager_and_fires_callback():
         assert lost.wait(5), "on_leadership_lost fires"
         assert not mgr.is_leader
         lease = store.get(LEASE_API, "Lease", "mgr-lease",
-                          "kubeflow-system")
+                          "kubeflow")
         assert lease["spec"]["holderIdentity"] == "z"
     finally:
         mgr.stop()

@@ -1,0 +1,74 @@
+"""Releasing subsystem: VERSION/version sync, image build plan,
+manifest tags (reference releasing/version/VERSION + image DAGs)."""
+
+import os
+import re
+import subprocess
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _version():
+    with open(os.path.join(REPO, "releasing", "version", "VERSION")) as f:
+        return f.read().strip()
+
+
+def test_version_file_format():
+    assert re.fullmatch(r"v\d+\.\d+\.\d+", _version())
+
+
+def test_package_version_in_sync():
+    from kubeflow_tpu.version import __version__
+    assert _version() == "v" + __version__
+
+
+def test_build_plan_covers_image_tree_in_dependency_order():
+    out = subprocess.run(
+        [os.path.join(REPO, "releasing", "build_images.sh"), "--dry-run"],
+        capture_output=True, text=True, check=True).stdout
+    # every images/ dir with a Dockerfile appears in the plan
+    dirs = sorted(d for d in os.listdir(os.path.join(REPO, "images"))
+                  if os.path.exists(
+                      os.path.join(REPO, "images", d, "Dockerfile")))
+    planned = re.findall(r"-t kubeflowtpu/([\w-]+):" + re.escape(_version()),
+                         out)
+    assert sorted(planned) == dirs, (planned, dirs)
+    # parents build before children
+    order = {name: i for i, name in enumerate(planned)}
+    for child, parent in [("jupyter", "base"), ("codeserver", "base"),
+                          ("jupyter-jax-tpu", "jupyter"),
+                          ("jupyter-pytorch-xla-tpu", "jupyter"),
+                          ("jupyter-jax-tpu-full", "jupyter-jax-tpu")]:
+        assert order[parent] < order[child]
+        assert f"BASE_IMAGE=kubeflowtpu/{parent}:{_version()}" in out
+
+
+def test_manifest_images_pinned_to_release_tag():
+    tag = _version()
+    bad = []
+    mdir = os.path.join(REPO, "manifests")
+    for root, _, files in os.walk(mdir):
+        for fn in files:
+            if not fn.endswith(".yaml"):
+                continue
+            with open(os.path.join(root, fn)) as f:
+                for doc in yaml.safe_load_all(f):
+                    for img in _images(doc):
+                        if img.startswith("kubeflowtpu/") \
+                                and not img.endswith(":" + tag):
+                            bad.append((fn, img))
+    assert not bad, bad
+
+
+def _images(doc):
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            if k == "image" and isinstance(v, str):
+                yield v
+            else:
+                yield from _images(v)
+    elif isinstance(doc, list):
+        for item in doc:
+            yield from _images(item)
