@@ -1,0 +1,91 @@
+"""Dev server: the full platform in one process for UI work/browser E2E.
+
+Boots the in-process store + every reconciler (incl. the fake-kubelet
+workload runtime so pods actually 'run'), seeds a tenant, and serves
+all four web apps. The browser tier (tests/browser/, or a human) drives
+exactly the §3.1 call stack: spawn → reconcile → ready → stop → delete.
+
+Usage: python hack/devserver.py [base_port]   (default 5601..5604)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("APP_DISABLE_AUTH", "true")
+os.environ.setdefault("APP_SECURE_COOKIES", "false")  # plain-http dev
+os.environ.setdefault("USE_ISTIO", "true")
+
+from kubeflow_tpu import api
+from kubeflow_tpu.controllers import admission, notebook, profile
+from kubeflow_tpu.controllers import tensorboard, tpuslice
+from kubeflow_tpu.controllers.workload_runtime import (
+    DeploymentReconciler, PodRuntimeReconciler, StatefulSetReconciler)
+from kubeflow_tpu.core import Manager, ObjectStore
+from kubeflow_tpu.web import dashboard, jupyter, tensorboards, volumes
+
+
+def build(seed=True):
+    store = ObjectStore()
+    api.register_all(store)
+    admission.PodDefaultWebhook(store).install()
+    mgr = Manager(store)
+    mgr.add(profile.ProfileReconciler())
+    mgr.add(notebook.NotebookReconciler())
+    mgr.add(tensorboard.TensorboardReconciler())
+    mgr.add(tpuslice.TpuSliceReconciler())
+    mgr.add(tpuslice.StudyJobReconciler())
+    mgr.add(StatefulSetReconciler())
+    mgr.add(DeploymentReconciler())
+    mgr.add(PodRuntimeReconciler())
+    if seed:
+        _seed(store)
+    mgr.start()
+    return store, mgr
+
+
+def _seed(store):
+    store.create(api.profile.new("team-a", "anonymous@kubeflow.org"))
+    store.create({
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": "tpu-node-1", "labels": {
+            "cloud.google.com/gke-tpu-accelerator":
+                "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "2x4"}},
+        "status": {"capacity": {"cpu": "16", "memory": "64Gi",
+                                "google.com/tpu": "8"}}})
+    store.create({
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "PodDefault",
+        "metadata": {"name": "gcs-access", "namespace": "team-a"},
+        "spec": {"desc": "Mount GCS credentials",
+                 "selector": {"matchLabels": {"gcs-access": "true"}},
+                 "env": [{"name": "GOOGLE_APPLICATION_CREDENTIALS",
+                          "value": "/secrets/gcs.json"}]}})
+
+
+def main():
+    base = int(sys.argv[1]) if len(sys.argv) > 1 else 5601
+    store, mgr = build()
+    apps = {
+        "jupyter": jupyter.create_app(store),
+        "volumes": volumes.create_app(store),
+        "tensorboards": tensorboards.create_app(store),
+        "dashboard": dashboard.create_app(store),
+    }
+    for i, (name, app) in enumerate(apps.items()):
+        port = base + i
+        app.serve(port=port, host="127.0.0.1")
+        print(f"{name}: http://127.0.0.1:{port}/", flush=True)
+    print("ready", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        mgr.stop()
+
+
+if __name__ == "__main__":
+    main()

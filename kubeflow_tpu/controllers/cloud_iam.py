@@ -32,7 +32,9 @@ log = logging.getLogger("kubeflow_tpu.cloud_iam")
 
 
 class CloudIamError(RuntimeError):
-    pass
+    def __init__(self, message, status=None):
+        super().__init__(message)
+        self.status = status
 
 
 def _http(req, timeout=30):
@@ -42,7 +44,7 @@ def _http(req, timeout=30):
     except urllib.error.HTTPError as e:
         raise CloudIamError(
             f"{req.get_method()} {req.full_url} -> {e.code}: "
-            f"{e.read()[:500]!r}") from e
+            f"{e.read()[:500]!r}", status=e.code) from e
     except urllib.error.URLError as e:
         raise CloudIamError(f"{req.full_url}: {e.reason}") from e
 
@@ -111,7 +113,14 @@ class GcpIamClient:
     def unbind(self, namespace, ksa, gsa):
         if not gsa:
             return
-        policy = self._call(gsa, "getIamPolicy")
+        try:
+            policy = self._call(gsa, "getIamPolicy")
+        except CloudIamError as e:
+            if e.status == 404:     # GSA deleted out-of-band: nothing
+                log.info("gcp iam: %s already gone; unbind is a no-op",
+                         gsa)
+                return              # to revoke — Profile deletion must
+            raise                   # not wedge on it
         member = self.member(namespace, ksa)
         changed = False
         bindings = policy.get("bindings", [])
@@ -170,6 +179,93 @@ def _sigv4_headers(method, url, body, service, region, access_key,
     return out
 
 
+class StaticAwsCredentials:
+    def __init__(self, access_key, secret_key, session_token=None):
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.session_token = session_token
+
+    def get(self):
+        return self
+
+
+class WebIdentityAwsCredentials:
+    """IRSA credential source: exchange the projected service-account
+    token for temporary keys via STS AssumeRoleWithWebIdentity (the
+    call itself is unsigned — the token authenticates it). This is how
+    the controller pod authenticates on EKS with no static keys, the
+    deployment mode the reference's AWS SDK picks up automatically."""
+
+    def __init__(self, role_arn=None, token_file=None,
+                 sts_url="https://sts.amazonaws.com",
+                 session_name="kubeflow-tpu-profile-controller"):
+        self.role_arn = role_arn or os.environ.get("AWS_ROLE_ARN", "")
+        self.token_file = token_file or os.environ.get(
+            "AWS_WEB_IDENTITY_TOKEN_FILE", "")
+        self.sts_url = sts_url.rstrip("/")
+        self.session_name = session_name
+        self._cached = None
+        self._expires = 0.0
+
+    @property
+    def available(self):
+        return bool(self.role_arn and self.token_file
+                    and os.path.exists(self.token_file))
+
+    def get(self):
+        now = datetime.datetime.now(datetime.timezone.utc).timestamp()
+        if self._cached is not None and now < self._expires - 120:
+            return self._cached
+        with open(self.token_file) as f:
+            token = f.read().strip()
+        body = urllib.parse.urlencode({
+            "Action": "AssumeRoleWithWebIdentity",
+            "Version": "2011-06-15",
+            "RoleArn": self.role_arn,
+            "RoleSessionName": self.session_name,
+            "WebIdentityToken": token,
+        }).encode()
+        req = urllib.request.Request(
+            self.sts_url + "/", data=body, method="POST",
+            headers={"Content-Type":
+                     "application/x-www-form-urlencoded",
+                     "Accept": "application/json"})
+        root = ET.fromstring(_http(req))
+        creds = root.find(".//{*}Credentials")
+        if creds is None:
+            raise CloudIamError("STS response had no Credentials")
+        get = lambda tag: creds.findtext("{*}" + tag, "")  # noqa: E731
+        self._cached = StaticAwsCredentials(
+            get("AccessKeyId"), get("SecretAccessKey"),
+            get("SessionToken"))
+        exp = get("Expiration")
+        parsed = None
+        try:
+            parsed = datetime.datetime.fromisoformat(
+                exp.replace("Z", "+00:00")).timestamp()
+        except ValueError:
+            pass
+        self._expires = parsed or (now + 900)
+        return self._cached
+
+
+def default_aws_credentials():
+    """Static env keys, else IRSA web identity, else error with a clear
+    message (an unauthenticatable client must fail loudly at startup,
+    not 403 on every reconcile)."""
+    if os.environ.get("AWS_ACCESS_KEY_ID"):
+        return StaticAwsCredentials(
+            os.environ["AWS_ACCESS_KEY_ID"],
+            os.environ.get("AWS_SECRET_ACCESS_KEY", ""),
+            os.environ.get("AWS_SESSION_TOKEN"))
+    web = WebIdentityAwsCredentials()
+    if web.available:
+        return web
+    raise CloudIamError(
+        "no AWS credentials: set AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY "
+        "or run with IRSA (AWS_ROLE_ARN + AWS_WEB_IDENTITY_TOKEN_FILE)")
+
+
 class AwsIamClient:
     """Edits a role's assume-role (trust) policy for IRSA.
 
@@ -180,19 +276,21 @@ class AwsIamClient:
     """
 
     def __init__(self, oidc_provider_arn, issuer,
-                 base_url="https://iam.amazonaws.com", region="us-east-1",
-                 access_key=None, secret_key=None, session_token=None,
+                 base_url="https://iam.amazonaws.com", region=None,
+                 credentials=None, access_key=None, secret_key=None,
+                 session_token=None,
                  service_accounts=("default-editor", "default-viewer")):
         self.oidc_provider_arn = oidc_provider_arn
         self.issuer = issuer.removeprefix("https://")
         self.base_url = base_url.rstrip("/")
-        self.region = region
-        self.access_key = access_key or os.environ.get(
-            "AWS_ACCESS_KEY_ID", "")
-        self.secret_key = secret_key or os.environ.get(
-            "AWS_SECRET_ACCESS_KEY", "")
-        self.session_token = session_token or os.environ.get(
-            "AWS_SESSION_TOKEN")
+        # the global iam.amazonaws.com endpoint requires a us-east-1
+        # credential scope regardless of where the cluster runs; only a
+        # custom regional endpoint should override this
+        self.region = region or "us-east-1"
+        if access_key or secret_key:
+            credentials = StaticAwsCredentials(
+                access_key or "", secret_key or "", session_token)
+        self.credentials = credentials or default_aws_credentials()
         self.service_accounts = tuple(service_accounts)
 
     # ------------------------------------------------------------ wire
@@ -200,9 +298,10 @@ class AwsIamClient:
     def _call(self, action, params):
         body = urllib.parse.urlencode(
             {"Action": action, "Version": "2010-05-08", **params}).encode()
+        creds = self.credentials.get()
         headers = _sigv4_headers(
             "POST", self.base_url + "/", body, "iam", self.region,
-            self.access_key, self.secret_key, self.session_token)
+            creds.access_key, creds.secret_key, creds.session_token)
         req = urllib.request.Request(self.base_url + "/", data=body,
                                      headers=headers, method="POST")
         return _http(req)
@@ -263,7 +362,14 @@ class AwsIamClient:
         if not role_arn:
             return
         name = self.role_name(role_arn)
-        policy = self._get_trust_policy(name)
+        try:
+            policy = self._get_trust_policy(name)
+        except CloudIamError as e:
+            if e.status == 404:     # role deleted out-of-band: revoke
+                log.info("aws iam: role %s already gone; detach is a "
+                         "no-op", role_arn)
+                return              # must not wedge Profile deletion
+            raise
         stmts = policy.get("Statement", [])
         kept = [s for s in stmts if s.get("Sid") != self._sid(namespace)]
         if len(kept) != len(stmts):
@@ -289,7 +395,12 @@ def clients_from_env():
     provider = os.environ.get("AWS_OIDC_PROVIDER_ARN")
     issuer = os.environ.get("AWS_OIDC_ISSUER")
     if provider and issuer:
-        aws = AwsIamClient(provider, issuer,
-                           region=os.environ.get("AWS_REGION",
-                                                 "us-east-1"))
+        # NOTE: no AWS_REGION here — the global IAM endpoint signs with
+        # a us-east-1 scope; AWS_IAM_ENDPOINT overrides for
+        # GovCloud/China partitions (regional endpoints + region)
+        aws = AwsIamClient(
+            provider, issuer,
+            base_url=os.environ.get("AWS_IAM_ENDPOINT",
+                                    "https://iam.amazonaws.com"),
+            region=os.environ.get("AWS_IAM_SIGNING_REGION"))
     return gcp, aws

@@ -48,41 +48,6 @@ class StoreMetricsService(MetricsService):
                  "value": series.get(metric, 0)}]
 
 
-_HOME_PAGE = """<!doctype html>
-<html><head><meta charset="utf-8"><title>Kubeflow TPU</title><style>
-* { font-family: system-ui, sans-serif; }
-body { margin: 0; background: #f5f7fa; }
-header { background: #1e88e5; color: #fff; padding: 14px 24px; }
-main { max-width: 900px; margin: 24px auto; }
-.cards { display: grid; grid-template-columns: repeat(3, 1fr);
-         gap: 16px; }
-a.card { background: #fff; border-radius: 6px; padding: 18px;
-         text-decoration: none; color: #222;
-         box-shadow: 0 1px 3px rgba(0,0,0,.15); }
-a.card h3 { margin: 0 0 6px; color: #1e88e5; }
-#who { margin: 12px 0; color: #555; }
-</style></head><body>
-<header><h1>Kubeflow TPU</h1></header>
-<main>
-  <div id="who"></div>
-  <div class="cards">
-    <a class="card" href="/jupyter/"><h3>Notebooks</h3>
-      Spawn Jupyter servers on TPU pod slices</a>
-    <a class="card" href="/volumes/"><h3>Volumes</h3>
-      Manage workspace and data PVCs</a>
-    <a class="card" href="/tensorboards/"><h3>Tensorboards</h3>
-      Visualize runs and TPU profiler traces</a>
-  </div>
-</main>
-<script>
-fetch("/api/env-info").then(r => r.json()).then(info => {
-  document.getElementById("who").textContent =
-    `signed in as ${info.user} - namespaces: ` +
-    info.namespaces.map(n => `${n.namespace} (${n.role})`).join(", ");
-});
-</script>
-</body></html>
-"""
 
 
 def create_app(store, metrics_service=None):
@@ -95,13 +60,10 @@ def create_app(store, metrics_service=None):
     def healthz(request):
         return {"status": "ok"}
 
-    @app.get("/")
-    def index(request):
-        # landing page: namespace cards + links to the apps the mesh
-        # routes (reference main-page + iframe-container, Polymer SPA)
-        from .http import Response
-        return Response(_HOME_PAGE, headers={
-            "Content-Type": "text/html; charset=utf-8"})
+    # landing SPA (reference main-page + iframe-container): shared
+    # component library + apps/dashboard.js
+    from . import frontend
+    frontend.install(app, "Kubeflow TPU", "dashboard")
 
     @app.get("/api/env-info")
     def env_info(request):

@@ -1,279 +1,55 @@
-"""Frontend: server-rendered single-page UIs for each web app.
+"""Frontend shell: serves the shared SPA component library per app.
 
-The reference ships three Angular SPAs + a Polymer dashboard
-(SURVEY.md §2#21-23, ~30k LoC of TS) built around one shared component
-library (resource-table, namespace-select, status-icon, confirm-dialog).
-This rebuild keeps that architecture — one shared UI engine, one config
-per app — but as a no-build-step vanilla-JS page served by each
-backend, talking to the same REST routes the Angular apps called. The
-engine provides: namespace selector, polling resource table with status
-icons, create form, row actions (connect/start/stop/delete) with
-confirm, CSRF handling (reads the XSRF-TOKEN cookie, echoes the
-header — crud_backend contract).
+The reference ships three Angular SPAs + a Polymer dashboard built on
+one shared component library (kubeflow-common-lib: resource-table,
+namespace-select, status-icon, confirm-dialog, logs-viewer, form
+controls — SURVEY.md §2#21-23). This rebuild keeps that architecture
+with no build step: ``static/lib/{core,components}.js`` is the common
+library (ES modules), ``static/apps/<app>.js`` is each app's page set
+(index / create form / details with logs+events tabs), and every
+backend serves the same HTML shell pointing at its app module. The
+SPAs talk to the identical REST routes the Angular apps called, with
+the crud_backend CSRF double-submit contract.
 """
 
-import json
+import os
 
 from .http import Response
 
-_PAGE = """<!doctype html>
+STATIC_DIR = os.path.join(os.path.dirname(__file__), "static")
+
+_SHELL = """<!doctype html>
 <html>
 <head>
 <meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
 <title>{title}</title>
-<style>
-:root {{ --kf: #1e88e5; --bg: #f5f7fa; }}
-* {{ box-sizing: border-box; font-family: system-ui, sans-serif; }}
-body {{ margin: 0; background: var(--bg); }}
-header {{ background: var(--kf); color: #fff; padding: 10px 20px;
-          display: flex; align-items: center; gap: 16px; }}
-header h1 {{ font-size: 18px; margin: 0; flex: 1; }}
-header select {{ padding: 4px 8px; }}
-main {{ padding: 20px; max-width: 1100px; margin: 0 auto; }}
-table {{ width: 100%; border-collapse: collapse; background: #fff;
-         box-shadow: 0 1px 3px rgba(0,0,0,.15); }}
-th, td {{ text-align: left; padding: 8px 12px;
-          border-bottom: 1px solid #eee; font-size: 14px; }}
-th {{ background: #fafafa; }}
-.status-ready {{ color: #2e7d32; }} .status-waiting {{ color: #f9a825; }}
-.status-warning {{ color: #c62828; }} .status-stopped {{ color: #757575; }}
-button {{ border: 0; border-radius: 4px; padding: 6px 10px;
-          cursor: pointer; margin-right: 4px; }}
-button.primary {{ background: var(--kf); color: #fff; }}
-button.danger {{ background: #c62828; color: #fff; }}
-#new-form {{ background: #fff; padding: 16px; margin-bottom: 16px;
-             box-shadow: 0 1px 3px rgba(0,0,0,.15); display: none; }}
-#new-form label {{ display: block; margin: 8px 0 2px; font-size: 13px; }}
-#new-form input, #new-form select {{ width: 320px; padding: 5px; }}
-#error {{ color: #c62828; padding: 8px 0; }}
-</style>
+<link rel="stylesheet" href="static/kubeflow.css">
 </head>
 <body>
-<header>
+<header class="kf-appbar">
   <h1>{title}</h1>
-  <label>namespace
-    <select id="ns-select"></select>
-  </label>
+  <a href="/">Dashboard</a>
 </header>
-<main>
-  <div id="error"></div>
-  <button class="primary" onclick="toggleForm()">+ New {kind}</button>
-  <div id="new-form"></div>
-  <table>
-    <thead id="table-head"></thead>
-    <tbody id="table-body"></tbody>
-  </table>
-</main>
-<script>
-const CFG = {config};
-let NS = localStorage.getItem("ns") || "";
-
-function esc(v) {{
-  return String(v).replace(/[&<>"']/g, c => ({{"&": "&amp;",
-    "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"}})[c]);
-}}
-function csrf() {{
-  const m = document.cookie.match(/XSRF-TOKEN=([^;]+)/);
-  return m ? {{"X-XSRF-TOKEN": m[1]}} : {{}};
-}}
-async function call(method, path, body) {{
-  const resp = await fetch(path, {{
-    method, headers: {{"Content-Type": "application/json", ...csrf()}},
-    body: body ? JSON.stringify(body) : undefined }});
-  const data = await resp.json();
-  if (!resp.ok) throw new Error(data.log || resp.statusText);
-  return data;
-}}
-function err(e) {{
-  document.getElementById("error").textContent = e ? String(e) : "";
-}}
-async function loadNamespaces() {{
-  const data = await call("GET", "api/namespaces");
-  const names = data.namespaces || data;
-  const sel = document.getElementById("ns-select");
-  sel.innerHTML = names.map(n => `<option>${{n}}</option>`).join("");
-  if (names.includes(NS)) sel.value = NS; else NS = names[0] || "";
-  sel.onchange = () => {{ NS = sel.value;
-                          localStorage.setItem("ns", NS); refresh(); }};
-}}
-function cell(row, col) {{
-  const v = col.path.split(".").reduce((o, k) => (o || {{}})[k], row);
-  if (col.status) {{
-    const phase = esc((v || {{}}).phase || "waiting");
-    return `<span class="status-${{phase}}">&#9679; ${{phase}}</span>`;
-  }}
-  return esc(typeof v === "object" ? JSON.stringify(v || {{}})
-                                   : (v ?? ""));
-}}
-async function refresh() {{
-  err("");
-  if (!NS) return;
-  document.getElementById("table-head").innerHTML = "<tr>" +
-    CFG.columns.map(c => `<th>${{c.label}}</th>`).join("") +
-    "<th>actions</th></tr>";
-  try {{
-    const data = await call("GET",
-        CFG.listPath.replaceAll("{{ns}}", NS));
-    const rows = data[CFG.listKey] || [];
-    document.getElementById("table-body").innerHTML = rows.map(r => {{
-      const name = esc(r.name);
-      const actions = CFG.actions.map(a =>
-        `<button class="${{a.cls}}" ` +
-        `onclick='act("${{a.id}}", "${{name}}")'>${{a.label}}</button>`
-      ).join("");
-      return "<tr>" + CFG.columns.map(c =>
-        `<td>${{cell(r, c)}}</td>`).join("") +
-        `<td>${{actions}}</td></tr>`;
-    }}).join("");
-  }} catch (e) {{ err(e); }}
-}}
-async function act(id, name) {{
-  const a = CFG.actions.find(x => x.id === id);
-  if (a.confirm && !confirm(`${{a.label}} ${{name}}?`)) return;
-  try {{
-    await call(a.method,
-        a.path.replaceAll("{{ns}}", NS).replaceAll("{{name}}", name),
-        a.body || undefined);
-    refresh();
-  }} catch (e) {{ err(e); }}
-}}
-function toggleForm() {{
-  const el = document.getElementById("new-form");
-  if (el.style.display === "block") {{ el.style.display = "none"; return; }}
-  el.style.display = "block";
-  el.innerHTML = CFG.form.fields.map(f =>
-    `<label>${{f.label}}</label>` + (f.options
-      ? `<select id="f-${{f.id}}">` + f.options.map(o =>
-          `<option>${{o}}</option>`).join("") + "</select>"
-      : `<input id="f-${{f.id}}" value="${{esc(f.value || "")}}">`)
-  ).join("") +
-  `<p><button class="primary" onclick="submitForm()">Create</button></p>`;
-}}
-async function submitForm() {{
-  const body = {{}};
-  for (const f of CFG.form.fields) {{
-    let v = document.getElementById("f-" + f.id).value;
-    if (f.json) try {{ v = JSON.parse(v); }} catch (_e) {{}}
-    const keys = f.id.split(".");
-    let target = body;
-    while (keys.length > 1) {{
-      const k = keys.shift();
-      target = target[k] = target[k] || {{}};
-    }}
-    target[keys[0]] = v;
-  }}
-  try {{
-    await call("POST", CFG.form.path.replaceAll("{{ns}}", NS), body);
-    toggleForm(); refresh();
-  }} catch (e) {{ err(e); }}
-}}
-loadNamespaces().then(refresh).catch(err);
-setInterval(refresh, {poll_ms});
-</script>
+<main id="app"></main>
+<script type="module" src="static/apps/{module}.js"></script>
 </body>
 </html>
 """
 
 
-def render(title, kind, config, poll_ms=10000):
+def shell(title, module):
     return Response(
-        _PAGE.format(title=title, kind=kind,
-                     config=json.dumps(config), poll_ms=poll_ms),
+        _SHELL.format(title=title, module=module),
         headers={"Content-Type": "text/html; charset=utf-8"})
 
 
-JUPYTER_UI = {
-    "listPath": "api/namespaces/{ns}/notebooks",
-    "listKey": "notebooks",
-    "columns": [
-        {"label": "status", "path": "status", "status": True},
-        {"label": "name", "path": "name"},
-        {"label": "image", "path": "shortImage"},
-        {"label": "cpu", "path": "cpu"},
-        {"label": "memory", "path": "memory"},
-        {"label": "TPUs", "path": "accelerators"},
-    ],
-    "actions": [
-        {"id": "stop", "label": "stop", "cls": "", "method": "PATCH",
-         "path": "api/namespaces/{ns}/notebooks/{name}",
-         "body": {"stopped": True}},
-        {"id": "start", "label": "start", "cls": "", "method": "PATCH",
-         "path": "api/namespaces/{ns}/notebooks/{name}",
-         "body": {"stopped": False}},
-        {"id": "delete", "label": "delete", "cls": "danger",
-         "method": "DELETE", "confirm": True,
-         "path": "api/namespaces/{ns}/notebooks/{name}"},
-    ],
-    "form": {
-        "path": "api/namespaces/{ns}/notebooks",
-        "fields": [
-            {"id": "name", "label": "Name"},
-            {"id": "image", "label": "Image",
-             "value": "kubeflownotebookswg/jupyter-jax-tpu:latest"},
-            {"id": "cpu", "label": "CPU", "value": "0.5"},
-            {"id": "memory", "label": "Memory", "value": "1.0Gi"},
-            {"id": "accelerators.num", "label": "TPU chips (none|1|4|8)",
-             "value": "none"},
-            {"id": "accelerators.topology",
-             "label": "TPU topology (e.g. 2x2)", "value": "2x2"},
-        ],
-    },
-}
+def install(app, title, module):
+    """Wire the SPA shell + shared static assets into a backend app."""
+    app.static_dir("/static", STATIC_DIR)
 
-VOLUMES_UI = {
-    "listPath": "api/namespaces/{ns}/pvcs",
-    "listKey": "pvcs",
-    "columns": [
-        {"label": "name", "path": "name"},
-        {"label": "size", "path": "capacity"},
-        {"label": "class", "path": "class"},
-        {"label": "modes", "path": "modes"},
-        {"label": "used by", "path": "usedBy"},
-    ],
-    "actions": [
-        {"id": "delete", "label": "delete", "cls": "danger",
-         "method": "DELETE", "confirm": True,
-         "path": "api/namespaces/{ns}/pvcs/{name}"},
-    ],
-    "form": {
-        "path": "api/namespaces/{ns}/pvcs",
-        "fields": [
-            {"id": "name", "label": "Name"},
-            {"id": "size", "label": "Size", "value": "10Gi"},
-            {"id": "mode", "label": "Access mode",
-             "options": ["ReadWriteOnce", "ReadWriteMany",
-                         "ReadOnlyMany"]},
-        ],
-    },
-}
-
-TENSORBOARDS_UI = {
-    "listPath": "api/namespaces/{ns}/tensorboards",
-    "listKey": "tensorboards",
-    "columns": [
-        {"label": "status", "path": "status", "status": True},
-        {"label": "name", "path": "name"},
-        {"label": "logspath", "path": "logspath"},
-    ],
-    "actions": [
-        {"id": "delete", "label": "delete", "cls": "danger",
-         "method": "DELETE", "confirm": True,
-         "path": "api/namespaces/{ns}/tensorboards/{name}"},
-    ],
-    "form": {
-        "path": "api/namespaces/{ns}/tensorboards",
-        "fields": [
-            {"id": "name", "label": "Name"},
-            {"id": "logspath", "label": "Logs path",
-             "value": "pvc://workspace/logs"},
-        ],
-    },
-}
-
-
-def install(app, title, kind, config):
     @app.get("/")
     def index(request):
-        return render(title, kind, config)
+        return shell(title, module)
 
     return app

@@ -73,10 +73,15 @@ class Response:
 
 
 _PARAM = re.compile(r"<([a-zA-Z_][a-zA-Z0-9_]*)>")
+_WILD = re.compile(r"<([a-zA-Z_][a-zA-Z0-9_]*)\.\.\.>")
 
 
 def _compile(pattern):
-    regex = _PARAM.sub(r"(?P<\1>[^/]+)", pattern.rstrip("/") or "/")
+    # <name> matches one segment; <name...> greedily matches across
+    # slashes (static file trees, proxy paths)
+    regex = _WILD.sub(r"(?P<\1>.+)",
+                      _PARAM.sub(r"(?P<\1>[^/]+)",
+                                 pattern.rstrip("/") or "/"))
     return re.compile(f"^{regex}$")
 
 
@@ -111,6 +116,31 @@ class App:
     def before_request(self, fn):
         self._before.append(fn)
         return fn
+
+    def static_dir(self, prefix, directory):
+        """Serve files under ``directory`` at ``prefix`` (the SPA asset
+        path — what the reference gets from Flask static / the Express
+        static middleware, centraldashboard app/server.ts:48-83)."""
+        import mimetypes
+        import os
+        directory = os.path.abspath(directory)
+
+        @self.get(prefix.rstrip("/") + "/<path...>")
+        def _static(request, path):
+            full = os.path.abspath(os.path.join(directory, path))
+            if not full.startswith(directory + os.sep) \
+                    or not os.path.isfile(full):
+                raise HTTPError(404, f"{path} not found")
+            ctype = mimetypes.guess_type(full)[0] or \
+                "application/octet-stream"
+            if full.endswith(".js"):
+                ctype = "text/javascript"
+            with open(full, "rb") as f:
+                return Response(f.read(), headers={
+                    "Content-Type": ctype,
+                    "Cache-Control": "no-cache"})
+
+        return _static
 
     def after_request(self, fn):
         """fn(request, response) -> response (may mutate headers)."""

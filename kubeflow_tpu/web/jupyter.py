@@ -416,5 +416,5 @@ def create_app(store):
         return cb.success()
 
     from . import frontend
-    frontend.install(app, "Notebooks", "Notebook", frontend.JUPYTER_UI)
+    frontend.install(app, "Notebooks", "jupyter")
     return app

@@ -1,0 +1,118 @@
+/* Central dashboard SPA: workgroup onboarding, namespace/role table,
+ * activity feed, metrics panel, app launcher (reference
+ * centraldashboard Polymer main-page + manage-users-view +
+ * iframe-container; backend routes web/dashboard.py). */
+
+import {
+  api, clear, confirmDialog, h, Poller, snack,
+} from "../lib/components.js";
+
+const outlet = document.getElementById("app");
+
+const APPS = [
+  { label: "Notebooks", href: "/jupyter/", desc: "spawn TPU notebooks" },
+  { label: "Volumes", href: "/volumes/", desc: "manage PVCs" },
+  { label: "Tensorboards", href: "/tensorboards/",
+    desc: "profiles + training curves" },
+];
+
+async function onboarding(el, info) {
+  /* workgroup self-service (api_workgroup.ts flow: exists → create) */
+  const exists = await api("GET", "api/workgroup/exists");
+  if (exists.hasWorkgroup || info.namespaces.length) return false;
+  const name = h("input", { id: "workgroup-name",
+    value: (info.user || "user").split("@")[0].replace(/\./g, "-") });
+  el.append(h("div.kf-section", { id: "onboarding" },
+    h("h2", {}, `Welcome, ${info.user}`),
+    h("p", {}, "You have no namespace yet. Create your workgroup to " +
+      "get a namespace with quotas, service accounts and routing."),
+    h("div.kf-field", {}, h("label", {}, "Namespace name"), name),
+    h("button.primary", { id: "create-workgroup", onclick: async () => {
+      try {
+        const out = await api("POST", "api/workgroup/create",
+          { namespace: name.value });
+        snack(out.message, "success");
+        location.reload();
+      } catch (e) {
+        snack(String(e.message || e), "error");
+      }
+    } }, "Create workgroup")));
+  return true;
+}
+
+function nsTable(info) {
+  return h("div.kf-section", {},
+    h("h2", {}, "My namespaces"),
+    h("table.kf-table", {},
+      h("thead", {}, h("tr", {},
+        h("th", {}, "namespace"), h("th", {}, "role"))),
+      h("tbody", {}, info.namespaces.map((n) => h("tr", {},
+        h("td", {}, n.namespace), h("td", {}, n.role))))));
+}
+
+function launcher() {
+  return h("div.kf-section", {},
+    h("h2", {}, "Applications"),
+    h("div.kf-quick", {}, APPS.map((a) =>
+      h("a", { href: a.href }, `${a.label} — ${a.desc}`))));
+}
+
+async function activityFeed(el, info) {
+  const ns = (info.namespaces[0] || {}).namespace;
+  if (!ns) return;
+  const list = h("tbody");
+  el.append(h("div.kf-section", {},
+    h("h2", {}, `Recent activity in ${ns}`),
+    h("table.kf-table", {},
+      h("thead", {}, h("tr", {},
+        ["type", "reason", "message", "when"].map(
+          (c) => h("th", {}, c)))),
+      list)));
+  const poller = new Poller(async () => {
+    const events = await api("GET", `api/activities/${ns}`);
+    clear(list).append(...events.slice(0, 12).map((e) => h("tr", {},
+      h("td", {}, e.type || ""),
+      h("td", {}, e.reason || ""),
+      h("td", {}, e.message || ""),
+      h("td", {}, e.lastTimestamp || ""))));
+    if (!events.length) {
+      list.append(h("tr", {},
+        h("td.kf-empty", { colSpan: 4 }, "no recent events")));
+    }
+  }, 15000);
+  poller.kick();
+}
+
+async function metricsPanel(el, info) {
+  const ns = (info.namespaces[0] || {}).namespace;
+  try {
+    const data = await api("GET",
+      "api/metrics/podcpu" + (ns ? `?namespace=${ns}` : ""));
+    const series = data.series || data.points || [];
+    el.append(h("div.kf-section", {},
+      h("h2", {}, "Pod CPU (15m)"),
+      h("code.kf-yaml", {}, JSON.stringify(series, null, 1))));
+  } catch (e) {
+    /* metrics service not configured: the reference hides the panel */
+  }
+}
+
+(async () => {
+  let info;
+  try {
+    info = await api("GET", "api/env-info");
+  } catch (e) {
+    outlet.append(h("p", {}, `cannot load env-info: ${e.message}`));
+    return;
+  }
+  outlet.append(h("div.kf-toolbar", {},
+    h("h2", {}, "Kubeflow TPU"),
+    h("span.kf-spacer"),
+    h("span", { id: "user" }, info.user || "")));
+  if (await onboarding(outlet, info)) return;
+  const grid = h("div.kf-grid");
+  outlet.append(grid);
+  grid.append(launcher(), nsTable(info));
+  await activityFeed(outlet, info);
+  await metricsPanel(outlet, info);
+})();

@@ -1,0 +1,357 @@
+/* Jupyter web app SPA: index / spawn form / notebook details.
+ *
+ * Vanilla-module rebuild of the reference Angular app
+ * (components/crud-web-apps/jupyter/frontend/src/app/pages/{index,
+ * form, notebook-page}) against the same REST routes (web/jupyter.py).
+ * The spawn form mirrors form_to_notebook's body contract: image picker
+ * + custom image, cpu/mem with quantity validation, TPU accelerator
+ * picker (type/topology/chips), workspace + data volume rows,
+ * PodDefault configurations, tolerations/affinity groups, shm. */
+
+import {
+  api, clear, currentNamespace, eventsTable, Field, FieldGroup, h,
+  indexPage, LogsViewer, Router, RowList, snack, statusIcon, tabPanel,
+  validators,
+} from "../lib/components.js";
+
+const outlet = document.getElementById("app");
+let router = null;
+
+/* --------------------------------------------------------------- index */
+
+async function indexView(el) {
+  await indexPage(el, {
+    newLabel: "New notebook",
+    onNew: () => router.go("/new"),
+    pollMs: 6000,
+    table: {
+      empty: "no notebooks in this namespace",
+      load: async (ns) =>
+        (await api("GET", `api/namespaces/${ns}/notebooks`)).notebooks,
+      columns: [
+        { key: "status", label: "Status", sort: false,
+          render: (r) => statusIcon(r.status) },
+        { key: "name", label: "Name",
+          render: (r) => h("a", {
+            href: `#/details/${encodeURIComponent(r.name)}`,
+          }, r.name) },
+        { key: "shortImage", label: "Image" },
+        { key: "cpu", label: "CPU" },
+        { key: "memory", label: "Memory" },
+        { key: "accelerators", label: "TPUs", sort: false,
+          render: (r) => Object.entries(r.accelerators || {})
+            .map(([k, v]) => `${v}× ${k.split("/")[0]}`)
+            .join(", ") || "—" },
+        { key: "age", label: "Created" },
+      ],
+      actions: [
+        { id: "connect", label: "connect", cls: "primary",
+          show: (r) => r.status && r.status.phase === "ready",
+          run: (r) => window.open(
+            `/notebook/${currentNamespace()}/${r.name}/`, "_blank") },
+        { id: "start", label: "start",
+          show: (r) => r.status && r.status.phase === "stopped",
+          run: async (r) => {
+            await api("PATCH",
+              `api/namespaces/${currentNamespace()}/notebooks/${r.name}`,
+              { stopped: false });
+            snack(`starting ${r.name}`, "success");
+          } },
+        { id: "stop", label: "stop",
+          show: (r) => !r.status || r.status.phase !== "stopped",
+          confirm: "The notebook server will be scaled to zero; the " +
+            "workspace volume is kept.",
+          run: async (r) => {
+            await api("PATCH",
+              `api/namespaces/${currentNamespace()}/notebooks/${r.name}`,
+              { stopped: true });
+            snack(`stopping ${r.name}`, "success");
+          } },
+        { id: "delete", label: "delete", cls: "danger", confirm:
+            "This deletes the notebook server. PVCs are not deleted.",
+          run: async (r) => {
+            await api("DELETE",
+              `api/namespaces/${currentNamespace()}/notebooks/${r.name}`);
+            snack(`deleted ${r.name}`, "success");
+          } },
+      ],
+    },
+  });
+}
+
+/* ---------------------------------------------------------- spawn form */
+
+function volumeRow(initial) {
+  const fields = new FieldGroup([
+    new Field({ id: "type", label: "Type", value: initial.type || "new",
+      options: [{ value: "new", label: "New volume" },
+                { value: "existing", label: "Existing volume" }] }),
+    new Field({ id: "name", label: "Volume name",
+      value: initial.name || "",
+      checks: [validators.required, validators.dns1123] }),
+    new Field({ id: "size", label: "Size", value: initial.size || "10Gi",
+      checks: [validators.quantity] }),
+    new Field({ id: "mount", label: "Mount path",
+      value: initial.mount || "/data" }),
+  ]);
+  return {
+    element: h("div", {}, fields.fields.map((f) => f.element)),
+    validate: () => fields.validate(),
+    values: () => fields.values(),
+  };
+}
+
+function volToBody(v, nbName) {
+  if (v.type === "existing") {
+    return { mount: v.mount, existingSource: {
+      persistentVolumeClaim: { claimName: v.name } } };
+  }
+  return { mount: v.mount, newPvc: {
+    metadata: { name: v.name || `${nbName}-volume` },
+    spec: { resources: { requests: { storage: v.size } },
+            accessModes: ["ReadWriteOnce"] } } };
+}
+
+async function formView(el) {
+  const ns = currentNamespace();
+  const [cfgResp, accResp, pdResp] = await Promise.all([
+    api("GET", "api/config"),
+    api("GET", "api/accelerators"),
+    api("GET", `api/namespaces/${ns}/poddefaults`),
+  ]);
+  const cfg = cfgResp.config;
+  const clusterAcc = accResp.accelerators || [];
+  const podDefaults = pdResp.poddefaults || [];
+
+  const imageOptions = (cfg.image.options || []).map((o) => ({
+    value: o, label: o.split("/").pop() }));
+  const basics = new FieldGroup([
+    new Field({ id: "name", label: "Name",
+      checks: [validators.required, validators.dns1123] }),
+    new Field({ id: "image", label: "Image",
+      value: cfg.image.value, options: imageOptions }),
+    new Field({ id: "customImage", label: "Custom image (overrides)",
+      value: "", checks: [validators.optional] }),
+    new Field({ id: "cpu", label: "CPU", value: cfg.cpu.value,
+      checks: [validators.quantity],
+      hint: `limit = request × ${cfg.cpu.limitFactor}` }),
+    new Field({ id: "memory", label: "Memory", value: cfg.memory.value,
+      checks: [validators.quantity],
+      hint: `limit = request × ${cfg.memory.limitFactor}` }),
+  ]);
+
+  /* TPU picker: types from the deploy config, topologies narrowed to
+   * what the cluster actually has when the scan found any */
+  const types = cfg.accelerators.types || [];
+  const typeField = new Field({ id: "type", label: "TPU type",
+    options: [{ value: "none", label: "None" },
+      ...types.map((t) => ({ value: t.id, label: t.uiName }))] });
+  const topoField = new Field({ id: "topology", label: "Topology",
+    options: ["-"], checks: [validators.optional] });
+  const chipsField = new Field({ id: "num", label: "Chips per host",
+    value: "4", checks: [validators.optional],
+    hint: "google.com/tpu resource limit" });
+  const syncTopologies = () => {
+    const t = types.find((x) => x.id === typeField.value());
+    const cluster = clusterAcc.find((x) => x.id === typeField.value());
+    const topos = (cluster && cluster.topologies.length
+      ? cluster.topologies : (t ? t.topologies : ["-"]));
+    clear(topoField.input).append(
+      ...topos.map((o) => h("option", { value: o }, o)));
+  };
+  typeField.input.addEventListener("change", syncTopologies);
+  syncTopologies();
+
+  const workspace = new FieldGroup([
+    new Field({ id: "wsEnabled", label: "Create workspace volume",
+      type: "checkbox", value: true }),
+    new Field({ id: "wsSize", label: "Workspace size", value: "10Gi",
+      checks: [validators.quantity] }),
+  ]);
+  const datavols = new RowList({ addLabel: "add data volume",
+    makeRow: volumeRow });
+
+  const pdBoxes = podDefaults.map((pd) => {
+    const box = h("input", { type: "checkbox",
+      dataset: { poddefault: pd.label } });
+    return { label: pd.label, desc: pd.desc, box };
+  });
+
+  const tolGroups = cfg.tolerationGroup.groups || [];
+  const affOptions = cfg.affinityConfig.options || [];
+  const advanced = new FieldGroup([
+    new Field({ id: "tolerationGroup", label: "Tolerations group",
+      value: cfg.tolerationGroup.value,
+      options: [{ value: "none", label: "None" },
+        ...tolGroups.map((g) => ({ value: g.groupKey,
+                                   label: g.displayName }))] }),
+    new Field({ id: "affinityConfig", label: "Affinity",
+      value: cfg.affinityConfig.value,
+      options: [{ value: "none", label: "None" },
+        ...affOptions.map((o) => ({ value: o.configKey,
+                                    label: o.displayName }))] }),
+    new Field({ id: "shm", label: "Enable shared memory (/dev/shm)",
+      type: "checkbox", value: cfg.shm.value }),
+  ]);
+
+  const submit = async () => {
+    const groups = [basics, workspace, advanced];
+    if (!groups.every((g) => g.validate()) || !datavols.validate()) {
+      snack("fix the highlighted fields", "error");
+      return;
+    }
+    const b = basics.values();
+    const adv = advanced.values();
+    const ws = workspace.values();
+    const body = {
+      name: b.name,
+      image: b.image,
+      customImage: b.customImage || undefined,
+      cpu: b.cpu,
+      memory: b.memory,
+      tolerationGroup: adv.tolerationGroup,
+      affinityConfig: adv.affinityConfig,
+      shm: adv.shm,
+      configurations: pdBoxes.filter((p) => p.box.checked)
+        .map((p) => p.label),
+      noWorkspace: !ws.wsEnabled,
+      datavols: datavols.values().map((v) => volToBody(v, b.name)),
+    };
+    if (ws.wsEnabled) {
+      body.workspace = { mount: "/home/jovyan", newPvc: {
+        metadata: { name: "{notebook-name}-workspace" },
+        spec: { resources: { requests: { storage: ws.wsSize } },
+                accessModes: ["ReadWriteOnce"] } } };
+    }
+    if (typeField.value() !== "none") {
+      body.accelerators = { num: chipsField.value(),
+        type: typeField.value(), topology: topoField.value() };
+    }
+    try {
+      await api("POST", `api/namespaces/${ns}/notebooks`, body);
+      snack(`created ${b.name}`, "success");
+      router.go("/");
+    } catch (e) {
+      snack(String(e.message || e), "error");
+    }
+  };
+
+  el.append(
+    h("div.kf-toolbar", {},
+      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
+      h("h2", {}, `New notebook in ${ns}`)),
+    h("div.kf-section", { id: "form-basics" },
+      h("h2", {}, "Notebook"),
+      basics.fields.map((f) => f.element)),
+    h("div.kf-section", { id: "form-tpu" },
+      h("h2", {}, "TPU accelerator"),
+      typeField.element, topoField.element, chipsField.element),
+    h("div.kf-section", { id: "form-volumes" },
+      h("h2", {}, "Volumes"),
+      workspace.fields.map((f) => f.element),
+      datavols.element),
+    h("div.kf-section", { id: "form-configurations" },
+      h("h2", {}, "Configurations (PodDefaults)"),
+      pdBoxes.length
+        ? pdBoxes.map((p) => h("label.kf-field", {},
+            p.box, ` ${p.label}`, p.desc
+              ? h("span.kf-field-hint", {}, ` — ${p.desc}`) : null))
+        : h("p.kf-field-hint", {}, "none available in this namespace")),
+    h("div.kf-section", { id: "form-advanced" },
+      h("h2", {}, "Advanced"),
+      advanced.fields.map((f) => f.element)),
+    h("div.kf-form-actions", {},
+      h("button.primary", { id: "submit-notebook", onclick: submit },
+        "Launch"),
+      h("button.ghost", { onclick: () => router.go("/") }, "Cancel")),
+  );
+}
+
+/* ------------------------------------------------------------- details */
+
+async function detailsView(el, params) {
+  const ns = currentNamespace();
+  const name = params.name;
+  let nb;
+  try {
+    nb = (await api("GET",
+      `api/namespaces/${ns}/notebooks/${name}`)).notebook;
+  } catch (e) {
+    el.append(h("p", {}, `cannot load ${name}: ${e.message}`));
+    return;
+  }
+  const spec = ((nb.spec.template || {}).spec || {});
+  const container = (spec.containers || [])[0] || {};
+  const res = container.resources || {};
+
+  const overview = (pane) => {
+    pane.append(h("div.kf-section", {},
+      h("h2", {}, "Overview"),
+      h("dl.kf-kv", {},
+        h("dt", {}, "image"), h("dd", {}, container.image || ""),
+        h("dt", {}, "cpu"), h("dd", {},
+          JSON.stringify((res.requests || {}).cpu || "")),
+        h("dt", {}, "memory"), h("dd", {},
+          JSON.stringify((res.requests || {}).memory || "")),
+        h("dt", {}, "TPU"), h("dd", {},
+          (res.limits || {})["google.com/tpu"] || "none"),
+        h("dt", {}, "node selector"), h("dd", {},
+          JSON.stringify(spec.nodeSelector || {})),
+        h("dt", {}, "conditions"), h("dd", {},
+          JSON.stringify((nb.status || {}).conditions || [])),
+      )));
+  };
+
+  const logsTab = (pane) => {
+    let viewer = null;
+    (async () => {
+      try {
+        const pod = (await api("GET",
+          `api/namespaces/${ns}/notebooks/${name}/pod`)).pod;
+        viewer = new LogsViewer(async () => {
+          const data = await api("GET",
+            `api/namespaces/${ns}/notebooks/${name}/pod/` +
+            `${pod.metadata.name}/logs`);
+          return (data.logs || []).join("\n");
+        });
+        pane.append(viewer.element);
+      } catch (e) {
+        pane.append(h("p.kf-empty", {}, `no pod yet: ${e.message}`));
+      }
+    })();
+    return () => viewer && viewer.stop();
+  };
+
+  const eventsTab = (pane) => {
+    (async () => {
+      const data = await api("GET",
+        `api/namespaces/${ns}/notebooks/${name}/events`);
+      pane.append(h("div.kf-card", {}, eventsTable(data.events)));
+    })();
+  };
+
+  const yamlTab = (pane) => {
+    pane.append(h("code.kf-yaml", {}, JSON.stringify(nb, null, 2)));
+  };
+
+  el.append(
+    h("div.kf-toolbar", {},
+      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
+      h("h2", {}, name, " "),
+      statusIcon((nb.statusSummary || {}).phase
+        ? nb.statusSummary : { phase: "waiting" })),
+    tabPanel([
+      { id: "overview", label: "Overview", render: overview },
+      { id: "logs", label: "Logs", render: logsTab },
+      { id: "events", label: "Events", render: eventsTab },
+      { id: "yaml", label: "YAML", render: yamlTab },
+    ]).element,
+  );
+}
+
+router = new Router(outlet, [
+  ["/", indexView],
+  ["/new", formView],
+  ["/details/:name", detailsView],
+]);
+router.render();

@@ -1,0 +1,83 @@
+/* Tensorboards web app SPA (reference
+ * components/crud-web-apps/tensorboards/frontend; routes from
+ * web/tensorboards.py). */
+
+import {
+  api, currentNamespace, Field, FieldGroup, h, indexPage, Router, snack,
+  statusIcon, validators,
+} from "../lib/components.js";
+
+const outlet = document.getElementById("app");
+let router = null;
+
+async function indexView(el) {
+  await indexPage(el, {
+    newLabel: "New tensorboard",
+    onNew: () => router.go("/new"),
+    table: {
+      empty: "no tensorboards in this namespace",
+      load: async (ns) =>
+        (await api("GET", `api/namespaces/${ns}/tensorboards`))
+          .tensorboards,
+      columns: [
+        { key: "status", label: "Status", sort: false,
+          render: (r) => statusIcon(r.status) },
+        { key: "name", label: "Name" },
+        { key: "logspath", label: "Logs path" },
+        { key: "age", label: "Created" },
+      ],
+      actions: [
+        { id: "connect", label: "connect", cls: "primary",
+          show: (r) => r.status && r.status.phase === "ready",
+          run: (r) => window.open(
+            `/tensorboard/${currentNamespace()}/${r.name}/`, "_blank") },
+        { id: "delete", label: "delete", cls: "danger", confirm: true,
+          run: async (r) => {
+            await api("DELETE",
+              `api/namespaces/${currentNamespace()}/tensorboards/` +
+              r.name);
+            snack(`deleted ${r.name}`, "success");
+          } },
+      ],
+    },
+  });
+}
+
+async function formView(el) {
+  const ns = currentNamespace();
+  const fields = new FieldGroup([
+    new Field({ id: "name", label: "Name",
+      checks: [validators.required, validators.dns1123] }),
+    new Field({ id: "logspath", label: "Logs path",
+      value: "pvc://workspace/logs",
+      hint: "pvc://<claim>/<subpath> or gs://bucket/path — TPU " +
+        "profiler dumps land under <logs>/plugins/profile" }),
+  ]);
+  const submit = async () => {
+    if (!fields.validate()) return;
+    const v = fields.values();
+    try {
+      await api("POST", `api/namespaces/${ns}/tensorboards`,
+        { name: v.name, logspath: v.logspath });
+      snack(`created ${v.name}`, "success");
+      router.go("/");
+    } catch (e) {
+      snack(String(e.message || e), "error");
+    }
+  };
+  el.append(
+    h("div.kf-toolbar", {},
+      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
+      h("h2", {}, `New tensorboard in ${ns}`)),
+    h("div.kf-section", {}, fields.fields.map((f) => f.element)),
+    h("div.kf-form-actions", {},
+      h("button.primary", { id: "submit-tensorboard", onclick: submit },
+        "Create"),
+      h("button.ghost", { onclick: () => router.go("/") }, "Cancel")));
+}
+
+router = new Router(outlet, [
+  ["/", indexView],
+  ["/new", formView],
+]);
+router.render();

@@ -1,0 +1,121 @@
+/* Volumes web app SPA: PVC list / new-volume form / details
+ * (reference components/crud-web-apps/volumes/frontend, same REST
+ * routes as web/volumes.py). */
+
+import {
+  api, currentNamespace, eventsTable, Field, FieldGroup, h, indexPage,
+  Router, snack, statusIcon, tabPanel, validators,
+} from "../lib/components.js";
+
+const outlet = document.getElementById("app");
+let router = null;
+
+async function indexView(el) {
+  await indexPage(el, {
+    newLabel: "New volume",
+    onNew: () => router.go("/new"),
+    table: {
+      empty: "no volumes in this namespace",
+      load: async (ns) =>
+        (await api("GET", `api/namespaces/${ns}/pvcs`)).pvcs,
+      columns: [
+        { key: "status", label: "Status", sort: false,
+          render: (r) => statusIcon(
+            (r.status || "").toLowerCase ? (r.status || "").toLowerCase()
+                                         : r.status) },
+        { key: "name", label: "Name",
+          render: (r) => h("a", {
+            href: `#/details/${encodeURIComponent(r.name)}`,
+          }, r.name) },
+        { key: "capacity", label: "Size" },
+        { key: "class", label: "Storage class" },
+        { key: "modes", label: "Access modes",
+          render: (r) => (r.modes || []).join(", ") },
+        { key: "usedBy", label: "Used by",
+          render: (r) => (r.usedBy || []).join(", ") || "—" },
+      ],
+      actions: [
+        { id: "delete", label: "delete", cls: "danger",
+          confirm: "Deleting a PVC that a notebook mounts will break it.",
+          run: async (r) => {
+            await api("DELETE",
+              `api/namespaces/${currentNamespace()}/pvcs/${r.name}`);
+            snack(`deleted ${r.name}`, "success");
+          } },
+      ],
+    },
+  });
+}
+
+async function formView(el) {
+  const ns = currentNamespace();
+  const fields = new FieldGroup([
+    new Field({ id: "name", label: "Name",
+      checks: [validators.required, validators.dns1123] }),
+    new Field({ id: "size", label: "Size", value: "10Gi",
+      checks: [validators.quantity] }),
+    new Field({ id: "mode", label: "Access mode",
+      options: ["ReadWriteOnce", "ReadWriteMany", "ReadOnlyMany"] }),
+    new Field({ id: "storageClass", label: "Storage class (blank = default)",
+      value: "", checks: [validators.optional] }),
+  ]);
+  const submit = async () => {
+    if (!fields.validate()) return;
+    const v = fields.values();
+    try {
+      await api("POST", `api/namespaces/${ns}/pvcs`, {
+        name: v.name, size: v.size, mode: v.mode,
+        class: v.storageClass || undefined,
+      });
+      snack(`created ${v.name}`, "success");
+      router.go("/");
+    } catch (e) {
+      snack(String(e.message || e), "error");
+    }
+  };
+  el.append(
+    h("div.kf-toolbar", {},
+      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
+      h("h2", {}, `New volume in ${ns}`)),
+    h("div.kf-section", {}, fields.fields.map((f) => f.element)),
+    h("div.kf-form-actions", {},
+      h("button.primary", { id: "submit-volume", onclick: submit },
+        "Create"),
+      h("button.ghost", { onclick: () => router.go("/") }, "Cancel")));
+}
+
+async function detailsView(el, params) {
+  const ns = currentNamespace();
+  const name = params.name;
+  el.append(
+    h("div.kf-toolbar", {},
+      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
+      h("h2", {}, name)),
+    tabPanel([
+      { id: "pods", label: "Pods using this volume", render: (pane) => {
+        (async () => {
+          const data = await api("GET",
+            `api/namespaces/${ns}/pvcs/${name}/pods`);
+          const pods = data.pods || [];
+          pane.append(h("div.kf-section", {},
+            pods.length
+              ? h("ul", {}, pods.map((p) => h("li", {}, p)))
+              : h("p.kf-empty", {}, "not mounted by any pod")));
+        })();
+      } },
+      { id: "events", label: "Events", render: (pane) => {
+        (async () => {
+          const data = await api("GET",
+            `api/namespaces/${ns}/pvcs/${name}/events`);
+          pane.append(h("div.kf-card", {}, eventsTable(data.events)));
+        })();
+      } },
+    ]).element);
+}
+
+router = new Router(outlet, [
+  ["/", indexView],
+  ["/new", formView],
+  ["/details/:name", detailsView],
+]);
+router.render();

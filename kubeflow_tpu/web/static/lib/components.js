@@ -1,0 +1,345 @@
+/* Shared UI components — the kubeflow-common-lib analogue:
+ * resource-table (sortable columns, status icons, row actions),
+ * namespace-selector, logs-viewer, events-table, tab panel, validated
+ * form fields (components/crud-web-apps/common/frontend/
+ * kubeflow-common-lib/projects/kubeflow/src/lib: resource-table/,
+ * namespace-select/, logs-viewer/, status/, form/). */
+
+import {
+  api, clear, confirmDialog, currentNamespace, h, namespaces, Poller,
+  Router, setNamespace, snack,
+} from "./core.js";
+
+/* ------------------------------------------------------ status icons */
+
+const STATUS_ICONS = {
+  ready: "●", running: "●", bound: "●",
+  waiting: "◐", stopped: "■", warning: "▲",
+  error: "▲", terminating: "◔",
+};
+
+export function statusIcon(status) {
+  const phase = (status && status.phase) || String(status || "waiting");
+  const icon = STATUS_ICONS[phase] || "◐";
+  const el = h("span.status.status-" + phase,
+    { title: (status && status.message) || phase },
+    icon + " " + phase);
+  return el;
+}
+
+/* ------------------------------------------------- namespace selector */
+
+export async function namespaceSelector(onChange) {
+  const names = await namespaces();
+  let ns = currentNamespace();
+  if (!names.includes(ns)) ns = names[0] || "";
+  setNamespace(ns);
+  const select = h("select", {
+    id: "ns-select",
+    onchange: () => { setNamespace(select.value); onChange(select.value); },
+  }, names.map((n) => h("option", { value: n, selected: n === ns }, n)));
+  return { element: h("label.ns-label", {}, "namespace ", select),
+           value: () => select.value };
+}
+
+/* ------------------------------------------------------ resource table */
+
+export class ResourceTable {
+  /* cfg: {columns: [{key,label,render?,sort?}], actions: [{id,label,
+   *       cls?,confirm?,show?,run}], load: async(ns)=>rows,
+   *       empty: "message", rowKey} */
+  constructor(cfg) {
+    this.cfg = cfg;
+    this.sortKey = null;
+    this.sortDir = 1;
+    this.rows = [];
+    this.element = h("div.kf-card", {},
+      h("table.kf-table", {},
+        this.thead = h("thead"), this.tbody = h("tbody")));
+    this.renderHead();
+  }
+
+  renderHead() {
+    clear(this.thead).append(h("tr", {},
+      this.cfg.columns.map((c) => h("th", {
+        onclick: c.sort === false ? null : () => this.sortBy(c.key),
+        className: c.sort === false ? "" : "sortable",
+      }, c.label,
+        this.sortKey === c.key ? (this.sortDir > 0 ? " ↑" : " ↓") : "")),
+      this.cfg.actions && this.cfg.actions.length
+        ? h("th", {}, "") : null,
+    ));
+  }
+
+  sortBy(key) {
+    this.sortDir = this.sortKey === key ? -this.sortDir : 1;
+    this.sortKey = key;
+    this.renderHead();
+    this.renderRows();
+  }
+
+  setRows(rows) {
+    this.rows = rows || [];
+    this.renderRows();
+  }
+
+  renderRows() {
+    const rows = [...this.rows];
+    if (this.sortKey) {
+      const key = this.sortKey;
+      rows.sort((a, b) => {
+        const av = a[key], bv = b[key];
+        return (av > bv ? 1 : av < bv ? -1 : 0) * this.sortDir;
+      });
+    }
+    clear(this.tbody);
+    if (!rows.length) {
+      this.tbody.append(h("tr", {}, h("td.kf-empty", {
+        colSpan: this.cfg.columns.length + 1,
+      }, this.cfg.empty || "nothing here yet")));
+      return;
+    }
+    for (const row of rows) {
+      this.tbody.append(h("tr", { dataset: { row: row.name } },
+        this.cfg.columns.map((c) => h("td", {},
+          c.render ? c.render(row) : String(row[c.key] ?? ""))),
+        this.cfg.actions && this.cfg.actions.length ? h("td.kf-actions", {},
+          this.cfg.actions
+            .filter((a) => !a.show || a.show(row))
+            .map((a) => h("button." + (a.cls || "ghost"), {
+              dataset: { action: a.id, row: row.name },
+              onclick: async () => {
+                if (a.confirm) {
+                  const ok = await confirmDialog({
+                    title: `${a.label} ${row.name}?`,
+                    body: a.confirm === true ? "" : a.confirm,
+                    action: a.label, danger: a.cls === "danger",
+                  });
+                  if (!ok) return;
+                }
+                try {
+                  await a.run(row);
+                } catch (e) {
+                  snack(String(e.message || e), "error");
+                }
+              },
+            }, a.label))) : null,
+      ));
+    }
+  }
+}
+
+/* A standard "index page": namespace bar + new button + polled table */
+export async function indexPage(outlet, cfg) {
+  const table = new ResourceTable(cfg.table);
+  let poller = null;
+  const refresh = async () => {
+    table.setRows(await cfg.table.load(currentNamespace()));
+  };
+  const selector = await namespaceSelector(() => poller.kick());
+  outlet.append(
+    h("div.kf-toolbar", {},
+      selector.element,
+      h("span.kf-spacer"),
+      cfg.newLabel ? h("button.primary", {
+        id: "new-resource",
+        onclick: cfg.onNew,
+      }, "+ " + cfg.newLabel) : null),
+    table.element);
+  poller = new Poller(refresh, cfg.pollMs || 8000);
+  poller.kick();
+  return { table, poller, refresh };
+}
+
+/* --------------------------------------------------------- logs viewer */
+
+export class LogsViewer {
+  /* Polls a logs endpoint, renders tail-follow text (logs-viewer
+   * component; backend route jupyter.py get_logs). */
+  constructor(loadFn) {
+    this.pre = h("pre.kf-logs", {}, "loading logs…");
+    this.follow = true;
+    this.element = h("div", {},
+      h("div.kf-logs-bar", {},
+        h("label", {},
+          h("input", { type: "checkbox", checked: true,
+            onchange: (e) => { this.follow = e.target.checked; } }),
+          " follow"),
+        h("button.ghost", { onclick: () => this.download() }, "download"),
+      ),
+      this.pre);
+    this.poller = new Poller(async () => {
+      const text = await loadFn();
+      this.pre.textContent = text || "(no logs)";
+      if (this.follow) this.pre.scrollTop = this.pre.scrollHeight;
+    }, 4000);
+    this.poller.kick();
+  }
+
+  download() {
+    const blob = new Blob([this.pre.textContent], { type: "text/plain" });
+    const a = h("a", { href: URL.createObjectURL(blob),
+                       download: "logs.txt" });
+    a.click();
+    URL.revokeObjectURL(a.href);
+  }
+
+  stop() {
+    this.poller.stop();
+  }
+}
+
+/* -------------------------------------------------------- events table */
+
+export function eventsTable(events) {
+  return h("table.kf-table", {},
+    h("thead", {}, h("tr", {},
+      ["type", "reason", "message", "when"].map((c) => h("th", {}, c)))),
+    h("tbody", {},
+      (events || []).length ? events.map((e) => h("tr", {},
+        h("td", {}, e.type || ""),
+        h("td", {}, e.reason || ""),
+        h("td", {}, e.message || ""),
+        h("td", {}, e.lastTimestamp || e.firstTimestamp || ""),
+      )) : h("tr", {}, h("td.kf-empty", { colSpan: 4 }, "no events"))));
+}
+
+/* ---------------------------------------------------------- tab panel */
+
+export function tabPanel(tabs) {
+  /* tabs: [{id, label, render: (pane)=>void|cleanupFn}] */
+  const panes = h("div.kf-tabpane");
+  let cleanup = null;
+  const activate = (tab, btn) => {
+    bar.querySelectorAll("button").forEach((b) =>
+      b.classList.toggle("active", b === btn));
+    if (cleanup) { try { cleanup(); } catch (e) { /* ignore */ } }
+    clear(panes);
+    cleanup = tab.render(panes) || null;
+  };
+  const bar = h("div.kf-tabs", {}, tabs.map((t) => {
+    const btn = h("button", {
+      dataset: { tab: t.id },
+      onclick: () => activate(t, btn),
+    }, t.label);
+    return btn;
+  }));
+  const element = h("div", {}, bar, panes);
+  activate(tabs[0], bar.querySelector("button"));
+  return { element };
+}
+
+/* ------------------------------------------------------- form controls */
+
+export const validators = {
+  required: (v) => (v ? "" : "required"),
+  dns1123: (v) => (/^[a-z0-9]([-a-z0-9]*[a-z0-9])?$/.test(v)
+    ? "" : "lowercase alphanumeric and '-', must start/end alphanumeric"),
+  quantity: (v) => (/^[0-9]+(\.[0-9]+)?(m|Mi|Gi|Ti|G|M|k|Ki)?$/.test(v)
+    ? "" : "not a valid quantity (e.g. 0.5, 500m, 1Gi)"),
+  optional: () => "",
+};
+
+export class Field {
+  constructor({ id, label, value, type, options, checks, hint }) {
+    this.id = id;
+    this.checks = checks || [validators.required];
+    this.error = h("div.kf-field-error");
+    if (options) {
+      this.input = h("select", { id: "f-" + id },
+        options.map((o) => h("option", {
+          value: o.value !== undefined ? o.value : o,
+          selected: (o.value !== undefined ? o.value : o) === value,
+        }, o.label !== undefined ? o.label : o)));
+    } else if (type === "checkbox") {
+      this.input = h("input", { id: "f-" + id, type, checked: !!value });
+    } else {
+      this.input = h("input", { id: "f-" + id, type: type || "text",
+                                value: value ?? "" });
+      this.input.addEventListener("input", () => this.validate());
+    }
+    this.element = h("div.kf-field", {},
+      h("label", { htmlFor: "f-" + id }, label),
+      this.input,
+      hint ? h("div.kf-field-hint", {}, hint) : null,
+      this.error);
+  }
+
+  value() {
+    if (this.input.type === "checkbox") return this.input.checked;
+    return this.input.value;
+  }
+
+  validate() {
+    const v = this.value();
+    for (const check of this.checks) {
+      const msg = check(v);
+      if (msg) {
+        this.error.textContent = msg;
+        this.element.classList.add("invalid");
+        return false;
+      }
+    }
+    this.error.textContent = "";
+    this.element.classList.remove("invalid");
+    return true;
+  }
+}
+
+export class FieldGroup {
+  constructor(fields) {
+    this.fields = fields;
+  }
+
+  get(id) {
+    return this.fields.find((f) => f.id === id);
+  }
+
+  validate() {
+    return this.fields.map((f) => f.validate()).every(Boolean);
+  }
+
+  values() {
+    const out = {};
+    for (const f of this.fields) out[f.id] = f.value();
+    return out;
+  }
+}
+
+/* Dynamic row list (volume rows in the spawn form: add/remove) */
+export class RowList {
+  constructor({ addLabel, makeRow }) {
+    this.rows = [];
+    this.makeRow = makeRow;
+    this.list = h("div.kf-rowlist");
+    this.element = h("div", {}, this.list,
+      h("button.ghost", { id: addLabel.replace(/\W+/g, "-").toLowerCase(),
+        onclick: () => this.add() }, "+ " + addLabel));
+  }
+
+  add(initial) {
+    const row = this.makeRow(initial || {});
+    const wrapper = h("div.kf-row", {}, row.element,
+      h("button.ghost.kf-row-remove", {
+        onclick: () => {
+          this.rows = this.rows.filter((r) => r !== row);
+          wrapper.remove();
+        },
+      }, "✕"));
+    this.rows.push(row);
+    this.list.append(wrapper);
+    return row;
+  }
+
+  values() {
+    return this.rows.map((r) => r.values());
+  }
+
+  validate() {
+    return this.rows.map((r) => r.validate()).every(Boolean);
+  }
+}
+
+export {
+  api, h, clear, snack, confirmDialog, Poller, Router, currentNamespace,
+};

@@ -1,0 +1,199 @@
+/* Core SPA runtime: API client (CSRF echo per crud_backend contract),
+ * DOM builder, hash router, snackbar, confirm dialog, poller.
+ *
+ * The vanilla-ES-module rebuild of the reference's kubeflow-common-lib
+ * foundations (Angular services: backend.service, snack-bar, poller —
+ * components/crud-web-apps/common/frontend/kubeflow-common-lib). */
+
+export function esc(v) {
+  return String(v ?? "").replace(/[&<>"']/g, (c) => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;",
+    '"': "&quot;", "'": "&#39;",
+  })[c]);
+}
+
+function csrfHeader() {
+  const m = document.cookie.match(/XSRF-TOKEN=([^;]+)/);
+  return m ? { "X-XSRF-TOKEN": decodeURIComponent(m[1]) } : {};
+}
+
+export async function api(method, path, body) {
+  const resp = await fetch(path, {
+    method,
+    headers: { "Content-Type": "application/json", ...csrfHeader() },
+    body: body === undefined ? undefined : JSON.stringify(body),
+  });
+  let data = {};
+  try { data = await resp.json(); } catch (e) { /* empty body */ }
+  if (!resp.ok) {
+    throw new Error(data.log || data.error || resp.statusText);
+  }
+  return data;
+}
+
+/* h("div.card", {onclick: fn, title: "x"}, child1, "text", ...) */
+export function h(tag, attrs, ...children) {
+  if (attrs instanceof Node || typeof attrs === "string"
+      || Array.isArray(attrs)) {
+    children.unshift(attrs);   // attrs omitted: treat as first child
+    attrs = {};
+  }
+  const [name, ...classes] = tag.split(".");
+  const el = document.createElement(name || "div");
+  if (classes.length) el.className = classes.join(" ");
+  for (const [k, v] of Object.entries(attrs || {})) {
+    if (v === null || v === undefined || v === false) continue;
+    if (k.startsWith("on") && typeof v === "function") {
+      el.addEventListener(k.slice(2), v);
+    } else if (k === "dataset") {
+      Object.assign(el.dataset, v);
+    } else if (k in el && k !== "list" && k !== "form") {
+      el[k] = v;
+    } else {
+      el.setAttribute(k, v === true ? "" : v);
+    }
+  }
+  for (const c of children.flat(Infinity)) {
+    if (c === null || c === undefined || c === false) continue;
+    el.append(c instanceof Node ? c : document.createTextNode(String(c)));
+  }
+  return el;
+}
+
+export function clear(el) {
+  while (el.firstChild) el.removeChild(el.firstChild);
+  return el;
+}
+
+/* ------------------------------------------------------------ router */
+
+export class Router {
+  /* routes: [["/", fn], ["/new", fn], ["/details/:name", fn]] over
+   * location.hash — iframe-friendly (the dashboard embeds the apps the
+   * same way the reference's iframe-container does). */
+  constructor(outlet, routes) {
+    this.outlet = outlet;
+    this.routes = routes.map(([pattern, fn]) => {
+      const names = [];
+      const regex = new RegExp("^" + pattern.replace(
+        /:([A-Za-z_]+)/g, (_, n) => { names.push(n); return "([^/]+)"; },
+      ) + "$");
+      return { regex, names, fn };
+    });
+    window.addEventListener("hashchange", () => this.render());
+  }
+
+  path() {
+    return location.hash.replace(/^#/, "") || "/";
+  }
+
+  go(path) {
+    if ("#" + path === location.hash) this.render();
+    else location.hash = path;
+  }
+
+  render() {
+    const path = this.path();
+    for (const { regex, names, fn } of this.routes) {
+      const m = path.match(regex);
+      if (m) {
+        const params = {};
+        names.forEach((n, i) => {
+          params[n] = decodeURIComponent(m[i + 1]);
+        });
+        clear(this.outlet);
+        fn(this.outlet, params);
+        return;
+      }
+    }
+    clear(this.outlet).append(h("p", {}, `no view for ${path}`));
+  }
+}
+
+/* ---------------------------------------------------------- feedback */
+
+let snackTimer = null;
+export function snack(message, kind) {
+  let el = document.getElementById("kf-snackbar");
+  if (!el) {
+    el = h("div", { id: "kf-snackbar" });
+    document.body.append(el);
+  }
+  el.textContent = message;
+  el.className = "show " + (kind || "info");
+  clearTimeout(snackTimer);
+  snackTimer = setTimeout(() => { el.className = ""; }, 4000);
+}
+
+export function confirmDialog({ title, body, action, danger }) {
+  /* promise<bool> modal (kubeflow-common-lib confirm-dialog) */
+  return new Promise((resolve) => {
+    const close = (ok) => { overlay.remove(); resolve(ok); };
+    const overlay = h("div.kf-overlay", { onclick: (e) => {
+      if (e.target === overlay) close(false);
+    } },
+      h("div.kf-dialog", {},
+        h("h3", {}, title),
+        h("p", {}, body || ""),
+        h("div.kf-dialog-actions", {},
+          h("button.ghost", { onclick: () => close(false) }, "Cancel"),
+          h("button" + (danger ? ".danger" : ".primary"),
+            { onclick: () => close(true) }, action || "OK"),
+        ),
+      ),
+    );
+    document.body.append(overlay);
+  });
+}
+
+/* ------------------------------------------------------------ poller */
+
+export class Poller {
+  /* Repeated refresh with backoff on errors; pause when the tab is
+   * hidden (common-lib poller.service behavior). */
+  constructor(fn, intervalMs) {
+    this.fn = fn;
+    this.interval = intervalMs || 8000;
+    this.timer = null;
+    this.stopped = false;
+    document.addEventListener("visibilitychange", () => {
+      if (!document.hidden && !this.stopped) this.kick();
+    });
+  }
+
+  async tick() {
+    if (this.stopped || document.hidden) return;
+    let delay = this.interval;
+    try {
+      await this.fn();
+    } catch (e) {
+      delay = Math.min(this.interval * 4, 60000);
+    }
+    if (!this.stopped) this.timer = setTimeout(() => this.tick(), delay);
+  }
+
+  kick() {
+    clearTimeout(this.timer);
+    this.tick();
+  }
+
+  stop() {
+    this.stopped = true;
+    clearTimeout(this.timer);
+  }
+}
+
+/* -------------------------------------------------------- namespaces */
+
+export async function namespaces() {
+  const data = await api("GET", "api/namespaces");
+  return data.namespaces || data;
+}
+
+export function currentNamespace() {
+  return localStorage.getItem("kf-namespace") || "";
+}
+
+export function setNamespace(ns) {
+  localStorage.setItem("kf-namespace", ns);
+}

@@ -72,5 +72,5 @@ def create_app(store):
         return cb.success()
 
     from . import frontend
-    frontend.install(app, "Tensorboards", "Tensorboard", frontend.TENSORBOARDS_UI)
+    frontend.install(app, "Tensorboards", "tensorboards")
     return app

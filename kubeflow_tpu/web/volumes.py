@@ -103,5 +103,5 @@ def create_app(store):
         return cb.success()
 
     from . import frontend
-    frontend.install(app, "Volumes", "Volume", frontend.VOLUMES_UI)
+    frontend.install(app, "Volumes", "volumes")
     return app

@@ -66,6 +66,7 @@ for entry in "${ORDER[@]}"; do
   run ${ENGINE:-docker} "${args[@]}"
   if $PUSH; then
     run ${ENGINE:-docker} push "$tag"
+    run ${ENGINE:-docker} push "$REGISTRY/$name:latest"
   fi
 done
 

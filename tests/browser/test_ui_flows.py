@@ -1,0 +1,150 @@
+"""Browser E2E: the §3.1 call stack driven through the real UI.
+
+Runs under playwright (browser-e2e CI job installs it; the unit-test
+image has no browser, so this module skips there). The same flows are
+contract-tested browserlessly in tests/test_frontend_assets.py and
+tests/test_web_apps.py; this tier proves the DOM wiring: spawn form →
+table row → status icon → stop/start/delete with confirm dialogs —
+the reference's Cypress surface
+(components/crud-web-apps/jupyter/frontend/cypress/e2e/*.cy.ts).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+pw = pytest.importorskip("playwright.sync_api")
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def servers():
+    base = _free_port()
+    env = dict(os.environ, PYTHONPATH=REPO, APP_SECURE_COOKIES="false")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "hack", "devserver.py"),
+         str(base)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if "ready" in line:
+            break
+    else:
+        proc.kill()
+        pytest.fail("devserver did not start")
+    yield {"jupyter": f"http://localhost:{base}",
+           "volumes": f"http://localhost:{base + 1}",
+           "tensorboards": f"http://localhost:{base + 2}",
+           "dashboard": f"http://localhost:{base + 3}"}
+    proc.terminate()
+
+
+@pytest.fixture(scope="module")
+def page(servers):
+    with pw.sync_playwright() as p:
+        browser = p.chromium.launch()
+        page = browser.new_page()
+        yield page
+        browser.close()
+
+
+def test_jupyter_spawn_to_delete(servers, page):
+    page.goto(servers["jupyter"] + "/")
+    page.wait_for_selector("#ns-select")
+    assert page.locator("#ns-select").input_value() == "team-a"
+    page.wait_for_selector("text=no notebooks in this namespace")
+
+    # spawn form
+    page.click("#new-resource")
+    page.wait_for_selector("#form-basics")
+    page.fill("#f-name", "ui-nb")
+    page.select_option("#f-type", "tpu-v5-lite-podslice")
+    page.select_option("#f-topology", "2x4")
+    page.click("#form-configurations input[type=checkbox]")
+    page.click("#submit-notebook")
+
+    # back on index; the controller + fake kubelet bring it to ready
+    page.wait_for_selector("tr[data-row=ui-nb]")
+    page.wait_for_selector("tr[data-row=ui-nb] .status-ready",
+                           timeout=30000)
+    assert page.locator(
+        "button[data-action=connect][data-row=ui-nb]").is_visible()
+
+    # details page: tabs render
+    page.click("tr[data-row=ui-nb] a")
+    page.wait_for_selector(".kf-tabs")
+    page.click("button[data-tab=events]")
+    page.click("button[data-tab=yaml]")
+    assert "google.com/tpu" in page.inner_text("code.kf-yaml")
+    page.click("text=← back")
+
+    # stop (confirm dialog) → stopped status → start → ready
+    page.click("button[data-action=stop][data-row=ui-nb]")
+    page.click(".kf-dialog button.primary, .kf-dialog button.danger")
+    page.wait_for_selector("tr[data-row=ui-nb] .status-stopped",
+                           timeout=30000)
+    page.click("button[data-action=start][data-row=ui-nb]")
+    page.wait_for_selector("tr[data-row=ui-nb] .status-ready",
+                           timeout=30000)
+
+    # delete (danger confirm) → row gone
+    page.click("button[data-action=delete][data-row=ui-nb]")
+    page.click(".kf-dialog button.danger")
+    page.wait_for_selector("tr[data-row=ui-nb]", state="detached",
+                           timeout=30000)
+
+
+def test_volumes_create_and_delete(servers, page):
+    page.goto(servers["volumes"] + "/")
+    page.wait_for_selector("#new-resource")
+    page.click("#new-resource")
+    page.fill("#f-name", "ui-vol")
+    page.fill("#f-size", "5Gi")
+    page.click("#submit-volume")
+    page.wait_for_selector("tr[data-row=ui-vol]")
+    page.click("button[data-action=delete][data-row=ui-vol]")
+    page.click(".kf-dialog button.danger")
+    page.wait_for_selector("tr[data-row=ui-vol]", state="detached",
+                           timeout=30000)
+
+
+def test_tensorboards_form(servers, page):
+    page.goto(servers["tensorboards"] + "/")
+    page.wait_for_selector("#new-resource")
+    page.click("#new-resource")
+    page.fill("#f-name", "ui-tb")
+    page.click("#submit-tensorboard")
+    page.wait_for_selector("tr[data-row=ui-tb]")
+
+
+def test_dashboard_landing(servers, page):
+    page.goto(servers["dashboard"] + "/")
+    page.wait_for_selector("#user")
+    assert "team-a" in page.inner_text("main")
+    assert page.locator("a[href='/jupyter/']").is_visible()
+
+
+def test_form_validation_blocks_bad_names(servers, page):
+    page.goto(servers["jupyter"] + "/#/new")
+    page.wait_for_selector("#form-basics")
+    page.fill("#f-name", "Bad_Name!")
+    page.click("#submit-notebook")
+    # stays on the form with a field error; nothing was created
+    assert page.locator("#form-basics .kf-field.invalid").count() >= 1
+    page.goto(servers["jupyter"] + "/#/")
+    page.wait_for_selector("#ns-select")
+    assert page.locator('tr[data-row="Bad_Name!"]').count() == 0

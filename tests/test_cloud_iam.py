@@ -17,6 +17,7 @@ class FakeGcpIam:
 
     def __init__(self):
         self.policies = {}
+        self.missing = set()       # GSAs that 404 (deleted out-of-band)
         self.auth_headers = []
         fake = self
 
@@ -32,6 +33,10 @@ class FakeGcpIam:
                 gsa = gsa.rsplit("/", 1)[-1]
                 length = int(self.headers.get("Content-Length") or 0)
                 body = json.loads(self.rfile.read(length) or b"{}")
+                if gsa in fake.missing:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
                 if verb == "getIamPolicy":
                     out = fake.policies.get(gsa, {"etag": "e0"})
                 elif verb == "setIamPolicy":
@@ -53,6 +58,7 @@ class FakeGcpIam:
 
     def close(self):
         self.httpd.shutdown()
+        self.httpd.server_close()
 
 
 class FakeAwsIam:
@@ -109,6 +115,7 @@ class FakeAwsIam:
 
     def close(self):
         self.httpd.shutdown()
+        self.httpd.server_close()
 
 
 # ----------------------------------------------------------------- GCP
@@ -254,3 +261,80 @@ def test_plugins_drive_real_clients(gcp, aws):
         "Sid"] == "kubeflow-team-a"
     aplugin.revoke(store, profile_obj, {"awsIamRole": ROLE_ARN})
     assert aws_fake.trust["kf-notebooks"]["Statement"] == []
+
+
+class TestCredentialsAndRevokeTolerance:
+    def test_detach_on_deleted_role_is_noop(self, aws):
+        fake, client = aws
+        # role never created in the fake → GetRole 404 → clean no-op
+        client.detach_trust(
+            "x", "arn:aws:iam::123456789012:role/vanished")
+
+    def test_gcp_unbind_on_deleted_gsa_is_noop(self, gcp):
+        fake, client = gcp
+        fake.missing.add("gone@x")
+        client.unbind("a", "default-editor", "gone@x")  # must not raise
+        # a non-404 error still surfaces
+        fake.close()
+        with pytest.raises(CloudIamError):
+            client.unbind("a", "default-editor", "g@x")
+
+    def test_sigv4_scope_is_us_east_1_by_default(self, aws):
+        fake, client = aws
+        assert client.region == "us-east-1"
+        client.attach_trust("scope-ns", ROLE_ARN)
+        assert "/us-east-1/iam/aws4_request" in fake.auth_headers[-1]
+
+    def test_web_identity_credentials_via_fake_sts(self, tmp_path):
+        import threading
+        import urllib.parse
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        from kubeflow_tpu.controllers.cloud_iam import (
+            WebIdentityAwsCredentials)
+
+        seen = {}
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                seen.update(dict(urllib.parse.parse_qsl(
+                    self.rfile.read(length).decode())))
+                body = (
+                    "<AssumeRoleWithWebIdentityResponse>"
+                    "<AssumeRoleWithWebIdentityResult><Credentials>"
+                    "<AccessKeyId>ASIATEMP</AccessKeyId>"
+                    "<SecretAccessKey>tmpsecret</SecretAccessKey>"
+                    "<SessionToken>tmptoken</SessionToken>"
+                    "<Expiration>2099-01-01T00:00:00Z</Expiration>"
+                    "</Credentials>"
+                    "</AssumeRoleWithWebIdentityResult>"
+                    "</AssumeRoleWithWebIdentityResponse>").encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        token_file = tmp_path / "token"
+        token_file.write_text("jwt-token-abc")
+        try:
+            creds = WebIdentityAwsCredentials(
+                role_arn="arn:aws:iam::1:role/ctl",
+                token_file=str(token_file),
+                sts_url=f"http://127.0.0.1:{httpd.server_address[1]}")
+            assert creds.available
+            got = creds.get()
+            assert got.access_key == "ASIATEMP"
+            assert got.session_token == "tmptoken"
+            assert seen["WebIdentityToken"] == "jwt-token-abc"
+            # cached until expiry: a second get() makes no new call
+            seen.clear()
+            again = creds.get()
+            assert again is got and not seen
+        finally:
+            httpd.shutdown()

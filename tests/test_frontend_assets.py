@@ -1,0 +1,238 @@
+"""Frontend tier: shell wiring, module graph, and JS↔backend contract.
+
+Browser-engine tests live in tests/browser/ (playwright, run by the
+browser-e2e CI job — no JS runtime exists in the unit-test image).
+This layer pins everything that can break statically:
+
+- each app serves the SPA shell pointing at its module,
+- every static asset resolves with the right content type,
+- the ES-module import graph is closed (every import resolves, every
+  imported name is exported by its target),
+- every API path template the JS calls matches a registered backend
+  route in that app (the Angular-app/backend drift class of bug),
+- no path traversal through the static route.
+"""
+
+import os
+import re
+
+import pytest
+
+from kubeflow_tpu import api as capi
+from kubeflow_tpu.core import ObjectStore
+from kubeflow_tpu.web import dashboard, jupyter, tensorboards, volumes
+from kubeflow_tpu.web.frontend import STATIC_DIR
+from kubeflow_tpu.web.http import Request
+
+APPS = {
+    "jupyter": jupyter.create_app,
+    "volumes": volumes.create_app,
+    "tensorboards": tensorboards.create_app,
+    "dashboard": dashboard.create_app,
+}
+
+
+@pytest.fixture(scope="module")
+def store():
+    s = ObjectStore()
+    capi.register_all(s)
+    return s
+
+
+def _get(app, path):
+    return app.handle(Request("GET", path,
+                              headers={"kubeflow-userid": "u@x.org"}))
+
+
+def _js_files():
+    out = []
+    for root, _, files in os.walk(STATIC_DIR):
+        for fn in files:
+            if fn.endswith(".js"):
+                out.append(os.path.join(root, fn))
+    return sorted(out)
+
+
+def test_shells_point_at_app_modules(store):
+    for name, factory in APPS.items():
+        app = factory(store)
+        resp = _get(app, "/")
+        assert resp.status == 200, name
+        html = resp.body.decode()
+        assert f"static/apps/{name}.js" in html, name
+        assert "static/kubeflow.css" in html
+
+
+def test_static_assets_served_with_types(store):
+    app = APPS["jupyter"](store)
+    css = _get(app, "/static/kubeflow.css")
+    assert css.status == 200
+    assert "text/css" in css.headers["Content-Type"]
+    for rel in ("lib/core.js", "lib/components.js", "apps/jupyter.js"):
+        resp = _get(app, f"/static/{rel}")
+        assert resp.status == 200, rel
+        assert resp.headers["Content-Type"] == "text/javascript", rel
+
+
+def test_static_no_traversal(store):
+    app = APPS["jupyter"](store)
+    for path in ("/static/../jupyter.py", "/static/..%2f..%2fetc/passwd",
+                 "/static/../../../../etc/passwd"):
+        resp = _get(app, path)
+        assert resp.status == 404, path
+
+
+_IMPORT = re.compile(
+    r'import\s*(?:\{([^}]*)\}\s*from\s*)?["\'](\.[^"\']+)["\']')
+_EXPORT_NAMES = re.compile(
+    r"export\s+(?:async\s+)?(?:function|class|const|let)\s+(\w+)")
+_EXPORT_LIST = re.compile(r"export\s*\{([^}]*)\}", re.S)
+
+
+def _exports_of(path):
+    src = open(path).read()
+    names = set(_EXPORT_NAMES.findall(src))
+    for block in _EXPORT_LIST.findall(src):
+        for item in block.split(","):
+            item = item.strip()
+            if item:
+                names.add(item.split(" as ")[-1].strip())
+    return names
+
+
+def test_module_graph_closed():
+    for js in _js_files():
+        src = open(js).read()
+        for names, target in _IMPORT.findall(src):
+            full = os.path.normpath(
+                os.path.join(os.path.dirname(js), target))
+            assert os.path.isfile(full), f"{js}: import {target}"
+            exported = _exports_of(full)
+            for n in names.split(","):
+                n = n.strip().split(" as ")[0].strip()
+                if n:
+                    assert n in exported, \
+                        f"{os.path.basename(js)} imports {n} " \
+                        f"not exported by {target}"
+
+
+def _strip_js(src):
+    """Blank out comments, strings, template literals (keeping ${}
+    expressions), and regex literals — a tiny scanner, since no JS
+    engine exists in this image."""
+    out = []
+    i, n = 0, len(src)
+    last_sig = ""  # last significant char (regex-vs-division heuristic)
+    while i < n:
+        c = src[i]
+        nxt = src[i + 1] if i + 1 < n else ""
+        if c == "/" and nxt == "/":
+            while i < n and src[i] != "\n":
+                i += 1
+            continue
+        if c == "/" and nxt == "*":
+            i += 2
+            while i + 1 < n and not (src[i] == "*" and src[i + 1] == "/"):
+                i += 1
+            i += 2
+            continue
+        if c in "'\"":
+            quote = c
+            i += 1
+            while i < n and src[i] != quote:
+                i += 2 if src[i] == "\\" else 1
+            i += 1
+            last_sig = quote
+            continue
+        if c == "`":
+            # template literal: skip text, keep ${ } expr contents
+            i += 1
+            while i < n and src[i] != "`":
+                if src[i] == "\\":
+                    i += 2
+                elif src[i] == "$" and i + 1 < n and src[i + 1] == "{":
+                    depth = 1
+                    out.append("(")
+                    i += 2
+                    while i < n and depth:
+                        if src[i] == "{":
+                            depth += 1
+                        elif src[i] == "}":
+                            depth -= 1
+                        if depth:
+                            out.append(src[i])
+                        i += 1
+                    out.append(")")
+                else:
+                    i += 1
+            i += 1
+            last_sig = "`"
+            continue
+        if c == "/" and last_sig in "=(,:[!&|?;{}\n+" + "":
+            # regex literal position (not division)
+            i += 1
+            in_class = False
+            while i < n and (in_class or src[i] != "/"):
+                if src[i] == "\\":
+                    i += 1
+                elif src[i] == "[":
+                    in_class = True
+                elif src[i] == "]":
+                    in_class = False
+                i += 1
+            i += 1
+            last_sig = "0"
+            continue
+        out.append(c)
+        if not c.isspace():
+            last_sig = c
+        i += 1
+    return "".join(out)
+
+
+def test_js_brackets_balanced():
+    # no JS runtime in this image: catch gross syntax damage at least
+    pairs = {"(": ")", "[": "]", "{": "}"}
+    for js in _js_files():
+        src = _strip_js(open(js).read())
+        stack = []
+        for ch in src:
+            if ch in pairs:
+                stack.append(pairs[ch])
+            elif ch in pairs.values():
+                assert stack and stack.pop() == ch, \
+                    f"unbalanced {ch} in {js}"
+        assert not stack, f"unclosed {stack[-1]} in {js}"
+
+
+_API_CALL = re.compile(r'api\(\s*"(GET|POST|PATCH|DELETE|PUT)"\s*,\s*'
+                       r'([`"\'])((?:(?!\2).)*)\2')
+
+
+def _routes_of(app):
+    return [(m, rx) for (m, rx, _fn) in app._routes]
+
+
+def test_js_api_calls_match_backend_routes(store):
+    """Every api() path template in each app's JS (and the shared lib)
+    must match a registered route on that app."""
+    for name, factory in APPS.items():
+        app = factory(store)
+        routes = _routes_of(app)
+        sources = [os.path.join(STATIC_DIR, "apps", f"{name}.js"),
+                   os.path.join(STATIC_DIR, "lib", "core.js"),
+                   os.path.join(STATIC_DIR, "lib", "components.js")]
+        for src_path in sources:
+            src = open(src_path).read()
+            # join template concatenations: `a` + `b` and `a/` + r.name
+            src = re.sub(r"`\s*\+\s*`", "", src, flags=re.S)
+            src = re.sub(r"`\s*\+\s*[\w.()]+", "${x}`", src)
+            for method, _q, template in _API_CALL.findall(src):
+                path = "/" + re.sub(r"\$\{[^}]*\}", "param",
+                                    template).lstrip("/")
+                path = path.split("?")[0]
+                matched = any(m == method and rx.match(path)
+                              for m, rx in routes)
+                assert matched, (f"{os.path.basename(src_path)} calls "
+                                 f"{method} {template} — no such route "
+                                 f"in {name} app")
