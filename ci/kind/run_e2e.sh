@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Real-cluster e2e: controllers on this host against the current
+# kubectl context (KinD in CI), assertions via ci/kind/e2e_test.py.
+#
+# This is the controller-runtime "run locally against a cluster" mode:
+# the cluster hosts the apiserver, CRDs and real workloads; the
+# controller process runs here through core.kubestore.KubeStore — the
+# same wire path the in-cluster Deployment uses.
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+cd "$REPO"
+
+kubectl apply -k manifests/crds
+kubectl apply -f ci/kind/istio-crds.yaml
+
+# mint a token for the controller + tests (K8s >= 1.24)
+kubectl create serviceaccount kftpu-e2e -n default \
+  --dry-run=client -o yaml | kubectl apply -f -
+kubectl create clusterrolebinding kftpu-e2e-admin \
+  --clusterrole=cluster-admin --serviceaccount=default:kftpu-e2e \
+  --dry-run=client -o yaml | kubectl apply -f -
+
+export KUBE_TOKEN="$(kubectl create token kftpu-e2e -n default)"
+export KUBE_API_SERVER="$(kubectl config view --minify \
+  -o jsonpath='{.clusters[0].cluster.server}')"
+export KUBE_INSECURE=true     # KinD self-signed certs
+export USE_ISTIO=true
+export ENABLE_CULLING=false
+export METRICS_PORT=18080
+
+echo "cluster: $KUBE_API_SERVER"
+
+python -m kubeflow_tpu.cmd notebook-controller &
+CTRL_PID=$!
+trap 'kill $CTRL_PID 2>/dev/null || true' EXIT
+
+# controller health gate — fail fast if it never comes up
+for i in $(seq 1 30); do
+  curl -fs "http://127.0.0.1:${METRICS_PORT}/healthz" >/dev/null && break
+  sleep 1
+done
+curl -fs "http://127.0.0.1:${METRICS_PORT}/healthz" >/dev/null || {
+  echo "notebook-controller failed to become healthy" >&2
+  exit 1
+}
+
+export E2E_EXPECT_TPU_NODE=true   # install_kind.sh patched capacity
+python -m pytest ci/kind/e2e_test.py -v "$@"

@@ -1,0 +1,49 @@
+"""The ci/kind e2e, executed in-process over the real wire protocol.
+
+Same test module, same KubeStore REST dialect, same controllers — the
+apiserver is the fake from tests/fake_apiserver.py and the kubelet is
+the workload runtime. This keeps the KinD suite (ci/kind/e2e_test.py)
+green-by-construction: every assertion it makes against a live cluster
+is exercised here on every CI run (envtest philosophy — fake exactly
+the apiserver boundary, keep the semantics)."""
+
+import importlib
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from fake_apiserver import FakeApiServer  # noqa: E402
+
+from kubeflow_tpu.controllers import notebook  # noqa: E402
+from kubeflow_tpu.controllers.workload_runtime import (  # noqa: E402
+    PodRuntimeReconciler, StatefulSetReconciler)
+from kubeflow_tpu.core import Manager  # noqa: E402
+from kubeflow_tpu.core.kubestore import KubeStore  # noqa: E402
+
+
+@pytest.fixture()
+def wire(monkeypatch):
+    server = FakeApiServer()
+    monkeypatch.setenv("KUBE_API_SERVER", server.url)
+    monkeypatch.setenv("KUBE_TOKEN", "t")
+    monkeypatch.setenv("USE_ISTIO", "true")
+    monkeypatch.setenv("E2E_EXPECT_CASCADE", "false")  # fake has no GC
+    store = KubeStore(base_url=server.url, token="t")
+    mgr = Manager(store)
+    mgr.add(notebook.NotebookReconciler())
+    mgr.add(StatefulSetReconciler())
+    mgr.add(PodRuntimeReconciler())
+    mgr.start()
+    yield store
+    mgr.stop()
+    for w in store._watches:
+        w.stop()
+    server.close()
+
+
+def test_kind_e2e_suite_over_wire(wire):
+    e2e = importlib.import_module("ci.kind.e2e_test")
+    e2e.test_notebook_lifecycle(wire)
