@@ -26,16 +26,17 @@ def _release_tag():
 
 
 TAG = _release_tag()
+PLATFORM_IMAGE = "kubeflowtpu/platform:" + TAG
 
 # component -> (image, port, extra env, needs webhook cert)
 CONTROLLERS = {
     "notebook-controller": {
-        "image": "kubeflowtpu/notebook-controller:" + TAG,
+        "image": PLATFORM_IMAGE,
         "env": {"USE_ISTIO": "true", "ISTIO_GATEWAY":
                 "kubeflow/kubeflow-gateway", "ENABLE_CULLING": "true"},
     },
     "secure-notebook-controller": {
-        "image": "kubeflowtpu/secure-notebook-controller:" + TAG,
+        "image": PLATFORM_IMAGE,
         "env": {"OAUTH_PROXY_IMAGE":
                 "kubeflowtpu/auth-proxy:" + TAG},
         "webhook": {"path": "/mutate-notebook-v1",
@@ -45,21 +46,21 @@ CONTROLLERS = {
                                "resources": ["notebooks"]}]},
     },
     "profile-controller": {
-        "image": "kubeflowtpu/profile-controller:" + TAG,
+        "image": PLATFORM_IMAGE,
         "env": {"USERID_HEADER": "kubeflow-userid",
                 "USERID_PREFIX": ""},
         "cluster_scope": True,
     },
     "tensorboard-controller": {
-        "image": "kubeflowtpu/tensorboard-controller:" + TAG,
+        "image": PLATFORM_IMAGE,
         "env": {"RWO_PVC_SCHEDULING": "true"},
     },
     "tpuslice-controller": {
-        "image": "kubeflowtpu/tpuslice-controller:" + TAG,
+        "image": PLATFORM_IMAGE,
         "env": {},
     },
     "admission-webhook": {
-        "image": "kubeflowtpu/admission-webhook:" + TAG,
+        "image": PLATFORM_IMAGE,
         "env": {},
         "webhook": {"path": "/apply-poddefault",
                     "rules": [{"apiGroups": [""],
@@ -70,16 +71,16 @@ CONTROLLERS = {
 }
 
 WEB_APPS = {
-    "jupyter-web-app": {"image": "kubeflowtpu/jupyter-web-app:" + TAG,
+    "jupyter-web-app": {"image": PLATFORM_IMAGE,
                         "port": 5000, "prefix": "/jupyter"},
-    "volumes-web-app": {"image": "kubeflowtpu/volumes-web-app:" + TAG,
+    "volumes-web-app": {"image": PLATFORM_IMAGE,
                         "port": 5000, "prefix": "/volumes"},
     "tensorboards-web-app": {
-        "image": "kubeflowtpu/tensorboards-web-app:" + TAG,
+        "image": PLATFORM_IMAGE,
         "port": 5000, "prefix": "/tensorboards"},
-    "access-management": {"image": "kubeflowtpu/access-management:" + TAG,
+    "access-management": {"image": PLATFORM_IMAGE,
                           "port": 8081, "prefix": "/kfam"},
-    "centraldashboard": {"image": "kubeflowtpu/centraldashboard:" + TAG,
+    "centraldashboard": {"image": PLATFORM_IMAGE,
                          "port": 8082, "prefix": "/"},
 }
 
@@ -278,7 +279,8 @@ def main():
         # admission-webhook runs no Manager (cmd/__init__.py) → no lease
         docs = rbac(name, election=(name != "admission-webhook"))
         docs.append(deployment(name, spec["image"], spec["env"],
-                               port=8443 if "webhook" in spec else None))
+                               port=8443 if "webhook" in spec else None,
+                               args=[name]))
         if "webhook" in spec:
             docs.append(service(name, 443, target=8443))
             docs.append(webhook_config(name, spec["webhook"]))
@@ -291,7 +293,7 @@ def main():
         docs = rbac(name)
         docs.append(deployment(name, spec["image"],
                                {"USERID_HEADER": "kubeflow-userid"},
-                               port=spec["port"]))
+                               port=spec["port"], args=[name]))
         docs.append(service(name, 80, target=spec["port"]))
         docs.append(virtual_service(name, spec["prefix"], 80))
         dump(f"{name}/resources.yaml", docs)

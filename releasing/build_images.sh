@@ -32,11 +32,15 @@ done
 ORDER=(
   "base -"
   "auth-proxy -"
+  "platform -"
   "jupyter base"
   "codeserver base"
+  "rstudio base"
+  "jupyter-scipy jupyter"
   "jupyter-jax-tpu jupyter"
   "jupyter-pytorch-xla-tpu jupyter"
   "jupyter-jax-tpu-full jupyter-jax-tpu"
+  "jupyter-pytorch-xla-tpu-full jupyter-pytorch-xla-tpu"
 )
 
 ENGINE=""
@@ -62,7 +66,12 @@ for entry in "${ORDER[@]}"; do
   if [[ "$parent" != "-" ]]; then
     args+=(--build-arg "BASE_IMAGE=$REGISTRY/$parent:$VERSION")
   fi
-  args+=("$REPO/images/$name")
+  if [[ "$name" == "platform" ]]; then
+    # control-plane image copies the package: repo-root build context
+    args+=(-f "$REPO/images/platform/Dockerfile" "$REPO")
+  else
+    args+=("$REPO/images/$name")
+  fi
   run ${ENGINE:-docker} "${args[@]}"
   if $PUSH; then
     run ${ENGINE:-docker} push "$tag"
