@@ -13,6 +13,7 @@ TPU utilization source can be plugged the same way.
 
 from ..api import profile as papi
 from ..core import meta as m
+from ..core.errors import AlreadyExistsError
 from . import crud_backend as cb
 from . import kfam as kfam_lib
 from .http import App, HTTPError
@@ -110,6 +111,64 @@ def create_app(store, metrics_service=None):
             raise HTTPError(409, f"profile {name} already exists")
         store.create(papi.new(name, user))
         return {"message": f"Created profile {name}"}
+
+    # ---- contributor management (reference api_workgroup.ts
+    # getContributors/addContributor/removeContributor + the Polymer
+    # manage-users-view; kfam's binding semantics shared directly)
+
+    def _require_owner(request, ns):
+        if not kfam_lib.is_owner_or_admin(store, request.user, ns):
+            raise HTTPError(
+                403, f"user {request.user} is not owner/admin of {ns}")
+
+    @app.get("/api/workgroup/contributors")
+    def get_contributors(request):
+        ns = request.query.get("namespace")
+        if not ns:
+            raise HTTPError(400, "namespace query param required")
+        _require_owner(request, ns)
+        # the owner's own namespaceAdmin binding is not a "contributor"
+        # (reference api_workgroup.ts getContributors filters the owner)
+        prof = store.try_get(PROFILE_API, papi.KIND, ns)
+        owner = m.deep_get(prof or {}, "spec", "owner", "name")
+        return {"namespace": ns,
+                "contributors": [
+                    c for c in kfam_lib.list_contributors(store, ns)
+                    if c["user"] != owner]}
+
+    @app.post("/api/workgroup/contributors")
+    def add_contributor(request):
+        body = request.json
+        ns = body.get("namespace")
+        user = body.get("contributor")
+        if not ns or not user:
+            raise HTTPError(400, "namespace and contributor required")
+        _require_owner(request, ns)
+        role = body.get("role", "edit")
+        if role not in ("admin", "edit", "view"):
+            raise HTTPError(400, f"unknown role {role!r}")
+        try:
+            kfam_lib.add_contributor(store, ns, user, role)
+        except AlreadyExistsError:
+            raise HTTPError(409, f"{user} already has {role} in {ns}")
+        return {"message": f"Added {user} to {ns}"}
+
+    @app.delete("/api/workgroup/contributors")
+    def remove_contributor(request):
+        body = request.json
+        ns = body.get("namespace")
+        user = body.get("contributor")
+        if not ns or not user:
+            raise HTTPError(400, "namespace and contributor required")
+        _require_owner(request, ns)
+        role = body.get("role")
+        if role is not None and role not in ("admin", "edit", "view"):
+            raise HTTPError(400, f"unknown role {role!r}")
+        # no role → revoke every role the user holds (a removal that
+        # silently leaves access behind is worse than over-revoking)
+        for r in ([role] if role else ["admin", "edit", "view"]):
+            kfam_lib.remove_contributor(store, ns, user, r)
+        return {"message": f"Removed {user} from {ns}"}
 
     @app.get("/api/namespaces")
     def namespaces(request):

@@ -322,7 +322,8 @@ def create_app(store):
         nb = store.try_get(NB_API, nbapi.KIND, name, ns)
         if nb is None:
             raise HTTPError(404, f"notebook {ns}/{name} not found")
-        return cb.success({"notebook": nb})
+        return cb.success({"notebook": nb,
+                           "statusSummary": notebook_status(nb)})
 
     @app.get("/api/namespaces/<ns>/notebooks/<name>/pod")
     def get_pod(request, ns, name):

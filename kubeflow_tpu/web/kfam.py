@@ -72,6 +72,49 @@ def _authorization_policy(user, role, namespace):
         })
 
 
+# ---- shared contributor operations (used by the kfam routes below and
+# the dashboard's workgroup API — reference api_workgroup.ts proxies to
+# kfam over HTTP; same-language design calls the functions directly)
+
+def list_contributors(store, namespace):
+    """Contributor user names bound in a namespace (any role)."""
+    out = []
+    for rb in store.list(RBAC_API, "RoleBinding", namespace):
+        user = m.deep_get(rb, "metadata", "annotations", "user")
+        role = m.deep_get(rb, "metadata", "annotations", "role")
+        if user and role:
+            out.append({"user": user, "role": role})
+    return out
+
+
+def add_contributor(store, namespace, user, role_key="edit"):
+    """RoleBinding + mesh AuthorizationPolicy pair (bindings.go:96)."""
+    cluster_role = _ROLES[role_key]
+    name = binding_name(user, cluster_role)
+    rb = builtin.role_binding(
+        name, namespace, "ClusterRole", cluster_role,
+        [{"kind": "User", "name": user,
+          "apiGroup": "rbac.authorization.k8s.io"}],
+        annotations={"role": role_key, "user": user})
+    store.create(rb)
+    try:
+        store.create(_authorization_policy(user, cluster_role,
+                                           namespace))
+    except AlreadyExistsError:
+        pass
+
+
+def remove_contributor(store, namespace, user, role_key="edit"):
+    cluster_role = _ROLES[role_key]
+    name = binding_name(user, cluster_role)
+    for api, kind in ((RBAC_API, "RoleBinding"),
+                      (ISTIO_API, "AuthorizationPolicy")):
+        try:
+            store.delete(api, kind, name, namespace)
+        except NotFoundError:
+            pass
+
+
 def create_app(store):
     app = App("kfam")
     app.store = store
@@ -108,17 +151,14 @@ def create_app(store):
         if namespace and not namespaces:
             raise HTTPError(403, f"not owner or admin of {namespace}")
         for ns in namespaces:
-            for rb in store.list(RBAC_API, "RoleBinding", ns):
-                role = m.deep_get(rb, "metadata", "annotations", "role")
-                user = m.deep_get(rb, "metadata", "annotations", "user")
-                if not role or not user:
-                    continue
+            for c in list_contributors(store, ns):
                 bindings.append({
-                    "user": {"kind": "User", "name": user},
+                    "user": {"kind": "User", "name": c["user"]},
                     "referredNamespace": ns,
                     "RoleRef": {"apiGroup": "rbac.authorization.k8s.io",
                                 "kind": "ClusterRole",
-                                "name": _ROLES.get(role, role)},
+                                "name": _ROLES.get(c["role"],
+                                                   c["role"])},
                 })
         return {"bindings": bindings}
 
@@ -144,20 +184,12 @@ def create_app(store):
             raise HTTPError(
                 403, f"user {request.user} is neither owner of "
                      f"{ns} nor cluster admin")
-        name = binding_name(user, cluster_role)
-        rb = builtin.role_binding(
-            name, ns, "ClusterRole", cluster_role,
-            [{"kind": "User", "name": user,
-              "apiGroup": "rbac.authorization.k8s.io"}],
-            annotations={"role": role_key, "user": user})
         try:
-            store.create(rb)
+            add_contributor(store, ns, user, role_key)
         except AlreadyExistsError:
-            raise HTTPError(409, f"binding {name} already exists")
-        try:
-            store.create(_authorization_policy(user, cluster_role, ns))
-        except AlreadyExistsError:
-            pass
+            raise HTTPError(
+                409, f"binding {binding_name(user, cluster_role)} "
+                     f"already exists")
         return {"success": True}
 
     @app.delete("/kfam/v1/bindings")
@@ -165,13 +197,7 @@ def create_app(store):
         user, ns, role_key, cluster_role = _binding_args(request.json)
         if not is_owner_or_admin(store, request.user, ns):
             raise HTTPError(403, "not owner or admin")
-        name = binding_name(user, cluster_role)
-        for api, kind in ((RBAC_API, "RoleBinding"),
-                          (ISTIO_API, "AuthorizationPolicy")):
-            try:
-                store.delete(api, kind, name, ns)
-            except NotFoundError:
-                pass
+        remove_contributor(store, ns, user, role_key)
         return {"success": True}
 
     @app.post("/kfam/v1/profiles")

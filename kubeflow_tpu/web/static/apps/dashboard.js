@@ -50,6 +50,80 @@ function nsTable(info) {
         h("td", {}, n.namespace), h("td", {}, n.role))))));
 }
 
+function contributorsPanel(info) {
+  /* reference manage-users-view: owners add/remove namespace
+   * contributors (kfam RoleBinding + mesh AuthorizationPolicy pair);
+   * a selector covers every owned namespace */
+  const owned = info.namespaces.filter((n) => n.role === "owner");
+  if (!owned.length) return null;
+  const list = h("tbody");
+  const title = h("h2", {}, "");
+  const nsSelect = h("select", { id: "contributors-ns",
+    onchange: () => refresh().catch(fail) },
+    owned.map((n) => h("option", {}, n.namespace)));
+  const email = h("input", { id: "contributor-email",
+                             placeholder: "user@example.com" });
+  const role = h("select", { id: "contributor-role" },
+    ["edit", "view", "admin"].map((r) => h("option", {}, r)));
+
+  const fail = (e) => snack(String(e.message || e), "error");
+
+  const refresh = async () => {
+    const ns = nsSelect.value;
+    title.textContent = `Contributors of ${ns}`;
+    const data = await api("GET",
+      `api/workgroup/contributors?namespace=${ns}`);
+    clear(list);
+    for (const c of data.contributors) {
+      list.append(h("tr", { dataset: { contributor: c.user } },
+        h("td", {}, c.user), h("td", {}, c.role),
+        h("td.kf-actions", {}, h("button.ghost", {
+          onclick: async () => {
+            const ok = await confirmDialog({
+              title: `Remove ${c.user} from ${ns}?`,
+              action: "Remove", danger: true });
+            if (!ok) return;
+            try {
+              await api("DELETE", "api/workgroup/contributors",
+                { namespace: ns, contributor: c.user, role: c.role });
+              await refresh();
+            } catch (e) {
+              fail(e);
+            }
+          } }, "remove"))));
+    }
+    if (!data.contributors.length) {
+      list.append(h("tr", {},
+        h("td.kf-empty", { colSpan: 3 }, "no contributors yet")));
+    }
+  };
+
+  const add = async () => {
+    if (!email.value) return;
+    try {
+      await api("POST", "api/workgroup/contributors",
+        { namespace: nsSelect.value, contributor: email.value,
+          role: role.value });
+      snack(`added ${email.value}`, "success");
+      email.value = "";
+      await refresh();
+    } catch (e) {
+      fail(e);
+    }
+  };
+
+  refresh().catch(fail);
+  return h("div.kf-section", { id: "contributors" },
+    h("div.kf-toolbar", {}, title, h("span.kf-spacer"), nsSelect),
+    h("table.kf-table", {},
+      h("thead", {}, h("tr", {},
+        h("th", {}, "user"), h("th", {}, "role"), h("th", {}, ""))),
+      list),
+    h("div.kf-toolbar", {}, email, role,
+      h("button.primary", { id: "add-contributor", onclick: add },
+        "Add contributor")));
+}
+
 function launcher() {
   return h("div.kf-section", {},
     h("h2", {}, "Applications"),
@@ -113,6 +187,8 @@ async function metricsPanel(el, info) {
   const grid = h("div.kf-grid");
   outlet.append(grid);
   grid.append(launcher(), nsTable(info));
+  const contributors = contributorsPanel(info);
+  if (contributors) outlet.append(contributors);
   await activityFeed(outlet, info);
   await metricsPanel(outlet, info);
 })();

@@ -272,10 +272,12 @@ async function formView(el) {
 async function detailsView(el, params) {
   const ns = currentNamespace();
   const name = params.name;
-  let nb;
+  let nb, statusSummary;
   try {
-    nb = (await api("GET",
-      `api/namespaces/${ns}/notebooks/${name}`)).notebook;
+    const resp = await api("GET",
+      `api/namespaces/${ns}/notebooks/${name}`);
+    nb = resp.notebook;
+    statusSummary = resp.statusSummary;
   } catch (e) {
     el.append(h("p", {}, `cannot load ${name}: ${e.message}`));
     return;
@@ -338,8 +340,7 @@ async function detailsView(el, params) {
     h("div.kf-toolbar", {},
       h("button.ghost", { onclick: () => router.go("/") }, "← back"),
       h("h2", {}, name, " "),
-      statusIcon((nb.statusSummary || {}).phase
-        ? nb.statusSummary : { phase: "waiting" })),
+      statusIcon(statusSummary || { phase: "waiting" })),
     tabPanel([
       { id: "overview", label: "Overview", render: overview },
       { id: "logs", label: "Logs", render: logsTab },

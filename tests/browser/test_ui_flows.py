@@ -138,6 +138,18 @@ def test_dashboard_landing(servers, page):
     assert page.locator("a[href='/jupyter/']").is_visible()
 
 
+def test_dashboard_contributor_management(servers, page):
+    page.goto(servers["dashboard"] + "/")
+    page.wait_for_selector("#contributors")
+    page.fill("#contributor-email", "bob@example.com")
+    page.click("#add-contributor")
+    page.wait_for_selector('tr[data-contributor="bob@example.com"]')
+    page.click('tr[data-contributor="bob@example.com"] button')
+    page.click(".kf-dialog button.danger")
+    page.wait_for_selector('tr[data-contributor="bob@example.com"]',
+                           state="detached", timeout=15000)
+
+
 def test_form_validation_blocks_bad_names(servers, page):
     page.goto(servers["jupyter"] + "/#/new")
     page.wait_for_selector("#form-basics")

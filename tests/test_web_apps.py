@@ -311,6 +311,46 @@ class TestDashboard:
             is True
         assert store.try_get("v1", "Namespace", "mallory") is not None
 
+    def test_contributor_management(self, platform):
+        """api_workgroup.ts contributor flow + manage-users-view
+        semantics: owner adds/lists/removes; strangers are 403'd;
+        the binding + AuthorizationPolicy pair lands (kfam parity)."""
+        store, _ = platform
+        c = client(dashboard.create_app(store))
+        r = c.post("/api/workgroup/contributors", json_body={
+            "namespace": "team-a", "contributor": "bob@example.com"})
+        assert r.status == 200, r.json
+        got = c.get(
+            "/api/workgroup/contributors?namespace=team-a").json
+        assert got["contributors"] == [
+            {"user": "bob@example.com", "role": "edit"}]
+        # duplicate → 409
+        assert c.post("/api/workgroup/contributors", json_body={
+            "namespace": "team-a",
+            "contributor": "bob@example.com"}).status == 409
+        # the kfam pair exists
+        name = kfam.binding_name("bob@example.com", "kubeflow-edit")
+        assert store.try_get("rbac.authorization.k8s.io/v1",
+                             "RoleBinding", name, "team-a")
+        assert store.try_get("security.istio.io/v1beta1",
+                             "AuthorizationPolicy", name, "team-a")
+        # bob (a non-owner) may not manage contributors
+        cb_bob = client(dashboard.create_app(store),
+                        {"kubeflow-userid": "bob@example.com"})
+        assert cb_bob.get(
+            "/api/workgroup/contributors?namespace=team-a").status == 403
+        assert cb_bob.post("/api/workgroup/contributors", json_body={
+            "namespace": "team-a",
+            "contributor": "eve@example.com"}).status == 403
+        # remove
+        r = c.delete("/api/workgroup/contributors", json_body={
+            "namespace": "team-a", "contributor": "bob@example.com"})
+        assert r.status == 200
+        assert c.get("/api/workgroup/contributors?namespace=team-a"
+                     ).json["contributors"] == []
+        assert store.try_get("rbac.authorization.k8s.io/v1",
+                             "RoleBinding", name, "team-a") is None
+
     def test_metrics_service(self, platform):
         store, _ = platform
         c = client(dashboard.create_app(store))
