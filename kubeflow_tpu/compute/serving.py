@@ -14,7 +14,9 @@ substrate:
 """
 
 import json
+import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import jax
@@ -24,11 +26,121 @@ import numpy as np
 BATCH_BUCKETS = (1, 8, 64, 256)
 
 
+class _Batcher:
+    """Dynamic request batching (TF-Serving's batching layer): coalesce
+    concurrent predict calls into one device invocation. Requests are
+    grouped by item shape; the window closes at ``max_batch`` rows or
+    ``timeout_s`` after the first request, whichever first."""
+
+    def __init__(self, run_fn, max_batch=64, timeout_s=0.005):
+        self.run = run_fn             # (ndarray) -> ndarray
+        self.max_batch = max_batch
+        self.timeout_s = timeout_s
+        self.q = queue.Queue()
+        self._stop = False
+        self.thread = threading.Thread(target=self._loop, daemon=True,
+                                       name="serving-batcher")
+        self.thread.start()
+
+    def submit(self, x):
+        """Blocking: returns (result_rows, device_ms_of_the_batch)."""
+        done = threading.Event()
+        slot = {"x": x, "done": done}
+        self.q.put(slot)
+        done.wait()
+        if "error" in slot:
+            raise slot["error"]
+        return slot["out"], slot["ms"]
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                first = self.q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if first is None:
+                return
+            # everything below must never kill the thread: a dead
+            # batcher would hang every future predict on the model
+            try:
+                self._collect_and_run(first)
+            except Exception as e:  # noqa: BLE001 — keep serving
+                if "done" in first and not first["done"].is_set():
+                    first["error"] = e
+                    first["done"].set()
+
+    def _collect_and_run(self, first):
+        group = [first]
+        solo = []                  # different-shaped: run after group
+        rows = first["x"].shape[0]
+        stopping = False
+        deadline = time.monotonic() + self.timeout_s
+        while rows < self.max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self.q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if nxt is None:        # stop(): flush what we collected
+                stopping = True
+                break
+            if nxt["x"].shape[1:] != first["x"].shape[1:]:
+                solo.append(nxt)
+                continue
+            group.append(nxt)
+            rows += nxt["x"].shape[0]
+        self._run_group(group)
+        for s in solo:
+            self._run_group([s])
+        if stopping:
+            self._stop = True
+
+    def _run_group(self, group):
+        try:
+            x = np.concatenate([g["x"] for g in group], axis=0) \
+                if len(group) > 1 else group[0]["x"]
+            t0 = time.perf_counter()
+            out = np.asarray(self.run(x))
+            ms = 1000 * (time.perf_counter() - t0)
+            off = 0
+            for g in group:
+                n = g["x"].shape[0]
+                g["out"] = out[off:off + n]
+                g["ms"] = ms
+                off += n
+        except Exception as e:  # noqa: BLE001 — propagate per-request
+            for g in group:
+                g["error"] = e
+        finally:
+            for g in group:
+                g["done"].set()
+
+    def stop(self):
+        self._stop = True
+        self.q.put(None)
+
+
 class ServedModel:
-    def __init__(self, name, predict_fn, version=1):
+    def __init__(self, name, predict_fn, version=1, batching=False,
+                 max_batch=64, batch_timeout_ms=5.0):
         self.name = name
         self.version = version
         self._fn = jax.jit(predict_fn)
+        self.device_calls = 0
+        self._batcher = _Batcher(
+            self._run, max_batch=max_batch,
+            timeout_s=batch_timeout_ms / 1000.0) if batching else None
+
+    def _run(self, x):
+        n = x.shape[0]
+        bucket = next((b for b in BATCH_BUCKETS if b >= n), n)
+        if bucket > n:
+            pad = np.zeros((bucket - n,) + x.shape[1:], x.dtype)
+            x = np.concatenate([x, pad], axis=0)
+        self.device_calls += 1
+        return np.asarray(self._fn(x))[:n]
 
     def predict(self, instances):
         return self.predict_timed(instances)[0]
@@ -36,17 +148,21 @@ class ServedModel:
     def predict_timed(self, instances):
         """→ (predictions, device_ms). Timing returned per-call (no
         shared state: the HTTP server is threaded)."""
-        import time
         x = np.asarray(instances)
-        n = x.shape[0]
-        bucket = next((b for b in BATCH_BUCKETS if b >= n), n)
-        if bucket > n:
-            pad = np.zeros((bucket - n,) + x.shape[1:], x.dtype)
-            x = np.concatenate([x, pad], axis=0)
+        if x.ndim == 0:
+            raise ValueError(
+                "instances must be a list of inputs, got a scalar")
+        if self._batcher is not None:
+            out, ms = self._batcher.submit(x)
+            return out.tolist(), ms
         t0 = time.perf_counter()
-        out = np.asarray(self._fn(x))[:n]
+        out = self._run(x)
         infer_ms = 1000 * (time.perf_counter() - t0)
         return out.tolist(), infer_ms
+
+    def close(self):
+        if self._batcher is not None:
+            self._batcher.stop()
 
 
 class ModelServer:
@@ -58,8 +174,12 @@ class ModelServer:
         self._httpd = None
         self._thread = None
 
-    def register(self, name, predict_fn, version=1):
-        self._models[name] = ServedModel(name, predict_fn, version)
+    def register(self, name, predict_fn, version=1, **model_kwargs):
+        old = self._models.get(name)
+        self._models[name] = ServedModel(name, predict_fn, version,
+                                         **model_kwargs)
+        if old is not None:
+            old.close()    # don't leak the displaced model's batcher
 
     def models(self):
         return dict(self._models)
@@ -135,3 +255,5 @@ class ModelServer:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd = None
+        for model in self._models.values():
+            model.close()

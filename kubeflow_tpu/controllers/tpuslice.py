@@ -136,28 +136,84 @@ class TpuSliceReconciler(Reconciler):
 
 # --------------------------------------------------------------- StudyJob
 
-def sample_parameters(parameters, trial_index, seed=0):
-    """Deterministic per-trial parameter sampling (seeded — reproducible
-    sweeps; the reference's Katib test uses random-search,
-    katib_studyjob_test.py)."""
+def _param_grid_steps(p):
+    ptype = p.get("type", "double")
+    if ptype == "categorical":
+        return len(p.get("values") or [""])
+    if ptype == "int":
+        lo, hi = int(p.get("min", 0)), int(p.get("max", 1))
+        return min(int(p.get("steps", hi - lo + 1)), hi - lo + 1)
+    return int(p.get("steps", 3))
+
+
+def _param_value_at(p, u):
+    """Map u∈[0,1] (or a grid fraction) to a parameter value; doubles
+    support scale: linear (default) or log (Katib's logUniform)."""
+    import math
+    ptype = p.get("type", "double")
+    if ptype == "double":
+        lo, hi = float(p.get("min", 0)), float(p.get("max", 1))
+        if p.get("scale") == "log":
+            if lo <= 0:
+                raise ValueError(
+                    f"log scale needs min > 0 for {p.get('name')}")
+            return math.exp(math.log(lo) + u * (math.log(hi)
+                                                - math.log(lo)))
+        return lo + u * (hi - lo)
+    if ptype == "int":
+        lo, hi = int(p.get("min", 0)), int(p.get("max", 1))
+        return lo + min(int(u * (hi - lo + 1)), hi - lo)
+    if ptype == "categorical":
+        choices = p.get("values") or [""]
+        return choices[min(int(u * len(choices)), len(choices) - 1)]
+    raise ValueError(f"unknown parameter type {ptype!r}")
+
+
+def grid_size(parameters):
+    size = 1
+    for p in parameters:
+        size *= max(_param_grid_steps(p), 1)
+    return size
+
+
+def sample_parameters(parameters, trial_index, seed=0,
+                      algorithm="random"):
+    """Deterministic per-trial parameter assignment.
+
+    - ``random`` (default): seeded hash sampling — reproducible sweeps
+      (the reference's Katib test uses random-search,
+      katib_studyjob_test.py); doubles honor ``scale: log``.
+    - ``grid``: mixed-radix enumeration of the cartesian grid
+      (per-param ``steps``; categorical/int enumerate their domain);
+      trial_index wraps modulo the grid size.
+    """
     import hashlib
     values = {}
+    if algorithm == "grid":
+        idx = trial_index % max(grid_size(parameters), 1)
+        for p in parameters:
+            steps = max(_param_grid_steps(p), 1)
+            k = idx % steps
+            idx //= steps
+            ptype = p.get("type", "double")
+            if ptype == "double":
+                u = 0.0 if steps == 1 else k / (steps - 1)
+                values[p["name"]] = _param_value_at(p, u)
+            elif ptype == "int":
+                # direct index — a k/steps fraction round-trip drops or
+                # duplicates grid points to float error
+                values[p["name"]] = int(p.get("min", 0)) + k
+            else:   # categorical
+                values[p["name"]] = (p.get("values") or [""])[k]
+        return values
+    if algorithm != "random":
+        raise ValueError(f"unknown algorithm {algorithm!r}; "
+                         f"expected random or grid")
     for p in parameters:
         h = hashlib.sha256(
             f"{seed}:{trial_index}:{p['name']}".encode()).digest()
         u = int.from_bytes(h[:8], "big") / float(1 << 64)
-        ptype = p.get("type", "double")
-        if ptype == "double":
-            lo, hi = float(p.get("min", 0)), float(p.get("max", 1))
-            values[p["name"]] = lo + u * (hi - lo)
-        elif ptype == "int":
-            lo, hi = int(p.get("min", 0)), int(p.get("max", 1))
-            values[p["name"]] = lo + int(u * (hi - lo + 1))
-        elif ptype == "categorical":
-            choices = p.get("values") or [""]
-            values[p["name"]] = choices[int(u * len(choices)) % len(choices)]
-        else:
-            raise ValueError(f"unknown parameter type {ptype!r}")
+        values[p["name"]] = _param_value_at(p, u)
     return values
 
 
@@ -214,6 +270,27 @@ class StudyJobReconciler(Reconciler):
         parallelism = int(spec.get("parallelTrialCount", max_trials))
         parameters = spec.get("parameters") or []
         seed = int(m.deep_get(spec, "algorithm", "seed", default=0) or 0)
+        algorithm = m.deep_get(spec, "algorithm", "name",
+                               default="random") or "random"
+        # spec validation up front: a bad algorithm/parameter spec must
+        # become a terminal Failed condition, not an infinite
+        # crash-requeue loop
+        if parameters:
+            try:
+                sample_parameters(parameters, 0, seed, algorithm)
+            except ValueError as e:
+                status = {
+                    "phase": "Failed",
+                    "conditions": [{
+                        "type": "Failed", "status": "True",
+                        "reason": "InvalidSpec", "message": str(e),
+                        "lastTransitionTime": m.now_iso(),
+                    }],
+                }
+                if status != study.get("status"):
+                    study["status"] = status
+                    self.store.update_status(study)
+                return Result()
         objective = spec.get("objective") or {}
         metric_name = objective.get("metricName", "objective")
         maximize = objective.get("type", "maximize") == "maximize"
@@ -242,7 +319,8 @@ class StudyJobReconciler(Reconciler):
                      if t.get("state") == "Running")
         next_index = len(trials)
         while next_index < max_trials and active < parallelism:
-            values = sample_parameters(parameters, next_index, seed)
+            values = sample_parameters(parameters, next_index, seed,
+                                       algorithm)
             tname = self._trial_name(req.name, next_index)
             template = render_template(
                 spec.get("trialTemplate") or {"spec": {"containers": [{}]}},

@@ -175,3 +175,74 @@ class TestTrial:
         loss = trial.run_mnist_trial(steps=5)
         data = json.load(open(tmp_path / "m.json"))
         assert data["objective"] == loss
+
+
+class TestDynamicBatching:
+    """TF-Serving-style request coalescing: concurrent predicts share
+    one device invocation; results stay per-request correct."""
+
+    def test_concurrent_requests_coalesce(self):
+        import threading
+
+        import numpy as np
+
+        from kubeflow_tpu.compute import serving
+
+        model = serving.ServedModel(
+            "m", lambda x: x * 2.0, batching=True, max_batch=64,
+            batch_timeout_ms=50.0)
+        try:
+            results = {}
+
+            def one(i):
+                out, ms = model.predict_timed(
+                    np.full((2, 3), float(i), np.float32))
+                results[i] = out
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10)
+            assert len(results) == 8
+            for i, rows in results.items():
+                assert np.allclose(np.asarray(rows), 2.0 * i), (i, rows)
+            # 8 requests × 2 rows = 16 rows ≤ max_batch: far fewer
+            # device calls than requests
+            assert model.device_calls < 8, model.device_calls
+        finally:
+            model.close()
+
+    def test_mixed_shapes_run_solo(self):
+        import numpy as np
+
+        from kubeflow_tpu.compute import serving
+
+        model = serving.ServedModel(
+            "m", lambda x: x + 1.0, batching=True,
+            batch_timeout_ms=1.0)
+        try:
+            a, _ = model.predict_timed(np.zeros((1, 4), np.float32))
+            b, _ = model.predict_timed(np.zeros((1, 8), np.float32))
+            assert np.asarray(a).shape == (1, 4)
+            assert np.asarray(b).shape == (1, 8)
+        finally:
+            model.close()
+
+    def test_batcher_propagates_errors(self):
+        import numpy as np
+        import pytest
+
+        from kubeflow_tpu.compute import serving
+
+        def boom(x):
+            raise RuntimeError("bad model")
+
+        model = serving.ServedModel("m", boom, batching=True,
+                                    batch_timeout_ms=1.0)
+        try:
+            with pytest.raises(Exception):
+                model.predict_timed(np.zeros((1, 2), np.float32))
+        finally:
+            model.close()

@@ -182,3 +182,90 @@ class TestStudyJob:
         names = [p["metadata"]["name"]
                  for p in store.list("v1", "Pod", "default")]
         assert "study1-trial-2" in names
+
+
+class TestStudyAlgorithms:
+    """Katib-style algorithm surface: grid enumeration, log-scale
+    doubles, deterministic random (reference katib_studyjob_test.py
+    exercises random-search only; grid is the other core sweep)."""
+
+    PARAMS = [
+        {"name": "lr", "type": "double", "min": 0.001, "max": 0.1,
+         "scale": "log", "steps": 3},
+        {"name": "hidden", "type": "int", "min": 1, "max": 2},
+        {"name": "opt", "type": "categorical",
+         "values": ["sgd", "adam"]},
+    ]
+
+    def test_grid_enumerates_full_cartesian(self):
+        from kubeflow_tpu.controllers.tpuslice import (
+            grid_size, sample_parameters)
+        n = grid_size(self.PARAMS)
+        assert n == 3 * 2 * 2
+        combos = {tuple(sorted(sample_parameters(
+            self.PARAMS, i, algorithm="grid").items()))
+            for i in range(n)}
+        assert len(combos) == n, "every grid point distinct"
+        # wraps modulo the grid
+        assert sample_parameters(self.PARAMS, 0, algorithm="grid") == \
+            sample_parameters(self.PARAMS, n, algorithm="grid")
+
+    def test_log_scale_endpoints_and_bounds(self):
+        from kubeflow_tpu.controllers.tpuslice import sample_parameters
+        lrs = sorted({sample_parameters(
+            self.PARAMS, i, algorithm="grid")["lr"]
+            for i in range(12)})
+        assert abs(lrs[0] - 0.001) < 1e-9
+        assert abs(lrs[-1] - 0.1) < 1e-9
+        assert abs(lrs[1] - 0.01) < 1e-6, "log midpoint is 0.01"
+        for i in range(50):
+            v = sample_parameters(self.PARAMS, i, seed=7)["lr"]
+            assert 0.001 <= v <= 0.1
+
+    def test_random_is_seed_deterministic(self):
+        from kubeflow_tpu.controllers.tpuslice import sample_parameters
+        a = sample_parameters(self.PARAMS, 3, seed=1)
+        b = sample_parameters(self.PARAMS, 3, seed=1)
+        c = sample_parameters(self.PARAMS, 3, seed=2)
+        assert a == b and a != c
+
+    def test_unknown_algorithm_rejected(self):
+        import pytest
+        from kubeflow_tpu.controllers.tpuslice import sample_parameters
+        with pytest.raises(ValueError):
+            sample_parameters(self.PARAMS, 0, algorithm="bayes")
+
+    def test_large_categorical_grid_has_no_float_holes(self):
+        from kubeflow_tpu.controllers.tpuslice import sample_parameters
+        params = [{"name": "v", "type": "categorical",
+                   "values": [f"v{i}" for i in range(22)]}]
+        got = [sample_parameters(params, i, algorithm="grid")["v"]
+               for i in range(22)]
+        assert got == [f"v{i}" for i in range(22)]
+        params = [{"name": "n", "type": "int", "min": 0, "max": 21}]
+        got = [sample_parameters(params, i, algorithm="grid")["n"]
+               for i in range(22)]
+        assert got == list(range(22))
+
+    def test_invalid_spec_fails_study_terminally(self, store, manager):
+        """bad algorithm name → Failed condition, no requeue loop."""
+        from kubeflow_tpu.controllers.tpuslice import StudyJobReconciler
+        manager.add(StudyJobReconciler())
+        manager.start_sync()
+        from kubeflow_tpu.api import tpuslice as tsapi
+        study = tsapi.new_study(
+            "bad", "default", {"metricName": "objective"},
+            [{"name": "lr", "type": "double", "min": 0, "max": 1}],
+            {"spec": {"containers": [{"image": "x"}]}},
+            max_trials=2)
+        study["spec"]["algorithm"] = {"name": "bayesianoptimization"}
+        store.create(study)
+        manager.run_sync()
+        cur = store.get("kubeflow.org/v1alpha1", tsapi.STUDY_KIND,
+                        "bad", "default")
+        assert cur["status"]["phase"] == "Failed"
+        assert "bayesianoptimization" in \
+            cur["status"]["conditions"][0]["message"]
+        # no trial pods were launched
+        assert not [p for p in store.list("v1", "Pod", "default")
+                    if "studyjob" in (p["metadata"].get("labels") or {})]
