@@ -116,6 +116,48 @@ def mesh_for_slice(accelerator="", topology="", tensor=1, sequence=1,
         devices=devices)
 
 
+def device_slice_groups(devices=None):
+    """Group devices by TPU slice (``device.slice_index``; devices
+    without one — CPU, single-slice TPU — form one group). Groups are
+    ordered by slice index and must be equal-sized: multislice meshes
+    are rectangular."""
+    if devices is None:
+        devices = jax.devices()
+    groups = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    sizes = {len(g) for g in groups.values()}
+    if len(sizes) > 1:
+        raise ValueError(
+            f"unequal slice sizes {sorted(sizes)}: multislice meshes "
+            f"must be rectangular (got "
+            f"{ {k: len(v) for k, v in groups.items()} })")
+    return [groups[k] for k in sorted(groups)]
+
+
+def make_multislice_mesh(fsdp=1, sequence=1, tensor=1, expert=1,
+                         devices=None):
+    """Multi-slice mesh: ``data`` spans slices (DCN — once-per-step
+    gradient psum tolerates its latency), the model axes stay inside a
+    slice (ICI). Device order is [slice, within-slice], so reshaping to
+    (n_slices·data_per_slice, …inner) keeps every inner-axis collective
+    on ICI — the scaling-book multislice recipe. On one slice this
+    degrades to a plain mesh."""
+    groups = device_slice_groups(devices)
+    per_slice = len(groups[0])
+    inner = fsdp * sequence * tensor * expert
+    if per_slice % inner:
+        raise ValueError(
+            f"slice of {per_slice} chips not divisible by inner axes "
+            f"fsdp×sequence×tensor×expert = {inner}")
+    data = len(groups) * (per_slice // inner)
+    ordered = [d for g in groups for d in g]
+    return make_mesh(
+        MeshSpec(data=data, fsdp=fsdp, sequence=sequence, tensor=tensor,
+                 expert=expert),
+        devices=ordered)
+
+
 def distributed_env():
     """Read the TpuSlice/PodDefault-injected worker env. Returns
     (coordinator, num_processes, process_id) or None when not in a
