@@ -132,7 +132,11 @@ def device_slice_groups(devices=None):
             f"unequal slice sizes {sorted(sizes)}: multislice meshes "
             f"must be rectangular (got "
             f"{ {k: len(v) for k, v in groups.items()} })")
-    return [groups[k] for k in sorted(groups)]
+    # canonical within-slice order: adjacent device ids share an ICI
+    # link — arbitrary caller order on the inner axes would silently
+    # route per-layer collectives between non-adjacent chips
+    return [sorted(groups[k], key=lambda d: getattr(d, "id", 0))
+            for k in sorted(groups)]
 
 
 def make_multislice_mesh(fsdp=1, sequence=1, tensor=1, expert=1,
@@ -144,6 +148,22 @@ def make_multislice_mesh(fsdp=1, sequence=1, tensor=1, expert=1,
     on ICI — the scaling-book multislice recipe. On one slice this
     degrades to a plain mesh."""
     groups = device_slice_groups(devices)
+    ordered, spec = multislice_layout(groups, fsdp=fsdp,
+                                      sequence=sequence, tensor=tensor,
+                                      expert=expert)
+    return make_mesh(spec, devices=ordered)
+
+
+def multislice_layout(groups, fsdp=1, sequence=1, tensor=1, expert=1):
+    """Pure layout computation for make_multislice_mesh (separately
+    testable without real Device objects): returns (ordered_devices,
+    MeshSpec) with data = n_slices × (per_slice // inner)."""
+    for name, size in (("fsdp", fsdp), ("sequence", sequence),
+                       ("tensor", tensor), ("expert", expert)):
+        if size < 1:
+            raise ValueError(
+                f"{name}={size}: multislice inner axes must be >= 1 "
+                f"(the -1 wildcard lives on data, which is computed)")
     per_slice = len(groups[0])
     inner = fsdp * sequence * tensor * expert
     if per_slice % inner:
@@ -152,10 +172,8 @@ def make_multislice_mesh(fsdp=1, sequence=1, tensor=1, expert=1,
             f"fsdp×sequence×tensor×expert = {inner}")
     data = len(groups) * (per_slice // inner)
     ordered = [d for g in groups for d in g]
-    return make_mesh(
-        MeshSpec(data=data, fsdp=fsdp, sequence=sequence, tensor=tensor,
-                 expert=expert),
-        devices=ordered)
+    return ordered, MeshSpec(data=data, fsdp=fsdp, sequence=sequence,
+                             tensor=tensor, expert=expert)
 
 
 def distributed_env():

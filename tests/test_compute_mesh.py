@@ -124,14 +124,39 @@ class TestMultislice:
         assert mesh.devices.size == len(jax.devices())
 
     def test_two_fake_slices_put_data_across_dcn(self):
-        from kubeflow_tpu.compute.mesh import device_slice_groups
+        from kubeflow_tpu.compute.mesh import (device_slice_groups,
+                                               multislice_layout)
         devs = [self.FakeDev(i, i // 4) for i in range(8)]
         groups = device_slice_groups(devs)
-        # inner axes consume a slice exactly → data dim == n_slices and
-        # the mesh device order keeps each slice contiguous (ICI-inner)
-        ordered = [d for g in groups for d in g]
+        # inner axes consume a slice exactly → data == n_slices; order
+        # keeps each slice contiguous (ICI-inner) and id-sorted even
+        # when the caller passed devices shuffled
+        ordered, spec = multislice_layout(groups, fsdp=2, tensor=2)
+        sizes = spec.resolved(len(ordered))
+        assert sizes == {"data": 2, "fsdp": 2, "expert": 1,
+                         "sequence": 1, "tensor": 2}
         assert [d.slice_index for d in ordered[:4]] == [0] * 4
         assert [d.slice_index for d in ordered[4:]] == [1] * 4
+        assert [d.id for d in ordered] == list(range(8))
+        # partial-slice data: inner smaller than a slice
+        ordered, spec = multislice_layout(groups, tensor=2)
+        assert spec.resolved(8)["data"] == 4
+
+    def test_within_slice_order_canonicalized_by_id(self):
+        from kubeflow_tpu.compute.mesh import device_slice_groups
+        devs = [self.FakeDev(i, i // 4) for i in range(8)]
+        groups = device_slice_groups(devs[::-1])   # shuffled input
+        assert [d.id for g in groups for d in g] == list(range(8))
+
+    def test_inner_axes_reject_wildcards_and_zero(self):
+        import pytest
+
+        from kubeflow_tpu.compute.mesh import multislice_layout
+        devs = [[self.FakeDev(i, 0) for i in range(8)]]
+        with pytest.raises(ValueError):
+            multislice_layout(devs, tensor=-1)
+        with pytest.raises(ValueError):
+            multislice_layout(devs, fsdp=0)
 
     def test_inner_axes_must_fit_in_slice(self):
         import pytest
