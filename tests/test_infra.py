@@ -199,3 +199,39 @@ class TestWebhookCertHotReload:
             assert served_cn() == b"beta", "new handshakes serve rotated cert"
         finally:
             server.stop()
+
+
+class TestArgoWorkflowBuilders:
+    """ci/workflows.py (reference ArgoTestBuilder,
+    workflow_utils.py:30): every component generates a valid Workflow
+    with a checkout→test→build DAG."""
+
+    def test_every_component_generates_valid_dag(self):
+        import ci.workflows as w
+        for component in sorted(w.COMPONENTS):
+            wf = w.build_workflow(component)
+            assert wf["kind"] == "Workflow"
+            spec = wf["spec"]
+            names = {t["name"] for t in spec["templates"]}
+            assert {"checkout", "build-image", "e2e"} <= names
+            dag = next(t for t in spec["templates"]
+                       if t["name"] == "e2e")["dag"]["tasks"]
+            by_name = {t["name"]: t for t in dag}
+            # build depends (transitively) on checkout
+            deps = by_name["build-image"].get("dependencies", [])
+            assert deps and all(d in by_name for d in deps)
+            # template references resolve
+            for t in dag:
+                assert t["template"] in names
+
+    def test_no_push_flag(self):
+        import ci.workflows as w
+        comp = sorted(w.COMPONENTS)[0]
+        wf = w.build_workflow(comp, no_push=False)
+        args = next(t for t in wf["spec"]["templates"]
+                    if t["name"] == "build-image")["container"]["args"]
+        assert "--no-push" not in args
+        wf = w.build_workflow(comp, no_push=True)
+        args = next(t for t in wf["spec"]["templates"]
+                    if t["name"] == "build-image")["container"]["args"]
+        assert "--no-push" in args
