@@ -49,6 +49,22 @@ async function indexView(el) {
 
 async function formView(el) {
   const ns = currentNamespace();
+  let classes = null;
+  try {
+    classes = (await api("GET", "api/storageclasses")).storageClasses
+      || [];
+  } catch (e) {
+    classes = null;   // listing restricted: fall back to free text
+  }
+  const scField = classes
+    ? new Field({ id: "storageClass", label: "Storage class",
+        value: "",
+        options: [{ value: "", label: "(cluster default)" },
+                  ...classes],
+        checks: [validators.optional] })
+    : new Field({ id: "storageClass",
+        label: "Storage class (blank = default)", value: "",
+        checks: [validators.optional] });
   const fields = new FieldGroup([
     new Field({ id: "name", label: "Name",
       checks: [validators.required, validators.dns1123] }),
@@ -56,8 +72,7 @@ async function formView(el) {
       checks: [validators.quantity] }),
     new Field({ id: "mode", label: "Access mode",
       options: ["ReadWriteOnce", "ReadWriteMany", "ReadOnlyMany"] }),
-    new Field({ id: "storageClass", label: "Storage class (blank = default)",
-      value: "", checks: [validators.optional] }),
+    scField,
   ]);
   const submit = async () => {
     if (!fields.validate()) return;

@@ -308,8 +308,13 @@ class TestReconcileIntegration:
                      if e.get("source", {}).get("component") ==
                      "notebook-controller"
                      and e.get("involvedObject", {}).get("kind") == "Notebook"]
-        assert len(reemitted) == 1
-        assert "Reissued from pod/nb1-0" in reemitted[0]["message"]
+        # the fake kubelet's lifecycle events (Scheduled/Pulled/Started)
+        # re-emit too; the warning we injected must be among them
+        backoff = [e for e in reemitted if e.get("reason") == "BackOff"]
+        assert len(backoff) == 1
+        assert "Reissued from pod/nb1-0" in backoff[0]["message"]
+        assert {e.get("reason") for e in reemitted} >= {
+            "BackOff", "Scheduled", "Started"}
 
     def test_metrics_counted(self, store, nb_manager, clean_env):
         store.create(make_notebook("nb1"))
