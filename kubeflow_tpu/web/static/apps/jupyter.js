@@ -9,7 +9,7 @@
  * PodDefault configurations, tolerations/affinity groups, shm. */
 
 import {
-  api, clear, currentNamespace, eventsTable, Field, FieldGroup, h,
+  age, api, clear, currentNamespace, eventsTable, Field, FieldGroup, h,
   indexPage, LogsViewer, Router, RowList, snack, statusIcon, tabPanel,
   validators,
 } from "../lib/components.js";
@@ -42,7 +42,7 @@ async function indexView(el) {
           render: (r) => Object.entries(r.accelerators || {})
             .map(([k, v]) => `${v}× ${k.split("/")[0]}`)
             .join(", ") || "—" },
-        { key: "age", label: "Created" },
+        { key: "age", label: "Created", render: (r) => age(r.age) },
       ],
       actions: [
         { id: "connect", label: "connect", cls: "primary",

@@ -3,7 +3,7 @@
  * web/tensorboards.py). */
 
 import {
-  api, currentNamespace, Field, FieldGroup, h, indexPage, Router, snack,
+  age, api, currentNamespace, Field, FieldGroup, h, indexPage, Router, snack,
   statusIcon, validators,
 } from "../lib/components.js";
 
@@ -24,7 +24,7 @@ async function indexView(el) {
           render: (r) => statusIcon(r.status) },
         { key: "name", label: "Name" },
         { key: "logspath", label: "Logs path" },
-        { key: "age", label: "Created" },
+        { key: "age", label: "Created", render: (r) => age(r.age) },
       ],
       actions: [
         { id: "connect", label: "connect", cls: "primary",

@@ -10,6 +10,20 @@ import {
   Router, setNamespace, snack,
 } from "./core.js";
 
+/* --------------------------------------------------------------- age */
+
+export function age(timestamp) {
+  /* "3m ago"-style relative time for creationTimestamps */
+  if (!timestamp) return "";
+  const t = Date.parse(timestamp);
+  if (Number.isNaN(t)) return String(timestamp);
+  let s = Math.max(0, (Date.now() - t) / 1000);
+  for (const [unit, span] of [["d", 86400], ["h", 3600], ["m", 60]]) {
+    if (s >= span) return `${Math.floor(s / span)}${unit} ago`;
+  }
+  return `${Math.floor(s)}s ago`;
+}
+
 /* ------------------------------------------------------ status icons */
 
 const STATUS_ICONS = {
