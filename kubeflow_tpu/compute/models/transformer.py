@@ -41,6 +41,16 @@ class Config:
     attention: str = "flash"     # dense | flash | ring
     remat: bool = True
     scan_layers: bool = True
+    # chunked cross-entropy (ops/cross_entropy.py): skip materializing
+    # fp32 [B,S,V] logits in the loss; ce_chunk must divide vocab_size
+    chunked_ce: bool = False
+    ce_chunk: int = 2048
+
+    def __post_init__(self):
+        if self.chunked_ce and self.vocab_size % self.ce_chunk:
+            raise ValueError(
+                f"ce_chunk={self.ce_chunk} must divide "
+                f"vocab_size={self.vocab_size}")
 
     @property
     def kv_heads(self):
@@ -192,8 +202,8 @@ def _layer(lp, x, rope, config):
     return sharding.constrain(x + down, ("batch", "seq", "act_embed"))
 
 
-def apply(params, tokens, config):
-    """tokens [B, S] int32 → logits [B, S, vocab] fp32."""
+def backbone(params, tokens, config):
+    """tokens [B, S] int32 → final-norm hidden states [B, S, D]."""
     dt = config.compute_dtype
     x = sharding.embed_lookup(params["embed"].astype(dt), tokens)
     positions = jnp.arange(tokens.shape[1])
@@ -209,28 +219,44 @@ def apply(params, tokens, config):
         for lp in params["layers"]:
             x = layer(lp, x)
 
-    x = _rmsnorm(x, params["final_norm"].astype(dt))
-    logits = jnp.einsum("bsd,dv->bsv", x, params["head"].astype(dt),
+    return _rmsnorm(x, params["final_norm"].astype(dt))
+
+
+def apply(params, tokens, config):
+    """tokens [B, S] int32 → logits [B, S, vocab] fp32."""
+    x = backbone(params, tokens, config)
+    logits = jnp.einsum("bsd,dv->bsv", x,
+                        params["head"].astype(config.compute_dtype),
                         preferred_element_type=jnp.float32)
     return sharding.constrain(logits, ("batch", "seq", None))
 
 
 def loss_fn(params, batch, config):
     """batch: {tokens [B,S], targets [B,S], mask [B,S] optional}.
-    Cross entropy in fp32 with z-loss 1e-4 for logit drift control."""
-    logits = apply(params, batch["tokens"], config)
+    Cross entropy in fp32 with z-loss 1e-4 for logit drift control.
+    With ``config.chunked_ce`` the fp32 [B,S,V] logits are never
+    materialized (ops/cross_entropy.py)."""
     targets = batch["targets"]
     mask = batch.get("mask")
     if mask is None:
         mask = jnp.ones(targets.shape, jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    label_logits = jnp.take_along_axis(
-        logits, targets[..., None], axis=-1)[..., 0]
-    nll = logz - label_logits
+    if config.chunked_ce:
+        from ..ops.cross_entropy import chunked_softmax_xent
+        x = backbone(params, batch["tokens"], config)
+        nll, logz, pred = chunked_softmax_xent(
+            x, params["head"].astype(config.compute_dtype), targets,
+            config.ce_chunk)
+    else:
+        logits = apply(params, batch["tokens"], config)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        label_logits = jnp.take_along_axis(
+            logits, targets[..., None], axis=-1)[..., 0]
+        nll = logz - label_logits
+        pred = logits.argmax(-1)
     z_loss = 1e-4 * jnp.square(logz)
     denom = jnp.maximum(mask.sum(), 1.0)
     loss = ((nll + z_loss) * mask).sum() / denom
-    acc = ((logits.argmax(-1) == targets) * mask).sum() / denom
+    acc = ((pred == targets) * mask).sum() / denom
     return loss, {"loss": loss, "accuracy": acc,
                   "perplexity": jnp.exp((nll * mask).sum() / denom)}
 

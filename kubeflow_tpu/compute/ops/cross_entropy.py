@@ -1,0 +1,139 @@
+"""Chunked softmax cross-entropy — the LM loss without the logits wall.
+
+The standard LM loss materializes fp32 logits ``[B,S,V]`` (for a 32k
+vocab at batch 8×1024 that's a ~1 GB tensor written + re-read in both
+passes, plus the logsumexp traffic) before reducing to a per-token
+scalar. This op never forms the full logits: a ``lax.scan`` over vocab
+chunks computes a running (max, sumexp, label-logit) in fp32, and a
+hand-written VJP recomputes each chunk's logits on the fly in the
+backward to produce dx/dhead — trading a second chunk matmul for O(B·S)
+residuals instead of O(B·S·V). The same recompute-over-materialize
+trade the flash-attention kernels make for the S² score matrix
+(public "chunked/fused cross-entropy" recipe; no reference counterpart
+— the reference has no model code at all, SURVEY.md §2).
+
+Numerics: matmuls accumulate fp32 (``preferred_element_type``),
+reductions are fp32 throughout; matches the dense path to ~1e-5.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _chunks(head, chunk):
+    d, v = head.shape
+    if v % chunk:
+        raise ValueError(
+            f"ce_chunk={chunk} must divide vocab_size={v} "
+            f"(pick a divisor, e.g. {v // (v // chunk or 1)})")
+    return head.reshape(d, v // chunk, chunk).transpose(1, 0, 2)
+
+
+def _fwd_scan(x, head, targets, chunk):
+    """→ (logz [N], label_logit [N], argmax [N]) over flat tokens."""
+    n = x.shape[0]
+    hchunks = _chunks(head, chunk)                    # [C, D, chunk]
+
+    def body(carry, inputs):
+        m, l, label, best, best_idx = carry
+        hc, base = inputs
+        s = jax.lax.dot_general(
+            x, hc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [N, chunk]
+        s_max = s.max(axis=1)
+        m_new = jnp.maximum(m, s_max)
+        l = l * jnp.exp(m - m_new) + jnp.exp(
+            s - m_new[:, None]).sum(axis=1)
+        # label logit if the target falls in this chunk
+        local = targets - base
+        in_chunk = (local >= 0) & (local < chunk)
+        picked = jnp.take_along_axis(
+            s, jnp.clip(local, 0, chunk - 1)[:, None], axis=1)[:, 0]
+        label = jnp.where(in_chunk, picked, label)
+        # running argmax (for the accuracy metric)
+        better = s_max > best
+        best_idx = jnp.where(better, base + s.argmax(axis=1), best_idx)
+        best = jnp.maximum(best, s_max)
+        return (m_new, l, label, best, best_idx), None
+
+    bases = jnp.arange(hchunks.shape[0]) * chunk
+    init = (jnp.full((n,), NEG_INF, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.full((n,), NEG_INF, jnp.float32),
+            jnp.full((n,), NEG_INF, jnp.float32),
+            jnp.zeros((n,), jnp.int32))
+    (m, l, label, _, best_idx), _ = lax.scan(body, init,
+                                             (hchunks, bases))
+    return m + jnp.log(l), label, best_idx
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_softmax_xent(x, head, targets, chunk=2048):
+    """x [..., D] (bf16 ok), head [D, V], targets [...] int32.
+
+    Returns ``(nll, logz, pred)`` per token (fp32, fp32, int32 argmax
+    for the accuracy metric) — callers build loss + z-loss from these
+    exactly as with dense logits. V must divide by ``chunk``.
+    """
+    return _xent_fwd(x, head, targets, chunk)[0]
+
+
+def _xent_fwd(x, head, targets, chunk):
+    shape = targets.shape
+    xf = x.reshape(-1, x.shape[-1])
+    tf_ = targets.reshape(-1)
+    logz, label, pred = _fwd_scan(xf, head, tf_, chunk)
+    nll = logz - label
+    return ((nll.reshape(shape), logz.reshape(shape),
+             pred.reshape(shape)),
+            (x, head, targets, logz))
+
+
+def _xent_bwd(chunk, res, grads):
+    x, head, targets, logz = res
+    g_nll, g_logz, _g_pred = grads                  # pred is integer
+    shape = x.shape
+    xf = x.reshape(-1, shape[-1])
+    tf_ = targets.reshape(-1)
+    gn = g_nll.reshape(-1).astype(jnp.float32)
+    gz = g_logz.reshape(-1).astype(jnp.float32)
+    gtot = gn + gz                                   # d/ds of logz term
+    hchunks = _chunks(head, chunk)
+
+    def body(carry, inputs):
+        dx_acc, = carry
+        hc, base = inputs
+        s = jax.lax.dot_general(
+            xf, hc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        p = jnp.exp(s - logz[:, None])               # softmax chunk
+        local = tf_ - base
+        in_chunk = (local >= 0) & (local < chunk)
+        # onehot_scale is already zero outside the chunk — the single
+        # load-bearing guard
+        onehot_scale = jnp.where(in_chunk, gn, 0.0)
+        ds = p * gtot[:, None]
+        ds = ds - onehot_scale[:, None] * jax.nn.one_hot(
+            jnp.clip(local, 0, chunk - 1), chunk, dtype=jnp.float32)
+        dx_acc = dx_acc + jax.lax.dot_general(
+            ds, hc, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dhc = jax.lax.dot_general(
+            xf, ds, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [D, chunk]
+        return (dx_acc,), dhc
+
+    bases = jnp.arange(hchunks.shape[0]) * chunk
+    (dx,), dhcs = lax.scan(
+        body, (jnp.zeros(xf.shape, jnp.float32),), (hchunks, bases))
+    dhead = dhcs.transpose(1, 0, 2).reshape(head.shape)
+    return (dx.reshape(shape).astype(x.dtype),
+            dhead.astype(head.dtype), None)
+
+
+chunked_softmax_xent.defvjp(_xent_fwd, _xent_bwd)

@@ -1,0 +1,89 @@
+"""Chunked cross-entropy vs the dense logits path: values, grads,
+argmax, and loss_fn integration (ops/cross_entropy.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.compute.models import transformer
+from kubeflow_tpu.compute.ops.cross_entropy import chunked_softmax_xent
+
+
+def _dense(x, head, targets):
+    logits = (x.astype(jnp.float32)
+              @ head.astype(jnp.float32))
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label = jnp.take_along_axis(logits, targets[..., None],
+                                axis=-1)[..., 0]
+    return logz - label, logz, logits.argmax(-1)
+
+
+@pytest.fixture()
+def problem():
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (6, 32), jnp.float32)
+    head = jax.random.normal(jax.random.fold_in(key, 1), (32, 40),
+                             jnp.float32) * 0.3
+    targets = jax.random.randint(jax.random.fold_in(key, 2), (6,), 0, 40)
+    return x, head, targets
+
+
+def test_matches_dense_forward(problem):
+    x, head, targets = problem
+    nll, logz, pred = chunked_softmax_xent(x, head, targets, 8)
+    dn, dz, dp = _dense(x, head, targets)
+    np.testing.assert_allclose(nll, dn, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(logz, dz, rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(pred, dp)
+
+
+def test_matches_dense_gradients(problem):
+    x, head, targets = problem
+
+    def loss_chunked(x, head):
+        nll, logz, _ = chunked_softmax_xent(x, head, targets, 8)
+        return (nll + 1e-4 * logz ** 2).mean()
+
+    def loss_dense(x, head):
+        nll, logz, _ = _dense(x, head, targets)
+        return (nll + 1e-4 * logz ** 2).mean()
+
+    gc = jax.grad(loss_chunked, argnums=(0, 1))(x, head)
+    gd = jax.grad(loss_dense, argnums=(0, 1))(x, head)
+    for a, b in zip(gc, gd):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_batched_shape_and_bf16(problem):
+    x, head, targets = problem
+    xb = jnp.stack([x, x + 0.1]).astype(jnp.bfloat16)    # [2, 6, 32]
+    tb = jnp.stack([targets, (targets + 3) % 40])
+    nll, logz, pred = chunked_softmax_xent(xb, head.astype(jnp.bfloat16),
+                                           tb, 8)
+    assert nll.shape == (2, 6) and pred.shape == (2, 6)
+    dn, _, _ = _dense(xb[0].astype(jnp.float32), head, tb[0])
+    np.testing.assert_allclose(nll[0], dn, rtol=2e-2, atol=2e-2)
+
+
+def test_loss_fn_chunked_matches_dense():
+    cfg_d = transformer.Config(vocab_size=64, d_model=32, n_layers=2,
+                               n_heads=4, max_seq=16, dtype="float32",
+                               attention="dense", remat=False)
+    cfg_c = transformer.Config(vocab_size=64, d_model=32, n_layers=2,
+                               n_heads=4, max_seq=16, dtype="float32",
+                               attention="dense", remat=False,
+                               chunked_ce=True, ce_chunk=16)
+    params = transformer.init_params(cfg_d, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    batch = {"tokens": toks, "targets": jnp.roll(toks, -1, 1)}
+    ld, md = transformer.loss_fn(params, batch, cfg_d)
+    lc, mc = transformer.loss_fn(params, batch, cfg_c)
+    np.testing.assert_allclose(ld, lc, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(md["accuracy"], mc["accuracy"])
+    gd = jax.grad(lambda p: transformer.loss_fn(p, batch, cfg_d)[0])(
+        params)
+    gc = jax.grad(lambda p: transformer.loss_fn(p, batch, cfg_c)[0])(
+        params)
+    for a, b in zip(jax.tree.leaves(gd), jax.tree.leaves(gc)):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
