@@ -59,13 +59,11 @@ def main(argv=None):
     if forced:
         jax.config.update("jax_platforms", forced)
 
-    import jax.numpy as jnp
     import numpy as np
-    from jax.sharding import NamedSharding
 
     from . import checkpoint as ckpt_lib
+    from . import data as data_lib
     from . import mesh as mesh_lib
-    from . import sharding as sharding_lib
     from . import train
     from .models import transformer
 
@@ -94,13 +92,10 @@ def main(argv=None):
     step_fn = train.make_train_step(
         train.plain_loss(transformer.loss_fn, cfg), opt, mesh)
 
-    batch_sharding = NamedSharding(
-        mesh, sharding_lib.spec_for(("batch", "seq")))
-
     def global_batch(step):
         """Deterministic per-step batch, assembled from process-local
-        shards (the data-pipeline contract: every process feeds only
-        its own chips)."""
+        shards via the data pipeline (every process feeds only its own
+        chips — data_lib.shard_batch handles single- vs multi-host)."""
         rng = np.random.default_rng(1000 + step)
         n_proc = jax.process_count()
         full = rng.integers(
@@ -108,11 +103,9 @@ def main(argv=None):
             (args.batch_per_process * n_proc, args.seq), dtype=np.int32)
         local = full[pid * args.batch_per_process:
                      (pid + 1) * args.batch_per_process]
-        toks = jax.make_array_from_process_local_data(
-            batch_sharding, local)
-        tgt = jax.make_array_from_process_local_data(
-            batch_sharding, np.roll(local, -1, axis=1))
-        return {"tokens": toks, "targets": tgt}
+        return data_lib.shard_batch(
+            {"tokens": local, "targets": np.roll(local, -1, axis=1)},
+            mesh)
 
     fault_at = int(os.environ.get("SLICE_WORKER_FAULT_AT_STEP", "-1"))
     log_f = open(args.log, "a") if args.log else None
