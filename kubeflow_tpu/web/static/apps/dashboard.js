@@ -4,15 +4,17 @@
  * iframe-container; backend routes web/dashboard.py). */
 
 import {
-  api, clear, confirmDialog, h, Poller, snack,
+  api, clear, confirmDialog, h, Poller, Router, snack,
 } from "../lib/components.js";
 
 const outlet = document.getElementById("app");
 
 const APPS = [
-  { label: "Notebooks", href: "/jupyter/", desc: "spawn TPU notebooks" },
-  { label: "Volumes", href: "/volumes/", desc: "manage PVCs" },
-  { label: "Tensorboards", href: "/tensorboards/",
+  { id: "jupyter", label: "Notebooks", href: "/jupyter/",
+    desc: "spawn TPU notebooks" },
+  { id: "volumes", label: "Volumes", href: "/volumes/",
+    desc: "manage PVCs" },
+  { id: "tensorboards", label: "Tensorboards", href: "/tensorboards/",
     desc: "profiles + training curves" },
 ];
 
@@ -125,10 +127,35 @@ function contributorsPanel(info) {
 }
 
 function launcher() {
+  /* in-dashboard navigation: apps open in the iframe container
+   * (reference iframe-container); the ↗ link opens them standalone */
   return h("div.kf-section", {},
     h("h2", {}, "Applications"),
-    h("div.kf-quick", {}, APPS.map((a) =>
-      h("a", { href: a.href }, `${a.label} — ${a.desc}`))));
+    h("div.kf-quick", {}, APPS.map((a) => h("div", {},
+      h("a", { href: `#/app/${a.id}` }, `${a.label} — ${a.desc}`),
+      " ",
+      h("a", { href: a.href, target: "_blank", title: "open standalone" },
+        "↗")))));
+}
+
+function iframeView(el, params) {
+  /* reference centraldashboard iframe-container: the web apps render
+   * inside the dashboard shell; behind the mesh all apps share this
+   * origin under their path prefixes */
+  const app = APPS.find((a) => a.id === params.app);
+  if (!app) {
+    el.append(h("p", {}, `unknown app ${params.app}`));
+    return;
+  }
+  el.append(
+    h("div.kf-toolbar", {},
+      h("button.ghost", { onclick: () => { location.hash = "#/"; } },
+        "← dashboard"),
+      h("h2", {}, app.label)),
+    h("iframe.kf-app-frame", {
+      src: app.href,
+      title: app.label,
+    }));
 }
 
 async function activityFeed(el, info) {
@@ -171,24 +198,30 @@ async function metricsPanel(el, info) {
   }
 }
 
-(async () => {
+async function landingView(el) {
   let info;
   try {
     info = await api("GET", "api/env-info");
   } catch (e) {
-    outlet.append(h("p", {}, `cannot load env-info: ${e.message}`));
+    el.append(h("p", {}, `cannot load env-info: ${e.message}`));
     return;
   }
-  outlet.append(h("div.kf-toolbar", {},
+  el.append(h("div.kf-toolbar", {},
     h("h2", {}, "Kubeflow TPU"),
     h("span.kf-spacer"),
     h("span", { id: "user" }, info.user || "")));
-  if (await onboarding(outlet, info)) return;
+  if (await onboarding(el, info)) return;
   const grid = h("div.kf-grid");
-  outlet.append(grid);
+  el.append(grid);
   grid.append(launcher(), nsTable(info));
   const contributors = contributorsPanel(info);
-  if (contributors) outlet.append(contributors);
-  await activityFeed(outlet, info);
-  await metricsPanel(outlet, info);
-})();
+  if (contributors) el.append(contributors);
+  await activityFeed(el, info);
+  await metricsPanel(el, info);
+}
+
+const router = new Router(outlet, [
+  ["/", landingView],
+  ["/app/:app", iframeView],
+]);
+router.render();
