@@ -267,12 +267,18 @@ def bench_study(steps, batch):
     (this host has one chip; trials/hr scales linearly per chip)."""
     from kubeflow_tpu.compute import trial as trial_lib
 
+    import contextlib
+    import io
+
     n_trials = max(4, min(steps, 8))
     t0 = time.perf_counter()
     for i in range(n_trials):
         os.environ["TRIAL_PARAMETERS"] = json.dumps(
             {"lr": 10 ** (-2 - i % 3), "hidden": 64 * (1 + i % 2)})
-        trial_lib.run_mnist_trial(steps=30)
+        # trials print their metric lines for the metrics-collector
+        # contract; keep bench stdout pure JSON result lines
+        with contextlib.redirect_stdout(io.StringIO()):
+            trial_lib.run_mnist_trial(steps=30)
     dt = time.perf_counter() - t0
     os.environ.pop("TRIAL_PARAMETERS", None)
     per_hr = n_trials / dt * 3600
