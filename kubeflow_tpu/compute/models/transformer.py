@@ -45,6 +45,11 @@ class Config:
     # fp32 [B,S,V] logits in the loss; ce_chunk must divide vocab_size
     chunked_ce: bool = False
     ce_chunk: int = 2048
+    # mixture-of-experts MLP (Switch-style top-1, capacity-based dense
+    # dispatch — SPMD-friendly einsums, expert weights sharded over the
+    # ``expert`` mesh axis). 0 = dense MLP.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     def __post_init__(self):
         if self.chunked_ce and self.vocab_size % self.ce_chunk:
@@ -76,17 +81,29 @@ class Config:
 def _layer_shapes(c):
     h, kv, d, f = c.n_heads, c.kv_heads, c.d_model, c.ff_dim
     hd = c.head_dim
-    return {
+    shapes = {
         "attn_norm": ((d,), (None,)),
         "wq": ((d, h, hd), ("embed", "heads", None)),
         "wk": ((d, kv, hd), ("embed", "heads", None)),
         "wv": ((d, kv, hd), ("embed", "heads", None)),
         "wo": ((h, hd, d), ("heads", None, "embed")),
         "mlp_norm": ((d,), (None,)),
-        "w_gate": ((d, f), ("embed", "mlp")),
-        "w_up": ((d, f), ("embed", "mlp")),
-        "w_down": ((f, d), ("mlp", "embed")),
     }
+    if c.moe_experts:
+        e = c.moe_experts
+        shapes.update({
+            "router": ((d, e), ("embed", None)),
+            "we_gate": ((e, d, f), ("expert", "embed", "mlp")),
+            "we_up": ((e, d, f), ("expert", "embed", "mlp")),
+            "we_down": ((e, f, d), ("expert", "mlp", "embed")),
+        })
+    else:
+        shapes.update({
+            "w_gate": ((d, f), ("embed", "mlp")),
+            "w_up": ((d, f), ("embed", "mlp")),
+            "w_down": ((f, d), ("mlp", "embed")),
+        })
+    return shapes
 
 
 def _shapes(c):
@@ -130,7 +147,10 @@ def init_params(config, key):
     def layer_params(key):
         out = {}
         for i, (name, (shape, _)) in enumerate(_layer_shapes(config).items()):
-            out[name] = init_one(jax.random.fold_in(key, i), shape, shape[0])
+            # expert weights [E, in, out]: fan-in is the middle dim
+            fan_in = shape[1] if name.startswith("we_") else shape[0]
+            out[name] = init_one(jax.random.fold_in(key, i), shape,
+                                 fan_in)
         return out
 
     if config.scan_layers:
@@ -179,6 +199,59 @@ def _attention(q, k, v, config):
     return attn_lib.dense_attention(q, k, v, causal=True)
 
 
+def _switch_moe(h, lp, config):
+    """Switch-transformer top-1 MoE with capacity-based dense dispatch.
+
+    SPMD shape discipline: routing is per sequence-group (each batch
+    row is a group), the dispatch/combine tensors are one-hot einsums
+    (no ragged ops, XLA-shardable), and expert weights carry the
+    ``expert`` logical axis so an ``expert``-sized mesh axis gives true
+    expert parallelism (all-to-all inserted by XLA at the dispatch
+    einsums). Tokens over capacity are dropped (standard Switch
+    behavior); aux load-balancing loss per the Switch paper.
+
+    Returns (out [b,s,d], aux_loss scalar fp32).
+    """
+    dt = config.compute_dtype
+    b, s, d = h.shape
+    e = config.moe_experts
+    capacity = max(1, int(s / e * config.moe_capacity_factor))
+
+    # router in fp32 (Switch-paper selective precision: bf16-quantized
+    # logits destabilize near-tied argmax assignments)
+    router_logits = jnp.einsum(
+        "bsd,de->bse", h.astype(jnp.float32),
+        lp["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    gate, expert_idx = probs.max(axis=-1), probs.argmax(axis=-1)
+
+    # position of each token within its expert's capacity buffer
+    assign = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [b,s,e]
+    pos = jnp.cumsum(assign, axis=1) * assign - 1.0            # [b,s,e]
+    within = (pos >= 0) & (pos < capacity)
+    dispatch = jax.nn.one_hot(
+        jnp.clip(pos, 0, capacity - 1).astype(jnp.int32), capacity,
+        dtype=dt) * within.astype(dt)[..., None]               # [b,s,e,c]
+
+    # route → expert MLPs → combine (expert dim sharded over the mesh)
+    xin = jnp.einsum("bsec,bsd->ebcd", dispatch, h)
+    xin = sharding.constrain(xin, ("expert", "batch", None, "act_embed"))
+    gate_h = jnp.einsum("ebcd,edf->ebcf", xin, lp["we_gate"].astype(dt))
+    up = jnp.einsum("ebcd,edf->ebcf", xin, lp["we_up"].astype(dt))
+    out_e = jnp.einsum("ebcf,efd->ebcd", jax.nn.silu(gate_h) * up,
+                       lp["we_down"].astype(dt))
+    out_e = sharding.constrain(out_e,
+                               ("expert", "batch", None, "act_embed"))
+    combine = dispatch * gate.astype(dt)[..., None, None]
+    out = jnp.einsum("bsec,ebcd->bsd", combine, out_e)
+
+    # Switch aux loss: fraction-of-tokens · mean-router-prob per expert
+    frac_tokens = assign.mean(axis=(0, 1))
+    frac_probs = probs.mean(axis=(0, 1))
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return out, aux
+
+
 def _layer(lp, x, rope, config):
     cos, sin = rope
     dt = config.compute_dtype
@@ -195,15 +268,21 @@ def _layer(lp, x, rope, config):
     x = sharding.constrain(x + o, ("batch", "seq", "act_embed"))
 
     h = _rmsnorm(x, lp["mlp_norm"].astype(dt))
-    gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(dt))
-    up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(dt))
-    down = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
-                      lp["w_down"].astype(dt))
-    return sharding.constrain(x + down, ("batch", "seq", "act_embed"))
+    if config.moe_experts:
+        down, aux = _switch_moe(h, lp, config)
+    else:
+        gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(dt))
+        up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(dt))
+        down = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                          lp["w_down"].astype(dt))
+        aux = jnp.zeros((), jnp.float32)
+    return (sharding.constrain(x + down, ("batch", "seq", "act_embed")),
+            aux)
 
 
 def backbone(params, tokens, config):
-    """tokens [B, S] int32 → final-norm hidden states [B, S, D]."""
+    """tokens [B, S] int32 → (final-norm hidden states [B, S, D],
+    MoE aux load-balancing loss — 0.0 for dense MLPs)."""
     dt = config.compute_dtype
     x = sharding.embed_lookup(params["embed"].astype(dt), tokens)
     positions = jnp.arange(tokens.shape[1])
@@ -213,22 +292,29 @@ def backbone(params, tokens, config):
     if config.remat:
         layer = jax.checkpoint(layer)
     if config.scan_layers:
-        x, _ = lax.scan(lambda c, lp: (layer(lp, c), None),
-                        x, params["layers"])
+        x, auxs = lax.scan(lambda c, lp: layer(lp, c),
+                           x, params["layers"])
+        aux = auxs.mean()
     else:
+        aux = jnp.zeros((), jnp.float32)
         for lp in params["layers"]:
-            x = layer(lp, x)
+            x, a = layer(lp, x)
+            aux = aux + a / config.n_layers
 
-    return _rmsnorm(x, params["final_norm"].astype(dt))
+    return _rmsnorm(x, params["final_norm"].astype(dt)), aux
+
+
+def _logits(x, head):
+    logits = jnp.einsum("bsd,dv->bsv", x, head,
+                        preferred_element_type=jnp.float32)
+    return sharding.constrain(logits, ("batch", "seq", None))
 
 
 def apply(params, tokens, config):
-    """tokens [B, S] int32 → logits [B, S, vocab] fp32."""
-    x = backbone(params, tokens, config)
-    logits = jnp.einsum("bsd,dv->bsv", x,
-                        params["head"].astype(config.compute_dtype),
-                        preferred_element_type=jnp.float32)
-    return sharding.constrain(logits, ("batch", "seq", None))
+    """tokens [B, S] int32 → logits [B, S, vocab] fp32 (inference
+    surface: the MoE aux loss is dropped here; loss_fn carries it)."""
+    x, _ = backbone(params, tokens, config)
+    return _logits(x, params["head"].astype(config.compute_dtype))
 
 
 def loss_fn(params, batch, config):
@@ -240,14 +326,14 @@ def loss_fn(params, batch, config):
     mask = batch.get("mask")
     if mask is None:
         mask = jnp.ones(targets.shape, jnp.float32)
+    x, aux = backbone(params, batch["tokens"], config)
+    head = params["head"].astype(config.compute_dtype)
     if config.chunked_ce:
         from ..ops.cross_entropy import chunked_softmax_xent
-        x = backbone(params, batch["tokens"], config)
         nll, logz, pred = chunked_softmax_xent(
-            x, params["head"].astype(config.compute_dtype), targets,
-            config.ce_chunk)
+            x, head, targets, config.ce_chunk)
     else:
-        logits = apply(params, batch["tokens"], config)
+        logits = _logits(x, head)
         logz = jax.nn.logsumexp(logits, axis=-1)
         label_logits = jnp.take_along_axis(
             logits, targets[..., None], axis=-1)[..., 0]
@@ -256,9 +342,14 @@ def loss_fn(params, batch, config):
     z_loss = 1e-4 * jnp.square(logz)
     denom = jnp.maximum(mask.sum(), 1.0)
     loss = ((nll + z_loss) * mask).sum() / denom
+    if config.moe_experts:
+        loss = loss + 0.01 * aux     # Switch aux load-balancing loss
     acc = ((pred == targets) * mask).sum() / denom
-    return loss, {"loss": loss, "accuracy": acc,
-                  "perplexity": jnp.exp((nll * mask).sum() / denom)}
+    metrics = {"loss": loss, "accuracy": acc,
+               "perplexity": jnp.exp((nll * mask).sum() / denom)}
+    if config.moe_experts:
+        metrics["moe_aux"] = aux
+    return loss, metrics
 
 
 def flops_per_token(config):

@@ -246,3 +246,35 @@ class TestDynamicBatching:
                 model.predict_timed(np.zeros((1, 2), np.float32))
         finally:
             model.close()
+
+
+class TestProfiler:
+    """compute/profiler.py: traces land where the Tensorboard CR path
+    serves them (<logs>/plugins/profile — SURVEY §5 tracing story)."""
+
+    def test_trace_writes_tensorboard_profile_layout(self, tmp_path):
+        import glob
+        import os
+
+        import jax
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.compute import profiler
+
+        with profiler.trace(str(tmp_path)):
+            jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))
+                    ).block_until_ready()
+        found = glob.glob(os.path.join(
+            str(tmp_path), "plugins", "profile", "*", "*"))
+        assert found, "no profile artifacts written"
+
+    def test_step_timer_ema_and_throughput(self):
+        from kubeflow_tpu.compute import profiler
+
+        t = profiler.StepTimer(ema=0.5)
+        t.tick()
+        import time as _t
+        _t.sleep(0.01)
+        dt = t.tick()
+        assert dt > 0
+        assert t.throughput(128) > 0
