@@ -24,8 +24,8 @@ import urllib.parse
 import urllib.request
 
 from . import meta as m
-from .errors import (AlreadyExistsError, ConflictError, InvalidError,
-                     NotFoundError)
+from .errors import (AdmissionDeniedError, AlreadyExistsError,
+                     ConflictError, InvalidError, NotFoundError)
 from .store import WatchEvent
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -110,18 +110,27 @@ class KubeStore:
                                           timeout=timeout)
         except urllib.error.HTTPError as e:
             payload = e.read().decode(errors="replace")
+            # prefer the Status object's human message/reason over the
+            # raw JSON blob (webhook denials put their reason there)
+            try:
+                status = json.loads(payload)
+                message = status.get("message") or payload
+                reason = status.get("reason")
+            except ValueError:
+                message, reason = payload, None
             if e.code == 404:
-                raise NotFoundError(payload)
+                raise NotFoundError(message)
             if e.code == 409:
-                try:
-                    reason = json.loads(payload).get("reason")
-                except ValueError:
-                    reason = None
                 if reason == "AlreadyExists":
-                    raise AlreadyExistsError(payload)
-                raise ConflictError(payload)
-            if e.code in (400, 422):
-                raise InvalidError(payload)
+                    raise AlreadyExistsError(message)
+                raise ConflictError(message)
+            if e.code == 400:
+                # apiserver admission denials answer 400: keep the web
+                # layer's AdmissionDenied contract identical across the
+                # in-process store and a real cluster
+                raise AdmissionDeniedError(message)
+            if e.code == 422:
+                raise InvalidError(message)
             raise
         if stream:
             return resp
@@ -175,11 +184,13 @@ class KubeStore:
                             for p, v in field_match.items())]
         return items
 
-    def create(self, obj):
+    def create(self, obj, dry_run=False):
         api_version, kind = obj["apiVersion"], obj["kind"]
         ns = m.namespace_of(obj)
-        return self._request(
-            "POST", self._path(api_version, kind, ns), body=obj)
+        path = self._path(api_version, kind, ns)
+        if dry_run:
+            path += "?dryRun=All"     # server-side validation only
+        return self._request("POST", path, body=obj)
 
     def update(self, obj):
         api_version, kind = obj["apiVersion"], obj["kind"]

@@ -172,7 +172,11 @@ class ObjectStore:
 
     # ----------------------------------------------------------------- api
 
-    def create(self, obj):
+    def create(self, obj, dry_run=False):
+        """With ``dry_run``, run the full validation path — schema
+        checks, duplicate detection, admission chain — without
+        persisting or emitting events (apiserver ``dryRun=All``; the
+        reference JWA dry-run-creates before committing, post.py)."""
         obj = m.deep_copy(obj)
         if not obj.get("apiVersion") or not obj.get("kind"):
             raise InvalidError("apiVersion and kind are required")
@@ -188,6 +192,8 @@ class ObjectStore:
             if key in bucket:
                 raise AlreadyExistsError(f"{k} {key[1]!r} already exists")
             obj = self._run_admission("CREATE", obj, None)
+            if dry_run:
+                return m.deep_copy(obj)
             md = obj.setdefault("metadata", {})
             md["uid"] = m.new_uid()
             md["creationTimestamp"] = m.now_iso()

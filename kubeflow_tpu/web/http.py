@@ -14,6 +14,8 @@ import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
+from ..core.errors import ApiError
+
 
 class HTTPError(Exception):
     def __init__(self, status, message):
@@ -183,6 +185,15 @@ class App:
             return Response(
                 {"success": False, "status": e.status, "log": e.message},
                 status=e.status)
+        except ApiError as e:
+            # store errors carry k8s status codes (NotFound 404,
+            # AlreadyExists/Conflict 409, AdmissionDenied 400, …):
+            # surface them instead of a generic 500 — what the
+            # reference gets from Flask-ized ApiException handlers
+            return Response(
+                {"success": False, "status": e.code,
+                 "log": f"{e.reason}: {e.message}"},
+                status=e.code)
         except Exception as e:  # noqa: BLE001 — service boundary
             traceback.print_exc()
             return Response(

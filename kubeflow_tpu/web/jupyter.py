@@ -383,10 +383,19 @@ def create_app(store):
         if new_pvcs:
             cb.ensure_authorized(store, request, "create",
                                  "persistentvolumeclaims", ns)
-        for pvc in new_pvcs:
-            if store.try_get("v1", "PersistentVolumeClaim",
-                             m.name_of(pvc), ns) is None:
-                store.create(pvc)
+        # dry-run the CR AND every to-be-created PVC first (reference
+        # post.py): schema/admission problems surface as one clean
+        # error before anything persists
+        missing = [pvc for pvc in new_pvcs
+                   if store.try_get("v1", "PersistentVolumeClaim",
+                                    m.name_of(pvc), ns) is None]
+        store.create(nb, dry_run=True)
+        for pvc in missing:
+            store.create(pvc, dry_run=True)
+        if request.query.get("dry_run", "").lower() == "true":
+            return cb.success(status=200)     # validate-only request
+        for pvc in missing:
+            store.create(pvc)
         store.create(nb)
         return cb.success(status=200)
 

@@ -239,18 +239,20 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == ("/apis/authorization.k8s.io/v1/"
                          "subjectaccessreviews"):
             return self._sar()
-        match, _ = self._parse()
+        match, query = self._parse()
         if match is None:
             return self._status(404, "NotFound", self.path)
         obj = self._read_body()
         plural, ns = match["plural"], match["ns"]
         name = obj.get("metadata", {}).get("name")
+        dry = query.get("dryRun") == "All"
         with self.fake.lock:
             key = (plural, ns, name)
             if key in self.fake.objects:
                 return self._status(409, "AlreadyExists", name)
-            self.fake._bump("ADDED", obj)
-            self.fake.objects[key] = obj
+            if not dry:           # dryRun=All: validate, don't persist
+                self.fake._bump("ADDED", obj)
+                self.fake.objects[key] = obj
         return self._send_json(201, obj)
 
     def _sar(self):

@@ -178,3 +178,46 @@ class TestClusterScoped:
         from kubeflow_tpu.api import profile
         store.create(profile.new("team-a", "alice@example.com"))
         assert store.get("kubeflow.org/v1", "Profile", "team-a")
+
+
+class TestDryRunCreate:
+    """apiserver dryRun=All semantics (reference JWA dry-run-creates
+    before committing, jupyter post.py)."""
+
+    def test_dry_run_validates_without_persisting(self, store):
+        obj = {"apiVersion": "v1", "kind": "ConfigMap",
+               "metadata": {"name": "cm1", "namespace": "default"},
+               "data": {}}
+        out = store.create(obj, dry_run=True)
+        assert out["metadata"]["name"] == "cm1"
+        assert store.try_get("v1", "ConfigMap", "cm1", "default") is None
+        # schema validation still runs
+        import pytest
+
+        from kubeflow_tpu.core.errors import (AlreadyExistsError,
+                                              InvalidError)
+        with pytest.raises(InvalidError):
+            store.create({"apiVersion": "v1", "kind": "ConfigMap",
+                          "metadata": {}}, dry_run=True)
+        # duplicate detection still runs
+        store.create(obj)
+        with pytest.raises(AlreadyExistsError):
+            store.create(obj, dry_run=True)
+
+    def test_dry_run_runs_admission_and_emits_no_events(self, store):
+        from kubeflow_tpu.core.errors import AdmissionDeniedError as ApiError
+
+        def deny(operation, obj, old):
+            raise ApiError("denied by webhook")
+
+        store.register_validating_hook(
+            deny, match=lambda g, k, ns: k == "ConfigMap")
+        w = store.watch("v1", "ConfigMap", send_initial=False)
+        import pytest
+        with pytest.raises(ApiError):
+            store.create({"apiVersion": "v1", "kind": "ConfigMap",
+                          "metadata": {"name": "x",
+                                       "namespace": "default"}},
+                         dry_run=True)
+        assert w.q.empty()
+        w.stop()

@@ -279,3 +279,16 @@ class TestLeaderElectionOverKubeStore:
         a.release()  # not holder: must be a no-op
         assert store.get(LEASE_API, "Lease", "ctl",
                          "kubeflow")["spec"]["holderIdentity"] == "b"
+
+
+class TestDryRunCreate:
+    def test_dry_run_sends_flag_and_persists_nothing(self, rig):
+        server, store = rig
+        out = store.create(make_cm("dry1"), dry_run=True)
+        assert out["metadata"]["name"] == "dry1"
+        assert ("configmaps", "default", "dry1") not in server.objects
+        assert any("dryRun=All" in path for method, path in
+                   server.requests if method == "POST")
+        # non-dry create still persists
+        store.create(make_cm("dry1"))
+        assert ("configmaps", "default", "dry1") in server.objects

@@ -388,3 +388,65 @@ class TestCsrfCookieFlow:
             "user": {"kind": "User", "name": "x@example.com"},
             "referredNamespace": "team-a"})
         assert r.status == 403 and "CSRF" in r.json["log"]
+
+
+class TestNotebookDryRun:
+    """Reference post.py dry-run-create semantics: validation surfaces
+    before any PVC exists; ?dry_run=true is validate-only."""
+
+    BODY = {"name": "dr-nb", "workspace": {
+        "mount": "/home/jovyan", "newPvc": {
+            "metadata": {"name": "{notebook-name}-ws"},
+            "spec": {"resources": {"requests": {"storage": "1Gi"}},
+                     "accessModes": ["ReadWriteOnce"]}}}}
+
+    def test_validate_only_creates_nothing(self, platform):
+        store, _ = platform
+        c = client(jupyter.create_app(store))
+        r = c.post("/api/namespaces/team-a/notebooks?dry_run=true",
+                   json_body=self.BODY)
+        assert r.status == 200, r.json
+        assert store.try_get("kubeflow.org/v1beta1", "Notebook",
+                             "dr-nb", "team-a") is None
+        assert store.try_get("v1", "PersistentVolumeClaim",
+                             "dr-nb-ws", "team-a") is None
+
+    def test_admission_denial_leaves_no_pvc_behind(self, platform):
+        store, _ = platform
+        from kubeflow_tpu.core.errors import AdmissionDeniedError
+
+        def deny(operation, obj, old):
+            if obj.get("metadata", {}).get("name") == "dr-nb":
+                raise AdmissionDeniedError("name dr-nb is banned")
+
+        store.register_validating_hook(
+            deny, match=lambda g, k, ns: k == "Notebook")
+        c = client(jupyter.create_app(store))
+        r = c.post("/api/namespaces/team-a/notebooks",
+                   json_body=self.BODY)
+        assert r.status == 400, r.json
+        assert "banned" in r.json["log"]
+        assert "AdmissionDenied" in r.json["log"]
+        # the dry-run ran before PVC creation: nothing orphaned
+        assert store.try_get("v1", "PersistentVolumeClaim",
+                             "dr-nb-ws", "team-a") is None
+
+    def test_pvc_denial_is_caught_by_dry_run(self, platform):
+        store, _ = platform
+        from kubeflow_tpu.core.errors import AdmissionDeniedError
+
+        def deny(operation, obj, old):
+            if obj.get("metadata", {}).get("name", "").endswith("-ws"):
+                raise AdmissionDeniedError("quota: no more volumes")
+
+        store.register_validating_hook(
+            deny, match=lambda g, k, ns: k == "PersistentVolumeClaim")
+        c = client(jupyter.create_app(store))
+        r = c.post("/api/namespaces/team-a/notebooks",
+                   json_body=self.BODY)
+        assert r.status == 400, r.json
+        # neither the CR nor any PVC persisted
+        assert store.try_get("kubeflow.org/v1beta1", "Notebook",
+                             "dr-nb", "team-a") is None
+        assert store.try_get("v1", "PersistentVolumeClaim",
+                             "dr-nb-ws", "team-a") is None
