@@ -164,10 +164,11 @@ def create_app(store, metrics_service=None):
         role = body.get("role")
         if role is not None and role not in ("admin", "edit", "view"):
             raise HTTPError(400, f"unknown role {role!r}")
-        # no role → revoke every role the user holds (a removal that
+        kind = body.get("kind", "User")
+        # no role → revoke every role the subject holds (a removal that
         # silently leaves access behind is worse than over-revoking)
         for r in ([role] if role else ["admin", "edit", "view"]):
-            kfam_lib.remove_contributor(store, ns, user, r)
+            kfam_lib.remove_contributor(store, ns, user, r, kind=kind)
         return {"message": f"Removed {user} from {ns}"}
 
     @app.get("/api/namespaces")

@@ -28,10 +28,17 @@ _ROLES = {"admin": "kubeflow-admin", "edit": "kubeflow-edit",
           "view": "kubeflow-view"}
 
 
-def binding_name(user, role):
-    """bindings.go:61-77 name encoding: lowercase, specials → dashes."""
+_KIND_PREFIX = {"User": "user", "Group": "group",
+                "ServiceAccount": "sa"}
+
+
+def binding_name(user, role, kind="User"):
+    """bindings.go:61-77 name encoding: lowercase, specials → dashes.
+    Non-User subject kinds get their own prefix so same-named subjects
+    of different kinds cannot collide (k8s RBAC keeps User/Group
+    namespaces separate; so must our name scheme)."""
     safe = re.sub(r"[^a-z0-9]", "-", user.lower())
-    return f"user-{safe}-clusterrole-{role}"
+    return f"{_KIND_PREFIX.get(kind, 'user')}-{safe}-clusterrole-{role}"
 
 
 def cluster_admin():
@@ -52,7 +59,13 @@ def is_owner_or_admin(store, user, namespace):
             return True
     rb = store.try_get(RBAC_API, "RoleBinding",
                        binding_name(user, "kubeflow-admin"), namespace)
-    return rb is not None
+    if rb is None:
+        return False
+    # kind confusion guard: only a User-subject admin binding
+    # authorizes the identity-header principal (a Group named like the
+    # user must not)
+    return m.deep_get(rb, "metadata", "annotations", "subjectKind",
+                      default="User") == "User"
 
 
 def _authorization_policy(user, role, namespace):
@@ -76,41 +89,60 @@ def _authorization_policy(user, role, namespace):
 # the dashboard's workgroup API — reference api_workgroup.ts proxies to
 # kfam over HTTP; same-language design calls the functions directly)
 
+SUBJECT_KINDS = ("User", "Group", "ServiceAccount")
+
+
 def list_contributors(store, namespace):
-    """Contributor user names bound in a namespace (any role)."""
+    """Contributor subjects bound in a namespace (any role)."""
     out = []
     for rb in store.list(RBAC_API, "RoleBinding", namespace):
         user = m.deep_get(rb, "metadata", "annotations", "user")
         role = m.deep_get(rb, "metadata", "annotations", "role")
         if user and role:
-            out.append({"user": user, "role": role})
+            out.append({"user": user, "role": role,
+                        "kind": m.deep_get(rb, "metadata", "annotations",
+                                           "subjectKind",
+                                           default="User")})
     return out
 
 
-def add_contributor(store, namespace, user, role_key="edit"):
-    """RoleBinding + mesh AuthorizationPolicy pair (bindings.go:96)."""
+def add_contributor(store, namespace, user, role_key="edit",
+                    kind="User"):
+    """RoleBinding + mesh AuthorizationPolicy pair (bindings.go:96).
+    ``kind``: any rbac Subject kind (Group/ServiceAccount bindings get
+    the RoleBinding only — the mesh policy keys on the identity header,
+    which carries a user, so group enforcement stays with RBAC)."""
+    if kind not in SUBJECT_KINDS:
+        raise HTTPError(400, f"unknown subject kind {kind!r}; expected "
+                             f"one of {SUBJECT_KINDS}")
     cluster_role = _ROLES[role_key]
-    name = binding_name(user, cluster_role)
+    name = binding_name(user, cluster_role, kind)
+    subject = {"kind": kind, "name": user}
+    if kind != "ServiceAccount":
+        subject["apiGroup"] = "rbac.authorization.k8s.io"
+    else:
+        subject["namespace"] = namespace
     rb = builtin.role_binding(
-        name, namespace, "ClusterRole", cluster_role,
-        [{"kind": "User", "name": user,
-          "apiGroup": "rbac.authorization.k8s.io"}],
-        annotations={"role": role_key, "user": user})
+        name, namespace, "ClusterRole", cluster_role, [subject],
+        annotations={"role": role_key, "user": user,
+                     "subjectKind": kind})
     store.create(rb)
-    try:
-        store.create(_authorization_policy(user, cluster_role,
-                                           namespace))
-    except AlreadyExistsError:
-        pass
-
-
-def remove_contributor(store, namespace, user, role_key="edit"):
-    cluster_role = _ROLES[role_key]
-    name = binding_name(user, cluster_role)
-    for api, kind in ((RBAC_API, "RoleBinding"),
-                      (ISTIO_API, "AuthorizationPolicy")):
+    if kind == "User":
         try:
-            store.delete(api, kind, name, namespace)
+            store.create(_authorization_policy(user, cluster_role,
+                                               namespace))
+        except AlreadyExistsError:
+            pass
+
+
+def remove_contributor(store, namespace, user, role_key="edit",
+                       kind="User"):
+    cluster_role = _ROLES[role_key]
+    name = binding_name(user, cluster_role, kind)
+    for api, obj_kind in ((RBAC_API, "RoleBinding"),
+                          (ISTIO_API, "AuthorizationPolicy")):
+        try:
+            store.delete(api, obj_kind, name, namespace)
         except NotFoundError:
             pass
 
@@ -153,7 +185,8 @@ def create_app(store):
         for ns in namespaces:
             for c in list_contributors(store, ns):
                 bindings.append({
-                    "user": {"kind": "User", "name": c["user"]},
+                    "user": {"kind": c.get("kind", "User"),
+                             "name": c["user"]},
                     "referredNamespace": ns,
                     "RoleRef": {"apiGroup": "rbac.authorization.k8s.io",
                                 "kind": "ClusterRole",
@@ -164,6 +197,7 @@ def create_app(store):
 
     def _binding_args(body):
         user = m.deep_get(body, "user", "name")
+        kind = m.deep_get(body, "user", "kind", default="User")
         ns = body.get("referredNamespace")
         if not user or not ns:
             raise HTTPError(400, "user.name and referredNamespace "
@@ -175,17 +209,18 @@ def create_app(store):
             raise HTTPError(
                 400, f"unknown RoleRef.name {role_ref!r}; expected one "
                      f"of {sorted(_ROLES) + sorted(_ROLES.values())}")
-        return user, ns, role_key, _ROLES[role_key]
+        return user, ns, role_key, _ROLES[role_key], kind
 
     @app.post("/kfam/v1/bindings")
     def create_binding(request):
-        user, ns, role_key, cluster_role = _binding_args(request.json)
+        user, ns, role_key, cluster_role, kind = \
+            _binding_args(request.json)
         if not is_owner_or_admin(store, request.user, ns):
             raise HTTPError(
                 403, f"user {request.user} is neither owner of "
                      f"{ns} nor cluster admin")
         try:
-            add_contributor(store, ns, user, role_key)
+            add_contributor(store, ns, user, role_key, kind=kind)
         except AlreadyExistsError:
             raise HTTPError(
                 409, f"binding {binding_name(user, cluster_role)} "
@@ -194,10 +229,11 @@ def create_app(store):
 
     @app.delete("/kfam/v1/bindings")
     def delete_binding(request):
-        user, ns, role_key, cluster_role = _binding_args(request.json)
+        user, ns, role_key, _cluster_role, kind = \
+            _binding_args(request.json)
         if not is_owner_or_admin(store, request.user, ns):
             raise HTTPError(403, "not owner or admin")
-        remove_contributor(store, ns, user, role_key)
+        remove_contributor(store, ns, user, role_key, kind=kind)
         return {"success": True}
 
     @app.post("/kfam/v1/profiles")

@@ -194,11 +194,11 @@ async function formView(el) {
       type: "checkbox", value: cfg.shm.value }),
   ]);
 
-  const submit = async () => {
+  const buildBody = () => {
     const groups = [basics, workspace, advanced];
     if (!groups.every((g) => g.validate()) || !datavols.validate()) {
       snack("fix the highlighted fields", "error");
-      return;
+      return null;
     }
     const b = basics.values();
     const adv = advanced.values();
@@ -227,10 +227,29 @@ async function formView(el) {
       body.accelerators = { num: chipsField.value(),
         type: typeField.value(), topology: topoField.value() };
     }
+    return body;
+  };
+
+  const submit = async () => {
+    const body = buildBody();
+    if (!body) return;
     try {
       await api("POST", `api/namespaces/${ns}/notebooks`, body);
-      snack(`created ${b.name}`, "success");
+      snack(`created ${body.name}`, "success");
       router.go("/");
+    } catch (e) {
+      snack(String(e.message || e), "error");
+    }
+  };
+
+  const validate = async () => {
+    /* server-side dry-run: schema + admission chain, nothing created */
+    const body = buildBody();
+    if (!body) return;
+    try {
+      await api("POST",
+        `api/namespaces/${ns}/notebooks?dry_run=true`, body);
+      snack("configuration is valid", "success");
     } catch (e) {
       snack(String(e.message || e), "error");
     }
@@ -263,6 +282,8 @@ async function formView(el) {
     h("div.kf-form-actions", {},
       h("button.primary", { id: "submit-notebook", onclick: submit },
         "Launch"),
+      h("button.ghost", { id: "validate-notebook", onclick: validate },
+        "Validate (dry run)"),
       h("button.ghost", { onclick: () => router.go("/") }, "Cancel")),
   );
 }

@@ -153,6 +153,10 @@ def test_dashboard_contributor_management(servers, page):
 def test_form_validation_blocks_bad_names(servers, page):
     page.goto(servers["jupyter"] + "/#/new")
     page.wait_for_selector("#form-basics")
+    # server-side dry run round-trips cleanly for a good config
+    page.fill("#f-name", "probe-ok")
+    page.click("#validate-notebook")
+    page.wait_for_selector("#kf-snackbar.success")
     page.fill("#f-name", "Bad_Name!")
     page.click("#submit-notebook")
     # stays on the form with a field error; nothing was created

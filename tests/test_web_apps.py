@@ -323,7 +323,8 @@ class TestDashboard:
         got = c.get(
             "/api/workgroup/contributors?namespace=team-a").json
         assert got["contributors"] == [
-            {"user": "bob@example.com", "role": "edit"}]
+            {"user": "bob@example.com", "role": "edit",
+             "kind": "User"}]
         # duplicate → 409
         assert c.post("/api/workgroup/contributors", json_body={
             "namespace": "team-a",
@@ -450,3 +451,106 @@ class TestNotebookDryRun:
                              "dr-nb", "team-a") is None
         assert store.try_get("v1", "PersistentVolumeClaim",
                              "dr-nb-ws", "team-a") is None
+
+
+class TestKfamSubjectKinds:
+    """Group/ServiceAccount contributor subjects (rbac Subject kinds;
+    mesh AuthorizationPolicy only for User — the identity header
+    carries a user)."""
+
+    def test_group_binding(self, platform):
+        store, _ = platform
+        c = client(kfam.create_app(store))
+        r = c.post("/kfam/v1/bindings", json_body={
+            "user": {"kind": "Group", "name": "ml-team"},
+            "referredNamespace": "team-a",
+            "RoleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+        })
+        assert r.status == 200, r.json
+        name = kfam.binding_name("ml-team", "kubeflow-edit", "Group")
+        rb = store.get("rbac.authorization.k8s.io/v1", "RoleBinding",
+                       name, "team-a")
+        assert rb["subjects"] == [{
+            "kind": "Group", "name": "ml-team",
+            "apiGroup": "rbac.authorization.k8s.io"}]
+        # no mesh policy for non-User subjects
+        assert store.try_get("security.istio.io/v1beta1",
+                             "AuthorizationPolicy", name,
+                             "team-a") is None
+        listed = c.get("/kfam/v1/bindings?namespace=team-a").json
+        kinds = {b["user"]["kind"] for b in listed["bindings"]}
+        assert "Group" in kinds
+
+    def test_serviceaccount_binding_scopes_namespace(self, platform):
+        store, _ = platform
+        c = client(kfam.create_app(store))
+        r = c.post("/kfam/v1/bindings", json_body={
+            "user": {"kind": "ServiceAccount", "name": "ci-runner"},
+            "referredNamespace": "team-a",
+        })
+        assert r.status == 200, r.json
+        name = kfam.binding_name("ci-runner", "kubeflow-edit",
+                                 "ServiceAccount")
+        rb = store.get("rbac.authorization.k8s.io/v1", "RoleBinding",
+                       name, "team-a")
+        assert rb["subjects"] == [{"kind": "ServiceAccount",
+                                   "name": "ci-runner",
+                                   "namespace": "team-a"}]
+
+    def test_group_admin_does_not_authorize_same_named_user(
+            self, platform):
+        """kind-confusion guard: a Group admin binding must not grant
+        owner/admin powers to a USER whose identity equals the group
+        name."""
+        store, _ = platform
+        c = client(kfam.create_app(store))
+        r = c.post("/kfam/v1/bindings", json_body={
+            "user": {"kind": "Group", "name": "contractors"},
+            "referredNamespace": "team-a",
+            "RoleRef": {"kind": "ClusterRole",
+                        "name": "kubeflow-admin"},
+        })
+        assert r.status == 200, r.json
+        impostor = client(kfam.create_app(store),
+                          {"kubeflow-userid": "contractors"})
+        assert impostor.get(
+            "/kfam/v1/bindings?namespace=team-a").status == 403
+        r = impostor.post("/kfam/v1/bindings", json_body={
+            "user": {"kind": "User", "name": "eve@example.com"},
+            "referredNamespace": "team-a",
+        })
+        assert r.status == 403
+
+    def test_same_name_different_kinds_do_not_collide(self, platform):
+        store, _ = platform
+        c = client(kfam.create_app(store))
+        for kind in ("User", "ServiceAccount"):
+            r = c.post("/kfam/v1/bindings", json_body={
+                "user": {"kind": kind, "name": "ci-runner"},
+                "referredNamespace": "team-a",
+            })
+            assert r.status == 200, (kind, r.json)
+        # deleting the ServiceAccount binding leaves the User's intact
+        r = c.delete("/kfam/v1/bindings", json_body={
+            "user": {"kind": "ServiceAccount", "name": "ci-runner"},
+            "referredNamespace": "team-a",
+        })
+        assert r.status == 200
+        assert store.try_get(
+            "rbac.authorization.k8s.io/v1", "RoleBinding",
+            kfam.binding_name("ci-runner", "kubeflow-edit"),
+            "team-a") is not None
+        assert store.try_get(
+            "rbac.authorization.k8s.io/v1", "RoleBinding",
+            kfam.binding_name("ci-runner", "kubeflow-edit",
+                              "ServiceAccount"),
+            "team-a") is None
+
+    def test_unknown_kind_rejected(self, platform):
+        store, _ = platform
+        c = client(kfam.create_app(store))
+        r = c.post("/kfam/v1/bindings", json_body={
+            "user": {"kind": "Robot", "name": "x"},
+            "referredNamespace": "team-a",
+        })
+        assert r.status == 400
