@@ -45,10 +45,12 @@ class Config:
     # fp32 [B,S,V] logits in the loss; ce_chunk must divide vocab_size
     chunked_ce: bool = False
     ce_chunk: int = 2048
-    # mixture-of-experts MLP (Switch-style top-1, capacity-based dense
-    # dispatch — SPMD-friendly einsums, expert weights sharded over the
-    # ``expert`` mesh axis). 0 = dense MLP.
+    # mixture-of-experts MLP (capacity-based dense dispatch —
+    # SPMD-friendly einsums, expert weights sharded over the ``expert``
+    # mesh axis). 0 = dense MLP; moe_top_k: 1 = Switch, 2 = GShard-style
+    # with gates renormalized over the chosen experts.
     moe_experts: int = 0
+    moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
 
     def __post_init__(self):
@@ -200,21 +202,23 @@ def _attention(q, k, v, config):
 
 
 def _switch_moe(h, lp, config):
-    """Switch-transformer top-1 MoE with capacity-based dense dispatch.
+    """Top-k MoE with capacity-based dense dispatch (k=1 Switch, k=2
+    GShard-style with gates renormalized over the chosen experts).
 
     SPMD shape discipline: routing is per sequence-group (each batch
     row is a group), the dispatch/combine tensors are one-hot einsums
     (no ragged ops, XLA-shardable), and expert weights carry the
     ``expert`` logical axis so an ``expert``-sized mesh axis gives true
     expert parallelism (all-to-all inserted by XLA at the dispatch
-    einsums). Tokens over capacity are dropped (standard Switch
-    behavior); aux load-balancing loss per the Switch paper.
+    einsums). Tokens over capacity are dropped (standard behavior);
+    aux load-balancing loss from the first choice (Switch/GShard).
 
     Returns (out [b,s,d], aux_loss scalar fp32).
     """
     dt = config.compute_dtype
     b, s, d = h.shape
     e = config.moe_experts
+    k = min(config.moe_top_k, e)
     capacity = max(1, int(s / e * config.moe_capacity_factor))
 
     # router in fp32 (Switch-paper selective precision: bf16-quantized
@@ -223,18 +227,22 @@ def _switch_moe(h, lp, config):
         "bsd,de->bse", h.astype(jnp.float32),
         lp["router"].astype(jnp.float32))
     probs = jax.nn.softmax(router_logits, axis=-1)
-    gate, expert_idx = probs.max(axis=-1), probs.argmax(axis=-1)
+    gate_vals, expert_idx = lax.top_k(probs, k)          # [b,s,k]
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(axis=-1, keepdims=True), 1e-9)
 
-    # position of each token within its expert's capacity buffer
-    assign = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [b,s,e]
-    pos = jnp.cumsum(assign, axis=1) * assign - 1.0            # [b,s,e]
+    # each of the k choices is a dispatch slot; positions within an
+    # expert's capacity buffer are assigned over the (s, k) slot order
+    assign = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [b,s,k,e]
+    flat = assign.reshape(b, s * k, e)
+    pos = (jnp.cumsum(flat, axis=1) * flat - 1.0).reshape(b, s, k, e)
     within = (pos >= 0) & (pos < capacity)
     dispatch = jax.nn.one_hot(
         jnp.clip(pos, 0, capacity - 1).astype(jnp.int32), capacity,
-        dtype=dt) * within.astype(dt)[..., None]               # [b,s,e,c]
+        dtype=dt) * within.astype(dt)[..., None]          # [b,s,k,e,c]
 
     # route → expert MLPs → combine (expert dim sharded over the mesh)
-    xin = jnp.einsum("bsec,bsd->ebcd", dispatch, h)
+    xin = jnp.einsum("bskec,bsd->ebcd", dispatch, h)
     xin = sharding.constrain(xin, ("expert", "batch", None, "act_embed"))
     gate_h = jnp.einsum("ebcd,edf->ebcf", xin, lp["we_gate"].astype(dt))
     up = jnp.einsum("ebcd,edf->ebcf", xin, lp["we_up"].astype(dt))
@@ -242,11 +250,11 @@ def _switch_moe(h, lp, config):
                        lp["we_down"].astype(dt))
     out_e = sharding.constrain(out_e,
                                ("expert", "batch", None, "act_embed"))
-    combine = dispatch * gate.astype(dt)[..., None, None]
-    out = jnp.einsum("bsec,ebcd->bsd", combine, out_e)
+    combine = dispatch * gate_vals.astype(dt)[..., None, None]
+    out = jnp.einsum("bskec,ebcd->bsd", combine, out_e)
 
-    # Switch aux loss: fraction-of-tokens · mean-router-prob per expert
-    frac_tokens = assign.mean(axis=(0, 1))
+    # aux loss: fraction-of-first-choice-tokens · mean prob per expert
+    frac_tokens = assign[:, :, 0, :].mean(axis=(0, 1))
     frac_probs = probs.mean(axis=(0, 1))
     aux = e * jnp.sum(frac_tokens * frac_probs)
     return out, aux

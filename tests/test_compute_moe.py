@@ -94,3 +94,42 @@ def test_expert_parallel_mesh_matches_single_device():
     for _ in range(4):
         state, metrics = step(state, batch)
     assert float(metrics["loss"]) < float(loss_ref)
+
+
+class TestTopK:
+    def test_top2_with_two_experts_is_exact_soft_mixture(self):
+        """k=2, E=2, ample capacity: renormalized top-2 gates = the full
+        softmax, so MoE output must equal the closed-form soft mixture
+        of both experts."""
+        cfg = _cfg(moe_experts=2, moe_top_k=2, n_layers=1,
+                   moe_capacity_factor=2.0)   # capacity = s per expert
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        lp = jax.tree.map(lambda x: x[0], params["layers"])
+        h = jax.random.normal(jax.random.PRNGKey(5), (2, cfg.max_seq, 32))
+        out, _ = transformer._switch_moe(h, lp, cfg)
+
+        probs = jax.nn.softmax(h @ lp["router"], axis=-1)
+        expect = 0.0
+        for ei in range(2):
+            mlp = (jax.nn.silu(h @ lp["we_gate"][ei])
+                   * (h @ lp["we_up"][ei])) @ lp["we_down"][ei]
+            expect = expect + probs[..., ei:ei + 1] * mlp
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_top2_trains_on_expert_mesh(self):
+        cfg = _cfg(moe_experts=4, moe_top_k=2)
+        mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=2, expert=2,
+                                                    tensor=2))
+        opt = train.make_optimizer(1e-3, 1, 10)
+        state = train.init_state(
+            lambda k: transformer.init_params(cfg, k), opt, mesh,
+            transformer.logical_axes(cfg), jax.random.PRNGKey(0))
+        step = train.make_train_step(
+            train.plain_loss(transformer.loss_fn, cfg), opt, mesh)
+        batch = _batch(cfg)
+        state, m0 = step(state, batch)
+        first = float(m0["loss"])
+        for _ in range(4):
+            state, metrics = step(state, batch)
+        assert float(metrics["loss"]) < first
