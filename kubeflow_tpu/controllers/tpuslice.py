@@ -176,6 +176,19 @@ def grid_size(parameters):
     return size
 
 
+_HALTON_PRIMES = (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37)
+
+
+def _halton(index, base):
+    """van der Corput radical inverse — the Halton sequence coordinate."""
+    f, r, i = 1.0, 0.0, index + 1
+    while i > 0:
+        f /= base
+        r += f * (i % base)
+        i //= base
+    return r
+
+
 def sample_parameters(parameters, trial_index, seed=0,
                       algorithm="random"):
     """Deterministic per-trial parameter assignment.
@@ -186,9 +199,18 @@ def sample_parameters(parameters, trial_index, seed=0,
     - ``grid``: mixed-radix enumeration of the cartesian grid
       (per-param ``steps``; categorical/int enumerate their domain);
       trial_index wraps modulo the grid size.
+    - ``halton``: low-discrepancy quasi-random sweep (one prime base
+      per parameter dimension, seed offsets the sequence) — better
+      space coverage than random at small trial counts.
     """
     import hashlib
     values = {}
+    if algorithm == "halton":
+        for j, p in enumerate(parameters):
+            base = _HALTON_PRIMES[j % len(_HALTON_PRIMES)]
+            u = _halton(trial_index + seed, base)
+            values[p["name"]] = _param_value_at(p, u)
+        return values
     if algorithm == "grid":
         idx = trial_index % max(grid_size(parameters), 1)
         for p in parameters:
@@ -208,7 +230,7 @@ def sample_parameters(parameters, trial_index, seed=0,
         return values
     if algorithm != "random":
         raise ValueError(f"unknown algorithm {algorithm!r}; "
-                         f"expected random or grid")
+                         f"expected random, grid, or halton")
     for p in parameters:
         h = hashlib.sha256(
             f"{seed}:{trial_index}:{p['name']}".encode()).digest()
