@@ -282,6 +282,30 @@ class StudyJobReconciler(Reconciler):
     def _trial_name(self, study_name, i):
         return f"{study_name}-trial-{i}"
 
+    def _metric_from_logs(self, pod, namespace, metric_name):
+        """Scrape the trial pod's stdout for the metric line. Cluster
+        mode reads the kubelet log endpoint (KubeStore.read_pod_log);
+        the in-process runtime uses the kubeflow.org/pod-logs
+        annotation convention (same as the JWA logs route)."""
+        if pod is None:
+            return None
+        from ..compute.trial import parse_metric_line
+        reader = getattr(self.store, "read_pod_log", None)
+        if reader is not None:
+            try:
+                logs = reader(m.name_of(pod), namespace)
+            except Exception:
+                return None
+        else:
+            logs = m.annotations_of(pod).get("kubeflow.org/pod-logs", "")
+        best = None
+        for line in (logs or "").splitlines():
+            parsed = parse_metric_line(line)
+            if parsed and parsed.get("name") == metric_name \
+                    and isinstance(parsed.get("value"), (int, float)):
+                best = float(parsed["value"])   # last report wins
+        return best
+
     def reconcile(self, req):
         study = self.store.try_get(self.API, tsapi.STUDY_KIND, req.name,
                                    req.namespace)
@@ -321,7 +345,11 @@ class StudyJobReconciler(Reconciler):
                   for t in m.deep_get(study, "status", "trials",
                                       default=[]) or []}
 
-        # collect results for running trials
+        # collect results for running trials: a metrics ConfigMap wins,
+        # else the reconciler IS the metrics collector — it scrapes the
+        # trial pod's logs for the `trial-metric {...}` stdout line
+        # (compute/trial.py report(); Katib's metrics-collector idiom,
+        # here without a sidecar)
         for i, trial in trials.items():
             if trial.get("state") in ("Succeeded", "Failed"):
                 continue
@@ -332,6 +360,12 @@ class StudyJobReconciler(Reconciler):
             if cm is not None and metric_name in (cm.get("data") or {}):
                 trial["state"] = "Succeeded"
                 trial["objectiveValue"] = float(cm["data"][metric_name])
+                continue
+            metric = self._metric_from_logs(pod, req.namespace,
+                                            metric_name)
+            if metric is not None:
+                trial["state"] = "Succeeded"
+                trial["objectiveValue"] = metric
             elif pod is not None and \
                     m.deep_get(pod, "status", "phase") == "Failed":
                 trial["state"] = "Failed"

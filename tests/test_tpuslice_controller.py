@@ -291,3 +291,41 @@ class TestStudyAlgorithms:
                                     algorithm="halton")
         assert shifted == sample_parameters(params, 3,
                                             algorithm="halton")
+
+    def test_metrics_scraped_from_pod_logs_without_configmap(
+            self, store, manager):
+        """The reconciler is the metrics collector: no ConfigMap, the
+        trial-metric stdout line in the pod logs completes the trial
+        (compute/trial.py report contract)."""
+        from kubeflow_tpu.controllers.tpuslice import StudyJobReconciler
+        from kubeflow_tpu.controllers.workload_runtime import (
+            PodRuntimeReconciler)
+        from kubeflow_tpu.core import meta as m2
+        manager.add(StudyJobReconciler())
+        manager.add(PodRuntimeReconciler())
+        manager.start_sync()
+        study = tsapi.new_study(
+            "logscrape", "default",
+            objective={"type": "minimize", "metricName": "objective"},
+            parameters=[{"name": "lr", "type": "double",
+                         "min": 0.001, "max": 0.1}],
+            trial_template={"spec": {"containers": [
+                {"name": "t", "image": "x",
+                 "args": ["--lr={{lr}}"]}]}},
+            max_trials=1, parallelism=1)
+        store.create(study)
+        manager.run_sync()
+        pod = store.get("v1", "Pod", "logscrape-trial-0", "default")
+        m2.set_annotation(
+            pod, "kubeflow.org/pod-logs",
+            "starting up\n"
+            'trial-metric {"name": "objective", "value": 0.5}\n'
+            'trial-metric {"name": "objective", "value": 0.25}\n')
+        store.update(pod)
+        manager.run_sync()
+        cur = store.get("kubeflow.org/v1alpha1", tsapi.STUDY_KIND,
+                        "logscrape", "default")
+        trial = cur["status"]["trials"][0]
+        assert trial["state"] == "Succeeded"
+        assert trial["objectiveValue"] == 0.25    # last report wins
+        assert cur["status"]["bestTrial"]["objectiveValue"] == 0.25
