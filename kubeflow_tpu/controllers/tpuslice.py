@@ -283,18 +283,35 @@ class StudyJobReconciler(Reconciler):
         return f"{study_name}-trial-{i}"
 
     def _metric_from_logs(self, pod, namespace, metric_name):
-        """Scrape the trial pod's stdout for the metric line. Cluster
-        mode reads the kubelet log endpoint (KubeStore.read_pod_log);
-        the in-process runtime uses the kubeflow.org/pod-logs
-        annotation convention (same as the JWA logs route)."""
+        """Scrape the trial pod's stdout for the metric line.
+
+        Cluster mode reads the kubelet log endpoint
+        (KubeStore.read_pod_log) — only once the pod reached a terminal
+        phase, so an intermediate per-epoch report can't be mistaken
+        for the final objective, with a bounded tail (the final report
+        is at/near the end). The in-process runtime uses the
+        kubeflow.org/pod-logs annotation convention ungated (its fake
+        kubelet never reaches Succeeded; the annotation is the injected
+        final log)."""
         if pod is None:
             return None
         from ..compute.trial import parse_metric_line
         reader = getattr(self.store, "read_pod_log", None)
         if reader is not None:
+            phase = m.deep_get(pod, "status", "phase")
+            if phase not in ("Succeeded", "Failed"):
+                return None
+            containers = m.deep_get(pod, "spec", "containers",
+                                    default=[]) or []
+            container = (containers[0].get("name")
+                         if len(containers) > 1 else None)
             try:
-                logs = reader(m.name_of(pod), namespace)
+                logs = reader(m.name_of(pod), namespace,
+                              container=container, tail_lines=200)
             except Exception:
+                log.warning(
+                    "studyjob: reading logs of trial pod %s/%s failed",
+                    namespace, m.name_of(pod), exc_info=True)
                 return None
         else:
             logs = m.annotations_of(pod).get("kubeflow.org/pod-logs", "")
