@@ -219,7 +219,10 @@ def _switch_moe(h, lp, config):
     b, s, d = h.shape
     e = config.moe_experts
     k = min(config.moe_top_k, e)
-    capacity = max(1, int(s / e * config.moe_capacity_factor))
+    # GShard capacity: proportional to k·tokens/experts — top-k routing
+    # makes k assignments per token, so capacity must scale with k or
+    # the default factor silently drops ~(k-1)/k of balanced traffic
+    capacity = max(1, int(k * s / e * config.moe_capacity_factor))
 
     # router in fp32 (Switch-paper selective precision: bf16-quantized
     # logits destabilize near-tied argmax assignments)

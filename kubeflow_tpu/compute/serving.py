@@ -44,30 +44,52 @@ class _Batcher:
 
     def submit(self, x):
         """Blocking: returns (result_rows, device_ms_of_the_batch)."""
+        if self._stop or not self.thread.is_alive():
+            raise RuntimeError("batcher stopped")
         done = threading.Event()
         slot = {"x": x, "done": done}
         self.q.put(slot)
-        done.wait()
+        # never block forever: if the loop thread dies between the
+        # liveness check above and the put, nothing will drain the slot
+        while not done.wait(0.5):
+            if not self.thread.is_alive() and not done.is_set():
+                raise RuntimeError("batcher stopped")
         if "error" in slot:
             raise slot["error"]
         return slot["out"], slot["ms"]
 
     def _loop(self):
-        while not self._stop:
+        try:
+            while not self._stop:
+                try:
+                    first = self.q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if first is None:
+                    return
+                # everything below must never kill the thread: a dead
+                # batcher would hang every future predict on the model
+                try:
+                    self._collect_and_run(first)
+                except Exception as e:  # noqa: BLE001 — keep serving
+                    if "done" in first and not first["done"].is_set():
+                        first["error"] = e
+                        first["done"].set()
+        finally:
+            self._drain()
+
+    def _drain(self):
+        """Fail any queued requests on shutdown instead of leaving
+        their callers blocked on done.wait()."""
+        while True:
             try:
-                first = self.q.get(timeout=0.1)
+                slot = self.q.get_nowait()
             except queue.Empty:
-                continue
-            if first is None:
                 return
-            # everything below must never kill the thread: a dead
-            # batcher would hang every future predict on the model
-            try:
-                self._collect_and_run(first)
-            except Exception as e:  # noqa: BLE001 — keep serving
-                if "done" in first and not first["done"].is_set():
-                    first["error"] = e
-                    first["done"].set()
+            if slot is None:
+                continue
+            slot["error"] = RuntimeError("batcher stopped")
+            slot["done"].set()
 
     def _collect_and_run(self, first):
         group = [first]

@@ -222,9 +222,12 @@ def sample_parameters(parameters, trial_index, seed=0,
                 u = 0.0 if steps == 1 else k / (steps - 1)
                 values[p["name"]] = _param_value_at(p, u)
             elif ptype == "int":
-                # direct index — a k/steps fraction round-trip drops or
-                # duplicates grid points to float error
-                values[p["name"]] = int(p.get("min", 0)) + k
+                # spread the steps across [min, max] (not min..min+k):
+                # steps is capped at the domain size, so consecutive k
+                # land ≥1 apart and the rounded points stay distinct
+                lo, hi = int(p.get("min", 0)), int(p.get("max", 1))
+                values[p["name"]] = lo if steps == 1 else \
+                    round(lo + k * (hi - lo) / (steps - 1))
             else:   # categorical
                 values[p["name"]] = (p.get("values") or [""])[k]
         return values
@@ -375,17 +378,29 @@ class StudyJobReconciler(Reconciler):
             cm = self.store.try_get("v1", "ConfigMap", f"{tname}-metrics",
                                     req.namespace)
             if cm is not None and metric_name in (cm.get("data") or {}):
+                # the metrics ConfigMap is the trial's own explicit
+                # completion report — authoritative even if the pod
+                # later crashed in teardown
                 trial["state"] = "Succeeded"
                 trial["objectiveValue"] = float(cm["data"][metric_name])
+                continue
+            if pod is not None and \
+                    m.deep_get(pod, "status", "phase") == "Failed":
+                # a crashed trial is Failed no matter what it printed:
+                # log-scraped metric lines may be stale per-epoch
+                # reports, which must not enter best-trial selection —
+                # keep the partial value separately for debugging
+                trial["state"] = "Failed"
+                partial = self._metric_from_logs(pod, req.namespace,
+                                                 metric_name)
+                if partial is not None:
+                    trial["partialObjectiveValue"] = partial
                 continue
             metric = self._metric_from_logs(pod, req.namespace,
                                             metric_name)
             if metric is not None:
                 trial["state"] = "Succeeded"
                 trial["objectiveValue"] = metric
-            elif pod is not None and \
-                    m.deep_get(pod, "status", "phase") == "Failed":
-                trial["state"] = "Failed"
 
         # launch trials up to parallelism
         active = sum(1 for t in trials.values()

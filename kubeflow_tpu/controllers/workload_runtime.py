@@ -194,7 +194,11 @@ class PodRuntimeReconciler(Reconciler):
         pod = self.store.try_get("v1", "Pod", req.name, req.namespace)
         if pod is None:
             return Result()
-        if m.deep_get(pod, "status", "phase") == "Running":
+        if m.deep_get(pod, "status", "phase") in (
+                "Running", "Succeeded", "Failed"):
+            # Succeeded/Failed are terminal for a kubelet: a crashed
+            # pod must never be silently revived — recovery is the
+            # owning controller's job (gang restart, STS recreate)
             return Result()
         if not self._schedulable(pod):
             prior = m.deep_get(pod, "status", "conditions", default=[]) or []

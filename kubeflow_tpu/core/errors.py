@@ -48,6 +48,14 @@ class InvalidError(ApiError):
     reason = "Invalid"
 
 
+class BadRequestError(ApiError):
+    """Malformed request (bad JSON, unparseable selectors/dryRun) —
+    the apiserver's 400/BadRequest, distinct from 422/Invalid."""
+
+    code = 400
+    reason = "BadRequest"
+
+
 class ForbiddenError(ApiError):
     code = 403
     reason = "Forbidden"

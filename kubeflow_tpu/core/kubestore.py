@@ -25,7 +25,8 @@ import urllib.request
 
 from . import meta as m
 from .errors import (AdmissionDeniedError, AlreadyExistsError,
-                     ConflictError, InvalidError, NotFoundError)
+                     BadRequestError, ConflictError, InvalidError,
+                     NotFoundError)
 from .store import WatchEvent
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -125,10 +126,16 @@ class KubeStore:
                     raise AlreadyExistsError(message)
                 raise ConflictError(message)
             if e.code == 400:
-                # apiserver admission denials answer 400: keep the web
-                # layer's AdmissionDenied contract identical across the
-                # in-process store and a real cluster
-                raise AdmissionDeniedError(message)
+                # apiserver admission denials answer 400, but so do
+                # malformed requests (bad JSON, invalid field selectors,
+                # unparseable dryRun) — only classify as a denial when
+                # the Status looks like one, so the web layer doesn't
+                # blame a webhook for a client-side bug
+                if "admission webhook" in message \
+                        or "denied the request" in message \
+                        or reason in ("Forbidden", "AdmissionDenied"):
+                    raise AdmissionDeniedError(message)
+                raise BadRequestError(message)
             if e.code == 422:
                 raise InvalidError(message)
             raise

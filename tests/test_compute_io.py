@@ -247,6 +247,19 @@ class TestDynamicBatching:
         finally:
             model.close()
 
+    def test_submit_after_stop_raises_instead_of_hanging(self):
+        import numpy as np
+        import pytest
+
+        from kubeflow_tpu.compute import serving
+
+        model = serving.ServedModel("m", lambda x: x, batching=True,
+                                    batch_timeout_ms=1.0)
+        model.close()
+        model._batcher.thread.join(timeout=5)
+        with pytest.raises(RuntimeError, match="stopped"):
+            model.predict_timed(np.zeros((1, 2), np.float32))
+
 
 class TestProfiler:
     """compute/profiler.py: traces land where the Tensorboard CR path

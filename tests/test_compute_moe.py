@@ -98,11 +98,13 @@ def test_expert_parallel_mesh_matches_single_device():
 
 class TestTopK:
     def test_top2_with_two_experts_is_exact_soft_mixture(self):
-        """k=2, E=2, ample capacity: renormalized top-2 gates = the full
-        softmax, so MoE output must equal the closed-form soft mixture
-        of both experts."""
+        """k=2, E=2: renormalized top-2 gates = the full softmax, so
+        MoE output must equal the closed-form soft mixture of both
+        experts. capacity_factor=1.0 only suffices because capacity
+        scales with k (GShard k·s/e); the pre-fix s/e capacity would
+        drop half the assignments here and fail this test."""
         cfg = _cfg(moe_experts=2, moe_top_k=2, n_layers=1,
-                   moe_capacity_factor=2.0)   # capacity = s per expert
+                   moe_capacity_factor=1.0)   # capacity = k·s/e = s
         params = transformer.init_params(cfg, jax.random.PRNGKey(0))
         lp = jax.tree.map(lambda x: x[0], params["layers"])
         h = jax.random.normal(jax.random.PRNGKey(5), (2, cfg.max_seq, 32))

@@ -85,6 +85,46 @@ class TestCrud:
         assert store.token == "test-token"
 
 
+class TestHttp400Classification:
+    """Only admission-webhook denials become AdmissionDeniedError; a
+    malformed request's 400 is BadRequestError (the apiserver answers
+    400 for bad JSON / bad field selectors / unparseable dryRun too)."""
+
+    def _respond_400(self, store, status, monkeypatch):
+        import io
+        import json
+        import urllib.error
+        import urllib.request
+
+        def fake_urlopen(req, **kw):
+            raise urllib.error.HTTPError(
+                req.full_url, 400, "Bad Request", {},
+                io.BytesIO(json.dumps(status).encode()))
+        monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+        return store
+
+    def test_webhook_denial_is_admission_denied(self, rig, monkeypatch):
+        from kubeflow_tpu.core.errors import AdmissionDeniedError
+        server, store = rig
+        self._respond_400(store, {
+            "kind": "Status", "reason": "BadRequest",
+            "message": 'admission webhook "validate.kubeflow.org" '
+                       "denied the request: bad image"}, monkeypatch)
+        with pytest.raises(AdmissionDeniedError, match="bad image"):
+            store.create(make_cm("a"))
+
+    def test_malformed_request_is_bad_request(self, rig, monkeypatch):
+        from kubeflow_tpu.core.errors import BadRequestError
+        server, store = rig
+        self._respond_400(store, {
+            "kind": "Status", "reason": "BadRequest",
+            "message": "unable to parse field selector"}, monkeypatch)
+        with pytest.raises(BadRequestError, match="field selector") \
+                as exc:
+            store.create(make_cm("a"))
+        assert exc.value.code == 400  # web layer re-serves the true code
+
+
 class TestListSelectors:
     def test_label_selector_flat_and_matchlabels(self, rig):
         server, store = rig

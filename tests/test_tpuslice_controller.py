@@ -292,6 +292,52 @@ class TestStudyAlgorithms:
         assert shifted == sample_parameters(params, 3,
                                             algorithm="halton")
 
+    def test_grid_int_steps_span_the_declared_range(self):
+        """int param with steps < domain spreads points across
+        [min, max] (matching double behavior) instead of enumerating
+        min..min+steps-1 and never exploring the top of the range."""
+        from kubeflow_tpu.controllers.tpuslice import sample_parameters
+        params = [{"name": "n", "type": "int",
+                   "min": 0, "max": 100, "steps": 5}]
+        got = sorted(sample_parameters(params, i, algorithm="grid")["n"]
+                     for i in range(5))
+        assert got == [0, 25, 50, 75, 100]
+
+    def test_failed_trial_with_metric_lines_is_failed(
+            self, store, manager):
+        """A trial that prints per-epoch metrics then crashes must be
+        Failed, not Succeeded with a stale intermediate objective; the
+        partial value is kept separately and excluded from bestTrial."""
+        from kubeflow_tpu.controllers.tpuslice import StudyJobReconciler
+        from kubeflow_tpu.core import meta as m2
+        manager.add(StudyJobReconciler())
+        manager.start_sync()
+        study = tsapi.new_study(
+            "crash", "default",
+            objective={"type": "maximize", "metricName": "objective"},
+            parameters=[{"name": "lr", "type": "double",
+                         "min": 0.001, "max": 0.1}],
+            trial_template={"spec": {"containers": [
+                {"name": "t", "image": "x"}]}},
+            max_trials=1, parallelism=1)
+        store.create(study)
+        manager.run_sync()
+        pod = store.get("v1", "Pod", "crash-trial-0", "default")
+        m2.set_annotation(
+            pod, "kubeflow.org/pod-logs",
+            'trial-metric {"name": "objective", "value": 0.9}\n'
+            "Traceback (most recent call last): boom\n")
+        pod.setdefault("status", {})["phase"] = "Failed"
+        store.update(pod)
+        manager.run_sync()
+        cur = store.get("kubeflow.org/v1alpha1", tsapi.STUDY_KIND,
+                        "crash", "default")
+        trial = cur["status"]["trials"][0]
+        assert trial["state"] == "Failed"
+        assert "objectiveValue" not in trial
+        assert trial["partialObjectiveValue"] == 0.9
+        assert "bestTrial" not in cur["status"]
+
     def test_metrics_scraped_from_pod_logs_without_configmap(
             self, store, manager):
         """The reconciler is the metrics collector: no ConfigMap, the
