@@ -19,9 +19,13 @@ Every pod runs this module:
    (re)formation — is exactly steps 1+4.
 
 Deterministic fault injection for tests/e2e: set
-``SLICE_WORKER_FAULT_AT_STEP=<n>`` on one worker and it dies with
-exit code 17 *before* executing step n — the restart path is then
-byte-for-byte the normal resume path.
+``SLICE_WORKER_FAULT_AT_STEP=<n>`` and the worker dies with exit code
+17 *before* executing step n — the restart path is then byte-for-byte
+the normal resume path. ``SLICE_WORKER_FAULT_WORKER=<id>`` scopes the
+fault to one worker (the env is gang-wide when injected via the
+TpuSlice PodDefault), and the fault only fires on a fresh run
+(``resumed`` False), so the controller-restarted gang proceeds past it
+instead of crash-looping.
 
 Run: ``python -m kubeflow_tpu.cmd slice-worker --ckpt-dir ... --steps N``
 """
@@ -108,6 +112,12 @@ def main(argv=None):
             mesh)
 
     fault_at = int(os.environ.get("SLICE_WORKER_FAULT_AT_STEP", "-1"))
+    fault_worker = os.environ.get("SLICE_WORKER_FAULT_WORKER")
+    my_id = int(os.environ.get("TPU_WORKER_ID", pid))
+    if fault_worker is not None and int(fault_worker) != my_id:
+        fault_at = -1
+    if resumed:
+        fault_at = -1   # fault injection targets the fresh run only
     log_f = open(args.log, "a") if args.log else None
 
     def log(**kw):

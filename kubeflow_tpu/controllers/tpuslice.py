@@ -8,10 +8,17 @@ re-home). Design:
 - ``TpuSlice`` → headless Service (stable ``<slice>-<i>.<slice>`` worker
   DNS) + StatefulSet sized to the slice topology + a PodDefault that
   injects TPU_WORKER_* / JAX_COORDINATOR_ADDRESS env through the
-  admission plane. Worker 0 is the JAX coordinator; slice failure
-  handling is level-triggered: a deleted/failed worker pod is recreated
-  by the StatefulSet runtime and rejoins via the same stable address
-  (the "mesh (re)formation" hard part, SURVEY.md §7).
+  admission plane. Worker 0 is the JAX coordinator. Failure handling is
+  the gang-restart control loop (the "mesh (re)formation" hard part,
+  SURVEY.md §7): one dead worker leaves XLA collectives unservicable and
+  a lone restarted pod cannot rejoin a live jax.distributed gang, so on
+  any worker reaching Failed/terminated-nonzero the controller bumps the
+  gang generation, deletes every worker pod, and lets the StatefulSet
+  recreate the gang coherently; the fresh gang resumes from the last
+  durable checkpoint. ``status.restartCount``/``lastRestartReason``
+  track recoveries; ``spec.maxRestarts`` bounds crash loops (the
+  recovery invariant the reference tests for its own resources, odh
+  notebook_controller_test.go:121).
 - ``StudyJob`` → N trial pods fanned out (one per chip by default),
   parameters sampled per spec.algorithm; trial pods report their
   objective in a ``<trial>-metrics`` ConfigMap (the in-cluster metrics-
@@ -27,9 +34,18 @@ import re
 from ..api import builtin, poddefault as pdapi, tpuslice as tsapi
 from ..core import meta as m
 from ..core import reconcilehelper as helper
-from ..core.manager import Reconciler, Result
+from ..core.errors import NotFoundError
+from ..core.manager import EventRecorder, Reconciler, Request, Result
 
 log = logging.getLogger("kubeflow_tpu.controllers.tpuslice")
+
+#: pod-template annotation carrying the gang restart generation — bumping
+#: it (plus deleting the gang's pods) is how the controller restarts the
+#: whole gang coherently; runtimes key the coordinator epoch off it
+GANG_GENERATION = "kubeflow.org/gang-generation"
+
+#: default restart budget before the slice goes terminally Failed
+DEFAULT_MAX_RESTARTS = 5
 
 
 def generate_headless_service(ts):
@@ -41,7 +57,7 @@ def generate_headless_service(ts):
     return svc
 
 
-def generate_statefulset(ts):
+def generate_statefulset(ts, generation=0):
     name, ns = m.name_of(ts), m.namespace_of(ts)
     accelerator = m.deep_get(ts, "spec", "accelerator", default="")
     topology = m.deep_get(ts, "spec", "topology", default="2x2")
@@ -72,7 +88,39 @@ def generate_statefulset(ts):
         template_labels=template_labels,
         pod_spec=pod_spec)
     sts["spec"]["serviceName"] = name
+    sts["spec"]["template"]["metadata"]["annotations"] = {
+        GANG_GENERATION: str(generation)}
     return sts
+
+
+def worker_failure(pod):
+    """Reason string if the worker pod is dead (gang-fatally), else None.
+
+    Phase Failed covers restartPolicy=Never exits; for the
+    restartPolicy=Always shape the kubelet cycles the crash through
+    state.terminated → state.waiting(CrashLoopBackOff) with the exit
+    in lastState.terminated — all three are checked so the detection
+    window isn't the brief terminated state."""
+    if m.deep_get(pod, "status", "phase") == "Failed":
+        statuses = m.deep_get(pod, "status", "containerStatuses",
+                              default=[]) or []
+        for cs in statuses:
+            code = m.deep_get(cs, "state", "terminated", "exitCode")
+            if code is not None:
+                return f"worker {m.name_of(pod)} exited {code}"
+        return f"worker {m.name_of(pod)} failed"
+    for cs in m.deep_get(pod, "status", "containerStatuses",
+                         default=[]) or []:
+        code = m.deep_get(cs, "state", "terminated", "exitCode")
+        if code not in (None, 0):
+            return f"worker {m.name_of(pod)} exited {code}"
+        last = m.deep_get(cs, "lastState", "terminated", "exitCode")
+        if last not in (None, 0):
+            return f"worker {m.name_of(pod)} exited {last}"
+        if m.deep_get(cs, "state", "waiting", "reason") == \
+                "CrashLoopBackOff":
+            return f"worker {m.name_of(pod)} crash-looping"
+    return None
 
 
 class TpuSliceReconciler(Reconciler):
@@ -80,9 +128,23 @@ class TpuSliceReconciler(Reconciler):
     API = f"{tsapi.GROUP}/{tsapi.VERSION}"
 
     def setup(self, builder):
+        self.recorder = EventRecorder(self.store, self.name)
         builder.watch_for(self.API, tsapi.SLICE_KIND)
         builder.watch_owned("apps/v1", "StatefulSet", tsapi.SLICE_KIND)
-        builder.watch_owned("v1", "Pod", tsapi.SLICE_KIND)
+        # worker pods are owned by the StatefulSet, not the slice — map
+        # them by gang label so a dying worker wakes this reconciler
+        # directly (the failure-detection path must not depend on the
+        # STS status mirror changing)
+        builder.watch_mapped("v1", "Pod", self._map_gang_pod)
+
+    def _map_gang_pod(self, ev):
+        gang = m.labels_of(ev.object).get("tpu-slice")
+        if gang:
+            yield Request(gang, m.namespace_of(ev.object))
+
+    def _gang_pods(self, name, namespace):
+        return self.store.list("v1", "Pod", namespace,
+                               label_selector={"tpu-slice": name})
 
     def reconcile(self, req):
         ts = self.store.try_get(self.API, tsapi.SLICE_KIND, req.name,
@@ -96,6 +158,55 @@ class TpuSliceReconciler(Reconciler):
         chips_per_host = tsapi.ACCELERATOR_HOSTS.get(
             accelerator, (4, None))[0]
 
+        old_status = dict(ts.get("status") or {})
+        restart_count = int(old_status.get("restartCount") or 0)
+        last_reason = old_status.get("lastRestartReason")
+        max_restarts = m.deep_get(ts, "spec", "maxRestarts",
+                                  default=DEFAULT_MAX_RESTARTS)
+
+        # ---- gang failure detection (SURVEY §5 slice-failure row).
+        # One dead worker wedges XLA collectives for the whole slice: a
+        # restarted pod alone cannot rejoin a live jax.distributed gang,
+        # so the unit of recovery is the gang — bump the generation and
+        # delete every worker pod; the StatefulSet recreates them
+        # coherently and the fresh gang resumes from the last durable
+        # checkpoint (compute/slice_worker.py).
+        pods = self._gang_pods(req.name, req.namespace)
+        succeeded = [p for p in pods
+                     if m.deep_get(p, "status", "phase") == "Succeeded"]
+        # failure detection only considers the CURRENT generation's live
+        # pods: a deleted-but-lingering pod (finalizer / graceful
+        # apiserver deletion) or a leftover from a prior generation must
+        # not re-count the same crash on every reconcile
+        current = [
+            p for p in pods
+            if not m.deep_get(p, "metadata", "deletionTimestamp")
+            and m.annotations_of(p).get(GANG_GENERATION, "0")
+            == str(restart_count)]
+        failures = [r for r in (worker_failure(p) for p in current) if r]
+        gang_done = len(succeeded) >= workers
+        # Succeeded latches like Failed: a terminal slice must not
+        # re-run its workload because a finished pod was cleaned up
+        if old_status.get("phase") == "Succeeded":
+            gang_done = True
+        restarting = terminal_failure = False
+        if failures and not gang_done and old_status.get("phase") != "Failed":
+            if max_restarts is not None and restart_count >= \
+                    int(max_restarts):
+                terminal_failure = True
+                last_reason = (f"{failures[0]}; restart limit "
+                               f"({max_restarts}) exceeded")
+                self.recorder.event(ts, "Warning", "RestartLimitExceeded",
+                                    last_reason)
+            else:
+                restarting = True
+                restart_count += 1
+                last_reason = failures[0]
+                self.recorder.event(
+                    ts, "Warning", "GangRestart",
+                    f"{last_reason}; restarting gang "
+                    f"(generation {restart_count})")
+
         # PodDefault must exist before pods are admitted
         pd = pdapi.tpu_worker_pod_default(
             req.namespace, req.name, workers,
@@ -107,28 +218,50 @@ class TpuSliceReconciler(Reconciler):
         m.set_controller_reference(svc, ts)
         helper.service(self.store, svc)
 
-        sts = generate_statefulset(ts)
+        sts = generate_statefulset(ts, generation=restart_count)
         m.set_controller_reference(sts, ts)
         live = helper.statefulset(self.store, sts)
 
+        if restarting:
+            # delete the whole gang — stragglers included: a worker
+            # blocked in a collective never exits on its own
+            for p in pods:
+                try:
+                    self.store.delete("v1", "Pod", m.name_of(p),
+                                      req.namespace)
+                except NotFoundError:
+                    pass
+
         ready = int(m.deep_get(live, "status", "readyReplicas",
                                default=0) or 0)
-        phase = "Running" if ready >= workers else "Pending"
+        if gang_done:
+            phase = "Succeeded"
+        elif terminal_failure or old_status.get("phase") == "Failed":
+            phase = "Failed"
+        elif restarting:
+            phase = "Restarting"
+        elif ready >= workers:
+            phase = "Running"
+        else:
+            phase = "Pending"
         status = {
             "readyWorkers": ready,
             "workers": workers,
             "phase": phase,
+            "restartCount": restart_count,
             "conditions": [{
                 "type": "Ready",
                 "status": "True" if phase == "Running" else "False",
                 "lastTransitionTime": m.now_iso(),
             }],
         }
-        old_status = dict(ts.get("status") or {})
-        old_status.pop("conditions", None)
+        if last_reason:
+            status["lastRestartReason"] = last_reason
+        old_cmp = dict(old_status)
+        old_cmp.pop("conditions", None)
         new_cmp = dict(status)
         new_cmp.pop("conditions", None)
-        if new_cmp != old_status:
+        if new_cmp != old_cmp:
             ts["status"] = status
             self.store.update_status(ts)
         return Result()
@@ -269,7 +402,6 @@ class StudyJobReconciler(Reconciler):
         builder.watch_mapped("v1", "ConfigMap", self._map_metrics_cm)
 
     def _map_metrics_cm(self, ev):
-        from ..core.manager import Request
         name = m.name_of(ev.object)
         if not name.endswith("-metrics"):
             return

@@ -55,9 +55,12 @@ class StatefulSetReconciler(Reconciler):
             labels = dict(m.deep_get(template, "metadata", "labels",
                                      default={}) or {})
             labels[POD_INDEX_LABEL] = str(i)
+            annotations = dict(m.deep_get(template, "metadata",
+                                          "annotations", default={}) or {})
             pod = builtin.pod(pod_name, req.namespace,
                               m.deep_copy(template.get("spec") or {}),
-                              labels=labels)
+                              labels=labels,
+                              annotations=annotations or None)
             pod["spec"]["hostname"] = pod_name
             pod["spec"]["subdomain"] = req.name
             m.set_controller_reference(pod, sts)
@@ -116,9 +119,12 @@ class DeploymentReconciler(Reconciler):
             labels = dict(m.deep_get(template, "metadata", "labels",
                                      default={}) or {})
             labels[POD_INDEX_LABEL] = str(i)
+            annotations = dict(m.deep_get(template, "metadata",
+                                          "annotations", default={}) or {})
             pod = builtin.pod(pod_name, req.namespace,
                               m.deep_copy(template.get("spec") or {}),
-                              labels=labels)
+                              labels=labels,
+                              annotations=annotations or None)
             m.set_controller_reference(pod, dep)
             self.store.create(pod)
 

@@ -44,6 +44,13 @@ def copy_statefulset_fields(desired, live):
     if want_tpl != have_tpl:
         changed = True
     m.deep_set(live, m.deep_copy(want_tpl), "spec", "template", "spec")
+    # pod-template metadata too: gang-generation and other controller-
+    # owned template annotations must reach recreated pods
+    want_md = m.deep_get(desired, "spec", "template", "metadata") or {}
+    have_md = m.deep_get(live, "spec", "template", "metadata") or {}
+    if want_md != have_md:
+        changed = True
+    m.deep_set(live, m.deep_copy(want_md), "spec", "template", "metadata")
     return changed
 
 

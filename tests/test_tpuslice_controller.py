@@ -88,6 +88,87 @@ class TestTpuSlice:
         assert store.get("apps/v1", "StatefulSet", "tiny",
                          "default")["spec"]["replicas"] == 1
 
+    def _fail_pod(self, store, name, exit_code=17):
+        pod = store.get("v1", "Pod", name, "default")
+        pod["status"] = {
+            "phase": "Failed",
+            "containerStatuses": [{
+                "name": "worker", "ready": False, "restartCount": 0,
+                "state": {"terminated": {"exitCode": exit_code}}}]}
+        store.update(pod)
+        return pod
+
+    def test_gang_restart_on_worker_failure(self, store, manager):
+        """A Failed worker restarts the WHOLE gang (VERDICT r2 #1): all
+        pods replaced (fresh uids + bumped generation annotation),
+        restartCount/lastRestartReason tracked, event emitted."""
+        slice_manager(store, manager)
+        store.create(make_slice("s1", topology="4x4"))
+        manager.run_sync()
+        old_uids = {p["metadata"]["name"]: p["metadata"]["uid"]
+                    for p in store.list("v1", "Pod", "default",
+                                        label_selector={"tpu-slice": "s1"})}
+        assert len(old_uids) == 4
+        self._fail_pod(store, "s1-2", exit_code=17)
+        manager.run_sync()
+
+        pods = store.list("v1", "Pod", "default",
+                          label_selector={"tpu-slice": "s1"})
+        assert len(pods) == 4
+        for p in pods:
+            # every gang pod was replaced, not just the failed one
+            assert p["metadata"]["uid"] != old_uids[p["metadata"]["name"]]
+            assert p["metadata"]["annotations"][
+                "kubeflow.org/gang-generation"] == "1"
+            assert p["status"]["phase"] == "Running"
+
+        ts = store.get("kubeflow.org/v1alpha1", "TpuSlice", "s1",
+                       "default")
+        assert ts["status"]["restartCount"] == 1
+        assert "s1-2 exited 17" in ts["status"]["lastRestartReason"]
+        assert ts["status"]["phase"] == "Running"
+        events = [e for e in store.list("v1", "Event", "default")
+                  if e.get("reason") == "GangRestart"]
+        assert events and "s1-2 exited 17" in events[0]["message"]
+
+    def test_restart_limit_makes_slice_terminally_failed(
+            self, store, manager):
+        slice_manager(store, manager)
+        ts = make_slice("crashy", topology="4x2")
+        ts["spec"]["maxRestarts"] = 1
+        store.create(ts)
+        manager.run_sync()
+        self._fail_pod(store, "crashy-1")
+        manager.run_sync()
+        assert store.get("kubeflow.org/v1alpha1", "TpuSlice", "crashy",
+                         "default")["status"]["restartCount"] == 1
+        self._fail_pod(store, "crashy-1")
+        manager.run_sync()
+        cur = store.get("kubeflow.org/v1alpha1", "TpuSlice", "crashy",
+                        "default")
+        assert cur["status"]["phase"] == "Failed"
+        assert cur["status"]["restartCount"] == 1
+        assert "restart limit" in cur["status"]["lastRestartReason"]
+        # the failed pod is left in place as evidence, not restarted
+        assert store.get("v1", "Pod", "crashy-1",
+                         "default")["status"]["phase"] == "Failed"
+
+    def test_all_workers_succeeded_is_terminal_success(
+            self, store, manager):
+        slice_manager(store, manager)
+        store.create(make_slice("done", topology="2x2"))
+        manager.run_sync()
+        pod = store.get("v1", "Pod", "done-0", "default")
+        pod["status"] = {"phase": "Succeeded", "containerStatuses": [
+            {"name": "worker", "ready": False, "restartCount": 0,
+             "state": {"terminated": {"exitCode": 0}}}]}
+        store.update(pod)
+        manager.run_sync()
+        cur = store.get("kubeflow.org/v1alpha1", "TpuSlice", "done",
+                        "default")
+        assert cur["status"]["phase"] == "Succeeded"
+        assert cur["status"]["restartCount"] == 0
+
 
 class TestSampling:
     def test_deterministic(self):
