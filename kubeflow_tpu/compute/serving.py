@@ -252,17 +252,28 @@ class ModelServer:
                     return self._send(404, {"error": "model not found"})
                 if verb != "predict":
                     return self._send(400, {"error": f"verb {verb}"})
+                # 400 = the caller's fault (malformed body); 500 = ours
+                # (inference failed) — clients like the reference's
+                # test_tf_serving retry loop key off the distinction
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length) or b"{}")
                     instances = req["instances"]
+                except (ValueError, KeyError, TypeError) as e:
+                    return self._send(400, {"error": f"bad request: {e}"})
+                try:
                     predictions, infer = model.predict_timed(instances)
-                    # device-time breakdown (harmless extension header:
-                    # JSON transport dominates at image sizes)
-                    self._send(200, {"predictions": predictions},
-                               (("X-Inference-Time-Ms", f"{infer:.1f}"),))
+                except ValueError as e:     # scalar/ragged instances
+                    return self._send(400, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 — wire boundary
-                    self._send(400, {"error": str(e)})
+                    return self._send(500,
+                                      {"error": f"inference failed: {e}"})
+                # success write OUTSIDE the try: a client reset mid-body
+                # must not trigger a second (500) response on the wire
+                # (device-time header: JSON transport dominates at image
+                # sizes, the breakdown keeps that visible)
+                self._send(200, {"predictions": predictions},
+                           (("X-Inference-Time-Ms", f"{infer:.1f}"),))
 
         return Handler
 

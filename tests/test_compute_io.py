@@ -146,6 +146,34 @@ class TestServing:
                 urllib.request.urlopen(
                     f"http://127.0.0.1:{port}/v1/models/nope")
             assert e.value.code == 404
+
+            # malformed body is the caller's fault -> 400
+            bad = urllib.request.Request(
+                url + ":predict", data=b"{not json",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(bad)
+            assert e.value.code == 400
+        finally:
+            server.stop()
+
+    def test_inference_failure_is_500_not_400(self):
+        # clients (and the bench retry loop) key off 4xx-vs-5xx: a
+        # device-side failure must not masquerade as a client error
+        def boom(x):
+            raise RuntimeError("device fell over")
+        server = serving.ModelServer()
+        server.register("m", boom)
+        port = server.start(port=0, host="127.0.0.1")
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/m:predict",
+                data=json.dumps({"instances": [[1.0]]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 500
+            assert "inference failed" in e.value.read().decode()
         finally:
             server.stop()
 
