@@ -244,6 +244,13 @@ def bench_serving(steps, batch):
             except urllib.error.HTTPError as e:
                 body = e.read().decode(errors="replace")[:300]
                 err = f"HTTP {e.code} {body}"
+                if e.code < 500 and e.code not in (408, 429):
+                    # caller fault per the serving taxonomy
+                    # (compute/serving.py: 400 = malformed request) —
+                    # deterministic, retrying can't help; 408/429 are
+                    # transient and stay in the retry loop
+                    raise RuntimeError(f"predict rejected: {err}") \
+                        from None
             except OSError as e:    # URLError/reset/timeout transients
                 err = f"{type(e).__name__}: {e}"
             print(f"bench: serving predict attempt {attempt + 1} "
@@ -290,7 +297,12 @@ def bench_serving(steps, batch):
 
 def bench_study(steps, batch):
     """BASELINE config #4: StudyJob trial throughput, one trial per chip
-    (this host has one chip; trials/hr scales linearly per chip)."""
+    (this host has one chip; trials/hr scales linearly per chip).
+
+    The per-chip extrapolation is a controller guarantee, not an
+    assumption: every trial pod carries an exclusive ``google.com/tpu``
+    limit (controllers/tpuslice.py apply_trial_placement), so parallel
+    trials can never timeshare a chip."""
     from kubeflow_tpu.compute import trial as trial_lib
 
     import contextlib

@@ -60,21 +60,30 @@ def new_slice(name, namespace, accelerator, topology, pod_spec,
 
 def new_study(name, namespace, objective, parameters, trial_template,
               max_trials=10, parallelism=None, algorithm="random",
-              seed=0):
+              seed=0, accelerator=None, chips_per_trial=None):
     """parameters: list of {name, type: double|int|categorical, min, max,
     values}; trial_template: pod spec template whose container args may use
-    ``{{param}}`` placeholders (katib_studyjob_test.py idiom)."""
+    ``{{param}}`` placeholders (katib_studyjob_test.py idiom).
+
+    ``chips_per_trial`` (default 1, applied by the controller) sizes the
+    exclusive ``google.com/tpu`` limit injected into each trial pod;
+    ``accelerator`` pins trials to hosts of that slice type."""
+    spec = {
+        "objective": objective,      # {type: maximize|minimize, metricName}
+        "algorithm": {"name": algorithm, "seed": seed},
+        "parameters": list(parameters),
+        "trialTemplate": trial_template,
+        "maxTrialCount": max_trials,
+        "parallelTrialCount": parallelism or max_trials,
+    }
+    if accelerator is not None:
+        spec["accelerator"] = accelerator
+    if chips_per_trial is not None:
+        spec["chipsPerTrial"] = chips_per_trial
     return {
         "apiVersion": f"{GROUP}/{VERSION}", "kind": STUDY_KIND,
         "metadata": {"name": name, "namespace": namespace},
-        "spec": {
-            "objective": objective,      # {type: maximize|minimize, metricName}
-            "algorithm": {"name": algorithm, "seed": seed},
-            "parameters": list(parameters),
-            "trialTemplate": trial_template,
-            "maxTrialCount": max_trials,
-            "parallelTrialCount": parallelism or max_trials,
-        },
+        "spec": spec,
         "status": {"conditions": [], "trials": [], "phase": "Created",
                    "completedTrials": 0},
     }

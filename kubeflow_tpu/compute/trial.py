@@ -37,12 +37,21 @@ def params(defaults=None):
     return out
 
 
-def report(value, name=None, extra=None):
+def report(value, name=None, extra=None, step=None):
+    """Report the objective. With ``step`` this is an INTERMEDIATE
+    report (per-epoch progress): it goes to stdout only and feeds the
+    early-stopping service (controllers/hpo.py medianstop) — the
+    collector never mistakes it for the final objective. Without
+    ``step`` it is the final report, written to METRICS_PATH too."""
     name = name or os.environ.get("TRIAL_OBJECTIVE_NAME", "objective")
     payload = {"name": name, "value": float(value)}
+    if step is not None:
+        payload["step"] = int(step)
     if extra:
         payload["extra"] = {k: float(v) for k, v in extra.items()}
     print(METRIC_LINE_PREFIX + json.dumps(payload), flush=True)
+    if step is not None:
+        return payload
     path = os.environ.get("METRICS_PATH", "/tmp/trial-metrics.json")
     try:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

@@ -1,0 +1,149 @@
+"""Model-based HPO: TPE suggester + median early stopping.
+
+The reference delegates HPO to Katib (testing/katib_studyjob_test.py is
+the CR-shape spec); Katib's suggestion services include TPE and its
+early-stopping service ships medianstop. This module re-homes both on
+the StudyJob algorithm seam (controllers/tpuslice.py
+sample_parameters / StudyJobReconciler):
+
+- ``tpe_sample``: Tree-structured Parzen Estimator (Bergstra et al.
+  2011). Completed trials are split into a good set (top ``GAMMA``
+  quantile by objective) and a bad set; per parameter, both sets are
+  modeled as Parzen mixtures in unit space and the candidate maximizing
+  l(u)/g(u) — density under good over density under bad — is chosen.
+  Deterministic: the RNG is seeded from (seed, trial_index), so a
+  reconciler replay proposes the same trial.
+- ``median_should_stop``: Katib medianstop — a running trial whose
+  best-so-far intermediate objective is worse than the median of its
+  peers' best objectives at the same step is stopped early.
+
+Everything works in unit space [0,1]; the caller supplies the
+parameter-space mapping (``value_at``) so double/int/log-scale and
+categorical domains stay defined in one place (tpuslice._param_value_at).
+"""
+
+import hashlib
+import math
+import statistics
+
+import numpy as np
+
+__all__ = ["tpe_sample", "median_should_stop", "N_STARTUP"]
+
+#: trials sampled space-fillingly before the model kicks in
+N_STARTUP = 5
+#: candidates drawn from the good-set mixture per parameter
+N_CANDIDATES = 24
+#: fraction of observations forming the good set
+GAMMA = 0.25
+
+
+def _rng(seed, trial_index):
+    h = hashlib.sha256(f"tpe:{seed}:{trial_index}".encode()).digest()
+    return np.random.default_rng(int.from_bytes(h[:8], "big"))
+
+
+def _bandwidth(points):
+    n = max(len(points), 1)
+    spread = float(np.std(points)) if len(points) > 1 else 0.0
+    return max(0.05, spread * n ** -0.2)
+
+
+def _mixture_density(u, points, sigma):
+    """Parzen mixture of Gaussians at ``points`` + one uniform prior
+    component (keeps the ratio finite where a set has no mass)."""
+    total = 1.0     # uniform component, density 1 on [0,1]
+    inv = 1.0 / (sigma * math.sqrt(2.0 * math.pi))
+    for x in points:
+        total += math.exp(-0.5 * ((u - x) / sigma) ** 2) * inv
+    return total / (len(points) + 1)
+
+
+def _tpe_unit(rng, good, bad):
+    sigma_g, sigma_b = _bandwidth(good), _bandwidth(bad)
+    candidates = []
+    for _ in range(N_CANDIDATES):
+        mu = good[int(rng.integers(len(good)))]
+        candidates.append(float(np.clip(rng.normal(mu, sigma_g), 0.0, 1.0)))
+    return max(candidates, key=lambda u:
+               _mixture_density(u, good, sigma_g)
+               / _mixture_density(u, bad, sigma_b))
+
+
+def _tpe_categorical_unit(rng, n_choices, good_idx, bad_idx):
+    def smoothed(idxs):
+        counts = np.ones(n_choices)     # +1 prior per choice
+        for i in idxs:
+            counts[int(i)] += 1
+        return counts / counts.sum()
+
+    p_good, p_bad = smoothed(good_idx), smoothed(bad_idx)
+    draws = rng.choice(n_choices, size=min(N_CANDIDATES, 4 * n_choices),
+                       p=p_good)
+    best = max({int(d) for d in draws},
+               key=lambda i: p_good[i] / p_bad[i])
+    return (best + 0.5) / n_choices
+
+
+def tpe_sample(parameters, trial_index, seed, history, maximize,
+               value_at, unit_of):
+    """One TPE proposal. ``history``: [(values_dict, objective)] of
+    completed trials; ``value_at(p, u)`` maps unit space to the
+    parameter domain and ``unit_of(p, value)`` is its inverse — both
+    live in tpuslice.py so forward and inverse domain mappings cannot
+    drift apart. Caller handles the startup phase (history shorter than
+    ``N_STARTUP``) with a space-filling sampler."""
+    obs = [(v, o) for v, o in history if o is not None]
+    obs.sort(key=lambda x: x[1], reverse=maximize)
+    n_good = max(1, math.ceil(GAMMA * len(obs)))
+    good_obs, bad_obs = obs[:n_good], obs[n_good:] or obs[:n_good]
+
+    rng = _rng(seed, trial_index)
+    values = {}
+    for p in parameters:
+        name = p["name"]
+        good = [unit_of(p, v[name]) for v, _ in good_obs if name in v]
+        bad = [unit_of(p, v[name]) for v, _ in bad_obs if name in v]
+        if not good:
+            u = float(rng.uniform())
+        elif p.get("type", "double") == "categorical":
+            choices = p.get("values") or [""]
+            u = _tpe_categorical_unit(
+                rng, len(choices),
+                [int(g * len(choices)) for g in good],
+                [int(b * len(choices)) for b in bad] or
+                [int(g * len(choices)) for g in good])
+        else:
+            u = _tpe_unit(rng, good, bad or good)
+        values[name] = value_at(p, u)
+    return values
+
+
+# ------------------------------------------------------------ medianstop
+
+def median_should_stop(reports, peer_reports, maximize,
+                       start_step=1, min_peers=2):
+    """Katib medianstop: stop the candidate if its best-so-far
+    intermediate objective is worse than the median of peers' best
+    objectives at (or before) the candidate's current step.
+
+    ``reports``: the candidate's [(step, value)]; ``peer_reports``: one
+    such list per peer trial. Trials report on a shared step schedule
+    (compute/trial.py report(step=)), so comparing at step <= current
+    is well-defined."""
+    if not reports:
+        return False
+    step = max(s for s, _ in reports)
+    if step < start_step:
+        return False
+    peers = []
+    for ph in peer_reports:
+        vals = [v for s, v in (ph or []) if s <= step]
+        if vals:
+            peers.append(max(vals) if maximize else min(vals))
+    if len(peers) < min_peers:
+        return False
+    med = statistics.median(peers)
+    best = max(v for _, v in reports) if maximize else \
+        min(v for _, v in reports)
+    return best < med if maximize else best > med

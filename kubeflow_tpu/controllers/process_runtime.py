@@ -17,8 +17,12 @@ Kubelet behaviors implemented for real:
 - ``$(VAR)`` expansion in command/args from the container env,
 - terminal phases Succeeded/Failed with ``terminated.exitCode``,
 - pod logs: child stdout/stderr captured per pod and published in the
-  ``kubeflow.org/pod-logs`` annotation on exit (the in-process log
-  contract the StudyJob metrics scraper reads).
+  ``kubeflow.org/pod-logs`` annotation — live while the child runs for
+  pods carrying ``live_logs_label`` (StudyJob trials: early stopping
+  sees intermediate ``trial-metric`` reports mid-flight; gang workers
+  are excluded so hours-long runs don't churn the store) and finally
+  on exit for everyone (the in-process log contract the StudyJob
+  metrics scraper reads).
 
 Gang coordinator mapping: cluster pods reach worker 0 via the headless
 Service DNS; local subprocesses can't, so the runtime rewrites
@@ -105,10 +109,14 @@ class ProcessPodRuntime(Reconciler):
     name = "process-pod-runtime"
 
     def __init__(self, gang_label="tpu-slice", workdir=".",
-                 extra_env=None):
+                 extra_env=None, live_logs_label="studyjob"):
         self.gang_label = gang_label
         self.workdir = workdir
         self.extra_env = dict(extra_env or {})
+        #: live log mirroring is gated to pods carrying this label —
+        #: StudyJob trials need the mid-flight feed (early stopping);
+        #: long-running gang workers must not churn the store at 2 Hz
+        self.live_logs_label = live_logs_label
         self._lock = threading.RLock()   # _spawn→_gang_port re-enters
         self._children = {}     # (ns, name) -> record
         self._gang_ports = {}   # (ns, gang, generation) -> port
@@ -168,13 +176,7 @@ class ProcessPodRuntime(Reconciler):
 
     def _reap(self, record):
         rc = record["proc"].wait()
-        try:
-            with open(record["log_path"], "rb") as f:
-                f.seek(0, os.SEEK_END)
-                f.seek(max(0, f.tell() - LOG_TAIL_BYTES))
-                logs = f.read().decode(errors="replace")
-        except OSError:
-            logs = ""
+        logs = self._log_tail(record)
         now = m.now_iso()
         for _ in range(5):
             try:
@@ -183,6 +185,8 @@ class ProcessPodRuntime(Reconciler):
                 if pod is None or m.uid_of(pod) != record["uid"]:
                     return  # pod was deleted/replaced; nothing to mirror
                 m.set_annotation(pod, "kubeflow.org/pod-logs", logs)
+                m.annotations_of(pod).pop(
+                    "kubeflow.org/pod-logs-partial", None)
                 container = (m.deep_get(pod, "spec", "containers",
                                         default=[{}]) or [{}])[0]
                 pod["status"] = {
@@ -206,6 +210,36 @@ class ProcessPodRuntime(Reconciler):
                  rc)
 
     # -------------------------------------------------------- reconcile
+
+    def _log_tail(self, record):
+        try:
+            with open(record["log_path"], "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - LOG_TAIL_BYTES))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    def _publish_live_logs(self, pod, record):
+        """Mirror the running child's log tail into the pod-logs
+        annotation so intermediate ``trial-metric`` reports reach the
+        StudyJob early-stopping loop before the process exits (a real
+        kubelet serves running-pod logs; this is the in-process
+        equivalent). Conflicts are skipped — the requeue retries."""
+        logs = self._log_tail(record)
+        if not logs or logs == m.annotations_of(pod).get(
+                "kubeflow.org/pod-logs"):
+            return
+        m.set_annotation(pod, "kubeflow.org/pod-logs", logs)
+        # a live tail is PARTIAL: the scraper must not take a step-less
+        # metric line as the trial's final objective while the process
+        # still runs (it may flush the line, then tear down holding the
+        # chip) — _reap clears the marker when the logs become final
+        m.set_annotation(pod, "kubeflow.org/pod-logs-partial", "true")
+        try:
+            self.store.update(pod)
+        except (ConflictError, NotFoundError, ApiError):
+            pass
 
     def reconcile(self, req):
         pod = self.store.try_get("v1", "Pod", req.name, req.namespace)
@@ -233,12 +267,16 @@ class ProcessPodRuntime(Reconciler):
                 pod["status"] = {"phase": "Running", "podIP": "127.0.0.1"}
                 self.store.update_status(pod)
                 try:
-                    self._spawn(pod)
+                    record = self._spawn(pod)
                 except Exception as e:  # noqa: BLE001 — exec failure
                     log.warning("spawn of %s/%s failed: %s",
                                 req.namespace, req.name, e)
                     pod["status"] = {"phase": "Failed", "message": str(e)}
                     self.store.update_status(pod)
+            if record is not None and record["proc"].poll() is None \
+                    and self.live_logs_label in m.labels_of(pod):
+                self._publish_live_logs(pod, record)
+                return Result(requeue_after=0.5)
         return Result()
 
     def close(self):

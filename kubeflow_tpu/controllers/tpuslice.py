@@ -302,6 +302,32 @@ def _param_value_at(p, u):
     raise ValueError(f"unknown parameter type {ptype!r}")
 
 
+def _param_unit_of(p, value):
+    """Inverse of ``_param_value_at``: parameter value -> u∈[0,1].
+    Ints/categoricals map to their bucket midpoint so the forward map
+    round-trips. Kept adjacent to the forward map on purpose — a new
+    type or scale must land in both or TPE fits garbage densities."""
+    import math
+    ptype = p.get("type", "double")
+    if ptype == "double":
+        lo, hi = float(p.get("min", 0)), float(p.get("max", 1))
+        if p.get("scale") == "log":
+            lo, hi, value = math.log(lo), math.log(hi), math.log(value)
+        return 0.0 if hi == lo else min(1.0, max(
+            0.0, (value - lo) / (hi - lo)))
+    if ptype == "int":
+        lo, hi = int(p.get("min", 0)), int(p.get("max", 1))
+        return (int(value) - lo + 0.5) / (hi - lo + 1)
+    if ptype == "categorical":
+        choices = p.get("values") or [""]
+        try:
+            idx = choices.index(value)
+        except ValueError:
+            idx = 0
+        return (idx + 0.5) / len(choices)
+    raise ValueError(f"unknown parameter type {ptype!r}")
+
+
 def grid_size(parameters):
     size = 1
     for p in parameters:
@@ -323,7 +349,7 @@ def _halton(index, base):
 
 
 def sample_parameters(parameters, trial_index, seed=0,
-                      algorithm="random"):
+                      algorithm="random", history=None, maximize=True):
     """Deterministic per-trial parameter assignment.
 
     - ``random`` (default): seeded hash sampling — reproducible sweeps
@@ -335,9 +361,22 @@ def sample_parameters(parameters, trial_index, seed=0,
     - ``halton``: low-discrepancy quasi-random sweep (one prime base
       per parameter dimension, seed offsets the sequence) — better
       space coverage than random at small trial counts.
+    - ``tpe``: model-based (Tree-structured Parzen Estimator,
+      controllers/hpo.py — Katib's TPE suggestion service re-homed).
+      ``history`` is [(values, objective)] of completed trials; the
+      first ``hpo.N_STARTUP`` trials fall back to halton for
+      space-filling startup.
     """
     import hashlib
     values = {}
+    if algorithm == "tpe":
+        from . import hpo
+        done = [(v, o) for v, o in (history or []) if o is not None]
+        if len(done) < hpo.N_STARTUP:
+            return sample_parameters(parameters, trial_index, seed,
+                                     "halton")
+        return hpo.tpe_sample(parameters, trial_index, seed, done,
+                              maximize, _param_value_at, _param_unit_of)
     if algorithm == "halton":
         for j, p in enumerate(parameters):
             base = _HALTON_PRIMES[j % len(_HALTON_PRIMES)]
@@ -366,13 +405,98 @@ def sample_parameters(parameters, trial_index, seed=0,
         return values
     if algorithm != "random":
         raise ValueError(f"unknown algorithm {algorithm!r}; "
-                         f"expected random, grid, or halton")
+                         f"expected random, grid, halton, or tpe")
     for p in parameters:
         h = hashlib.sha256(
             f"{seed}:{trial_index}:{p['name']}".encode()).digest()
         u = int.from_bytes(h[:8], "big") / float(1 << 64)
         values[p["name"]] = _param_value_at(p, u)
     return values
+
+
+def merge_reports(stored, scraped):
+    """Merge freshly scraped intermediate reports into the stored
+    history (scraped wins per step). The scrape only sees a bounded log
+    tail — once early metric lines rotate out of the tail, the stored
+    low-step values are the only copy, and medianstop's ``s <= step``
+    peer filter needs them."""
+    by_step = {s: v for s, v in (stored or [])}
+    by_step.update({s: v for s, v in scraped})
+    return [[s, by_step[s]] for s in sorted(by_step)]
+
+
+def thin_reports(reports, cap=20):
+    """Bound a trial's intermediate-report history to ~``cap`` entries
+    by striding across the WHOLE step range (always keeping the last).
+
+    A plain tail would starve medianstop for late-starting trials:
+    established peers would retain no low-step values, the
+    ``s <= step`` peer filter would come up empty, and a fresh loser
+    would burn its chip unjudged until it caught up to the peers'
+    retained window."""
+    if len(reports) <= cap:
+        return reports
+    stride = -(-len(reports) // cap)
+    thinned = reports[::stride]
+    if thinned[-1] != reports[-1]:
+        thinned.append(reports[-1])
+    return thinned
+
+
+def apply_trial_placement(pod_spec, spec, study_name):
+    """Enforce exclusive chip placement on a trial pod spec.
+
+    The bench's trials/hr-per-chip extrapolation assumes trials never
+    timeshare a chip; the spec-level guarantee is the ``google.com/tpu``
+    device-plugin limit — chips are allocated exclusively, so two pods
+    can never be handed the same chip. The controller injects:
+
+    - ``google.com/tpu: <spec.chipsPerTrial>`` (default 1) into the
+      first container unless the template already declares a TPU limit;
+    - accelerator/topology nodeSelector when ``spec.accelerator`` is
+      set, so trials land on hosts of the declared slice type;
+    - a required podAntiAffinity against sibling trials for whole-host
+      trials (chipsPerTrial >= chips per host), making host exclusivity
+      visible to the scheduler even where the device plugin is opaque.
+
+    Template-declared values always win (setdefault semantics), matching
+    the reference Katib contract that the trial template is user-owned
+    (testing/katib_studyjob_test.py:39-43).
+    """
+    chips = int(spec.get("chipsPerTrial", 1) or 1)
+    accelerator = spec.get("accelerator", "")
+    chips_per_host, host_topology = tsapi.ACCELERATOR_HOSTS.get(
+        accelerator, (4, None))
+    containers = pod_spec.setdefault("containers", [])
+    if not containers:
+        containers.append({})
+    # template wins if ANY container already claims TPU chips (the trial
+    # container need not be listed first — sidecars commonly are)
+    declared = any(
+        m.deep_get(c, "resources", "limits", "google.com/tpu") is not None
+        for c in containers)
+    if not declared:
+        containers[0].setdefault("resources", {}).setdefault(
+            "limits", {})["google.com/tpu"] = str(chips)
+    if accelerator:
+        selector = pod_spec.setdefault("nodeSelector", {})
+        selector.setdefault("cloud.google.com/gke-tpu-accelerator",
+                            accelerator)
+        if host_topology:
+            selector.setdefault("cloud.google.com/gke-tpu-topology",
+                                host_topology)
+    if chips >= chips_per_host:
+        anti = pod_spec.setdefault("affinity", {}).setdefault(
+            "podAntiAffinity", {})
+        rules = anti.setdefault(
+            "requiredDuringSchedulingIgnoredDuringExecution", [])
+        if not any(m.deep_get(r, "labelSelector", "matchLabels",
+                              "studyjob") == study_name for r in rules):
+            rules.append({
+                "labelSelector": {"matchLabels": {"studyjob": study_name}},
+                "topologyKey": "kubernetes.io/hostname",
+            })
+    return pod_spec
 
 
 def render_template(template, values):
@@ -417,46 +541,80 @@ class StudyJobReconciler(Reconciler):
     def _trial_name(self, study_name, i):
         return f"{study_name}-trial-{i}"
 
-    def _metric_from_logs(self, pod, namespace, metric_name):
-        """Scrape the trial pod's stdout for the metric line.
-
-        Cluster mode reads the kubelet log endpoint
-        (KubeStore.read_pod_log) — only once the pod reached a terminal
-        phase, so an intermediate per-epoch report can't be mistaken
-        for the final objective, with a bounded tail (the final report
-        is at/near the end). The in-process runtime uses the
-        kubeflow.org/pod-logs annotation convention ungated (its fake
-        kubelet never reaches Succeeded; the annotation is the injected
-        final log)."""
-        if pod is None:
-            return None
-        from ..compute.trial import parse_metric_line
+    def _read_trial_logs(self, pod, namespace):
+        """Fetch a trial pod's log tail. Cluster mode reads the kubelet
+        log endpoint (KubeStore.read_pod_log — works on running pods
+        too); the in-process runtimes publish via the
+        kubeflow.org/pod-logs annotation (process_runtime.py mirrors
+        the live tail there while the child runs). Returns "" on read
+        failure (logged — a broken log feed must be diagnosable)."""
         reader = getattr(self.store, "read_pod_log", None)
-        if reader is not None:
-            phase = m.deep_get(pod, "status", "phase")
-            if phase not in ("Succeeded", "Failed"):
-                return None
-            containers = m.deep_get(pod, "spec", "containers",
-                                    default=[]) or []
-            container = (containers[0].get("name")
-                         if len(containers) > 1 else None)
-            try:
-                logs = reader(m.name_of(pod), namespace,
-                              container=container, tail_lines=200)
-            except Exception:
-                log.warning(
-                    "studyjob: reading logs of trial pod %s/%s failed",
-                    namespace, m.name_of(pod), exc_info=True)
-                return None
+        if reader is None:
+            return m.annotations_of(pod).get("kubeflow.org/pod-logs", "")
+        containers = m.deep_get(pod, "spec", "containers",
+                                default=[]) or []
+        container = None
+        if len(containers) > 1:
+            # the reporting container is the one holding the chips, not
+            # whichever sidecar happens to be listed first
+            container = next(
+                (c.get("name") for c in containers
+                 if m.deep_get(c, "resources", "limits",
+                               "google.com/tpu") is not None),
+                containers[0].get("name"))
+        try:
+            return reader(m.name_of(pod), namespace,
+                          container=container, tail_lines=200) or ""
+        except Exception:
+            log.warning(
+                "studyjob: reading logs of trial pod %s/%s failed",
+                namespace, m.name_of(pod), exc_info=True)
+            return ""
+
+    def _scrape_trial(self, pod, namespace, metric_name,
+                      want_reports=True):
+        """One pass over the trial's log tail → (final, reports).
+
+        ``final`` is the last step-less metric line — the objective;
+        only trusted in cluster mode once the pod is terminal (an
+        unflushed mid-write line must not complete a trial). ``reports``
+        are the step-carrying intermediate lines, the early-stopping
+        feed — by design readable while the trial is still Running.
+        With ``want_reports=False`` (no early stopping configured) a
+        non-terminal cluster pod is not read at all: nothing would
+        consume the reports, and each read is a kubelet round-trip."""
+        if pod is None:
+            return None, []
+        from ..compute.trial import parse_metric_line
+        final, reports = None, []
+        if getattr(self.store, "read_pod_log", None) is not None:
+            # cluster mode: the kubelet serves running-pod logs, so a
+            # step-less line is only final once the pod is terminal
+            terminal_gated = m.deep_get(pod, "status", "phase") not in (
+                "Succeeded", "Failed")
+            if terminal_gated and not want_reports:
+                return None, []
         else:
-            logs = m.annotations_of(pod).get("kubeflow.org/pod-logs", "")
-        best = None
-        for line in (logs or "").splitlines():
+            # annotation mode: a live-mirrored tail is explicitly
+            # marked partial (process_runtime.py); an unmarked
+            # annotation is a final publication (exit or test fixture)
+            terminal_gated = m.annotations_of(pod).get(
+                "kubeflow.org/pod-logs-partial") == "true"
+        for line in self._read_trial_logs(pod, namespace).splitlines():
             parsed = parse_metric_line(line)
-            if parsed and parsed.get("name") == metric_name \
-                    and isinstance(parsed.get("value"), (int, float)):
-                best = float(parsed["value"])   # last report wins
-        return best
+            if not parsed or parsed.get("name") != metric_name \
+                    or not isinstance(parsed.get("value"), (int, float)):
+                continue
+            step = parsed.get("step")
+            if step is None:
+                if not terminal_gated:
+                    final = float(parsed["value"])   # last report wins
+            elif want_reports and isinstance(step, (int, float)):
+                reports.append([int(step), float(parsed["value"])])
+        return final, reports
+
+    def _metric_from_logs(self, pod, namespace, metric_name):
+        return self._scrape_trial(pod, namespace, metric_name)[0]
 
     def reconcile(self, req):
         study = self.store.try_get(self.API, tsapi.STUDY_KIND, req.name,
@@ -470,29 +628,39 @@ class StudyJobReconciler(Reconciler):
         seed = int(m.deep_get(spec, "algorithm", "seed", default=0) or 0)
         algorithm = m.deep_get(spec, "algorithm", "name",
                                default="random") or "random"
-        # spec validation up front: a bad algorithm/parameter spec must
-        # become a terminal Failed condition, not an infinite
-        # crash-requeue loop
-        if parameters:
-            try:
+        es = spec.get("earlyStopping") or {}
+        es_enabled = es.get("algorithm") in ("median", "medianstop")
+        # spec validation up front: a bad algorithm/parameter/early-
+        # stopping spec must become a terminal Failed condition, not a
+        # silently-ignored knob or an infinite crash-requeue loop
+        try:
+            if es.get("algorithm") and not es_enabled:
+                raise ValueError(
+                    f"unknown earlyStopping algorithm "
+                    f"{es['algorithm']!r}; expected median")
+            if parameters:
                 sample_parameters(parameters, 0, seed, algorithm)
-            except ValueError as e:
-                status = {
-                    "phase": "Failed",
-                    "conditions": [{
-                        "type": "Failed", "status": "True",
-                        "reason": "InvalidSpec", "message": str(e),
-                        "lastTransitionTime": m.now_iso(),
-                    }],
-                }
-                if status != study.get("status"):
-                    study["status"] = status
-                    self.store.update_status(study)
-                return Result()
+        except ValueError as e:
+            status = {
+                "phase": "Failed",
+                "conditions": [{
+                    "type": "Failed", "status": "True",
+                    "reason": "InvalidSpec", "message": str(e),
+                    "lastTransitionTime": m.now_iso(),
+                }],
+            }
+            if status != study.get("status"):
+                study["status"] = status
+                self.store.update_status(study)
+            return Result()
         objective = spec.get("objective") or {}
         metric_name = objective.get("metricName", "objective")
         maximize = objective.get("type", "maximize") == "maximize"
 
+        # snapshot before the collect loop mutates trial dicts in place:
+        # the dirty check below must see the pre-reconcile state or an
+        # update that only touches trial fields is silently skipped
+        prior_status = m.deep_copy(study.get("status") or {})
         trials = {t["index"]: t
                   for t in m.deep_get(study, "status", "trials",
                                       default=[]) or []}
@@ -503,10 +671,22 @@ class StudyJobReconciler(Reconciler):
         # (compute/trial.py report(); Katib's metrics-collector idiom,
         # here without a sidecar)
         for i, trial in trials.items():
-            if trial.get("state") in ("Succeeded", "Failed"):
+            if trial.get("state") in ("Succeeded", "Failed",
+                                      "EarlyStopped"):
                 continue
             tname = self._trial_name(req.name, i)
             pod = self.store.try_get("v1", "Pod", tname, req.namespace)
+            if pod is not None:
+                # surface placement: where the scheduler put the trial
+                # and which chips the device plugin handed it (published
+                # by the runtime as a pod annotation)
+                node = m.deep_get(pod, "spec", "nodeName")
+                if node:
+                    trial["node"] = node
+                assigned = m.annotations_of(pod).get(
+                    "kubeflow.org/tpu-chips")
+                if assigned:
+                    trial["chips"] = assigned
             cm = self.store.try_get("v1", "ConfigMap", f"{tname}-metrics",
                                     req.namespace)
             if cm is not None and metric_name in (cm.get("data") or {}):
@@ -528,26 +708,73 @@ class StudyJobReconciler(Reconciler):
                 if partial is not None:
                     trial["partialObjectiveValue"] = partial
                 continue
-            metric = self._metric_from_logs(pod, req.namespace,
-                                            metric_name)
-            if metric is not None:
+            final, reports = self._scrape_trial(
+                pod, req.namespace, metric_name,
+                want_reports=es_enabled)
+            if reports:
+                # the medianstop feed: merge into stored history (the
+                # scrape only sees a bounded tail — once early lines
+                # rotate out, the stored low-step values are the only
+                # copy peers can be compared at), bounded by thinning
+                trial["reports"] = thin_reports(
+                    merge_reports(trial.get("reports"), reports))
+            if final is not None:
                 trial["state"] = "Succeeded"
-                trial["objectiveValue"] = metric
+                trial["objectiveValue"] = final
 
-        # launch trials up to parallelism
+        # ---- early stopping (Katib medianstop re-homed, hpo.py): a
+        # running trial whose best intermediate objective is worse than
+        # the median of its peers' at the same step is killed now — its
+        # chip goes to the next trial instead of finishing a loser
+        if es_enabled:
+            from . import hpo
+            for i, trial in trials.items():
+                if trial.get("state") != "Running" \
+                        or not trial.get("reports"):
+                    continue
+                peers = [t.get("reports") or [] for j, t in trials.items()
+                         if j != i]
+                if hpo.median_should_stop(
+                        [(s, v) for s, v in trial["reports"]],
+                        [[(s, v) for s, v in p] for p in peers],
+                        maximize,
+                        start_step=int(es.get("startStep", 1)),
+                        min_peers=int(es.get("minTrialsRequired", 2))):
+                    tname = self._trial_name(req.name, i)
+                    try:
+                        self.store.delete("v1", "Pod", tname,
+                                          req.namespace)
+                    except NotFoundError:
+                        pass
+                    trial["state"] = "EarlyStopped"
+                    vals = [v for _, v in trial["reports"]]
+                    # observation at stop time, recorded for the study
+                    # table; best-trial selection only ranks Succeeded
+                    trial["objectiveValue"] = (max(vals) if maximize
+                                               else min(vals))
+
+        # launch trials up to parallelism; model-based algorithms see
+        # the completed history (tpe ignores still-running trials)
+        history = [(t.get("parameters") or {}, t.get("objectiveValue"))
+                   for t in trials.values()
+                   if t.get("state") == "Succeeded"
+                   and "objectiveValue" in t]
         active = sum(1 for t in trials.values()
                      if t.get("state") == "Running")
         next_index = len(trials)
         while next_index < max_trials and active < parallelism:
             values = sample_parameters(parameters, next_index, seed,
-                                       algorithm)
+                                       algorithm, history=history,
+                                       maximize=maximize)
             tname = self._trial_name(req.name, next_index)
             template = render_template(
                 spec.get("trialTemplate") or {"spec": {"containers": [{}]}},
                 values)
             pod = builtin.pod(
                 tname, req.namespace,
-                m.deep_copy(template.get("spec") or {}),
+                apply_trial_placement(
+                    m.deep_copy(template.get("spec") or {}), spec,
+                    req.name),
                 labels={"studyjob": req.name,
                         "studyjob-trial": str(next_index)})
             m.set_controller_reference(pod, study)
@@ -561,7 +788,8 @@ class StudyJobReconciler(Reconciler):
             next_index += 1
 
         completed = sum(1 for t in trials.values()
-                        if t.get("state") in ("Succeeded", "Failed"))
+                        if t.get("state") in ("Succeeded", "Failed",
+                                              "EarlyStopped"))
         done = [t for t in trials.values() if t.get("state") == "Succeeded"
                 and "objectiveValue" in t]
         best = None
@@ -590,7 +818,14 @@ class StudyJobReconciler(Reconciler):
             status["bestTrial"] = {"index": best["index"],
                                    "parameters": best["parameters"],
                                    "objectiveValue": best["objectiveValue"]}
-        if status != study.get("status"):
+        if status != prior_status:
             study["status"] = status
             self.store.update_status(study)
+        if es_enabled and any(t.get("state") == "Running"
+                              for t in trials.values()):
+            # kubelet log growth emits no watch events: the medianstop
+            # feed must be polled while trials run (the in-process
+            # runtime's annotation mirror generates events, but cluster
+            # mode would starve without this)
+            return Result(requeue_after=2.0)
         return Result()

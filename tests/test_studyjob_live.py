@@ -1,0 +1,103 @@
+"""Live early stopping: medianstop kills a REAL trailing trial process.
+
+The full HPO feedback loop against real subprocesses (the tier above
+the annotation-injection unit tests in test_tpuslice_controller.py):
+trial pods run actual Python processes that stream intermediate
+``trial-metric`` reports via compute.trial.report(step=); the
+ProcessPodRuntime mirrors their live log tails into the pod-logs
+annotation; the StudyJobReconciler's medianstop loop sees the trailing
+trial mid-flight, deletes its pod, and the runtime SIGKILLs the
+process — long before its 120 s sleep would end. The reference
+delegates this whole loop to Katib's earlystopping service + sidecar
+metrics collector (SURVEY.md §2); here it is one control plane.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+from kubeflow_tpu import api
+from kubeflow_tpu.api import tpuslice as tsapi
+from kubeflow_tpu.controllers.process_runtime import ProcessPodRuntime
+from kubeflow_tpu.controllers.tpuslice import StudyJobReconciler
+from kubeflow_tpu.core.manager import Manager
+from kubeflow_tpu.core.store import ObjectStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GOOD = ("from kubeflow_tpu.compute import trial; import time; "
+        "trial.report({v}, name='acc', step=1); time.sleep(6); "
+        "trial.report({v} + 0.05, name='acc')")
+LOSER = ("from kubeflow_tpu.compute import trial; import time; "
+         "trial.report(0.01, name='acc', step=1); time.sleep(120)")
+
+
+@pytest.mark.slow
+def test_medianstop_kills_real_trailing_trial(tmp_path):
+    store = ObjectStore()
+    api.register_all(store)
+    runtime = ProcessPodRuntime(gang_label="studyjob",
+                                workdir=str(tmp_path),
+                                extra_env={"PYTHONPATH": REPO})
+    mgr = Manager(store)
+    mgr.add(StudyJobReconciler())
+    mgr.add(runtime)
+    mgr.start()
+    try:
+        study = tsapi.new_study(
+            "live", "default",
+            objective={"type": "maximize", "metricName": "acc"},
+            # one categorical parameter steers which script each trial
+            # runs: grid enumeration gives trials 0/1 the good script
+            # and trial 2 the loser, deterministically
+            parameters=[{"name": "idx", "type": "categorical",
+                         "values": ["0", "1", "2"]}],
+            trial_template={"spec": {"containers": [{
+                "name": "trial", "image": "local",
+                "command": [sys.executable, "-c",
+                            "import sys; exec(sys.argv[1])",
+                            "{{script}}"]}]}},
+            max_trials=3, parallelism=3, algorithm="grid")
+        study["spec"]["earlyStopping"] = {
+            "algorithm": "median", "startStep": 1,
+            "minTrialsRequired": 2}
+        # render the script through a second placeholder keyed off idx
+        tmpl = study["spec"]["trialTemplate"]["spec"]["containers"][0]
+        scripts = {"0": GOOD.format(v=0.90), "1": GOOD.format(v=0.80),
+                   "2": LOSER}
+        # template substitution only knows {{idx}}; bake the mapping in
+        tmpl["command"][2] = (
+            "import sys; _s = {0!r}; exec(_s[sys.argv[1]])".format(
+                scripts))
+        tmpl["command"][3] = "{{idx}}"
+        store.create(study)
+
+        deadline = time.time() + 90
+        status = {}
+        while time.time() < deadline:
+            got = store.get("kubeflow.org/v1alpha1", "StudyJob", "live",
+                            "default")
+            status = got.get("status") or {}
+            if status.get("phase") == "Completed":
+                break
+            time.sleep(0.5)
+        assert status.get("phase") == "Completed", status
+        states = {t["index"]: t["state"] for t in status["trials"]}
+        assert sorted(states.values()) == \
+            ["EarlyStopped", "Succeeded", "Succeeded"], states
+        stopped = next(t for t in status["trials"]
+                       if t["state"] == "EarlyStopped")
+        # the loser was the one streaming 0.01 — and it was killed off
+        # the live log feed ~115 s before its sleep would have ended
+        assert stopped["objectiveValue"] == 0.01
+        assert stopped["reports"] == [[1, 0.01]]
+        assert store.try_get(
+            "v1", "Pod", f"live-trial-{stopped['index']}",
+            "default") is None
+        best = status["bestTrial"]
+        assert abs(best["objectiveValue"] - 0.95) < 1e-9
+    finally:
+        mgr.stop()
+        runtime.close()
