@@ -1,0 +1,206 @@
+"""LM step decomposition lab: where do the milliseconds go?
+
+Times each piece of the flagship LM train step in isolation on the real
+chip so MFU work targets the actual bottleneck instead of folklore.
+Usage: python hack/lm_lab.py [piece ...] where piece in
+{matmul, attn, backbone, head, step}. Default: all.
+"""
+
+import os
+import sys
+import time
+
+# run as `python hack/lm_lab.py`: the repo root must be importable, but
+# NOT via PYTHONPATH (exporting it breaks the axon TPU plugin's imports)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.compute import mesh as mesh_lib
+from kubeflow_tpu.compute import train
+from kubeflow_tpu.compute.models import transformer
+from kubeflow_tpu.compute.ops import flash_attention
+
+PEAK = 197e12
+B, S = 8, 1024
+CFG = transformer.Config(vocab_size=32768, d_model=1024, n_layers=12,
+                         n_heads=16, max_seq=S, dtype="bfloat16",
+                         attention="flash", remat=False)
+
+
+def _drain(x):
+    """Force completion by VALUE readback — block_until_ready is not
+    reliable through the axon tunnel (same idiom as bench.py). The TPU
+    runs enqueued programs in order, so reading the last result's bytes
+    fences every program before it."""
+    leaf = jax.tree.leaves(x)[0]
+    return float(jnp.sum(leaf).astype(jnp.float32))
+
+
+def bench(fn, *args, steps=30, flops=None, tag=""):
+    out = fn(*args)
+    _drain(out)
+    out = fn(*args)
+    _drain(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    _drain(out)
+    dt = (time.perf_counter() - t0) / steps
+    if tag:
+        mfu = (flops / dt / PEAK) if flops else float("nan")
+        print(f"{tag:28s} {dt*1e3:8.2f} ms   mfu={mfu:.3f}", flush=True)
+    return dt
+
+
+INNER = 20
+
+
+def bench_inner(fn_one, args, flops_one, tag):
+    """Time ``fn_one(*args)`` amortized over INNER in-jit iterations —
+    the ~3.5 ms per-dispatch tunnel overhead would otherwise swamp any
+    sub-10ms kernel. A scalar carry chains iterations so XLA can't
+    hoist the body out of the scan."""
+    def loop(c, *args):
+        def body(c, _):
+            out = fn_one(*args, c)
+            # reduce over the WHOLE output: a carry that reads one
+            # element lets XLA slice the matmul down to one dot product
+            return jnp.sum(out).astype(jnp.float32) * 1e-30, None
+        c, _ = jax.lax.scan(body, c, None, length=INNER)
+        return c
+    f = jax.jit(loop)
+    d = bench(f, jnp.float32(0.0), *args, flops=None, tag="")
+    dt = d / INNER
+    mfu = flops_one / dt / PEAK
+    print(f"{tag:28s} {dt*1e3:8.2f} ms   mfu={mfu:.3f}  (inner)",
+          flush=True)
+    return dt
+
+
+def lab_matmul():
+    """MXU ceiling at LM-relevant shapes."""
+    for mm, kk, nn in ((B * S, 1024, 2816), (B * S, 1024, 32768),
+                       (B * S, 2816, 1024), (8192, 8192, 8192)):
+        a = jnp.ones((mm, kk), jnp.bfloat16)
+        b = jnp.ones((kk, nn), jnp.bfloat16)
+        bench_inner(
+            lambda a, b, c: (a + c.astype(jnp.bfloat16)) @ b, (a, b),
+            2 * mm * kk * nn, f"matmul {mm}x{kk}x{nn}")
+
+
+def lab_attn():
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, S, 16, 64),
+                          jnp.bfloat16)
+    flops_fwd = 4 * B * 16 * S * S * 64 / 2     # causal halves the work
+
+    def flash_one(q, c):
+        return flash_attention(q + c.astype(q.dtype), q, q, causal=True)
+    bench_inner(flash_one, (q,), flops_fwd, "flash fwd")
+
+    def flash_fb(q, c):
+        return jax.grad(
+            lambda q: flash_attention(q, q, q, causal=True)
+            .astype(jnp.float32).sum())(q + c.astype(q.dtype))
+    bench_inner(flash_fb, (q,), 3.5 * flops_fwd, "flash fwd+bwd")
+
+    def dense(q):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, q) / 8.0
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s.astype(jnp.float32), -1e9)
+        p = jax.nn.softmax(s, axis=-1).astype(jnp.bfloat16)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, q)
+    bench_inner(lambda q, c: dense(q + c.astype(q.dtype)), (q,),
+                flops_fwd, "dense fwd")
+    bench_inner(
+        lambda q, c: jax.grad(
+            lambda q: dense(q).astype(jnp.float32).sum())(
+                q + c.astype(q.dtype)),
+        (q,), 3 * flops_fwd, "dense fwd+bwd")
+
+    def rmsnorm_qkv(h, w, c):
+        from kubeflow_tpu.compute.models.transformer import _rmsnorm
+        n = _rmsnorm(h + c.astype(h.dtype), jnp.ones((1024,), h.dtype))
+        return jnp.einsum("bsd,dk->bsk", n, w)
+    h = jax.random.normal(jax.random.PRNGKey(1), (B, S, 1024),
+                          jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(2), (1024, 1024),
+                          jnp.bfloat16)
+    bench_inner(rmsnorm_qkv, (h, w), 2 * B * S * 1024 * 1024,
+                "rmsnorm+proj 1024x1024")
+    bench_inner(lambda h, w, c: jnp.einsum(
+        "bsd,dk->bsk", h + c.astype(h.dtype), w), (h, w),
+        2 * B * S * 1024 * 1024, "bare proj 1024x1024")
+
+
+def _state_and_batch(cfg):
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=-1))
+    opt = train.make_optimizer(learning_rate=3e-4, warmup_steps=10,
+                               total_steps=10_000)
+    state = train.init_state(
+        lambda k: transformer.init_params(cfg, k), opt, mesh,
+        transformer.logical_axes(cfg), jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                              cfg.vocab_size)
+    return mesh, opt, state, {"tokens": toks,
+                              "targets": jnp.roll(toks, -1, axis=1)}
+
+
+def lab_backbone():
+    _, _, state, data = _state_and_batch(CFG)
+    params = state.params
+
+    def fwd(p, toks):
+        x, _ = transformer.backbone(
+            jax.tree.map(lambda a: a.astype(jnp.bfloat16), p), toks, CFG)
+        return x.astype(jnp.float32).sum()
+
+    n_body = transformer.param_count(CFG) - 2 * 32768 * 1024
+    ftok = 2 * n_body + 2 * 1024 * 1024 + 12 * CFG.n_layers * 1024
+    bench(jax.jit(fwd), params, data["tokens"],
+          flops=ftok * B * S, tag="backbone fwd")
+    bench(jax.jit(jax.grad(fwd)), params, data["tokens"],
+          flops=3 * ftok * B * S, tag="backbone fwd+bwd")
+
+
+def lab_head():
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, S, 1024),
+                          jnp.bfloat16)
+    head = jax.random.normal(jax.random.PRNGKey(1), (1024, 32768),
+                             jnp.float32)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (B, S), 0, 32768)
+
+    def ce(head, x):
+        logits = jnp.einsum("bsd,dv->bsv", x, head.astype(jnp.bfloat16),
+                            preferred_element_type=jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        lab = jnp.take_along_axis(logits, tgt[..., None], -1)[..., 0]
+        return (logz - lab).mean()
+
+    flops = 2 * B * S * 1024 * 32768
+    bench(jax.jit(ce), head, x, flops=flops, tag="CE head fwd")
+    bench(jax.jit(jax.grad(ce)), head, x, flops=3 * flops,
+          tag="CE head fwd+bwd")
+
+
+def lab_step():
+    mesh, opt, state, data = _state_and_batch(CFG)
+    step = train.make_train_step(
+        train.plain_loss(transformer.loss_fn, CFG), opt, mesh)
+    holder = [state]
+
+    def one(data):
+        s, m = step(holder[0], data)
+        holder[0] = s
+        return m["loss"]
+    ftok = transformer.flops_per_token(CFG)
+    bench(one, data, flops=ftok * B * S, tag="full train step")
+
+
+if __name__ == "__main__":
+    pieces = sys.argv[1:] or ["matmul", "attn", "head", "backbone",
+                              "step"]
+    for p in pieces:
+        globals()[f"lab_{p}"]()

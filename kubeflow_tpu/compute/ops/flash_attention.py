@@ -35,15 +35,21 @@ NEG_INF = -1e30
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                 block_k, seq_k):
+    # MXU precision discipline: matmul operands stay in the INPUT dtype
+    # (bf16 runs the MXU at full rate; fp32 operands would quarter it),
+    # accumulation + softmax statistics in fp32 via
+    # preferred_element_type — the numerics the input dtype already
+    # implies, at 4x the fp32-operand throughput.
     bq, d = q_ref.shape[1], q_ref.shape[2]
+    dt = q_ref.dtype
     jq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
+    q = (q_ref[0].astype(jnp.float32) * scale).astype(dt)
     q_pos = jq * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
     def body(kb, carry):
         o, m, l = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -56,7 +62,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         p = jnp.exp(s - m_new)
         l = l * corr + p.sum(axis=1, keepdims=True)
         o = o * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(dt), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return o, m_new, l
 
@@ -78,17 +84,22 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, *, scale, causal, block_k, seq_k):
     """dQ = scale · Σ_kb [p ⊙ (dO·Vᵀ − delta)] · K."""
     bq, d = q_ref.shape[1], q_ref.shape[2]
+    dt = q_ref.dtype
     jq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    # scale folded into q EXACTLY as the forward kernel does (scale in
+    # fp32, one rounding to the input dtype): the recomputed s must
+    # renormalize against the forward's lse, so fwd and bwd rounding
+    # must be identical
+    q = (q_ref[0].astype(jnp.float32) * scale).astype(dt)
+    do = do_ref[0]
     lse = lse_ref[0]           # [bq, 1] fp32
     delta = delta_ref[0]       # [bq, 1] fp32
     q_pos = jq * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
     def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = scale * jax.lax.dot_general(
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if causal:
@@ -99,7 +110,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(dt)
         return dq + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -117,33 +128,38 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, scale, causal, block_q, seq_q):
     """dV = Σ_qb pᵀ·dO ;  dK = scale · Σ_qb [p ⊙ (dO·Vᵀ − delta)]ᵀ·Q."""
     bk, d = k_ref.shape[1], k_ref.shape[2]
+    dt = k_ref.dtype
     jk = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]
+    v = v_ref[0]
     k_pos = jk * bk + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
 
     def body(qb, carry):
         dk, dv = carry
         qb_start = qb * block_q
-        q = q_ref[0, pl.ds(qb_start, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qb_start, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qb_start, block_q), :]
+        do = do_ref[0, pl.ds(qb_start, block_q), :]
         lse = lse_ref[0, pl.ds(qb_start, block_q), :]
         delta = delta_ref[0, pl.ds(qb_start, block_q), :]
-        s = scale * jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+        # scale folded into q with one fwd-identical rounding (see
+        # _bwd_dq_kernel); p stays fp32 for the ds product — operands
+        # are cast per matmul, never double-rounded
+        qs = (q.astype(jnp.float32) * scale).astype(dt)
+        s = jax.lax.dot_general(
+            qs, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if causal:
             q_pos = qb_start + lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)                       # [block_q, bk]
+        p = jnp.exp(s - lse)                       # [block_q, bk] fp32
         dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(dt), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(dt)
         dk = dk + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)

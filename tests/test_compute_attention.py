@@ -39,6 +39,37 @@ def test_flash_gradients_match_dense(qkv):
         assert jnp.abs(a - b).max() < 2e-4
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_bf16_matches_fp32_dense(qkv, causal):
+    """The production path (dtype=bfloat16) keeps matmul operands in
+    bf16 with fp32 accumulation — the kernels' fast path, which the
+    fp32 fixtures above never exercise. Reference: exact fp32 dense on
+    the upcast of the SAME bf16 values, so the tolerance only has to
+    absorb in-kernel rounding, not input quantization."""
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+    ref = A.dense_attention(*(x.astype(jnp.float32) for x in (q, k, v)),
+                            causal=causal)
+    out = flash_attention(q, k, v, causal=causal)
+    assert out.dtype == jnp.bfloat16
+    assert jnp.abs(ref - out.astype(jnp.float32)).max() < 3e-2
+
+
+def test_flash_bf16_gradients_match_fp32_dense(qkv):
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+
+    def loss(fn, cast):
+        return lambda q, k, v: (fn(cast(q), cast(k), cast(v), True)
+                                .astype(jnp.float32) ** 2).sum()
+
+    gd = jax.grad(loss(A.dense_attention, lambda x: x.astype(
+        jnp.float32)), argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss(flash_attention, lambda x: x),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gd, gf):
+        scale = jnp.abs(a).max()
+        assert (jnp.abs(a - b.astype(jnp.float32)).max() / scale) < 3e-2
+
+
 def test_flash_nondivisible_seq_falls_back(qkv):
     q, k, v = (x[:, :200] for x in qkv)
     ref = A.dense_attention(q, k, v, causal=True)
