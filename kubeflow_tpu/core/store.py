@@ -247,11 +247,13 @@ class ObjectStore:
                 out.append(self._maybe_convert(obj, api_version))
         return out
 
-    def update(self, obj):
+    def update(self, obj, dry_run=False):
         """Full update with optimistic concurrency: metadata.resourceVersion
         must match the stored object or ConflictError is raised — the
         single-writer invariant the reference controllers rely on
-        (SURVEY.md §5 race-detection notes)."""
+        (SURVEY.md §5 race-detection notes). With ``dry_run``, run the
+        conflict check + admission chain without persisting (apiserver
+        ``dryRun=All`` on UPDATE — the YAML editor's Validate path)."""
         obj = m.deep_copy(obj)
         g, k = m.gvk(obj)
         with self._lock:
@@ -270,6 +272,8 @@ class ObjectStore:
                 if conv is not None:
                     obj = conv(obj, m.api_ver(old.get("apiVersion")))
             obj = self._run_admission("UPDATE", obj, m.deep_copy(old))
+            if dry_run:
+                return m.deep_copy(obj)
             md = obj.setdefault("metadata", {})
             # server-managed fields are immutable
             md["uid"] = old["metadata"]["uid"]

@@ -109,6 +109,9 @@ class App:
     def post(self, p):
         return self.route("POST", p)
 
+    def put(self, p):
+        return self.route("PUT", p)
+
     def patch(self, p):
         return self.route("PATCH", p)
 
@@ -258,6 +261,9 @@ class TestClient:
 
     def post(self, path, **kw):
         return self.open("POST", path, **kw)
+
+    def put(self, path, **kw):
+        return self.open("PUT", path, **kw)
 
     def patch(self, path, **kw):
         return self.open("PATCH", path, **kw)

@@ -376,10 +376,46 @@ def create_app(store):
         pvcs = store.list("v1", "PersistentVolumeClaim", ns)
         return cb.success({"pvcs": pvcs})
 
+    def _raw_notebook(body, ns):
+        """Validate a user-authored Notebook CR (the YAML-editor path:
+        the browser parses YAML client-side and posts the CR as JSON)."""
+        if not isinstance(body, dict):
+            raise HTTPError(400, "body must be a Notebook object")
+        if body.get("kind") != nbapi.KIND:
+            raise HTTPError(400, f"kind must be {nbapi.KIND}, "
+                                 f"got {body.get('kind')!r}")
+        valid_apis = {f"{nbapi.GROUP}/{v}" for v in nbapi.VERSIONS}
+        if body.get("apiVersion") not in valid_apis:
+            raise HTTPError(400, f"apiVersion must be one of "
+                                 f"{sorted(valid_apis)}")
+        nb = m.deep_copy(body)
+        md = nb.setdefault("metadata", {})
+        if md.get("namespace") not in (None, ns):
+            raise HTTPError(
+                400, f"metadata.namespace {md['namespace']!r} does not "
+                     f"match the request namespace {ns!r}")
+        md["namespace"] = ns
+        if not md.get("name"):
+            raise HTTPError(400, "metadata.name is required")
+        return nb
+
     @app.post("/api/namespaces/<ns>/notebooks")
     def post_notebook(request, ns):
         cb.ensure_authorized(store, request, "create", "notebooks", ns)
+        dry_run = request.query.get("dry_run", "").lower() == "true"
+        if request.query.get("raw", "").lower() == "true":
+            # YAML-editor path: the body IS the CR; dry-run first so
+            # schema/admission errors surface in the editor
+            nb = _raw_notebook(request.json, ns)
+            store.create(nb, dry_run=True)
+            if not dry_run:
+                store.create(nb)
+            return cb.success(status=200)
         nb, new_pvcs = form_to_notebook(request.json, ns, app.config)
+        if request.query.get("render", "").lower() == "true":
+            # form -> CR without creating: seeds the YAML editor with
+            # exactly what the form would submit
+            return cb.success({"notebook": nb, "pvcs": new_pvcs})
         if new_pvcs:
             cb.ensure_authorized(store, request, "create",
                                  "persistentvolumeclaims", ns)
@@ -392,7 +428,7 @@ def create_app(store):
         store.create(nb, dry_run=True)
         for pvc in missing:
             store.create(pvc, dry_run=True)
-        if request.query.get("dry_run", "").lower() == "true":
+        if dry_run:
             return cb.success(status=200)     # validate-only request
         for pvc in missing:
             store.create(pvc)

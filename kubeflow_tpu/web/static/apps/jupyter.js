@@ -11,7 +11,7 @@
 import {
   age, api, clear, currentNamespace, eventsTable, Field, FieldGroup, h,
   indexPage, LogsViewer, Router, RowList, snack, statusIcon, tabPanel,
-  validators,
+  validators, YamlEditor, yamlDump,
 } from "../lib/components.js";
 
 const outlet = document.getElementById("app");
@@ -255,10 +255,28 @@ async function formView(el) {
     }
   };
 
+  const editAsYaml = async () => {
+    /* render the form through the server's form→CR translation and
+     * hand the result to the YAML editor */
+    const body = buildBody();
+    if (!body) return;
+    try {
+      const out = await api("POST",
+        `api/namespaces/${ns}/notebooks?render=true`, body);
+      yamlSeed = out.notebook;
+      router.go("/new-yaml");
+    } catch (e) {
+      snack(String(e.message || e), "error");
+    }
+  };
+
   el.append(
     h("div.kf-toolbar", {},
       h("button.ghost", { onclick: () => router.go("/") }, "← back"),
-      h("h2", {}, `New notebook in ${ns}`)),
+      h("h2", {}, `New notebook in ${ns}`),
+      h("span.kf-spacer"),
+      h("button.ghost", { id: "edit-as-yaml", onclick: editAsYaml },
+        "Edit as YAML")),
     h("div.kf-section", { id: "form-basics" },
       h("h2", {}, "Notebook"),
       basics.fields.map((f) => f.element)),
@@ -284,6 +302,76 @@ async function formView(el) {
         "Launch"),
       h("button.ghost", { id: "validate-notebook", onclick: validate },
         "Validate (dry run)"),
+      h("button.ghost", { onclick: () => router.go("/") }, "Cancel")),
+  );
+}
+
+/* ------------------------------------------------------- yaml authoring */
+
+/* one-shot seed handed from the form's "Edit as YAML" to the editor
+ * view (hash routing can't carry an object) */
+let yamlSeed = null;
+
+function starterNotebook(ns) {
+  return {
+    apiVersion: "kubeflow.org/v1beta1",
+    kind: "Notebook",
+    metadata: { name: "my-notebook", namespace: ns },
+    spec: { template: { spec: { containers: [{
+      name: "my-notebook",
+      image: "kubeflownotebookswg/jupyter-jax-tpu:latest",
+      resources: { requests: { cpu: "500m", memory: "1Gi" } },
+    }] } } },
+  };
+}
+
+async function yamlFormView(el) {
+  /* edit → dry-run → fix → create, server-side admission included
+   * (reference common-lib editor module + form-page submit flow) */
+  const ns = currentNamespace();
+  const editor = new YamlEditor({ rows: 26 });
+  editor.setObject(yamlSeed || starterNotebook(ns));
+  yamlSeed = null;
+
+  const parsedOrNull = () => {
+    try {
+      return editor.parsed();
+    } catch (e) {
+      editor.setStatus(e.message, "error", e.line);
+      snack(e.message, "error");
+      return null;
+    }
+  };
+  const post = async (dryRun) => {
+    const cr = parsedOrNull();
+    if (cr === null) return;
+    try {
+      await api("POST", `api/namespaces/${ns}/notebooks?raw=true` +
+        (dryRun ? "&dry_run=true" : ""), cr);
+      if (dryRun) {
+        editor.setStatus(
+          "dry run ok — schema and admission chain accept this", "");
+        snack("manifest is valid", "success");
+      } else {
+        snack(`created ${(cr.metadata || {}).name}`, "success");
+        router.go("/");
+      }
+    } catch (e) {
+      editor.setStatus(String(e.message || e), "error");
+      snack(String(e.message || e), "error");
+    }
+  };
+
+  el.append(
+    h("div.kf-toolbar", {},
+      h("button.ghost", { onclick: () => router.go("/new") }, "← form"),
+      h("h2", {}, `New notebook in ${ns} (YAML)`)),
+    h("div.kf-section", { id: "yaml-editor-section" }, editor.element),
+    h("div.kf-form-actions", {},
+      h("button.primary", { id: "yaml-create",
+        onclick: () => post(false) }, "Create"),
+      h("button.ghost", { id: "yaml-dryrun",
+        onclick: () => post(true) }, "Validate (dry run)"),
       h("button.ghost", { onclick: () => router.go("/") }, "Cancel")),
   );
 }
@@ -354,7 +442,7 @@ async function detailsView(el, params) {
   };
 
   const yamlTab = (pane) => {
-    pane.append(h("code.kf-yaml", {}, JSON.stringify(nb, null, 2)));
+    pane.append(h("code.kf-yaml", {}, yamlDump(nb)));
   };
 
   el.append(
@@ -374,6 +462,7 @@ async function detailsView(el, params) {
 router = new Router(outlet, [
   ["/", indexView],
   ["/new", formView],
+  ["/new-yaml", yamlFormView],
   ["/details/:name", detailsView],
 ]);
 router.render();

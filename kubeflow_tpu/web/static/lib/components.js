@@ -354,6 +354,89 @@ export class RowList {
   }
 }
 
+/* --------------------------------------------------------- yaml editor */
+
+import { dump as yamlDump, parse as yamlParse } from "./yaml.js";
+
+export class YamlEditor {
+  /* In-browser manifest editor (common-lib resource-editor analogue,
+   * no-build tier): line-numbered textarea, Tab inserts spaces, live
+   * parse with the offending line called out, and a dirty flag so
+   * callers can warn before navigation. parsed() throws YamlError when
+   * the buffer doesn't parse — callers surface it next to their own
+   * server-side dry-run errors. */
+  constructor({ value, rows, onChange } = {}) {
+    this.gutter = h("pre.kf-editor-gutter");
+    this.area = h("textarea.kf-editor-text", {
+      rows: rows || 24, spellcheck: false,
+      value: value || "",
+    });
+    this.status = h("div.kf-editor-status");
+    this.dirty = false;
+    this.area.addEventListener("input", () => {
+      this.dirty = true;
+      this.refresh();
+      if (onChange) onChange();
+    });
+    this.area.addEventListener("scroll", () => {
+      this.gutter.scrollTop = this.area.scrollTop;
+    });
+    this.area.addEventListener("keydown", (e) => {
+      if (e.key === "Tab") {
+        e.preventDefault();
+        const { selectionStart: s, selectionEnd: end } = this.area;
+        this.area.setRangeText("  ", s, end, "end");
+        this.dirty = true;
+        this.refresh();
+      }
+    });
+    this.element = h("div.kf-editor", {},
+      h("div.kf-editor-body", {}, this.gutter, this.area),
+      this.status);
+    this.refresh();
+  }
+
+  value() {
+    return this.area.value;
+  }
+
+  setValue(text) {
+    this.area.value = text;
+    this.dirty = false;
+    this.refresh();
+  }
+
+  setObject(obj) {
+    this.setValue(yamlDump(obj));
+  }
+
+  parsed() {
+    return yamlParse(this.value());
+  }
+
+  refresh() {
+    const lines = this.value().split("\n").length;
+    this.gutter.textContent = Array.from(
+      { length: lines }, (_, i) => i + 1).join("\n");
+    try {
+      this.parsed();
+      this.setStatus("yaml ok", "");
+      return true;
+    } catch (e) {
+      this.setStatus(e.message, "error", e.line);
+      return false;
+    }
+  }
+
+  setStatus(message, kind, line) {
+    this.status.textContent = message;
+    this.status.className = "kf-editor-status " + (kind || "");
+    this.errorLine = line || null;
+  }
+}
+
+export { yamlDump, yamlParse };
+
 export {
   api, h, clear, snack, confirmDialog, Poller, Router, currentNamespace,
 };

@@ -1,0 +1,346 @@
+/* Minimal YAML for Kubernetes manifests: dump + parse.
+ *
+ * The in-browser counterpart of the reference common-lib editor module
+ * (kubeflow-common-lib lib/resource-editor uses monaco + js-yaml; this
+ * no-build tier implements the k8s-manifest subset by hand): nested
+ * mappings, sequences, scalars (quoted/plain), block literals (| / |-),
+ * inline flow [] and {}, comments. No anchors, tags, or multi-doc.
+ *
+ * parse() throws YamlError with a 1-based .line so the editor can point
+ * at the offending row; dump(parse(x)) is stable for k8s CRs.
+ */
+
+export class YamlError extends Error {
+  constructor(message, line) {
+    super(line ? `line ${line}: ${message}` : message);
+    this.line = line;
+  }
+}
+
+/* ------------------------------------------------------------- dump */
+
+const PLAIN = /^[A-Za-z$%_/][A-Za-z0-9_./@%+-]*$/;
+
+function scalar(v) {
+  if (v === null || v === undefined) return "null";
+  if (typeof v === "boolean" || typeof v === "number") return String(v);
+  const s = String(v);
+  if (s !== "" && PLAIN.test(s)
+      && !/^(true|false|null|yes|no|on|off)$/i.test(s)
+      && !/^[+-]?(\d|\.\d)/.test(s)) {
+    return s;
+  }
+  return JSON.stringify(s);
+}
+
+function dumpNode(v, indent) {
+  const pad = "  ".repeat(indent);
+  if (Array.isArray(v)) {
+    if (!v.length) return " []\n";
+    let out = "\n";
+    for (const item of v) {
+      if (item !== null && typeof item === "object"
+          && Object.keys(item).length) {
+        const body = dumpNode(item, indent + 1);
+        /* fold the first key onto the "- " line */
+        out += `${pad}-${body.replace(/^\n/, " ").replace(
+          new RegExp(`^${"  ".repeat(indent + 1)}`), "")}`;
+      } else {
+        out += `${pad}- ${dumpNode(item, indent + 1).replace(/^ /, "")
+          .replace(/\n$/, "")}\n`;
+      }
+    }
+    return out;
+  }
+  if (v !== null && typeof v === "object") {
+    const keys = Object.keys(v);
+    if (!keys.length) return " {}\n";
+    let out = "\n";
+    for (const k of keys) {
+      const body = dumpNode(v[k], indent + 1);
+      out += `${pad}${scalar(k)}:${body}`;
+    }
+    return out;
+  }
+  if (typeof v === "string" && v.includes("\n")) {
+    const lines = v.replace(/\n$/, "").split("\n");
+    const chomp = v.endsWith("\n") ? "" : "-";
+    return ` |${chomp}\n` + lines.map(
+      (l) => "  ".repeat(indent) + l).join("\n") + "\n";
+  }
+  return ` ${scalar(v)}\n`;
+}
+
+export function dump(obj) {
+  const out = dumpNode(obj, 0);
+  return out.replace(/^\n/, "").replace(/^ /, "");
+}
+
+/* ------------------------------------------------------------ parse */
+
+function parseScalar(text, line) {
+  const s = text.trim();
+  if (s === "" || s === "~" || s === "null") return null;
+  if (s === "true") return true;
+  if (s === "false") return false;
+  if (/^[+-]?\d+$/.test(s)) return parseInt(s, 10);
+  if (/^[+-]?(\d+\.\d*|\.\d+|\d+)([eE][+-]?\d+)?$/.test(s)) {
+    return parseFloat(s);
+  }
+  if (s.startsWith('"') || s.startsWith("'")) {
+    const q = s[0];
+    if (!s.endsWith(q) || s.length < 2) {
+      throw new YamlError("unterminated quoted string", line);
+    }
+    if (q === '"') {
+      try { return JSON.parse(s); } catch (e) {
+        throw new YamlError("bad double-quoted string", line);
+      }
+    }
+    return s.slice(1, -1).replace(/''/g, "'");
+  }
+  if (s.startsWith("[") || s.startsWith("{")) return parseFlow(s, line);
+  return s;
+}
+
+function parseFlow(s, line) {
+  /* inline [a, b] / {k: v} — tokenize then recurse */
+  let i = 0;
+  function ws() { while (i < s.length && /\s/.test(s[i])) i++; }
+  function value() {
+    ws();
+    if (s[i] === "[") {
+      i++; const arr = [];
+      ws();
+      if (s[i] === "]") { i++; return arr; }
+      for (;;) {
+        arr.push(value());
+        ws();
+        if (s[i] === ",") { i++; continue; }
+        if (s[i] === "]") { i++; return arr; }
+        throw new YamlError("expected , or ] in flow sequence", line);
+      }
+    }
+    if (s[i] === "{") {
+      i++; const obj = {};
+      ws();
+      if (s[i] === "}") { i++; return obj; }
+      for (;;) {
+        ws();
+        const k = token(":");
+        ws();
+        if (s[i] !== ":") {
+          throw new YamlError("expected : in flow mapping", line);
+        }
+        i++;                      // consume ':'
+        obj[k] = value();
+        ws();
+        if (s[i] === ",") { i++; continue; }
+        if (s[i] === "}") { i++; return obj; }
+        throw new YamlError("expected , or } in flow mapping", line);
+      }
+    }
+    return parseScalar(token(",]}"), line);
+  }
+  function token(stops) {
+    ws();
+    if (s[i] === '"' || s[i] === "'") {
+      const q = s[i]; let j = i + 1;
+      while (j < s.length && s[j] !== q) j += (s[j] === "\\" ? 2 : 1);
+      if (j >= s.length) {
+        throw new YamlError("unterminated quoted string", line);
+      }
+      const raw = s.slice(i, j + 1);
+      i = j + 1;
+      return parseScalar(raw, line);
+    }
+    let j = i;
+    while (j < s.length && !stops.includes(s[j])) j++;
+    const raw = s.slice(i, j).trim();
+    i = j;
+    return raw;
+  }
+  const v = value();
+  ws();
+  if (i !== s.length) throw new YamlError("trailing flow content", line);
+  return v;
+}
+
+function stripComment(raw) {
+  let inS = false, inD = false;
+  for (let i = 0; i < raw.length; i++) {
+    const c = raw[i];
+    if (c === "\\" && inD) i++;              // escaped char in "…"
+    else if (c === "'" && !inD) inS = !inS;
+    else if (c === '"' && !inS) inD = !inD;
+    else if (c === "#" && !inS && !inD
+             && (i === 0 || /\s/.test(raw[i - 1]))) {
+      return raw.slice(0, i);
+    }
+  }
+  return raw;
+}
+
+export function parse(text) {
+  const rows = [];
+  const src = text.split("\n");
+  for (let n = 0; n < src.length; n++) {
+    const noComment = stripComment(src[n]);
+    if (!noComment.trim()) continue;
+    if (noComment.trim() === "---") {
+      if (rows.length) throw new YamlError("multi-document", n + 1);
+      continue;
+    }
+    const indent = noComment.match(/^ */)[0].length;
+    if (noComment[indent] === "\t") {
+      throw new YamlError("tabs are not allowed for indentation", n + 1);
+    }
+    rows.push({ indent, text: noComment.trim(), line: n + 1, n, src });
+  }
+  if (!rows.length) return null;
+  const [value, next] = parseBlock(rows, 0, rows[0].indent);
+  if (next !== rows.length) {
+    throw new YamlError("unexpected dedent/content", rows[next].line);
+  }
+  return value;
+}
+
+function keySplit(text, line) {
+  /* split "key: rest" respecting quoted keys; null if not a mapping */
+  let i = 0;
+  if (text[0] === '"' || text[0] === "'") {
+    const q = text[0];
+    i = 1;
+    while (i < text.length && text[i] !== q) i += (text[i] === "\\" ? 2 : 1);
+    if (i >= text.length) {
+      throw new YamlError("unterminated quoted key", line);
+    }
+    i++;
+  } else {
+    while (i < text.length && text[i] !== ":") i++;
+  }
+  while (i < text.length && text[i] !== ":") i++;
+  if (i >= text.length) return null;
+  if (i + 1 < text.length && !/\s/.test(text[i + 1])) return null;
+  const key = parseScalar(text.slice(0, i), line);
+  return [String(key), text.slice(i + 1).trim()];
+}
+
+function parseBlockScalar(rows, i, parentIndent, header, headerN, src) {
+  /* literal content comes from the RAW source lines starting right
+   * after the header: '#' is content here (shebangs!), and blank
+   * interior lines are preserved — the structural rows already had
+   * comments stripped and blanks dropped, so they only delimit. */
+  const chomp = header.includes("-") ? "" : "\n";
+  let j = i;
+  while (j < rows.length && rows[j].indent > parentIndent) j++;
+  const end = j < rows.length ? rows[j].n : src.length;
+  let base = null;
+  const lines = [];
+  for (const raw of src.slice(headerN + 1, end)) {
+    if (!raw.trim()) {
+      lines.push("");
+      continue;
+    }
+    const indent = raw.match(/^ */)[0].length;
+    if (indent <= parentIndent) break;  // stripped trailing comment
+    if (base === null) base = indent;
+    lines.push(raw.slice(Math.min(base, indent)));
+  }
+  while (lines.length && lines[lines.length - 1] === "") lines.pop();
+  return [lines.join("\n") + (lines.length ? chomp : ""), j];
+}
+
+function parseBlock(rows, i, indent) {
+  const row = rows[i];
+  if (row.text.startsWith("- ") || row.text === "-") {
+    const arr = [];
+    let j = i;
+    while (j < rows.length && rows[j].indent === indent
+           && (rows[j].text.startsWith("- ") || rows[j].text === "-")) {
+      const rest = rows[j].text === "-" ? ""
+        : rows[j].text.slice(2).trim();
+      if (!rest) {
+        /* nested block on following lines */
+        if (j + 1 < rows.length && rows[j + 1].indent > indent) {
+          const [v, next] = parseBlock(rows, j + 1, rows[j + 1].indent);
+          arr.push(v);
+          j = next;
+        } else {
+          arr.push(null);
+          j++;
+        }
+        continue;
+      }
+      const kv = keySplit(rest, rows[j].line);
+      if (kv) {
+        /* map starting on the dash line: re-enter with a synthetic row
+         * at indent+2 (the canonical k8s style) */
+        const synthetic = { indent: indent + 2, text: rest,
+                            line: rows[j].line, n: rows[j].n,
+                            src: rows[j].src };
+        const tail = rows.slice(j + 1);
+        const sub = [synthetic];
+        let k = 0;
+        while (k < tail.length && tail[k].indent > indent) {
+          sub.push(tail[k]);
+          k++;
+        }
+        const [v, consumed] = parseBlock(sub, 0, indent + 2);
+        if (consumed !== sub.length) {
+          throw new YamlError("bad indentation in sequence item",
+                              sub[consumed].line);
+        }
+        arr.push(v);
+        j = j + 1 + k;
+        continue;
+      }
+      arr.push(parseScalar(rest, rows[j].line));
+      j++;
+    }
+    return [arr, j];
+  }
+
+  const obj = {};
+  let j = i;
+  while (j < rows.length && rows[j].indent === indent) {
+    const kv = keySplit(rows[j].text, rows[j].line);
+    if (!kv) {
+      if (j === i) {
+        return [parseScalar(rows[j].text, rows[j].line), j + 1];
+      }
+      throw new YamlError(`expected "key: value"`, rows[j].line);
+    }
+    const [key, rest] = kv;
+    if (key in obj) throw new YamlError(`duplicate key ${key}`,
+                                        rows[j].line);
+    if (rest === "" || rest === "|" || rest === "|-" || rest === ">"
+        || rest === ">-") {
+      const nxt = rows[j + 1];
+      const hasChild = nxt !== undefined && nxt.indent > indent;
+      /* kubectl-style zero-indent sequences: a list under a key may
+       * sit at the SAME indent as the key (valid YAML, ubiquitous in
+       * k8s docs) — the sequence loop stops at the first non-dash row
+       * at that indent, so the mapping resumes correctly after it */
+      const dashChild = nxt !== undefined && nxt.indent === indent
+        && (nxt.text.startsWith("- ") || nxt.text === "-");
+      if (rest.startsWith("|") || rest.startsWith(">")) {
+        const [v, next] = parseBlockScalar(rows, j + 1, indent, rest,
+                                           rows[j].n, rows[j].src);
+        obj[key] = rest.startsWith(">") ? v.replace(/\n(?!$)/g, " ") : v;
+        j = next;
+      } else if (hasChild || dashChild) {
+        const [v, next] = parseBlock(rows, j + 1, nxt.indent);
+        obj[key] = v;
+        j = next;
+      } else {
+        obj[key] = null;
+        j++;
+      }
+    } else {
+      obj[key] = parseScalar(rest, rows[j].line);
+      j++;
+    }
+  }
+  return [obj, j];
+}

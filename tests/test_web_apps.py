@@ -453,6 +453,187 @@ class TestNotebookDryRun:
                              "dr-nb-ws", "team-a") is None
 
 
+class TestRawNotebookCreate:
+    """YAML-editor contract (?raw=true): the body IS the Notebook CR;
+    ?render=true returns the form's CR without creating (editor seed);
+    dry-run surfaces schema/admission errors in the editor."""
+
+    def _cr(self, name="raw-nb", **md):
+        return {"apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+                "metadata": {"name": name, **md},
+                "spec": {"template": {"spec": {"containers": [{
+                    "name": name, "image": "img:1"}]}}}}
+
+    def test_raw_create(self, platform):
+        store, mgr = platform
+        c = client(jupyter.create_app(store))
+        r = c.post("/api/namespaces/team-a/notebooks?raw=true",
+                   json_body=self._cr())
+        assert r.status == 200, r.json
+        nb = store.get("kubeflow.org/v1beta1", "Notebook", "raw-nb",
+                       "team-a")
+        assert m.namespace_of(nb) == "team-a"
+
+    def test_raw_dry_run_creates_nothing(self, platform):
+        store, _ = platform
+        c = client(jupyter.create_app(store))
+        r = c.post(
+            "/api/namespaces/team-a/notebooks?raw=true&dry_run=true",
+            json_body=self._cr())
+        assert r.status == 200, r.json
+        assert store.try_get("kubeflow.org/v1beta1", "Notebook",
+                             "raw-nb", "team-a") is None
+
+    def test_raw_rejects_wrong_kind_and_namespace(self, platform):
+        store, _ = platform
+        c = client(jupyter.create_app(store))
+        bad_kind = self._cr()
+        bad_kind["kind"] = "Pod"
+        assert c.post("/api/namespaces/team-a/notebooks?raw=true",
+                      json_body=bad_kind).status == 400
+        cross_ns = self._cr(namespace="team-b")
+        r = c.post("/api/namespaces/team-a/notebooks?raw=true",
+                   json_body=cross_ns)
+        assert r.status == 400
+        assert "namespace" in r.json["log"]
+        assert c.post("/api/namespaces/team-a/notebooks?raw=true",
+                      json_body={"kind": "Notebook",
+                                 "apiVersion": "kubeflow.org/v1beta1",
+                                 "metadata": {}}).status == 400
+
+    def test_raw_admission_denial_surfaces(self, platform):
+        store, _ = platform
+        from kubeflow_tpu.core.errors import AdmissionDeniedError
+
+        def deny(operation, obj, old):
+            raise AdmissionDeniedError("notebooks are frozen today")
+
+        store.register_validating_hook(
+            deny, match=lambda g, k, ns: k == "Notebook")
+        c = client(jupyter.create_app(store))
+        r = c.post(
+            "/api/namespaces/team-a/notebooks?raw=true&dry_run=true",
+            json_body=self._cr())
+        assert r.status == 400
+        assert "frozen" in r.json["log"]
+
+    def test_render_returns_cr_without_creating(self, platform):
+        store, _ = platform
+        c = client(jupyter.create_app(store))
+        r = c.post("/api/namespaces/team-a/notebooks?render=true",
+                   json_body={"name": "seeded"})
+        assert r.status == 200, r.json
+        assert r.json["notebook"]["kind"] == "Notebook"
+        assert r.json["notebook"]["metadata"]["name"] == "seeded"
+        assert store.try_get("kubeflow.org/v1beta1", "Notebook",
+                             "seeded", "team-a") is None
+
+
+class TestPodDefaultAuthoring:
+    """Dashboard PodDefault CRUD (VERDICT r2 missing #2): full-CR
+    list/create/update/delete with dry-run, authz-gated."""
+
+    def _pd(self, name="pd1", **spec):
+        return {"apiVersion": "kubeflow.org/v1alpha1",
+                "kind": "PodDefault",
+                "metadata": {"name": name},
+                "spec": {"selector": {"matchLabels": {name: "true"}},
+                         "desc": "test", **spec}}
+
+    def test_create_list_update_delete(self, platform):
+        store, _ = platform
+        c = client(dashboard.create_app(store))
+        assert c.post("/api/namespaces/team-a/poddefaults",
+                      json_body=self._pd()).status == 200
+        listed = c.get("/api/namespaces/team-a/poddefaults").json
+        assert [p["metadata"]["name"]
+                for p in listed["poddefaults"]] == ["pd1"]
+        update = self._pd(env=[{"name": "A", "value": "1"}])
+        assert c.put("/api/namespaces/team-a/poddefaults/pd1",
+                     json_body=update).status == 200
+        live = store.get("kubeflow.org/v1alpha1", "PodDefault", "pd1",
+                         "team-a")
+        assert live["spec"]["env"] == [{"name": "A", "value": "1"}]
+        assert c.delete(
+            "/api/namespaces/team-a/poddefaults/pd1").status == 200
+        assert store.try_get("kubeflow.org/v1alpha1", "PodDefault",
+                             "pd1", "team-a") is None
+
+    def test_dry_run_creates_nothing(self, platform):
+        store, _ = platform
+        c = client(dashboard.create_app(store))
+        r = c.post("/api/namespaces/team-a/poddefaults?dry_run=true",
+                   json_body=self._pd())
+        assert r.status == 200, r.json
+        assert store.try_get("kubeflow.org/v1alpha1", "PodDefault",
+                             "pd1", "team-a") is None
+
+    def test_update_dry_run_hits_admission_without_writing(
+            self, platform):
+        store, _ = platform
+        from kubeflow_tpu.core.errors import AdmissionDeniedError
+        c = client(dashboard.create_app(store))
+        c.post("/api/namespaces/team-a/poddefaults",
+               json_body=self._pd())
+
+        def deny(operation, obj, old):
+            if operation == "UPDATE" and \
+                    (obj.get("spec") or {}).get("env"):
+                raise AdmissionDeniedError("env injection is frozen")
+
+        store.register_validating_hook(
+            deny, match=lambda g, k, ns: k == "PodDefault")
+        bad = self._pd(env=[{"name": "A", "value": "1"}])
+        r = c.put("/api/namespaces/team-a/poddefaults/pd1?dry_run=true",
+                  json_body=bad)
+        assert r.status == 400
+        assert "frozen" in r.json["log"]
+        # a passing dry-run writes nothing
+        ok = self._pd()
+        r = c.put("/api/namespaces/team-a/poddefaults/pd1?dry_run=true",
+                  json_body=ok)
+        assert r.status == 200, r.json
+        live = store.get("kubeflow.org/v1alpha1", "PodDefault", "pd1",
+                         "team-a")
+        assert "env" not in live["spec"]
+
+    def test_selector_required(self, platform):
+        store, _ = platform
+        c = client(dashboard.create_app(store))
+        pd = self._pd()
+        del pd["spec"]["selector"]
+        r = c.post("/api/namespaces/team-a/poddefaults", json_body=pd)
+        assert r.status == 400
+        assert "selector" in r.json["log"]
+
+    def test_update_name_mismatch_is_400(self, platform):
+        store, _ = platform
+        c = client(dashboard.create_app(store))
+        c.post("/api/namespaces/team-a/poddefaults",
+               json_body=self._pd())
+        r = c.put("/api/namespaces/team-a/poddefaults/pd1",
+                  json_body=self._pd(name="other"))
+        assert r.status == 400
+
+    def test_non_member_cannot_author(self, platform):
+        store, _ = platform
+        c = client(dashboard.create_app(store), headers=MALLORY)
+        r = c.post("/api/namespaces/team-a/poddefaults",
+                   json_body=self._pd())
+        assert r.status == 403
+
+    def test_authored_poddefault_reaches_spawn_form(self, platform):
+        """The authored CR flows through the admission plane's listing
+        the JWA form reads — authoring closes the loop end to end."""
+        store, _ = platform
+        dc = client(dashboard.create_app(store))
+        dc.post("/api/namespaces/team-a/poddefaults",
+                json_body=self._pd(name="tpu-env"))
+        jc = client(jupyter.create_app(store))
+        pds = jc.get("/api/namespaces/team-a/poddefaults").json
+        assert [p["name"] for p in pds["poddefaults"]] == ["tpu-env"]
+
+
 class TestKfamSubjectKinds:
     """Group/ServiceAccount contributor subjects (rbac Subject kinds;
     mesh AuthorizationPolicy only for User — the identity header

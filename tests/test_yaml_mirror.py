@@ -1,0 +1,112 @@
+"""Executable coverage for the in-browser YAML lib's algorithm.
+
+The unit image has no JS engine, so lib/yaml.js itself runs only in the
+browser tier (tests/browser test_yaml_lib_roundtrip_battery). This
+module runs the SAME battery against tests/yaml_mirror.py — a
+line-for-line Python transliteration — and pins yaml.js by hash so the
+mirror cannot drift: editing the JS fails test_mirror_is_in_sync until
+the mirror (and both batteries) are updated together.
+"""
+
+import hashlib
+import os
+
+import pytest
+
+import yaml_mirror as y
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+YAML_JS = os.path.join(REPO, "kubeflow_tpu", "web", "static", "lib",
+                       "yaml.js")
+
+#: sha256 of the yaml.js this mirror transliterates — update BOTH files
+#: together (and keep the browser battery in sync)
+YAML_JS_SHA = "d1f2bc4eca6329e32349f2eb0b2d25405eb61396dc0cdc403489c1d95a5776f6"
+
+ROUNDTRIP_CASES = [
+    {"apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+     "metadata": {"name": "nb", "namespace": "team-a",
+                  "labels": {"app": "x"}, "annotations": {}},
+     "spec": {"template": {"spec": {"containers": [{
+         "name": "nb", "image": "img:1",
+         "command": ["sh", "-c", "run"],
+         "resources": {"requests": {"cpu": "500m", "memory": "1Gi"},
+                       "limits": {"google.com/tpu": "4"}},
+         "env": [{"name": "A", "value": "1"},
+                 {"name": "B", "valueFrom": {"fieldRef": {
+                     "fieldPath": "metadata.name"}}}]}],
+         "nodeSelector": {}, "tolerations": []}}}},
+    {"a": None, "b": True, "c": False, "d": 0, "e": -1.5, "f": "",
+     "g": "with spaces", "h": "1234x", "i": [1, [2, 3], {"k": "v"}],
+     "weird key": "#notacomment", "j": "line1\nline2\n"},
+    {"script": "#!/bin/sh\necho hi\nexit 0\n", "num": "007"},
+    [],
+    [{"name": "a"}, {"name": "b", "nested": {"deep": [1, 2]}}],
+    {"apiVersion": "kubeflow.org/v1alpha1", "kind": "PodDefault",
+     "metadata": {"name": "pd", "namespace": "team-a"},
+     "spec": {"selector": {"matchLabels": {"pd": "true"}},
+              "desc": "quoted: because of the colon",
+              "env": [{"name": "E", "value": "v"}]}},
+    # escaped quote followed by space-hash inside a double-quoted
+    # string: the comment stripper must honor backslash escapes
+    {"k": 'a" #x', "arg": 'say "hi" # not a comment'},
+]
+
+HANDWRITTEN = [
+    ("a: 1\nb:\n  - x\n  - y\n", {"a": 1, "b": ["x", "y"]}),
+    ("# comment\nkey: value # trailing\n", {"key": "value"}),
+    ("flow: [1, two, {k: v}]\n", {"flow": [1, "two", {"k": "v"}]}),
+    ("empty:\nnext: 1\n", {"empty": None, "next": 1}),
+    ('q: "a: b"\n', {"q": "a: b"}),
+    ("- name: x\n  v: 1\n- name: y\n",
+     [{"name": "x", "v": 1}, {"name": "y"}]),
+    ("- script: |\n    #!/bin/sh\n    run\n  name: x\n",
+     [{"script": "#!/bin/sh\nrun\n", "name": "x"}]),
+    ("cmd: |-\n  line1\n\n  line3\n", {"cmd": "line1\n\nline3"}),
+    ("url: http://x/y#frag\n", {"url": "http://x/y#frag"}),
+    ("n: 007\ns: 'single'\n", {"n": 7, "s": "single"}),
+    # kubectl-style zero-indent sequence under a key
+    ("containers:\n- name: x\n  image: i\n- name: y\nafter: 1\n",
+     {"containers": [{"name": "x", "image": "i"}, {"name": "y"}],
+      "after": 1}),
+    # whitespace before the colon in a flow mapping with a quoted key
+    ('f: {"a:b" : v}\n', {"f": {"a:b": "v"}}),
+]
+
+
+def test_mirror_is_in_sync():
+    digest = hashlib.sha256(open(YAML_JS, "rb").read()).hexdigest()
+    assert digest == YAML_JS_SHA, (
+        "lib/yaml.js changed — re-sync tests/yaml_mirror.py (and the "
+        "browser battery in tests/browser/test_ui_flows.py), rerun "
+        f"this suite, then pin YAML_JS_SHA = \"{digest}\"")
+
+
+@pytest.mark.parametrize("case", ROUNDTRIP_CASES,
+                         ids=lambda c: str(type(c).__name__))
+def test_roundtrip(case):
+    assert y.parse(y.dump(case)) == case
+
+
+@pytest.mark.parametrize("src,want", HANDWRITTEN)
+def test_handwritten(src, want):
+    assert y.parse(src) == want
+
+
+def test_errors_carry_line_numbers():
+    with pytest.raises(y.YamlError) as e:
+        y.parse("a: 1\n\tb: 2\n")
+    assert e.value.line == 2
+    with pytest.raises(y.YamlError) as e:
+        y.parse('a: "unterminated\n')
+    assert e.value.line == 1
+    with pytest.raises(y.YamlError) as e:
+        y.parse("a: 1\na: 2\n")
+    assert "duplicate" in str(e.value)
+
+
+def test_dump_is_yaml_not_json():
+    out = y.dump(ROUNDTRIP_CASES[0])
+    assert out.startswith("apiVersion: kubeflow.org/v1beta1\n")
+    assert "{" not in out.split("\n")[0]
+    assert "- name: nb" in out
