@@ -234,7 +234,7 @@ def bench_serving(steps, batch):
 
     infer_ms = []
 
-    def post(retries=8):
+    def post(body=None, retries=8):
         """→ (json, successful_attempt_seconds, failed_attempts).
 
         The reference's serving contract test retries transient
@@ -242,20 +242,24 @@ def bench_serving(steps, batch):
         same idiom here so one device or tunnel hiccup can't fail the
         bench. Only the successful attempt's time is returned — failed
         round-trips and retry sleeps must not pollute the recorded
-        latency/throughput (they're surfaced via the retry count)."""
+        latency/throughput (they're surfaced via the retry count).
+        The timed span covers request + full response body read+parse,
+        identically for every payload (JSON vs b64 comparisons must
+        measure the same thing)."""
         import sys
         import urllib.error
         for attempt in range(retries):
             req = urllib.request.Request(
-                url, data=payload,
+                url, data=body if body is not None else payload,
                 headers={"Content-Type": "application/json"})
             t1 = time.perf_counter()
             try:
                 resp = urllib.request.urlopen(req, timeout=120)
+                out = _json.load(resp)
                 break
             except urllib.error.HTTPError as e:
-                body = e.read().decode(errors="replace")[:300]
-                err = f"HTTP {e.code} {body}"
+                detail = e.read().decode(errors="replace")[:300]
+                err = f"HTTP {e.code} {detail}"
                 if e.code < 500 and e.code not in (408, 429):
                     # caller fault per the serving taxonomy
                     # (compute/serving.py: 400 = malformed request) —
@@ -275,7 +279,16 @@ def bench_serving(steps, batch):
         hdr = resp.headers.get("X-Inference-Time-Ms")
         if hdr:
             infer_ms.append(float(hdr))
-        return _json.load(resp), elapsed, attempt
+        return out, elapsed, attempt
+
+    # binary tensor path (serving.py b64 contract): same route, raw
+    # little-endian buffer instead of JSON float lists — measures what
+    # a framework-native client gets once the JSON transport is gone
+    import base64 as _b64
+    arr = np.asarray(instances, dtype=np.float32)
+    bin_payload = _json.dumps({"tensor": {
+        "dtype": "float32", "shape": list(arr.shape),
+        "b64": _b64.b64encode(arr.tobytes()).decode()}}).encode()
 
     try:
         post(); post()  # compile + warm
@@ -285,6 +298,8 @@ def bench_serving(steps, batch):
             _, elapsed, failures = post()
             lat.append(elapsed)
             retried += failures
+        post(bin_payload)      # warm the binary path
+        bin_lat = sorted(post(bin_payload)[1] for _ in range(steps))
     finally:
         server.stop()
     dt = sum(lat)       # successful attempts only (see post())
@@ -304,7 +319,13 @@ def bench_serving(steps, batch):
                        # p50−infer gap is JSON transport (the contract)
                        "infer_p50_ms": round(
                            infer_ms[len(infer_ms) // 2], 1)
-                           if infer_ms else None}}
+                           if infer_ms else None,
+                       # the b64 tensor contract on the same route —
+                       # what a native client gets without JSON floats
+                       "b64_p50_ms": round(
+                           1000 * bin_lat[len(bin_lat) // 2], 1),
+                       "b64_predictions_per_sec": round(
+                           steps * batch / sum(bin_lat), 1)}}
 
 
 def bench_study(steps, batch):

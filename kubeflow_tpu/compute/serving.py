@@ -10,9 +10,17 @@ substrate:
 - per-model jitted predict fn (bf16 on MXU, donation-free, batched),
 - dynamic-batch bucketing to a few padded sizes so XLA compiles a
   handful of programs instead of one per request shape,
-- ``/v1/models/<name>`` status endpoint for readiness probes.
+- ``/v1/models/<name>`` status endpoint for readiness probes,
+- a binary tensor encoding riding the same route: JSON float lists
+  dominate predict latency at image sizes (BASELINE.md: ~60 ms device
+  vs ~150 ms p50), so in the spirit of TF-Serving's ``{"b64": ...}``
+  convention the body may carry the whole batch as
+  ``{"tensor": {"dtype", "shape", "b64"}}`` (base64 of the raw
+  little-endian buffer) and the response mirrors it. The reference
+  ``instances`` contract is untouched.
 """
 
+import base64
 import json
 import queue
 import threading
@@ -21,6 +29,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import jax
 import numpy as np
+
+#: dtypes accepted on the binary tensor path (little-endian raw bytes)
+TENSOR_DTYPES = {"float32", "float16", "int32", "int8", "uint8"}
 
 #: pad request batches up to one of these (one XLA program each)
 BATCH_BUCKETS = (1, 8, 64, 256)
@@ -108,7 +119,11 @@ class _Batcher:
             if nxt is None:        # stop(): flush what we collected
                 stopping = True
                 break
-            if nxt["x"].shape[1:] != first["x"].shape[1:]:
+            if nxt["x"].shape[1:] != first["x"].shape[1:] \
+                    or nxt["x"].dtype != first["x"].dtype:
+                # dtype matters too: the tensor path can carry uint8
+                # etc., and np.concatenate would silently promote —
+                # results must not depend on concurrent traffic
                 solo.append(nxt)
                 continue
             group.append(nxt)
@@ -167,24 +182,61 @@ class ServedModel:
     def predict(self, instances):
         return self.predict_timed(instances)[0]
 
-    def predict_timed(self, instances):
-        """→ (predictions, device_ms). Timing returned per-call (no
-        shared state: the HTTP server is threaded)."""
-        x = np.asarray(instances)
+    def predict_raw(self, x):
+        """→ (ndarray, device_ms) — the binary-path core; the JSON path
+        wraps it. Timing returned per-call (no shared state: the HTTP
+        server is threaded)."""
+        x = np.asarray(x)
         if x.ndim == 0:
             raise ValueError(
                 "instances must be a list of inputs, got a scalar")
         if self._batcher is not None:
-            out, ms = self._batcher.submit(x)
-            return out.tolist(), ms
+            return self._batcher.submit(x)
         t0 = time.perf_counter()
         out = self._run(x)
-        infer_ms = 1000 * (time.perf_counter() - t0)
-        return out.tolist(), infer_ms
+        return out, 1000 * (time.perf_counter() - t0)
+
+    def predict_timed(self, instances):
+        out, ms = self.predict_raw(instances)
+        return out.tolist(), ms
 
     def close(self):
         if self._batcher is not None:
             self._batcher.stop()
+
+
+def _decode_tensor(t):
+    """``{"dtype", "shape", "b64"}`` → ndarray; malformed → ValueError
+    (→ HTTP 400: every defect here is the caller's)."""
+    if not isinstance(t, dict):
+        raise ValueError("tensor must be an object")
+    dtype = t.get("dtype")
+    if dtype not in TENSOR_DTYPES:
+        raise ValueError(f"tensor.dtype must be one of "
+                         f"{sorted(TENSOR_DTYPES)}, got {dtype!r}")
+    shape = t.get("shape")
+    if not isinstance(shape, list) or not shape \
+            or not all(isinstance(d, int) and d >= 0 for d in shape):
+        raise ValueError("tensor.shape must be a list of ints")
+    data = base64.b64decode(t.get("b64") or "", validate=True)
+    want = int(np.prod(shape)) * np.dtype(dtype).itemsize
+    if len(data) != want:
+        raise ValueError(
+            f"tensor data is {len(data)} bytes, shape×dtype needs {want}")
+    return np.frombuffer(data, dtype=np.dtype(dtype).newbyteorder("<"))\
+        .reshape(shape)
+
+
+def _encode_tensor(x):
+    x = np.ascontiguousarray(x)
+    if x.dtype.name not in TENSOR_DTYPES:
+        x = x.astype(np.float32)
+    if x.dtype.byteorder == ">":        # big-endian host: swap once
+        x = x.astype(x.dtype.newbyteorder("<"))
+    # native/little-endian arrays serialize without an extra copy —
+    # this is the hot path the binary contract exists to make cheap
+    return {"dtype": x.dtype.name, "shape": list(x.shape),
+            "b64": base64.b64encode(x.tobytes()).decode()}
 
 
 class ModelServer:
@@ -255,14 +307,19 @@ class ModelServer:
                 # 400 = the caller's fault (malformed body); 500 = ours
                 # (inference failed) — clients like the reference's
                 # test_tf_serving retry loop key off the distinction
+                binary = False
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length) or b"{}")
-                    instances = req["instances"]
+                    if "tensor" in req:
+                        binary = True
+                        x = _decode_tensor(req["tensor"])
+                    else:
+                        x = req["instances"]
                 except (ValueError, KeyError, TypeError) as e:
                     return self._send(400, {"error": f"bad request: {e}"})
                 try:
-                    predictions, infer = model.predict_timed(instances)
+                    out, infer = model.predict_raw(x)
                 except ValueError as e:     # scalar/ragged instances
                     return self._send(400, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 — wire boundary
@@ -271,8 +328,13 @@ class ModelServer:
                 # success write OUTSIDE the try: a client reset mid-body
                 # must not trigger a second (500) response on the wire
                 # (device-time header: JSON transport dominates at image
-                # sizes, the breakdown keeps that visible)
-                self._send(200, {"predictions": predictions},
+                # sizes on the instances path, the breakdown keeps that
+                # visible; the tensor path exists to remove it)
+                if binary:
+                    payload = {"tensor": _encode_tensor(out)}
+                else:
+                    payload = {"predictions": out.tolist()}
+                self._send(200, payload,
                            (("X-Inference-Time-Ms", f"{infer:.1f}"),))
 
         return Handler

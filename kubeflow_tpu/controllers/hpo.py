@@ -28,7 +28,8 @@ import statistics
 
 import numpy as np
 
-__all__ = ["tpe_sample", "median_should_stop", "N_STARTUP"]
+__all__ = ["tpe_sample", "median_should_stop", "asha_should_stop",
+           "N_STARTUP"]
 
 #: trials sampled space-fillingly before the model kicks in
 N_STARTUP = 5
@@ -147,3 +148,58 @@ def median_should_stop(reports, peer_reports, maximize,
     best = max(v for _, v in reports) if maximize else \
         min(v for _, v in reports)
     return best < med if maximize else best > med
+
+
+# ------------------------------------------------------ hyperband/ASHA
+
+def _best_at(reports, step, maximize):
+    vals = [v for s, v in (reports or []) if s <= step]
+    if not vals:
+        return None
+    return max(vals) if maximize else min(vals)
+
+
+def asha_should_stop(reports, peer_reports, maximize,
+                     min_resource=1, eta=3):
+    """Asynchronous successive halving (ASHA, Li et al. 2018 — the
+    parallelism-friendly Hyperband): rungs sit at
+    ``min_resource * eta^k`` steps; when the candidate reaches a rung,
+    it continues only if its best-so-far objective is in the top
+    ``1/eta`` of everything observed at that rung. Unlike synchronous
+    Hyperband there is no bracket barrier — a trial is judged against
+    whatever has reached the rung so far, so chips never idle waiting
+    for a bracket to fill.
+
+    ``reports``/``peer_reports``: [(step, value)] as stored by the
+    StudyJob reconciler. Returns True when the candidate should be
+    killed at its highest reached rung."""
+    # spec values are user-controlled: clamp so a degenerate eta or
+    # resource can never spin this loop forever (the reconciler also
+    # rejects them up front with InvalidSpec; this is defense in depth)
+    eta = max(2, int(eta))
+    min_resource = max(1, int(min_resource))
+    if not reports:
+        return False
+    reached = max(s for s, _ in reports)
+    rung = None
+    r = min_resource
+    while r <= reached:
+        rung = r
+        r *= eta
+    if rung is None:
+        return False            # below the first rung: never judged
+    mine = _best_at(reports, rung, maximize)
+    if mine is None:
+        return False            # no report at or below the rung yet
+    pool = [mine]
+    for ph in peer_reports:
+        if ph and max(s for s, _ in ph) >= rung:
+            v = _best_at(ph, rung, maximize)
+            if v is not None:
+                pool.append(v)
+    if len(pool) < eta:
+        return False            # too few arrivals to halve against
+    pool.sort(reverse=maximize)
+    keep = max(1, math.ceil(len(pool) / eta))
+    threshold = pool[keep - 1]
+    return mine < threshold if maximize else mine > threshold

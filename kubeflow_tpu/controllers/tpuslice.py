@@ -629,18 +629,33 @@ class StudyJobReconciler(Reconciler):
         algorithm = m.deep_get(spec, "algorithm", "name",
                                default="random") or "random"
         es = spec.get("earlyStopping") or {}
-        es_enabled = es.get("algorithm") in ("median", "medianstop")
+        es_alg = es.get("algorithm")
+        es_enabled = es_alg in ("median", "medianstop", "hyperband",
+                                "asha")
         # spec validation up front: a bad algorithm/parameter/early-
         # stopping spec must become a terminal Failed condition, not a
         # silently-ignored knob or an infinite crash-requeue loop
         try:
-            if es.get("algorithm") and not es_enabled:
+            if es_alg and not es_enabled:
                 raise ValueError(
-                    f"unknown earlyStopping algorithm "
-                    f"{es['algorithm']!r}; expected median")
+                    f"unknown earlyStopping algorithm {es_alg!r}; "
+                    f"expected median or hyperband")
+            if es_enabled:
+                # numeric knobs are user-controlled: reject junk (and
+                # hang-inducing degenerate values) as InvalidSpec here,
+                # not as a crash-requeue loop mid-study
+                if es_alg in ("hyperband", "asha"):
+                    if int(es.get("eta", 3)) < 2:
+                        raise ValueError("earlyStopping.eta must be >= 2")
+                    if int(es.get("minResource", 1)) < 1:
+                        raise ValueError(
+                            "earlyStopping.minResource must be >= 1")
+                else:
+                    int(es.get("startStep", 1))
+                    int(es.get("minTrialsRequired", 2))
             if parameters:
                 sample_parameters(parameters, 0, seed, algorithm)
-        except ValueError as e:
+        except (ValueError, TypeError) as e:
             status = {
                 "phase": "Failed",
                 "conditions": [{
@@ -722,24 +737,31 @@ class StudyJobReconciler(Reconciler):
                 trial["state"] = "Succeeded"
                 trial["objectiveValue"] = final
 
-        # ---- early stopping (Katib medianstop re-homed, hpo.py): a
-        # running trial whose best intermediate objective is worse than
-        # the median of its peers' at the same step is killed now — its
-        # chip goes to the next trial instead of finishing a loser
+        # ---- early stopping (hpo.py — Katib's services re-homed):
+        # medianstop kills a trial whose best intermediate trails the
+        # peer median at the same step; hyperband/ASHA successively
+        # halves at exponential rungs. Either way the loser's chip goes
+        # to the next trial instead of finishing.
         if es_enabled:
             from . import hpo
             for i, trial in trials.items():
                 if trial.get("state") != "Running" \
                         or not trial.get("reports"):
                     continue
-                peers = [t.get("reports") or [] for j, t in trials.items()
-                         if j != i]
-                if hpo.median_should_stop(
-                        [(s, v) for s, v in trial["reports"]],
-                        [[(s, v) for s, v in p] for p in peers],
-                        maximize,
+                peers = [[(s, v) for s, v in (t.get("reports") or [])]
+                         for j, t in trials.items() if j != i]
+                mine = [(s, v) for s, v in trial["reports"]]
+                if es_alg in ("hyperband", "asha"):
+                    stop = hpo.asha_should_stop(
+                        mine, peers, maximize,
+                        min_resource=int(es.get("minResource", 1)),
+                        eta=int(es.get("eta", 3)))
+                else:
+                    stop = hpo.median_should_stop(
+                        mine, peers, maximize,
                         start_step=int(es.get("startStep", 1)),
-                        min_peers=int(es.get("minTrialsRequired", 2))):
+                        min_peers=int(es.get("minTrialsRequired", 2)))
+                if stop:
                     tname = self._trial_name(req.name, i)
                     try:
                         self.store.delete("v1", "Pod", tname,

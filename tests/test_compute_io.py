@@ -157,6 +157,57 @@ class TestServing:
         finally:
             server.stop()
 
+    def test_binary_tensor_contract_matches_json_path(self):
+        """The b64 tensor encoding rides the same route and returns
+        bit-identical predictions to the instances path — it exists to
+        delete the JSON-float transport cost, not to change results."""
+        import base64
+
+        cfg = mlp.Config(in_dim=16, hidden=8, n_classes=4)
+        params = mlp.init_params(cfg, jax.random.PRNGKey(0))
+        server = serving.ModelServer()
+        server.register("m", lambda x: jax.nn.softmax(
+            mlp.apply(params, x, cfg), axis=-1))
+        port = server.start(port=0, host="127.0.0.1")
+        try:
+            url = f"http://127.0.0.1:{port}/v1/models/m:predict"
+            x = np.random.default_rng(0).standard_normal(
+                (3, 16)).astype(np.float32)
+
+            def post(body):
+                req = urllib.request.Request(
+                    url, data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"})
+                return json.load(urllib.request.urlopen(req))
+
+            via_json = np.asarray(post({"instances": x.tolist()})
+                                  ["predictions"], np.float32)
+            t = post({"tensor": {
+                "dtype": "float32", "shape": list(x.shape),
+                "b64": base64.b64encode(x.tobytes()).decode()}})["tensor"]
+            assert t["dtype"] == "float32" and t["shape"] == [3, 4]
+            via_bin = np.frombuffer(
+                base64.b64decode(t["b64"]), np.float32).reshape(3, 4)
+            np.testing.assert_array_equal(via_json, via_bin)
+
+            # malformed tensors are the caller's fault -> 400
+            for bad in (
+                {"dtype": "float64", "shape": [1],
+                 "b64": base64.b64encode(b"x" * 8).decode()},
+                {"dtype": "float32", "shape": [2],
+                 "b64": base64.b64encode(b"1234").decode()},  # 4 != 8
+                {"dtype": "float32", "shape": [1], "b64": "!!!"},
+                "not-an-object",
+            ):
+                req = urllib.request.Request(
+                    url, data=json.dumps({"tensor": bad}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    urllib.request.urlopen(req)
+                assert e.value.code == 400, bad
+        finally:
+            server.stop()
+
     def test_inference_failure_is_500_not_400(self):
         # clients (and the bench retry loop) key off 4xx-vs-5xx: a
         # device-side failure must not masquerade as a client error

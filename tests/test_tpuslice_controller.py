@@ -670,14 +670,145 @@ class TestEarlyStopping:
     def test_unknown_early_stopping_algorithm_fails_study(
             self, store, manager):
         self._mgr(store, manager)
-        self._study(store, early_stopping={"algorithm": "hyperband"})
+        self._study(store, early_stopping={"algorithm": "pbt"})
         manager.run_sync()
         study = store.get("kubeflow.org/v1alpha1", "StudyJob", "study1",
                           "default")
         assert study["status"]["phase"] == "Failed"
         cond = study["status"]["conditions"][0]
         assert cond["reason"] == "InvalidSpec"
-        assert "hyperband" in cond["message"]
+        assert "pbt" in cond["message"]
+
+
+class TestASHA:
+    """Hyperband early stopping (asynchronous successive halving,
+    hpo.asha_should_stop): rungs at min_resource·eta^k; a trial at a
+    rung survives only in the top 1/eta of arrivals."""
+
+    def _stop(self, mine, peers, **kw):
+        from kubeflow_tpu.controllers import hpo
+        return hpo.asha_should_stop(mine, peers, True, **kw)
+
+    def test_bottom_of_rung_is_stopped(self):
+        mine = [(1, 0.1)]
+        peers = [[(1, 0.9)], [(1, 0.8)], [(2, 0.7)]]
+        assert self._stop(mine, peers, min_resource=1, eta=3)
+
+    def test_top_of_rung_survives(self):
+        mine = [(1, 0.95)]
+        peers = [[(1, 0.9)], [(1, 0.8)], [(1, 0.7)]]
+        assert not self._stop(mine, peers, min_resource=1, eta=3)
+
+    def test_below_first_rung_never_judged(self):
+        assert not self._stop([(1, 0.0)], [[(4, 0.9)], [(4, 0.8)]],
+                              min_resource=2, eta=2)
+
+    def test_too_few_arrivals_never_halves(self):
+        assert not self._stop([(1, 0.0)], [[(1, 0.9)]], eta=3)
+
+    def test_judged_at_highest_reached_rung(self):
+        # judged at rung 3 (the highest reached), on best-so-far: a
+        # trial that plateaued low gets cut against rung-3 arrivals
+        mine = [(1, 0.5), (3, 0.4)]
+        peers = [[(3, 0.9)], [(3, 0.8)], [(3, 0.7)]]
+        assert self._stop(mine, peers, min_resource=1, eta=3)
+
+    def test_best_so_far_protects_early_peaks(self):
+        # ASHA judges achieved quality: an early 0.9 keeps the trial
+        # alive even if later reports dip
+        mine = [(1, 0.9), (3, 0.4)]
+        peers = [[(3, 0.85)], [(3, 0.8)], [(3, 0.7)]]
+        assert not self._stop(mine, peers, min_resource=1, eta=3)
+
+    def test_survivors_fraction_is_one_over_eta(self):
+        # 6 arrivals at rung 1, eta=3 → top 2 survive
+        values = [0.9, 0.8, 0.7, 0.6, 0.5, 0.4]
+        outcomes = []
+        for i, v in enumerate(values):
+            peers = [[(1, p)] for j, p in enumerate(values) if j != i]
+            outcomes.append(not self._stop([(1, v)], peers, eta=3))
+        assert outcomes == [True, True, False, False, False, False]
+
+    def test_degenerate_spec_is_invalid_not_a_hang(self):
+        # eta<=1 / minResource<=0 would spin the rung loop forever on a
+        # user-supplied spec; the function clamps (defense in depth)
+        assert not self._stop([(5, 0.1)], [[(5, 0.9)], [(5, 0.8)]],
+                              min_resource=0, eta=1)
+
+    def test_sparse_reports_above_rung_not_judged(self):
+        # first report lands past the rung: nothing to compare yet
+        assert not self._stop([(5, 0.1)],
+                              [[(1, 0.9), (3, 0.9)], [(3, 0.8)]],
+                              min_resource=1, eta=3)
+
+    def test_bad_eta_fails_study_terminally(self, store, manager):
+        manager.add(StudyJobReconciler())
+        manager.start_sync()
+        study = tsapi.new_study(
+            "study1", "default",
+            objective={"type": "maximize", "metricName": "acc"},
+            parameters=[{"name": "lr", "type": "double",
+                         "min": 0.01, "max": 0.1}],
+            trial_template={"spec": {"containers": [{}]}},
+            max_trials=2)
+        study["spec"]["earlyStopping"] = {"algorithm": "hyperband",
+                                          "eta": "high"}
+        store.create(study)
+        manager.run_sync()
+        got = store.get("kubeflow.org/v1alpha1", "StudyJob", "study1",
+                        "default")
+        assert got["status"]["phase"] == "Failed"
+        assert got["status"]["conditions"][0]["reason"] == "InvalidSpec"
+
+    def test_eta_one_fails_study_terminally(self, store, manager):
+        manager.add(StudyJobReconciler())
+        manager.start_sync()
+        study = tsapi.new_study(
+            "study1", "default",
+            objective={"type": "maximize", "metricName": "acc"},
+            parameters=[{"name": "lr", "type": "double",
+                         "min": 0.01, "max": 0.1}],
+            trial_template={"spec": {"containers": [{}]}},
+            max_trials=2)
+        study["spec"]["earlyStopping"] = {"algorithm": "asha", "eta": 1}
+        store.create(study)
+        manager.run_sync()
+        got = store.get("kubeflow.org/v1alpha1", "StudyJob", "study1",
+                        "default")
+        assert got["status"]["phase"] == "Failed"
+        assert "eta" in got["status"]["conditions"][0]["message"]
+
+    def test_controller_kills_rung_loser(self, store, manager):
+        manager.add(StudyJobReconciler())
+        manager.add(PodRuntimeReconciler())
+        manager.start_sync()
+        study = tsapi.new_study(
+            "study1", "default",
+            objective={"type": "maximize", "metricName": "acc"},
+            parameters=[{"name": "lr", "type": "double",
+                         "min": 0.01, "max": 0.1}],
+            trial_template={"spec": {"containers": [{
+                "name": "t", "image": "i", "args": ["{{lr}}"]}]}},
+            max_trials=3, parallelism=3, seed=1)
+        # eta=2 with 3 arrivals at the rung → top 2 survive
+        study["spec"]["earlyStopping"] = {"algorithm": "hyperband",
+                                          "minResource": 1, "eta": 2}
+        store.create(study)
+        manager.run_sync()
+        import json as _json
+        for idx, v in ((0, 0.9), (1, 0.8), (2, 0.1)):
+            pod = store.get("v1", "Pod", f"study1-trial-{idx}",
+                            "default")
+            pod["metadata"].setdefault("annotations", {})[
+                "kubeflow.org/pod-logs"] = "trial-metric " + _json.dumps(
+                {"name": "acc", "value": v, "step": 1})
+            store.update(pod)
+        manager.run_sync()
+        study = store.get("kubeflow.org/v1alpha1", "StudyJob", "study1",
+                          "default")
+        states = {t["index"]: t["state"]
+                  for t in study["status"]["trials"]}
+        assert states == {0: "Running", 1: "Running", 2: "EarlyStopped"}
 
 
 class TestStudyAlgorithms:
