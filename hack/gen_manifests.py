@@ -78,6 +78,8 @@ WEB_APPS = {
     "tensorboards-web-app": {
         "image": PLATFORM_IMAGE,
         "port": 5000, "prefix": "/tensorboards"},
+    "studies-web-app": {"image": PLATFORM_IMAGE,
+                        "port": 5000, "prefix": "/studies"},
     "access-management": {"image": PLATFORM_IMAGE,
                           "port": 8081, "prefix": "/kfam"},
     "centraldashboard": {"image": PLATFORM_IMAGE,

@@ -167,6 +167,11 @@ def tensorboards_web_app(argv=()):
     _web(tensorboards.create_app, 5000)
 
 
+def studies_web_app(argv=()):
+    from ..web import studies
+    _web(studies.create_app, 5000)
+
+
 def access_management(argv=()):
     from ..web import kfam
     _web(kfam.create_app, 8081)
@@ -193,6 +198,7 @@ COMPONENTS = {
     "jupyter-web-app": jupyter_web_app,
     "volumes-web-app": volumes_web_app,
     "tensorboards-web-app": tensorboards_web_app,
+    "studies-web-app": studies_web_app,
     "access-management": access_management,
     "centraldashboard": centraldashboard,
 }

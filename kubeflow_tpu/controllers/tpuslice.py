@@ -499,6 +499,40 @@ def apply_trial_placement(pod_spec, spec, study_name):
     return pod_spec
 
 
+#: early-stopping services the reconciler implements (hpo.py)
+ES_ALGORITHMS = ("median", "medianstop", "hyperband", "asha")
+
+
+def validate_study_spec(spec):
+    """Raise ValueError/TypeError for an invalid StudyJob spec —
+    algorithm name, parameter domains, early-stopping knobs. ONE
+    definition shared by the reconciler (terminal InvalidSpec
+    condition) and the Studies web app's submit/dry-run path (HTTP
+    400): the editor must reject exactly what the controller would."""
+    es = spec.get("earlyStopping") or {}
+    es_alg = es.get("algorithm")
+    if es_alg and es_alg not in ES_ALGORITHMS:
+        raise ValueError(f"unknown earlyStopping algorithm {es_alg!r}; "
+                         f"expected median or hyperband")
+    if es_alg in ("hyperband", "asha"):
+        # numeric knobs are user-controlled: junk (and hang-inducing
+        # degenerate values) must fail fast, not crash-requeue
+        if int(es.get("eta", 3)) < 2:
+            raise ValueError("earlyStopping.eta must be >= 2")
+        if int(es.get("minResource", 1)) < 1:
+            raise ValueError("earlyStopping.minResource must be >= 1")
+    elif es_alg:
+        int(es.get("startStep", 1))
+        int(es.get("minTrialsRequired", 2))
+    parameters = spec.get("parameters") or []
+    if parameters:
+        seed = int(m.deep_get(spec, "algorithm", "seed",
+                              default=0) or 0)
+        algorithm = m.deep_get(spec, "algorithm", "name",
+                               default="random") or "random"
+        sample_parameters(parameters, 0, seed, algorithm)
+
+
 def render_template(template, values):
     out = m.deep_copy(template)
 
@@ -630,31 +664,12 @@ class StudyJobReconciler(Reconciler):
                                default="random") or "random"
         es = spec.get("earlyStopping") or {}
         es_alg = es.get("algorithm")
-        es_enabled = es_alg in ("median", "medianstop", "hyperband",
-                                "asha")
+        es_enabled = es_alg in ES_ALGORITHMS
         # spec validation up front: a bad algorithm/parameter/early-
         # stopping spec must become a terminal Failed condition, not a
         # silently-ignored knob or an infinite crash-requeue loop
         try:
-            if es_alg and not es_enabled:
-                raise ValueError(
-                    f"unknown earlyStopping algorithm {es_alg!r}; "
-                    f"expected median or hyperband")
-            if es_enabled:
-                # numeric knobs are user-controlled: reject junk (and
-                # hang-inducing degenerate values) as InvalidSpec here,
-                # not as a crash-requeue loop mid-study
-                if es_alg in ("hyperband", "asha"):
-                    if int(es.get("eta", 3)) < 2:
-                        raise ValueError("earlyStopping.eta must be >= 2")
-                    if int(es.get("minResource", 1)) < 1:
-                        raise ValueError(
-                            "earlyStopping.minResource must be >= 1")
-                else:
-                    int(es.get("startStep", 1))
-                    int(es.get("minTrialsRequired", 2))
-            if parameters:
-                sample_parameters(parameters, 0, seed, algorithm)
+            validate_study_spec(spec)
         except (ValueError, TypeError) as e:
             status = {
                 "phase": "Failed",

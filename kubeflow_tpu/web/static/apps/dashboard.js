@@ -16,6 +16,8 @@ const APPS = [
     desc: "manage PVCs" },
   { id: "tensorboards", label: "Tensorboards", href: "/tensorboards/",
     desc: "profiles + training curves" },
+  { id: "studies", label: "Studies", href: "/studies/",
+    desc: "HPO sweeps (StudyJob)" },
 ];
 
 async function onboarding(el, info) {

@@ -1,0 +1,249 @@
+/* Studies web app SPA: StudyJob index / YAML create / trial drill-down.
+ *
+ * The platform owns the StudyJob CRD (HPO sweeps with TPE + medianstop/
+ * hyperband early stopping); this app is its management surface —
+ * list with progress + best objective, details with the per-trial
+ * table (states incl. EarlyStopped, intermediate reports, placement),
+ * create through the shared YAML editor with server-side dry-run
+ * (backend routes: web/studies.py). */
+
+import {
+  age, api, currentNamespace, eventsTable, h, indexPage, Router, snack,
+  statusIcon, tabPanel, YamlEditor, yamlDump,
+} from "../lib/components.js";
+
+const outlet = document.getElementById("app");
+let router = null;
+
+const PHASE_ICON = { Created: "waiting", Running: "running",
+                     Completed: "ready", Failed: "error" };
+
+function phaseIcon(phase) {
+  return statusIcon({ phase: PHASE_ICON[phase] || "waiting",
+                      message: phase });
+}
+
+/* --------------------------------------------------------------- index */
+
+async function indexView(el) {
+  await indexPage(el, {
+    newLabel: "New study",
+    onNew: () => router.go("/new"),
+    pollMs: 5000,
+    table: {
+      empty: "no studies in this namespace",
+      load: async (ns) =>
+        (await api("GET", `api/namespaces/${ns}/studyjobs`)).studyjobs,
+      columns: [
+        { key: "phase", label: "Status", sort: false,
+          render: (r) => phaseIcon(r.phase) },
+        { key: "name", label: "Name",
+          render: (r) => h("a", {
+            href: `#/details/${encodeURIComponent(r.name)}`,
+          }, r.name) },
+        { key: "algorithm", label: "Algorithm",
+          render: (r) => r.algorithm +
+            (r.earlyStopping ? ` + ${r.earlyStopping}` : "") },
+        { key: "completedTrials", label: "Trials",
+          render: (r) => `${r.completedTrials}/${r.maxTrials}` },
+        { key: "bestValue", label: "Best",
+          render: (r) => r.bestValue === null
+            || r.bestValue === undefined
+            ? "—" : `${r.objective}=${Number(r.bestValue).toPrecision(4)}` },
+        { key: "age", label: "Created", render: (r) => age(r.age) },
+      ],
+      actions: [
+        { id: "delete", label: "delete", cls: "danger",
+          confirm: "Deletes the study and its trial pods.",
+          run: async (r) => {
+            await api("DELETE",
+              `api/namespaces/${currentNamespace()}/studyjobs/${r.name}`);
+            snack(`deleted ${r.name}`, "success");
+          } },
+      ],
+    },
+  });
+}
+
+/* ---------------------------------------------------------- new (yaml) */
+
+function starterStudy(ns) {
+  return {
+    apiVersion: "kubeflow.org/v1alpha1",
+    kind: "StudyJob",
+    metadata: { name: "my-study", namespace: ns },
+    spec: {
+      objective: { type: "maximize", metricName: "accuracy" },
+      algorithm: { name: "tpe", seed: 0 },
+      earlyStopping: { algorithm: "median", startStep: 1 },
+      parameters: [
+        { name: "lr", type: "double", min: 0.0001, max: 0.1,
+          scale: "log" },
+        { name: "hidden", type: "int", min: 32, max: 256 },
+      ],
+      maxTrialCount: 12,
+      parallelTrialCount: 4,
+      trialTemplate: { spec: { containers: [{
+        name: "trial",
+        image: "kubeflownotebookswg/jupyter-jax-tpu:latest",
+        command: ["python", "-m", "kubeflow_tpu.compute.trial"],
+        env: [{ name: "TRIAL_PARAMETERS",
+                value: '{"lr": {{lr}}, "hidden": {{hidden}}}' }],
+      }] } },
+    },
+  };
+}
+
+async function newView(el) {
+  const ns = currentNamespace();
+  const editor = new YamlEditor({ rows: 28 });
+  editor.setObject(starterStudy(ns));
+
+  const post = async (dryRun) => {
+    let cr;
+    try {
+      cr = editor.parsed();
+    } catch (e) {
+      editor.setStatus(e.message, "error", e.line);
+      snack(e.message, "error");
+      return;
+    }
+    try {
+      await api("POST", `api/namespaces/${ns}/studyjobs?` +
+        (dryRun ? "dry_run=true" : ""), cr);
+      if (dryRun) {
+        editor.setStatus("dry run ok — sweep spec and admission "
+          + "chain accept this", "");
+        snack("study spec is valid", "success");
+      } else {
+        snack(`created ${(cr.metadata || {}).name}`, "success");
+        router.go("/");
+      }
+    } catch (e) {
+      editor.setStatus(String(e.message || e), "error");
+      snack(String(e.message || e), "error");
+    }
+  };
+
+  el.append(
+    h("div.kf-toolbar", {},
+      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
+      h("h2", {}, `New study in ${ns}`)),
+    h("div.kf-section", { id: "study-editor" }, editor.element),
+    h("div.kf-form-actions", {},
+      h("button.primary", { id: "study-create",
+        onclick: () => post(false) }, "Create"),
+      h("button.ghost", { id: "study-dryrun",
+        onclick: () => post(true) }, "Validate (dry run)"),
+      h("button.ghost", { onclick: () => router.go("/") }, "Cancel")),
+  );
+}
+
+/* ------------------------------------------------------------- details */
+
+const TRIAL_ICON = { Running: "running", Succeeded: "ready",
+                     Failed: "error", EarlyStopped: "stopped" };
+
+function sparkline(reports) {
+  /* tiny unicode trend of the intermediate reports */
+  if (!reports || !reports.length) return "";
+  const values = reports.map(([, v]) => v);
+  const lo = Math.min(...values), hi = Math.max(...values);
+  const bars = "▁▂▃▄▅▆▇█";
+  return values.slice(-12).map((v) => bars[
+    hi === lo ? 0 : Math.round((v - lo) / (hi - lo) * 7)]).join("");
+}
+
+async function detailsView(el, params) {
+  const ns = currentNamespace();
+  let study, summary;
+  try {
+    const resp = await api("GET",
+      `api/namespaces/${ns}/studyjobs/${params.name}`);
+    study = resp.studyjob;
+    summary = resp.summary;
+  } catch (e) {
+    el.append(h("p", {}, `cannot load ${params.name}: ${e.message}`));
+    return;
+  }
+  const trials = (study.status || {}).trials || [];
+  const best = (study.status || {}).bestTrial || null;
+
+  const overview = (pane) => {
+    pane.append(h("div.kf-section", {},
+      h("h2", {}, "Overview"),
+      h("dl.kf-kv", {},
+        h("dt", {}, "algorithm"), h("dd", {}, summary.algorithm),
+        h("dt", {}, "early stopping"),
+        h("dd", {}, summary.earlyStopping || "off"),
+        h("dt", {}, "objective"),
+        h("dd", {}, `${(study.spec.objective || {}).type || "maximize"} `
+          + summary.objective),
+        h("dt", {}, "progress"),
+        h("dd", {}, `${summary.completedTrials}/${summary.maxTrials}`),
+        h("dt", {}, "best"),
+        h("dd", {}, best
+          ? `trial ${best.index}: ${summary.objective}=` +
+            `${Number(best.objectiveValue).toPrecision(5)} @ ` +
+            JSON.stringify(best.parameters)
+          : "—"),
+      )));
+  };
+
+  const trialsTab = (pane) => {
+    pane.append(h("div.kf-card", {}, h("table.kf-table", {},
+      h("thead", {}, h("tr", {},
+        ["", "trial", "state", "objective", "progress", "parameters",
+         "node"].map((c) => h("th", {}, c)))),
+      h("tbody", {}, trials.length ? trials.map((t) => h("tr", {
+        dataset: { trial: String(t.index) },
+        className: best && t.index === best.index ? "kf-best" : "",
+      },
+        h("td", {}, statusIcon({ phase: TRIAL_ICON[t.state] || "waiting",
+                                 message: t.state })),
+        h("td", {}, String(t.index)),
+        h("td", {}, t.state),
+        h("td", {}, t.objectiveValue !== undefined
+          ? Number(t.objectiveValue).toPrecision(4)
+          : (t.partialObjectiveValue !== undefined
+            ? `(${Number(t.partialObjectiveValue).toPrecision(4)})` : "—")),
+        h("td", {}, sparkline(t.reports)),
+        h("td", {}, JSON.stringify(t.parameters || {})),
+        h("td", {}, t.node || ""),
+      )) : h("tr", {}, h("td.kf-empty", { colSpan: 7 },
+        "no trials yet"))))));
+  };
+
+  const eventsTab = (pane) => {
+    (async () => {
+      const data = await api("GET",
+        `api/namespaces/${ns}/studyjobs/${params.name}/events`);
+      pane.append(h("div.kf-card", {}, eventsTable(data.events)));
+    })();
+  };
+
+  const yamlTab = (pane) => {
+    pane.append(h("code.kf-yaml", {}, yamlDump(study)));
+  };
+
+  el.append(
+    h("div.kf-toolbar", {},
+      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
+      h("h2", {}, params.name, " "),
+      phaseIcon(summary.phase)),
+    tabPanel([
+      { id: "overview", label: "Overview", render: overview },
+      { id: "trials", label: `Trials (${trials.length})`,
+        render: trialsTab },
+      { id: "events", label: "Events", render: eventsTab },
+      { id: "yaml", label: "YAML", render: yamlTab },
+    ]).element,
+  );
+}
+
+router = new Router(outlet, [
+  ["/", indexView],
+  ["/new", newView],
+  ["/details/:name", detailsView],
+]);
+router.render();

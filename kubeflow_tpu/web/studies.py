@@ -1,0 +1,117 @@
+"""Studies web app backend — StudyJob HPO management.
+
+No in-tree reference counterpart (Katib's UI lives out of tree;
+SURVEY.md §2 parallelism table) — but this platform owns the StudyJob
+CRD (controllers/tpuslice.py), so its surface gets first-class
+management like every other CR: list with progress + best objective,
+trial drill-down (states incl. EarlyStopped, intermediate reports,
+placement), YAML-editor create with server-side dry-run (the same
+raw-CR contract as web/jupyter.py), delete. Built on crud_backend
+(header authn, SAR authz, CSRF) like the other apps.
+"""
+
+from ..api import tpuslice as tsapi
+from ..core import meta as m
+from ..core.errors import NotFoundError
+from . import crud_backend as cb
+from .http import HTTPError
+
+STUDY_API = f"{tsapi.GROUP}/{tsapi.VERSION}"
+
+
+def _summary(study):
+    status = study.get("status") or {}
+    spec = study.get("spec") or {}
+    best = status.get("bestTrial") or {}
+    return {
+        "name": m.name_of(study),
+        "namespace": m.namespace_of(study),
+        "phase": status.get("phase", "Created"),
+        "algorithm": m.deep_get(spec, "algorithm", "name",
+                                default="random"),
+        "earlyStopping": m.deep_get(spec, "earlyStopping", "algorithm",
+                                    default=""),
+        "objective": m.deep_get(spec, "objective", "metricName",
+                                default="objective"),
+        "completedTrials": status.get("completedTrials", 0),
+        "maxTrials": spec.get("maxTrialCount", 0),
+        "bestValue": best.get("objectiveValue"),
+        "bestParameters": best.get("parameters") or {},
+        "age": m.deep_get(study, "metadata", "creationTimestamp",
+                          default=""),
+    }
+
+
+def create_app(store):
+    app = cb.create_app("studies-web-app", store)
+
+    @app.get("/api/namespaces/<ns>/studyjobs")
+    def list_studies(request, ns):
+        cb.ensure_authorized(store, request, "list", "studyjobs", ns)
+        studies = store.list(STUDY_API, tsapi.STUDY_KIND, ns)
+        return cb.success({"studyjobs": [_summary(s) for s in studies]})
+
+    @app.get("/api/namespaces/<ns>/studyjobs/<name>")
+    def get_study(request, ns, name):
+        cb.ensure_authorized(store, request, "get", "studyjobs", ns)
+        study = store.try_get(STUDY_API, tsapi.STUDY_KIND, name, ns)
+        if study is None:
+            raise HTTPError(404, f"studyjob {ns}/{name} not found")
+        return cb.success({"studyjob": study,
+                           "summary": _summary(study)})
+
+    @app.get("/api/namespaces/<ns>/studyjobs/<name>/events")
+    def get_events(request, ns, name):
+        cb.ensure_authorized(store, request, "list", "events", ns)
+        return cb.success({"events": cb.events_for(store, ns, name)})
+
+    @app.post("/api/namespaces/<ns>/studyjobs")
+    def post_study(request, ns):
+        """The body IS the StudyJob CR (the YAML-editor contract, same
+        shape as the JWA raw path); ?dry_run=true validates through the
+        admission chain without creating."""
+        cb.ensure_authorized(store, request, "create", "studyjobs", ns)
+        body = request.json
+        if not isinstance(body, dict):
+            raise HTTPError(400, "body must be a StudyJob object")
+        if body.get("kind") != tsapi.STUDY_KIND:
+            raise HTTPError(400, f"kind must be {tsapi.STUDY_KIND}, "
+                                 f"got {body.get('kind')!r}")
+        if body.get("apiVersion") != STUDY_API:
+            raise HTTPError(400, f"apiVersion must be {STUDY_API}")
+        study = m.deep_copy(body)
+        md = study.setdefault("metadata", {})
+        if md.get("namespace") not in (None, ns):
+            raise HTTPError(
+                400, f"metadata.namespace {md['namespace']!r} does not "
+                     f"match the request namespace {ns!r}")
+        md["namespace"] = ns
+        if not md.get("name"):
+            raise HTTPError(400, "metadata.name is required")
+        spec = study.get("spec") or {}
+        # surface bad sweeps at submit time with the controller's OWN
+        # validation (one shared definition: algorithm, parameter
+        # domains, early-stopping knobs) — not as a Failed condition
+        # discovered later in the index
+        from ..controllers.tpuslice import validate_study_spec
+        try:
+            validate_study_spec(spec)
+        except (ValueError, TypeError) as e:
+            raise HTTPError(400, f"invalid spec: {e}")
+        store.create(study, dry_run=True)
+        if request.query.get("dry_run", "").lower() != "true":
+            store.create(study)
+        return cb.success(status=200)
+
+    @app.delete("/api/namespaces/<ns>/studyjobs/<name>")
+    def delete_study(request, ns, name):
+        cb.ensure_authorized(store, request, "delete", "studyjobs", ns)
+        try:
+            store.delete(STUDY_API, tsapi.STUDY_KIND, name, ns)
+        except NotFoundError:
+            raise HTTPError(404, f"studyjob {ns}/{name} not found")
+        return cb.success()
+
+    from . import frontend
+    frontend.install(app, "Studies", "studies")
+    return app

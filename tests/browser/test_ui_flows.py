@@ -64,7 +64,8 @@ def servers():
     yield {"jupyter": f"http://localhost:{base}",
            "volumes": f"http://localhost:{base + 1}",
            "tensorboards": f"http://localhost:{base + 2}",
-           "dashboard": f"http://localhost:{base + 3}"}
+           "dashboard": f"http://localhost:{base + 3}",
+           "studies": f"http://localhost:{base + 4}"}
     proc.terminate()
 
 
@@ -367,6 +368,42 @@ def test_yaml_lib_roundtrip_battery(servers, page):
       return failures;
     }""")
     assert failures == [], failures
+
+
+def test_studies_create_and_trials_table(servers, page):
+    """StudyJob management surface: YAML create with dry-run, index
+    progress, trial drill-down with early-stopped states."""
+    page.goto(servers["studies"] + "/#/new")
+    page.wait_for_selector("#study-editor")
+    yaml = page.locator(".kf-editor-text").input_value()
+    assert "kind: StudyJob" in yaml
+    # bad algorithm → dry-run rejects with the controller's message
+    page.fill(".kf-editor-text", yaml.replace("name: tpe",
+                                              "name: warp-drive"))
+    page.click("#study-dryrun")
+    page.wait_for_selector(".kf-editor-status.error")
+    # fix + shrink the sweep, then create
+    fixed = yaml.replace("my-study", "ui-study").replace(
+        "maxTrialCount: 12", "maxTrialCount: 2").replace(
+        "parallelTrialCount: 4", "parallelTrialCount: 2")
+    page.fill(".kf-editor-text", fixed)
+    page.click("#study-dryrun")
+    page.wait_for_selector("#kf-snackbar.success")
+    page.click("#study-create")
+    page.wait_for_selector("tr[data-row=ui-study]")
+    # details: trials table renders rows with states
+    page.click("tr[data-row=ui-study] a")
+    page.wait_for_selector(".kf-tabs")
+    page.click("button[data-tab=trials]")
+    page.wait_for_selector("tr[data-trial='0']")
+    page.click("button[data-tab=yaml]")
+    assert "kind: StudyJob" in page.inner_text("code.kf-yaml")
+    # cleanup
+    page.goto(servers["studies"] + "/#/")
+    page.click("button[data-action=delete][data-row=ui-study]")
+    page.click(".kf-dialog button.danger")
+    page.wait_for_selector("tr[data-row=ui-study]", state="detached",
+                           timeout=15000)
 
 
 def test_form_validation_blocks_bad_names(servers, page):

@@ -20,7 +20,8 @@ import pytest
 
 from kubeflow_tpu import api as capi
 from kubeflow_tpu.core import ObjectStore
-from kubeflow_tpu.web import dashboard, jupyter, tensorboards, volumes
+from kubeflow_tpu.web import (dashboard, jupyter, studies,
+                              tensorboards, volumes)
 from kubeflow_tpu.web.frontend import STATIC_DIR
 from kubeflow_tpu.web.http import Request
 
@@ -28,6 +29,7 @@ APPS = {
     "jupyter": jupyter.create_app,
     "volumes": volumes.create_app,
     "tensorboards": tensorboards.create_app,
+    "studies": studies.create_app,
     "dashboard": dashboard.create_app,
 }
 

@@ -634,6 +634,136 @@ class TestPodDefaultAuthoring:
         assert [p["name"] for p in pds["poddefaults"]] == ["tpu-env"]
 
 
+class TestStudiesApp:
+    """Studies web app (web/studies.py): the StudyJob CRD's management
+    surface — list with progress/best, trial drill-down, YAML-editor
+    create with dry-run, delete."""
+
+    def _cr(self, name="s1", **kw):
+        cr = {
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "StudyJob",
+            "metadata": {"name": name},
+            "spec": {
+                "objective": {"type": "maximize",
+                              "metricName": "accuracy"},
+                "algorithm": {"name": kw.pop("algorithm", "random"),
+                              "seed": 1},
+                "parameters": [{"name": "lr", "type": "double",
+                                "min": 0.01, "max": 0.1}],
+                "trialTemplate": {"spec": {"containers": [{
+                    "name": "t", "image": "i",
+                    "args": ["--lr={{lr}}"]}]}},
+                "maxTrialCount": 2, "parallelTrialCount": 2,
+            },
+        }
+        cr["spec"].update(kw)
+        return cr
+
+    def _app(self, store):
+        from kubeflow_tpu.web import studies
+        return client(studies.create_app(store))
+
+    def test_create_list_details_delete(self, platform):
+        store, mgr = platform
+        from kubeflow_tpu.controllers.tpuslice import StudyJobReconciler
+        mgr.add(StudyJobReconciler())
+        mgr.start_sync()      # open the late controller's watches
+        c = self._app(store)
+        assert c.post("/api/namespaces/team-a/studyjobs",
+                      json_body=self._cr()).status == 200
+        mgr.run_sync()
+        lst = c.get("/api/namespaces/team-a/studyjobs").json
+        row = lst["studyjobs"][0]
+        assert row["name"] == "s1" and row["maxTrials"] == 2
+        assert row["algorithm"] == "random"
+        got = c.get("/api/namespaces/team-a/studyjobs/s1").json
+        assert len(got["studyjob"]["status"]["trials"]) == 2
+        assert c.delete(
+            "/api/namespaces/team-a/studyjobs/s1").status == 200
+        assert store.try_get("kubeflow.org/v1alpha1", "StudyJob", "s1",
+                             "team-a") is None
+
+    def test_dry_run_creates_nothing(self, platform):
+        store, _ = platform
+        c = self._app(store)
+        r = c.post("/api/namespaces/team-a/studyjobs?dry_run=true",
+                   json_body=self._cr())
+        assert r.status == 200, r.json
+        assert store.try_get("kubeflow.org/v1alpha1", "StudyJob", "s1",
+                             "team-a") is None
+
+    def test_bad_sweep_rejected_at_submit(self, platform):
+        # the controller's validation runs at POST time: the editor
+        # sees the error instead of a later Failed condition
+        store, _ = platform
+        c = self._app(store)
+        bad = self._cr(algorithm="warp-drive")
+        r = c.post("/api/namespaces/team-a/studyjobs", json_body=bad)
+        assert r.status == 400
+        assert "warp-drive" in r.json["log"]
+        bad_log = self._cr()
+        bad_log["spec"]["parameters"] = [{
+            "name": "lr", "type": "double", "min": 0, "max": 1,
+            "scale": "log"}]
+        r = c.post("/api/namespaces/team-a/studyjobs",
+                   json_body=bad_log)
+        assert r.status == 400
+        assert "log scale" in r.json["log"]
+        # early-stopping knobs validate at submit too — the shared
+        # validate_study_spec, not a partial copy (review finding)
+        bad_es = self._cr()
+        bad_es["spec"]["earlyStopping"] = {"algorithm": "warp"}
+        r = c.post("/api/namespaces/team-a/studyjobs?dry_run=true",
+                   json_body=bad_es)
+        assert r.status == 400 and "warp" in r.json["log"]
+        bad_eta = self._cr()
+        bad_eta["spec"]["earlyStopping"] = {"algorithm": "hyperband",
+                                            "eta": 1}
+        r = c.post("/api/namespaces/team-a/studyjobs?dry_run=true",
+                   json_body=bad_eta)
+        assert r.status == 400 and "eta" in r.json["log"]
+
+    def test_wrong_kind_and_cross_namespace_rejected(self, platform):
+        store, _ = platform
+        c = self._app(store)
+        wrong = self._cr()
+        wrong["kind"] = "TpuSlice"
+        assert c.post("/api/namespaces/team-a/studyjobs",
+                      json_body=wrong).status == 400
+        cross = self._cr()
+        cross["metadata"]["namespace"] = "team-b"
+        assert c.post("/api/namespaces/team-a/studyjobs",
+                      json_body=cross).status == 400
+
+    def test_non_member_is_403(self, platform):
+        store, _ = platform
+        from kubeflow_tpu.web import studies
+        c = client(studies.create_app(store), headers=MALLORY)
+        assert c.get("/api/namespaces/team-a/studyjobs").status == 403
+
+    def test_summary_surfaces_best_and_early_stopping(self, platform):
+        store, mgr = platform
+        from kubeflow_tpu.controllers.tpuslice import StudyJobReconciler
+        mgr.add(StudyJobReconciler())
+        mgr.start_sync()      # open the late controller's watches
+        c = self._app(store)
+        cr = self._cr(algorithm="tpe")
+        cr["spec"]["earlyStopping"] = {"algorithm": "median"}
+        assert c.post("/api/namespaces/team-a/studyjobs",
+                      json_body=cr).status == 200
+        mgr.run_sync()
+        from kubeflow_tpu.api import builtin
+        store.create(builtin.config_map(
+            "s1-trial-0-metrics", "team-a", {"accuracy": "0.9"},
+            labels={"studyjob": "s1"}))
+        mgr.run_sync()
+        row = c.get("/api/namespaces/team-a/studyjobs").json[
+            "studyjobs"][0]
+        assert row["bestValue"] == 0.9
+        assert row["algorithm"] == "tpe"
+        assert row["earlyStopping"] == "median"
+
+
 class TestKfamSubjectKinds:
     """Group/ServiceAccount contributor subjects (rbac Subject kinds;
     mesh AuthorizationPolicy only for User — the identity header
