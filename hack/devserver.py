@@ -25,8 +25,8 @@ from kubeflow_tpu.controllers import tensorboard, tpuslice
 from kubeflow_tpu.controllers.workload_runtime import (
     DeploymentReconciler, PodRuntimeReconciler, StatefulSetReconciler)
 from kubeflow_tpu.core import Manager, ObjectStore
-from kubeflow_tpu.web import (dashboard, jupyter, studies,
-                              tensorboards, volumes)
+from kubeflow_tpu.web import (dashboard, jupyter, slices,
+                              studies, tensorboards, volumes)
 
 
 def build(seed=True):
@@ -76,6 +76,7 @@ def main():
         "tensorboards": tensorboards.create_app(store),
         "dashboard": dashboard.create_app(store),
         "studies": studies.create_app(store),
+        "slices": slices.create_app(store),
     }
     for i, (name, app) in enumerate(apps.items()):
         port = base + i

@@ -167,6 +167,11 @@ def tensorboards_web_app(argv=()):
     _web(tensorboards.create_app, 5000)
 
 
+def slices_web_app(argv=()):
+    from ..web import slices
+    _web(slices.create_app, 5000)
+
+
 def studies_web_app(argv=()):
     from ..web import studies
     _web(studies.create_app, 5000)
@@ -199,6 +204,7 @@ COMPONENTS = {
     "volumes-web-app": volumes_web_app,
     "tensorboards-web-app": tensorboards_web_app,
     "studies-web-app": studies_web_app,
+    "slices-web-app": slices_web_app,
     "access-management": access_management,
     "centraldashboard": centraldashboard,
 }

@@ -509,6 +509,11 @@ def validate_study_spec(spec):
     definition shared by the reconciler (terminal InvalidSpec
     condition) and the Studies web app's submit/dry-run path (HTTP
     400): the editor must reject exactly what the controller would."""
+    # trial-count / seed knobs parse as ints or the spec is invalid —
+    # the reconciler reads them with int() and must never crash-requeue
+    int(spec.get("maxTrialCount", 0))
+    int(spec.get("parallelTrialCount", 0))
+    int(m.deep_get(spec, "algorithm", "seed", default=0) or 0)
     es = spec.get("earlyStopping") or {}
     es_alg = es.get("algorithm")
     if es_alg and es_alg not in ES_ALGORITHMS:
@@ -656,18 +661,10 @@ class StudyJobReconciler(Reconciler):
         if study is None:
             return Result()
         spec = study.get("spec", {})
-        max_trials = int(spec.get("maxTrialCount", 0))
-        parallelism = int(spec.get("parallelTrialCount", max_trials))
-        parameters = spec.get("parameters") or []
-        seed = int(m.deep_get(spec, "algorithm", "seed", default=0) or 0)
-        algorithm = m.deep_get(spec, "algorithm", "name",
-                               default="random") or "random"
-        es = spec.get("earlyStopping") or {}
-        es_alg = es.get("algorithm")
-        es_enabled = es_alg in ES_ALGORITHMS
-        # spec validation up front: a bad algorithm/parameter/early-
-        # stopping spec must become a terminal Failed condition, not a
-        # silently-ignored knob or an infinite crash-requeue loop
+        # spec validation BEFORE any int() parsing: a bad knob must
+        # become a terminal Failed condition, not a crash-requeue loop
+        # (validate_study_spec is the one shared definition the Studies
+        # web app also enforces at submit)
         try:
             validate_study_spec(spec)
         except (ValueError, TypeError) as e:
@@ -683,6 +680,15 @@ class StudyJobReconciler(Reconciler):
                 study["status"] = status
                 self.store.update_status(study)
             return Result()
+        max_trials = int(spec.get("maxTrialCount", 0))
+        parallelism = int(spec.get("parallelTrialCount", max_trials))
+        parameters = spec.get("parameters") or []
+        seed = int(m.deep_get(spec, "algorithm", "seed", default=0) or 0)
+        algorithm = m.deep_get(spec, "algorithm", "name",
+                               default="random") or "random"
+        es = spec.get("earlyStopping") or {}
+        es_alg = es.get("algorithm")
+        es_enabled = es_alg in ES_ALGORITHMS
         objective = spec.get("objective") or {}
         metric_name = objective.get("metricName", "objective")
         maximize = objective.get("type", "maximize") == "maximize"

@@ -257,6 +257,36 @@ def create_app(name, store):
 
 # ---------------------------------------------------------- store helpers
 
+def raw_cr(body, ns, kind, api_versions):
+    """Validate a user-authored CR envelope — the YAML-editor contract
+    shared by every app's raw create path (the browser parses YAML
+    client-side and posts the CR as JSON). ONE definition: kind,
+    apiVersion (str or iterable of accepted versions), namespace
+    consistency, required name. Returns a deep copy with the namespace
+    pinned; kind-specific spec validation stays with the caller."""
+    if isinstance(api_versions, str):
+        api_versions = (api_versions,)
+    if not isinstance(body, dict):
+        raise HTTPError(400, f"body must be a {kind} object")
+    if body.get("kind") != kind:
+        raise HTTPError(400, f"kind must be {kind}, "
+                             f"got {body.get('kind')!r}")
+    if body.get("apiVersion") not in api_versions:
+        versions = sorted(api_versions)
+        raise HTTPError(400, f"apiVersion must be "
+                             f"{versions[0] if len(versions) == 1 else versions}")
+    cr = m.deep_copy(body)
+    md = cr.setdefault("metadata", {})
+    if md.get("namespace") not in (None, ns):
+        raise HTTPError(
+            400, f"metadata.namespace {md['namespace']!r} does not "
+                 f"match the request namespace {ns!r}")
+    md["namespace"] = ns
+    if not md.get("name"):
+        raise HTTPError(400, "metadata.name is required")
+    return cr
+
+
 def events_for(store, namespace, involved_name):
     """Events whose involvedObject.name matches (reference
     api/events.py filtering idiom)."""

@@ -192,22 +192,7 @@ def create_app(store, metrics_service=None):
     PD_API = "kubeflow.org/v1alpha1"
 
     def _raw_poddefault(body, ns):
-        if not isinstance(body, dict):
-            raise HTTPError(400, "body must be a PodDefault object")
-        if body.get("kind") != "PodDefault":
-            raise HTTPError(400, f"kind must be PodDefault, "
-                                 f"got {body.get('kind')!r}")
-        if body.get("apiVersion") != PD_API:
-            raise HTTPError(400, f"apiVersion must be {PD_API}")
-        pd = m.deep_copy(body)
-        md = pd.setdefault("metadata", {})
-        if md.get("namespace") not in (None, ns):
-            raise HTTPError(
-                400, f"metadata.namespace {md['namespace']!r} does not "
-                     f"match the request namespace {ns!r}")
-        md["namespace"] = ns
-        if not md.get("name"):
-            raise HTTPError(400, "metadata.name is required")
+        pd = cb.raw_cr(body, ns, "PodDefault", PD_API)
         if not m.deep_get(pd, "spec", "selector", "matchLabels"):
             raise HTTPError(
                 400, "spec.selector.matchLabels is required — it is "

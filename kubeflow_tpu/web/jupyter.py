@@ -377,27 +377,10 @@ def create_app(store):
         return cb.success({"pvcs": pvcs})
 
     def _raw_notebook(body, ns):
-        """Validate a user-authored Notebook CR (the YAML-editor path:
-        the browser parses YAML client-side and posts the CR as JSON)."""
-        if not isinstance(body, dict):
-            raise HTTPError(400, "body must be a Notebook object")
-        if body.get("kind") != nbapi.KIND:
-            raise HTTPError(400, f"kind must be {nbapi.KIND}, "
-                                 f"got {body.get('kind')!r}")
-        valid_apis = {f"{nbapi.GROUP}/{v}" for v in nbapi.VERSIONS}
-        if body.get("apiVersion") not in valid_apis:
-            raise HTTPError(400, f"apiVersion must be one of "
-                                 f"{sorted(valid_apis)}")
-        nb = m.deep_copy(body)
-        md = nb.setdefault("metadata", {})
-        if md.get("namespace") not in (None, ns):
-            raise HTTPError(
-                400, f"metadata.namespace {md['namespace']!r} does not "
-                     f"match the request namespace {ns!r}")
-        md["namespace"] = ns
-        if not md.get("name"):
-            raise HTTPError(400, "metadata.name is required")
-        return nb
+        """Notebook envelope of the shared YAML-editor contract
+        (cb.raw_cr); any served CRD version is accepted."""
+        return cb.raw_cr(body, ns, nbapi.KIND,
+                         {f"{nbapi.GROUP}/{v}" for v in nbapi.VERSIONS})
 
     @app.post("/api/namespaces/<ns>/notebooks")
     def post_notebook(request, ns):

@@ -1,0 +1,121 @@
+"""Slices web app backend — TpuSlice gang management.
+
+No in-tree reference counterpart (multi-worker training was delegated
+to out-of-tree tf-operator; SURVEY.md §2 parallelism table) — but this
+platform owns the TpuSlice CRD (controllers/tpuslice.py), so the gangs
+get a management surface: list with topology/readiness/restart budget,
+worker drill-down (per-pod phase + gang generation), YAML-editor
+create with dry-run, delete. Built on crud_backend like the others.
+"""
+
+from ..api import tpuslice as tsapi
+from ..core import meta as m
+from ..core.errors import NotFoundError
+from . import crud_backend as cb
+from .http import HTTPError
+
+SLICE_API = f"{tsapi.GROUP}/{tsapi.VERSION}"
+
+
+def _topology_math(spec):
+    """(chips, workers) for the summary — None on a malformed topology.
+    A CR with junk topology can reach the store through kubectl; one
+    bad object must degrade to a blank cell, not 500 the whole list."""
+    topology = spec.get("topology") or "2x2"
+    try:
+        return (tsapi.topology_chips(topology),
+                tsapi.workers_for(spec.get("accelerator", ""),
+                                  topology))
+    except ValueError:
+        return None, None
+
+
+def _summary(ts):
+    status = ts.get("status") or {}
+    spec = ts.get("spec") or {}
+    chips, workers = _topology_math(spec)
+    return {
+        "name": m.name_of(ts),
+        "namespace": m.namespace_of(ts),
+        "accelerator": spec.get("accelerator", ""),
+        "topology": spec.get("topology", ""),
+        "chips": chips,
+        "phase": status.get("phase", "Pending"),
+        "readyWorkers": status.get("readyWorkers", 0),
+        "workers": status.get("workers") or workers,
+        "restartCount": status.get("restartCount", 0),
+        "maxRestarts": spec.get("maxRestarts", 5),
+        "lastRestartReason": status.get("lastRestartReason", ""),
+        "age": m.deep_get(ts, "metadata", "creationTimestamp",
+                          default=""),
+    }
+
+
+def _workers(store, ts):
+    name, ns = m.name_of(ts), m.namespace_of(ts)
+    out = []
+    for pod in store.list("v1", "Pod", ns,
+                          label_selector={"tpu-slice": name}):
+        out.append({
+            "name": m.name_of(pod),
+            "phase": m.deep_get(pod, "status", "phase",
+                                default="Pending"),
+            "generation": m.annotations_of(pod).get(
+                "kubeflow.org/gang-generation", "0"),
+            "node": m.deep_get(pod, "spec", "nodeName", default=""),
+        })
+    return sorted(out, key=lambda w: w["name"])
+
+
+def create_app(store):
+    app = cb.create_app("slices-web-app", store)
+
+    @app.get("/api/namespaces/<ns>/tpuslices")
+    def list_slices(request, ns):
+        cb.ensure_authorized(store, request, "list", "tpuslices", ns)
+        slices = store.list(SLICE_API, tsapi.SLICE_KIND, ns)
+        return cb.success({"tpuslices": [_summary(s) for s in slices]})
+
+    @app.get("/api/namespaces/<ns>/tpuslices/<name>")
+    def get_slice(request, ns, name):
+        cb.ensure_authorized(store, request, "get", "tpuslices", ns)
+        ts = store.try_get(SLICE_API, tsapi.SLICE_KIND, name, ns)
+        if ts is None:
+            raise HTTPError(404, f"tpuslice {ns}/{name} not found")
+        return cb.success({"tpuslice": ts, "summary": _summary(ts),
+                           "workerPods": _workers(store, ts)})
+
+    @app.get("/api/namespaces/<ns>/tpuslices/<name>/events")
+    def get_events(request, ns, name):
+        cb.ensure_authorized(store, request, "list", "events", ns)
+        return cb.success({"events": cb.events_for(store, ns, name)})
+
+    @app.post("/api/namespaces/<ns>/tpuslices")
+    def post_slice(request, ns):
+        """Body IS the TpuSlice CR (YAML-editor contract);
+        ?dry_run=true validates without creating."""
+        cb.ensure_authorized(store, request, "create", "tpuslices", ns)
+        ts = cb.raw_cr(request.json, ns, tsapi.SLICE_KIND, SLICE_API)
+        topology = m.deep_get(ts, "spec", "topology", default="")
+        try:
+            tsapi.topology_chips(topology or "2x2")
+        except ValueError:
+            raise HTTPError(400, f"invalid topology {topology!r} "
+                                 f"(expected e.g. 2x2 or 2x2x4)")
+        store.create(ts, dry_run=True)
+        if request.query.get("dry_run", "").lower() != "true":
+            store.create(ts)
+        return cb.success(status=200)
+
+    @app.delete("/api/namespaces/<ns>/tpuslices/<name>")
+    def delete_slice(request, ns, name):
+        cb.ensure_authorized(store, request, "delete", "tpuslices", ns)
+        try:
+            store.delete(SLICE_API, tsapi.SLICE_KIND, name, ns)
+        except NotFoundError:
+            raise HTTPError(404, f"tpuslice {ns}/{name} not found")
+        return cb.success()
+
+    from . import frontend
+    frontend.install(app, "TPU Slices", "slices")
+    return app

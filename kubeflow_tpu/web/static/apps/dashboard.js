@@ -18,6 +18,8 @@ const APPS = [
     desc: "profiles + training curves" },
   { id: "studies", label: "Studies", href: "/studies/",
     desc: "HPO sweeps (StudyJob)" },
+  { id: "slices", label: "TPU Slices", href: "/slices/",
+    desc: "multi-host training gangs" },
 ];
 
 async function onboarding(el, info) {

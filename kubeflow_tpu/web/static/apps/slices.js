@@ -1,0 +1,219 @@
+/* Slices web app SPA: TpuSlice index / YAML create / worker drill-down.
+ *
+ * The TpuSlice CRD is the platform's multi-host training gang (headless
+ * Service + StatefulSet + PodDefault TPU env + gang-restart control
+ * loop); this app is its management surface — list with topology and
+ * readiness, details with the per-worker table (phase, gang
+ * generation, node) and restart budget, create through the shared YAML
+ * editor with server-side dry-run (backend: web/slices.py). */
+
+import {
+  age, api, currentNamespace, eventsTable, h, indexPage, Router, snack,
+  statusIcon, tabPanel, YamlEditor, yamlDump,
+} from "../lib/components.js";
+
+const outlet = document.getElementById("app");
+let router = null;
+
+const PHASE_ICON = { Pending: "waiting", Running: "ready",
+                     Restarting: "warning", Succeeded: "stopped",
+                     Failed: "error" };
+
+function phaseIcon(phase) {
+  return statusIcon({ phase: PHASE_ICON[phase] || "waiting",
+                      message: phase });
+}
+
+/* --------------------------------------------------------------- index */
+
+async function indexView(el) {
+  await indexPage(el, {
+    newLabel: "New slice",
+    onNew: () => router.go("/new"),
+    pollMs: 5000,
+    table: {
+      empty: "no TPU slices in this namespace",
+      load: async (ns) =>
+        (await api("GET", `api/namespaces/${ns}/tpuslices`)).tpuslices,
+      columns: [
+        { key: "phase", label: "Status", sort: false,
+          render: (r) => phaseIcon(r.phase) },
+        { key: "name", label: "Name",
+          render: (r) => h("a", {
+            href: `#/details/${encodeURIComponent(r.name)}`,
+          }, r.name) },
+        { key: "accelerator", label: "Accelerator" },
+        { key: "topology", label: "Topology",
+          render: (r) => `${r.topology} (${r.chips} chips)` },
+        { key: "readyWorkers", label: "Workers",
+          render: (r) => `${r.readyWorkers}/${r.workers}` },
+        { key: "restartCount", label: "Restarts",
+          render: (r) => `${r.restartCount}/${r.maxRestarts}` },
+        { key: "age", label: "Created", render: (r) => age(r.age) },
+      ],
+      actions: [
+        { id: "delete", label: "delete", cls: "danger",
+          confirm: "Deletes the slice and all of its worker pods.",
+          run: async (r) => {
+            await api("DELETE",
+              `api/namespaces/${currentNamespace()}/tpuslices/${r.name}`);
+            snack(`deleted ${r.name}`, "success");
+          } },
+      ],
+    },
+  });
+}
+
+/* ---------------------------------------------------------- new (yaml) */
+
+function starterSlice(ns) {
+  return {
+    apiVersion: "kubeflow.org/v1alpha1",
+    kind: "TpuSlice",
+    metadata: { name: "my-slice", namespace: ns },
+    spec: {
+      accelerator: "tpu-v5-lite-podslice",
+      topology: "4x4",
+      maxRestarts: 5,
+      template: { spec: { containers: [{
+        name: "worker",
+        image: "kubeflownotebookswg/jupyter-jax-tpu:latest",
+        command: ["python", "-m", "kubeflow_tpu.cmd", "slice-worker",
+                  "--ckpt-dir", "/workspace/ckpt", "--steps", "1000"],
+      }] } },
+    },
+  };
+}
+
+async function newView(el) {
+  const ns = currentNamespace();
+  const editor = new YamlEditor({ rows: 24 });
+  editor.setObject(starterSlice(ns));
+
+  const post = async (dryRun) => {
+    let cr;
+    try {
+      cr = editor.parsed();
+    } catch (e) {
+      editor.setStatus(e.message, "error", e.line);
+      snack(e.message, "error");
+      return;
+    }
+    try {
+      await api("POST", `api/namespaces/${ns}/tpuslices?` +
+        (dryRun ? "dry_run=true" : ""), cr);
+      if (dryRun) {
+        editor.setStatus("dry run ok — topology and admission chain "
+          + "accept this", "");
+        snack("slice spec is valid", "success");
+      } else {
+        snack(`created ${(cr.metadata || {}).name}`, "success");
+        router.go("/");
+      }
+    } catch (e) {
+      editor.setStatus(String(e.message || e), "error");
+      snack(String(e.message || e), "error");
+    }
+  };
+
+  el.append(
+    h("div.kf-toolbar", {},
+      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
+      h("h2", {}, `New TPU slice in ${ns}`)),
+    h("div.kf-section", { id: "slice-editor" }, editor.element),
+    h("div.kf-form-actions", {},
+      h("button.primary", { id: "slice-create",
+        onclick: () => post(false) }, "Create"),
+      h("button.ghost", { id: "slice-dryrun",
+        onclick: () => post(true) }, "Validate (dry run)"),
+      h("button.ghost", { onclick: () => router.go("/") }, "Cancel")),
+  );
+}
+
+/* ------------------------------------------------------------- details */
+
+const POD_ICON = { Running: "running", Pending: "waiting",
+                   Succeeded: "ready", Failed: "error" };
+
+async function detailsView(el, params) {
+  const ns = currentNamespace();
+  let ts, summary, workers;
+  try {
+    const resp = await api("GET",
+      `api/namespaces/${ns}/tpuslices/${params.name}`);
+    ts = resp.tpuslice;
+    summary = resp.summary;
+    workers = resp.workerPods;
+  } catch (e) {
+    el.append(h("p", {}, `cannot load ${params.name}: ${e.message}`));
+    return;
+  }
+
+  const overview = (pane) => {
+    pane.append(h("div.kf-section", {},
+      h("h2", {}, "Overview"),
+      h("dl.kf-kv", {},
+        h("dt", {}, "accelerator"), h("dd", {}, summary.accelerator),
+        h("dt", {}, "topology"),
+        h("dd", {}, `${summary.topology} — ${summary.chips} chips over `
+          + `${summary.workers} workers`),
+        h("dt", {}, "ready"),
+        h("dd", {}, `${summary.readyWorkers}/${summary.workers}`),
+        h("dt", {}, "restarts"),
+        h("dd", {}, `${summary.restartCount}/${summary.maxRestarts}`
+          + (summary.lastRestartReason
+            ? ` — last: ${summary.lastRestartReason}` : "")),
+      )));
+  };
+
+  const workersTab = (pane) => {
+    pane.append(h("div.kf-card", {}, h("table.kf-table", {},
+      h("thead", {}, h("tr", {},
+        ["", "worker", "phase", "gang generation", "node"].map(
+          (c) => h("th", {}, c)))),
+      h("tbody", {}, workers.length ? workers.map((w) => h("tr", {
+        dataset: { worker: w.name },
+      },
+        h("td", {}, statusIcon({ phase: POD_ICON[w.phase] || "waiting",
+                                 message: w.phase })),
+        h("td", {}, w.name),
+        h("td", {}, w.phase),
+        h("td", {}, w.generation),
+        h("td", {}, w.node),
+      )) : h("tr", {}, h("td.kf-empty", { colSpan: 5 },
+        "no worker pods yet"))))));
+  };
+
+  const eventsTab = (pane) => {
+    (async () => {
+      const data = await api("GET",
+        `api/namespaces/${ns}/tpuslices/${params.name}/events`);
+      pane.append(h("div.kf-card", {}, eventsTable(data.events)));
+    })();
+  };
+
+  const yamlTab = (pane) => {
+    pane.append(h("code.kf-yaml", {}, yamlDump(ts)));
+  };
+
+  el.append(
+    h("div.kf-toolbar", {},
+      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
+      h("h2", {}, params.name, " "),
+      phaseIcon(summary.phase)),
+    tabPanel([
+      { id: "overview", label: "Overview", render: overview },
+      { id: "workers", label: `Workers (${workers.length})`,
+        render: workersTab },
+      { id: "events", label: "Events", render: eventsTab },
+      { id: "yaml", label: "YAML", render: yamlTab },
+    ]).element,
+  );
+}
+
+router = new Router(outlet, [
+  ["/", indexView],
+  ["/new", newView],
+  ["/details/:name", detailsView],
+]);
+router.render();

@@ -71,23 +71,8 @@ def create_app(store):
         shape as the JWA raw path); ?dry_run=true validates through the
         admission chain without creating."""
         cb.ensure_authorized(store, request, "create", "studyjobs", ns)
-        body = request.json
-        if not isinstance(body, dict):
-            raise HTTPError(400, "body must be a StudyJob object")
-        if body.get("kind") != tsapi.STUDY_KIND:
-            raise HTTPError(400, f"kind must be {tsapi.STUDY_KIND}, "
-                                 f"got {body.get('kind')!r}")
-        if body.get("apiVersion") != STUDY_API:
-            raise HTTPError(400, f"apiVersion must be {STUDY_API}")
-        study = m.deep_copy(body)
-        md = study.setdefault("metadata", {})
-        if md.get("namespace") not in (None, ns):
-            raise HTTPError(
-                400, f"metadata.namespace {md['namespace']!r} does not "
-                     f"match the request namespace {ns!r}")
-        md["namespace"] = ns
-        if not md.get("name"):
-            raise HTTPError(400, "metadata.name is required")
+        study = cb.raw_cr(request.json, ns, tsapi.STUDY_KIND,
+                          STUDY_API)
         spec = study.get("spec") or {}
         # surface bad sweeps at submit time with the controller's OWN
         # validation (one shared definition: algorithm, parameter

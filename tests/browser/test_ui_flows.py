@@ -65,7 +65,8 @@ def servers():
            "volumes": f"http://localhost:{base + 1}",
            "tensorboards": f"http://localhost:{base + 2}",
            "dashboard": f"http://localhost:{base + 3}",
-           "studies": f"http://localhost:{base + 4}"}
+           "studies": f"http://localhost:{base + 4}",
+           "slices": f"http://localhost:{base + 5}"}
     proc.terminate()
 
 
@@ -403,6 +404,29 @@ def test_studies_create_and_trials_table(servers, page):
     page.click("button[data-action=delete][data-row=ui-study]")
     page.click(".kf-dialog button.danger")
     page.wait_for_selector("tr[data-row=ui-study]", state="detached",
+                           timeout=15000)
+
+
+def test_slices_index_and_details(servers, page):
+    """TpuSlice management surface: YAML create, worker table."""
+    page.goto(servers["slices"] + "/#/new")
+    page.wait_for_selector("#slice-editor")
+    yaml = page.locator(".kf-editor-text").input_value()
+    assert "kind: TpuSlice" in yaml
+    page.fill(".kf-editor-text", yaml.replace("my-slice", "ui-slice")
+              .replace("topology: 4x4", "topology: 2x2"))
+    page.click("#slice-dryrun")
+    page.wait_for_selector("#kf-snackbar.success")
+    page.click("#slice-create")
+    page.wait_for_selector("tr[data-row=ui-slice]")
+    page.click("tr[data-row=ui-slice] a")
+    page.wait_for_selector(".kf-tabs")
+    page.click("button[data-tab=workers]")
+    page.wait_for_selector("tr[data-worker=ui-slice-0]")
+    page.goto(servers["slices"] + "/#/")
+    page.click("button[data-action=delete][data-row=ui-slice]")
+    page.click(".kf-dialog button.danger")
+    page.wait_for_selector("tr[data-row=ui-slice]", state="detached",
                            timeout=15000)
 
 

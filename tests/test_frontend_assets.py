@@ -20,8 +20,8 @@ import pytest
 
 from kubeflow_tpu import api as capi
 from kubeflow_tpu.core import ObjectStore
-from kubeflow_tpu.web import (dashboard, jupyter, studies,
-                              tensorboards, volumes)
+from kubeflow_tpu.web import (dashboard, jupyter, slices,
+                              studies, tensorboards, volumes)
 from kubeflow_tpu.web.frontend import STATIC_DIR
 from kubeflow_tpu.web.http import Request
 
@@ -30,6 +30,7 @@ APPS = {
     "volumes": volumes.create_app,
     "tensorboards": tensorboards.create_app,
     "studies": studies.create_app,
+    "slices": slices.create_app,
     "dashboard": dashboard.create_app,
 }
 

@@ -760,6 +760,27 @@ class TestASHA:
         assert got["status"]["phase"] == "Failed"
         assert got["status"]["conditions"][0]["reason"] == "InvalidSpec"
 
+    def test_junk_trial_count_fails_study_terminally(
+            self, store, manager):
+        # maxTrialCount: "lots" (reachable via kubectl) must become a
+        # terminal InvalidSpec, not an int() crash-requeue loop
+        manager.add(StudyJobReconciler())
+        manager.start_sync()
+        study = tsapi.new_study(
+            "study1", "default",
+            objective={"type": "maximize", "metricName": "acc"},
+            parameters=[{"name": "lr", "type": "double",
+                         "min": 0.01, "max": 0.1}],
+            trial_template={"spec": {"containers": [{}]}},
+            max_trials=2)
+        study["spec"]["maxTrialCount"] = "lots"
+        store.create(study)
+        manager.run_sync()
+        got = store.get("kubeflow.org/v1alpha1", "StudyJob", "study1",
+                        "default")
+        assert got["status"]["phase"] == "Failed"
+        assert got["status"]["conditions"][0]["reason"] == "InvalidSpec"
+
     def test_eta_one_fails_study_terminally(self, store, manager):
         manager.add(StudyJobReconciler())
         manager.start_sync()

@@ -722,6 +722,13 @@ class TestStudiesApp:
         r = c.post("/api/namespaces/team-a/studyjobs?dry_run=true",
                    json_body=bad_eta)
         assert r.status == 400 and "eta" in r.json["log"]
+        # trial-count knobs parse as ints or the submit 400s (the
+        # reconciler reads them with int(); junk must never reach it)
+        bad_count = self._cr()
+        bad_count["spec"]["maxTrialCount"] = "lots"
+        r = c.post("/api/namespaces/team-a/studyjobs?dry_run=true",
+                   json_body=bad_count)
+        assert r.status == 400
 
     def test_wrong_kind_and_cross_namespace_rejected(self, platform):
         store, _ = platform
@@ -762,6 +769,103 @@ class TestStudiesApp:
         assert row["bestValue"] == 0.9
         assert row["algorithm"] == "tpe"
         assert row["earlyStopping"] == "median"
+
+
+class TestSlicesApp:
+    """Slices web app (web/slices.py): the TpuSlice CRD's management
+    surface — list with topology/readiness/restart budget, worker
+    drill-down, YAML-editor create with dry-run, delete."""
+
+    def _cr(self, name="sl1", topology="4x4"):
+        return {"apiVersion": "kubeflow.org/v1alpha1",
+                "kind": "TpuSlice",
+                "metadata": {"name": name},
+                "spec": {"accelerator": "tpu-v5-lite-podslice",
+                         "topology": topology,
+                         "template": {"spec": {"containers": [{
+                             "name": "worker", "image": "i"}]}}}}
+
+    def _app(self, store):
+        from kubeflow_tpu.web import slices
+        return client(slices.create_app(store))
+
+    def test_create_list_workers_delete(self, platform):
+        store, mgr = platform
+        from kubeflow_tpu.controllers.tpuslice import TpuSliceReconciler
+        mgr.add(TpuSliceReconciler())
+        mgr.start_sync()
+        c = self._app(store)
+        assert c.post("/api/namespaces/team-a/tpuslices",
+                      json_body=self._cr()).status == 200
+        mgr.run_sync()
+        lst = c.get("/api/namespaces/team-a/tpuslices").json
+        row = lst["tpuslices"][0]
+        assert row["name"] == "sl1" and row["chips"] == 16
+        assert row["workers"] == 4 and row["phase"] == "Running"
+        got = c.get("/api/namespaces/team-a/tpuslices/sl1").json
+        workers = got["workerPods"]
+        assert [w["name"] for w in workers] == [
+            "sl1-0", "sl1-1", "sl1-2", "sl1-3"]
+        assert all(w["generation"] == "0" for w in workers)
+        assert c.delete(
+            "/api/namespaces/team-a/tpuslices/sl1").status == 200
+        assert store.try_get("kubeflow.org/v1alpha1", "TpuSlice", "sl1",
+                             "team-a") is None
+
+    def test_restart_budget_surfaces(self, platform):
+        store, mgr = platform
+        from kubeflow_tpu.controllers.tpuslice import TpuSliceReconciler
+        mgr.add(TpuSliceReconciler())
+        mgr.start_sync()
+        c = self._app(store)
+        c.post("/api/namespaces/team-a/tpuslices", json_body=self._cr())
+        mgr.run_sync()
+        pod = store.get("v1", "Pod", "sl1-1", "team-a")
+        pod["status"] = {"phase": "Failed", "containerStatuses": [{
+            "name": "worker", "ready": False, "restartCount": 0,
+            "state": {"terminated": {"exitCode": 17}}}]}
+        store.update(pod)
+        mgr.run_sync()
+        row = c.get("/api/namespaces/team-a/tpuslices").json[
+            "tpuslices"][0]
+        assert row["restartCount"] == 1
+        assert "exited 17" in row["lastRestartReason"]
+
+    def test_dry_run_and_bad_topology(self, platform):
+        store, _ = platform
+        c = self._app(store)
+        r = c.post("/api/namespaces/team-a/tpuslices?dry_run=true",
+                   json_body=self._cr())
+        assert r.status == 200, r.json
+        assert store.try_get("kubeflow.org/v1alpha1", "TpuSlice", "sl1",
+                             "team-a") is None
+        r = c.post("/api/namespaces/team-a/tpuslices",
+                   json_body=self._cr(topology="banana"))
+        assert r.status == 400
+        assert "topology" in r.json["log"]
+
+    def test_non_member_is_403(self, platform):
+        store, _ = platform
+        from kubeflow_tpu.web import slices
+        c = client(slices.create_app(store), headers=MALLORY)
+        assert c.get("/api/namespaces/team-a/tpuslices").status == 403
+
+    def test_stored_bad_topology_degrades_not_500(self, platform):
+        # a junk-topology CR can reach the store via kubectl; one bad
+        # object must not take down the whole namespace listing
+        store, _ = platform
+        bad = self._cr(name="junk", topology="banana")
+        bad["metadata"]["namespace"] = "team-a"
+        store.create(bad)
+        good = self._cr(name="ok")
+        good["metadata"]["namespace"] = "team-a"
+        store.create(good)
+        c = self._app(store)
+        r = c.get("/api/namespaces/team-a/tpuslices")
+        assert r.status == 200, r.json
+        rows = {x["name"]: x for x in r.json["tpuslices"]}
+        assert rows["junk"]["chips"] is None
+        assert rows["ok"]["chips"] == 16
 
 
 class TestKfamSubjectKinds:
