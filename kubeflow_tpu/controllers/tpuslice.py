@@ -513,6 +513,7 @@ def validate_study_spec(spec):
     # the reconciler reads them with int() and must never crash-requeue
     int(spec.get("maxTrialCount", 0))
     int(spec.get("parallelTrialCount", 0))
+    int(spec.get("chipsPerTrial", 1) or 1)
     int(m.deep_get(spec, "algorithm", "seed", default=0) or 0)
     es = spec.get("earlyStopping") or {}
     es_alg = es.get("algorithm")

@@ -9,6 +9,7 @@ create with dry-run, delete. Built on crud_backend like the others.
 """
 
 from ..api import tpuslice as tsapi
+from ..controllers.tpuslice import DEFAULT_MAX_RESTARTS
 from ..core import meta as m
 from ..core.errors import NotFoundError
 from . import crud_backend as cb
@@ -44,7 +45,7 @@ def _summary(ts):
         "readyWorkers": status.get("readyWorkers", 0),
         "workers": status.get("workers") or workers,
         "restartCount": status.get("restartCount", 0),
-        "maxRestarts": spec.get("maxRestarts", 5),
+        "maxRestarts": spec.get("maxRestarts", DEFAULT_MAX_RESTARTS),
         "lastRestartReason": status.get("lastRestartReason", ""),
         "age": m.deep_get(ts, "metadata", "creationTimestamp",
                           default=""),
@@ -64,7 +65,11 @@ def _workers(store, ts):
                 "kubeflow.org/gang-generation", "0"),
             "node": m.deep_get(pod, "spec", "nodeName", default=""),
         })
-    return sorted(out, key=lambda w: w["name"])
+    def ordinal(w):
+        # StatefulSet ordinals order numerically: sl1-10 after sl1-9
+        head, _, tail = w["name"].rpartition("-")
+        return (head, int(tail)) if tail.isdigit() else (w["name"], -1)
+    return sorted(out, key=ordinal)
 
 
 def create_app(store):
