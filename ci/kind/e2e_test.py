@@ -143,6 +143,121 @@ def test_notebook_lifecycle(store):
               is None or None, desc="cascade delete")
 
 
+def test_tpuslice_gang_lifecycle(store):
+    """The TPU-native workload plane against a live apiserver: TpuSlice
+    → PodDefault + headless Service + gang StatefulSet, worker pods
+    materialized, status mirror, cascade on delete. (Worker pods may
+    sit Pending on clusters whose kubelet doesn't serve the patched
+    google.com/tpu capacity — the gang shape, not readiness, is the
+    contract here.)"""
+    name = f"e2e-slice-{uuid.uuid4().hex[:6]}"
+    ts = {
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "TpuSlice",
+        "metadata": {"name": name, "namespace": NS},
+        "spec": {"accelerator": "tpu-v5-lite-podslice",
+                 "topology": "2x2",           # 4 chips = 1 worker
+                 "template": {"spec": {"containers": [{
+                     "name": "worker", "image": IMAGE,
+                     "resources": {"requests": {"cpu": "50m"}},
+                 }]}}},
+    }
+    store.create(ts)
+    try:
+        sts = _wait(lambda: store.try_get("apps/v1", "StatefulSet",
+                                          name, NS), desc="gang sts")
+        assert sts["spec"]["replicas"] == 1
+        assert sts["spec"]["serviceName"] == name
+        tmpl = sts["spec"]["template"]
+        worker = tmpl["spec"]["containers"][0]
+        assert worker["resources"]["limits"]["google.com/tpu"] == "4"
+        assert tmpl["metadata"]["annotations"][
+            "kubeflow.org/gang-generation"] == "0"
+
+        svc = _wait(lambda: store.try_get("v1", "Service", name, NS),
+                    desc="headless service")
+        assert svc["spec"].get("clusterIP") == "None"
+
+        pd = _wait(lambda: store.try_get(
+            "kubeflow.org/v1alpha1", "PodDefault",
+            f"tpu-worker-{name}", NS), desc="tpu poddefault")
+        env = {e["name"] for e in pd["spec"]["env"]}
+        assert "TPU_WORKER_HOSTNAMES" in env
+
+        _wait(lambda: store.try_get("v1", "Pod", f"{name}-0", NS),
+              timeout=180, desc="worker pod")
+
+        def mirrored():
+            cur = store.try_get("kubeflow.org/v1alpha1", "TpuSlice",
+                                name, NS)
+            st = (cur or {}).get("status") or {}
+            return cur if st.get("workers") == 1 else None
+        got = _wait(mirrored, timeout=180, desc="slice status mirror")
+        assert got["status"]["phase"] in ("Pending", "Running")
+        assert got["status"]["restartCount"] == 0
+    finally:
+        store.delete("kubeflow.org/v1alpha1", "TpuSlice", name, NS)
+    if os.environ.get("E2E_EXPECT_CASCADE", "true").lower() == "true":
+        _wait(lambda: store.try_get("apps/v1", "StatefulSet", name, NS)
+              is None or None, desc="gang cascade delete")
+
+
+def test_studyjob_lifecycle(store):
+    """StudyJob HPO against a live apiserver: trial fan-out with the
+    exclusive-chip placement injected, metrics-ConfigMap completion
+    contract, best-trial selection."""
+    name = f"e2e-study-{uuid.uuid4().hex[:6]}"
+    study = {
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "StudyJob",
+        "metadata": {"name": name, "namespace": NS},
+        "spec": {
+            "objective": {"type": "maximize", "metricName": "acc"},
+            "algorithm": {"name": "random", "seed": 7},
+            "parameters": [{"name": "lr", "type": "double",
+                            "min": 0.01, "max": 0.1}],
+            "trialTemplate": {"spec": {"containers": [{
+                "name": "trial", "image": IMAGE,
+                "args": ["--lr={{lr}}"],
+            }]}},
+            "maxTrialCount": 1, "parallelTrialCount": 1,
+        },
+    }
+    store.create(study)
+    try:
+        pod = _wait(lambda: store.try_get("v1", "Pod",
+                                          f"{name}-trial-0", NS),
+                    timeout=180, desc="trial pod")
+        # placement guarantee: exclusive chip limit injected
+        assert pod["spec"]["containers"][0]["resources"]["limits"][
+            "google.com/tpu"] == "1"
+        arg = pod["spec"]["containers"][0]["args"][0]
+        assert arg.startswith("--lr=0.")
+
+        # the in-cluster metrics-collector contract: the trial reports
+        # its objective via the <study>-trial-<i>-metrics ConfigMap
+        store.create({"apiVersion": "v1", "kind": "ConfigMap",
+                      "metadata": {"name": f"{name}-trial-0-metrics",
+                                   "namespace": NS,
+                                   "labels": {"studyjob": name}},
+                      "data": {"acc": "0.91"}})
+
+        def completed():
+            cur = store.try_get("kubeflow.org/v1alpha1", "StudyJob",
+                                name, NS)
+            st = (cur or {}).get("status") or {}
+            return cur if st.get("phase") == "Completed" else None
+        got = _wait(completed, timeout=180, desc="study completion")
+        best = got["status"]["bestTrial"]
+        assert best["index"] == 0
+        assert best["objectiveValue"] == 0.91
+    finally:
+        store.delete("kubeflow.org/v1alpha1", "StudyJob", name, NS)
+        try:
+            store.delete("v1", "ConfigMap", f"{name}-trial-0-metrics",
+                         NS)
+        except Exception:
+            pass        # created late in the test or already gone
+
+
 def test_accelerator_capacity_visible(store):
     """The TPU re-keying of /api/gpus depends on node capacity: the KinD
     worker is patched with google.com/tpu capacity (install_kind.sh)."""

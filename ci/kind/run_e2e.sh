@@ -33,17 +33,23 @@ echo "cluster: $KUBE_API_SERVER"
 
 python -m kubeflow_tpu.cmd notebook-controller &
 CTRL_PID=$!
-trap 'kill $CTRL_PID 2>/dev/null || true' EXIT
+# the TPU workload plane: TpuSlice gangs + StudyJob sweeps
+SLICE_METRICS_PORT=18081
+METRICS_PORT=$SLICE_METRICS_PORT python -m kubeflow_tpu.cmd tpuslice-controller &
+SLICE_PID=$!
+trap 'kill $CTRL_PID $SLICE_PID 2>/dev/null || true' EXIT
 
-# controller health gate — fail fast if it never comes up
-for i in $(seq 1 30); do
-  curl -fs "http://127.0.0.1:${METRICS_PORT}/healthz" >/dev/null && break
-  sleep 1
+# controller health gates — fail fast if either never comes up
+for port in "$METRICS_PORT" "$SLICE_METRICS_PORT"; do
+  for i in $(seq 1 30); do
+    curl -fs "http://127.0.0.1:${port}/healthz" >/dev/null && break
+    sleep 1
+  done
+  curl -fs "http://127.0.0.1:${port}/healthz" >/dev/null || {
+    echo "controller on :${port} failed to become healthy" >&2
+    exit 1
+  }
 done
-curl -fs "http://127.0.0.1:${METRICS_PORT}/healthz" >/dev/null || {
-  echo "notebook-controller failed to become healthy" >&2
-  exit 1
-}
 
 export E2E_EXPECT_TPU_NODE=true   # install_kind.sh patched capacity
 python -m pytest ci/kind/e2e_test.py -v "$@"

@@ -53,7 +53,13 @@ def _run_manager(reconcilers, store=None, election_id=None):
     return mgr, store
 
 
-def _serve_health(port=8080):
+def _serve_health(port=None):
+    """Health server on ``port``; default honors METRICS_PORT so every
+    controller entrypoint can be re-ported by env (the e2e harness runs
+    several on one host)."""
+    import os as _os
+    if port is None:
+        port = int(_os.environ.get("METRICS_PORT", "8080"))
     from ..web.http import App
     app = App("health")
 

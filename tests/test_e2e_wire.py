@@ -17,7 +17,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from fake_apiserver import FakeApiServer  # noqa: E402
 
-from kubeflow_tpu.controllers import notebook  # noqa: E402
+from kubeflow_tpu.controllers import notebook, tpuslice  # noqa: E402
 from kubeflow_tpu.controllers.workload_runtime import (  # noqa: E402
     PodRuntimeReconciler, StatefulSetReconciler)
 from kubeflow_tpu.core import Manager  # noqa: E402
@@ -34,6 +34,8 @@ def wire(monkeypatch):
     store = KubeStore(base_url=server.url, token="t")
     mgr = Manager(store)
     mgr.add(notebook.NotebookReconciler())
+    mgr.add(tpuslice.TpuSliceReconciler())
+    mgr.add(tpuslice.StudyJobReconciler())
     mgr.add(StatefulSetReconciler())
     mgr.add(PodRuntimeReconciler())
     mgr.start()
@@ -47,3 +49,13 @@ def wire(monkeypatch):
 def test_kind_e2e_suite_over_wire(wire):
     e2e = importlib.import_module("ci.kind.e2e_test")
     e2e.test_notebook_lifecycle(wire)
+
+
+def test_kind_tpuslice_gang_over_wire(wire):
+    e2e = importlib.import_module("ci.kind.e2e_test")
+    e2e.test_tpuslice_gang_lifecycle(wire)
+
+
+def test_kind_studyjob_over_wire(wire):
+    e2e = importlib.import_module("ci.kind.e2e_test")
+    e2e.test_studyjob_lifecycle(wire)
