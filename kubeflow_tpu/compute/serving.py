@@ -23,6 +23,7 @@ substrate:
 import base64
 import json
 import queue
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -231,7 +232,10 @@ def _encode_tensor(x):
     x = np.ascontiguousarray(x)
     if x.dtype.name not in TENSOR_DTYPES:
         x = x.astype(np.float32)
-    if x.dtype.byteorder == ">":        # big-endian host: swap once
+    if x.dtype.byteorder == ">" or (
+            x.dtype.byteorder == "=" and sys.byteorder == "big"):
+        # native-order dtypes report '=' regardless of host endianness,
+        # so a big-endian host must be caught via sys.byteorder
         x = x.astype(x.dtype.newbyteorder("<"))
     # native/little-endian arrays serialize without an extra copy —
     # this is the hot path the binary contract exists to make cheap

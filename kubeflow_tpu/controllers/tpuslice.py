@@ -519,7 +519,7 @@ def validate_study_spec(spec):
     es_alg = es.get("algorithm")
     if es_alg and es_alg not in ES_ALGORITHMS:
         raise ValueError(f"unknown earlyStopping algorithm {es_alg!r}; "
-                         f"expected median or hyperband")
+                         f"expected one of {', '.join(ES_ALGORITHMS)}")
     if es_alg in ("hyperband", "asha"):
         # numeric knobs are user-controlled: junk (and hang-inducing
         # degenerate values) must fail fast, not crash-requeue

@@ -181,20 +181,57 @@ class PodRuntimeReconciler(Reconciler):
         self.recorder = EventRecorder(self.store, "fake-kubelet")
         builder.watch_for("v1", "Pod")
 
-    def _schedulable(self, pod):
+    def _place(self, pod):
+        """Pick the node this pod binds to, or None if unschedulable.
+        Pods with no selector (or no Node inventory) land on fake-node —
+        scheduling constraints are opt-in in the in-process runtime."""
+        bound = m.deep_get(pod, "spec", "nodeName")
+        if bound:
+            return bound
         selector = m.deep_get(pod, "spec", "nodeSelector") or {}
         if not selector:
-            return True
+            return "fake-node"
         nodes = self.store.list("v1", "Node")
         if not nodes:
-            # no Node inventory registered — scheduling constraints are
-            # opt-in in the in-process runtime
-            return True
+            return "fake-node"
         for node in nodes:
             labels = m.labels_of(node)
             if all(labels.get(k) == v for k, v in selector.items()):
-                return True
-        return False
+                return m.name_of(node)
+        return None
+
+    def _assign_chips(self, pod, node):
+        """Device-plugin half of the fake kubelet: hand the pod its
+        ``google.com/tpu`` chips and publish the assignment as the
+        ``kubeflow.org/tpu-chips`` pod annotation — the contract the
+        TpuSlice reconciler surfaces into trial status (tpuslice.py
+        placement mirror). Chips are the lowest ids free on the node."""
+        want = 0
+        for c in m.deep_get(pod, "spec", "containers", default=[]) or []:
+            want += int(m.deep_get(c, "resources", "limits",
+                                   "google.com/tpu", default=0) or 0)
+        if want <= 0:
+            return None
+        used = set()
+        for other in self.store.list("v1", "Pod"):
+            if m.uid_of(other) == m.uid_of(pod):
+                continue
+            if m.deep_get(other, "spec", "nodeName") != node:
+                continue
+            if m.deep_get(other, "status", "phase") in ("Succeeded",
+                                                        "Failed"):
+                # terminal pods release their devices (retained pods
+                # keep the annotation for log/metric scraping only)
+                continue
+            assigned = m.annotations_of(other).get("kubeflow.org/tpu-chips")
+            if assigned:
+                used.update(int(x) for x in assigned.split(",") if x)
+        chips, cursor = [], 0
+        while len(chips) < want:
+            if cursor not in used:
+                chips.append(cursor)
+            cursor += 1
+        return ",".join(str(c) for c in chips)
 
     def reconcile(self, req):
         pod = self.store.try_get("v1", "Pod", req.name, req.namespace)
@@ -206,7 +243,8 @@ class PodRuntimeReconciler(Reconciler):
             # pod must never be silently revived — recovery is the
             # owning controller's job (gang restart, STS recreate)
             return Result()
-        if not self._schedulable(pod):
+        node = self._place(pod)
+        if node is None:
             prior = m.deep_get(pod, "status", "conditions", default=[]) or []
             prior_sched = next((c for c in prior
                                 if c.get("type") == "PodScheduled"), {})
@@ -222,6 +260,18 @@ class PodRuntimeReconciler(Reconciler):
                 pod["status"] = status
                 self.store.update_status(pod)
             return Result()
+        # bind the pod and hand out its TPU chips before it runs — the
+        # scheduler-binding + device-plugin half of the kubelet contract
+        chips = self._assign_chips(pod, node)
+        changed = m.deep_get(pod, "spec", "nodeName") != node
+        pod["spec"]["nodeName"] = node
+        if chips and m.annotations_of(pod).get(
+                "kubeflow.org/tpu-chips") != chips:
+            pod.setdefault("metadata", {}).setdefault(
+                "annotations", {})["kubeflow.org/tpu-chips"] = chips
+            changed = True
+        if changed:
+            pod = self.store.update(pod)
         now = m.now_iso()
         container_statuses = []
         for c in m.deep_get(pod, "spec", "containers", default=[]) or []:
@@ -252,7 +302,7 @@ class PodRuntimeReconciler(Reconciler):
         # fake kubelet must produce them for those paths to be real
         self.recorder.event(pod, "Normal", "Scheduled",
                             f"Successfully assigned {req.namespace}/"
-                            f"{req.name} to fake-node")
+                            f"{req.name} to {node}")
         for cs in container_statuses:
             self.recorder.event(
                 pod, "Normal", "Pulled",

@@ -231,7 +231,9 @@ function parseBlockScalar(rows, i, parentIndent, header, headerN, src) {
    * after the header: '#' is content here (shebangs!), and blank
    * interior lines are preserved — the structural rows already had
    * comments stripped and blanks dropped, so they only delimit. */
-  const chomp = header.includes("-") ? "" : "\n";
+  /* chomping: '-' strip, '+' keep every trailing newline, default clip */
+  const mode = header.includes("-") ? "strip"
+    : header.includes("+") ? "keep" : "clip";
   let j = i;
   while (j < rows.length && rows[j].indent > parentIndent) j++;
   const end = j < rows.length ? rows[j].n : src.length;
@@ -247,8 +249,21 @@ function parseBlockScalar(rows, i, parentIndent, header, headerN, src) {
     if (base === null) base = indent;
     lines.push(raw.slice(Math.min(base, indent)));
   }
-  while (lines.length && lines[lines.length - 1] === "") lines.pop();
+  if (mode !== "keep") {
+    while (lines.length && lines[lines.length - 1] === "") lines.pop();
+  }
+  const chomp = mode === "strip" ? "" : "\n";
   return [lines.join("\n") + (lines.length ? chomp : ""), j];
+}
+
+function foldScalar(s) {
+  /* folded ('>') semantics: a single interior break folds to a space;
+   * a run of 1+k breaks (blank lines) keeps k newlines. Trailing
+   * newlines are chomping's business — leave them untouched. */
+  const tail = s.match(/\n*$/)[0];
+  const body = s.slice(0, s.length - tail.length);
+  return body.replace(/\n+/g,
+    r => r.length === 1 ? " " : "\n".repeat(r.length - 1)) + tail;
 }
 
 function parseBlock(rows, i, indent) {
@@ -314,8 +329,8 @@ function parseBlock(rows, i, indent) {
     const [key, rest] = kv;
     if (key in obj) throw new YamlError(`duplicate key ${key}`,
                                         rows[j].line);
-    if (rest === "" || rest === "|" || rest === "|-" || rest === ">"
-        || rest === ">-") {
+    if (rest === "" || rest === "|" || rest === "|-" || rest === "|+"
+        || rest === ">" || rest === ">-" || rest === ">+") {
       const nxt = rows[j + 1];
       const hasChild = nxt !== undefined && nxt.indent > indent;
       /* kubectl-style zero-indent sequences: a list under a key may
@@ -327,7 +342,7 @@ function parseBlock(rows, i, indent) {
       if (rest.startsWith("|") || rest.startsWith(">")) {
         const [v, next] = parseBlockScalar(rows, j + 1, indent, rest,
                                            rows[j].n, rows[j].src);
-        obj[key] = rest.startsWith(">") ? v.replace(/\n(?!$)/g, " ") : v;
+        obj[key] = rest.startsWith(">") ? foldScalar(v) : v;
         j = next;
       } else if (hasChild || dashChild) {
         const [v, next] = parseBlock(rows, j + 1, nxt.indent);

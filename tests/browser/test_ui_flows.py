@@ -348,6 +348,12 @@ def test_yaml_lib_roundtrip_battery(servers, page):
          {containers: [{name: "x", image: "i"}, {name: "y"}],
           after: 1}],
         ['f: {"a:b" : v}\\n', {f: {"a:b": "v"}}],
+        ["keep: |+\\n  a\\n\\n\\nnext: 1\\n",
+         {keep: "a\\n\\n\\n", next: 1}],
+        ["clip: |\\n  a\\n\\n\\nnext: 1\\n", {clip: "a\\n", next: 1}],
+        ["f: >\\n  one\\n  two\\n\\n  three\\n", {f: "one two\\nthree\\n"}],
+        ["f: >-\\n  a\\n  b\\n", {f: "a b"}],
+        ["f: >+\\n  a\\n\\nnext: 1\\n", {f: "a\\n\\n", next: 1}],
       ];
       handwritten.forEach(([src, want], i) => {
         try {

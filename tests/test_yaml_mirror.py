@@ -21,7 +21,7 @@ YAML_JS = os.path.join(REPO, "kubeflow_tpu", "web", "static", "lib",
 
 #: sha256 of the yaml.js this mirror transliterates — update BOTH files
 #: together (and keep the browser battery in sync)
-YAML_JS_SHA = "d1f2bc4eca6329e32349f2eb0b2d25405eb61396dc0cdc403489c1d95a5776f6"
+YAML_JS_SHA = "86a38f5f705817684f5fd8de5578d72769e221c724f6efa2336bb8920f4144d4"
 
 ROUNDTRIP_CASES = [
     {"apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
@@ -71,6 +71,15 @@ HANDWRITTEN = [
       "after": 1}),
     # whitespace before the colon in a flow mapping with a quoted key
     ('f: {"a:b" : v}\n', {"f": {"a:b": "v"}}),
+    # block-scalar chomping: '|+' keeps trailing newlines (kubectl
+    # accepts it; ADVICE r3 — previously mis-parsed as the scalar "|+")
+    ("keep: |+\n  a\n\n\nnext: 1\n", {"keep": "a\n\n\n", "next": 1}),
+    ("clip: |\n  a\n\n\nnext: 1\n", {"clip": "a\n", "next": 1}),
+    # folded '>': single break folds to space, blank line keeps a
+    # newline (previously blank interior lines became spaces)
+    ("f: >\n  one\n  two\n\n  three\n", {"f": "one two\nthree\n"}),
+    ("f: >-\n  a\n  b\n", {"f": "a b"}),
+    ("f: >+\n  a\n\nnext: 1\n", {"f": "a\n\n", "next": 1}),
 ]
 
 

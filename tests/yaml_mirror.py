@@ -251,7 +251,9 @@ def parse_block_scalar(rows, i, parent_indent, header, header_n, src):
     # literal content comes from the RAW source lines starting right
     # after the header: '#' is content here (shebangs!), comment-looking
     # and blank interior lines are preserved
-    chomp = "" if "-" in header else "\n"
+    # chomping: '-' strip, '+' keep every trailing newline, default clip
+    mode = "strip" if "-" in header else \
+        "keep" if "+" in header else "clip"
     j = i
     while j < len(rows) and rows[j]["indent"] > parent_indent:
         j += 1
@@ -268,9 +270,23 @@ def parse_block_scalar(rows, i, parent_indent, header, header_n, src):
         if base is None:
             base = indent
         lines.append(raw[min(base, indent):])
-    while lines and lines[-1] == "":
-        lines.pop()
+    if mode != "keep":
+        while lines and lines[-1] == "":
+            lines.pop()
+    chomp = "" if mode == "strip" else "\n"
     return ["\n".join(lines) + (chomp if lines else ""), j]
+
+
+def fold_scalar(s):
+    # folded ('>') semantics: a single interior break folds to a space;
+    # a run of 1+k breaks (blank lines) keeps k newlines; trailing
+    # newlines are chomping's business
+    tail = re.search(r"\n*$", s).group(0)
+    body = s[:len(s) - len(tail)]
+    return re.sub(
+        r"\n+",
+        lambda r: " " if len(r.group(0)) == 1
+        else "\n" * (len(r.group(0)) - 1), body) + tail
 
 
 def parse_block(rows, i, indent):
@@ -327,7 +343,7 @@ def parse_block(rows, i, indent):
         key, rest = kv
         if key in obj:
             raise YamlError(f"duplicate key {key}", rows[j]["line"])
-        if rest in ("", "|", "|-", ">", ">-"):
+        if rest in ("", "|", "|-", "|+", ">", ">-", ">+"):
             nxt = rows[j + 1] if j + 1 < len(rows) else None
             has_child = nxt is not None and nxt["indent"] > indent
             # kubectl-style zero-indent sequences: a list under a key
@@ -338,8 +354,7 @@ def parse_block(rows, i, indent):
                 v, nxt = parse_block_scalar(rows, j + 1, indent, rest,
                                             rows[j]["n"],
                                             rows[j]["src"])
-                obj[key] = re.sub(r"\n(?!$)", " ", v) \
-                    if rest.startswith(">") else v
+                obj[key] = fold_scalar(v) if rest.startswith(">") else v
                 j = nxt
             elif has_child or dash_child:
                 v, consumed = parse_block(rows, j + 1, nxt["indent"])
