@@ -38,13 +38,17 @@ import numpy as np
 from jax.sharding import Mesh
 
 DATA = "data"
+PIPELINE = "pipeline"
 FSDP = "fsdp"
 SEQUENCE = "sequence"
 TENSOR = "tensor"
 EXPERT = "expert"
 
-#: canonical axis order, outermost (DCN-friendly) → innermost (ICI-hot)
-AXIS_ORDER = (DATA, FSDP, EXPERT, SEQUENCE, TENSOR)
+#: canonical axis order, outermost (DCN-friendly) → innermost (ICI-hot).
+#: pipeline sits next to data: its stage→stage hops move one
+#: microbatch's activations per tick — low-frequency traffic that
+#: tolerates DCN, unlike the per-layer tensor/sequence collectives
+AXIS_ORDER = (DATA, PIPELINE, FSDP, EXPERT, SEQUENCE, TENSOR)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,6 +57,7 @@ class MeshSpec:
     the remaining devices" (like a reshape wildcard)."""
 
     data: int = 1
+    pipeline: int = 1
     fsdp: int = 1
     sequence: int = 1
     tensor: int = 1

@@ -24,6 +24,7 @@ from jax import lax
 
 from .. import attention as attn_lib
 from .. import sharding
+from ..mesh import EXPERT as EXPERT_AXIS
 from ..ops import flash_attention
 
 
@@ -52,12 +53,34 @@ class Config:
     moe_experts: int = 0
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
+    # dropless dispatch (megablocks-style sort + grouped matmul via
+    # lax.ragged_dot): every routed token is computed, no capacity
+    # buffers. capacity_factor is ignored when set.
+    moe_dropless: bool = False
+    # GPipe pipeline parallelism (compute/pipeline.py, ADR-7): layers
+    # stage-shard over the ``pipeline`` mesh axis. 0/1 = off;
+    # pipeline_microbatches 0 → = pipeline_stages.
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 0
 
     def __post_init__(self):
         if self.chunked_ce and self.vocab_size % self.ce_chunk:
             raise ValueError(
                 f"ce_chunk={self.ce_chunk} must divide "
                 f"vocab_size={self.vocab_size}")
+        if self.pipeline_stages > 1:
+            if not self.scan_layers:
+                raise ValueError(
+                    "pipeline_stages needs scan_layers=True (stage "
+                    "assignment shards the stacked-layer dim)")
+            if self.n_layers % self.pipeline_stages:
+                raise ValueError(
+                    f"n_layers={self.n_layers} not divisible by "
+                    f"pipeline_stages={self.pipeline_stages}")
+
+    @property
+    def microbatches(self):
+        return self.pipeline_microbatches or self.pipeline_stages
 
     @property
     def kv_heads(self):
@@ -121,7 +144,10 @@ def logical_axes(config):
     tree = {}
     for name, v in _shapes(config).items():
         if name == "layers":
-            prefix = ("layers",) if config.scan_layers else ()
+            # with pipeline parallelism the stacked-layer dim IS the
+            # stage assignment (sharded over the pipeline mesh axis)
+            lead = "stage" if config.pipeline_stages > 1 else "layers"
+            prefix = (lead,) if config.scan_layers else ()
             tree["layers"] = {k: prefix + ax for k, (_, ax) in v.items()}
             if not config.scan_layers:
                 tree["layers"] = [tree["layers"]] * config.n_layers
@@ -224,15 +250,7 @@ def _switch_moe(h, lp, config):
     # the default factor silently drops ~(k-1)/k of balanced traffic
     capacity = max(1, int(k * s / e * config.moe_capacity_factor))
 
-    # router in fp32 (Switch-paper selective precision: bf16-quantized
-    # logits destabilize near-tied argmax assignments)
-    router_logits = jnp.einsum(
-        "bsd,de->bse", h.astype(jnp.float32),
-        lp["router"].astype(jnp.float32))
-    probs = jax.nn.softmax(router_logits, axis=-1)
-    gate_vals, expert_idx = lax.top_k(probs, k)          # [b,s,k]
-    gate_vals = gate_vals / jnp.maximum(
-        gate_vals.sum(axis=-1, keepdims=True), 1e-9)
+    probs, gate_vals, expert_idx = _router(h, lp, config)  # [b,s,k]
 
     # each of the k choices is a dispatch slot; positions within an
     # expert's capacity buffer are assigned over the (s, k) slot order
@@ -256,11 +274,114 @@ def _switch_moe(h, lp, config):
     combine = dispatch * gate_vals.astype(dt)[..., None, None]
     out = jnp.einsum("bskec,ebcd->bsd", combine, out_e)
 
-    # aux loss: fraction-of-first-choice-tokens · mean prob per expert
-    frac_tokens = assign[:, :, 0, :].mean(axis=(0, 1))
+    return out, _moe_aux(probs, expert_idx, e)
+
+
+def _router(h, lp, config):
+    """Shared routing head: fp32 softmax (Switch-paper selective
+    precision), top-k gates renormalized over the chosen experts.
+    Returns (probs [b,s,e], gate_vals [b,s,k], expert_idx [b,s,k])."""
+    e = config.moe_experts
+    k = min(config.moe_top_k, e)
+    router_logits = jnp.einsum(
+        "bsd,de->bse", h.astype(jnp.float32),
+        lp["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    gate_vals, expert_idx = lax.top_k(probs, k)
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(axis=-1, keepdims=True), 1e-9)
+    return probs, gate_vals, expert_idx
+
+
+def _moe_aux(probs, expert_idx, e):
+    """Switch/GShard load-balancing aux loss from the first choice."""
+    frac_tokens = (expert_idx[..., 0:1] ==
+                   jnp.arange(e)).astype(jnp.float32).mean(axis=(0, 1))
     frac_probs = probs.mean(axis=(0, 1))
-    aux = e * jnp.sum(frac_tokens * frac_probs)
-    return out, aux
+    return e * jnp.sum(frac_tokens * frac_probs)
+
+
+def _dropless_moe(h, lp, config):
+    """Dropless MoE dispatch: megablocks-style sort + grouped matmul.
+
+    No capacity buffers — every (token, choice) assignment is computed:
+    assignments are sorted by expert id and each expert's contiguous
+    row-block goes through one ``lax.ragged_dot`` per projection (the
+    TPU grouped-matmul primitive; MXU-tiled, no padding rows beyond the
+    sort order itself).
+
+    Expert parallelism is a partial-manual ``shard_map`` over the
+    ``expert`` mesh axis (same idiom as ring attention / pipeline):
+    each shard keeps its local experts' weights, processes only the
+    assignments routed to them (foreign rows collapse into a zero-weight
+    dummy group), and the sparse per-shard outputs psum-combine. Routing
+    is replicated; data-local routing with a ragged all-to-all is the
+    perf refinement if profiles ever show the psum dominating.
+
+    Returns (out [b,s,d], aux_loss scalar fp32).
+    """
+    dt = config.compute_dtype
+    b, s, d = h.shape
+    e = config.moe_experts
+    k = min(config.moe_top_k, e)
+    probs, gate_vals, expert_idx = _router(h, lp, config)
+
+    hf = h.reshape(b * s, d)
+    flat_idx = expert_idx.reshape(b * s, k)
+    flat_gate = gate_vals.reshape(b * s, k)
+
+    def manual(wg, wu, wd, hf, idx, gates):
+        shard = lax.axis_index(EXPERT_AXIS)
+        e_local = wg.shape[0]
+        flat = idx.reshape(-1)                       # [N*k] global ids
+        loc = flat - shard * e_local
+        mine = (loc >= 0) & (loc < e_local)
+        # stable sort by local expert; foreign rows form a trailing
+        # dummy group with zero weights
+        key = jnp.where(mine, loc, e_local)
+        order = jnp.argsort(key, stable=True)
+        counts = jnp.bincount(key, length=e_local + 1).astype(jnp.int32)
+        tok = order // k
+        xg = jnp.take(hf, tok, axis=0)
+        zg = jnp.zeros((1,) + wg.shape[1:], wg.dtype)
+        zd = jnp.zeros((1,) + wd.shape[1:], wd.dtype)
+        gate_h = lax.ragged_dot(xg, jnp.concatenate([wg, zg]), counts)
+        up_h = lax.ragged_dot(xg, jnp.concatenate([wu, zg]), counts)
+        rows = lax.ragged_dot(jax.nn.silu(gate_h) * up_h,
+                              jnp.concatenate([wd, zd]), counts)
+        scale = gates.reshape(-1)[order] * mine[order].astype(gates.dtype)
+        rows = rows * scale.astype(rows.dtype)[:, None]
+        out = jnp.zeros_like(hf).at[tok].add(rows)
+        return lax.psum(out, EXPERT_AXIS)
+
+    if _axis_is_manual(EXPERT_AXIS):
+        # already inside a manual region that owns ``expert`` (the
+        # pipeline shard_map) — weights arrive pre-localized; run the
+        # body directly on the ambient axis
+        out = manual(lp["we_gate"].astype(dt), lp["we_up"].astype(dt),
+                     lp["we_down"].astype(dt), hf.astype(dt),
+                     flat_idx, flat_gate.astype(dt))
+    else:
+        from jax.sharding import PartitionSpec as P
+        sm = jax.shard_map(
+            manual,
+            in_specs=(P(EXPERT_AXIS), P(EXPERT_AXIS), P(EXPERT_AXIS),
+                      P(), P(), P()),
+            out_specs=P(), axis_names={EXPERT_AXIS}, check_vma=False)
+        out = sm(lp["we_gate"].astype(dt), lp["we_up"].astype(dt),
+                 lp["we_down"].astype(dt), hf.astype(dt),
+                 flat_idx, flat_gate.astype(dt))
+    return out.reshape(b, s, d), _moe_aux(probs, expert_idx, e)
+
+
+def _axis_is_manual(axis):
+    """True when tracing inside a shard_map that holds ``axis`` manual
+    (lax.axis_index/psum over it are legal)."""
+    try:
+        lax.axis_size(axis)
+        return True
+    except Exception:
+        return False
 
 
 def _layer(lp, x, rope, config):
@@ -279,7 +400,9 @@ def _layer(lp, x, rope, config):
     x = sharding.constrain(x + o, ("batch", "seq", "act_embed"))
 
     h = _rmsnorm(x, lp["mlp_norm"].astype(dt))
-    if config.moe_experts:
+    if config.moe_experts and config.moe_dropless:
+        down, aux = _dropless_moe(h, lp, config)
+    elif config.moe_experts:
         down, aux = _switch_moe(h, lp, config)
     else:
         gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(dt))
@@ -302,7 +425,23 @@ def backbone(params, tokens, config):
     layer = lambda lp, x: _layer(lp, x, rope, config)  # noqa: E731
     if config.remat:
         layer = jax.checkpoint(layer)
-    if config.scan_layers:
+    if config.pipeline_stages > 1:
+        from jax.sharding import PartitionSpec as P
+
+        from .. import pipeline as pipeline_lib
+        from ..mesh import PIPELINE as PP_AXIS
+        extra, specs = (), None
+        if config.moe_experts and config.moe_dropless:
+            # dropless MoE runs manual over ``expert``; the pipeline
+            # shard_map must own that axis (no nested manual regions),
+            # with the expert dim of we_* weights sharded inside it
+            extra = (EXPERT_AXIS,)
+            specs = {k: P(PP_AXIS, EXPERT_AXIS) if k.startswith("we_")
+                     else P(PP_AXIS) for k in params["layers"]}
+        x, aux = pipeline_lib.pipelined_layers(
+            layer, params["layers"], x, config.microbatches,
+            extra_axes=extra, stacked_specs=specs)
+    elif config.scan_layers:
         x, auxs = lax.scan(lambda c, lp: layer(lp, c),
                            x, params["layers"])
         aux = auxs.mean()

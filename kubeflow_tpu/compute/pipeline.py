@@ -1,0 +1,130 @@
+"""GPipe-style pipeline parallelism over a ``pipeline`` mesh axis.
+
+The reference has no pipeline parallelism anywhere (SURVEY.md §2
+parallelism table: "TP/PP/SP/EP/CP … absent entirely; first-class new
+components to build"). TPU-native design, per ADR-7:
+
+- Stage assignment is a *sharding*: the scan-over-layers stacked params
+  (leading dim L) carry the ``stage`` logical axis, so a ``pipeline``
+  mesh axis of size P gives each device a contiguous block of L/P
+  layers — no parameter surgery, checkpoints stay layout-compatible
+  (restore-across-mesh-layouts already proven for the other axes).
+- The schedule is data: a ``lax.scan`` over M + P - 1 ticks inside a
+  partial-manual ``shard_map`` (manual over ``pipeline`` only, exactly
+  like ring attention over ``sequence`` — attention.py:103). Every
+  stage runs the same traced program; activations hop stage→stage via
+  ``lax.ppermute`` on a linear chain, riding ICI/DCN neighbor links.
+- Differentiable by construction: autodiff through scan + ppermute
+  yields the reverse chain for the backward pass (1F1B-style memory
+  scheduling is a later optimization; GPipe semantics first).
+- Composes with the other axes: batch stays sharded over data/fsdp,
+  heads/mlp over tensor — only the pipeline axis is manual here.
+
+Bubble accounting: ticks T = M + P - 1, so utilization is M / (M+P-1);
+callers pick ``n_microbatches`` ≥ P to keep the bubble fraction at
+(P-1)/(M+P-1). Warmup/drain ticks compute on garbage inputs whose
+outputs (and cotangents) are masked out — wasted FLOPs equal to the
+bubble, the standard GPipe trade.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+
+def pipelined_layers(layer_fn, stacked, x, n_microbatches,
+                     axis=mesh_lib.PIPELINE, extra_axes=(),
+                     stacked_specs=None):
+    """Run a stack of layers as a GPipe pipeline over ``axis``.
+
+    ``layer_fn(lp, x) -> (x', aux)`` — one layer (pre-remat'd by the
+    caller); ``stacked`` — pytree with leading layer dim L on every
+    leaf, L divisible by the pipeline axis size; ``x`` — [B, S, D]
+    activations, B divisible by ``n_microbatches``.
+
+    ``extra_axes``/``stacked_specs``: a layer body that itself needs a
+    manual mesh axis (dropless MoE's ``expert`` — transformer passes
+    both) cannot open a nested shard_map over it, so this outer one
+    takes ownership: the axis joins the manual set and ``stacked_specs``
+    (a pytree of PartitionSpecs matching ``stacked``) says which leaf
+    dims live on it; the body then uses the ambient axis directly.
+
+    Returns (y [B, S, D], aux) where aux is the mean of per-layer aux
+    values over all layers and microbatches (MoE load-balancing loss).
+    """
+    leaves = jax.tree.leaves(stacked)
+    n_layers = leaves[0].shape[0]
+    batch = x.shape[0]
+    if batch % n_microbatches:
+        raise ValueError(
+            f"batch {batch} not divisible by "
+            f"n_microbatches={n_microbatches}")
+
+    fn = functools.partial(_pipeline_manual, layer_fn, n_microbatches,
+                           n_layers, axis)
+    sm = jax.shard_map(
+        fn, in_specs=(stacked_specs if stacked_specs is not None
+                      else P(axis), P()),
+        out_specs=(P(), P()),
+        axis_names={axis, *extra_axes}, check_vma=False)
+    return sm(stacked, x)
+
+
+def _pipeline_manual(layer_fn, n_micro, n_layers, axis, local, x):
+    """Per-stage body (inside shard_map): local = this stage's [L/P, …]
+    layer block; x = full [B, S, D] (replicated over the pipeline axis,
+    auto-sharded over everything else)."""
+    n_stages = lax.axis_size(axis)
+    stage = lax.axis_index(axis)
+    m = n_micro
+    mb = x.shape[0] // m
+    xs = x.reshape(m, mb, *x.shape[1:])
+
+    def run_stage(xin):
+        def one(carry, lp):
+            y, aux = layer_fn(lp, carry)
+            return y, aux
+        y, auxs = lax.scan(one, xin, local)
+        return y, auxs.sum()
+
+    # linear chain, not a ring: the last stage's output is the result,
+    # not an input to stage 0
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+    ticks = m + n_stages - 1
+
+    def tick(carry, t):
+        recv, out, aux_sum = carry
+        feed = lax.dynamic_index_in_dim(
+            xs, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+        xin = jnp.where(stage == 0, feed, recv)
+        y, aux = run_stage(xin)
+        recv_next = lax.ppermute(y, axis, perm)
+        # this stage works on microbatch t - stage this tick; outside
+        # [0, m) it's a warmup/drain bubble whose output must not land
+        my_micro = t - stage
+        valid = (my_micro >= 0) & (my_micro < m)
+        aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
+        oidx = jnp.clip(t - (n_stages - 1), 0, m - 1)
+        cur = lax.dynamic_index_in_dim(out, oidx, 0, keepdims=False)
+        emit = (stage == n_stages - 1) & (t >= n_stages - 1)
+        new = jnp.where(emit, y.astype(out.dtype), cur)
+        out = lax.dynamic_update_index_in_dim(out, new, oidx, 0)
+        return (recv_next, out, aux_sum), None
+
+    out0 = jnp.zeros_like(xs)
+    recv0 = jnp.zeros_like(xs[0])
+    (recv, out, aux_sum), _ = lax.scan(
+        tick, (recv0, out0, jnp.zeros((), jnp.float32)),
+        jnp.arange(ticks))
+
+    # only the last stage's buffer holds real outputs; every stage's
+    # aux_sum holds its own layers' contributions — one psum each
+    out = lax.psum(
+        jnp.where(stage == n_stages - 1, out, jnp.zeros_like(out)), axis)
+    aux = lax.psum(aux_sum, axis) / (n_layers * m)
+    return out.reshape(x.shape), aux

@@ -33,6 +33,9 @@ DEFAULT_RULES = {
     "kv": None,
     "expert": mesh_lib.EXPERT,
     "layers": None,                  # scan-over-layers leading dim
+    # pipeline parallelism: the stacked-layer leading dim becomes the
+    # stage assignment — L/P contiguous layers per device (pipeline.py)
+    "stage": mesh_lib.PIPELINE,
 }
 
 

@@ -258,12 +258,32 @@ function parseBlockScalar(rows, i, parentIndent, header, headerN, src) {
 
 function foldScalar(s) {
   /* folded ('>') semantics: a single interior break folds to a space;
-   * a run of 1+k breaks (blank lines) keeps k newlines. Trailing
-   * newlines are chomping's business — leave them untouched. */
+   * a run of 1+k breaks (blank lines) keeps k newlines; breaks
+   * adjacent to a MORE-INDENTED line stay literal (whitespace-
+   * significant content survives). Trailing newlines are chomping's
+   * business — leave them untouched. */
   const tail = s.match(/\n*$/)[0];
   const body = s.slice(0, s.length - tail.length);
-  return body.replace(/\n+/g,
-    r => r.length === 1 ? " " : "\n".repeat(r.length - 1)) + tail;
+  const lines = body.split("\n");
+  const indented = l => l.startsWith(" ") || l.startsWith("\t");
+  let out = lines[0];
+  let prev = lines[0];
+  let i = 1;
+  while (i < lines.length) {
+    let j = i;
+    while (j < lines.length && lines[j] === "") j++;
+    const blanks = j - i;
+    const next = j < lines.length ? lines[j] : "";
+    const literal = indented(prev) || indented(next);
+    if (blanks === 0) {
+      out += (literal ? "\n" : " ") + next;
+    } else {
+      out += "\n".repeat(literal ? blanks + 1 : blanks) + next;
+    }
+    prev = next;
+    i = j + 1;
+  }
+  return out + tail;
 }
 
 function parseBlock(rows, i, indent) {

@@ -354,6 +354,9 @@ def test_yaml_lib_roundtrip_battery(servers, page):
         ["f: >\\n  one\\n  two\\n\\n  three\\n", {f: "one two\\nthree\\n"}],
         ["f: >-\\n  a\\n  b\\n", {f: "a b"}],
         ["f: >+\\n  a\\n\\nnext: 1\\n", {f: "a\\n\\n", next: 1}],
+        ["f: >\\n  a\\n    b\\n  c\\n", {f: "a\\n  b\\nc\\n"}],
+        ["f: >\\n  a\\n\\n    code\\n\\n  b\\n",
+         {f: "a\\n\\n  code\\n\\nb\\n"}],
       ];
       handwritten.forEach(([src, want], i) => {
         try {

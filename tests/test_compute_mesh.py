@@ -82,8 +82,10 @@ def test_constrain_is_noop_outside_jit():
 
 
 def test_canonical_axes_cover_all_strategies():
-    # dp/fsdp/sp/tp/ep all first-class (SURVEY.md §2 parallelism table)
-    assert M.AXIS_ORDER == ("data", "fsdp", "expert", "sequence", "tensor")
+    # dp/pp/fsdp/sp/tp/ep all first-class (SURVEY.md §2 parallelism
+    # table; pp landed with compute/pipeline.py — ADR-7)
+    assert M.AXIS_ORDER == ("data", "pipeline", "fsdp", "expert",
+                            "sequence", "tensor")
     devices = jax.devices()
     assert len(devices) == 8, "tests require the virtual 8-device mesh"
 
@@ -119,8 +121,8 @@ class TestMultislice:
         mesh = M.make_multislice_mesh(fsdp=2, tensor=2)
         # 8 virtual cpu devices, one 'slice': data fills the rest
         assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
-            "data": 2, "fsdp": 2, "expert": 1, "sequence": 1,
-            "tensor": 2}
+            "data": 2, "pipeline": 1, "fsdp": 2, "expert": 1,
+            "sequence": 1, "tensor": 2}
         assert mesh.devices.size == len(jax.devices())
 
     def test_two_fake_slices_put_data_across_dcn(self):
@@ -133,8 +135,8 @@ class TestMultislice:
         # when the caller passed devices shuffled
         ordered, spec = multislice_layout(groups, fsdp=2, tensor=2)
         sizes = spec.resolved(len(ordered))
-        assert sizes == {"data": 2, "fsdp": 2, "expert": 1,
-                         "sequence": 1, "tensor": 2}
+        assert sizes == {"data": 2, "pipeline": 1, "fsdp": 2,
+                         "expert": 1, "sequence": 1, "tensor": 2}
         assert [d.slice_index for d in ordered[:4]] == [0] * 4
         assert [d.slice_index for d in ordered[4:]] == [1] * 4
         assert [d.id for d in ordered] == list(range(8))

@@ -1,0 +1,194 @@
+"""Pipeline parallelism (compute/pipeline.py, ADR-7).
+
+Correctness bar: the GPipe schedule is an *execution order*, not a
+different function — pipelined loss and gradients must match the plain
+scan-over-layers program bit-for-tolerance on the same params. Verified
+on the virtual 8-device CPU mesh (conftest), composed with data and
+tensor axes, plus the stage-sharding layout and failure modes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.compute import mesh as M
+from kubeflow_tpu.compute import sharding as S
+from kubeflow_tpu.compute import train
+from kubeflow_tpu.compute.models import transformer
+
+
+def _mesh(**axes):
+    import math
+    n = math.prod(axes.values()) if axes else 1
+    return M.make_mesh(M.MeshSpec(**axes), devices=jax.devices()[:n])
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=64, d_model=16, n_layers=4, n_heads=2,
+                max_seq=16, dtype="float32", attention="dense",
+                remat=False)
+    base.update(kw)
+    return transformer.Config(**base)
+
+
+def _batch(cfg, batch=4, seed=0):
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(seed), (batch, cfg.max_seq), 0,
+        cfg.vocab_size)
+    return {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+
+
+def _loss_and_grads(cfg, mesh, batch):
+    params = transformer.init_params(cfg, jax.random.PRNGKey(1))
+    params = S.shard_tree(params, mesh, transformer.logical_axes(cfg))
+    with jax.set_mesh(mesh):
+        loss_fn = lambda p: transformer.loss_fn(p, batch, cfg)[0]  # noqa
+        loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+    return float(loss), jax.tree.map(np.asarray, grads)
+
+
+class TestPipelineMatchesScan:
+    def test_loss_and_grads_match_plain_scan(self):
+        batch = _batch(_cfg())
+        plain = _loss_and_grads(_cfg(), _mesh(), batch)
+        piped = _loss_and_grads(
+            _cfg(pipeline_stages=2, pipeline_microbatches=2),
+            _mesh(pipeline=2), batch)
+        assert np.isclose(plain[0], piped[0], rtol=1e-5)
+        flat_a = jax.tree.leaves(plain[1])
+        flat_b = jax.tree.leaves(piped[1])
+        for a, b in zip(flat_a, flat_b):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def test_more_microbatches_than_stages(self):
+        batch = _batch(_cfg(), batch=8)
+        plain = _loss_and_grads(_cfg(), _mesh(), batch)
+        piped = _loss_and_grads(
+            _cfg(pipeline_stages=2, pipeline_microbatches=4),
+            _mesh(pipeline=2), batch)
+        assert np.isclose(plain[0], piped[0], rtol=1e-5)
+
+    def test_four_stages(self):
+        batch = _batch(_cfg(), batch=4)
+        plain = _loss_and_grads(_cfg(), _mesh(), batch)
+        piped = _loss_and_grads(
+            _cfg(pipeline_stages=4, pipeline_microbatches=4),
+            _mesh(pipeline=4), batch)
+        assert np.isclose(plain[0], piped[0], rtol=1e-5)
+
+
+class TestPipelineComposition:
+    def test_trains_with_data_and_tensor_axes(self):
+        """pipeline×data×tensor mesh: full train step, loss decreases
+        (memorization) — the ADR-7 'PP axis trains in dryrun' bar."""
+        cfg = _cfg(pipeline_stages=2, pipeline_microbatches=2)
+        mesh = _mesh(data=2, pipeline=2, tensor=2)
+        opt = train.make_optimizer(learning_rate=3e-2, warmup_steps=1,
+                                   total_steps=50)
+        state = train.init_state(
+            lambda k: transformer.init_params(cfg, k), opt, mesh,
+            transformer.logical_axes(cfg), jax.random.PRNGKey(0))
+        step = train.make_train_step(
+            train.plain_loss(transformer.loss_fn, cfg), opt, mesh)
+        batch = _batch(cfg, batch=8)
+        losses = []
+        for _ in range(5):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_stage_dim_is_sharded_over_pipeline_axis(self):
+        cfg = _cfg(pipeline_stages=2)
+        mesh = _mesh(pipeline=2)
+        shardings = S.tree_shardings(mesh, transformer.logical_axes(cfg))
+        spec = shardings["layers"]["wq"].spec
+        assert spec[0] == M.PIPELINE
+
+    def test_moe_aux_loss_survives_pipelining(self):
+        """MoE layers inside a pipeline: the aux load-balancing loss
+        must be the mean over real (non-bubble) layer executions."""
+        cfg = _cfg(moe_experts=2, pipeline_stages=2,
+                   pipeline_microbatches=2)
+        mesh = _mesh(pipeline=2)
+        batch = _batch(cfg)
+        params = transformer.init_params(cfg, jax.random.PRNGKey(1))
+        params = S.shard_tree(params, mesh, transformer.logical_axes(cfg))
+        with jax.set_mesh(mesh):
+            loss_p, metrics_p = jax.jit(
+                lambda p: transformer.loss_fn(p, batch, cfg))(params)
+        plain_cfg = _cfg(moe_experts=2)
+        plain_mesh = _mesh()
+        params2 = transformer.init_params(plain_cfg, jax.random.PRNGKey(1))
+        params2 = S.shard_tree(params2, plain_mesh,
+                               transformer.logical_axes(plain_cfg))
+        with jax.set_mesh(plain_mesh):
+            loss_d, metrics_d = jax.jit(
+                lambda p: transformer.loss_fn(p, batch, plain_cfg))(
+                    params2)
+        # routing and dispatch are per-row, so the CE term (perplexity)
+        # is invariant under microbatching; the aux loss is quadratic in
+        # routing fractions, so its microbatch mean is a different (and
+        # correct) estimator — same situation as gradient accumulation.
+        # It must exist, be finite, and sit near the full-batch value.
+        np.testing.assert_allclose(float(metrics_p["perplexity"]),
+                                   float(metrics_d["perplexity"]),
+                                   rtol=1e-5)
+        aux_p = float(metrics_p["moe_aux"])
+        assert np.isfinite(aux_p)
+        np.testing.assert_allclose(aux_p, float(metrics_d["moe_aux"]),
+                                   rtol=0.1)
+
+
+class TestPipelineValidation:
+    def test_layers_must_divide_stages(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            _cfg(n_layers=3, pipeline_stages=2)
+
+    def test_needs_scan_layers(self):
+        with pytest.raises(ValueError, match="scan_layers"):
+            _cfg(scan_layers=False, pipeline_stages=2)
+
+    def test_batch_must_divide_microbatches(self):
+        from kubeflow_tpu.compute import pipeline as pl
+        cfg = _cfg(pipeline_stages=2, pipeline_microbatches=3)
+        mesh = _mesh(pipeline=2)
+        batch = _batch(cfg, batch=4)
+        params = transformer.init_params(cfg, jax.random.PRNGKey(1))
+        with pytest.raises(ValueError, match="not divisible"):
+            with jax.set_mesh(mesh):
+                jax.jit(lambda p: transformer.loss_fn(
+                    p, batch, cfg)[0])(params)
+        assert pl  # imported for the error-source module
+
+
+class TestPipelineDroplessMoE:
+    def test_dropless_moe_inside_pipeline(self):
+        """Nested-manual composition (caught by the r4 verify drive):
+        dropless MoE needs manual control of ``expert`` inside the
+        pipeline's manual region — the pipeline shard_map owns both
+        axes and the MoE body rides the ambient one. CE must match the
+        non-pipelined program exactly; aux is the microbatch estimator."""
+        kw = dict(vocab_size=64, d_model=32, n_layers=4, n_heads=2,
+                  max_seq=16, dtype="float32", attention="dense",
+                  remat=False, moe_experts=2, moe_top_k=2,
+                  moe_dropless=True)
+        cfg_pp = transformer.Config(pipeline_stages=2,
+                                    pipeline_microbatches=2, **kw)
+        cfg = transformer.Config(**kw)
+        batch = _batch(cfg, batch=8)
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        with jax.set_mesh(_mesh()):
+            _, m_plain = jax.jit(
+                lambda p: transformer.loss_fn(p, batch, cfg))(params)
+        mesh = _mesh(data=2, pipeline=2, expert=2)
+        sharded = S.shard_tree(params, mesh,
+                               transformer.logical_axes(cfg_pp))
+        with jax.set_mesh(mesh):
+            _, m_pp = jax.jit(
+                lambda p: transformer.loss_fn(p, batch, cfg_pp))(sharded)
+        np.testing.assert_allclose(float(m_pp["perplexity"]),
+                                   float(m_plain["perplexity"]),
+                                   rtol=1e-5)
+        assert np.isfinite(float(m_pp["moe_aux"]))

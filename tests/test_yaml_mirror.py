@@ -21,7 +21,7 @@ YAML_JS = os.path.join(REPO, "kubeflow_tpu", "web", "static", "lib",
 
 #: sha256 of the yaml.js this mirror transliterates — update BOTH files
 #: together (and keep the browser battery in sync)
-YAML_JS_SHA = "86a38f5f705817684f5fd8de5578d72769e221c724f6efa2336bb8920f4144d4"
+YAML_JS_SHA = "360cdb88b4cc66f08943a87062c84486cab004bc4ee115b60be3e82997083e7a"
 
 ROUNDTRIP_CASES = [
     {"apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
@@ -80,6 +80,10 @@ HANDWRITTEN = [
     ("f: >\n  one\n  two\n\n  three\n", {"f": "one two\nthree\n"}),
     ("f: >-\n  a\n  b\n", {"f": "a b"}),
     ("f: >+\n  a\n\nnext: 1\n", {"f": "a\n\n", "next": 1}),
+    # folded: breaks adjacent to MORE-indented lines stay literal
+    # (r4 review; verified against PyYAML)
+    ("f: >\n  a\n    b\n  c\n", {"f": "a\n  b\nc\n"}),
+    ("f: >\n  a\n\n    code\n\n  b\n", {"f": "a\n\n  code\n\nb\n"}),
 ]
 
 

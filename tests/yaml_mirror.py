@@ -279,14 +279,30 @@ def parse_block_scalar(rows, i, parent_indent, header, header_n, src):
 
 def fold_scalar(s):
     # folded ('>') semantics: a single interior break folds to a space;
-    # a run of 1+k breaks (blank lines) keeps k newlines; trailing
-    # newlines are chomping's business
+    # a run of 1+k breaks (blank lines) keeps k newlines; breaks
+    # adjacent to a MORE-indented line stay literal; trailing newlines
+    # are chomping's business
     tail = re.search(r"\n*$", s).group(0)
     body = s[:len(s) - len(tail)]
-    return re.sub(
-        r"\n+",
-        lambda r: " " if len(r.group(0)) == 1
-        else "\n" * (len(r.group(0)) - 1), body) + tail
+    lines = body.split("\n")
+    indented = lambda l: l.startswith((" ", "\t"))  # noqa: E731
+    out = lines[0]
+    prev = lines[0]
+    i = 1
+    while i < len(lines):
+        j = i
+        while j < len(lines) and lines[j] == "":
+            j += 1
+        blanks = j - i
+        nxt = lines[j] if j < len(lines) else ""
+        literal = indented(prev) or indented(nxt)
+        if blanks == 0:
+            out += ("\n" if literal else " ") + nxt
+        else:
+            out += "\n" * (blanks + 1 if literal else blanks) + nxt
+        prev = nxt
+        i = j + 1
+    return out + tail
 
 
 def parse_block(rows, i, indent):
