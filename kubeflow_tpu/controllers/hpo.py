@@ -29,7 +29,7 @@ import statistics
 import numpy as np
 
 __all__ = ["tpe_sample", "median_should_stop", "asha_should_stop",
-           "N_STARTUP"]
+           "pbt_next", "N_STARTUP"]
 
 #: trials sampled space-fillingly before the model kicks in
 N_STARTUP = 5
@@ -118,6 +118,88 @@ def tpe_sample(parameters, trial_index, seed, history, maximize,
             u = _tpe_unit(rng, good, bad or good)
         values[name] = value_at(p, u)
     return values
+
+
+# ------------------------------------------------------------------ PBT
+
+def pbt_next(parameters, trial_index, seed, population, prev_gen,
+             maximize, value_at, unit_of, quantile=0.25,
+             resample_prob=0.25, factors=(0.8, 1.2)):
+    """Population-based training (Jaderberg et al. 2017) on the
+    generational trial seam: trial i is member ``i % population`` of
+    generation ``i // population``; each generation trains one segment
+    from its inherited checkpoint, reports the objective, and exits.
+
+    ``prev_gen``: the previous generation's trials as
+    [{"index", "parameters", "objectiveValue"}] (missing/None objective
+    ranks worst). Returns ``(values, meta)`` where meta records the
+    truth-exploit/explore decisions for trial status:
+
+    - bottom-``quantile`` members EXPLOIT: inherit a uniformly chosen
+      top-``quantile`` member's parameters and checkpoint, then EXPLORE
+      by perturbation (numeric: ×0.8/1.2 clamped into the domain, or a
+      fresh resample with ``resample_prob``; categorical: resample with
+      ``resample_prob``),
+    - everyone else CONTINUES: same parameters, own checkpoint.
+
+    Deterministic: RNG seeded from (seed, trial_index), so reconciler
+    replays propose identical generations. Checkpoint *paths* are the
+    caller's contract (the StudyJob reconciler renders them into the
+    trial template); this function only decides lineage.
+    """
+    generation = trial_index // population
+    member = trial_index % population
+    rng = _rng(f"pbt:{seed}", trial_index)
+    # only Succeeded trials carry a trustworthy objective AND a written
+    # checkpoint — EarlyStopped/Failed pods died before the segment-end
+    # save, so they must neither rank nor serve as exploit parents
+    valid = [t for t in prev_gen if t.get("objectiveValue") is not None]
+    if generation == 0 or not valid:
+        # fresh start (whole population lost ⇒ same as generation 0);
+        # the reconciler uses its space-filling sampler for this path
+        values = {p["name"]: value_at(p, float(rng.uniform()))
+                  for p in parameters}
+        return values, {"event": "init", "parent": None}
+
+    ranked = sorted(valid, key=lambda t: t["objectiveValue"],
+                    reverse=maximize)
+    cut = max(1, math.ceil(quantile * len(ranked)))
+    top = ranked[:cut]
+    # disjoint from top even when 2·cut > population (e.g. pop 3 at
+    # quantile 0.5): a top-quantile member must never be exploited away
+    bottom = ranked[max(cut, len(ranked) - cut):]
+    bottom_members = {t["index"] % population for t in bottom}
+    me = next((t for t in valid
+               if t["index"] % population == member), None)
+
+    if member not in bottom_members and me is not None:
+        return dict(me.get("parameters") or {}), {
+            "event": "continue", "parent": me["index"]}
+
+    parent = top[int(rng.integers(len(top)))]
+    values = dict(parent.get("parameters") or {})
+    perturbed = {}
+    for p in parameters:
+        name = p["name"]
+        if name not in values:
+            values[name] = value_at(p, float(rng.uniform()))
+            continue
+        old = values[name]
+        if float(rng.uniform()) < resample_prob:
+            values[name] = value_at(p, float(rng.uniform()))
+        elif p.get("type", "double") == "categorical":
+            continue                      # resample-only exploration
+        else:
+            # classic PBT numeric perturbation: multiply by 0.8/1.2,
+            # clamped into the domain via the unit-space round-trip
+            # (log-scale doubles multiply naturally; ints re-bucket)
+            factor = factors[int(rng.integers(len(factors)))]
+            u_new = min(1.0, max(0.0, unit_of(p, old * factor)))
+            values[name] = value_at(p, u_new)
+        if values[name] != old:
+            perturbed[name] = [old, values[name]]
+    return values, {"event": "exploit", "parent": parent["index"],
+                    "perturbed": perturbed}
 
 
 # ------------------------------------------------------------ medianstop

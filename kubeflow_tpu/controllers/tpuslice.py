@@ -403,9 +403,15 @@ def sample_parameters(parameters, trial_index, seed=0,
             else:   # categorical
                 values[p["name"]] = (p.get("values") or [""])[k]
         return values
+    if algorithm == "pbt":
+        # generation-0 / validation path: PBT's fresh members are
+        # space-filling; the generational exploit/explore flow runs in
+        # the reconciler via hpo.pbt_next (needs the previous
+        # generation's trials, not just (values, objective) history)
+        return sample_parameters(parameters, trial_index, seed, "halton")
     if algorithm != "random":
         raise ValueError(f"unknown algorithm {algorithm!r}; "
-                         f"expected random, grid, halton, or tpe")
+                         f"expected random, grid, halton, tpe, or pbt")
     for p in parameters:
         h = hashlib.sha256(
             f"{seed}:{trial_index}:{p['name']}".encode()).digest()
@@ -515,6 +521,24 @@ def validate_study_spec(spec):
     int(spec.get("parallelTrialCount", 0))
     int(spec.get("chipsPerTrial", 1) or 1)
     int(m.deep_get(spec, "algorithm", "seed", default=0) or 0)
+    if m.deep_get(spec, "algorithm", "name") == "pbt":
+        pop = int(m.deep_get(spec, "algorithm", "population",
+                             default=0) or 0)
+        if pop < 2:
+            raise ValueError("pbt needs algorithm.population >= 2")
+        if pop > int(spec.get("maxTrialCount", 0)):
+            raise ValueError(
+                "pbt population exceeds maxTrialCount (needs at least "
+                "one full generation)")
+        q = float(m.deep_get(spec, "algorithm", "exploitQuantile",
+                             default=0.25) or 0.25)
+        if not 0.0 < q <= 0.5:
+            raise ValueError(
+                "pbt exploitQuantile must be in (0, 0.5]")
+        rp = float(m.deep_get(spec, "algorithm", "resampleProb",
+                              default=0.25) or 0.25)
+        if not 0.0 <= rp <= 1.0:
+            raise ValueError("pbt resampleProb must be in [0, 1]")
     es = spec.get("earlyStopping") or {}
     es_alg = es.get("algorithm")
     if es_alg and es_alg not in ES_ALGORITHMS:
@@ -655,6 +679,80 @@ class StudyJobReconciler(Reconciler):
 
     def _metric_from_logs(self, pod, namespace, metric_name):
         return self._scrape_trial(pod, namespace, metric_name)[0]
+
+    def _pbt_values(self, spec, trials, next_index, seed, population,
+                    parameters, maximize, ckroot):
+        """Generational PBT step (hpo.pbt_next on the trial seam).
+
+        Returns (values, meta) — or (None, None) while the previous
+        generation is still running (the generation barrier: exploit
+        needs every peer's objective). meta carries the template render
+        extras (``{{pbt_checkpoint}}`` / ``{{pbt_resume_from}}`` — the
+        workload saves its segment to the former and, when present,
+        restores the latter with the ordinary compute/checkpoint
+        machinery) and the trial-status record with exploit/perturb
+        events.
+
+        Storage contract: checkpoint paths are meaningful only inside
+        the trial containers — on a real cluster
+        ``algorithm.checkpointDir`` MUST point at storage every trial
+        pod mounts (a RWX PVC / GCS fuse mount); the ``/tmp/pbt/...``
+        default only works where trials share a filesystem (the
+        in-process runtime, single-host studies). The platform cannot
+        see container mounts, so this is the template author's
+        obligation, same as the trial image itself."""
+        from . import hpo
+        generation = next_index // population
+        member = next_index % population
+        prev = []
+        if generation > 0:
+            lo = (generation - 1) * population
+            terminal = ("Succeeded", "Failed", "EarlyStopped")
+            raw = [trials[j] for j in range(lo, lo + population)
+                   if j in trials]
+            if len(raw) < population or any(
+                    t.get("state") not in terminal for t in raw):
+                return None, None
+            # lineage safety: only Succeeded trials wrote their
+            # segment-end checkpoint — EarlyStopped/Failed members
+            # must not rank or be resumed from (their objective, if
+            # recorded, is a mid-segment observation)
+            prev = [{"index": t["index"],
+                     "parameters": t.get("parameters"),
+                     "objectiveValue": t.get("objectiveValue")
+                     if t.get("state") == "Succeeded" else None}
+                    for t in raw]
+        if generation == 0:
+            # space-filling fresh population (same sampler the
+            # sample_parameters('pbt') validation path documents)
+            values = sample_parameters(parameters, next_index, seed,
+                                       "halton")
+            meta = {"event": "init", "parent": None}
+        else:
+            q = float(m.deep_get(spec, "algorithm", "exploitQuantile",
+                                 default=0.25) or 0.25)
+            rp = float(m.deep_get(spec, "algorithm", "resampleProb",
+                                  default=0.25) or 0.25)
+            values, meta = hpo.pbt_next(
+                parameters, next_index, seed, population, prev, maximize,
+                _param_value_at, _param_unit_of, quantile=q,
+                resample_prob=rp)
+        ckpt = f"{ckroot}/gen{generation}-m{member}"
+        resume = ""
+        if generation > 0 and meta.get("parent") is not None:
+            parent_member = meta["parent"] % population
+            resume = f"{ckroot}/gen{generation - 1}-m{parent_member}"
+        status = {"generation": generation, "member": member,
+                  "event": meta["event"], "checkpoint": ckpt}
+        if meta.get("parent") is not None:
+            status["parent"] = meta["parent"]
+        if resume:
+            status["resumeFrom"] = resume
+        if meta.get("perturbed"):
+            status["perturbed"] = meta["perturbed"]
+        render = {"pbt_checkpoint": ckpt, "pbt_resume_from": resume,
+                  "pbt_generation": generation, "pbt_member": member}
+        return values, {"status": status, "render": render}
 
     def reconcile(self, req):
         study = self.store.try_get(self.API, tsapi.STUDY_KIND, req.name,
@@ -806,14 +904,29 @@ class StudyJobReconciler(Reconciler):
         active = sum(1 for t in trials.values()
                      if t.get("state") == "Running")
         next_index = len(trials)
+        population = int(m.deep_get(spec, "algorithm", "population",
+                                    default=0) or 0)
+        ckroot = (m.deep_get(spec, "algorithm", "checkpointDir",
+                             default="") or
+                  f"/tmp/pbt/{req.namespace}/{req.name}")
         while next_index < max_trials and active < parallelism:
-            values = sample_parameters(parameters, next_index, seed,
-                                       algorithm, history=history,
-                                       maximize=maximize)
+            pbt_meta = None
+            if algorithm == "pbt":
+                values, pbt_meta = self._pbt_values(
+                    spec, trials, next_index, seed, population,
+                    parameters, maximize, ckroot)
+                if values is None:
+                    break       # generation barrier: wait for peers
+                render_values = {**values, **pbt_meta["render"]}
+            else:
+                values = sample_parameters(parameters, next_index, seed,
+                                           algorithm, history=history,
+                                           maximize=maximize)
+                render_values = values
             tname = self._trial_name(req.name, next_index)
             template = render_template(
                 spec.get("trialTemplate") or {"spec": {"containers": [{}]}},
-                values)
+                render_values)
             pod = builtin.pod(
                 tname, req.namespace,
                 apply_trial_placement(
@@ -828,6 +941,8 @@ class StudyJobReconciler(Reconciler):
             trials[next_index] = {"index": next_index,
                                   "parameters": values,
                                   "state": "Running"}
+            if pbt_meta is not None:
+                trials[next_index]["pbt"] = pbt_meta["status"]
             active += 1
             next_index += 1
 
