@@ -236,6 +236,19 @@ def bench_serving(steps, batch):
 
     server = serving.ModelServer()
     server.register("resnet50", predict)
+
+    # weight-only int8 variant (compute/quantize.py): int8 weights stay
+    # in HBM, widen in VMEM — the batch-1 weight-bandwidth rung
+    from kubeflow_tpu.compute import quantize as quant
+    qparams = quant.quantize_tree(params)
+
+    def predict_int8(x):
+        deq = quant.dequantize_tree(qparams, dtype=jnp.bfloat16)
+        logits, _ = resnet.apply(deq, stats, x.astype(jnp.bfloat16),
+                                 cfg, train=False)
+        return jax.nn.softmax(logits, axis=-1).astype(jnp.float32)
+
+    server.register("resnet50-int8", predict_int8)
     port = server.start(port=0, host="127.0.0.1")
     url = f"http://127.0.0.1:{port}/v1/models/resnet50:predict"
     # (stop() in finally: under BENCH_MODEL=all a leaked server would
@@ -246,7 +259,7 @@ def bench_serving(steps, batch):
 
     infer_ms = []
 
-    def post(body=None, retries=8):
+    def post(body=None, retries=8, to_url=None):
         """→ (json, successful_attempt_seconds, failed_attempts).
 
         The reference's serving contract test retries transient
@@ -262,7 +275,8 @@ def bench_serving(steps, batch):
         import urllib.error
         for attempt in range(retries):
             req = urllib.request.Request(
-                url, data=body if body is not None else payload,
+                to_url or url,
+                data=body if body is not None else payload,
                 headers={"Content-Type": "application/json"})
             t1 = time.perf_counter()
             try:
@@ -312,6 +326,54 @@ def bench_serving(steps, batch):
             retried += failures
         post(bin_payload)      # warm the binary path
         bin_lat = sorted(post(bin_payload)[1] for _ in range(steps))
+
+        # pipelined stream route (serving.py :predictStream): one
+        # keep-alive connection, NDJSON of b64 requests, decode of
+        # request k+1 overlapped with device execute of k
+        import http.client
+        tensor_line = _json.dumps({"tensor": {
+            "dtype": "float32", "shape": list(arr.shape),
+            "b64": _b64.b64encode(arr.tobytes()).decode()}}).encode()
+
+        def run_stream(n_requests, model="resnet50"):
+            body = b"\n".join([tensor_line] * n_requests)
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=300)
+            t1 = time.perf_counter()
+            conn.request("POST", f"/v1/models/{model}:predictStream",
+                         body,
+                         {"Content-Type": "application/x-ndjson"})
+            resp = conn.getresponse()
+            data = resp.read()
+            dt_s = time.perf_counter() - t1
+            conn.close()
+            n_ok = sum(1 for ln in data.split(b"\n")
+                       if ln.strip() and b"error" not in ln[:12])
+            if n_ok != n_requests:
+                raise RuntimeError(
+                    f"stream returned {n_ok}/{n_requests} results: "
+                    f"{data[:300]!r}")
+            return dt_s
+
+        run_stream(2)                       # warm
+        stream_s = run_stream(steps)
+        stream_pps = steps * batch / stream_s
+
+        # int8 path: warm, then b64 latency + stream throughput +
+        # accuracy delta vs the fp32 model on the identical input
+        int8_url = (f"http://127.0.0.1:{port}/v1/models/"
+                    f"resnet50-int8:predict")
+        fp32_probs = np.asarray(predict(arr))
+        int8_probs = np.asarray(predict_int8(arr))
+        top1_agree = float(
+            (fp32_probs.argmax(-1) == int8_probs.argmax(-1)).mean())
+        max_prob_delta = float(np.max(np.abs(fp32_probs - int8_probs)))
+
+        post(bin_payload, to_url=int8_url)  # warm/compile
+        int8_lat = sorted(post(bin_payload, to_url=int8_url)[1]
+                          for _ in range(steps))
+        run_stream(2, model="resnet50-int8")
+        int8_stream_s = run_stream(steps, model="resnet50-int8")
     finally:
         server.stop()
     dt = sum(lat)       # successful attempts only (see post())
@@ -337,7 +399,20 @@ def bench_serving(steps, batch):
                        "b64_p50_ms": round(
                            1000 * bin_lat[len(bin_lat) // 2], 1),
                        "b64_predictions_per_sec": round(
-                           steps * batch / sum(bin_lat), 1)}}
+                           steps * batch / sum(bin_lat), 1),
+                       # pipelined NDJSON stream (one connection,
+                       # dispatch overlapped with decode) — the r4
+                       # throughput rung
+                       "stream_predictions_per_sec": round(
+                           stream_pps, 1),
+                       # weight-only int8 (compute/quantize.py)
+                       "int8_b64_p50_ms": round(
+                           1000 * int8_lat[len(int8_lat) // 2], 1),
+                       "int8_stream_predictions_per_sec": round(
+                           steps * batch / int8_stream_s, 1),
+                       "int8_top1_agreement": round(top1_agree, 4),
+                       "int8_max_prob_delta": round(
+                           max_prob_delta, 5)}}
 
 
 def bench_study(steps, batch):

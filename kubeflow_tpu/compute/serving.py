@@ -172,13 +172,26 @@ class ServedModel:
             timeout_s=batch_timeout_ms / 1000.0) if batching else None
 
     def _run(self, x):
+        out, n = self.dispatch(x)
+        return self.finalize(out, n)
+
+    def dispatch(self, x):
+        """Async half: pad to a bucket and launch the device program
+        WITHOUT blocking on the result (JAX dispatch is async) —
+        returns (device_future, rows). The stream route pipelines by
+        dispatching request k+1 while k executes."""
         n = x.shape[0]
         bucket = next((b for b in BATCH_BUCKETS if b >= n), n)
         if bucket > n:
             pad = np.zeros((bucket - n,) + x.shape[1:], x.dtype)
             x = np.concatenate([x, pad], axis=0)
         self.device_calls += 1
-        return np.asarray(self._fn(x))[:n]
+        return self._fn(x), n
+
+    @staticmethod
+    def finalize(out, n):
+        """Blocking half: fetch the device result."""
+        return np.asarray(out)[:n]
 
     def predict(self, instances):
         return self.predict_timed(instances)[0]
@@ -268,6 +281,11 @@ class ModelServer:
         models = self._models
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1: connections persist across requests (every
+            # response carries Content-Length or chunked framing) —
+            # sequential clients stop paying TCP setup per predict
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *args):
                 pass
 
@@ -276,6 +294,15 @@ class ModelServer:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if code >= 400:
+                    # error paths may not have drained the request body
+                    # (e.g. 404 before the read) — reusing the
+                    # connection would parse leftover body bytes as the
+                    # next request line, so close, and TELL the client
+                    # (a keep-alive peer would otherwise die with a
+                    # reset on its next request)
+                    self.close_connection = True
+                    self.send_header("Connection", "close")
                 for k, v in extra_headers:
                     self.send_header(k, v)
                 self.end_headers()
@@ -306,6 +333,8 @@ class ModelServer:
                 model = models.get(name)
                 if model is None:
                     return self._send(404, {"error": "model not found"})
+                if verb == "predictStream":
+                    return self._predict_stream(model)
                 if verb != "predict":
                     return self._send(400, {"error": f"verb {verb}"})
                 # 400 = the caller's fault (malformed body); 500 = ours
@@ -340,6 +369,122 @@ class ModelServer:
                     payload = {"predictions": out.tolist()}
                 self._send(200, payload,
                            (("X-Inference-Time-Ms", f"{infer:.1f}"),))
+
+            def _predict_stream(self, model):
+                """Batched-pipelined predict over one connection: the
+                request body is NDJSON (one predict request per line,
+                same ``{"instances"|"tensor"}`` schema); the response
+                streams NDJSON results back in order, chunked.
+
+                Two levers stack (the ROADMAP serving next-rung; no
+                reference counterpart — TF-Serving's answer is gRPC
+                streaming + its batching layer): consecutive same-shape
+                requests coalesce into ONE device batch (batch-8 runs
+                ~6× the per-request rate on a v5e — BASELINE r4), and
+                the next group is decoded+dispatched while the previous
+                one's results are fetched and written."""
+                import collections
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except (ValueError, TypeError) as e:
+                    return self._send(400, {"error": f"bad stream: {e}"})
+
+                def iter_lines(remaining):
+                    # incremental ingest: decode/dispatch start on the
+                    # first line, memory stays O(one line), and upload
+                    # of line k+1 overlaps the device on group k
+                    while remaining > 0:
+                        # limit EXACTLY remaining: one byte more would
+                        # block forever on a body whose last line has
+                        # no trailing newline (keep-alive socket, no
+                        # EOF to break the read)
+                        ln = self.rfile.readline(remaining)
+                        if not ln:
+                            return
+                        remaining -= len(ln)
+                        if ln.strip():
+                            yield ln
+
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(payload):
+                    body = json.dumps(payload).encode() + b"\n"
+                    self.wfile.write(
+                        f"{len(body):X}\r\n".encode() + body + b"\r\n")
+
+                GROUP = 8      # rows coalesced into one device call
+                pending = collections.deque()
+
+                def emit_done(slot):
+                    """slot: ('err', msg) | (fut, rows, binaries)."""
+                    if slot[0] == "err":
+                        chunk({"error": slot[1]})
+                        return
+                    fut, rows, binaries = slot
+                    try:
+                        out = model.finalize(fut, sum(rows))
+                    except Exception as e:  # noqa: BLE001 — wire
+                        for _ in rows:
+                            chunk({"error": f"inference failed: {e}"})
+                        return
+                    off = 0
+                    for n, binary in zip(rows, binaries):
+                        part = out[off:off + n]
+                        off += n
+                        chunk({"tensor": _encode_tensor(part)} if binary
+                              else {"predictions": part.tolist()})
+
+                group = []      # [(x, binary)] same shape/dtype
+
+                def flush_group():
+                    if not group:
+                        return
+                    xs = [x for x, _ in group]
+                    x = np.concatenate(xs, 0) if len(xs) > 1 else xs[0]
+                    try:
+                        fut, _ = model.dispatch(x)
+                        pending.append(
+                            (fut, [g.shape[0] for g in xs],
+                             [b for _, b in group]))
+                    except Exception as e:  # noqa: BLE001 — per-group
+                        for _ in group:
+                            pending.append(
+                                ("err", f"inference failed: {e}"))
+                    group.clear()
+                    # fetch the PREVIOUS group while this one executes
+                    while len(pending) > 1:
+                        emit_done(pending.popleft())
+
+                for ln in iter_lines(length):
+                    try:
+                        req = json.loads(ln)
+                        if "tensor" in req:
+                            binary = True
+                            x = _decode_tensor(req["tensor"])
+                        else:
+                            binary = False
+                            x = np.asarray(req["instances"])
+                            if x.ndim == 0:
+                                raise ValueError("scalar instances")
+                    except Exception as e:  # noqa: BLE001 — per-line
+                        flush_group()
+                        pending.append(("err", f"bad request: {e}"))
+                        continue
+                    if group and (
+                            x.shape[1:] != group[0][0].shape[1:]
+                            or x.dtype != group[0][0].dtype
+                            or sum(g.shape[0] for g, _ in group)
+                            + x.shape[0] > GROUP):
+                        flush_group()
+                    group.append((x, binary))
+                flush_group()
+                while pending:
+                    emit_done(pending.popleft())
+                self.wfile.write(b"0\r\n\r\n")   # chunked terminator
 
         return Handler
 

@@ -155,8 +155,10 @@ def pbt_next(parameters, trial_index, seed, population, prev_gen,
     # save, so they must neither rank nor serve as exploit parents
     valid = [t for t in prev_gen if t.get("objectiveValue") is not None]
     if generation == 0 or not valid:
-        # fresh start (whole population lost ⇒ same as generation 0);
-        # the reconciler uses its space-filling sampler for this path
+        # fresh start (whole population lost ⇒ same as generation 0):
+        # uniform fallback for library callers — the reconciler
+        # detects this case itself and substitutes its space-filling
+        # halton sampler (tpuslice._pbt_values) for better coverage
         values = {p["name"]: value_at(p, float(rng.uniform()))
                   for p in parameters}
         return values, {"event": "init", "parent": None}

@@ -722,9 +722,11 @@ class StudyJobReconciler(Reconciler):
                      "objectiveValue": t.get("objectiveValue")
                      if t.get("state") == "Succeeded" else None}
                     for t in raw]
-        if generation == 0:
+        if generation == 0 or all(t["objectiveValue"] is None
+                                  for t in prev):
             # space-filling fresh population (same sampler the
-            # sample_parameters('pbt') validation path documents)
+            # sample_parameters('pbt') validation path documents);
+            # a whole lost generation restarts the same way
             values = sample_parameters(parameters, next_index, seed,
                                        "halton")
             meta = {"event": "init", "parent": None}

@@ -415,3 +415,146 @@ class TestCrossMeshRestore:
         # restored leaves carry mesh_b's shardings, not mesh_a's
         leaf = jax.tree.leaves(restored.params)[0]
         assert leaf.sharding.mesh.shape == mesh_b.shape
+
+
+class TestServingStream:
+    """r4 serving rungs: HTTP/1.1 keep-alive, the pipelined
+    :predictStream route (NDJSON in, chunked NDJSON out, device
+    overlapped with decode), and weight-only int8."""
+
+    def _server(self):
+        cfg = mlp.Config(in_dim=16, hidden=8, n_classes=4)
+        params = mlp.init_params(cfg, jax.random.PRNGKey(0))
+        server = serving.ModelServer()
+        server.register("m", lambda x: jax.nn.softmax(
+            mlp.apply(params, x, cfg), axis=-1))
+        port = server.start(port=0, host="127.0.0.1")
+        return server, port, params, cfg
+
+    def test_keepalive_reuses_one_connection(self):
+        import http.client
+        server, port, _, _ = self._server()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            body = json.dumps(
+                {"instances": np.zeros((2, 16)).tolist()}).encode()
+            for _ in range(3):
+                conn.request("POST", "/v1/models/m:predict", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 200
+                out = json.loads(resp.read())
+                assert len(out["predictions"]) == 2
+                # same socket every time: HTTP/1.1 keep-alive held
+                assert resp.will_close is False
+        finally:
+            server.stop()
+
+    def test_stream_route_orders_and_pipelines(self):
+        import base64
+        import http.client
+        server, port, params, cfg = self._server()
+        try:
+            rng = np.random.default_rng(0)
+            xs = [rng.standard_normal((1, 16)).astype(np.float32)
+                  for _ in range(7)]
+            lines = []
+            for i, x in enumerate(xs):
+                if i % 2:
+                    lines.append(json.dumps({"tensor": {
+                        "dtype": "float32", "shape": list(x.shape),
+                        "b64": base64.b64encode(x.tobytes()).decode()}}))
+                else:
+                    lines.append(json.dumps({"instances": x.tolist()}))
+            body = "\n".join(lines).encode()
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            conn.request("POST", "/v1/models/m:predictStream", body,
+                         {"Content-Type": "application/x-ndjson"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            out_lines = [json.loads(ln) for ln in
+                         resp.read().decode().strip().split("\n")]
+            assert len(out_lines) == len(xs)
+            for i, (x, out) in enumerate(zip(xs, out_lines)):
+                want = np.asarray(jax.nn.softmax(
+                    mlp.apply(params, jnp.asarray(x), cfg), axis=-1))
+                if i % 2:
+                    t = out["tensor"]
+                    got = np.frombuffer(
+                        base64.b64decode(t["b64"]),
+                        dtype=np.dtype(t["dtype"]).newbyteorder("<")
+                    ).reshape(t["shape"])
+                else:
+                    got = np.asarray(out["predictions"])
+                np.testing.assert_allclose(got, want, atol=1e-5)
+        finally:
+            server.stop()
+
+    def test_stream_bad_line_errors_inline_not_fatal(self):
+        import http.client
+        server, port, _, _ = self._server()
+        try:
+            good = json.dumps(
+                {"instances": np.zeros((1, 16)).tolist()})
+            body = "\n".join([good, "{malformed", good]).encode()
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            conn.request("POST", "/v1/models/m:predictStream", body)
+            resp = conn.getresponse()
+            out_lines = [json.loads(ln) for ln in
+                         resp.read().decode().strip().split("\n")]
+            assert len(out_lines) == 3
+            assert "predictions" in out_lines[0]
+            assert "error" in out_lines[1]
+            assert "predictions" in out_lines[2]
+        finally:
+            server.stop()
+
+
+class TestInt8Quantization:
+    """Weight-only int8 (compute/quantize.py): int8 weights + per-
+    channel scales dequantized inside jit; accuracy pinned vs fp32."""
+
+    def test_roundtrip_error_bounded(self):
+        from kubeflow_tpu.compute import quantize as q
+        w = np.random.default_rng(0).standard_normal(
+            (64, 128)).astype(np.float32)
+        qw = q.quantize_array(w)
+        back = np.asarray(qw["q"], np.float32) * qw["scale"]
+        # per-channel symmetric int8: error ≤ scale/2 per element
+        assert np.max(np.abs(back - w) / qw["scale"]) <= 0.5 + 1e-6
+
+    def test_small_and_int_leaves_pass_through(self):
+        from kubeflow_tpu.compute import quantize as q
+        tree = {"w": np.ones((128, 128), np.float32),
+                "bias": np.ones((4,), np.float32),
+                "steps": np.arange(5)}
+        qt = q.quantize_tree(tree)
+        assert qt["w"]["_int8"] and qt["w"]["q"].dtype == np.int8
+        assert qt["bias"].dtype == np.float32
+        assert qt["steps"].dtype == np.arange(5).dtype
+
+    def test_quantized_predict_agrees_with_fp32(self):
+        from kubeflow_tpu.compute import quantize as q
+        cfg = mlp.Config(in_dim=16, hidden=64, n_classes=8)
+        params = mlp.init_params(cfg, jax.random.PRNGKey(0))
+        qparams = q.quantize_tree(params, min_size=64)
+
+        def predict_q(x):
+            deq = q.dequantize_tree(qparams, dtype=jnp.float32)
+            return jax.nn.softmax(mlp.apply(deq, x, cfg), axis=-1)
+
+        x = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (32, 16)), jnp.float32)
+        ref = np.asarray(jax.nn.softmax(mlp.apply(params, x, cfg), -1))
+        got = np.asarray(jax.jit(predict_q)(x))
+        # top-1 agreement is the serving contract; probabilities close
+        agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+        assert agree >= 0.95, agree
+        assert np.max(np.abs(ref - got)) < 0.05
+
+    def test_bytes_shrink_4x(self):
+        from kubeflow_tpu.compute import quantize as q
+        tree = {"w": np.ones((256, 256), np.float32)}
+        qb, fb = q.quantized_bytes(q.quantize_tree(tree))
+        assert fb == 256 * 256 * 4
+        assert qb < fb / 3.5
