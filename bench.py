@@ -359,6 +359,25 @@ def bench_serving(steps, batch):
         stream_s = run_stream(steps)
         stream_pps = steps * batch / stream_s
 
+        # sequential b64 over ONE persistent connection — the
+        # measurement that actually exercises HTTP/1.1 keep-alive
+        # (urllib opens a fresh connection per request and sends
+        # Connection: close, so post() above cannot see reuse)
+        ka = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+
+        def ka_post():
+            t1 = time.perf_counter()
+            ka.request("POST", "/v1/models/resnet50:predict",
+                       bin_payload,
+                       {"Content-Type": "application/json"})
+            r = ka.getresponse()
+            r.read()
+            return time.perf_counter() - t1
+
+        ka_post()                            # warm on this socket
+        ka_lat = sorted(ka_post() for _ in range(steps))
+        ka.close()
+
         # int8 path: warm, then b64 latency + stream throughput +
         # accuracy delta vs the fp32 model on the identical input
         int8_url = (f"http://127.0.0.1:{port}/v1/models/"
@@ -400,6 +419,12 @@ def bench_serving(steps, batch):
                            1000 * bin_lat[len(bin_lat) // 2], 1),
                        "b64_predictions_per_sec": round(
                            steps * batch / sum(bin_lat), 1),
+                       # same contract over one persistent connection
+                       # (keep-alive actually exercised)
+                       "b64_keepalive_p50_ms": round(
+                           1000 * ka_lat[len(ka_lat) // 2], 1),
+                       "b64_keepalive_predictions_per_sec": round(
+                           steps * batch / sum(ka_lat), 1),
                        # pipelined NDJSON stream (one connection,
                        # dispatch overlapped with decode) — the r4
                        # throughput rung

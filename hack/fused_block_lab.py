@@ -1,0 +1,73 @@
+"""Measure the Pallas fused bottleneck (ops/fused_block.py) vs XLA's
+own fusion of the same eval-mode block on the real chip.
+
+Method per tpu-bench discipline (BASELINE.md provenance): chain the
+block N times inside one jit (output feeds input — same shape), so
+per-iteration time amortizes the ~3.5 ms dispatch floor; drain with a
+value readback. Run: python hack/fused_block_lab.py
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from kubeflow_tpu.compute.models import resnet  # noqa: E402
+from kubeflow_tpu.compute.ops import fused_block  # noqa: E402
+
+CHAIN = 100
+
+
+def bench(fn, x, label):
+    chained = jax.jit(lambda x: jax.lax.fori_loop(
+        0, CHAIN, lambda _, h: fn(h), x))
+    out = chained(x)
+    float(jnp.sum(out))                      # compile + drain
+    t0 = time.perf_counter()
+    out = chained(x)
+    float(jnp.sum(out))
+    dt = (time.perf_counter() - t0) / CHAIN
+    print(f"{label}: {dt * 1000:.3f} ms/block-call")
+    return dt
+
+
+def main():
+    cfg = resnet.Config(depth=50, dtype="bfloat16")
+    params, stats = resnet.init_params(cfg, jax.random.PRNGKey(0))
+    print(f"backend: {jax.default_backend()}")
+
+    for stage, (hw, batch) in enumerate([(56, 256), (28, 256),
+                                         (14, 256)]):
+        bp = params["stages"][stage][1]
+        bs = stats["stages"][stage][1]
+        c = bp["conv0"].shape[2]
+        x = jax.random.normal(jax.random.PRNGKey(1),
+                              (batch, hw, hw, c), jnp.bfloat16)
+
+        def xla_block(h, bp=bp, bs=bs):
+            return resnet._block(h, bp, bs, cfg, 1, False)[0]
+
+        def pallas_block(h, bp=bp, bs=bs):
+            return fused_block.fused_bottleneck_eval(
+                h, bp, bs, eps=cfg.bn_eps, interpret=False)
+
+        # correctness on-chip first
+        ref = np.asarray(jax.jit(xla_block)(x), np.float32)
+        got = np.asarray(jax.jit(pallas_block)(x), np.float32)
+        err = np.max(np.abs(ref - got))
+        print(f"stage {hw}x{hw}x{c} (batch {batch}): "
+              f"max|Δ| = {err:.4f}")
+
+        t_xla = bench(xla_block, x, f"  xla   {hw}²")
+        t_pl = bench(pallas_block, x, f"  pallas {hw}²")
+        bytes_rw = 2 * x.size * 2
+        print(f"  speedup ×{t_xla / t_pl:.2f}; fused streams "
+              f"{bytes_rw / t_pl / 1e9:.0f} GB/s of the 819 GB/s limit")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""Fused ResNet bottleneck block as a Pallas TPU kernel — the ROADMAP
+"fused conv-block" project, built, measured, and REJECTED (kept
+in-tree as the dead-end record; BASELINE.md r4 note has the numbers).
+
+The r3 roofline measurement (hack/resnet_roofline.py) pinned ResNet-50
+*training* at 93% of the chip's HBM bandwidth under XLA's own fusion —
+going faster means removing traffic, and the named candidate was this
+kernel: one identity bottleneck block (conv1×1 → BN → relu → conv3×3 →
+BN → relu → conv1×1 → BN → +residual → relu) as ONE kernel per image,
+reading the [H,W,C] activation from HBM once and writing it once. The
+inter-conv tensors and the 3×3's halo neighborhood live in VMEM;
+eval-mode BN folds into the conv weights/biases (`fold_bn`), so the
+kernel is a matmul chain:
+
+    c1 = relu(X · W1 + b1)                    X: [H·W, C]
+    c2 = relu(im2col(c1) · W2 + b2)           (3×3 as one K=9M matmul)
+    y  = relu(c2 · W3 + b3 + X)
+
+It is bit-exact against the XLA block on the chip (max|Δ|=0, bf16) and
+it LOSES (hack/fused_block_lab.py, chain-of-100 amortized, batch 256):
+0.78× XLA at 56²×256, 0.65× at 28²×512, 0.66× at 14²×1024 — after the
+im2col rewrite already bought back 35% over the 9-matmul variant. Why
+rejected, in full:
+
+1. **Training (the regime that mattered) can't fuse at all**: exact BN
+   takes batch-global statistics between each conv and its relu, so
+   the inter-conv tensors must materialize in HBM (conv1's output is
+   103 MB at batch 256 vs ~16 MB VMEM). Recompute-based multi-pass
+   fusions move MORE bytes than XLA's schedule (3×411 MB of re-reads
+   vs 206 MB of materialization per block); per-tile ghost-BN fits
+   VMEM only at ghost size ≤ 2 images, which is not ResNet-50's
+   training function (≡ 128-way-DP per-device stats).
+2. **Eval (the fusible regime) is not bandwidth-bound**: the XLA block
+   runs 2.7 ms at 56² where its HBM traffic costs 0.41 ms — it is
+   compute/scheduling-bound, so the ~2× traffic removal this kernel
+   achieves is capped at a ~0.2 ms win while the kernel gives away
+   ~0.7-1.0 ms of conv efficiency: XLA's native conv kernels schedule
+   the MXU better than any reasonable Pallas im2col-matmul chain (no
+   access to the conv instruction scheduling from Pallas).
+
+Verdict: 0.307 train MFU stands as the measured XLA-fusion ceiling of
+this chip for ResNet-50 fwd+bwd (BASELINE r3 roofline), and this file
+is the required evidence that the one named traffic-removal idea was
+built and measured rather than hypothesized.
+
+No reference counterpart (the reference has no model code at all —
+SURVEY.md §2); written against /opt/skills/guides/pallas_guide.md.
+Interpret mode runs the same kernel on CPU for the unit tier.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def fold_bn(conv_w, bn_params, bn_stats, eps=1e-5):
+    """Eval BN is affine: y = conv(x, w)·s + b with
+    s = scale/sqrt(var+eps), b = bias − mean·s. Returns (w·s, b) so the
+    kernel (and any conv) applies BN as a fused bias add."""
+    s = bn_params["scale"] * jax.lax.rsqrt(bn_stats["var"] + eps)
+    b = bn_params["bias"] - bn_stats["mean"] * s
+    return conv_w * s.reshape((1,) * (conv_w.ndim - 1) + (-1,)), b
+
+
+def _kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref, b3_ref,
+            out_ref, c1_pad, *, h, w):
+    m = w1_ref.shape[1]
+    c = x_ref.shape[3]
+    x = x_ref[0].reshape(h * w, c)
+
+    c1 = jnp.dot(x, w1_ref[:], preferred_element_type=jnp.float32)
+    c1 = jnp.maximum(c1 + b1_ref[0], 0.0).astype(x.dtype)
+
+    # zero-padded plane for the 3×3 neighborhood: static slices of
+    # VMEM scratch replace the HBM halo a spatially-tiled kernel
+    # would need. The 9 taps concatenate on the contraction dim
+    # (im2col in VMEM), so the 3×3 conv is ONE [HW, 9M]·[9M, M]
+    # matmul — K=9M=576 keeps the MXU deep instead of nine K=64
+    # passes at an eighth of its capability.
+    c1_pad[:] = jnp.zeros((h + 2, w + 2, m), x.dtype)
+    c1_pad[1:h + 1, 1:w + 1, :] = c1.reshape(h, w, m)
+    taps = [c1_pad[dy:dy + h, dx:dx + w, :].reshape(h * w, m)
+            for dy in range(3) for dx in range(3)]
+    col = jnp.concatenate(taps, axis=1)              # [HW, 9M]
+    acc = jnp.dot(col, w2_ref[:].reshape(9 * m, m),
+                  preferred_element_type=jnp.float32)
+    c2 = jnp.maximum(acc + b2_ref[0], 0.0).astype(x.dtype)
+
+    y = jnp.dot(c2, w3_ref[:], preferred_element_type=jnp.float32)
+    y = y + b3_ref[0] + x.astype(jnp.float32)
+    out_ref[0] = jnp.maximum(y, 0.0).astype(x.dtype).reshape(h, w, c)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _run(x, w1, b1, w2, b2, w3, b3, interpret=False):
+    n, h, w, c = x.shape
+    m = w1.shape[1]
+    return pl.pallas_call(
+        functools.partial(_kernel, h=h, w=w),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((c, m), lambda i: (0, 0)),
+            pl.BlockSpec((1, m), lambda i: (0, 0)),
+            pl.BlockSpec((9, m, m), lambda i: (0, 0, 0)),
+            pl.BlockSpec((1, m), lambda i: (0, 0)),
+            pl.BlockSpec((m, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        scratch_shapes=[pltpu.VMEM((h + 2, w + 2, m), x.dtype)],
+        interpret=interpret,
+    )(x, w1, b1.reshape(1, -1), w2, b2.reshape(1, -1), w3,
+      b3.reshape(1, -1))
+
+
+def fused_bottleneck_eval(x, block_params, block_stats, eps=1e-5,
+                          interpret=None):
+    """Run one identity bottleneck block (stride 1, no projection) in
+    eval mode as a single fused kernel.
+
+    ``block_params``/``block_stats``: the resnet.py per-block trees
+    (conv0/bn0, conv1/bn1, conv2/bn2). x: [N, H, W, C] with
+    C = conv0 input channels = conv2 output channels.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    w1, b1 = fold_bn(block_params["conv0"], block_params["bn0"],
+                     block_stats["bn0"], eps)
+    w2, b2 = fold_bn(block_params["conv1"], block_params["bn1"],
+                     block_stats["bn1"], eps)
+    w3, b3 = fold_bn(block_params["conv2"], block_params["bn2"],
+                     block_stats["bn2"], eps)
+    dt = x.dtype
+    m = w1.shape[-1]
+    w1 = w1.reshape(w1.shape[2], m)                  # [1,1,C,M] → [C,M]
+    w2 = w2.reshape(9, m, m)                         # [3,3,M,M] → [9,M,M]
+    w3 = w3.reshape(m, w3.shape[3])                  # [1,1,M,C] → [M,C]
+    return _run(x, w1.astype(dt), b1.astype(jnp.float32),
+                w2.astype(dt), b2.astype(jnp.float32),
+                w3.astype(dt), b3.astype(jnp.float32),
+                interpret=interpret)

@@ -31,6 +31,11 @@ def quantize_array(w, axis=-1):
     w = np.asarray(w, dtype=np.float32)
     reduce_axes = tuple(i for i in range(w.ndim)
                         if i != (axis % w.ndim))
+    if not reduce_axes:
+        # 1-D leaf: per-channel would mean per-ELEMENT scales (q = ±127
+        # everywhere, 25% bigger than fp32) — use one tensor scale
+        reduce_axes = tuple(range(w.ndim))
+        axis = None
     amax = np.max(np.abs(w), axis=reduce_axes, keepdims=True)
     scale = (amax / 127.0).astype(np.float32)
     scale = np.where(scale == 0.0, 1.0, scale)
@@ -48,8 +53,11 @@ def quantize_tree(params, min_size=MIN_QUANT_SIZE, axis=-1):
     def one(w):
         arr = np.asarray(w)
         # np.issubdtype rejects ml_dtypes (bfloat16/float8) — exactly
-        # the dtypes serving params arrive in; match by kind instead
-        if arr.size >= min_size and "float" in arr.dtype.name:
+        # the dtypes serving params arrive in; match by kind instead.
+        # 1-D leaves (norm scales/biases) stay float: their bytes are
+        # noise and their dynamic range often is not
+        if arr.ndim >= 2 and arr.size >= min_size \
+                and "float" in arr.dtype.name:
             return quantize_array(arr, axis=axis)
         return w
     return jax.tree.map(one, params)

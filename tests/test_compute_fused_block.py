@@ -1,0 +1,70 @@
+"""Fused bottleneck kernel (ops/fused_block.py) — the in-tree
+dead-end record from the r4 conv-block project. The kernel must stay
+bit-correct against the XLA block (it is cited as *measured* evidence,
+so it has to keep running), and fold_bn is load-bearing for any
+inference path that wants BN folded."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.compute.models import resnet
+from kubeflow_tpu.compute.ops import fused_block
+
+
+@pytest.fixture(scope="module")
+def block():
+    cfg = resnet.Config(depth=50, dtype="float32")
+    params, stats = resnet.init_params(cfg, jax.random.PRNGKey(0))
+    bp = dict(params["stages"][0][1])       # identity block, no proj
+    bs = {k: dict(v) for k, v in stats["stages"][0][1].items()}
+    key = jax.random.PRNGKey(3)
+    for i in range(3):                      # non-trivial BN stats
+        bs[f"bn{i}"]["mean"] = 0.1 * jax.random.normal(
+            jax.random.fold_in(key, i), bs[f"bn{i}"]["mean"].shape)
+        bs[f"bn{i}"]["var"] = 0.5 + jnp.abs(jax.random.normal(
+            jax.random.fold_in(key, 10 + i), bs[f"bn{i}"]["var"].shape))
+        bp[f"bn{i}"] = {
+            "scale": 1.0 + 0.1 * jax.random.normal(
+                jax.random.fold_in(key, 20 + i),
+                bp[f"bn{i}"]["scale"].shape),
+            "bias": 0.1 * jax.random.normal(
+                jax.random.fold_in(key, 30 + i),
+                bp[f"bn{i}"]["bias"].shape)}
+    return cfg, bp, bs
+
+
+def test_fold_bn_matches_unfolded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 8, 16))
+    bn_p = {"scale": jnp.linspace(0.5, 2.0, 16),
+            "bias": jnp.linspace(-1.0, 1.0, 16)}
+    bn_s = {"mean": jnp.linspace(-0.5, 0.5, 16),
+            "var": jnp.linspace(0.5, 1.5, 16)}
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 4, 8))
+    raw = resnet._conv(x, w, 1, jnp.float32)
+    s = bn_p["scale"] * jax.lax.rsqrt(bn_s["var"] + 1e-5)
+    want = raw * s + (bn_p["bias"] - bn_s["mean"] * s)
+    wf, bf = fused_block.fold_bn(w, bn_p, bn_s, eps=1e-5)
+    got = resnet._conv(x, wf, 1, jnp.float32) + bf
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_block_matches_xla_block(block):
+    cfg, bp, bs = block
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8, 256),
+                          jnp.float32)
+    ref, _ = resnet._block(x, bp, bs, cfg, stride=1, train=False)
+    got = fused_block.fused_bottleneck_eval(x, bp, bs, eps=cfg.bn_eps)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_fused_block_zero_input_passes_residual_relu(block):
+    cfg, bp, bs = block
+    x = jnp.zeros((1, 8, 8, 256), jnp.float32)
+    got = fused_block.fused_bottleneck_eval(x, bp, bs, eps=cfg.bn_eps)
+    ref, _ = resnet._block(x, bp, bs, cfg, stride=1, train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
