@@ -65,6 +65,26 @@ def _seed(store):
                  "selector": {"matchLabels": {"gcs-access": "true"}},
                  "env": [{"name": "GOOGLE_APPLICATION_CREDENTIALS",
                           "value": "/secrets/gcs.json"}]}})
+    # a study with completed trials, so the details chart + trial
+    # table have data out of the box (the fake kubelet runs the pods;
+    # the metrics ConfigMaps below are the trials' completion reports)
+    store.create({
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "StudyJob",
+        "metadata": {"name": "demo-sweep", "namespace": "team-a"},
+        "spec": {"objective": {"type": "maximize",
+                               "metricName": "accuracy"},
+                 "algorithm": {"name": "halton", "seed": 4},
+                 "maxTrialCount": 6, "parallelTrialCount": 6,
+                 "parameters": [{"name": "lr", "type": "double",
+                                 "min": 0.001, "max": 0.1,
+                                 "scale": "log"}],
+                 "trialTemplate": {"spec": {"containers": [
+                     {"name": "trial", "image": "trial:1",
+                      "args": ["--lr={{lr}}"]}]}}}})
+    for i, acc in enumerate((0.62, 0.81, 0.74, 0.9)):
+        store.create(api.builtin.config_map(
+            f"demo-sweep-trial-{i}-metrics", "team-a",
+            {"accuracy": str(acc)}, labels={"studyjob": "demo-sweep"}))
 
 
 def main():

@@ -371,10 +371,18 @@ def create_app(store):
 
     @app.get("/api/namespaces/<ns>/pvcs")
     def list_pvcs(request, ns):
+        """Summaries for the form's existing-volume picker: the
+        reference JWA likewise serves the PVC names+sizes the volume
+        section lists (jupyter backend get_pvcs)."""
         cb.ensure_authorized(store, request, "list",
                              "persistentvolumeclaims", ns)
         pvcs = store.list("v1", "PersistentVolumeClaim", ns)
-        return cb.success({"pvcs": pvcs})
+        return cb.success({"pvcs": [{
+            "name": m.name_of(p),
+            "size": m.deep_get(p, "spec", "resources", "requests",
+                               "storage") or "",
+            "phase": m.deep_get(p, "status", "phase") or "",
+        } for p in pvcs]})
 
     def _raw_notebook(body, ns):
         """Notebook envelope of the shared YAML-editor contract

@@ -289,7 +289,7 @@ async function podDefaultsView(el) {
 
   const edit = (existing) => {
     const ns = nsSelect.value;
-    const editor = new YamlEditor({ rows: 22 });
+    const editor = new YamlEditor({ rows: 22, kind: "PodDefault" });
     editor.setObject(existing || starterPodDefault(ns));
     const save = async (dryRun) => {
       let cr;

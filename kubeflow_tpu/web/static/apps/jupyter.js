@@ -81,23 +81,54 @@ async function indexView(el) {
 
 /* ---------------------------------------------------------- spawn form */
 
-function volumeRow(initial) {
-  const fields = new FieldGroup([
-    new Field({ id: "type", label: "Type", value: initial.type || "new",
-      options: [{ value: "new", label: "New volume" },
-                { value: "existing", label: "Existing volume" }] }),
-    new Field({ id: "name", label: "Volume name",
-      value: initial.name || "",
-      checks: [validators.required, validators.dns1123] }),
-    new Field({ id: "size", label: "Size", value: initial.size || "10Gi",
-      checks: [validators.quantity] }),
-    new Field({ id: "mount", label: "Mount path",
-      value: initial.mount || "/data" }),
-  ]);
+function volumeRow(initial, pvcs) {
+  /* "existing" switches the free-text name to a picker over the
+   * namespace's PVCs (the reference jupyter form's existing-volume
+   * flow, frontend/src/app/pages/form volume section) and drops the
+   * size field — the claim already has one. */
+  const typeField = new Field({ id: "type", label: "Type",
+    value: initial.type || "new",
+    options: [{ value: "new", label: "New volume" },
+              { value: "existing", label: "Existing volume" }] });
+  const nameField = new Field({ id: "name", label: "Volume name",
+    value: initial.name || "",
+    checks: [validators.required, validators.dns1123] });
+  const pickField = new Field({ id: "pick", label: "Existing PVC",
+    value: initial.name || (pvcs[0] || {}).name || "",
+    options: (pvcs.length ? pvcs : [{ name: "" }]).map((p) => ({
+      value: p.name,
+      label: p.name + (p.size ? ` (${p.size})` : ""),
+    })),
+    checks: [validators.required] });
+  const sizeField = new Field({ id: "size", label: "Size",
+    value: initial.size || "10Gi", checks: [validators.quantity] });
+  const mountField = new Field({ id: "mount", label: "Mount path",
+    value: initial.mount || "/data" });
+
+  const sync = () => {
+    const existing = typeField.value() === "existing";
+    nameField.element.hidden = existing;
+    pickField.element.hidden = !existing;
+    sizeField.element.hidden = existing;
+  };
+  typeField.input.addEventListener("change", sync);
+  sync();
+
+  const active = () => (typeField.value() === "existing"
+    ? [typeField, pickField, mountField]
+    : [typeField, nameField, sizeField, mountField]);
   return {
-    element: h("div", {}, fields.fields.map((f) => f.element)),
-    validate: () => fields.validate(),
-    values: () => fields.values(),
+    element: h("div", {}, typeField.element, nameField.element,
+      pickField.element, sizeField.element, mountField.element),
+    validate: () => active().every((f) => f.validate()),
+    values: () => {
+      const v = new FieldGroup(active()).values();
+      if (v.pick !== undefined) {
+        v.name = v.pick;
+        delete v.pick;
+      }
+      return v;
+    },
   };
 }
 
@@ -114,11 +145,13 @@ function volToBody(v, nbName) {
 
 async function formView(el) {
   const ns = currentNamespace();
-  const [cfgResp, accResp, pdResp] = await Promise.all([
+  const [cfgResp, accResp, pdResp, pvcResp] = await Promise.all([
     api("GET", "api/config"),
     api("GET", "api/accelerators"),
     api("GET", `api/namespaces/${ns}/poddefaults`),
+    api("GET", `api/namespaces/${ns}/pvcs`),
   ]);
+  const existingPvcs = pvcResp.pvcs || [];
   const cfg = cfgResp.config;
   const clusterAcc = accResp.accelerators || [];
   const podDefaults = pdResp.poddefaults || [];
@@ -169,7 +202,7 @@ async function formView(el) {
       checks: [validators.quantity] }),
   ]);
   const datavols = new RowList({ addLabel: "add data volume",
-    makeRow: volumeRow });
+    makeRow: (init) => volumeRow(init, existingPvcs) });
 
   const pdBoxes = podDefaults.map((pd) => {
     const box = h("input", { type: "checkbox",
@@ -329,7 +362,7 @@ async function yamlFormView(el) {
   /* edit → dry-run → fix → create, server-side admission included
    * (reference common-lib editor module + form-page submit flow) */
   const ns = currentNamespace();
-  const editor = new YamlEditor({ rows: 26 });
+  const editor = new YamlEditor({ rows: 26, kind: "Notebook" });
   editor.setObject(yamlSeed || starterNotebook(ns));
   yamlSeed = null;
 

@@ -8,8 +8,9 @@
  * editor with server-side dry-run (backend: web/slices.py). */
 
 import {
-  age, api, currentNamespace, eventsTable, h, indexPage, Router, snack,
-  statusIcon, tabPanel, YamlEditor, yamlDump,
+  age, api, conditionsTable, currentNamespace, detailsList, duration,
+  eventsTable, h, indexPage, Router, snack, statusIcon, tabPanel,
+  YamlEditor, yamlDump,
 } from "../lib/components.js";
 
 const outlet = document.getElementById("app");
@@ -87,7 +88,7 @@ function starterSlice(ns) {
 
 async function newView(el) {
   const ns = currentNamespace();
-  const editor = new YamlEditor({ rows: 24 });
+  const editor = new YamlEditor({ rows: 24, kind: "TpuSlice" });
   editor.setObject(starterSlice(ns));
 
   const post = async (dryRun) => {
@@ -152,18 +153,21 @@ async function detailsView(el, params) {
   const overview = (pane) => {
     pane.append(h("div.kf-section", {},
       h("h2", {}, "Overview"),
-      h("dl.kf-kv", {},
-        h("dt", {}, "accelerator"), h("dd", {}, summary.accelerator),
-        h("dt", {}, "topology"),
-        h("dd", {}, `${summary.topology} — ${summary.chips} chips over `
-          + `${summary.workers} workers`),
-        h("dt", {}, "ready"),
-        h("dd", {}, `${summary.readyWorkers}/${summary.workers}`),
-        h("dt", {}, "restarts"),
-        h("dd", {}, `${summary.restartCount}/${summary.maxRestarts}`
+      detailsList([
+        ["accelerator", summary.accelerator],
+        ["topology",
+          `${summary.topology} — ${summary.chips} chips over `
+          + `${summary.workers} workers`],
+        ["ready", `${summary.readyWorkers}/${summary.workers}`],
+        ["up for",
+          duration((ts.metadata || {}).creationTimestamp)],
+        ["restarts",
+          `${summary.restartCount}/${summary.maxRestarts}`
           + (summary.lastRestartReason
-            ? ` — last: ${summary.lastRestartReason}` : "")),
-      )));
+            ? ` — last: ${summary.lastRestartReason}` : "")],
+      ]),
+      h("h2", {}, "Conditions"),
+      conditionsTable((ts.status || {}).conditions)));
   };
 
   const workersTab = (pane) => {

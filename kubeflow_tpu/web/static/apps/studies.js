@@ -8,7 +8,8 @@
  * (backend routes: web/studies.py). */
 
 import {
-  age, api, currentNamespace, eventsTable, h, indexPage, Router, snack,
+  age, api, clear, conditionsTable, currentNamespace, detailsList,
+  duration, eventsTable, h, indexPage, Poller, Router, snack,
   statusIcon, tabPanel, YamlEditor, yamlDump,
 } from "../lib/components.js";
 
@@ -96,7 +97,7 @@ function starterStudy(ns) {
 
 async function newView(el) {
   const ns = currentNamespace();
-  const editor = new YamlEditor({ rows: 28 });
+  const editor = new YamlEditor({ rows: 28, kind: "StudyJob" });
   editor.setObject(starterStudy(ns));
 
   const post = async (dryRun) => {
@@ -154,12 +155,117 @@ function sparkline(reports) {
     hi === lo ? 0 : Math.round((v - lo) / (hi - lo) * 7)]).join("");
 }
 
+/* ------------------------------------------------ trial-objective chart */
+
+/* status palette (dataviz skill: states are STATUS, never series
+ * colors; icon/label pairing in the legend, never color alone) */
+const TRIAL_COLOR = { Succeeded: "#0ca30c", EarlyStopped: "#fab219",
+                      Failed: "#d03b3b" };
+const SERIES_BLUE = "#2a78d6";   /* best-so-far line (categorical #1) */
+
+function sv(name, attrs, ...children) {
+  const el = document.createElementNS("http://www.w3.org/2000/svg",
+    name);
+  for (const [k, v] of Object.entries(attrs || {})) {
+    el.setAttribute(k, String(v));
+  }
+  for (const c of children.flat()) {
+    if (c != null) el.append(c);
+  }
+  return el;
+}
+
+export function trialChart(trials, maximize, objectiveName) {
+  /* live per-trial objective chart: one dot per completed trial
+   * (status-colored), best-so-far step line, recessive grid, SVG
+   * <title> tooltips. x = trial index, one y axis (the objective). */
+  const done = trials.filter((t) => t.objectiveValue !== undefined);
+  if (done.length < 2) {
+    return h("div.kf-empty", {},
+      "chart appears after two trials report");
+  }
+  const W = 640, H = 220, L = 56, R = 12, T = 14, B = 30;
+  const xs = trials.map((t) => t.index);
+  const xmin = Math.min(...xs), xmax = Math.max(...xs);
+  const vals = done.map((t) => t.objectiveValue);
+  let lo = Math.min(...vals), hi = Math.max(...vals);
+  if (hi === lo) { hi += 1; lo -= 1; }
+  const pad = (hi - lo) * 0.08;
+  lo -= pad; hi += pad;
+  const X = (i) => L + (i - xmin) / Math.max(1, xmax - xmin)
+    * (W - L - R);
+  const Y = (v) => T + (hi - v) / (hi - lo) * (H - T - B);
+
+  const ticks = [0, 1, 2, 3].map((k) => lo + (k / 3) * (hi - lo));
+  const grid = ticks.map((v) => sv("line", {
+    x1: L, x2: W - R, y1: Y(v), y2: Y(v),
+    stroke: "#e8e8e4", "stroke-width": 1 }));
+  const yLabels = ticks.map((v) => sv("text", {
+    x: L - 6, y: Y(v) + 4, "text-anchor": "end",
+    class: "kf-chart-label" }, Number(v).toPrecision(3)));
+  const xLabels = [xmin, xmax].map((i) => sv("text", {
+    x: X(i), y: H - 8, "text-anchor": "middle",
+    class: "kf-chart-label" }, String(i)));
+
+  /* best-so-far step line over completed trials, in index order */
+  const ordered = [...done].sort((a, b) => a.index - b.index);
+  let bestV = null;
+  const steps = [];
+  for (const t of ordered) {
+    const v = t.objectiveValue;
+    bestV = bestV === null ? v
+      : (maximize ? Math.max(bestV, v) : Math.min(bestV, v));
+    steps.push([t.index, bestV]);
+  }
+  let d = "";
+  steps.forEach(([i, v], k) => {
+    d += (k === 0 ? `M ${X(i)} ${Y(v)}` : ` H ${X(i)}`) + ` V ${Y(v)}`;
+  });
+  const line = sv("path", { d, fill: "none", stroke: SERIES_BLUE,
+    "stroke-width": 2 });
+  const bestEnd = steps[steps.length - 1];
+  const bestLabel = sv("text", {
+    x: Math.min(X(bestEnd[0]) + 6, W - R - 4), y: Y(bestEnd[1]) - 6,
+    class: "kf-chart-label kf-chart-best" },
+  `best ${Number(bestEnd[1]).toPrecision(4)}`);
+
+  const dots = done.map((t) => {
+    const tip = `trial ${t.index} · ${t.state} · `
+      + `${objectiveName}=${Number(t.objectiveValue).toPrecision(5)}`
+      + (t.parameters ? ` · ${JSON.stringify(t.parameters)}` : "");
+    /* 12px invisible hit circle under the 4.5px mark (hover target
+     * bigger than the mark), white ring separates overlapping dots */
+    return sv("g", {},
+      sv("circle", { cx: X(t.index), cy: Y(t.objectiveValue), r: 12,
+        fill: "transparent" }, sv("title", {}, tip)),
+      sv("circle", { cx: X(t.index), cy: Y(t.objectiveValue), r: 4.5,
+        fill: TRIAL_COLOR[t.state] || "#9a9a94",
+        stroke: "#fff", "stroke-width": 2 },
+      sv("title", {}, tip)));
+  });
+
+  const legend = h("div.kf-chart-legend", {},
+    Object.entries(TRIAL_COLOR).map(([state, color]) =>
+      h("span.kf-legend-item", {},
+        h("span.kf-legend-dot", { style: `background:${color}` }),
+        ` ${state}`)),
+    h("span.kf-legend-item", {},
+      h("span.kf-legend-line"), " best so far"));
+
+  return h("div.kf-chart", { id: "trial-chart" },
+    sv("svg", { viewBox: `0 0 ${W} ${H}`, role: "img",
+      "aria-label": `${objectiveName} per trial` },
+    grid, yLabels, xLabels, line, bestLabel, dots),
+    legend);
+}
+
 async function detailsView(el, params) {
   const ns = currentNamespace();
+  const load = async () => api("GET",
+    `api/namespaces/${ns}/studyjobs/${params.name}`);
   let study, summary;
   try {
-    const resp = await api("GET",
-      `api/namespaces/${ns}/studyjobs/${params.name}`);
+    const resp = await load();
     study = resp.studyjob;
     summary = resp.summary;
   } catch (e) {
@@ -170,34 +276,39 @@ async function detailsView(el, params) {
   const best = (study.status || {}).bestTrial || null;
 
   const overview = (pane) => {
+    const created = (study.metadata || {}).creationTimestamp;
     pane.append(h("div.kf-section", {},
       h("h2", {}, "Overview"),
-      h("dl.kf-kv", {},
-        h("dt", {}, "algorithm"), h("dd", {}, summary.algorithm),
-        h("dt", {}, "early stopping"),
-        h("dd", {}, summary.earlyStopping || "off"),
-        h("dt", {}, "objective"),
-        h("dd", {}, `${(study.spec.objective || {}).type || "maximize"} `
-          + summary.objective),
-        h("dt", {}, "progress"),
-        h("dd", {}, `${summary.completedTrials}/${summary.maxTrials}`),
-        h("dt", {}, "best"),
-        h("dd", {}, best
+      detailsList([
+        ["algorithm", summary.algorithm],
+        ["early stopping", summary.earlyStopping || "off"],
+        ["objective",
+          `${(study.spec.objective || {}).type || "maximize"} `
+          + summary.objective],
+        ["progress",
+          `${summary.completedTrials}/${summary.maxTrials}`],
+        ["running for", duration(created)],
+        ["best", best
           ? `trial ${best.index}: ${summary.objective}=` +
             `${Number(best.objectiveValue).toPrecision(5)} @ ` +
             JSON.stringify(best.parameters)
-          : "—"),
-      )));
+          : null],
+      ]),
+      h("h2", {}, "Conditions"),
+      conditionsTable((study.status || {}).conditions)));
   };
 
-  const trialsTab = (pane) => {
-    pane.append(h("div.kf-card", {}, h("table.kf-table", {},
-      h("thead", {}, h("tr", {},
-        ["", "trial", "state", "objective", "progress", "parameters",
-         "node"].map((c) => h("th", {}, c)))),
-      h("tbody", {}, trials.length ? trials.map((t) => h("tr", {
+  const trialRows = (tbody, trialList, bestNow, pbt) => {
+    clear(tbody);
+    if (!trialList.length) {
+      tbody.append(h("tr", {}, h("td.kf-empty", { colSpan: pbt ? 9 : 7 },
+        "no trials yet")));
+      return;
+    }
+    for (const t of trialList) {
+      tbody.append(h("tr", {
         dataset: { trial: String(t.index) },
-        className: best && t.index === best.index ? "kf-best" : "",
+        className: bestNow && t.index === bestNow.index ? "kf-best" : "",
       },
         h("td", {}, statusIcon({ phase: TRIAL_ICON[t.state] || "waiting",
                                  message: t.state })),
@@ -206,12 +317,49 @@ async function detailsView(el, params) {
         h("td", {}, t.objectiveValue !== undefined
           ? Number(t.objectiveValue).toPrecision(4)
           : (t.partialObjectiveValue !== undefined
-            ? `(${Number(t.partialObjectiveValue).toPrecision(4)})` : "—")),
+            ? `(${Number(t.partialObjectiveValue).toPrecision(4)})`
+            : "—")),
         h("td", {}, sparkline(t.reports)),
+        pbt ? h("td", {}, t.pbt ? `g${t.pbt.generation}` : "") : null,
+        pbt ? h("td", {}, t.pbt
+          ? t.pbt.event + (t.pbt.parent !== undefined
+            && t.pbt.event === "exploit"
+            ? ` ← ${t.pbt.parent}` : "") : "") : null,
         h("td", {}, JSON.stringify(t.parameters || {})),
         h("td", {}, t.node || ""),
-      )) : h("tr", {}, h("td.kf-empty", { colSpan: 7 },
-        "no trials yet"))))));
+      ));
+    }
+  };
+
+  const trialsTab = (pane) => {
+    const maximize =
+      ((study.spec.objective || {}).type || "maximize") === "maximize";
+    const pbt = trials.some((t) => t.pbt);
+    const chartBox = h("div");
+    const tbody = h("tbody");
+    const head = ["", "trial", "state", "objective", "progress"];
+    if (pbt) head.push("gen", "lineage");
+    head.push("parameters", "node");
+    pane.append(
+      chartBox,
+      h("div.kf-card", {}, h("table.kf-table", {},
+        h("thead", {}, h("tr", {},
+          head.map((c) => h("th", {}, c)))),
+        tbody)));
+    const render = (trialList, bestNow) => {
+      clear(chartBox).append(
+        trialChart(trialList, maximize, summary.objective));
+      trialRows(tbody, trialList, bestNow, pbt);
+    };
+    render(trials, best);
+    /* the LIVE half: poll while the tab is open; cleanup on switch */
+    const poller = new Poller(async () => {
+      const resp = await load();
+      const st = (resp.studyjob.status || {});
+      render(st.trials || [], st.bestTrial || null);
+    }, 4000);
+    poller.kick();
+    return () => poller.stop();
   };
 
   const eventsTab = (pane) => {

@@ -10,19 +10,11 @@ import {
   Router, setNamespace, snack,
 } from "./core.js";
 
-/* --------------------------------------------------------------- age */
+/* ----------------------------------------------------------- datetime */
 
-export function age(timestamp) {
-  /* "3m ago"-style relative time for creationTimestamps */
-  if (!timestamp) return "";
-  const t = Date.parse(timestamp);
-  if (Number.isNaN(t)) return String(timestamp);
-  let s = Math.max(0, (Date.now() - t) / 1000);
-  for (const [unit, span] of [["d", 86400], ["h", 3600], ["m", 60]]) {
-    if (s >= span) return `${Math.floor(s / span)}${unit} ago`;
-  }
-  return `${Math.floor(s)}s ago`;
-}
+import { age, duration, formatTimestamp } from "./datetime.js";
+
+export { age, duration, formatTimestamp };
 
 /* ------------------------------------------------------ status icons */
 
@@ -218,6 +210,42 @@ export function eventsTable(events) {
       )) : h("tr", {}, h("td.kf-empty", { colSpan: 4 }, "no events"))));
 }
 
+/* ----------------------------------------------------- conditions table */
+
+export function conditionsTable(conditions) {
+  /* status.conditions renderer (common-lib conditions-table/): type,
+   * status with icon, reason, message, last transition — shared by the
+   * notebook/slice/study details pages. */
+  return h("table.kf-table.kf-conditions", {},
+    h("thead", {}, h("tr", {},
+      ["type", "status", "reason", "message", "last transition"]
+        .map((c) => h("th", {}, c)))),
+    h("tbody", {},
+      (conditions || []).length ? conditions.map((c) => h("tr", {},
+        h("td", {}, c.type || ""),
+        h("td", {}, h("span", {
+          className: "status status-"
+            + (c.status === "True" ? "ready" : "warning"),
+        }, c.status || "")),
+        h("td", {}, c.reason || ""),
+        h("td", {}, c.message || ""),
+        h("td", { title: c.lastTransitionTime || "" },
+          age(c.lastTransitionTime)),
+      )) : h("tr", {}, h("td.kf-empty", { colSpan: 5 },
+        "no conditions"))));
+}
+
+/* -------------------------------------------------------- details list */
+
+export function detailsList(pairs) {
+  /* two-column key/value block (common-lib details-list/): pairs is
+   * [[label, value|Node], ...]; null/undefined values render as "—". */
+  return h("dl.kf-details", {}, (pairs || []).map(([k, v]) =>
+    [h("dt", {}, k),
+     h("dd", {}, v === null || v === undefined || v === ""
+       ? "—" : v)]).flat());
+}
+
 /* ---------------------------------------------------------- tab panel */
 
 export function tabPanel(tabs) {
@@ -357,21 +385,30 @@ export class RowList {
 /* --------------------------------------------------------- yaml editor */
 
 import { dump as yamlDump, parse as yamlParse } from "./yaml.js";
+import { completionsAt, lint as schemaLint,
+         schemaFor } from "./schema.js";
+import { highlightYaml } from "./highlight.js";
+
+export { highlightYaml };
 
 export class YamlEditor {
-  /* In-browser manifest editor (common-lib resource-editor analogue,
-   * no-build tier): line-numbered textarea, Tab inserts spaces, live
-   * parse with the offending line called out, and a dirty flag so
-   * callers can warn before navigation. parsed() throws YamlError when
-   * the buffer doesn't parse — callers surface it next to their own
-   * server-side dry-run errors. */
-  constructor({ value, rows, onChange } = {}) {
+  /* In-browser manifest editor (common-lib editor/ analogue, no-build
+   * tier): line numbers, syntax highlighting (transparent textarea
+   * over a highlighted pre), Tab inserts spaces, live parse with the
+   * offending line called out, schema-aware key completion
+   * (Ctrl-Space; lib/schema.js) and unknown-key lint in the status
+   * bar. parsed() throws YamlError when the buffer doesn't parse —
+   * callers surface it next to their server-side dry-run errors. */
+  constructor({ value, rows, onChange, kind } = {}) {
+    this.kind = kind || null;
     this.gutter = h("pre.kf-editor-gutter");
+    this.hl = h("pre.kf-editor-hl", {}, h("code"));
     this.area = h("textarea.kf-editor-text", {
       rows: rows || 24, spellcheck: false,
       value: value || "",
     });
     this.status = h("div.kf-editor-status");
+    this.menu = h("div.kf-editor-menu", { hidden: true });
     this.dirty = false;
     this.area.addEventListener("input", () => {
       this.dirty = true;
@@ -380,22 +417,101 @@ export class YamlEditor {
     });
     this.area.addEventListener("scroll", () => {
       this.gutter.scrollTop = this.area.scrollTop;
+      this.hl.scrollTop = this.area.scrollTop;
+      this.hl.scrollLeft = this.area.scrollLeft;
     });
-    this.area.addEventListener("keydown", (e) => {
-      if (e.key === "Tab") {
-        e.preventDefault();
-        const { selectionStart: s, selectionEnd: end } = this.area;
-        this.area.setRangeText("  ", s, end, "end");
-        this.dirty = true;
-        this.refresh();
-      }
-    });
+    this.area.addEventListener("keydown", (e) => this.onKey(e));
     this.element = h("div.kf-editor", {},
-      h("div.kf-editor-body", {}, this.gutter, this.area),
-      this.status);
+      h("div.kf-editor-body", {}, this.gutter,
+        h("div.kf-editor-stack", {}, this.hl, this.area)),
+      this.menu, this.status);
     this.refresh();
   }
 
+  onKey(e) {
+    if (!this.menu.hidden &&
+        ["ArrowDown", "ArrowUp", "Enter", "Tab", "Escape"]
+          .includes(e.key)) {
+      e.preventDefault();
+      this.menuKey(e.key);
+      return;
+    }
+    if (e.key === " " && e.ctrlKey) {
+      e.preventDefault();
+      this.complete();
+      return;
+    }
+    if (e.key === "Tab") {
+      e.preventDefault();
+      const { selectionStart: s, selectionEnd: end } = this.area;
+      this.area.setRangeText("  ", s, end, "end");
+      this.dirty = true;
+      this.refresh();
+    }
+  }
+
+  /* ----------------------------------------- schema key completion */
+  cursorContext() {
+    const text = this.value();
+    const upto = text.slice(0, this.area.selectionStart);
+    const line = upto.split("\n").length - 1;
+    const col = upto.length - (upto.lastIndexOf("\n") + 1);
+    const current = text.split("\n")[line] || "";
+    const before = current.slice(0, col);
+    const m = /([A-Za-z0-9_.-]*)$/.exec(before);
+    return { line, col, prefix: m ? m[1] : "" };
+  }
+
+  complete() {
+    const { line, prefix } = this.cursorContext();
+    const items = completionsAt(this.value(), line, prefix, this.kind);
+    if (!items.length) {
+      this.setStatus(this.kindName()
+        ? "no completions here" : "no schema for this document",
+      "warn");
+      return;
+    }
+    this.menuItems = items;
+    this.menuIndex = 0;
+    this.menuPrefix = prefix;
+    clear(this.menu).append(...items.map((k, i) =>
+      h("div.kf-menu-item" + (i === 0 ? ".active" : ""), {
+        onclick: () => { this.menuIndex = i; this.accept(); },
+      }, k)));
+    this.menu.hidden = false;
+  }
+
+  menuKey(key) {
+    if (key === "Escape") {
+      this.menu.hidden = true;
+      return;
+    }
+    if (key === "ArrowDown" || key === "ArrowUp") {
+      const n = this.menuItems.length;
+      this.menuIndex = (this.menuIndex + (key === "ArrowDown" ? 1
+        : n - 1)) % n;
+      [...this.menu.children].forEach((el, i) =>
+        el.classList.toggle("active", i === this.menuIndex));
+      return;
+    }
+    this.accept();
+  }
+
+  accept() {
+    const key = this.menuItems[this.menuIndex];
+    const start = this.area.selectionStart - this.menuPrefix.length;
+    this.area.setRangeText(key + ": ", start, this.area.selectionStart,
+      "end");
+    this.menu.hidden = true;
+    this.dirty = true;
+    this.refresh();
+  }
+
+  kindName() {
+    return this.kind || (schemaFor(this.value()) ? "doc" : null);
+  }
+
+  /* -------------------------------------------------------- basics */
   value() {
     return this.area.value;
   }
@@ -415,12 +531,22 @@ export class YamlEditor {
   }
 
   refresh() {
-    const lines = this.value().split("\n").length;
+    const text = this.value();
+    const lines = text.split("\n").length;
     this.gutter.textContent = Array.from(
       { length: lines }, (_, i) => i + 1).join("\n");
+    this.hl.firstChild.innerHTML = highlightYaml(text) + "\n";
+    this.menu.hidden = true;
     try {
-      this.parsed();
-      this.setStatus("yaml ok", "");
+      const doc = this.parsed();
+      const warns = schemaLint(doc, this.kind);
+      if (warns.length) {
+        this.setStatus(`yaml ok · schema: ${warns[0]}`
+          + (warns.length > 1 ? ` (+${warns.length - 1} more)` : ""),
+        "warn");
+      } else {
+        this.setStatus("yaml ok", "");
+      }
       return true;
     } catch (e) {
       this.setStatus(e.message, "error", e.line);

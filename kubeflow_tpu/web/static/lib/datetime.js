@@ -1,0 +1,48 @@
+/* Date/time helpers — the kubeflow-common-lib date-time module
+ * analogue (projects/kubeflow/src/lib/date-time/): relative age,
+ * absolute formatting, and durations, shared by every app's tables
+ * and details pages. */
+
+export function age(timestamp) {
+  /* "3m ago"-style relative time for creationTimestamps */
+  if (!timestamp) return "";
+  const t = Date.parse(timestamp);
+  if (Number.isNaN(t)) return String(timestamp);
+  let s = Math.max(0, (Date.now() - t) / 1000);
+  for (const [unit, span] of [["d", 86400], ["h", 3600], ["m", 60]]) {
+    if (s >= span) return `${Math.floor(s / span)}${unit} ago`;
+  }
+  return `${Math.floor(s)}s ago`;
+}
+
+export function formatTimestamp(timestamp) {
+  /* absolute local time, seconds precision: "2026-07-30 14:03:22" */
+  if (!timestamp) return "";
+  const t = new Date(timestamp);
+  if (Number.isNaN(t.getTime())) return String(timestamp);
+  const p = (n) => String(n).padStart(2, "0");
+  return `${t.getFullYear()}-${p(t.getMonth() + 1)}-${p(t.getDate())} `
+    + `${p(t.getHours())}:${p(t.getMinutes())}:${p(t.getSeconds())}`;
+}
+
+export function duration(start, end) {
+  /* compact span between two timestamps (end defaults to now):
+   * "1d2h", "3h12m", "45m", "12s" */
+  if (!start) return "";
+  const a = Date.parse(start);
+  const b = end ? Date.parse(end) : Date.now();
+  if (Number.isNaN(a) || Number.isNaN(b)) return "";
+  let s = Math.max(0, (b - a) / 1000);
+  const parts = [];
+  for (const [unit, span] of [["d", 86400], ["h", 3600], ["m", 60]]) {
+    if (s >= span) {
+      parts.push(`${Math.floor(s / span)}${unit}`);
+      s %= span;
+      if (parts.length === 2) return parts.join("");
+    }
+  }
+  if (parts.length) {
+    return s >= 1 ? parts.join("") + `${Math.floor(s)}s` : parts.join("");
+  }
+  return `${Math.floor(s)}s`;
+}

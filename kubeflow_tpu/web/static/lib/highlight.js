@@ -1,0 +1,40 @@
+/* YAML syntax highlighting — DOM-free (string → HTML string) so the
+ * in-env executed-JS tier (tools/jsmini, tests/test_js_execution.py)
+ * covers it; components.js renders the output into the editor's
+ * highlight layer. The no-build analogue of the reference's monaco
+ * editor module (kubeflow-common-lib editor/). */
+
+export function highlightYaml(text) {
+  const esc = (s) => s.replace(/[&<>"]/g, (c) =>
+    ({ "&": "&amp;", "<": "&lt;", ">": "&gt;",
+       '"': "&quot;" }[c]));
+  return text.split("\n").map((line) => {
+    const cm = line.indexOf("#");
+    let head = line;
+    let comment = "";
+    // a # inside quotes is content; the cheap test: even quote count
+    if (cm >= 0) {
+      const before = line.slice(0, cm);
+      const quotes = (before.match(/["']/g) || []).length;
+      if (quotes % 2 === 0) {
+        head = before;
+        comment = line.slice(cm);
+      }
+    }
+    let html = esc(head)
+      .replace(/^(\s*(?:-\s+)?)([A-Za-z0-9_.\/-]+)(:)/,
+        (m, pre, key, colon) =>
+          `${pre}<span class="y-key">${key}</span>${colon}`)
+      .replace(/(&quot;)((?:[^&]|&(?!quot;))*?)(&quot;)/g,
+        '<span class="y-str">$1$2$3</span>')
+      .replace(/('(?:[^']|'')*')/g, '<span class="y-str">$1</span>')
+      .replace(/\b(true|false|null)\b(?![^<]*<\/span>)/g,
+        '<span class="y-bool">$1</span>')
+      .replace(/(:\s|^\s*-\s+)(-?\d+\.?\d*)(\s*)$/,
+        '$1<span class="y-num">$2</span>$3');
+    if (comment) {
+      html += `<span class="y-comment">${esc(comment)}</span>`;
+    }
+    return html;
+  }).join("\n");
+}

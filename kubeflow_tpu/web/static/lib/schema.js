@@ -1,0 +1,226 @@
+/* Schema tables + completion/lint engine for the YAML editor — the
+ * no-build analogue of the reference's monaco schema integration
+ * (kubeflow-common-lib editor/ + k8s JSON schemas). Hand-curated
+ * subsets of the CRDs this platform serves plus core PodSpec; enough
+ * for key completion and unknown-key linting, not full validation
+ * (the server-side dry-run remains the authority).
+ *
+ * Schema shape: nested objects; "*" = map with arbitrary keys,
+ * "[]" = array item schema; 1 (truthy leaf) = scalar. */
+
+const LABELS = { "*": 1 };
+
+const RESOURCES = {
+  requests: { "*": 1 },
+  limits: { "*": 1 },
+};
+
+const CONTAINER = {
+  name: 1, image: 1, imagePullPolicy: 1, workingDir: 1,
+  command: { "[]": 1 },
+  args: { "[]": 1 },
+  env: { "[]": { name: 1, value: 1, valueFrom: {
+    fieldRef: { fieldPath: 1 },
+    secretKeyRef: { name: 1, key: 1 },
+    configMapKeyRef: { name: 1, key: 1 } } } },
+  envFrom: { "[]": { configMapRef: { name: 1 },
+                     secretRef: { name: 1 } } },
+  ports: { "[]": { name: 1, containerPort: 1, protocol: 1 } },
+  resources: RESOURCES,
+  volumeMounts: { "[]": { name: 1, mountPath: 1, subPath: 1,
+                          readOnly: 1 } },
+};
+
+const POD_SPEC = {
+  containers: { "[]": CONTAINER },
+  initContainers: { "[]": CONTAINER },
+  volumes: { "[]": { name: 1,
+    persistentVolumeClaim: { claimName: 1, readOnly: 1 },
+    emptyDir: { medium: 1, sizeLimit: 1 },
+    configMap: { name: 1 }, secret: { secretName: 1 } } },
+  nodeSelector: { "*": 1 },
+  tolerations: { "[]": { key: 1, operator: 1, value: 1, effect: 1 } },
+  affinity: { podAntiAffinity: { "*": 1 }, nodeAffinity: { "*": 1 } },
+  serviceAccountName: 1, hostname: 1, subdomain: 1,
+  imagePullSecrets: { "[]": { name: 1 } },
+  securityContext: { "*": 1 },
+};
+
+const METADATA = {
+  name: 1, namespace: 1, labels: LABELS, annotations: LABELS,
+};
+
+const TEMPLATE = { metadata: METADATA, spec: POD_SPEC };
+
+export const SCHEMAS = {
+  Notebook: {
+    apiVersion: 1, kind: 1, metadata: METADATA,
+    spec: { template: TEMPLATE },
+  },
+  StudyJob: {
+    apiVersion: 1, kind: 1, metadata: METADATA,
+    spec: {
+      objective: { type: 1, metricName: 1 },
+      algorithm: { name: 1, seed: 1, population: 1,
+                   exploitQuantile: 1, resampleProb: 1,
+                   checkpointDir: 1 },
+      earlyStopping: { algorithm: 1, startStep: 1,
+                       minTrialsRequired: 1, minResource: 1, eta: 1 },
+      parameters: { "[]": { name: 1, type: 1, min: 1, max: 1,
+                            steps: 1, scale: 1, values: { "[]": 1 } } },
+      trialTemplate: TEMPLATE,
+      maxTrialCount: 1, parallelTrialCount: 1, chipsPerTrial: 1,
+      accelerator: 1,
+    },
+  },
+  TpuSlice: {
+    apiVersion: 1, kind: 1, metadata: METADATA,
+    spec: { accelerator: 1, topology: 1, maxRestarts: 1,
+            template: TEMPLATE },
+  },
+  PersistentVolumeClaim: {
+    apiVersion: 1, kind: 1, metadata: METADATA,
+    spec: { accessModes: { "[]": 1 }, storageClassName: 1,
+            resources: RESOURCES, volumeMode: 1 },
+  },
+  PodDefault: {
+    apiVersion: 1, kind: 1, metadata: METADATA,
+    spec: { selector: { matchLabels: LABELS,
+                        matchExpressions: { "[]": {
+                          key: 1, operator: 1,
+                          values: { "[]": 1 } } } },
+            desc: 1,
+            env: CONTAINER.env, envFrom: CONTAINER.envFrom,
+            volumes: POD_SPEC.volumes,
+            volumeMounts: CONTAINER.volumeMounts,
+            tolerations: POD_SPEC.tolerations,
+            annotations: LABELS, labels: LABELS,
+            serviceAccountName: 1,
+            imagePullSecrets: POD_SPEC.imagePullSecrets },
+  },
+  Tensorboard: {
+    apiVersion: 1, kind: 1, metadata: METADATA,
+    spec: { logspath: 1 },
+  },
+  Profile: {
+    apiVersion: 1, kind: 1, metadata: METADATA,
+    spec: { owner: { kind: 1, name: 1 },
+            resourceQuotaSpec: { hard: { "*": 1 } },
+            plugins: { "[]": { kind: 1, spec: { "*": 1 } } } },
+  },
+};
+
+export function schemaFor(kindOrText) {
+  /* accept a kind name or a YAML buffer (kind: sniffed by regex so a
+   * half-typed, unparseable document still completes) */
+  if (SCHEMAS[kindOrText]) return SCHEMAS[kindOrText];
+  const m = /^kind:\s*["']?([A-Za-z]+)/m.exec(kindOrText || "");
+  return m ? SCHEMAS[m[1]] || null : null;
+}
+
+function descend(schema, path) {
+  let node = schema;
+  for (const key of path) {
+    if (!node || typeof node !== "object") return null;
+    if (key === "[]") node = node["[]"];
+    else node = node[key] !== undefined ? node[key] : node["*"];
+  }
+  return node && typeof node === "object" ? node : null;
+}
+
+export function pathAt(text, lineIdx) {
+  /* mapping path containing the given line, from indentation: walk up
+   * through shallower "key:" lines; a "- " item descends through "[]".
+   * Returns null on tab-indented or unindentable buffers. */
+  const lines = text.split("\n");
+  if (lineIdx >= lines.length) lineIdx = lines.length - 1;
+  const indentOf = (l) => l.length - l.trimStart().length;
+  const cur = lines[lineIdx] ?? "";
+  let indent = indentOf(cur);
+  if (cur.trimStart().startsWith("- ") || cur.trim() === "-") {
+    indent += 2;        // item contents live one level under the dash
+  }
+  const path = [];
+  let limit = indent;
+  for (let i = lineIdx - 1; i >= 0 && limit > 0; i--) {
+    const line = lines[i];
+    if (!line.trim() || line.trim().startsWith("#")) continue;
+    const li = indentOf(line);
+    const t = line.trim();
+    if (li >= limit) continue;
+    if (t.startsWith("- ")) {
+      path.unshift("[]");
+      const km = /^-\s+([A-Za-z0-9_.-]+):/.exec(t);
+      if (km && li + 2 < indent) path.splice(1, 0, km[1]);
+      limit = li;
+      continue;
+    }
+    const km = /^([A-Za-z0-9_.-]+):/.exec(t);
+    if (km) {
+      path.unshift(km[1]);
+      limit = li;
+    }
+  }
+  return path;
+}
+
+export function completionsAt(text, lineIdx, prefix, kind) {
+  /* candidate keys for the mapping at lineIdx, minus siblings already
+   * present at the same indent, filtered by prefix. ``kind`` (the
+   * editor's configured schema) wins over sniffing the buffer, so a
+   * half-typed document without its kind: line still completes. */
+  const schema = (kind && SCHEMAS[kind]) || schemaFor(text);
+  if (!schema) return [];
+  const path = pathAt(text, lineIdx);
+  // inside a list item the keys come from the item schema
+  const node = descend(schema, path);
+  if (!node) return [];
+  const lines = text.split("\n");
+  const cur = lines[lineIdx] ?? "";
+  const myIndent = cur.length - cur.trimStart().length;
+  const siblings = new Set();
+  for (let i = 0; i < lines.length; i++) {
+    if (i === lineIdx) continue;
+    const l = lines[i];
+    const km = /^(\s*)(-\s+)?([A-Za-z0-9_.-]+):/.exec(l);
+    if (!km) continue;
+    // a "- key:" line's key sits 2 past the dash — the same level as
+    // the item's other keys on following lines
+    const eff = km[1].length + (km[2] ? 2 : 0);
+    if (eff === myIndent) siblings.add(km[3]);
+  }
+  return Object.keys(node)
+    .filter((k) => k !== "*" && k !== "[]")
+    .filter((k) => !siblings.has(k))
+    .filter((k) => !prefix || k.startsWith(prefix))
+    .sort();
+}
+
+export function lint(doc, kind) {
+  /* unknown-key warnings against the schema; arrays descend through
+   * "[]", "*"-maps accept anything. Best-effort: unknown kinds (or a
+   * null doc) lint clean — the dry-run owns real validation. */
+  const schema = SCHEMAS[kind || (doc && doc.kind)];
+  const out = [];
+  if (!schema || !doc || typeof doc !== "object") return out;
+  const walk = (node, value, path) => {
+    if (!node || typeof node !== "object") return;
+    if (Array.isArray(value)) {
+      if (node["[]"]) {
+        value.forEach((v, i) => walk(node["[]"], v, `${path}[${i}]`));
+      }
+      return;
+    }
+    if (!value || typeof value !== "object") return;
+    for (const [k, v] of Object.entries(value)) {
+      const sub = node[k] !== undefined ? node[k] : node["*"];
+      if (sub === undefined) {
+        out.push(`${path ? path + "." : ""}${k} is not a known field`);
+      } else {
+        walk(sub, v, path ? `${path}.${k}` : k);
+      }
+    }
+  };
+  walk(schema, doc, "");
+  return out;
+}

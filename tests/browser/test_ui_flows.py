@@ -453,3 +453,72 @@ def test_form_validation_blocks_bad_names(servers, page):
     page.goto(servers["jupyter"] + "/#/")
     page.wait_for_selector("#ns-select")
     assert page.locator('tr[data-row="Bad_Name!"]').count() == 0
+
+
+def test_editor_highlight_completion_and_schema_lint(servers, page):
+    """r4 editor depth: syntax-highlight layer present, Ctrl-Space
+    completion inserts a schema key, unknown keys lint in the status
+    bar (lib/schema.js; also executed in-env by test_js_execution)."""
+    page.goto(servers["studies"] + "/#/new")
+    page.wait_for_selector("#study-editor")
+    # highlight layer carries key spans for the starter manifest
+    assert page.locator(".kf-editor-hl .y-key").count() > 5
+    # schema lint: an unknown spec key surfaces as a warning status
+    yaml = page.locator(".kf-editor-text").input_value()
+    page.fill(".kf-editor-text",
+              yaml.replace("maxTrialCount: 12",
+                           "maxTrialCount: 12\n  bogusKnob: 1"))
+    page.wait_for_selector(".kf-editor-status.warn")
+    assert "bogusKnob" in page.inner_text(".kf-editor-status")
+    # completion at end of spec block: type a prefix, Ctrl-Space, Enter
+    area = page.locator(".kf-editor-text")
+    area.focus()
+    page.keyboard.press("Control+End")
+    page.keyboard.type("\n  chips")
+    page.keyboard.press("Control+ ")
+    page.wait_for_selector(".kf-menu-item.active")
+    page.keyboard.press("Enter")
+    assert "chipsPerTrial: " in area.input_value()
+
+
+def test_trial_objective_chart_renders_live(servers, page):
+    """r4 Studies details chart: status-colored trial dots + the
+    best-so-far step line, fed by the seeded demo-sweep study (four
+    completed trials via the metrics-ConfigMap contract)."""
+    page.goto(servers["studies"] + "/#/details/demo-sweep")
+    page.click("button[data-tab=trials]")
+    page.wait_for_selector("#trial-chart svg")
+    # status dots + the step line + legend with labeled states
+    assert page.locator("#trial-chart circle[r='4.5']").count() >= 4
+    assert page.locator("#trial-chart path").count() >= 1
+    assert "Succeeded" in page.inner_text(".kf-chart-legend")
+    assert "best so far" in page.inner_text(".kf-chart-legend")
+    assert "best" in page.inner_text("#trial-chart svg")
+    # overview uses the shared details-list + conditions-table
+    page.click("button[data-tab=overview]")
+    page.wait_for_selector(".kf-details")
+    page.wait_for_selector(".kf-conditions")
+
+
+def test_jupyter_existing_pvc_picker(servers, page):
+    """r4 form depth: the 'existing volume' row becomes a PVC picker
+    fed by /api/namespaces/<ns>/pvcs; size disappears (the claim has
+    one)."""
+    import json as _json
+    import urllib.request
+    ns = "team-a"
+    req = urllib.request.Request(
+        servers["volumes"] + f"/api/namespaces/{ns}/pvcs",
+        data=_json.dumps({"name": "shared-data", "size": "5Gi",
+                          "mode": "ReadWriteOnce"}).encode(),
+        headers={"Content-Type": "application/json"})
+    urllib.request.urlopen(req)
+    page.goto(servers["jupyter"] + "/#/new")
+    page.wait_for_selector("#form-volumes")
+    page.click("#add-data-volume")
+    row = page.locator(".kf-rowlist .kf-row").last
+    row.locator("select#f-type").select_option("existing")
+    # name input hides, PVC select shows the seeded claim
+    assert row.locator("#f-pick option",
+                       has_text="shared-data").count() == 1
+    assert row.locator("#f-size").is_hidden()

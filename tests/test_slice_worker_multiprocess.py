@@ -117,7 +117,8 @@ def test_controller_restarts_gang_and_resumes(tmp_path):
     assert len(joined) == 2, "one fresh join + one post-restart join"
     assert joined[0]["processes"] == N_WORKERS
     assert joined[0]["devices"] == 4, "2 procs x 2 devices global mesh"
-    assert joined[0]["mesh"].startswith("{'data': 2, 'fsdp': 2")
+    assert joined[0]["mesh"].startswith(
+            "{'data': 2, 'pipeline': 1, 'fsdp': 2")
     assert not joined[0]["resumed"]
     steps1 = [e for e in ev0 if e["event"] == "step"
               and e["t"] <= joined[1]["t"]]

@@ -969,3 +969,15 @@ class TestKfamSubjectKinds:
             "referredNamespace": "team-a",
         })
         assert r.status == 400
+
+
+def test_jupyter_pvcs_are_picker_summaries(platform):
+    """The form's existing-volume picker reads {name, size} — raw PVC
+    objects broke it silently (r4 review)."""
+    from kubeflow_tpu.api import builtin
+    store, _ = platform
+    store.create(builtin.pvc("data-claim", "team-a", "7Gi"))
+    c = client(jupyter.create_app(store))
+    pvcs = c.get("/api/namespaces/team-a/pvcs").json["pvcs"]
+    assert pvcs and pvcs[0]["name"] == "data-claim"
+    assert pvcs[0]["size"] == "7Gi"

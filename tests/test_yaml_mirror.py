@@ -1,11 +1,13 @@
-"""Executable coverage for the in-browser YAML lib's algorithm.
+"""Differential coverage for the in-browser YAML lib's algorithm.
 
-The unit image has no JS engine, so lib/yaml.js itself runs only in the
-browser tier (tests/browser test_yaml_lib_roundtrip_battery). This
-module runs the SAME battery against tests/yaml_mirror.py — a
-line-for-line Python transliteration — and pins yaml.js by hash so the
-mirror cannot drift: editing the JS fails test_mirror_is_in_sync until
-the mirror (and both batteries) are updated together.
+Since r4 the ACTUAL lib/yaml.js executes in-env too (tools/jsmini —
+tests/test_js_execution.py imports this module's battery and runs it
+against the real file). This module keeps tests/yaml_mirror.py — a
+line-for-line Python transliteration — as a second, independent
+implementation: the battery passing against BOTH, plus the
+dump-equality differential in test_js_execution, catches bugs either
+implementation alone would normalize away. The SHA pin still forces
+the two (and the browser battery) to move together.
 """
 
 import hashlib

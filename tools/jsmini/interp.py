@@ -1,0 +1,1561 @@
+"""jsmini interpreter: tree-walking evaluator for the parsed AST.
+
+Value mapping: JS number → float, string → str, bool → bool,
+null → None, undefined → UNDEFINED, array → JSArray(list),
+object → JSObject(dict), Set → JSSet, RegExp → JSRegExp (Python re
+underneath), Date → JSDate. Anything outside the supported surface
+raises JSMiniError rather than approximating."""
+
+import datetime
+import json
+import math
+import os
+import re
+
+from .parser import parse_module
+
+
+class JSMiniError(Exception):
+    """Unsupported construct / interpreter-level failure."""
+
+
+class JSThrow(Exception):
+    """A JS `throw` in flight; .value is the thrown JS value."""
+
+    def __init__(self, value):
+        self.value = value
+        super().__init__(js_repr(value))
+
+
+class JSError(JSThrow):
+    """Alias kept for the public API: uncaught JS exceptions."""
+
+
+class _Break(Exception):
+    pass
+
+
+class _Continue(Exception):
+    pass
+
+
+class _Return(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+class _Undefined:
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "undefined"
+
+    def __bool__(self):
+        return False
+
+
+UNDEFINED = _Undefined()
+
+
+class JSArray(list):
+    pass
+
+
+class JSObject(dict):
+    def __init__(self, *args, js_class=None, **kw):
+        super().__init__(*args, **kw)
+        self.js_class = js_class
+
+
+class JSSet:
+    def __init__(self, items=()):
+        self.items = list(dict.fromkeys(items))
+
+    def add(self, v):
+        if v not in self.items:
+            self.items.append(v)
+        return self
+
+    def has(self, v):
+        return v in self.items
+
+    @property
+    def size(self):
+        return float(len(self.items))
+
+
+class JSRegExp:
+    def __init__(self, source, flags=""):
+        self.source = source
+        self.flags = flags
+        py_flags = 0
+        if "i" in flags:
+            py_flags |= re.I
+        if "m" in flags:
+            py_flags |= re.M
+        if "s" in flags:
+            py_flags |= re.S
+        self.rx = re.compile(source, py_flags)
+        self.global_ = "g" in flags
+
+    def test(self, s):
+        return self.rx.search(s) is not None
+
+    def exec(self, s):
+        m = self.rx.search(s)
+        if m is None:
+            return None
+        out = JSArray([m.group(0)])
+        out.extend(g if g is not None else UNDEFINED for g in m.groups())
+        return out
+
+
+class JSDate:
+    def __init__(self, ms):
+        self.ms = ms          # float ms since epoch, or nan
+
+    def _dt(self):
+        return datetime.datetime.fromtimestamp(self.ms / 1000.0)
+
+    def getTime(self):
+        return self.ms
+
+    def getFullYear(self):
+        return float(self._dt().year)
+
+    def getMonth(self):
+        return float(self._dt().month - 1)
+
+    def getDate(self):
+        return float(self._dt().day)
+
+    def getHours(self):
+        return float(self._dt().hour)
+
+    def getMinutes(self):
+        return float(self._dt().minute)
+
+    def getSeconds(self):
+        return float(self._dt().second)
+
+
+def date_parse(s):
+    if isinstance(s, JSDate):
+        return s.ms
+    if not isinstance(s, str):
+        return float(s) if isinstance(s, (int, float)) else math.nan
+    text = s.strip()
+    try:
+        if text.endswith("Z"):
+            text = text[:-1] + "+00:00"
+        dt = datetime.datetime.fromisoformat(text)
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=datetime.timezone.utc)
+        return dt.timestamp() * 1000.0
+    except ValueError:
+        return math.nan
+
+
+class JSFunction:
+    def __init__(self, name, params, body, env, interp, is_expr_body,
+                 this=None):
+        self.name = name or ""
+        self.params = params
+        self.body = body
+        self.env = env
+        self.interp = interp
+        self.is_expr_body = is_expr_body
+        self.this = this          # bound `this` (arrow fns capture)
+
+    def call(self, this, args):
+        env = Env(self.env)
+        interp = self.interp
+        i = 0
+        for p in self.params:
+            if p[0] == "rest":
+                env.declare(p[1], JSArray(args[i:]))
+                break
+            _, target, default = p
+            val = args[i] if i < len(args) else UNDEFINED
+            if val is UNDEFINED and default is not None:
+                val = interp.eval(default, env)
+            interp.bind_pattern(target, val, env, declare=True)
+            i += 1
+        env.this = this if self.this is None else self.this
+        if self.is_expr_body:
+            return interp.eval(self.body, env)
+        try:
+            interp.exec_block(self.body, env)
+        except _Return as r:
+            return r.value
+        return UNDEFINED
+
+
+class JSClass:
+    def __init__(self, name, parent, methods, statics):
+        self.name = name
+        self.parent = parent          # JSClass | NativeErrorClass | None
+        self.methods = methods        # {name: JSFunction}
+        self.statics = statics
+
+    def find_method(self, name):
+        cls = self
+        while cls is not None:
+            m = getattr(cls, "methods", {}).get(name)
+            if m is not None:
+                return m
+            cls = cls.parent
+        return None
+
+    def construct(self, args, interp):
+        obj = JSObject(js_class=self)
+        ctor = self.find_method("constructor")
+        if ctor is not None:
+            ctor.call(obj, args)
+        else:
+            base = self
+            while base is not None and not isinstance(base,
+                                                      NativeErrorClass):
+                base = base.parent
+            if base is not None:
+                base.init(obj, args)
+        return obj
+
+
+class NativeErrorClass:
+    """Error / TypeError base: constructor sets .message/.name; classes
+    extending it get super(message) via this shim."""
+
+    def __init__(self, name):
+        self.name = name
+        self.parent = None
+        self.methods = {}
+
+    def init(self, obj, args):
+        obj["message"] = args[0] if args else ""
+        obj.setdefault("name", self.name)
+
+    def construct(self, args, interp):
+        obj = JSObject(js_class=self)
+        self.init(obj, args)
+        return obj
+
+
+ERROR_CLASS = NativeErrorClass("Error")
+TYPE_ERROR_CLASS = NativeErrorClass("TypeError")
+TYPE_ERROR_CLASS.parent = ERROR_CLASS
+
+
+class Env:
+    __slots__ = ("vars", "parent", "this")
+
+    def __init__(self, parent=None):
+        self.vars = {}
+        self.parent = parent
+        self.this = parent.this if parent is not None else UNDEFINED
+
+    def declare(self, name, value):
+        self.vars[name] = value
+
+    def lookup(self, name):
+        env = self
+        while env is not None:
+            if name in env.vars:
+                return env.vars[name]
+            env = env.parent
+        raise JSThrow(make_error(f"{name} is not defined"))
+
+    def set(self, name, value):
+        env = self
+        while env is not None:
+            if name in env.vars:
+                env.vars[name] = value
+                return
+            env = env.parent
+        raise JSThrow(make_error(f"{name} is not defined"))
+
+
+def make_error(message, cls=ERROR_CLASS):
+    obj = JSObject(js_class=cls)
+    obj["message"] = message
+    obj["name"] = cls.name
+    return obj
+
+
+# ------------------------------------------------------- JS semantics
+
+def truthy(v):
+    if v is None or v is UNDEFINED or v is False:
+        return False
+    if isinstance(v, float):
+        return not (v == 0.0 or math.isnan(v))
+    if isinstance(v, str):
+        return len(v) > 0
+    if v is True:
+        return True
+    return True
+
+
+def js_typeof(v):
+    if v is UNDEFINED:
+        return "undefined"
+    if v is None:
+        return "object"
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, float):
+        return "number"
+    if isinstance(v, str):
+        return "string"
+    if callable(v) or isinstance(v, (JSFunction, JSClass)):
+        return "function"
+    return "object"
+
+
+def to_js_string(v):
+    if isinstance(v, str):
+        return v
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    if v is None:
+        return "null"
+    if v is UNDEFINED:
+        return "undefined"
+    if isinstance(v, float):
+        return num_to_str(v)
+    if isinstance(v, JSArray):
+        return ",".join("" if x in (None, UNDEFINED) else to_js_string(x)
+                        for x in v)
+    if isinstance(v, JSObject):
+        if v.js_class is not None:
+            name = v.get("name", getattr(v.js_class, "name", "Error"))
+            msg = v.get("message", "")
+            return f"{name}: {msg}" if msg else str(name)
+        return "[object Object]"
+    return str(v)
+
+
+def num_to_str(f):
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "Infinity" if f > 0 else "-Infinity"
+    if f == int(f) and abs(f) < 1e21:
+        return str(int(f))
+    return repr(f)
+
+
+def to_number(v):
+    if isinstance(v, bool):
+        return 1.0 if v else 0.0
+    if isinstance(v, float):
+        return v
+    if v is None:
+        return 0.0
+    if v is UNDEFINED:
+        return math.nan
+    if isinstance(v, str):
+        s = v.strip()
+        if s == "":
+            return 0.0
+        try:
+            return float(int(s, 16)) if s[:2].lower() == "0x" \
+                else float(s)
+        except ValueError:
+            return math.nan
+    return math.nan
+
+
+def strict_eq(a, b):
+    if isinstance(a, bool) or isinstance(b, bool):
+        return a is b
+    if isinstance(a, float) and isinstance(b, float):
+        return a == b                 # NaN != NaN falls out naturally
+    if type(a) is not type(b):
+        if a is None and b is None:
+            return True
+        if a is UNDEFINED and b is UNDEFINED:
+            return True
+        return False
+    if isinstance(a, (JSArray, JSObject, JSSet, JSRegExp, JSFunction,
+                      JSClass, JSDate)):
+        return a is b
+    return a == b
+
+
+def js_repr(v):
+    return to_js_string(v)
+
+
+def to_python(v):
+    """JS value → plain Python (for test assertions)."""
+    if v is UNDEFINED:
+        return None
+    if isinstance(v, float) and v == int(v) and not math.isinf(v):
+        return int(v)
+    if isinstance(v, JSArray):
+        return [to_python(x) for x in v]
+    if isinstance(v, JSObject):
+        return {k: to_python(x) for k, x in v.items()}
+    if isinstance(v, JSSet):
+        return {to_python(x) for x in v.items}
+    return v
+
+
+def from_python(v):
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, str):
+        return v
+    if isinstance(v, (list, tuple)):
+        return JSArray(from_python(x) for x in v)
+    if isinstance(v, dict):
+        out = JSObject()
+        for k, x in v.items():
+            out[str(k)] = from_python(x)
+        return out
+    raise JSMiniError(f"cannot convert {type(v).__name__} to JS")
+
+
+# ------------------------------------------------------- member access
+
+STRING_METHODS = {
+    "startsWith": lambda s: lambda p, at=0.0: s.startswith(p, int(at)),
+    "endsWith": lambda s: lambda p: s.endswith(p),
+    "includes": lambda s: lambda p: p in s,
+    "indexOf": lambda s: lambda p: float(s.find(p)),
+    "lastIndexOf": lambda s: lambda p: float(s.rfind(p)),
+    "slice": lambda s: lambda a=0.0, b=None: _slice(s, a, b),
+    "substring": lambda s: lambda a=0.0, b=None: _substring(s, a, b),
+    "charAt": lambda s: lambda i=0.0: s[int(i)] if 0 <= int(i) < len(s)
+    else "",
+    "charCodeAt": lambda s: lambda i=0.0: float(ord(s[int(i)]))
+    if 0 <= int(i) < len(s) else math.nan,
+    "toLowerCase": lambda s: lambda: s.lower(),
+    "toUpperCase": lambda s: lambda: s.upper(),
+    "trim": lambda s: lambda: s.strip(),
+    "trimStart": lambda s: lambda: s.lstrip(),
+    "trimEnd": lambda s: lambda: s.rstrip(),
+    "repeat": lambda s: lambda n: s * int(n),
+    "padStart": lambda s: lambda n, fill=" ": s.rjust(int(n), fill or " "),
+    "padEnd": lambda s: lambda n, fill=" ": s.ljust(int(n), fill or " "),
+    "split": lambda s: lambda sep=UNDEFINED: JSArray(
+        [s] if sep is UNDEFINED else
+        (list(s) if sep == "" else s.split(sep))),
+    "concat": lambda s: lambda *a: s + "".join(map(to_js_string, a)),
+    "match": lambda s: lambda rx: _str_match(s, rx),
+    "replace": lambda s: lambda pat, rep: _str_replace(s, pat, rep),
+    "replaceAll": lambda s: lambda pat, rep: _str_replace(
+        s, pat, rep, force_all=True),
+    "localeCompare": lambda s: lambda o: float(
+        (s > o) - (s < o)),
+}
+
+
+def _norm_idx(i, n):
+    i = int(i)
+    return max(0, n + i) if i < 0 else min(i, n)
+
+
+def _slice(s, a, b):
+    n = len(s)
+    start = _norm_idx(to_number(a), n)
+    end = n if b in (None, UNDEFINED) else _norm_idx(to_number(b), n)
+    return s[start:end]
+
+
+def _substring(s, a, b):
+    n = len(s)
+    start = min(max(int(to_number(a)), 0), n)
+    end = n if b in (None, UNDEFINED) else min(max(int(to_number(b)),
+                                                  0), n)
+    if start > end:
+        start, end = end, start
+    return s[start:end]
+
+
+def _str_match(s, rx):
+    if not isinstance(rx, JSRegExp):
+        rx = JSRegExp(re.escape(rx))
+    if rx.global_:
+        out = JSArray(m.group(0) for m in rx.rx.finditer(s))
+        return out if out else None
+    return rx.exec(s)
+
+
+def _str_replace(s, pat, rep, force_all=False):
+    def repl_fn(m):
+        if isinstance(rep, (JSFunction, JSClass)) or callable(rep):
+            groups = [g if g is not None else UNDEFINED
+                      for g in m.groups()]
+            out = call_value(rep, UNDEFINED,
+                             [m.group(0), *groups, float(m.start()), s])
+            return to_js_string(out)
+        return re.sub(r"\$(\d+|\$|&)",
+                      lambda mm: ("$" if mm.group(1) == "$"
+                                  else m.group(0) if mm.group(1) == "&"
+                                  else (m.group(int(mm.group(1))) or "")),
+                      rep)
+    if isinstance(pat, JSRegExp):
+        count = 0 if (pat.global_ or force_all) else 1
+        return pat.rx.sub(repl_fn, s, count=count)
+    if isinstance(rep, (JSFunction, JSClass)) or callable(rep):
+        idx = s.find(pat)
+        if idx < 0:
+            return s
+        out = call_value(rep, UNDEFINED, [pat, float(idx), s])
+        return s[:idx] + to_js_string(out) + s[idx + len(pat):]
+    return s.replace(pat, rep, -1 if force_all else 1)
+
+
+def _array_method(arr, name):
+    def sort(cmp=None):
+        if cmp is None or cmp is UNDEFINED:
+            arr.sort(key=to_js_string)
+        else:
+            import functools
+            arr.sort(key=functools.cmp_to_key(
+                lambda a, b: int(to_number(
+                    call_value(cmp, UNDEFINED, [a, b])) or 0)
+                if not math.isnan(to_number(
+                    call_value(cmp, UNDEFINED, [a, b]))) else 0))
+        return arr
+
+    def splice(start, count=None, *items):
+        n = len(arr)
+        s = _norm_idx(to_number(start), n)
+        c = n - s if count in (None, UNDEFINED) \
+            else max(0, int(to_number(count)))
+        removed = JSArray(arr[s:s + c])
+        arr[s:s + c] = list(items)
+        return removed
+
+    def flat(depth=1.0):
+        def go(xs, d):
+            out = []
+            for x in xs:
+                if isinstance(x, JSArray) and d > 0:
+                    out.extend(go(x, d - 1))
+                else:
+                    out.append(x)
+            return out
+        return JSArray(go(arr, int(to_number(depth))))
+
+    def reduce(fn, *init):
+        it = list(arr)
+        if init:
+            acc = init[0]
+            start = 0
+        else:
+            acc = it[0]
+            start = 1
+        for i in range(start, len(it)):
+            acc = call_value(fn, UNDEFINED, [acc, it[i], float(i), arr])
+        return acc
+
+    table = {
+        "push": lambda *a: (arr.extend(a), float(len(arr)))[1],
+        "pop": lambda: arr.pop() if arr else UNDEFINED,
+        "shift": lambda: arr.pop(0) if arr else UNDEFINED,
+        "unshift": lambda *a: (arr.__setitem__(slice(0, 0), list(a)),
+                               float(len(arr)))[1],
+        "slice": lambda a=0.0, b=None: JSArray(
+            arr[_norm_idx(to_number(a), len(arr)):
+                len(arr) if b in (None, UNDEFINED)
+                else _norm_idx(to_number(b), len(arr))]),
+        "splice": splice,
+        "indexOf": lambda v: float(next(
+            (i for i, x in enumerate(arr) if strict_eq(x, v)), -1)),
+        "includes": lambda v: any(strict_eq(x, v) for x in arr),
+        "join": lambda sep=",": (sep if sep is not UNDEFINED else ","
+                                 ).join("" if x in (None, UNDEFINED)
+                                        else to_js_string(x)
+                                        for x in arr),
+        "map": lambda fn: JSArray(
+            call_value(fn, UNDEFINED, [x, float(i), arr])
+            for i, x in enumerate(list(arr))),
+        "filter": lambda fn: JSArray(
+            x for i, x in enumerate(list(arr))
+            if truthy(call_value(fn, UNDEFINED, [x, float(i), arr]))),
+        "forEach": lambda fn: ([call_value(fn, UNDEFINED,
+                                           [x, float(i), arr])
+                                for i, x in enumerate(list(arr))],
+                               UNDEFINED)[1],
+        "find": lambda fn: next(
+            (x for i, x in enumerate(list(arr))
+             if truthy(call_value(fn, UNDEFINED, [x, float(i), arr]))),
+            UNDEFINED),
+        "findIndex": lambda fn: float(next(
+            (i for i, x in enumerate(list(arr))
+             if truthy(call_value(fn, UNDEFINED, [x, float(i), arr]))),
+            -1)),
+        "some": lambda fn: any(
+            truthy(call_value(fn, UNDEFINED, [x, float(i), arr]))
+            for i, x in enumerate(list(arr))),
+        "every": lambda fn: all(
+            truthy(call_value(fn, UNDEFINED, [x, float(i), arr]))
+            for i, x in enumerate(list(arr))),
+        "concat": lambda *a: JSArray(
+            list(arr) + [y for x in a
+                         for y in (x if isinstance(x, JSArray)
+                                   else [x])]),
+        "reverse": lambda: (arr.reverse(), arr)[1],
+        "sort": sort,
+        "flat": flat,
+        "reduce": reduce,
+        "keys": lambda: JSArray(float(i) for i in range(len(arr))),
+    }
+    return table.get(name)
+
+
+def get_member(obj, name, interp=None):
+    if isinstance(obj, str):
+        if name == "length":
+            return float(len(obj))
+        m = STRING_METHODS.get(name)
+        if m is not None:
+            return m(obj)
+        raise JSThrow(make_error(
+            f"string method {name} not supported", TYPE_ERROR_CLASS))
+    if isinstance(obj, JSArray):
+        if name == "length":
+            return float(len(obj))
+        m = _array_method(obj, name)
+        if m is not None:
+            return m
+        return UNDEFINED
+    if isinstance(obj, JSObject):
+        if name in obj:
+            return obj[name]
+        if obj.js_class is not None:
+            m = obj.js_class.find_method(name)
+            if m is not None:
+                return _bind_method(m, obj)
+        return UNDEFINED
+    if isinstance(obj, JSSet):
+        if name == "add":
+            return obj.add
+        if name == "has":
+            return obj.has
+        if name == "size":
+            return obj.size
+        return UNDEFINED
+    if isinstance(obj, JSRegExp):
+        if name in ("test", "exec"):
+            return getattr(obj, name)
+        if name == "source":
+            return obj.source
+        return UNDEFINED
+    if isinstance(obj, JSDate):
+        m = getattr(obj, name, None)
+        if m is not None:
+            return m
+        return UNDEFINED
+    if isinstance(obj, JSClass):
+        if name in obj.statics:
+            return _bind_method(obj.statics[name], obj)
+        return UNDEFINED
+    if isinstance(obj, _DateCtor):
+        return getattr(obj, name, UNDEFINED)
+    if isinstance(obj, float):
+        if name == "toFixed":
+            return lambda d=0.0: f"{obj:.{int(d)}f}"
+        if name == "toString":
+            return lambda base=10.0: (num_to_str(obj) if base == 10
+                                      else _to_base(obj, int(base)))
+        return UNDEFINED
+    if obj is None or obj is UNDEFINED:
+        raise JSThrow(make_error(
+            f"cannot read properties of {to_js_string(obj)} "
+            f"(reading '{name}')", TYPE_ERROR_CLASS))
+    if callable(obj):
+        return UNDEFINED
+    raise JSMiniError(f"member access on {type(obj).__name__}")
+
+
+def _to_base(f, base):
+    n = int(f)
+    if n == 0:
+        return "0"
+    digits = "0123456789abcdefghijklmnopqrstuvwxyz"
+    sign = "-" if n < 0 else ""
+    n = abs(n)
+    out = []
+    while n:
+        out.append(digits[n % base])
+        n //= base
+    return sign + "".join(reversed(out))
+
+
+def _bind_method(fn, this):
+    if isinstance(fn, JSFunction):
+        return lambda *args: fn.call(this, list(args))
+    return fn
+
+
+def call_value(fn, this, args):
+    if isinstance(fn, JSFunction):
+        return fn.call(this, args)
+    if isinstance(fn, JSClass):
+        raise JSThrow(make_error(
+            f"class {fn.name} cannot be invoked without new",
+            TYPE_ERROR_CLASS))
+    if callable(fn):
+        out = fn(*args)
+        return _native_result(out)
+    raise JSThrow(make_error(f"{js_repr(fn)} is not a function",
+                             TYPE_ERROR_CLASS))
+
+
+def _native_result(out):
+    if isinstance(out, bool) or out is None or out is UNDEFINED:
+        return out
+    if isinstance(out, (int,)) and not isinstance(out, bool):
+        return float(out)
+    return out
+
+
+# ------------------------------------------------------------ builtins
+
+def make_globals(interp):
+    def js_json_stringify(value, replacer=None, indent=None):
+        def conv(v):
+            if v is UNDEFINED:
+                return None
+            if isinstance(v, float):
+                return int(v) if v == int(v) and not math.isinf(v) else v
+            if isinstance(v, JSArray):
+                return [conv(x) for x in v]
+            if isinstance(v, JSObject):
+                return {k: conv(x) for k, x in v.items()
+                        if x is not UNDEFINED}
+            return v
+        kw = {"separators": (",", ":")}
+        if indent not in (None, UNDEFINED):
+            kw = {"indent": int(to_number(indent))}
+        return json.dumps(conv(value), **kw)
+
+    def js_json_parse(text):
+        return from_python(json.loads(text))
+
+    g = {
+        "Math": JSObject({
+            "floor": lambda x: float(math.floor(to_number(x))),
+            "ceil": lambda x: float(math.ceil(to_number(x))),
+            "round": lambda x: float(math.floor(to_number(x) + 0.5)),
+            "abs": lambda x: abs(to_number(x)),
+            "max": lambda *a: max((to_number(x) for x in a),
+                                  default=-math.inf),
+            "min": lambda *a: min((to_number(x) for x in a),
+                                  default=math.inf),
+            "sqrt": lambda x: math.sqrt(to_number(x)),
+            "pow": lambda a, b: to_number(a) ** to_number(b),
+            "PI": math.pi,
+        }),
+        "JSON": JSObject({
+            "stringify": js_json_stringify,
+            "parse": js_json_parse,
+        }),
+        "Object": JSObject({
+            "keys": lambda o: JSArray(o.keys())
+            if isinstance(o, JSObject) else JSArray(),
+            "values": lambda o: JSArray(o.values())
+            if isinstance(o, JSObject) else JSArray(),
+            "entries": lambda o: JSArray(
+                JSArray([k, v]) for k, v in o.items())
+            if isinstance(o, JSObject) else JSArray(),
+            "assign": lambda t, *src: (
+                [t.update(s) for s in src if isinstance(s, JSObject)],
+                t)[1],
+            "fromEntries": lambda pairs: JSObject(
+                {p[0]: p[1] for p in pairs}),
+        }),
+        "Array": JSObject({
+            "isArray": lambda v: isinstance(v, JSArray),
+            "from": _array_from,
+        }),
+        "Number": JSObject({
+            "isNaN": lambda v: isinstance(v, float) and math.isnan(v),
+            "isInteger": lambda v: isinstance(v, float)
+            and not math.isinf(v) and v == int(v),
+            "isFinite": lambda v: isinstance(v, float)
+            and math.isfinite(v),
+            "parseFloat": lambda s: _parse_float(s),
+            "MAX_SAFE_INTEGER": float(2 ** 53 - 1),
+        }),
+        "String": lambda v=UNDEFINED: to_js_string(
+            "" if v is UNDEFINED else v),
+        "Boolean": lambda v=UNDEFINED: truthy(v),
+        "parseFloat": lambda s: _parse_float(s),
+        "parseInt": lambda s, base=10.0: _parse_int(s, base),
+        "isNaN": lambda v: math.isnan(to_number(v)),
+        "NaN": math.nan,
+        "Infinity": math.inf,
+        "Error": ERROR_CLASS,
+        "TypeError": TYPE_ERROR_CLASS,
+        "RegExp": lambda src, flags="": JSRegExp(
+            src.source if isinstance(src, JSRegExp) else src,
+            flags if flags is not UNDEFINED else ""),
+        "Set": JSSet,
+        "Date": _DateCtor(),
+        "console": JSObject({
+            "log": lambda *a: print(*[to_js_string(x) for x in a]),
+            "warn": lambda *a: None,
+            "error": lambda *a: None,
+        }),
+        "undefined": UNDEFINED,
+        "globalThis": UNDEFINED,
+    }
+    num = g["Number"]
+
+    def number_call(v=UNDEFINED):
+        return 0.0 if v is UNDEFINED else to_number(v)
+    num_callable = _CallableObject(number_call, num)
+    g["Number"] = num_callable
+    return g
+
+
+class _CallableObject(JSObject):
+    """A JSObject that is also callable (Number(...), Number.isNaN)."""
+
+    def __init__(self, fn, props):
+        super().__init__(props)
+        self._fn = fn
+
+    def __call__(self, *args):
+        return self._fn(*args)
+
+
+class _DateCtor:
+    """`Date.now()` / `Date.parse()` statics + `new Date(x)`."""
+
+    name = "Date"
+    parent = None
+    methods = {}
+    statics = {}
+
+    def construct(self, args, interp):
+        if not args:
+            ms = datetime.datetime.now().timestamp() * 1000.0
+        else:
+            ms = date_parse(args[0])
+        return JSDate(ms)
+
+    def now(self):
+        return datetime.datetime.now().timestamp() * 1000.0
+
+    def parse(self, s):
+        return date_parse(s)
+
+
+def _array_from(src, mapfn=None):
+    if isinstance(src, JSArray):
+        items = list(src)
+    elif isinstance(src, str):
+        items = list(src)
+    elif isinstance(src, JSSet):
+        items = list(src.items)
+    elif isinstance(src, JSObject) and "length" in src:
+        items = [UNDEFINED] * int(to_number(src["length"]))
+    else:
+        items = []
+    if mapfn not in (None, UNDEFINED):
+        items = [call_value(mapfn, UNDEFINED, [x, float(i)])
+                 for i, x in enumerate(items)]
+    return JSArray(items)
+
+
+def _parse_float(s):
+    m = re.match(r"\s*[+-]?(\d+\.?\d*(?:[eE][+-]?\d+)?|\.\d+)",
+                 s if isinstance(s, str) else to_js_string(s))
+    return float(m.group(0)) if m else math.nan
+
+
+def _parse_int(s, base=10.0):
+    m = re.match(r"\s*[+-]?[0-9a-zA-Z]+",
+                 s if isinstance(s, str) else to_js_string(s))
+    if not m:
+        return math.nan
+    try:
+        return float(int(m.group(0), int(to_number(base) or 10)))
+    except ValueError:
+        return math.nan
+
+
+# ---------------------------------------------------------- interpreter
+
+class Interpreter:
+    def __init__(self, loader=None):
+        self.loader = loader
+
+    # -- module execution
+    def run_module(self, src, module_dir=None):
+        ast = parse_module(src)
+        env = Env()
+        env.vars.update(make_globals(self))
+        exports = {}
+        hoisted = []
+        for st in ast[1]:
+            self.hoist(st, env)
+        for st in ast[1]:
+            self.exec_stmt(st, env, exports, module_dir)
+        del hoisted
+        return exports, env
+
+    def hoist(self, st, env):
+        if st[0] == "funcdecl":
+            env.declare(st[1], self.make_function(st[1], st[2], st[3],
+                                                  env))
+        elif st[0] == "export" and st[1][0] == "funcdecl":
+            inner = st[1]
+            env.declare(inner[1], self.make_function(
+                inner[1], inner[2], inner[3], env))
+
+    def exec_stmt(self, st, env, exports=None, module_dir=None):
+        kind = st[0]
+        if kind == "export":
+            inner = st[1]
+            self.exec_stmt(inner, env)
+            for name in _declared_names(inner):
+                exports[name] = env.lookup(name)
+            return
+        if kind == "export_names":
+            for name in st[1]:
+                exports[name] = env.lookup(name)
+            return
+        if kind == "import":
+            _, names, path, line = st
+            if self.loader is None:
+                raise JSMiniError(
+                    f"line {line}: import {path!r} needs a loader")
+            mod = self.loader(path, module_dir)
+            for name, alias in names:
+                if name not in mod:
+                    raise JSMiniError(
+                        f"line {line}: {path} does not export {name}")
+                env.declare(alias, mod[name])
+            return
+        self.exec(st, env)
+
+    def exec_block(self, block, env):
+        scope = Env(env)
+        for st in block[1]:
+            if st[0] == "funcdecl":
+                scope.declare(st[1], self.make_function(
+                    st[1], st[2], st[3], scope))
+        for st in block[1]:
+            self.exec(st, scope)
+
+    def exec(self, st, env):
+        kind = st[0]
+        method = getattr(self, "x_" + kind, None)
+        if method is None:
+            raise JSMiniError(f"statement {kind} not supported")
+        return method(st, env)
+
+    def x_expr(self, st, env):
+        self.eval(st[1], env)
+
+    def x_block(self, st, env):
+        self.exec_block(st, env)
+
+    def x_decl(self, st, env):
+        for target, init in st[2]:
+            value = UNDEFINED if init is None else self.eval(init, env)
+            self.bind_pattern(target, value, env, declare=True)
+
+    def x_funcdecl(self, st, env):
+        if st[1] not in env.vars:
+            env.declare(st[1], self.make_function(st[1], st[2], st[3],
+                                                  env))
+
+    def x_classdecl(self, st, env):
+        _, name, parent_expr, methods = st
+        parent = None
+        if parent_expr is not None:
+            parent = self.eval(parent_expr, env)
+        ms, statics = {}, {}
+        cls = JSClass(name, parent, ms, statics)
+        for static, mname, params, body in methods:
+            fn = self.make_function(mname, params, body, env)
+            fn.js_class = cls
+            (statics if static else ms)[mname] = fn
+        env.declare(name, cls)
+
+    def x_return(self, st, env):
+        raise _Return(UNDEFINED if st[1] is None
+                      else self.eval(st[1], env))
+
+    def x_if(self, st, env):
+        if truthy(self.eval(st[1], env)):
+            self.exec(st[2], env)
+        elif st[3] is not None:
+            self.exec(st[3], env)
+
+    def x_while(self, st, env):
+        while truthy(self.eval(st[1], env)):
+            try:
+                self.exec(st[2], env)
+            except _Break:
+                break
+            except _Continue:
+                continue
+
+    def x_dowhile(self, st, env):
+        while True:
+            try:
+                self.exec(st[2], env)
+            except _Break:
+                break
+            except _Continue:
+                pass
+            if not truthy(self.eval(st[1], env)):
+                break
+
+    def x_for(self, st, env):
+        _, init, cond, step, body = st
+        scope = Env(env)
+        if init is not None:
+            self.exec(init, scope)
+        while cond is None or truthy(self.eval(cond, scope)):
+            try:
+                self.exec(body, scope)
+            except _Break:
+                break
+            except _Continue:
+                pass
+            if step is not None:
+                self.eval(step, scope)
+
+    def x_for_of(self, st, env):
+        _, kind, target, seq_expr, body = st
+        seq = self.eval(seq_expr, env)
+        if isinstance(seq, JSArray):
+            items = list(seq)
+        elif isinstance(seq, str):
+            items = list(seq)
+        elif isinstance(seq, JSSet):
+            items = list(seq.items)
+        else:
+            raise JSThrow(make_error(
+                f"{js_repr(seq)} is not iterable", TYPE_ERROR_CLASS))
+        for item in items:
+            scope = Env(env)
+            self.bind_pattern(target, item, scope, declare=True)
+            try:
+                self.exec(body, scope)
+            except _Break:
+                break
+            except _Continue:
+                continue
+
+    def x_for_in(self, st, env):
+        _, kind, target, seq_expr, body = st
+        seq = self.eval(seq_expr, env)
+        if isinstance(seq, JSObject):
+            keys = list(seq.keys())
+        elif isinstance(seq, JSArray):
+            keys = [num_to_str(float(i)) for i in range(len(seq))]
+        else:
+            keys = []
+        for key in keys:
+            scope = Env(env)
+            self.bind_pattern(target, key, scope, declare=True)
+            try:
+                self.exec(body, scope)
+            except _Break:
+                break
+            except _Continue:
+                continue
+
+    def x_break(self, st, env):
+        raise _Break()
+
+    def x_continue(self, st, env):
+        raise _Continue()
+
+    def x_throw(self, st, env):
+        raise JSThrow(self.eval(st[1], env))
+
+    def x_try(self, st, env):
+        _, body, param, catch, final = st
+        try:
+            self.exec_block(body, env)
+        except JSThrow as e:
+            if catch is not None:
+                scope = Env(env)
+                if param:
+                    scope.declare(param, e.value)
+                self.exec_block(catch, scope)
+            elif final is None:
+                raise
+        finally:
+            if final is not None:
+                self.exec_block(final, env)
+
+    # -- expressions
+    def eval(self, node, env):
+        kind = node[0]
+        method = getattr(self, "e_" + kind, None)
+        if method is None:
+            raise JSMiniError(f"expression {kind} not supported")
+        return method(node, env)
+
+    def e_num(self, node, env):
+        return node[1]
+
+    def e_str(self, node, env):
+        return node[1]
+
+    def e_bool(self, node, env):
+        return node[1]
+
+    def e_null(self, node, env):
+        return None
+
+    def e_undefined(self, node, env):
+        return UNDEFINED
+
+    def e_this(self, node, env):
+        return env.this
+
+    def e_name(self, node, env):
+        return env.lookup(node[1])
+
+    def e_regex(self, node, env):
+        return JSRegExp(node[1], node[2])
+
+    def e_template(self, node, env):
+        out = []
+        for part in node[1]:
+            if part[0] == "cooked":
+                out.append(part[1])
+            else:
+                out.append(to_js_string(self.eval(part[1], env)))
+        return "".join(out)
+
+    def e_array(self, node, env):
+        out = JSArray()
+        for item in node[1]:
+            if item[0] == "spread":
+                out.extend(self.eval(item[1], env))
+            else:
+                out.append(self.eval(item[1], env))
+        return out
+
+    def e_object(self, node, env):
+        out = JSObject()
+        for prop in node[1]:
+            if prop[0] == "spread":
+                src = self.eval(prop[1], env)
+                if isinstance(src, JSObject):
+                    out.update(src)
+            elif prop[0] == "computed":
+                key = to_js_string(self.eval(prop[1], env))
+                out[key] = self.eval(prop[2], env)
+            else:
+                out[prop[1]] = self.eval(prop[2], env)
+        return out
+
+    def e_seq(self, node, env):
+        self.eval(node[1], env)
+        return self.eval(node[2], env)
+
+    def e_cond(self, node, env):
+        return self.eval(node[2] if truthy(self.eval(node[1], env))
+                         else node[3], env)
+
+    def e_unary(self, node, env):
+        op = node[1]
+        if op == "typeof":
+            try:
+                return js_typeof(self.eval(node[2], env))
+            except JSThrow:
+                return "undefined"
+        v = self.eval(node[2], env)
+        if op == "!":
+            return not truthy(v)
+        if op == "-":
+            return -to_number(v)
+        if op == "+":
+            return to_number(v)
+        if op == "~":
+            return float(~int(to_number(v)))
+        if op == "void":
+            return UNDEFINED
+        if op == "delete":
+            return True
+        raise JSMiniError(f"unary {op}")
+
+    def e_update(self, node, env):
+        _, op, target, prefix = node
+        old = to_number(self.eval(target, env))
+        new = old + (1.0 if op == "++" else -1.0)
+        self.assign_to(target, new, env)
+        return new if prefix else old
+
+    def e_bin(self, node, env):
+        op = node[1]
+        if op == "&&":
+            left = self.eval(node[2], env)
+            return self.eval(node[3], env) if truthy(left) else left
+        if op == "||":
+            left = self.eval(node[2], env)
+            return left if truthy(left) else self.eval(node[3], env)
+        if op == "??":
+            left = self.eval(node[2], env)
+            return self.eval(node[3], env) \
+                if left is None or left is UNDEFINED else left
+        a = self.eval(node[2], env)
+        b = self.eval(node[3], env)
+        if op == "+":
+            if isinstance(a, str) or isinstance(b, str):
+                return to_js_string(a) + to_js_string(b)
+            return to_number(a) + to_number(b)
+        if op in ("-", "*", "/", "%", "**"):
+            x, y = to_number(a), to_number(b)
+            if op == "-":
+                return x - y
+            if op == "*":
+                return x * y
+            if op == "/":
+                return x / y if y != 0 else (
+                    math.nan if x == 0 else math.copysign(math.inf, x)
+                    * math.copysign(1, y))
+            if op == "%":
+                return math.fmod(x, y) if y != 0 else math.nan
+            return x ** y
+        if op == "===":
+            return strict_eq(a, b)
+        if op == "!==":
+            return not strict_eq(a, b)
+        if op == "==":
+            if (a is None or a is UNDEFINED) \
+                    and (b is None or b is UNDEFINED):
+                return True
+            return strict_eq(a, b)
+        if op == "!=":
+            if (a is None or a is UNDEFINED) \
+                    and (b is None or b is UNDEFINED):
+                return False
+            return not strict_eq(a, b)
+        if op in ("<", ">", "<=", ">="):
+            if isinstance(a, str) and isinstance(b, str):
+                pass
+            else:
+                a, b = to_number(a), to_number(b)
+                if math.isnan(a) or math.isnan(b):
+                    return False
+            return {"<": a < b, ">": a > b,
+                    "<=": a <= b, ">=": a >= b}[op]
+        if op == "in":
+            key = to_js_string(a)
+            if isinstance(b, JSObject):
+                return key in b
+            if isinstance(b, JSArray):
+                return key.isdigit() and int(key) < len(b)
+            return False
+        if op == "instanceof":
+            if isinstance(b, (JSClass, NativeErrorClass)):
+                cls = getattr(a, "js_class", None)
+                while cls is not None:
+                    if cls is b:
+                        return True
+                    cls = cls.parent
+                return False
+            return False
+        if op in ("&", "|", "^", "<<", ">>"):
+            x, y = int(to_number(a)), int(to_number(b))
+            return float({"&": x & y, "|": x | y, "^": x ^ y,
+                          "<<": x << y, ">>": x >> y}[op])
+        raise JSMiniError(f"binary {op}")
+
+    def e_assign(self, node, env):
+        _, op, target, value_expr = node
+        if op == "=":
+            value = self.eval(value_expr, env)
+        elif op in ("&&=", "||=", "??="):
+            cur = self.eval(target, env)
+            if op == "&&=" and not truthy(cur):
+                return cur
+            if op == "||=" and truthy(cur):
+                return cur
+            if op == "??=" and cur is not None and cur is not UNDEFINED:
+                return cur
+            value = self.eval(value_expr, env)
+        else:
+            cur = self.eval(target, env)
+            rhs = self.eval(value_expr, env)
+            binop = op[:-1]
+            value = self.e_bin(("bin", binop, ("lit", cur),
+                                ("lit", rhs)), env) \
+                if False else self._apply_bin(binop, cur, rhs)
+        self.assign_to(target, value, env)
+        return value
+
+    def _apply_bin(self, op, a, b):
+        if op == "+":
+            if isinstance(a, str) or isinstance(b, str):
+                return to_js_string(a) + to_js_string(b)
+            return to_number(a) + to_number(b)
+        x, y = to_number(a), to_number(b)
+        return {"-": x - y, "*": x * y,
+                "/": x / y if y else math.nan,
+                "%": math.fmod(x, y) if y else math.nan}[op]
+
+    def assign_to(self, target, value, env):
+        kind = target[0]
+        if kind == "name":
+            env.set(target[1], value)
+        elif kind == "member":
+            obj = self.eval(target[1], env)
+            self.set_member(obj, target[2], value)
+        elif kind == "index":
+            obj = self.eval(target[1], env)
+            idx = self.eval(target[2], env)
+            self.set_index(obj, idx, value)
+        else:
+            raise JSMiniError(f"cannot assign to {kind}")
+
+    def set_member(self, obj, name, value):
+        if isinstance(obj, JSObject):
+            obj[name] = value
+        elif isinstance(obj, JSArray) and name == "length":
+            n = int(to_number(value))
+            del obj[n:]
+        else:
+            raise JSThrow(make_error(
+                f"cannot set property {name} on "
+                f"{js_typeof(obj)}", TYPE_ERROR_CLASS))
+
+    def set_index(self, obj, idx, value):
+        if isinstance(obj, JSArray):
+            i = int(to_number(idx))
+            while len(obj) <= i:
+                obj.append(UNDEFINED)
+            obj[i] = value
+        elif isinstance(obj, JSObject):
+            obj[to_js_string(idx)] = value
+        else:
+            raise JSThrow(make_error("cannot index-assign",
+                                     TYPE_ERROR_CLASS))
+
+    def e_member(self, node, env):
+        obj = self.eval(node[1], env)
+        return get_member(obj, node[2], self)
+
+    def e_optmember(self, node, env):
+        obj = self.eval(node[1], env)
+        if obj is None or obj is UNDEFINED:
+            return UNDEFINED
+        return get_member(obj, node[2], self)
+
+    def e_index(self, node, env):
+        obj = self.eval(node[1], env)
+        idx = self.eval(node[2], env)
+        if isinstance(obj, JSArray):
+            i = int(to_number(idx))
+            if isinstance(idx, str) and not idx.lstrip("-").isdigit():
+                return get_member(obj, idx, self)
+            if 0 <= i < len(obj):
+                return obj[i]
+            return UNDEFINED
+        if isinstance(obj, str):
+            if isinstance(idx, float):
+                i = int(idx)
+                return obj[i] if 0 <= i < len(obj) else UNDEFINED
+            return get_member(obj, to_js_string(idx), self)
+        if isinstance(obj, JSObject):
+            key = to_js_string(idx)
+            if key in obj:
+                return obj[key]
+            return get_member(obj, key, self)
+        return get_member(obj, to_js_string(idx), self)
+
+    def e_call(self, node, env):
+        callee = node[1]
+        args = []
+        for a in node[2]:
+            if a[0] == "spread":
+                args.extend(self.eval(a[1], env))
+            else:
+                args.append(self.eval(a[1], env))
+        # method call: bind `this`
+        if callee[0] == "member":
+            obj = self.eval(callee[1], env)
+            if callee[1][0] == "super" or obj is None:
+                pass
+            fn = get_member(obj, callee[2], self)
+            if isinstance(fn, JSFunction):
+                return fn.call(obj, args)
+            return call_value(fn, obj, args)
+        if callee[0] == "super":
+            cls = getattr(env.this, "js_class", None)
+            parent = cls.parent if cls else None
+            while parent is not None and \
+                    not isinstance(parent, (JSClass, NativeErrorClass)):
+                parent = parent.parent
+            if isinstance(parent, NativeErrorClass):
+                parent.init(env.this, args)
+                return UNDEFINED
+            if isinstance(parent, JSClass):
+                ctor = parent.find_method("constructor")
+                if ctor:
+                    ctor.call(env.this, args)
+                return UNDEFINED
+            return UNDEFINED
+        fn = self.eval(callee, env)
+        return call_value(fn, UNDEFINED, args)
+
+    def e_new(self, node, env):
+        cls = self.eval(node[1], env)
+        args = [self.eval(a[1], env) for a in node[2]]
+        if isinstance(cls, (JSClass, NativeErrorClass, _DateCtor)):
+            return cls.construct(args, self)
+        if cls is JSSet or isinstance(cls, type):
+            return cls(*args)
+        if callable(cls):
+            return cls(*args)
+        raise JSThrow(make_error(f"{js_repr(cls)} is not a constructor",
+                                 TYPE_ERROR_CLASS))
+
+    def e_arrow(self, node, env):
+        _, params, body, is_expr = node
+        return JSFunction(None, params, body, env, self, is_expr,
+                          this=env.this)
+
+    def e_funcexpr(self, node, env):
+        _, name, params, body = node
+        return self.make_function(name, params, body, env)
+
+    def e_super(self, node, env):
+        raise JSMiniError("super only supported as super(...) call")
+
+    def make_function(self, name, params, body, env):
+        return JSFunction(name, params, body, env, self, False)
+
+    def bind_pattern(self, target, value, env, declare=False):
+        kind = target[0]
+        if kind == "name":
+            if declare:
+                env.declare(target[1], value)
+            else:
+                env.set(target[1], value)
+            return
+        if kind == "arr_pat":
+            seq = value if isinstance(value, (JSArray, list)) else \
+                (list(value) if isinstance(value, str) else None)
+            if seq is None:
+                raise JSThrow(make_error(
+                    f"{js_repr(value)} is not iterable",
+                    TYPE_ERROR_CLASS))
+            for i, sub in enumerate(target[1]):
+                if sub is None:
+                    continue
+                v = seq[i] if i < len(seq) else UNDEFINED
+                self.bind_pattern(sub, v, env, declare)
+            return
+        if kind == "obj_pat":
+            for name, alias, default in target[1]:
+                v = get_member(value, name, self) \
+                    if isinstance(value, (JSObject, JSArray, str)) \
+                    else UNDEFINED
+                if v is UNDEFINED and default is not None:
+                    v = self.eval(default, env)
+                if declare:
+                    env.declare(alias, v)
+                else:
+                    env.set(alias, v)
+            return
+        raise JSMiniError(f"pattern {kind}")
+
+
+def _declared_names(st):
+    kind = st[0]
+    if kind == "funcdecl" or kind == "classdecl":
+        return [st[1]]
+    if kind == "decl":
+        out = []
+        for target, _ in st[2]:
+            out.extend(_pattern_names(target))
+        return out
+    return []
+
+
+def _pattern_names(target):
+    if target[0] == "name":
+        return [target[1]]
+    if target[0] == "arr_pat":
+        out = []
+        for sub in target[1]:
+            if sub is not None:
+                out.extend(_pattern_names(sub))
+        return out
+    if target[0] == "obj_pat":
+        return [alias for _, alias, _ in target[1]]
+    return []
+
+
+# -------------------------------------------------------- module loader
+
+_module_cache = {}
+
+
+def load_module(path, use_cache=True):
+    """Execute a JS module file; returns its exports as a dict whose
+    functions are directly callable from Python (Python args are
+    converted in, results stay as JS values — use to_python())."""
+    path = os.path.abspath(path)
+    if use_cache and path in _module_cache:
+        return _module_cache[path]
+
+    def loader(rel, importer_dir):
+        target = os.path.normpath(
+            os.path.join(importer_dir or os.path.dirname(path), rel))
+        return load_module(target, use_cache)
+
+    interp = Interpreter(loader=loader)
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    exports, _ = interp.run_module(src, os.path.dirname(path))
+    wrapped = _ExportsDict(exports)
+    if use_cache:
+        _module_cache[path] = wrapped
+    return wrapped
+
+
+class _ExportsDict(dict):
+    """Exports with Python-friendly calling: fn(*py_args) converts
+    arguments via from_python (JS values pass through untouched)."""
+
+    def __init__(self, exports):
+        super().__init__()
+        for name, value in exports.items():
+            if isinstance(value, JSFunction):
+                self[name] = _py_callable(value)
+            else:
+                self[name] = value
+
+
+def _py_callable(fn):
+    def call(*args):
+        js_args = [a if isinstance(
+            a, (JSArray, JSObject, JSSet, JSRegExp, JSDate, JSFunction,
+                _Undefined)) or a is None or isinstance(a, (bool, str))
+            else (float(a) if isinstance(a, (int, float))
+                  else from_python(a))
+            for a in args]
+        return fn.call(UNDEFINED, js_args)
+    call.js_function = fn
+    return call

@@ -1,0 +1,250 @@
+"""jsmini lexer: JS source → token stream.
+
+Template literals lex as structured tokens (cooked string segments +
+embedded expression token substreams) so the parser never re-scans.
+Regex literals are disambiguated from division by the previous
+significant token, the standard heuristic."""
+
+import re
+
+KEYWORDS = {
+    "var", "let", "const", "function", "return", "if", "else", "for",
+    "while", "do", "break", "continue", "new", "delete", "typeof",
+    "instanceof", "in", "of", "this", "null", "true", "false",
+    "undefined", "class", "extends", "super", "static", "get", "set",
+    "try", "catch", "finally", "throw", "switch", "case", "default",
+    "import", "export", "from", "as", "void",
+    # recognized so their use fails at PARSE time (no handlers): jsmini
+    # must reject async/generator code loudly, not run it wrong
+    "async", "await", "yield",
+}
+
+PUNCT = [
+    "...", "=>", "===", "!==", "**=", "<<=", ">>=", "&&=", "||=", "??=",
+    "==", "!=", "<=", ">=", "&&", "||", "??", "?.", "++", "--", "+=",
+    "-=", "*=", "/=", "%=", "&=", "|=", "^=", "**", "<<", ">>",
+    "{", "}", "(", ")", "[", "]", ";", ",", "<", ">", "+", "-", "*",
+    "/", "%", "&", "|", "^", "!", "~", "?", ":", "=", ".",
+]
+
+_ID_START = re.compile(r"[A-Za-z_$]")
+_ID = re.compile(r"[A-Za-z0-9_$]*")
+_NUM = re.compile(r"0[xX][0-9a-fA-F]+|\d+\.?\d*(?:[eE][+-]?\d+)?|\.\d+")
+
+#: tokens after which a '/' starts a regex literal, not division
+_REGEX_PRECEDERS = {
+    None, "(", "[", "{", ",", ";", ":", "=", "==", "===", "!=", "!==",
+    "<", ">", "<=", ">=", "+", "-", "*", "/", "%", "!", "&&", "||",
+    "??", "?", "=>", "return", "typeof", "in", "of", "instanceof",
+    "new", "throw", "case", "delete", "void",
+}
+
+
+class Token:
+    __slots__ = ("kind", "value", "line", "parts")
+
+    def __init__(self, kind, value, line, parts=None):
+        self.kind = kind          # num str regex template id kw punct eof
+        self.value = value
+        self.line = line
+        self.parts = parts        # template: [(cooked, expr_tokens|None)]
+
+    def __repr__(self):
+        return f"<{self.kind} {self.value!r} @{self.line}>"
+
+
+class LexError(SyntaxError):
+    pass
+
+
+def tokenize(src):
+    return _Lexer(src).run()
+
+
+class _Lexer:
+    def __init__(self, src, line=1):
+        self.src = src
+        self.i = 0
+        self.line = line
+        self.out = []
+
+    def error(self, msg):
+        raise LexError(f"line {self.line}: {msg}")
+
+    def prev_significant(self):
+        return self.out[-1] if self.out else None
+
+    def run(self):
+        src, n = self.src, len(self.src)
+        while self.i < n:
+            c = src[self.i]
+            if c == "\n":
+                self.line += 1
+                self.i += 1
+                continue
+            if c in " \t\r":
+                self.i += 1
+                continue
+            if src.startswith("//", self.i):
+                j = src.find("\n", self.i)
+                self.i = n if j < 0 else j
+                continue
+            if src.startswith("/*", self.i):
+                j = src.find("*/", self.i)
+                if j < 0:
+                    self.error("unterminated block comment")
+                self.line += src.count("\n", self.i, j)
+                self.i = j + 2
+                continue
+            if c in "'\"":
+                self.out.append(self.string(c))
+                continue
+            if c == "`":
+                self.out.append(self.template())
+                continue
+            if c == "/" and self.regex_allowed():
+                self.out.append(self.regex())
+                continue
+            m = _NUM.match(src, self.i)
+            if m and (c.isdigit() or (c == "." and self.i + 1 < n
+                                      and src[self.i + 1].isdigit())):
+                text = m.group(0)
+                self.i = m.end()
+                value = (int(text, 16) if text[:2] in ("0x", "0X")
+                         else float(text))
+                self.out.append(Token("num", float(value), self.line))
+                continue
+            if _ID_START.match(c):
+                m = _ID.match(src, self.i + 1)
+                word = c + m.group(0)
+                self.i = m.end()
+                kind = "kw" if word in KEYWORDS else "id"
+                self.out.append(Token(kind, word, self.line))
+                continue
+            for p in PUNCT:
+                if src.startswith(p, self.i):
+                    self.i += len(p)
+                    self.out.append(Token("punct", p, self.line))
+                    break
+            else:
+                self.error(f"unexpected character {c!r}")
+        self.out.append(Token("eof", None, self.line))
+        return self.out
+
+    def regex_allowed(self):
+        prev = self.prev_significant()
+        if prev is None:
+            return True
+        if prev.kind in ("num", "str", "regex", "template", "id"):
+            return False
+        key = prev.value
+        return key in _REGEX_PRECEDERS
+
+    def string(self, quote):
+        src, n = self.src, len(self.src)
+        i = self.i + 1
+        buf = []
+        while i < n:
+            c = src[i]
+            if c == quote:
+                self.i = i + 1
+                return Token("str", "".join(buf), self.line)
+            if c == "\n":
+                self.error("unterminated string")
+            if c == "\\":
+                c2, skip = self.escape(i)
+                buf.append(c2)
+                i += skip
+                continue
+            buf.append(c)
+            i += 1
+        self.error("unterminated string")
+
+    def escape(self, i):
+        """Handle backslash escape at src[i]; returns (text, consumed)."""
+        src = self.src
+        c = src[i + 1] if i + 1 < len(src) else ""
+        simple = {"n": "\n", "t": "\t", "r": "\r", "b": "\b",
+                  "f": "\f", "v": "\v", "0": "\0", "\n": ""}
+        if c in simple:
+            return simple[c], 2
+        if c == "u":
+            if src[i + 2:i + 3] == "{":
+                j = src.find("}", i + 3)
+                return chr(int(src[i + 3:j], 16)), j - i + 1
+            return chr(int(src[i + 2:i + 6], 16)), 6
+        if c == "x":
+            return chr(int(src[i + 2:i + 4], 16)), 4
+        return c, 2
+
+    def template(self):
+        """`…${expr}…` → Token('template', None, parts=[(cooked,
+        tokens|None), …]); expression segments are lexed recursively."""
+        src, n = self.src, len(self.src)
+        line0 = self.line
+        i = self.i + 1
+        parts = []
+        buf = []
+        while i < n:
+            c = src[i]
+            if c == "`":
+                parts.append(("".join(buf), None))
+                self.i = i + 1
+                return Token("template", None, line0, parts)
+            if c == "\\":
+                text, skip = self.escape(i)
+                buf.append(text)
+                i += skip
+                continue
+            if c == "$" and src[i + 1:i + 2] == "{":
+                parts.append(("".join(buf), None))
+                buf = []
+                depth = 1
+                j = i + 2
+                while j < n and depth:
+                    if src[j] == "{":
+                        depth += 1
+                    elif src[j] == "}":
+                        depth -= 1
+                    elif src[j] in "'\"`":
+                        q = src[j]
+                        j += 1
+                        while j < n and src[j] != q:
+                            j += 2 if src[j] == "\\" else 1
+                    j += 1
+                sub = _Lexer(src[i + 2:j - 1], self.line)
+                parts.append((None, sub.run()))
+                self.line += src.count("\n", i, j)
+                i = j
+                continue
+            if c == "\n":
+                self.line += 1
+            buf.append(c)
+            i += 1
+        self.error("unterminated template literal")
+
+    def regex(self):
+        src, n = self.src, len(self.src)
+        i = self.i + 1
+        in_class = False
+        buf = []
+        while i < n:
+            c = src[i]
+            if c == "\\":
+                buf.append(src[i:i + 2])
+                i += 2
+                continue
+            if c == "[":
+                in_class = True
+            elif c == "]":
+                in_class = False
+            elif c == "/" and not in_class:
+                flags_m = _ID.match(src, i + 1)
+                flags = flags_m.group(0)
+                self.i = i + 1 + len(flags)
+                return Token("regex", ("".join(buf), flags), self.line)
+            elif c == "\n":
+                self.error("unterminated regex")
+            buf.append(c)
+            i += 1
+        self.error("unterminated regex")

@@ -1,0 +1,566 @@
+"""jsmini parser: token stream → AST (plain tuples).
+
+AST nodes are ("type", ...) tuples — cheap to build, trivial to walk.
+Only the surface the shipped lib modules use is implemented; anything
+else raises ParseError with a line number so unsupported syntax is
+loud, never silently mis-executed."""
+
+from .lexer import Token, tokenize
+
+
+class ParseError(SyntaxError):
+    pass
+
+
+def parse_module(src):
+    return Parser(tokenize(src)).module()
+
+
+# Binary operator precedence (higher binds tighter).
+BINOPS = {
+    "??": 1, "||": 2, "&&": 3,
+    "|": 4, "^": 5, "&": 6,
+    "==": 7, "!=": 7, "===": 7, "!==": 7,
+    "<": 8, ">": 8, "<=": 8, ">=": 8, "in": 8, "instanceof": 8,
+    "<<": 9, ">>": 9,
+    "+": 10, "-": 10,
+    "*": 11, "/": 11, "%": 11,
+    "**": 12,
+}
+
+ASSIGN_OPS = {"=", "+=", "-=", "*=", "/=", "%=", "&&=", "||=", "??="}
+
+
+class Parser:
+    def __init__(self, tokens):
+        self.toks = tokens
+        self.pos = 0
+
+    # ------------------------------------------------------- plumbing
+    def peek(self, ahead=0):
+        return self.toks[min(self.pos + ahead, len(self.toks) - 1)]
+
+    def next(self):
+        tok = self.toks[self.pos]
+        self.pos += 1
+        return tok
+
+    def at(self, value, kind=None):
+        tok = self.peek()
+        if kind and tok.kind != kind:
+            return False
+        return tok.value == value and tok.kind in (kind or "punct",
+                                                   "punct", "kw")
+
+    def eat(self, value):
+        if self.at(value):
+            return self.next()
+        return None
+
+    def expect(self, value):
+        tok = self.next()
+        if tok.value != value:
+            raise ParseError(
+                f"line {tok.line}: expected {value!r}, got {tok.value!r}")
+        return tok
+
+    def semi(self):
+        self.eat(";")
+
+    # -------------------------------------------------------- module
+    def module(self):
+        body = []
+        while self.peek().kind != "eof":
+            body.append(self.statement())
+        return ("module", body)
+
+    # ---------------------------------------------------- statements
+    def statement(self):
+        tok = self.peek()
+        if tok.kind == "kw":
+            handler = getattr(self, "st_" + tok.value, None)
+            if handler:
+                return handler()
+        if tok.value == "{" and tok.kind == "punct":
+            return self.block()
+        expr = self.expression()
+        self.semi()
+        return ("expr", expr)
+
+    def block(self):
+        self.expect("{")
+        body = []
+        while not self.at("}"):
+            body.append(self.statement())
+        self.expect("}")
+        return ("block", body)
+
+    def st_export(self):
+        self.next()
+        if self.eat("{"):
+            names = []
+            while not self.at("}"):
+                names.append(self.next().value)
+                if not self.eat(","):
+                    break
+            self.expect("}")
+            self.semi()
+            return ("export_names", names)
+        decl = self.statement()
+        return ("export", decl)
+
+    def st_import(self):
+        line = self.next().line
+        names = []
+        if self.eat("{"):
+            while not self.at("}"):
+                name = self.next().value
+                alias = name
+                if self.eat("as"):
+                    alias = self.next().value
+                names.append((name, alias))
+                if not self.eat(","):
+                    break
+            self.expect("}")
+        self.expect("from")
+        path = self.next().value
+        self.semi()
+        return ("import", names, path, line)
+
+    def st_const(self):
+        return self.declaration("const")
+
+    def st_let(self):
+        return self.declaration("let")
+
+    def st_var(self):
+        return self.declaration("var")
+
+    def declaration(self, kind):
+        self.next()
+        decls = []
+        while True:
+            target = self.binding_target()
+            init = None
+            if self.eat("="):
+                init = self.assignment()
+            decls.append((target, init))
+            if not self.eat(","):
+                break
+        self.semi()
+        return ("decl", kind, decls)
+
+    def binding_target(self):
+        if self.at("["):
+            self.next()
+            names = []
+            while not self.at("]"):
+                if self.eat(","):
+                    names.append(None)
+                    continue
+                names.append(self.binding_target())
+                if not self.at("]"):
+                    self.expect(",")
+            self.expect("]")
+            return ("arr_pat", names)
+        if self.at("{"):
+            self.next()
+            props = []
+            while not self.at("}"):
+                name = self.next().value
+                alias = name
+                default = None
+                if self.eat(":"):
+                    alias = self.next().value
+                if self.eat("="):
+                    default = self.assignment()
+                props.append((name, alias, default))
+                if not self.eat(","):
+                    break
+            self.expect("}")
+            return ("obj_pat", props)
+        tok = self.next()
+        if tok.kind not in ("id", "kw"):
+            raise ParseError(f"line {tok.line}: bad binding target "
+                             f"{tok.value!r}")
+        return ("name", tok.value)
+
+    def st_function(self):
+        self.next()
+        name = self.next().value
+        params = self.params()
+        body = self.block()
+        return ("funcdecl", name, params, body)
+
+    def params(self):
+        self.expect("(")
+        params = []
+        while not self.at(")"):
+            if self.eat("..."):
+                params.append(("rest", self.next().value))
+            else:
+                target = self.binding_target()
+                default = None
+                if self.eat("="):
+                    default = self.assignment()
+                params.append(("param", target, default))
+            if not self.at(")"):
+                self.expect(",")
+        self.expect(")")
+        return params
+
+    def st_class(self):
+        self.next()
+        name = self.next().value
+        parent = None
+        if self.eat("extends"):
+            parent = self.unary_postfix()
+        self.expect("{")
+        methods = []
+        while not self.at("}"):
+            if self.eat(";"):
+                continue
+            static = bool(self.eat("static"))
+            mname = self.next().value
+            params = self.params()
+            body = self.block()
+            methods.append((static, mname, params, body))
+        self.expect("}")
+        return ("classdecl", name, parent, methods)
+
+    def st_return(self):
+        line = self.next().line
+        if self.at(";") or self.at("}") or self.peek().line != line:
+            self.semi()
+            return ("return", None)
+        expr = self.expression()
+        self.semi()
+        return ("return", expr)
+
+    def st_if(self):
+        self.next()
+        self.expect("(")
+        cond = self.expression()
+        self.expect(")")
+        then = self.statement()
+        other = None
+        if self.eat("else"):
+            other = self.statement()
+        return ("if", cond, then, other)
+
+    def st_while(self):
+        self.next()
+        self.expect("(")
+        cond = self.expression()
+        self.expect(")")
+        return ("while", cond, self.statement())
+
+    def st_do(self):
+        self.next()
+        body = self.statement()
+        self.expect("while")
+        self.expect("(")
+        cond = self.expression()
+        self.expect(")")
+        self.semi()
+        return ("dowhile", cond, body)
+
+    def st_for(self):
+        self.next()
+        self.expect("(")
+        init = None
+        if not self.at(";"):
+            if self.peek().value in ("const", "let", "var") \
+                    and self.peek().kind == "kw":
+                kind = self.next().value
+                target = self.binding_target()
+                nxt = self.peek()
+                if nxt.value in ("of", "in") and nxt.kind == "kw":
+                    mode = self.next().value
+                    seq = self.expression()
+                    self.expect(")")
+                    return ("for_" + mode, kind, target, seq,
+                            self.statement())
+                init_decls = [(target,
+                               self.assignment() if self.eat("=")
+                               else None)]
+                while self.eat(","):
+                    t2 = self.binding_target()
+                    init_decls.append(
+                        (t2, self.assignment() if self.eat("=")
+                         else None))
+                init = ("decl", kind, init_decls)
+            else:
+                init = ("expr", self.expression())
+        self.expect(";")
+        cond = None if self.at(";") else self.expression()
+        self.expect(";")
+        step = None if self.at(")") else self.expression()
+        self.expect(")")
+        return ("for", init, cond, step, self.statement())
+
+    def st_break(self):
+        self.next()
+        self.semi()
+        return ("break",)
+
+    def st_continue(self):
+        self.next()
+        self.semi()
+        return ("continue",)
+
+    def st_throw(self):
+        self.next()
+        expr = self.expression()
+        self.semi()
+        return ("throw", expr)
+
+    def st_try(self):
+        self.next()
+        body = self.block()
+        param = None
+        catch = None
+        final = None
+        if self.eat("catch"):
+            if self.eat("("):
+                param = self.next().value
+                self.expect(")")
+            catch = self.block()
+        if self.eat("finally"):
+            final = self.block()
+        return ("try", body, param, catch, final)
+
+    # --------------------------------------------------- expressions
+    def expression(self):
+        expr = self.assignment()
+        while self.at(","):
+            self.next()
+            expr = ("seq", expr, self.assignment())
+        return expr
+
+    def assignment(self):
+        if self.is_arrow_ahead():
+            return self.arrow()
+        left = self.ternary()
+        tok = self.peek()
+        if tok.kind == "punct" and tok.value in ASSIGN_OPS:
+            self.next()
+            right = self.assignment()
+            return ("assign", tok.value, left, right)
+        return left
+
+    def is_arrow_ahead(self):
+        tok = self.peek()
+        if tok.kind == "id" and self.peek(1).value == "=>":
+            return True
+        if tok.value != "(" or tok.kind != "punct":
+            return False
+        depth = 0
+        k = self.pos
+        while k < len(self.toks):
+            v = self.toks[k].value
+            if v == "(":
+                depth += 1
+            elif v == ")":
+                depth -= 1
+                if depth == 0:
+                    return self.toks[k + 1].value == "=>"
+            elif v in ("{", "}") and depth == 1:
+                return False
+            k += 1
+        return False
+
+    def arrow(self):
+        if self.peek().kind == "id":
+            params = [("param", ("name", self.next().value), None)]
+        else:
+            params = self.params()
+        self.expect("=>")
+        if self.at("{"):
+            body = self.block()
+            return ("arrow", params, body, False)
+        return ("arrow", params, self.assignment(), True)
+
+    def ternary(self):
+        cond = self.binary(0)
+        if self.eat("?"):
+            then = self.assignment()
+            self.expect(":")
+            other = self.assignment()
+            return ("cond", cond, then, other)
+        return cond
+
+    def binary(self, min_prec):
+        left = self.unary()
+        while True:
+            tok = self.peek()
+            op = tok.value
+            if tok.kind == "kw" and op not in ("in", "instanceof"):
+                return left
+            prec = BINOPS.get(op)
+            if prec is None or prec < min_prec:
+                return left
+            self.next()
+            right = self.binary(prec + 1)
+            left = ("bin", op, left, right)
+
+    def unary(self):
+        tok = self.peek()
+        if tok.kind == "punct" and tok.value in ("!", "-", "+", "~"):
+            self.next()
+            return ("unary", tok.value, self.unary())
+        if tok.kind == "kw" and tok.value in ("typeof", "void",
+                                              "delete"):
+            self.next()
+            return ("unary", tok.value, self.unary())
+        if tok.kind == "punct" and tok.value in ("++", "--"):
+            self.next()
+            return ("update", tok.value, self.unary(), True)
+        return self.unary_postfix()
+
+    def unary_postfix(self):
+        expr = self.call_member(self.primary())
+        tok = self.peek()
+        if tok.kind == "punct" and tok.value in ("++", "--"):
+            self.next()
+            return ("update", tok.value, expr, False)
+        return expr
+
+    def call_member(self, expr):
+        while True:
+            if self.at("."):
+                self.next()
+                expr = ("member", expr, self.next().value)
+            elif self.at("?."):
+                self.next()
+                expr = ("optmember", expr, self.next().value)
+            elif self.at("["):
+                self.next()
+                idx = self.expression()
+                self.expect("]")
+                expr = ("index", expr, idx)
+            elif self.at("("):
+                expr = ("call", expr, self.args())
+            else:
+                return expr
+
+    def args(self):
+        self.expect("(")
+        out = []
+        while not self.at(")"):
+            if self.eat("..."):
+                out.append(("spread", self.assignment()))
+            else:
+                out.append(("arg", self.assignment()))
+            if not self.at(")"):
+                self.expect(",")
+        self.expect(")")
+        return out
+
+    def primary(self):
+        tok = self.next()
+        if tok.kind == "num":
+            return ("num", tok.value)
+        if tok.kind == "str":
+            return ("str", tok.value)
+        if tok.kind == "regex":
+            return ("regex", tok.value[0], tok.value[1])
+        if tok.kind == "template":
+            parts = []
+            for cooked, sub in tok.parts:
+                if sub is None:
+                    parts.append(("cooked", cooked))
+                else:
+                    parts.append(("expr", Parser(sub).expression()))
+            return ("template", parts)
+        if tok.kind == "id":
+            return ("name", tok.value)
+        if tok.kind == "kw":
+            if tok.value == "true":
+                return ("bool", True)
+            if tok.value == "false":
+                return ("bool", False)
+            if tok.value == "null":
+                return ("null",)
+            if tok.value == "undefined":
+                return ("undefined",)
+            if tok.value == "this":
+                return ("this",)
+            if tok.value == "super":
+                return ("super",)
+            if tok.value == "new":
+                callee = self.call_member_no_call(self.primary())
+                args = self.args() if self.at("(") else []
+                return ("new", callee, args)
+            if tok.value == "function":
+                name = None
+                if self.peek().kind == "id":
+                    name = self.next().value
+                params = self.params()
+                body = self.block()
+                return ("funcexpr", name, params, body)
+            if tok.value in ("of", "in", "get", "set", "as", "from",
+                            "static"):
+                return ("name", tok.value)   # contextual keywords
+        if tok.value == "(" and tok.kind == "punct":
+            expr = self.expression()
+            self.expect(")")
+            return expr
+        if tok.value == "[" and tok.kind == "punct":
+            items = []
+            while not self.at("]"):
+                if self.eat("..."):
+                    items.append(("spread", self.assignment()))
+                else:
+                    items.append(("item", self.assignment()))
+                if not self.at("]"):
+                    self.expect(",")
+            self.expect("]")
+            return ("array", items)
+        if tok.value == "{" and tok.kind == "punct":
+            props = []
+            while not self.at("}"):
+                if self.eat("..."):
+                    props.append(("spread", self.assignment()))
+                elif self.at("["):
+                    self.next()
+                    key = self.assignment()
+                    self.expect("]")
+                    self.expect(":")
+                    props.append(("computed", key, self.assignment()))
+                else:
+                    ktok = self.next()
+                    key = ktok.value if ktok.kind in ("id", "kw", "str") \
+                        else (str(int(ktok.value))
+                              if float(ktok.value).is_integer()
+                              else str(ktok.value))
+                    if self.at("("):
+                        params = self.params()
+                        body = self.block()
+                        props.append(
+                            ("prop", key, ("funcexpr", key, params,
+                                           body)))
+                    elif self.at(":"):
+                        self.next()
+                        props.append(("prop", key, self.assignment()))
+                    else:
+                        props.append(("prop", key, ("name", key)))
+                if not self.at("}"):
+                    self.expect(",")
+            self.expect("}")
+            return ("object", props)
+        raise ParseError(
+            f"line {tok.line}: unexpected token {tok.value!r}")
+
+    def call_member_no_call(self, expr):
+        while True:
+            if self.at("."):
+                self.next()
+                expr = ("member", expr, self.next().value)
+            elif self.at("["):
+                self.next()
+                idx = self.expression()
+                self.expect("]")
+                expr = ("index", expr, idx)
+            else:
+                return expr
