@@ -1,0 +1,71 @@
+"""Dropless vs capacity MoE dispatch on the chip (VERDICT r3 #8
+done-bar: "throughput non-regressing").
+
+Measures a full train step of the flagship-shaped MoE transformer under
+both dispatch modes on one v5e chip (single device: the expert axis is
+1, so this isolates the DISPATCH cost — sort+ragged_dot vs one-hot
+einsums — not the all-to-all, which only exists on expert>1 meshes).
+Chain/drain idioms per BASELINE provenance. Run: python hack/moe_lab.py
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from kubeflow_tpu.compute import train  # noqa: E402
+from kubeflow_tpu.compute.models import transformer  # noqa: E402
+
+STEPS = 20
+
+
+def bench(dropless):
+    cfg = transformer.Config(
+        vocab_size=32000, d_model=1024, n_layers=8, n_heads=8,
+        max_seq=1024, dtype="bfloat16", attention="flash",
+        remat=False, scan_layers=False,
+        moe_experts=8, moe_top_k=2, moe_dropless=dropless,
+        moe_capacity_factor=1.25)
+    opt = train.make_optimizer()
+    mesh = None
+    import numpy as np
+
+    from kubeflow_tpu.compute import mesh as mesh_lib
+    mesh = mesh_lib.make_mesh(devices=jax.devices()[:1])
+    state = train.init_state(
+        lambda k: transformer.init_params(cfg, k), opt, mesh,
+        transformer.logical_axes(cfg), jax.random.PRNGKey(0))
+    step = train.make_train_step(
+        train.plain_loss(transformer.loss_fn, cfg), opt, mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 1024), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens,
+             "targets": jnp.roll(tokens, -1, axis=1)}
+    state, m = step(state, batch)          # compile
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, m = step(state, batch)
+    loss = float(m["loss"])                # drain
+    dt = (time.perf_counter() - t0) / STEPS
+    toks = 8 * 1024 / dt
+    n = transformer.param_count(cfg)
+    print(f"{'dropless' if dropless else 'capacity'}: "
+          f"{dt * 1000:.1f} ms/step, {toks / 1e3:.1f}k tok/s, "
+          f"loss {loss:.3f} ({n / 1e6:.0f}M params incl. experts)")
+    return dt
+
+
+def main():
+    print(f"backend: {jax.default_backend()}")
+    cap = bench(False)
+    drop = bench(True)
+    print(f"dropless/capacity step-time ratio: {cap / drop:.3f}x "
+          f"({'non-regressing' if drop <= cap * 1.02 else 'REGRESSION'})")
+
+
+if __name__ == "__main__":
+    main()

@@ -120,7 +120,7 @@ function volumeRow(initial, pvcs) {
   return {
     element: h("div", {}, typeField.element, nameField.element,
       pickField.element, sizeField.element, mountField.element),
-    validate: () => active().every((f) => f.validate()),
+    validate: () => new FieldGroup(active()).validate(),
     values: () => {
       const v = new FieldGroup(active()).values();
       if (v.pick !== undefined) {

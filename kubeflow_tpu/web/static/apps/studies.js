@@ -334,30 +334,38 @@ async function detailsView(el, params) {
   const trialsTab = (pane) => {
     const maximize =
       ((study.spec.objective || {}).type || "maximize") === "maximize";
-    const pbt = trials.some((t) => t.pbt);
     const chartBox = h("div");
+    const thead = h("thead");
     const tbody = h("tbody");
-    const head = ["", "trial", "state", "objective", "progress"];
-    if (pbt) head.push("gen", "lineage");
-    head.push("parameters", "node");
     pane.append(
       chartBox,
-      h("div.kf-card", {}, h("table.kf-table", {},
-        h("thead", {}, h("tr", {},
-          head.map((c) => h("th", {}, c)))),
-        tbody)));
+      h("div.kf-card", {}, h("table.kf-table", {}, thead, tbody)));
+    let shownPbt = null;
     const render = (trialList, bestNow) => {
+      // pbt is re-derived per poll: a PBT study's first lineage event
+      // may arrive after the tab opened, and must grow the columns
+      const pbt = trialList.some((t) => t.pbt);
+      if (pbt !== shownPbt) {
+        shownPbt = pbt;
+        const head = ["", "trial", "state", "objective", "progress"];
+        if (pbt) head.push("gen", "lineage");
+        head.push("parameters", "node");
+        clear(thead).append(h("tr", {},
+          head.map((c) => h("th", {}, c))));
+      }
       clear(chartBox).append(
         trialChart(trialList, maximize, summary.objective));
       trialRows(tbody, trialList, bestNow, pbt);
     };
     render(trials, best);
-    /* the LIVE half: poll while the tab is open; cleanup on switch */
+    /* the LIVE half: poll while the tab is open; stops on tab switch
+     * (cleanup below) or route change (Poller self-stops when its
+     * root leaves the DOM) */
     const poller = new Poller(async () => {
       const resp = await load();
       const st = (resp.studyjob.status || {});
       render(st.trials || [], st.bestTrial || null);
-    }, 4000);
+    }, 4000, chartBox);
     poller.kick();
     return () => poller.stop();
   };

@@ -463,8 +463,18 @@ export class YamlEditor {
   }
 
   complete() {
-    const { line, prefix } = this.cursorContext();
-    const items = completionsAt(this.value(), line, prefix, this.kind);
+    const { line, col, prefix } = this.cursorContext();
+    const lines = this.value().split("\n");
+    const before = (lines[line] || "").slice(0, col);
+    // decide key-vs-value mode AND compute completions from the same
+    // truncated buffer (current line cut at the cursor), so the two
+    // can never disagree about which side of the colon we're on
+    this.menuMode =
+      /^\s*(?:-\s+)?[A-Za-z0-9_.-]+:\s+\S*$/.test(before)
+        ? "value" : "key";
+    const truncated = [...lines.slice(0, line), before,
+      ...lines.slice(line + 1)].join("\n");
+    const items = completionsAt(truncated, line, prefix, this.kind);
     if (!items.length) {
       this.setStatus(this.kindName()
         ? "no completions here" : "no schema for this document",
@@ -500,8 +510,9 @@ export class YamlEditor {
   accept() {
     const key = this.menuItems[this.menuIndex];
     const start = this.area.selectionStart - this.menuPrefix.length;
-    this.area.setRangeText(key + ": ", start, this.area.selectionStart,
-      "end");
+    this.area.setRangeText(
+      this.menuMode === "value" ? key : key + ": ",
+      start, this.area.selectionStart, "end");
     this.menu.hidden = true;
     this.dirty = true;
     this.refresh();

@@ -151,17 +151,25 @@ export function confirmDialog({ title, body, action, danger }) {
 export class Poller {
   /* Repeated refresh with backoff on errors; pause when the tab is
    * hidden (common-lib poller.service behavior). */
-  constructor(fn, intervalMs) {
+  constructor(fn, intervalMs, root=null) {
     this.fn = fn;
     this.interval = intervalMs || 8000;
+    this.root = root;       // stop automatically once detached
     this.timer = null;
     this.stopped = false;
-    document.addEventListener("visibilitychange", () => {
+    this._onVis = () => {
       if (!document.hidden && !this.stopped) this.kick();
-    });
+    };
+    document.addEventListener("visibilitychange", this._onVis);
   }
 
   async tick() {
+    if (this.root && !this.root.isConnected) {
+      // the view this poller feeds left the DOM (route change without
+      // an explicit cleanup) — self-stop instead of polling a
+      // detached subtree forever and leaking the listener
+      this.stop();
+    }
     if (this.stopped || document.hidden) return;
     let delay = this.interval;
     try {
@@ -180,6 +188,7 @@ export class Poller {
   stop() {
     this.stopped = true;
     clearTimeout(this.timer);
+    document.removeEventListener("visibilitychange", this._onVis);
   }
 }
 

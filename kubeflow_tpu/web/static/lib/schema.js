@@ -6,7 +6,8 @@
  * (the server-side dry-run remains the authority).
  *
  * Schema shape: nested objects; "*" = map with arbitrary keys,
- * "[]" = array item schema; 1 (truthy leaf) = scalar. */
+ * "[]" = array item schema; 1 (truthy leaf) = free scalar; an ARRAY
+ * leaf = enum of allowed scalar values (completed + linted). */
 
 const LABELS = { "*": 1 };
 
@@ -16,7 +17,9 @@ const RESOURCES = {
 };
 
 const CONTAINER = {
-  name: 1, image: 1, imagePullPolicy: 1, workingDir: 1,
+  name: 1, image: 1,
+  imagePullPolicy: ["Always", "IfNotPresent", "Never"],
+  workingDir: 1,
   command: { "[]": 1 },
   args: { "[]": 1 },
   env: { "[]": { name: 1, value: 1, valueFrom: {
@@ -39,7 +42,9 @@ const POD_SPEC = {
     emptyDir: { medium: 1, sizeLimit: 1 },
     configMap: { name: 1 }, secret: { secretName: 1 } } },
   nodeSelector: { "*": 1 },
-  tolerations: { "[]": { key: 1, operator: 1, value: 1, effect: 1 } },
+  tolerations: { "[]": { key: 1,
+    operator: ["Exists", "Equal"], value: 1,
+    effect: ["NoSchedule", "PreferNoSchedule", "NoExecute"] } },
   affinity: { podAntiAffinity: { "*": 1 }, nodeAffinity: { "*": 1 } },
   serviceAccountName: 1, hostname: 1, subdomain: 1,
   imagePullSecrets: { "[]": { name: 1 } },
@@ -60,14 +65,20 @@ export const SCHEMAS = {
   StudyJob: {
     apiVersion: 1, kind: 1, metadata: METADATA,
     spec: {
-      objective: { type: 1, metricName: 1 },
-      algorithm: { name: 1, seed: 1, population: 1,
+      objective: { type: ["maximize", "minimize"], metricName: 1 },
+      algorithm: { name: ["random", "grid", "halton", "tpe", "pbt"],
+                   seed: 1, population: 1,
                    exploitQuantile: 1, resampleProb: 1,
                    checkpointDir: 1 },
-      earlyStopping: { algorithm: 1, startStep: 1,
+      earlyStopping: { algorithm: ["median", "medianstop",
+                                   "hyperband", "asha"],
+                       startStep: 1,
                        minTrialsRequired: 1, minResource: 1, eta: 1 },
-      parameters: { "[]": { name: 1, type: 1, min: 1, max: 1,
-                            steps: 1, scale: 1, values: { "[]": 1 } } },
+      parameters: { "[]": { name: 1,
+                            type: ["double", "int", "categorical"],
+                            min: 1, max: 1, steps: 1,
+                            scale: ["linear", "log"],
+                            values: { "[]": 1 } } },
       trialTemplate: TEMPLATE,
       maxTrialCount: 1, parallelTrialCount: 1, chipsPerTrial: 1,
       accelerator: 1,
@@ -80,8 +91,11 @@ export const SCHEMAS = {
   },
   PersistentVolumeClaim: {
     apiVersion: 1, kind: 1, metadata: METADATA,
-    spec: { accessModes: { "[]": 1 }, storageClassName: 1,
-            resources: RESOURCES, volumeMode: 1 },
+    spec: { accessModes: { "[]": ["ReadWriteOnce", "ReadOnlyMany",
+                                  "ReadWriteMany"] },
+            storageClassName: 1,
+            resources: RESOURCES,
+            volumeMode: ["Filesystem", "Block"] },
   },
   PodDefault: {
     apiVersion: 1, kind: 1, metadata: METADATA,
@@ -121,7 +135,9 @@ export function schemaFor(kindOrText) {
 function descend(schema, path) {
   let node = schema;
   for (const key of path) {
-    if (!node || typeof node !== "object") return null;
+    if (!node || typeof node !== "object" || Array.isArray(node)) {
+      return null;
+    }
     if (key === "[]") node = node["[]"];
     else node = node[key] !== undefined ? node[key] : node["*"];
   }
@@ -137,8 +153,10 @@ export function pathAt(text, lineIdx) {
   const indentOf = (l) => l.length - l.trimStart().length;
   const cur = lines[lineIdx] ?? "";
   let indent = indentOf(cur);
+  let selfDash = false;
   if (cur.trimStart().startsWith("- ") || cur.trim() === "-") {
     indent += 2;        // item contents live one level under the dash
+    selfDash = true;
   }
   const path = [];
   let limit = indent;
@@ -149,6 +167,12 @@ export function pathAt(text, lineIdx) {
     const t = line.trim();
     if (li >= limit) continue;
     if (t.startsWith("- ")) {
+      if (selfDash && li === indent - 2) {
+        // sibling item of the cursor's own dash line: same list level,
+        // contributes no path segment (selfDash appends the one "[]")
+        limit = li;
+        continue;
+      }
       path.unshift("[]");
       const km = /^-\s+([A-Za-z0-9_.-]+):/.exec(t);
       if (km && li + 2 < indent) path.splice(1, 0, km[1]);
@@ -161,6 +185,9 @@ export function pathAt(text, lineIdx) {
       limit = li;
     }
   }
+  // when the cursor line IS a "- item" line, its own keys live inside
+  // the list's item schema
+  if (selfDash) path.push("[]");
   return path;
 }
 
@@ -172,11 +199,23 @@ export function completionsAt(text, lineIdx, prefix, kind) {
   const schema = (kind && SCHEMAS[kind]) || schemaFor(text);
   if (!schema) return [];
   const path = pathAt(text, lineIdx);
-  // inside a list item the keys come from the item schema
-  const node = descend(schema, path);
-  if (!node) return [];
   const lines = text.split("\n");
   const cur = lines[lineIdx] ?? "";
+  // VALUE position ("key: pre|"): complete from the key's enum leaf
+  const vm = /^(\s*)(?:-\s+)?([A-Za-z0-9_.-]+):\s+\S*$/.exec(cur);
+  if (vm) {
+    const parent = descend(schema, path);
+    const leaf = parent ? parent[vm[2]] : null;
+    if (Array.isArray(leaf)) {
+      return leaf
+        .filter((v) => !prefix || String(v).startsWith(prefix))
+        .map(String);
+    }
+    return [];
+  }
+  // KEY position: inside a list item the keys come from the item schema
+  const node = descend(schema, path);
+  if (!node || Array.isArray(node)) return [];
   const myIndent = cur.length - cur.trimStart().length;
   const siblings = new Set();
   for (let i = 0; i < lines.length; i++) {
@@ -204,7 +243,17 @@ export function lint(doc, kind) {
   const out = [];
   if (!schema || !doc || typeof doc !== "object") return out;
   const walk = (node, value, path) => {
-    if (!node || typeof node !== "object") return;
+    if (!node) return;
+    if (Array.isArray(node)) {
+      // enum leaf: scalar values must be one of the allowed set
+      if (value !== null && typeof value !== "object"
+          && !node.includes(value)) {
+        out.push(`${path}: ${JSON.stringify(value)} is not one of `
+          + node.join(", "));
+      }
+      return;
+    }
+    if (typeof node !== "object") return;
     if (Array.isArray(value)) {
       if (node["[]"]) {
         value.forEach((v, i) => walk(node["[]"], v, `${path}[${i}]`));

@@ -266,3 +266,67 @@ class TestReviewRegressionsExecuted:
         comp = to_python(schemajs["completionsAt"](
             text, 1, "", "StudyJob"))
         assert "objective" in comp and "trialTemplate" in comp
+
+
+class TestEnumCompletionExecuted:
+    """Value-level (enum) completion + enum lint — the r4 follow-on
+    rung, executed against the real schema.js."""
+
+    @pytest.fixture(scope="class")
+    def schemajs(self):
+        return load_module(os.path.join(STATIC, "schema.js"))
+
+    def test_value_completion_from_enum(self, schemajs):
+        text = ("kind: StudyJob\nspec:\n  objective:\n"
+                "    type: m\n")
+        comp = to_python(schemajs["completionsAt"](text, 3, "m"))
+        assert comp == ["maximize", "minimize"]
+
+    def test_value_completion_inside_list_item(self, schemajs):
+        text = ("kind: StudyJob\nspec:\n  parameters:\n"
+                "    - type: \n")
+        comp = to_python(schemajs["completionsAt"](text, 3, ""))
+        assert comp == ["double", "int", "categorical"]
+
+    def test_value_position_without_enum_is_empty(self, schemajs):
+        text = "kind: StudyJob\nspec:\n  maxTrialCount: 1\n"
+        assert to_python(schemajs["completionsAt"](text, 2, "1")) == []
+
+    def test_enum_lint_flags_bad_value(self, schemajs):
+        doc = {"kind": "StudyJob",
+               "spec": {"objective": {"type": "maximin"}}}
+        warns = to_python(schemajs["lint"](doc, "StudyJob"))
+        assert warns == [
+            'spec.objective.type: "maximin" is not one of '
+            "maximize, minimize"]
+
+    def test_enum_lint_in_arrays(self, schemajs):
+        doc = {"kind": "PersistentVolumeClaim",
+               "spec": {"accessModes": ["ReadWriteOnce", "RWX"]}}
+        warns = to_python(schemajs["lint"](
+            doc, "PersistentVolumeClaim"))
+        assert len(warns) == 1 and "RWX" in warns[0]
+
+    def test_enum_lint_accepts_valid(self, schemajs):
+        doc = {"kind": "StudyJob",
+               "spec": {"algorithm": {"name": "pbt"},
+                        "objective": {"type": "minimize"}}}
+        assert to_python(schemajs["lint"](doc, "StudyJob")) == []
+
+
+class TestPathAtSecondListItem:
+    """r4 review regression: completions on the SECOND and later list
+    items (sibling dash lines above must not double the '[]' segment)."""
+
+    def test_second_item_key_and_value_completion(self):
+        schemajs = load_module(os.path.join(STATIC, "schema.js"))
+        text = ("kind: StudyJob\nspec:\n  parameters:\n"
+                "    - name: a\n    - type: \n")
+        assert to_python(schemajs["pathAt"](text, 4)) == \
+            ["spec", "parameters", "[]"]
+        comp = to_python(schemajs["completionsAt"](text, 4, ""))
+        assert comp == ["double", "int", "categorical"]
+        text2 = ("kind: StudyJob\nspec:\n  parameters:\n"
+                 "    - name: a\n    - m")
+        comp2 = to_python(schemajs["completionsAt"](text2, 4, "m"))
+        assert comp2 == ["max", "min"]

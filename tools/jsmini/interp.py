@@ -794,9 +794,11 @@ def make_globals(interp):
             "parseFloat": lambda s: _parse_float(s),
             "MAX_SAFE_INTEGER": float(2 ** 53 - 1),
         }),
-        "String": lambda v=UNDEFINED: to_js_string(
+        # callable coercers tolerate the extra (index, array) args
+        # that .map(String) etc. pass along
+        "String": lambda v=UNDEFINED, *_: to_js_string(
             "" if v is UNDEFINED else v),
-        "Boolean": lambda v=UNDEFINED: truthy(v),
+        "Boolean": lambda v=UNDEFINED, *_: truthy(v),
         "parseFloat": lambda s: _parse_float(s),
         "parseInt": lambda s, base=10.0: _parse_int(s, base),
         "isNaN": lambda v: math.isnan(to_number(v)),
@@ -819,7 +821,7 @@ def make_globals(interp):
     }
     num = g["Number"]
 
-    def number_call(v=UNDEFINED):
+    def number_call(v=UNDEFINED, *_):
         return 0.0 if v is UNDEFINED else to_number(v)
     num_callable = _CallableObject(number_call, num)
     g["Number"] = num_callable
