@@ -4,7 +4,8 @@
  * iframe-container; backend routes web/dashboard.py). */
 
 import {
-  api, clear, confirmDialog, h, Poller, Router, snack, YamlEditor,
+  api, clear, confirmDialog, h, panel, Poller, Router, snack,
+  YamlEditor,
 } from "../lib/components.js";
 
 const outlet = document.getElementById("app");
@@ -168,13 +169,13 @@ async function activityFeed(el, info) {
   const ns = (info.namespaces[0] || {}).namespace;
   if (!ns) return;
   const list = h("tbody");
+  const table = h("table.kf-table", {},
+    h("thead", {}, h("tr", {},
+      ["type", "reason", "message", "when"].map(
+        (c) => h("th", {}, c)))),
+    list);
   el.append(h("div.kf-section", {},
-    h("h2", {}, `Recent activity in ${ns}`),
-    h("table.kf-table", {},
-      h("thead", {}, h("tr", {},
-        ["type", "reason", "message", "when"].map(
-          (c) => h("th", {}, c)))),
-      list)));
+    panel(`Recent activity in ${ns}`, table)));
   const poller = new Poller(async () => {
     const events = await api("GET", `api/activities/${ns}`);
     clear(list).append(...events.slice(0, 12).map((e) => h("tr", {},
@@ -186,7 +187,7 @@ async function activityFeed(el, info) {
       list.append(h("tr", {},
         h("td.kf-empty", { colSpan: 4 }, "no recent events")));
     }
-  }, 15000);
+  }, 15000, list);
   poller.kick();
 }
 

@@ -94,6 +94,8 @@ function volumeRow(initial, pvcs) {
     value: initial.name || "",
     checks: [validators.required, validators.dns1123] });
   const pickField = new Field({ id: "pick", label: "Existing PVC",
+    help: "Mounts a claim that already exists in this namespace - "
+      + "created from the Volumes app or a previous notebook.",
     value: initial.name || (pvcs[0] || {}).name || "",
     options: (pvcs.length ? pvcs : [{ name: "" }]).map((p) => ({
       value: p.name,
@@ -177,6 +179,9 @@ async function formView(el) {
    * what the cluster actually has when the scan found any */
   const types = cfg.accelerators.types || [];
   const typeField = new Field({ id: "type", label: "TPU type",
+    help: "Schedules the notebook onto hosts of this slice type via "
+      + "the cloud.google.com/gke-tpu-accelerator node selector; "
+      + "'None' runs CPU-only.",
     options: [{ value: "none", label: "None" },
       ...types.map((t) => ({ value: t.id, label: t.uiName }))] });
   const topoField = new Field({ id: "topology", label: "Topology",

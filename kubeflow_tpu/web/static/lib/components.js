@@ -246,6 +246,34 @@ export function detailsList(pairs) {
        ? "—" : v)]).flat());
 }
 
+/* ------------------------------------------------- popover / help / panel */
+
+export function popover(anchor, content) {
+  /* generic hover/focus popover (common-lib popover/): wraps the
+   * anchor; content shows on hover or keyboard focus. */
+  const tip = h("div.kf-popover", {}, content);
+  const wrap = h("span.kf-popover-anchor", { tabIndex: 0 }, anchor, tip);
+  return wrap;
+}
+
+export function helpPopover(text) {
+  /* the "?" affordance next to a label (common-lib help-popover/) */
+  return popover(h("span.kf-help", {}, "?"),
+    h("div.kf-help-text", {}, text));
+}
+
+export function panel(title, body, { open = true } = {}) {
+  /* collapsible section (common-lib panel/): <details> keeps it
+   * dependency- and JS-state-free. */
+  return h("details.kf-panel", { open },
+    h("summary", {}, title), h("div.kf-panel-body", {}, body));
+}
+
+export function loadingSpinner(label) {
+  return h("div.kf-spinner", {}, h("span.kf-spinner-dot"),
+    label || "loading…");
+}
+
 /* ---------------------------------------------------------- tab panel */
 
 export function tabPanel(tabs) {
@@ -283,10 +311,11 @@ export const validators = {
 };
 
 export class Field {
-  constructor({ id, label, value, type, options, checks, hint }) {
+  constructor({ id, label, value, type, options, checks, hint, help }) {
     this.id = id;
     this.checks = checks || [validators.required];
     this.error = h("div.kf-field-error");
+    this.help = help;
     if (options) {
       this.input = h("select", { id: "f-" + id },
         options.map((o) => h("option", {
@@ -301,7 +330,8 @@ export class Field {
       this.input.addEventListener("input", () => this.validate());
     }
     this.element = h("div.kf-field", {},
-      h("label", { htmlFor: "f-" + id }, label),
+      h("label", { htmlFor: "f-" + id }, label,
+        help ? helpPopover(help) : null),
       this.input,
       hint ? h("div.kf-field-hint", {}, hint) : null,
       this.error);
@@ -385,8 +415,8 @@ export class RowList {
 /* --------------------------------------------------------- yaml editor */
 
 import { dump as yamlDump, parse as yamlParse } from "./yaml.js";
-import { completionsAt, lint as schemaLint,
-         schemaFor } from "./schema.js";
+import { completionsAt, lint as schemaLint, schemaFor,
+         valueContext } from "./schema.js";
 import { highlightYaml } from "./highlight.js";
 
 export { highlightYaml };
@@ -467,11 +497,10 @@ export class YamlEditor {
     const lines = this.value().split("\n");
     const before = (lines[line] || "").slice(0, col);
     // decide key-vs-value mode AND compute completions from the same
-    // truncated buffer (current line cut at the cursor), so the two
-    // can never disagree about which side of the colon we're on
-    this.menuMode =
-      /^\s*(?:-\s+)?[A-Za-z0-9_.-]+:\s+\S*$/.test(before)
-        ? "value" : "key";
+    // truncated buffer (current line cut at the cursor) with the SAME
+    // schema.js helper, so the two cannot disagree about which side
+    // of the colon we're on
+    this.menuMode = valueContext(before) ? "value" : "key";
     const truncated = [...lines.slice(0, line), before,
       ...lines.slice(line + 1)].join("\n");
     const items = completionsAt(truncated, line, prefix, this.kind);

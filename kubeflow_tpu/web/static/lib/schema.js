@@ -191,6 +191,15 @@ export function pathAt(text, lineIdx) {
   return path;
 }
 
+export function valueContext(lineUpToCursor) {
+  /* "key: partial|" → match (with the key in [2]); null in key
+   * position. ONE definition shared by completionsAt and the editor's
+   * menu-mode choice, so inserting "key: " vs a bare value can never
+   * disagree with what was completed. */
+  return /^(\s*)(?:-\s+)?([A-Za-z0-9_.-]+):\s+\S*$/
+    .exec(lineUpToCursor);
+}
+
 export function completionsAt(text, lineIdx, prefix, kind) {
   /* candidate keys for the mapping at lineIdx, minus siblings already
    * present at the same indent, filtered by prefix. ``kind`` (the
@@ -202,7 +211,7 @@ export function completionsAt(text, lineIdx, prefix, kind) {
   const lines = text.split("\n");
   const cur = lines[lineIdx] ?? "";
   // VALUE position ("key: pre|"): complete from the key's enum leaf
-  const vm = /^(\s*)(?:-\s+)?([A-Za-z0-9_.-]+):\s+\S*$/.exec(cur);
+  const vm = valueContext(cur);
   if (vm) {
     const parent = descend(schema, path);
     const leaf = parent ? parent[vm[2]] : null;

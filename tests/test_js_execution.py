@@ -11,7 +11,10 @@ yaml.js now fails THIS suite, not just the browser tier.
 The yaml battery is imported from test_yaml_mirror so the mirror, the
 real JS (here), and the browser run the same cases byte-for-byte; the
 mirror remains as a second implementation for differential testing.
-core.js/components.js stay browser-tier-only (async/await + DOM).
+core.js/components.js also IMPORT under jsmini (async/await runs with
+sync-promise semantics), so their pure exports — the form validators,
+esc() — execute here too; only code that touches the DOM at call time
+stays browser-tier-only.
 """
 
 import os
@@ -224,7 +227,31 @@ class TestJsminiEngine:
         from jsmini import JSMiniError
         from jsmini.parser import ParseError
         with pytest.raises((JSMiniError, ParseError, SyntaxError)):
-            self.run("export async function f() { await g(); }")
+            self.run("export function* gen() { yield 1; }")
+
+    def test_async_await_sync_promise_semantics(self):
+        mod = self.run("""
+            async function inner(x) { return x * 2; }
+            export async function outer() {
+              const a = await inner(21);
+              const b = await Promise.resolve(1);
+              return a + b;
+            }
+            export const chained = [];
+            inner(5).then((v) => chained.push(v)).then(
+              () => chained.push("done"));
+            let caught = null;
+            async function boom() { throw new Error("nope"); }
+            boom().catch((e) => { caught = e.message; });
+            export function getCaught() { return caught; }
+        """)
+        # NOTE: jsmini exports are value snapshots, not ES live
+        # bindings — rebound `let` exports need a getter
+        from jsmini.interp import UNDEFINED, call_value
+        out = call_value(mod["outer"].js_function, UNDEFINED, [])
+        assert to_python(out.value) == 43
+        assert to_python(mod["chained"]) == [10, "done"]
+        assert to_python(mod["getCaught"]()) == "nope"
 
 
 class TestHighlightJsExecuted:
@@ -330,3 +357,109 @@ class TestPathAtSecondListItem:
                  "    - name: a\n    - m")
         comp2 = to_python(schemajs["completionsAt"](text2, 4, "m"))
         assert comp2 == ["max", "min"]
+
+
+class TestFormLogicExecuted:
+    """components.js/core.js import under jsmini; the form validators
+    and esc() — the logic every submit path runs — execute for real."""
+
+    @pytest.fixture(scope="class")
+    def comps(self):
+        return load_module(os.path.join(STATIC, "components.js"))
+
+    def _check(self, comps, name, value):
+        from jsmini.interp import UNDEFINED, call_value, get_member
+        fn = get_member(comps["validators"], name)
+        return to_python(call_value(fn, UNDEFINED, [value]))
+
+    def test_required(self, comps):
+        assert self._check(comps, "required", "") == "required"
+        assert self._check(comps, "required", "x") == ""
+
+    def test_dns1123(self, comps):
+        assert self._check(comps, "dns1123", "my-notebook-2") == ""
+        for bad in ("My-NB", "nb_x", "-nb", "nb-", ""):
+            assert self._check(comps, "dns1123", bad) != "", bad
+
+    def test_quantity(self, comps):
+        for ok in ("0.5", "500m", "1Gi", "16", "2Ti", "100Ki"):
+            assert self._check(comps, "quantity", ok) == "", ok
+        for bad in ("abc", "1GB", "-1", "1 Gi"):
+            assert self._check(comps, "quantity", bad) != "", bad
+
+    def test_esc_blocks_html_injection(self):
+        core = load_module(os.path.join(STATIC, "core.js"))
+        out = to_python(core["esc"]('<img onerror="x">&\'y\''))
+        assert "<" not in out and '"' not in out
+        assert out.startswith("&lt;img")
+
+    def test_components_exports_cover_shared_lib_surface(self, comps):
+        for name in ("ResourceTable", "YamlEditor", "Field",
+                     "FieldGroup", "RowList", "conditionsTable",
+                     "detailsList", "popover", "helpPopover", "panel",
+                     "loadingSpinner", "age", "duration",
+                     "formatTimestamp", "highlightYaml", "statusIcon",
+                     "eventsTable", "tabPanel", "validators"):
+            assert name in comps, name
+
+
+class TestPromiseSemanticsRegressions:
+    """r4 review findings on JSPromise, pinned: rejection is a flag
+    (reject(null) stays rejected), throwing handlers reject the derived
+    promise, Promise.all rejects on the first rejected member, and a
+    rest element must be last in an array pattern."""
+
+    def run(self, src):
+        import tempfile
+        with tempfile.NamedTemporaryFile("w", suffix=".js",
+                                         delete=False) as f:
+            f.write(src)
+        try:
+            return load_module(f.name, use_cache=False)
+        finally:
+            os.unlink(f.name)
+
+    def test_reject_null_stays_rejected(self):
+        mod = self.run("""
+            let seen = "unset";
+            Promise.reject(null).catch((e) => { seen = e; });
+            export function result() { return seen; }
+        """)
+        assert to_python(mod["result"]()) is None   # handler DID run
+
+    def test_throwing_then_handler_routes_to_catch(self):
+        mod = self.run("""
+            let msg = "unset";
+            Promise.resolve(1)
+              .then(() => { throw new Error("boom"); })
+              .catch((e) => { msg = e.message; });
+            export function result() { return msg; }
+        """)
+        assert to_python(mod["result"]()) == "boom"
+
+    def test_catch_returning_promise_is_adopted(self):
+        mod = self.run("""
+            async function fallback() { return 7; }
+            let v = null;
+            Promise.reject(new Error("x"))
+              .catch(() => fallback())
+              .then((x) => { v = x; });
+            export function result() { return v; }
+        """)
+        assert to_python(mod["result"]()) == 7
+
+    def test_promise_all_rejects_on_member_rejection(self):
+        mod = self.run("""
+            let err = null, val = null;
+            Promise.all([Promise.resolve(1),
+                         Promise.reject(new Error("dead"))])
+              .then((v) => { val = v; })
+              .catch((e) => { err = e.message; });
+            export function result() { return [err, val]; }
+        """)
+        assert to_python(mod["result"]()) == ["dead", None]
+
+    def test_rest_must_be_last_in_array_pattern(self):
+        from jsmini.parser import ParseError
+        with pytest.raises((ParseError, SyntaxError)):
+            self.run("const [...a, b] = [1, 2, 3];")

@@ -161,9 +161,68 @@ def date_parse(s):
         return math.nan
 
 
+class JSPromise:
+    """Synchronous promise: jsmini's event loop is 'everything settles
+    immediately' — right for a test harness whose fetch/timers are
+    synchronous shims. await unwraps; a rejected promise re-raises at
+    the await (or routes to .catch). Rejection is a FLAG, not an
+    error-is-None check — `Promise.reject(null)` must stay rejected."""
+
+    def __init__(self, value=None, error=None, rejected=False):
+        self.value = value
+        self.error = error          # the rejection reason (any JS value)
+        self.rejected = rejected or error is not None
+
+    @staticmethod
+    def _run(handler, arg):
+        """Call a then/catch handler with real-JS settling: a thrown
+        error rejects the derived promise; a returned promise is
+        adopted (never double-wrapped)."""
+        try:
+            out = call_value(handler, UNDEFINED, [arg])
+        except JSThrow as e:
+            return JSPromise(error=e.value, rejected=True)
+        return out if isinstance(out, JSPromise) else JSPromise(out)
+
+    def then(self, on_ok=None, on_err=None):
+        if self.rejected:
+            if on_err not in (None, UNDEFINED):
+                return self._run(on_err, self.error)
+            return self
+        if on_ok in (None, UNDEFINED):
+            return self
+        return self._run(on_ok, self.value)
+
+    def catch(self, on_err):
+        return self.then(None, on_err)
+
+    def finally_(self, fn):
+        try:
+            call_value(fn, UNDEFINED, [])
+        except JSThrow as e:
+            return JSPromise(error=e.value, rejected=True)
+        return self
+
+
+def promise_resolve(v=UNDEFINED):
+    return v if isinstance(v, JSPromise) else JSPromise(v)
+
+
+def promise_all(arr):
+    out = JSArray()
+    for x in arr:
+        if isinstance(x, JSPromise):
+            if x.rejected:
+                return JSPromise(error=x.error, rejected=True)
+            out.append(x.value)
+        else:
+            out.append(x)
+    return JSPromise(out)
+
+
 class JSFunction:
     def __init__(self, name, params, body, env, interp, is_expr_body,
-                 this=None):
+                 this=None, is_async=False):
         self.name = name or ""
         self.params = params
         self.body = body
@@ -171,8 +230,17 @@ class JSFunction:
         self.interp = interp
         self.is_expr_body = is_expr_body
         self.this = this          # bound `this` (arrow fns capture)
+        self.is_async = is_async
 
     def call(self, this, args):
+        if self.is_async:
+            try:
+                return promise_resolve(self._invoke(this, args))
+            except JSThrow as e:
+                return JSPromise(error=e.value, rejected=True)
+        return self._invoke(this, args)
+
+    def _invoke(self, this, args):
         env = Env(self.env)
         interp = self.interp
         i = 0
@@ -662,6 +730,14 @@ def get_member(obj, name, interp=None):
         if m is not None:
             return m
         return UNDEFINED
+    if isinstance(obj, JSPromise):
+        if name == "then":
+            return obj.then
+        if name == "catch":
+            return obj.catch
+        if name == "finally":
+            return obj.finally_
+        return UNDEFINED
     if isinstance(obj, JSClass):
         if name in obj.statics:
             return _bind_method(obj.statics[name], obj)
@@ -818,6 +894,12 @@ def make_globals(interp):
         }),
         "undefined": UNDEFINED,
         "globalThis": UNDEFINED,
+        "Promise": JSObject({
+            "resolve": promise_resolve,
+            "reject": lambda v=UNDEFINED: JSPromise(error=v,
+                                                    rejected=True),
+            "all": promise_all,
+        }),
     }
     num = g["Number"]
 
@@ -917,12 +999,13 @@ class Interpreter:
 
     def hoist(self, st, env):
         if st[0] == "funcdecl":
-            env.declare(st[1], self.make_function(st[1], st[2], st[3],
-                                                  env))
+            env.declare(st[1], self.make_function(
+                st[1], st[2], st[3], env, len(st) > 4 and st[4]))
         elif st[0] == "export" and st[1][0] == "funcdecl":
             inner = st[1]
             env.declare(inner[1], self.make_function(
-                inner[1], inner[2], inner[3], env))
+                inner[1], inner[2], inner[3], env,
+                len(inner) > 4 and inner[4]))
 
     def exec_stmt(self, st, env, exports=None, module_dir=None):
         kind = st[0]
@@ -955,7 +1038,8 @@ class Interpreter:
         for st in block[1]:
             if st[0] == "funcdecl":
                 scope.declare(st[1], self.make_function(
-                    st[1], st[2], st[3], scope))
+                    st[1], st[2], st[3], scope,
+                    len(st) > 4 and st[4]))
         for st in block[1]:
             self.exec(st, scope)
 
@@ -979,8 +1063,8 @@ class Interpreter:
 
     def x_funcdecl(self, st, env):
         if st[1] not in env.vars:
-            env.declare(st[1], self.make_function(st[1], st[2], st[3],
-                                                  env))
+            env.declare(st[1], self.make_function(
+                st[1], st[2], st[3], env, len(st) > 4 and st[4]))
 
     def x_classdecl(self, st, env):
         _, name, parent_expr, methods = st
@@ -989,8 +1073,9 @@ class Interpreter:
             parent = self.eval(parent_expr, env)
         ms, statics = {}, {}
         cls = JSClass(name, parent, ms, statics)
-        for static, mname, params, body in methods:
-            fn = self.make_function(mname, params, body, env)
+        for static, mname, params, body, *rest in methods:
+            fn = self.make_function(mname, params, body, env,
+                                    bool(rest and rest[0]))
             fn.js_class = cls
             (statics if static else ms)[mname] = fn
         env.declare(name, cls)
@@ -1433,19 +1518,30 @@ class Interpreter:
                                  TYPE_ERROR_CLASS))
 
     def e_arrow(self, node, env):
-        _, params, body, is_expr = node
+        _, params, body, is_expr, *rest = node
         return JSFunction(None, params, body, env, self, is_expr,
-                          this=env.this)
+                          this=env.this, is_async=bool(rest and
+                                                       rest[0]))
 
     def e_funcexpr(self, node, env):
-        _, name, params, body = node
-        return self.make_function(name, params, body, env)
+        _, name, params, body, *rest = node
+        return self.make_function(name, params, body, env,
+                                  bool(rest and rest[0]))
+
+    def e_await(self, node, env):
+        v = self.eval(node[1], env)
+        if isinstance(v, JSPromise):
+            if v.rejected:
+                raise JSThrow(v.error)
+            return v.value
+        return v
 
     def e_super(self, node, env):
         raise JSMiniError("super only supported as super(...) call")
 
-    def make_function(self, name, params, body, env):
-        return JSFunction(name, params, body, env, self, False)
+    def make_function(self, name, params, body, env, is_async=False):
+        return JSFunction(name, params, body, env, self, False,
+                          is_async=is_async)
 
     def bind_pattern(self, target, value, env, declare=False):
         kind = target[0]
@@ -1465,6 +1561,10 @@ class Interpreter:
             for i, sub in enumerate(target[1]):
                 if sub is None:
                     continue
+                if sub[0] == "rest_pat":
+                    self.bind_pattern(sub[1], JSArray(seq[i:]), env,
+                                      declare)
+                    break
                 v = seq[i] if i < len(seq) else UNDEFINED
                 self.bind_pattern(sub, v, env, declare)
             return

@@ -14,9 +14,10 @@ KEYWORDS = {
     "undefined", "class", "extends", "super", "static", "get", "set",
     "try", "catch", "finally", "throw", "switch", "case", "default",
     "import", "export", "from", "as", "void",
-    # recognized so their use fails at PARSE time (no handlers): jsmini
-    # must reject async/generator code loudly, not run it wrong
-    "async", "await", "yield",
+    "async", "await",
+    # recognized so its use fails at PARSE time: generators are out of
+    # scope and must be rejected loudly, not run wrong
+    "yield",
 }
 
 PUNCT = [

@@ -158,6 +158,13 @@ class Parser:
                 if self.eat(","):
                     names.append(None)
                     continue
+                if self.eat("..."):
+                    names.append(("rest_pat", self.binding_target()))
+                    if not self.at("]"):
+                        raise ParseError(
+                            f"line {self.peek().line}: rest element "
+                            f"must be last in array pattern")
+                    break
                 names.append(self.binding_target())
                 if not self.at("]"):
                     self.expect(",")
@@ -192,6 +199,15 @@ class Parser:
         body = self.block()
         return ("funcdecl", name, params, body)
 
+    def st_async(self):
+        # async fn → sync-promise semantics (interp.py JSPromise):
+        # the body runs synchronously, `await` unwraps settled promises
+        self.next()
+        st = self.statement()
+        if st[0] != "funcdecl":
+            raise ParseError("async is only supported on functions")
+        return ("funcdecl", st[1], st[2], st[3], True)
+
     def params(self):
         self.expect("(")
         params = []
@@ -221,10 +237,14 @@ class Parser:
             if self.eat(";"):
                 continue
             static = bool(self.eat("static"))
+            is_async = False
+            if self.at("async", "kw") and self.peek(1).value != "(":
+                self.next()
+                is_async = True
             mname = self.next().value
             params = self.params()
             body = self.block()
-            methods.append((static, mname, params, body))
+            methods.append((static, mname, params, body, is_async))
         self.expect("}")
         return ("classdecl", name, parent, methods)
 
@@ -339,6 +359,18 @@ class Parser:
         return expr
 
     def assignment(self):
+        if self.peek().kind == "kw" and self.peek().value == "async" \
+                and self.peek(1).value != "function":
+            save = self.pos
+            self.next()
+            if self.is_arrow_ahead():
+                arrow = self.arrow()
+                return arrow[:3] + (arrow[3], True)
+            self.pos = save
+        if self.at("async", "kw") and self.peek(1).value == "function":
+            self.next()
+            fn = self.assignment()
+            return fn[:4] + (True,)
         if self.is_arrow_ahead():
             return self.arrow()
         left = self.ternary()
@@ -413,6 +445,9 @@ class Parser:
                                               "delete"):
             self.next()
             return ("unary", tok.value, self.unary())
+        if tok.kind == "kw" and tok.value == "await":
+            self.next()
+            return ("await", self.unary())
         if tok.kind == "punct" and tok.value in ("++", "--"):
             self.next()
             return ("update", tok.value, self.unary(), True)
