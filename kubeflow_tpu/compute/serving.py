@@ -426,6 +426,15 @@ class ModelServer:
                         if not ln:
                             return
                         remaining -= len(ln)
+                        if remaining <= 0 and not ln.endswith(b"\n"):
+                            # the final line has no newline: either a
+                            # legitimate unterminated last record, or an
+                            # understated Content-Length cut it mid-
+                            # record — indistinguishable here, so tag it
+                            # and let the consumer decide by whether it
+                            # parses
+                            yield ("final_noeol", ln)
+                            return
                         if ln.strip():
                             yield ln
 
@@ -499,6 +508,12 @@ class ModelServer:
                         emit_done(pending.popleft())
 
                 for ln in iter_lines(length):
+                    maybe_truncated = False
+                    if isinstance(ln, tuple):  # ("final_noeol", line)
+                        ln = ln[1]
+                        if not ln.strip():
+                            continue
+                        maybe_truncated = True
                     try:
                         req = json.loads(ln)
                         if "tensor" in req:
@@ -511,7 +526,18 @@ class ModelServer:
                                 raise ValueError("scalar instances")
                     except Exception as e:  # noqa: BLE001 — per-line
                         flush_group()
-                        pending.append(("err", f"bad request: {e}"))
+                        if maybe_truncated:
+                            # unparseable final fragment with no
+                            # newline: an understated Content-Length
+                            # cut the record — say so explicitly (one
+                            # error) instead of a confusing per-
+                            # fragment parse failure
+                            pending.append((
+                                "err",
+                                "truncated body: Content-Length ended "
+                                f"mid-line after {len(ln)} bytes"))
+                        else:
+                            pending.append(("err", f"bad request: {e}"))
                         continue
                     if group and (
                             x.shape[1:] != group[0][0].shape[1:]

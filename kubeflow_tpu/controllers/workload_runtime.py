@@ -200,18 +200,37 @@ class PodRuntimeReconciler(Reconciler):
                 return m.name_of(node)
         return None
 
+    def _node_tpu_allocatable(self, node):
+        """Advertised ``google.com/tpu`` capacity of a node, or None when
+        the node carries no inventory (no Node object / no allocatable) —
+        in that case the fake kubelet stays permissive, matching the
+        opt-in scheduling-constraint stance of ``_place``."""
+        obj = self.store.try_get("v1", "Node", node, None)
+        if obj is None:
+            return None
+        alloc = m.deep_get(obj, "status", "allocatable",
+                           "google.com/tpu", default=None)
+        if alloc is None:
+            alloc = m.deep_get(obj, "status", "capacity",
+                               "google.com/tpu", default=None)
+        return None if alloc is None else int(alloc)
+
     def _assign_chips(self, pod, node):
         """Device-plugin half of the fake kubelet: hand the pod its
         ``google.com/tpu`` chips and publish the assignment as the
         ``kubeflow.org/tpu-chips`` pod annotation — the contract the
         TpuSlice reconciler surfaces into trial status (tpuslice.py
-        placement mirror). Chips are the lowest ids free on the node."""
+        placement mirror). Chips are the lowest ids free on the node,
+        capped at the node's advertised allocatable: an oversubscribed
+        pod gets ``(None, False)`` and stays Pending/Unschedulable
+        rather than receiving phantom chip ids, matching real
+        device-plugin behavior. Returns ``(chips_csv_or_None, ok)``."""
         want = 0
         for c in m.deep_get(pod, "spec", "containers", default=[]) or []:
             want += int(m.deep_get(c, "resources", "limits",
                                    "google.com/tpu", default=0) or 0)
         if want <= 0:
-            return None
+            return None, True
         used = set()
         for other in self.store.list("v1", "Pod"):
             if m.uid_of(other) == m.uid_of(pod):
@@ -226,12 +245,31 @@ class PodRuntimeReconciler(Reconciler):
             assigned = m.annotations_of(other).get("kubeflow.org/tpu-chips")
             if assigned:
                 used.update(int(x) for x in assigned.split(",") if x)
+        capacity = self._node_tpu_allocatable(node)
+        if capacity is not None and len(used) + want > capacity:
+            return None, False
         chips, cursor = [], 0
         while len(chips) < want:
             if cursor not in used:
                 chips.append(cursor)
             cursor += 1
-        return ",".join(str(c) for c in chips)
+        return ",".join(str(c) for c in chips), True
+
+    def _mark_unschedulable(self, pod):
+        prior = m.deep_get(pod, "status", "conditions", default=[]) or []
+        prior_sched = next((c for c in prior
+                            if c.get("type") == "PodScheduled"), {})
+        transition = prior_sched.get("lastTransitionTime") \
+            if prior_sched.get("status") == "False" else None
+        status = {
+            "phase": "Pending",
+            "conditions": [{"type": "PodScheduled", "status": "False",
+                            "reason": "Unschedulable",
+                            "lastTransitionTime":
+                                transition or m.now_iso()}]}
+        if status != pod.get("status"):
+            pod["status"] = status
+            self.store.update_status(pod)
 
     def reconcile(self, req):
         pod = self.store.try_get("v1", "Pod", req.name, req.namespace)
@@ -245,24 +283,23 @@ class PodRuntimeReconciler(Reconciler):
             return Result()
         node = self._place(pod)
         if node is None:
-            prior = m.deep_get(pod, "status", "conditions", default=[]) or []
-            prior_sched = next((c for c in prior
-                                if c.get("type") == "PodScheduled"), {})
-            transition = prior_sched.get("lastTransitionTime") \
-                if prior_sched.get("status") == "False" else None
-            status = {
-                "phase": "Pending",
-                "conditions": [{"type": "PodScheduled", "status": "False",
-                                "reason": "Unschedulable",
-                                "lastTransitionTime":
-                                    transition or m.now_iso()}]}
-            if status != pod.get("status"):
-                pod["status"] = status
-                self.store.update_status(pod)
-            return Result()
+            # no matching node YET: a later Node create emits no event
+            # for this pod (only Pods are watched), so liveness needs a
+            # retry tick; rate-limited so never-fitting pods back off
+            # instead of busy-polling
+            self._mark_unschedulable(pod)
+            return Result(requeue=True)
         # bind the pod and hand out its TPU chips before it runs — the
         # scheduler-binding + device-plugin half of the kubelet contract
-        chips = self._assign_chips(pod, node)
+        chips, fits = self._assign_chips(pod, node)
+        if not fits:
+            # node is full: real kubelets reject the admission and the
+            # pod stays Pending until another pod releases its devices.
+            # Same liveness argument as above — device release does not
+            # notify THIS pod — and the same backoff for pods whose
+            # request alone can never fit the node.
+            self._mark_unschedulable(pod)
+            return Result(requeue=True)
         changed = m.deep_get(pod, "spec", "nodeName") != node
         pod["spec"]["nodeName"] = node
         if chips and m.annotations_of(pod).get(

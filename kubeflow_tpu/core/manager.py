@@ -130,12 +130,19 @@ class _Controller:
             log.exception("[%s] reconcile %s failed", self.name, req)
             self.queue.add_rate_limited(req)
             return
+        # controller-runtime ordering: Requeue=true re-adds RATE-LIMITED
+        # without Forget, so successive voluntary requeues back off
+        # exponentially (a pod that can never fit its node settles at
+        # max_delay instead of busy-polling); forget only on clean
+        # completion or an explicit requeue_after tick.
+        if result is not None and result.requeue and not (
+                result.requeue_after and result.requeue_after > 0):
+            self.queue.add_rate_limited(req)
+            return
         self.queue.forget(req)
         if result is not None:
             if result.requeue_after and result.requeue_after > 0:
                 self.queue.add_after(req, result.requeue_after)
-            elif result.requeue:
-                self.queue.add_rate_limited(req)
 
 
 class Manager:

@@ -490,6 +490,33 @@ class TestServingStream:
         finally:
             server.stop()
 
+    def test_stream_truncated_body_one_explicit_error(self):
+        """An understated Content-Length that cuts a line mid-record
+        must produce ONE 'truncated body' error, not a confusing
+        per-fragment parse failure (r4 advisor finding)."""
+        import http.client
+        server, port, _, _ = self._server()
+        try:
+            good = json.dumps(
+                {"instances": np.zeros((1, 16)).tolist()})
+            body = (good + "\n" + good).encode()
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            # lie about the length: cut the second line in half
+            cut = len(good.encode()) + 1 + len(good) // 2
+            conn.putrequest("POST", "/v1/models/m:predictStream")
+            conn.putheader("Content-Type", "application/x-ndjson")
+            conn.putheader("Content-Length", str(cut))
+            conn.endheaders()
+            conn.send(body[:cut])
+            resp = conn.getresponse()
+            out_lines = [json.loads(ln) for ln in
+                         resp.read().decode().strip().split("\n")]
+            assert len(out_lines) == 2
+            assert "predictions" in out_lines[0]
+            assert "truncated body" in out_lines[1]["error"]
+        finally:
+            server.stop()
+
     def test_stream_bad_line_errors_inline_not_fatal(self):
         import http.client
         server, port, _, _ = self._server()

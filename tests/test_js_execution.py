@@ -188,6 +188,21 @@ class TestJsminiEngine:
             raise JSThrow(mod["E"].construct(["x", 7.0], None))
         assert to_python(e.value.value["message"]) == "got x"
 
+    def test_date_members_are_whitelisted_no_python_escape(self):
+        """Member dispatch on Date objects must not fall through to
+        Python attributes (`d.__class__` etc.) — the host surface is an
+        explicit whitelist (r4 advisor finding)."""
+        mod = self.run("""
+            const d = new Date("2026-07-30T00:00:00Z");
+            export const y = d.getFullYear();
+            export const esc = [d.__class__, d.__init__, d._dt, d.ms,
+                                Date.__call__, Date.construct];
+            export const allEscaped = esc.every(
+                (x) => x === undefined);
+        """)
+        assert to_python(mod["y"]) == 2026
+        assert to_python(mod["allEscaped"]) is True
+
     def test_array_destructuring_and_methods(self):
         mod = self.run("""
             const [a, , b] = [1, 2, 3];

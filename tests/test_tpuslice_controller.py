@@ -420,6 +420,77 @@ class TestTrialPlacement:
         assert trial["chips"] == "2"
 
 
+class TestKubeletChipCapacity:
+    """The fake kubelet's device-plugin half must honor the node's
+    advertised ``google.com/tpu`` allocatable: an oversubscribed pod
+    stays Pending/Unschedulable instead of receiving phantom chip ids
+    (r4 advisor finding)."""
+
+    @staticmethod
+    def _pod(name, chips, node="tpu-host-0"):
+        return {"apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"nodeName": node,
+                         "containers": [{"name": "w", "image": "i",
+                                         "resources": {"limits": {
+                                             "google.com/tpu":
+                                                 str(chips)}}}]}}
+
+    def _mgr(self, store, manager):
+        manager.add(PodRuntimeReconciler())
+        manager.start_sync()
+
+    def test_oversubscribed_pod_stays_pending_without_phantom_chips(
+            self, store, manager):
+        self._mgr(store, manager)
+        store.create(builtin.node("tpu-host-0", {"google.com/tpu": "4"}))
+        store.create(self._pod("a", 3))
+        store.create(self._pod("b", 2))
+        manager.run_sync()
+        a = store.get("v1", "Pod", "a", "default")
+        b = store.get("v1", "Pod", "b", "default")
+        assert a["status"]["phase"] == "Running"
+        assert a["metadata"]["annotations"][
+            "kubeflow.org/tpu-chips"] == "0,1,2"
+        # b would need chips 3,4 on a 4-chip node: real device plugins
+        # reject; it must not be handed id 4
+        assert b["status"]["phase"] == "Pending"
+        assert b["status"]["conditions"][0]["reason"] == "Unschedulable"
+        assert "kubeflow.org/tpu-chips" not in (
+            b["metadata"].get("annotations") or {})
+
+    def test_pending_pod_runs_after_capacity_frees(self, store, manager):
+        import time
+        self._mgr(store, manager)
+        store.create(builtin.node("tpu-host-0", {"google.com/tpu": "4"}))
+        store.create(self._pod("a", 3))
+        store.create(self._pod("b", 2))
+        manager.run_sync()
+        a = store.get("v1", "Pod", "a", "default")
+        a["status"]["phase"] = "Succeeded"
+        store.update_status(a)
+        # liveness comes from the Unschedulable requeue tick, NOT from
+        # any event on pod b — nothing touches b here
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            manager.run_sync()
+            b = store.get("v1", "Pod", "b", "default")
+            if b["status"]["phase"] == "Running":
+                break
+            time.sleep(0.05)
+        assert b["status"]["phase"] == "Running"
+        assert b["metadata"]["annotations"][
+            "kubeflow.org/tpu-chips"] == "0,1"
+
+    def test_node_without_inventory_stays_permissive(self, store,
+                                                     manager):
+        self._mgr(store, manager)
+        store.create(self._pod("a", 8, node="fake-node"))
+        manager.run_sync()
+        a = store.get("v1", "Pod", "a", "default")
+        assert a["status"]["phase"] == "Running"
+
+
 class TestTPE:
     """Model-based suggester (Katib TPE service parity, hpo.py): on a
     seeded synthetic objective the model both finds a better optimum

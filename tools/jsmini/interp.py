@@ -144,6 +144,14 @@ class JSDate:
         return float(self._dt().second)
 
 
+# Explicit JS-visible surface: member dispatch must not fall through to
+# arbitrary Python attributes (d.__class__ etc. would escape the sandbox).
+_JSDATE_MEMBERS = frozenset((
+    "getTime", "getFullYear", "getMonth", "getDate",
+    "getHours", "getMinutes", "getSeconds",
+))
+
+
 def date_parse(s):
     if isinstance(s, JSDate):
         return s.ms
@@ -726,9 +734,8 @@ def get_member(obj, name, interp=None):
             return obj.source
         return UNDEFINED
     if isinstance(obj, JSDate):
-        m = getattr(obj, name, None)
-        if m is not None:
-            return m
+        if name in _JSDATE_MEMBERS:
+            return getattr(obj, name)
         return UNDEFINED
     if isinstance(obj, JSPromise):
         if name == "then":
@@ -743,7 +750,9 @@ def get_member(obj, name, interp=None):
             return _bind_method(obj.statics[name], obj)
         return UNDEFINED
     if isinstance(obj, _DateCtor):
-        return getattr(obj, name, UNDEFINED)
+        if name in ("now", "parse"):
+            return getattr(obj, name)
+        return UNDEFINED
     if isinstance(obj, float):
         if name == "toFixed":
             return lambda d=0.0: f"{obj:.{int(d)}f}"
