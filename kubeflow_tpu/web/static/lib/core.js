@@ -102,7 +102,12 @@ export class Router {
           params[n] = decodeURIComponent(m[i + 1]);
         });
         clear(this.outlet);
-        fn(this.outlet, params);
+        const out = fn(this.outlet, params);
+        if (out && out.catch) {
+          // async views: a rejection would otherwise vanish as an
+          // unhandled promise — surface it where the user can see it
+          out.catch((e) => snack(String(e.message || e), "error"));
+        }
         return;
       }
     }

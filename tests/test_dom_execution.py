@@ -1,0 +1,693 @@
+"""Executed DOM tier: the shipped SPA view code (apps/*.js and the DOM
+half of lib/{core,components}.js) runs under jsmini's browser shim
+(tools/jsmini/dom.py) against the REAL backends over the real store.
+
+Reference models (the tier VERDICT r1-r4 asked for): the Karma
+component specs (kubeflow-common-lib resource-table
+table.component.spec.ts — render, sort, actions), the Polymer
+component tests (centraldashboard main-page_test.js), and the Cypress
+page flows (jupyter frontend cypress/e2e/form-page.cy.ts) — here with
+the real REST backends instead of cy.intercept fixtures, so each flow
+executes frontend JS + HTTP contract + backend + controllers together.
+"""
+
+import pytest
+
+from kubeflow_tpu import api
+from kubeflow_tpu.controllers import (admission, notebook as nbctl,
+                                      profile as profctl,
+                                      tensorboard as tbctl,
+                                      workload_runtime)
+from kubeflow_tpu.core import Manager, ObjectStore
+from kubeflow_tpu.web import (dashboard, jupyter, slices, studies,
+                              tensorboards, volumes)
+from tools.jsmini.dom import Page
+from tools.jsmini.interp import UNDEFINED, to_python
+
+ALICE = "alice@example.com"
+
+
+@pytest.fixture()
+def platform(store, manager, clean_env, monkeypatch):
+    monkeypatch.delenv("APP_DISABLE_AUTH", raising=False)
+    monkeypatch.setenv("APP_SECURE_COOKIES", "false")
+    admission.PodDefaultWebhook(store).install()
+    manager.add(profctl.ProfileReconciler())
+    manager.add(nbctl.NotebookReconciler())
+    manager.add(tbctl.TensorboardReconciler())
+    manager.add(workload_runtime.StatefulSetReconciler())
+    manager.add(workload_runtime.DeploymentReconciler())
+    manager.add(workload_runtime.PodRuntimeReconciler())
+    manager.start_sync()
+    store.create({"apiVersion": "kubeflow.org/v1", "kind": "Profile",
+                  "metadata": {"name": "team-a"},
+                  "spec": {"owner": {"kind": "User", "name": ALICE}}})
+    manager.run_sync()
+    return store, manager
+
+
+def volumes_page(store):
+    page = Page(volumes.create_app(store))
+    page.load_app("volumes.js")
+    return page
+
+
+class TestVolumesApp:
+    """volumes-web-app flows (reference VWA Cypress + table spec)."""
+
+    def test_index_lists_pvcs_from_backend(self, platform):
+        store, manager = platform
+        store.create({"apiVersion": "v1", "kind":
+                      "PersistentVolumeClaim",
+                      "metadata": {"name": "data-1",
+                                   "namespace": "team-a"},
+                      "spec": {"accessModes": ["ReadWriteOnce"],
+                               "resources": {"requests":
+                                             {"storage": "5Gi"}}},
+                      "status": {"phase": "Bound"}})
+        page = volumes_page(store)
+        rows = page.query_all("tbody tr")
+        assert len(rows) == 1
+        assert "data-1" in page.text(rows[0])
+        assert "5Gi" in page.text(rows[0])
+        # status icon rendered from the real phase
+        assert "bound" in page.text(rows[0])
+
+    def test_create_flow_posts_and_returns_to_index(self, platform):
+        store, _ = platform
+        page = volumes_page(store)
+        page.click("#new-resource")
+        # hash router navigated to the form
+        assert page.location["hash"] == "#/new"
+        page.set_value("#f-name", "scratch")
+        page.set_value("#f-size", "2Gi")
+        page.click("#submit-volume")
+        pvc = store.get("v1", "PersistentVolumeClaim", "scratch",
+                        "team-a")
+        assert pvc["spec"]["resources"]["requests"]["storage"] == "2Gi"
+        assert "created scratch" in page.snackbar()
+        # back at the index, the new row is visible
+        assert page.location["hash"] == "#/"
+        assert any("scratch" in page.text(r)
+                   for r in page.query_all("tbody tr"))
+
+    def test_client_validation_blocks_bad_name(self, platform):
+        store, _ = platform
+        page = volumes_page(store)
+        page.go("/new")
+        page.set_value("#f-name", "Bad_Name!")
+        before = len(page.requests)
+        page.click("#submit-volume")
+        assert len(page.requests) == before       # nothing sent
+        field = page.query("#f-name")._parent
+        assert "invalid" in (field["className"] or "")
+        assert "lowercase" in page.text(field)
+
+    def test_delete_confirms_then_deletes(self, platform):
+        store, _ = platform
+        store.create({"apiVersion": "v1",
+                      "kind": "PersistentVolumeClaim",
+                      "metadata": {"name": "doomed",
+                                   "namespace": "team-a"},
+                      "spec": {}, "status": {"phase": "Bound"}})
+        page = volumes_page(store)
+        # cancel first: PVC survives
+        page.auto_dialog = False
+        page.click('button[data-action="delete"]')
+        assert store.try_get("v1", "PersistentVolumeClaim", "doomed",
+                             "team-a") is not None
+        # confirm: deleted via the real DELETE route
+        page.auto_dialog = True
+        page.click('button[data-action="delete"]')
+        assert store.try_get("v1", "PersistentVolumeClaim", "doomed",
+                             "team-a") is None
+        assert "deleted doomed" in page.snackbar()
+
+    def test_details_tabs_pods_and_events(self, platform):
+        store, _ = platform
+        store.create({"apiVersion": "v1",
+                      "kind": "PersistentVolumeClaim",
+                      "metadata": {"name": "used-pvc",
+                                   "namespace": "team-a"},
+                      "spec": {}, "status": {"phase": "Bound"}})
+        store.create({"apiVersion": "v1", "kind": "Pod",
+                      "metadata": {"name": "consumer",
+                                   "namespace": "team-a"},
+                      "spec": {"volumes": [{"name": "v",
+                                            "persistentVolumeClaim": {
+                                                "claimName":
+                                                    "used-pvc"}}],
+                               "containers": []}})
+        page = volumes_page(store)
+        page.go("/details/used-pvc")
+        assert "consumer" in page.text()
+        page.click('button[data-tab="events"]')
+        assert page.query("table.kf-table") is not None
+
+    def test_poller_refreshes_on_clock(self, platform):
+        store, _ = platform
+        page = volumes_page(store)
+        assert page.query_all("tbody tr[data-row]") == []
+        store.create({"apiVersion": "v1",
+                      "kind": "PersistentVolumeClaim",
+                      "metadata": {"name": "late",
+                                   "namespace": "team-a"},
+                      "spec": {}, "status": {"phase": "Bound"}})
+        page.advance(8000)          # poller interval
+        assert any("late" in page.text(r)
+                   for r in page.query_all("tbody tr"))
+
+
+class TestJupyterApp:
+    """jupyter-web-app flows (reference JWA Cypress form-page +
+    notebook-page specs, §3.1 spawn call stack)."""
+
+    def _page(self, store):
+        page = Page(jupyter.create_app(store))
+        page.load_app("jupyter.js")
+        return page
+
+    def test_spawn_form_creates_notebook_through_controllers(
+            self, platform):
+        store, manager = platform
+        page = self._page(store)
+        page.go("/new")
+        assert page.location["hash"] == "#/new"
+        page.set_value("#f-name", "mynb")
+        # TPU picker: choosing a type fills topologies from config
+        page.set_value("#f-type", "tpu-v5-lite-podslice")
+        topo = page.query("#f-topology")
+        assert len(topo._element_children()) >= 1
+        page.click("#submit-notebook")
+        assert "created mynb" in page.snackbar()
+        nb = store.get("kubeflow.org/v1beta1", "Notebook", "mynb",
+                       "team-a")
+        tmpl = nb["spec"]["template"]["spec"]
+        limits = tmpl["containers"][0]["resources"]["limits"]
+        assert limits["google.com/tpu"] == "4"
+        sel = tmpl["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == \
+            "tpu-v5-lite-podslice"
+        # the controllers take it from here (the §3.1 stack)
+        manager.run_sync()
+        assert store.try_get("apps/v1", "StatefulSet", "mynb",
+                             "team-a") is not None
+
+    def test_dry_run_validates_without_create(self, platform):
+        store, _ = platform
+        page = self._page(store)
+        page.go("/new")
+        page.set_value("#f-name", "dryrun-nb")
+        page.click("#validate-notebook")
+        assert "configuration is valid" in page.snackbar()
+        assert store.try_get("kubeflow.org/v1beta1", "Notebook",
+                             "dryrun-nb", "team-a") is None
+
+    def test_existing_pvc_picker_toggles_and_submits(self, platform):
+        store, manager = platform
+        store.create({"apiVersion": "v1",
+                      "kind": "PersistentVolumeClaim",
+                      "metadata": {"name": "shared-data",
+                                   "namespace": "team-a"},
+                      "spec": {"resources": {"requests":
+                                             {"storage": "8Gi"}}},
+                      "status": {"phase": "Bound"}})
+        page = self._page(store)
+        page.go("/new")
+        page.set_value("#f-name", "vol-nb")
+        page.click("#add-data-volume")
+        row = page.query(".kf-row")
+        # new-volume mode shows name+size, hides the picker
+        names = row._query_all("#f-name")
+        picks = row._query_all("#f-pick")
+        assert picks and picks[0]._parent["hidden"] is True
+        page.set_value(row._query_all("#f-type")[0], "existing")
+        assert picks[0]._parent["hidden"] is False
+        assert names[0]._parent["hidden"] is True
+        # the picker lists the namespace PVC with its size
+        assert "shared-data (8Gi)" in page.text(picks[0])
+        page.click("#submit-notebook")
+        nb = store.get("kubeflow.org/v1beta1", "Notebook", "vol-nb",
+                       "team-a")
+        vols = nb["spec"]["template"]["spec"]["volumes"]
+        claim_vols = [v for v in vols if "persistentVolumeClaim" in v]
+        assert any(v["persistentVolumeClaim"]["claimName"] ==
+                   "shared-data" for v in claim_vols)
+
+    def test_yaml_editor_roundtrip_create(self, platform):
+        store, _ = platform
+        page = self._page(store)
+        page.go("/new-yaml")
+        area = page.query(".kf-editor-text")
+        assert "kind: Notebook" in area["value"]
+        # dry-run the starter manifest through the real admission chain
+        page.click("#yaml-dryrun")
+        assert "manifest is valid" in page.snackbar()
+        page.click("#yaml-create")
+        assert store.try_get("kubeflow.org/v1beta1", "Notebook",
+                             "my-notebook", "team-a") is not None
+
+    def test_index_actions_follow_status(self, platform):
+        store, manager = platform
+        page = self._page(store)
+        page.go("/new")
+        page.set_value("#f-name", "nb1")
+        page.click("#submit-notebook")
+        manager.run_sync()
+        page.go("/")
+        # running notebook: stop+delete visible, start hidden
+        actions = [to_python(b._dataset["action"])
+                   for b in page.query_all("tbody button")]
+        assert "stop" in actions and "start" not in actions
+        page.auto_dialog = True
+        page.click('button[data-action="stop"]')
+        assert "stopping nb1" in page.snackbar()
+        nb = store.get("kubeflow.org/v1beta1", "Notebook", "nb1",
+                       "team-a")
+        assert nb["metadata"]["annotations"][
+            "kubeflow-resource-stopped"]
+
+    def test_logs_viewer_polls_pod_logs(self, platform):
+        store, manager = platform
+        page = self._page(store)
+        page.go("/new")
+        page.set_value("#f-name", "lognb")
+        page.click("#submit-notebook")
+        manager.run_sync()
+        page.go("/details/lognb")
+        page.click('button[data-tab="logs"]')
+        pre = page.query("pre.kf-logs")
+        assert pre is not None
+        text = page.text(pre)
+        assert text and "loading" not in text
+        # follow checkbox wired: unchecking stops the auto-scroll flag
+        page.set_checked(page.query(".kf-logs-bar input"), False)
+
+    def test_details_tabs_render(self, platform):
+        store, manager = platform
+        page = self._page(store)
+        page.go("/new")
+        page.set_value("#f-name", "nb2")
+        page.click("#submit-notebook")
+        manager.run_sync()
+        page.go("/details/nb2")
+        assert "image" in page.text()
+        # yaml tab dumps the CR through the executed yaml.js
+        page.click('button[data-tab="yaml"]')
+        assert "kind: Notebook" in page.text()
+        page.click('button[data-tab="events"]')
+        assert page.query("table.kf-table") is not None
+
+
+class TestDashboardApp:
+    """centraldashboard flows (reference main-page_test.js +
+    manage-users-view)."""
+
+    def _page(self, store, user=ALICE):
+        page = Page(dashboard.create_app(store), user=user)
+        page.load_app("dashboard.js")
+        return page
+
+    def test_landing_shows_namespaces_and_apps(self, platform):
+        store, _ = platform
+        page = self._page(store)
+        text = page.text()
+        assert ALICE in text
+        assert "team-a" in text and "owner" in text
+        assert "Notebooks" in text and "TPU Slices" in text
+
+    def test_onboarding_creates_workgroup_profile(self, platform):
+        store, manager = platform
+        page = self._page(store, user="newbie@example.com")
+        assert page.query("#onboarding") is not None
+        page.set_value("#workgroup-name", "newbie-ns")
+        page.click("#create-workgroup")
+        manager.run_sync()
+        prof = store.get("kubeflow.org/v1", "Profile", "newbie-ns")
+        assert prof["spec"]["owner"]["name"] == "newbie@example.com"
+        assert page.reloads == 1
+
+    def test_contributor_add_remove(self, platform):
+        store, manager = platform
+        page = self._page(store)
+        assert page.query("#contributors") is not None
+        page.set_value("#contributor-email", "bob@example.com")
+        page.click("#add-contributor")
+        assert "added bob@example.com" in page.snackbar()
+        rows = page.query_all('tr[data-contributor="bob@example.com"]')
+        assert rows
+        page.auto_dialog = True
+        page.click(rows[0]._query_all("button")[0])
+        assert not page.query_all(
+            'tr[data-contributor="bob@example.com"]')
+
+    def test_poddefault_authoring_roundtrip(self, platform):
+        store, _ = platform
+        page = self._page(store)
+        page.go("/poddefaults")
+        assert "no poddefaults in team-a" in page.text()
+        page.click("#new-poddefault")
+        page.click("#pd-dryrun")
+        assert "manifest is valid" in page.snackbar()
+        page.click("#pd-save")
+        assert store.try_get("kubeflow.org/v1alpha1", "PodDefault",
+                             "my-poddefault", "team-a") is not None
+        # back at the list: the new PodDefault is visible with selector
+        assert page.query('tr[data-poddefault="my-poddefault"]') \
+            is not None
+
+    def test_iframe_container_and_standalone_links(self, platform):
+        store, _ = platform
+        page = self._page(store)
+        page.go("/app/volumes")
+        frame = page.query("iframe.kf-app-frame")
+        assert frame is not None
+        assert frame["src"] == "/volumes/"
+        # back to the dashboard shell
+        page.click(".kf-toolbar button.ghost")
+        assert page.location["hash"] == "#/"
+
+    def test_activity_feed_polls_events(self, platform):
+        store, _ = platform
+        store.create({"apiVersion": "v1", "kind": "Event",
+                      "metadata": {"name": "ev1",
+                                   "namespace": "team-a"},
+                      "type": "Normal", "reason": "TestFired",
+                      "message": "it happened",
+                      "lastTimestamp": "2026-07-30T00:00:00Z"})
+        page = self._page(store)
+        assert "TestFired" in page.text()
+
+
+class TestTensorboardsApp:
+    def test_list_create_delete(self, platform):
+        store, manager = platform
+        page = Page(tensorboards.create_app(store))
+        page.load_app("tensorboards.js")
+        assert page.query("tbody td.kf-empty") is not None
+        page.click("#new-resource")
+        page.set_value("#f-name", "tb1")
+        page.set_value("#f-logspath", "pvc://logs-pvc/training")
+        page.click("#submit-tensorboard")
+        assert "created tb1" in page.snackbar()
+        tb = store.get("kubeflow.org/v1alpha1",
+                       "Tensorboard", "tb1", "team-a")
+        assert tb["spec"]["logspath"] == "pvc://logs-pvc/training"
+        manager.run_sync()
+        page.go("/")
+        page.auto_dialog = True
+        page.click('button[data-action="delete"]')
+        assert store.try_get("kubeflow.org/v1alpha1",
+                             "Tensorboard", "tb1", "team-a") is None
+
+
+class TestStudiesApp:
+    def _study(self, store, trials=6):
+        reports = [[1, 0.9], [2, 0.7], [3, 0.5]]
+        status_trials = []
+        for i in range(trials):
+            status_trials.append({
+                "name": f"study1-trial-{i}", "index": i,
+                "state": "Succeeded" if i % 3 else "EarlyStopped",
+                "objectiveValue": 1.0 - 0.1 * i,
+                "parameters": {"lr": 0.01 * (i + 1)},
+                "reports": reports,
+            })
+        store.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "StudyJob",
+            "metadata": {"name": "study1", "namespace": "team-a"},
+            "spec": {"maxTrialCount": trials, "parallelism": 2,
+                     "objective": {"metricName": "loss",
+                                   "type": "minimize"},
+                     "algorithm": {"name": "tpe"}},
+            "status": {"phase": "Running",
+                       "completedTrials": trials,
+                       "trials": status_trials,
+                       "bestTrial": {"name": "study1-trial-5",
+                                     "objectiveValue": 0.5,
+                                     "parameters": {"lr": 0.06}}}})
+
+    def test_index_and_live_trial_chart(self, platform):
+        store, _ = platform
+        self._study(store)
+        page = Page(studies.create_app(store))
+        page.load_app("studies.js")
+        row = page.query("tbody tr")
+        assert "study1" in page.text(row) and "tpe" in page.text(row)
+        page.go("/details/study1")
+        page.click('button[data-tab="trials"]')
+        # the SVG chart rendered: status-colored dots per trial + the
+        # best-so-far step line + legend
+        chart = page.query("#trial-chart")
+        assert chart is not None
+        svg = chart._query_all("svg")[0]
+        assert len(svg._query_all("path")) >= 1
+        assert len(svg._query_all("circle")) >= 6
+        assert "best so far" in page.text(chart)
+        # per-trial table with sparkline characters from reports
+        assert "▁" in page.text() or "█" in page.text()
+
+    def test_yaml_create_with_dry_run(self, platform):
+        store, _ = platform
+        page = Page(studies.create_app(store))
+        page.load_app("studies.js")
+        page.go("/new")
+        area = page.query(".kf-editor-text")
+        assert "kind: StudyJob" in area["value"]
+        page.click("#study-dryrun")
+        assert "valid" in page.snackbar()
+        page.click("#study-create")
+        assert store.list("kubeflow.org/v1alpha1", "StudyJob",
+                          "team-a")
+
+
+class TestSlicesApp:
+    def test_list_and_workers(self, store, clean_env, monkeypatch):
+        # own manager: reconcilers must be added BEFORE start (the
+        # controller-runtime contract the platform fixture follows)
+        from kubeflow_tpu.api import tpuslice as tsapi
+        from kubeflow_tpu.controllers.tpuslice import TpuSliceReconciler
+        monkeypatch.setenv("APP_SECURE_COOKIES", "false")
+        admission.PodDefaultWebhook(store).install()
+        manager = Manager(store)
+        manager.add(profctl.ProfileReconciler())
+        manager.add(workload_runtime.StatefulSetReconciler())
+        manager.add(workload_runtime.PodRuntimeReconciler())
+        manager.add(TpuSliceReconciler())
+        manager.start_sync()
+        store.create({"apiVersion": "kubeflow.org/v1",
+                      "kind": "Profile",
+                      "metadata": {"name": "team-a"},
+                      "spec": {"owner": {"kind": "User",
+                                         "name": ALICE}}})
+        store.create(tsapi.new_slice(
+            "sl1", "team-a", "tpu-v5-lite-podslice", "4x4",
+            {"containers": [{"name": "worker",
+                             "image": "jax-tpu:latest"}]}))
+        manager.run_sync()
+        page = Page(slices.create_app(store))
+        page.load_app("slices.js")
+        row = page.query("tbody tr")
+        assert "sl1" in page.text(row)
+        assert "4x4" in page.text(row)
+        page.go("/details/sl1")
+        page.click('button[data-tab="workers"]')
+        text = page.text()
+        assert "sl1-0" in text and "sl1-3" in text
+        manager.stop()
+
+
+class TestSharedComponentsDom:
+    """lib/components.js DOM behavior — the resource-table /
+    tab-panel / form / editor component specs
+    (table.component.spec.ts analogue, executed)."""
+
+    def _table_page(self, store):
+        page = Page(volumes.create_app(store))
+        for name, size in (("alpha", "1Gi"), ("zulu", "9Gi"),
+                           ("mike", "5Gi")):
+            store.create({"apiVersion": "v1",
+                          "kind": "PersistentVolumeClaim",
+                          "metadata": {"name": name,
+                                       "namespace": "team-a"},
+                          "spec": {"resources": {"requests":
+                                                 {"storage": size}}},
+                          "status": {"phase": "Bound"}})
+        page.load_app("volumes.js")
+        return page
+
+    def _row_names(self, page):
+        return [to_python(r._dataset["row"])
+                for r in page.query_all("tbody tr")]
+
+    def test_resource_table_sorts_on_header_click(self, platform):
+        store, _ = platform
+        page = self._table_page(store)
+        headers = page.query_all("thead th.sortable")
+        name_th = next(th for th in headers
+                       if page.text(th).startswith("Name"))
+        name_th._fire("click")
+        assert self._row_names(page) == ["alpha", "mike", "zulu"]
+        # renderHead rebuilt the header row: re-query for the arrow
+        assert "↑" in page.text(page.query("thead"))
+        name_th = next(th for th in page.query_all("thead th.sortable")
+                       if page.text(th).startswith("Name"))
+        name_th._fire("click")     # same column: direction flips
+        assert self._row_names(page) == ["zulu", "mike", "alpha"]
+        assert "↓" in page.text(page.query("thead"))
+
+    def test_tab_panel_switches_and_cleans_up(self, platform):
+        store, manager = platform
+        page = Page(jupyter.create_app(store))
+        page.load_app("jupyter.js")
+        page.go("/new")
+        page.set_value("#f-name", "tabnb")
+        page.click("#submit-notebook")
+        manager.run_sync()
+        page.go("/details/tabnb")
+        tabs = page.query_all(".kf-tabs button")
+        assert [to_python(t._dataset["tab"]) for t in tabs] == \
+            ["overview", "logs", "events", "yaml"]
+        active = [t for t in tabs
+                  if "active" in (t["className"] or "")]
+        assert [to_python(t._dataset["tab"]) for t in active] == \
+            ["overview"]
+
+    def test_yaml_editor_status_and_tab_key(self, platform):
+        store, _ = platform
+        page = Page(jupyter.create_app(store))
+        page.load_app("jupyter.js")
+        page.go("/new-yaml")
+        area = page.query(".kf-editor-text")
+        status = page.query(".kf-editor-status")
+        assert page.text(status) == "yaml ok"
+        # live parse: a broken buffer calls out the offending line
+        page.set_value(area, "a: 1\n  bad indent: [")
+        assert "line" in page.text(status)
+        # Tab inserts two spaces instead of leaving the field
+        page.set_value(area, "x")
+        area["selectionStart"] = 1.0
+        area["selectionEnd"] = 1.0
+        ev = page.keydown(area, "Tab")
+        assert area["value"] == "x  "
+        assert ev["defaultPrevented"] is True
+
+    def test_yaml_editor_completion_menu(self, platform):
+        store, _ = platform
+        page = Page(jupyter.create_app(store))
+        page.load_app("jupyter.js")
+        page.go("/new-yaml")
+        area = page.query(".kf-editor-text")
+        page.set_value(area, "apiVersion: kubeflow.org/v1beta1\n"
+                       "kind: Notebook\nsp")
+        end = float(len(to_python(area["value"])))
+        area["selectionStart"] = end
+        area["selectionEnd"] = end
+        page.keydown(area, " ", ctrl=True)
+        menu = page.query(".kf-editor-menu")
+        assert menu["hidden"] is False
+        items = [page.text(i) for i in
+                 menu._query_all(".kf-menu-item")]
+        assert "spec" in items
+        page.keydown(area, "Enter")
+        assert "spec: " in to_python(area["value"])
+        assert menu["hidden"] is True
+
+    def test_snack_clears_after_timeout(self, platform):
+        store, _ = platform
+        page = volumes_page(store)
+        page.go("/new")
+        page.set_value("#f-name", "ok-name")
+        page.click("#submit-volume")
+        bar = page.query("#kf-snackbar")
+        assert "show" in (bar["className"] or "")
+        page.advance(4000)
+        assert (bar["className"] or "") == ""
+
+    def test_poller_self_stops_when_root_detached(self, platform):
+        store, _ = platform
+        page = self._table_page(store)
+        # navigate away: the index view's table left the DOM
+        page.go("/new")
+        before = len(page.requests)
+        page.advance(60000)
+        # pollers did not keep hitting the backend from a dead view
+        pvc_lists = [r for r in page.requests[before:]
+                     if r[1].endswith("/pvcs") and r[0] == "GET"]
+        assert len(pvc_lists) <= 1
+
+
+class TestDomShimSemantics:
+    """Pin the shim behaviors the review flagged (tools/jsmini/dom.py)."""
+
+    def _page(self, store):
+        return Page(volumes.create_app(store))
+
+    def test_reparent_moves_the_identical_node_not_an_equal_twin(
+            self, platform):
+        store, _ = platform
+        page = self._page(store)
+        doc = page.document
+        parent = doc["createElement"]("tr")
+        a = doc["createElement"]("td")
+        b = doc["createElement"]("td")     # equal as dicts, distinct
+        parent._append(a, b)
+        other = doc["createElement"]("tr")
+        other._append(b)                   # move B, not its twin A
+        assert parent._children == [a]
+        assert parent._children[0] is a
+        assert b._parent is other
+
+    def test_unknown_attr_goes_to_setattribute_like_a_browser(
+            self, platform):
+        store, _ = platform
+        page = self._page(store)
+        from tools.jsmini.interp import JSObject
+        core = page.load_module("lib/core.js")
+        el = core["h"].call(UNDEFINED, [
+            "button", JSObject({"aria-expanded": True, "title": "t"})])
+        # aria-expanded is not an IDL property: attribute path
+        assert el._attrs.get("aria-expanded") == ""
+        # title IS: property path
+        assert dict.__contains__(el, "title")
+
+    def test_number_toPrecision_matches_js(self, platform):
+        from tools.jsmini.interp import _to_precision
+        assert _to_precision(9.99, 2) == "10"
+        assert _to_precision(99.99, 3) == "100"
+        assert _to_precision(123.456, 2) == "1.2e+2"
+        assert _to_precision(0.5, 4) == "0.5000"
+
+
+class TestCsrfExecuted:
+    """The double-submit cookie executes end-to-end: GET issues the
+    cookie, core.js csrfHeader() echoes it, crud_backend verifies."""
+
+    def test_mutation_with_cookie_echo_succeeds(self, platform,
+                                                monkeypatch):
+        store, _ = platform
+        monkeypatch.setenv("APP_SECURE_COOKIES", "true")
+        page = volumes_page(store)       # GETs set the XSRF cookie
+        assert "XSRF-TOKEN" in page.document["cookie"]
+        page.go("/new")
+        page.set_value("#f-name", "csrf-ok")
+        page.click("#submit-volume")
+        assert "created csrf-ok" in page.snackbar()
+        assert store.try_get("v1", "PersistentVolumeClaim", "csrf-ok",
+                             "team-a") is not None
+
+    def test_mutation_without_cookie_is_403(self, platform,
+                                            monkeypatch):
+        store, _ = platform
+        monkeypatch.setenv("APP_SECURE_COOKIES", "true")
+        page = volumes_page(store)
+        page.go("/new")
+        # strip the cookie AFTER the form rendered (any earlier and the
+        # form's own GETs would just re-issue the token — the correct
+        # double-submit behavior, verified above)
+        page.document._cookies.clear()
+        page.set_value("#f-name", "csrf-bad")
+        page.click("#submit-volume")
+        assert "CSRF" in page.snackbar()
+        assert store.try_get("v1", "PersistentVolumeClaim", "csrf-bad",
+                             "team-a") is None

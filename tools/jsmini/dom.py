@@ -1,0 +1,813 @@
+"""Minimal browser environment for jsmini — executes the shipped SPA
+view code (apps/*.js + the DOM half of lib/{core,components}.js) inside
+pytest, against the REAL REST backends.
+
+This is the executed-DOM tier the reference gets from Karma component
+specs and Cypress (e.g. kubeflow-common-lib resource-table
+table.component.spec.ts, centraldashboard main-page_test.js): render
+the actual components, click the actual buttons, assert on the actual
+tree — no mocks of our own frontend code. What the reference fakes at
+the HTTP boundary (cy.intercept fixtures), this fakes one level deeper
+and better: `fetch` dispatches into the real `web/*.py` app over the
+real in-process store, so list/create/delete flows execute the full
+frontend+backend contract including authn headers and the CSRF
+double-submit cookie.
+
+Scope: exactly the DOM surface the shipped JS uses (audited by grep,
+pinned by tests/test_dom_execution.py) — element tree ops, class
+management, events, a hash router's location/hashchange loop, timers
+with a virtual clock, localStorage, fetch. NOT a browser: no layout,
+no styles, no real async. Unknown members return undefined like real
+DOM expandos; unsupported *operations* fail loudly.
+
+Promise semantics: jsmini promises settle synchronously. confirmDialog
+returns `new Promise` that resolves from a button click, so the page
+auto-clicks the dialog when `page.auto_dialog` is set (True=confirm,
+False=cancel) — the promise is settled before the constructor returns,
+keeping the no-event-loop model sound. Leaving auto_dialog None makes
+an awaited dialog fail loudly instead of hanging.
+"""
+
+import heapq
+import json as _json
+import os
+import re
+from urllib.parse import parse_qs, urlsplit
+
+from .interp import (Interpreter, JSArray, JSClass, JSMiniError, JSObject,
+                     JSPromise, JSThrow, UNDEFINED, call_value, make_error,
+                     to_js_string)
+from .interp import from_python as _from_python
+
+# instanceof support: elements/text carry a js_class chain rooted at
+# Node, matching `c instanceof Node` in lib/core.js h()
+NODE_CLASS = JSClass("Node", None, {}, {})
+ELEMENT_CLASS = JSClass("Element", NODE_CLASS, {}, {})
+TEXT_CLASS = JSClass("Text", NODE_CLASS, {}, {})
+
+# IDL-style properties: `k in el` is true for these (h() routes them to
+# property assignment, everything else to setAttribute) and reads of
+# unset ones return a typed default, like real DOM elements
+_PROP_DEFAULTS = {
+    "id": "", "className": "", "title": "", "hidden": False,
+    "disabled": False, "value": "", "checked": False, "selected": False,
+    "type": "", "placeholder": "", "href": "", "src": "", "target": "",
+    "download": "", "rows": 0.0, "colSpan": 1.0, "tabIndex": 0.0,
+    "htmlFor": "", "spellcheck": True, "open": False, "name": "",
+    "scrollTop": 0.0, "scrollLeft": 0.0, "scrollHeight": 0.0,
+    "selectionStart": 0.0, "selectionEnd": 0.0, "innerHTML": "",
+}
+
+_STATUS_TEXT = {
+    200: "OK", 201: "Created", 204: "No Content", 400: "Bad Request",
+    401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
+    409: "Conflict", 422: "Unprocessable Entity",
+    500: "Internal Server Error", 503: "Service Unavailable",
+}
+
+
+class Event(JSObject):
+    def __init__(self, etype, target, props=None):
+        super().__init__()
+        self["type"] = etype
+        self["target"] = target
+        self["defaultPrevented"] = False
+        for k, v in (props or {}).items():
+            self[k] = v
+
+        def prevent():
+            self["defaultPrevented"] = True
+
+        self["preventDefault"] = prevent
+        self["stopPropagation"] = lambda: None
+
+
+class ClassList(JSObject):
+    """Live view over owner.className (add/remove/toggle/contains)."""
+
+    def __init__(self, owner):
+        super().__init__()
+        self._owner = owner
+
+    def _names(self):
+        return [c for c in (self._owner["className"] or "").split() if c]
+
+    def _store(self, names):
+        self._owner["className"] = " ".join(names)
+
+    def __contains__(self, name):
+        return name in ("add", "remove", "toggle", "contains", "length")
+
+    def __getitem__(self, name):
+        if name == "add":
+            return lambda *cs: self._store(
+                self._names() + [c for c in cs if c not in self._names()])
+        if name == "remove":
+            return lambda *cs: self._store(
+                [n for n in self._names() if n not in cs])
+        if name == "toggle":
+            return self._toggle
+        if name == "contains":
+            return lambda c: c in self._names()
+        if name == "length":
+            return float(len(self._names()))
+        return UNDEFINED
+
+    def _toggle(self, name, force=UNDEFINED):
+        names = self._names()
+        want = (name not in names) if force is UNDEFINED else bool(force)
+        if want and name not in names:
+            names.append(name)
+        if not want and name in names:
+            names.remove(name)
+        self._store(names)
+        return want
+
+
+class Text(JSObject):
+    def __init__(self, data):
+        super().__init__()
+        self.js_class = TEXT_CLASS    # after super: JSObject resets it
+        self._parent = None
+        dict.__setitem__(self, "data", to_js_string(data))
+
+    @property
+    def text(self):
+        return dict.__getitem__(self, "data")
+
+
+class Element(JSObject):
+    def __init__(self, doc, tag, ns=None):
+        super().__init__()
+        self.js_class = ELEMENT_CLASS  # after super: JSObject resets it
+        self._doc = doc
+        self._tag = tag.lower() if ns is None else tag
+        self._ns = ns
+        self._children = []
+        self._parent = None
+        self._attrs = {}
+        self._listeners = {}
+        self._dataset = JSObject()
+        self._classlist = ClassList(self)
+        if self._tag == "input":
+            dict.__setitem__(self, "type", "text")
+        if self._tag == "details":
+            dict.__setitem__(self, "open", False)
+
+    # ------------------------------------------------------- tree ops
+    @staticmethod
+    def _remove_by_identity(lst, item):
+        # by identity, never equality: two empty same-shape elements
+        # compare equal as dicts and list.remove would take the wrong
+        # sibling
+        for i, x in enumerate(lst):
+            if x is item:
+                del lst[i]
+                return True
+        return False
+
+    def _attach(self, child):
+        if isinstance(child, (Element, Text)):
+            if child._parent is not None:
+                self._remove_by_identity(child._parent._children, child)
+            child._parent = self
+            self._children.append(child)
+        elif child is None or child is UNDEFINED:
+            pass
+        else:
+            self._attach(Text(to_js_string(child)))
+
+    def _append(self, *children):
+        for c in children:
+            self._attach(c)
+        self._doc._after_attach(self)
+
+    def _remove_child(self, child):
+        if self._remove_by_identity(self._children, child):
+            child._parent = None
+        return child
+
+    def _detach(self):
+        if self._parent is not None:
+            self._parent._remove_child(self)
+
+    def _element_children(self):
+        return [c for c in self._children if isinstance(c, Element)]
+
+    def _text_content(self):
+        out = []
+        for c in self._children:
+            if isinstance(c, Text):
+                out.append(c.text)
+            else:
+                out.append(c._text_content())
+        return "".join(out)
+
+    def _set_text(self, value):
+        self._children = []
+        if value not in (None, UNDEFINED, ""):
+            self._attach(Text(to_js_string(value)))
+
+    def _is_connected(self):
+        node = self
+        while node._parent is not None:
+            node = node._parent
+        return node is self._doc.body or node is self._doc
+
+    # --------------------------------------------------------- events
+    def _add_listener(self, etype, fn):
+        self._listeners.setdefault(to_js_string(etype), []).append(fn)
+
+    def _remove_listener(self, etype, fn):
+        lst = self._listeners.get(to_js_string(etype), [])
+        for i, f in enumerate(lst):
+            if f is fn:
+                del lst[i]
+                break
+
+    def _fire(self, etype, props=None):
+        ev = Event(etype, self, props)
+        for fn in list(self._listeners.get(etype, [])):
+            out = call_value(fn, UNDEFINED, [ev])
+            if isinstance(out, JSPromise) and not out.pending \
+                    and out.rejected:
+                # an async handler died un-caught — surface it, the
+                # browser would log an unhandled rejection
+                raise JSThrow(out.error)
+        return ev
+
+    # ------------------------------------------------------ selectors
+    def _query_all(self, selector):
+        out = []
+        _select(self, _parse_selector(selector), out)
+        return out
+
+    # --------------------------------------------- JS member protocol
+    def __contains__(self, name):
+        # the IDL property surface plus anything actually set; unknown
+        # attrs in h() fall to the setAttribute path like a browser,
+        # and unknown reads still resolve to undefined via get_member
+        return (name in _ELEMENT_SPECIALS or name in _PROP_DEFAULTS
+                or dict.__contains__(self, name))
+
+    def __getitem__(self, name):
+        special = _ELEMENT_SPECIALS.get(name)
+        if special is not None:
+            return special(self)
+        if dict.__contains__(self, name):
+            v = dict.__getitem__(self, name)
+            if name == "value" and self._tag == "select" \
+                    and (v == "" or v is UNDEFINED):
+                return self._select_value()
+            return v
+        if name == "value":
+            if self._tag == "select":
+                return self._select_value()
+            if self._tag == "option":
+                return self._text_content()
+        if name in _PROP_DEFAULTS:
+            return _PROP_DEFAULTS[name]
+        if name in self._attrs:
+            return self._attrs[name]
+        return UNDEFINED
+
+    def __setitem__(self, name, value):
+        if name == "textContent":
+            self._set_text(value)
+            return
+        if name == "innerHTML":
+            # stored, children dropped — nothing re-parses HTML here
+            # (only the highlight overlay writes it, nothing reads DOM
+            # back out of it)
+            self._children = []
+            dict.__setitem__(self, name, to_js_string(value))
+            return
+        dict.__setitem__(self, name, value)
+
+    def _select_value(self):
+        opts = self._query_all("option")
+        for o in opts:
+            if o["selected"] is True:
+                return o["value"]
+        return opts[0]["value"] if opts else ""
+
+    def get(self, name, default=None):   # dict.get used by JSON paths
+        v = self[name]
+        return default if v is UNDEFINED else v
+
+
+def _el_special(fn):
+    return fn
+
+
+_ELEMENT_SPECIALS = {
+    "tagName": lambda el: el._tag.upper(),
+    "children": lambda el: JSArray(el._element_children()),
+    "childNodes": lambda el: JSArray(el._children),
+    "firstChild": lambda el: el._children[0] if el._children else None,
+    "lastChild": lambda el: el._children[-1] if el._children else None,
+    "parentNode": lambda el: el._parent,
+    "parentElement": lambda el: el._parent
+    if isinstance(el._parent, Element) else None,
+    "isConnected": lambda el: el._is_connected(),
+    "textContent": lambda el: el._text_content(),
+    "classList": lambda el: el._classlist,
+    "dataset": lambda el: el._dataset,
+    "append": lambda el: el._append,
+    "appendChild": lambda el: (lambda c: (el._append(c), c)[1]),
+    "removeChild": lambda el: el._remove_child,
+    "remove": lambda el: el._detach,
+    "addEventListener": lambda el: el._add_listener,
+    "removeEventListener": lambda el: el._remove_listener,
+    "dispatchEvent": lambda el: (lambda ev: el._fire(ev["type"])),
+    "click": lambda el: (lambda: el._fire("click")),
+    "focus": lambda el: (lambda: None),
+    "blur": lambda el: (lambda: None),
+    "setAttribute": lambda el: el._set_attribute,
+    "getAttribute": lambda el: (
+        lambda n: el._attrs.get(to_js_string(n), None)),
+    "removeAttribute": lambda el: (
+        lambda n: el._attrs.pop(to_js_string(n), None) and None),
+    "hasAttribute": lambda el: (
+        lambda n: to_js_string(n) in el._attrs),
+    "querySelector": lambda el: (
+        lambda s: (el._query_all(s) or [None])[0]),
+    "querySelectorAll": lambda el: (
+        lambda s: JSArray(el._query_all(s))),
+    "setRangeText": lambda el: el._set_range_text,
+}
+
+
+def _set_attribute(self, name, value):
+    self._attrs[to_js_string(name)] = to_js_string(value)
+
+
+def _set_range_text(self, text, start=UNDEFINED, end=UNDEFINED,
+                    mode="preserve"):
+    value = to_js_string(self["value"])
+    s = int(start) if start is not UNDEFINED \
+        else int(self["selectionStart"])
+    e = int(end) if end is not UNDEFINED else int(self["selectionEnd"])
+    self["value"] = value[:s] + to_js_string(text) + value[e:]
+    if mode == "end":
+        pos = float(s + len(to_js_string(text)))
+        self["selectionStart"] = pos
+        self["selectionEnd"] = pos
+
+
+Element._set_attribute = _set_attribute
+Element._set_range_text = _set_range_text
+
+
+# ---------------------------------------------------------- selectors
+
+_SIMPLE = re.compile(
+    r"^([A-Za-z][A-Za-z0-9-]*|\*)?"            # tag
+    r"((?:[.#][A-Za-z0-9_-]+)*)"               # .classes / #id
+    r"((?:\[[A-Za-z0-9_-]+(?:=\"?[^\"\]]*\"?)?\])*)$")   # [attr=val]
+
+
+def _parse_selector(selector):
+    parts = to_js_string(selector).split()
+    parsed = []
+    for part in parts:
+        m = _SIMPLE.match(part)
+        if not m:
+            raise JSMiniError(f"unsupported selector {selector!r}")
+        tag = m.group(1) or None
+        classes, elid = [], None
+        for tok in re.findall(r"[.#][A-Za-z0-9_-]+", m.group(2) or ""):
+            if tok[0] == ".":
+                classes.append(tok[1:])
+            else:
+                elid = tok[1:]
+        attrs = []
+        for tok in re.findall(r"\[([A-Za-z0-9_-]+)(?:=\"?([^\"\]]*)\"?)?\]",
+                              m.group(3) or ""):
+            attrs.append((tok[0], tok[1] if tok[1] != "" else None))
+        parsed.append((tag, elid, classes, attrs))
+    return parsed
+
+
+def _matches(el, simple):
+    tag, elid, classes, attrs = simple
+    if tag not in (None, "*") and el._tag != tag.lower() \
+            and el._tag != tag:
+        return False
+    if elid is not None and el["id"] != elid \
+            and el._attrs.get("id") != elid:
+        return False
+    el_classes = set((el["className"] or "").split()) \
+        | set((el._attrs.get("class") or "").split())
+    if any(c not in el_classes for c in classes):
+        return False
+    for name, want in attrs:
+        if name.startswith("data-"):
+            key = _camel(name[5:])
+            have = el._dataset[key] if key in el._dataset else None
+        else:
+            have = el._attrs.get(name)
+            if have is None and dict.__contains__(el, name):
+                have = to_js_string(dict.__getitem__(el, name))
+        if have is None or (want is not None
+                            and to_js_string(have) != want):
+            return False
+    return True
+
+
+def _camel(kebab):
+    head, *rest = kebab.split("-")
+    return head + "".join(p.capitalize() for p in rest)
+
+
+def _select(root, parsed, out):
+    seen = set()                   # identity — dict equality would
+                                   # merge distinct empty elements
+
+    def walk(el, idx):
+        for child in el._element_children():
+            if _matches(child, parsed[idx]):
+                if idx == len(parsed) - 1:
+                    if id(child) not in seen:
+                        seen.add(id(child))
+                        out.append(child)
+                else:
+                    walk(child, idx + 1)
+            walk(child, idx)
+    walk(root, 0)
+
+
+# ----------------------------------------------------------- document
+
+class Document(Element):
+    def __init__(self, page):
+        self.page = page              # before super: __setitem__ runs
+        super().__init__(self, "#document")
+        self._doc = self
+        self.body = Element(self, "body")
+        self.body._parent = self
+        self._children.append(self.body)
+        self._cookies = {}
+
+    def _after_attach(self, parent):
+        """Post-append hook: auto-answer confirm dialogs (see module
+        docstring) so their promise settles inside the executor."""
+        auto = self.page.auto_dialog
+        if auto is None:
+            return
+        for el in parent._query_all("div.kf-overlay"):
+            if el._is_connected() and not getattr(el, "_answered", False):
+                el._answered = True
+                buttons = el._query_all("button")
+                if buttons:
+                    (buttons[-1] if auto else buttons[0])._fire("click")
+
+    _DOC_MEMBERS = frozenset((
+        "cookie", "body", "createElement", "createElementNS",
+        "createTextNode", "getElementById", "hidden"))
+
+    def __contains__(self, name):
+        return name in self._DOC_MEMBERS or super().__contains__(name)
+
+    def __getitem__(self, name):
+        if name == "cookie":
+            return "; ".join(f"{k}={v}" for k, v in self._cookies.items())
+        if name == "body":
+            return self.body
+        if name == "createElement":
+            return lambda tag: Element(self, to_js_string(tag))
+        if name == "createElementNS":
+            return lambda ns, tag: Element(self, to_js_string(tag),
+                                           ns=to_js_string(ns))
+        if name == "createTextNode":
+            return lambda s: Text(s)
+        if name == "getElementById":
+            return self._get_by_id
+        if name == "hidden":
+            return dict.__contains__(self, "hidden") and \
+                dict.__getitem__(self, "hidden")
+        return super().__getitem__(name)
+
+    def __setitem__(self, name, value):
+        if name == "cookie":
+            first = to_js_string(value).split(";", 1)[0]
+            if "=" in first:
+                k, v = first.split("=", 1)
+                self._cookies[k.strip()] = v.strip()
+            return
+        super().__setitem__(name, value)
+
+    def _get_by_id(self, elid):
+        elid = to_js_string(elid)
+        found = self._query_all(f"#{elid}")
+        return found[0] if found else None
+
+
+class Location(JSObject):
+    def __init__(self, page):
+        super().__init__()
+        self._page = page
+        dict.__setitem__(self, "hash", "")
+
+    def __contains__(self, name):
+        return name in ("hash", "reload")
+
+    def __getitem__(self, name):
+        if name == "reload":
+            return self._reload
+        return dict.__getitem__(self, name) \
+            if dict.__contains__(self, name) else UNDEFINED
+
+    def __setitem__(self, name, value):
+        if name == "hash":
+            value = to_js_string(value)
+            if value and not value.startswith("#"):
+                value = "#" + value
+            old = dict.__getitem__(self, "hash")
+            dict.__setitem__(self, "hash", value)
+            if value != old:
+                self._page.window._fire("hashchange")
+            return
+        dict.__setitem__(self, name, value)
+
+    def _reload(self):
+        self._page.reloads += 1
+
+
+class EventTargetObject(JSObject):
+    """window / localStorage-style host object with listeners and a
+    fixed method surface."""
+
+    def __init__(self):
+        super().__init__()
+        self._listeners = {}
+
+    def _add_listener(self, etype, fn):
+        self._listeners.setdefault(to_js_string(etype), []).append(fn)
+
+    def _remove_listener(self, etype, fn):
+        lst = self._listeners.get(to_js_string(etype), [])
+        for i, f in enumerate(lst):
+            if f is fn:
+                del lst[i]
+                break
+
+    def _fire(self, etype, props=None):
+        ev = Event(etype, self, props)
+        for fn in list(self._listeners.get(etype, [])):
+            out = call_value(fn, UNDEFINED, [ev])
+            if isinstance(out, JSPromise) and not out.pending \
+                    and out.rejected:
+                raise JSThrow(out.error)
+        return ev
+
+
+class Window(EventTargetObject):
+    def __init__(self, page):
+        super().__init__()
+        self._page = page
+
+    def __contains__(self, name):
+        return name in ("addEventListener", "removeEventListener",
+                        "open", "location")
+
+    def __getitem__(self, name):
+        if name == "addEventListener":
+            return self._add_listener
+        if name == "removeEventListener":
+            return self._remove_listener
+        if name == "open":
+            return self._open
+        if name == "location":
+            return self._page.location
+        return UNDEFINED
+
+    def _open(self, url, target=UNDEFINED):
+        self._page.opened.append((to_js_string(url),
+                                  to_js_string(target)
+                                  if target is not UNDEFINED else ""))
+        return None
+
+
+class LocalStorage(JSObject):
+    def __init__(self):
+        super().__init__()
+        self._data = {}
+
+    def __contains__(self, name):
+        return name in ("getItem", "setItem", "removeItem", "clear")
+
+    def __getitem__(self, name):
+        if name == "getItem":
+            return lambda k: self._data.get(to_js_string(k), None)
+        if name == "setItem":
+            return self._set
+        if name == "removeItem":
+            return lambda k: self._data.pop(to_js_string(k), None) \
+                and None
+        if name == "clear":
+            return self._data.clear
+        return UNDEFINED
+
+    def _set(self, k, v):
+        self._data[to_js_string(k)] = to_js_string(v)
+
+
+# --------------------------------------------------------------- page
+
+class Page:
+    """One loaded SPA: DOM + globals + fetch into a real backend app.
+
+    Usage:
+        app = jupyter.create_app(store)
+        page = Page(app, user="alice@example.com")
+        page.load_app("jupyter.js")       # executes the module
+        rows = page.query_all("tbody tr")
+        page.click(page.query("[data-action=delete]"))
+    """
+
+    STATIC = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                          "kubeflow_tpu", "web", "static")
+
+    def __init__(self, app, user="alice@example.com", static_dir=None):
+        self.app = app
+        self.user = user
+        self.static_dir = os.path.abspath(static_dir or self.STATIC)
+        self.opened = []
+        self.reloads = 0
+        self.auto_dialog = None
+        self.requests = []            # (method, path) log
+        self.clock = 0.0
+        self._timers = []             # heap of (due, seq, fn)
+        self._timer_seq = 0
+        self.document = Document(self)
+        self.window = Window(self)
+        self.location = Location(self)
+        self.local_storage = LocalStorage()
+        self._module_cache = {}
+        outlet = Element(self.document, "div")
+        outlet["id"] = "app"
+        self.document.body._append(outlet)
+        self.globals = {
+            "Node": NODE_CLASS,
+            "Element": ELEMENT_CLASS,
+            "document": self.document,
+            "window": self.window,
+            "location": self.location,
+            "localStorage": self.local_storage,
+            "fetch": self._fetch,
+            "setTimeout": self._set_timeout,
+            "clearTimeout": self._clear_timeout,
+            "Blob": lambda parts=UNDEFINED, opts=UNDEFINED: JSObject(
+                {"parts": parts, "opts": opts}),
+            "URL": JSObject({
+                "createObjectURL": lambda b: "blob:mem",
+                "revokeObjectURL": lambda u: None,
+            }),
+        }
+
+    # ------------------------------------------------------- loading
+    def load_module(self, path):
+        """Execute a JS module (path relative to web/static) with this
+        page's DOM globals; imports resolve and share the page cache."""
+        path = os.path.abspath(os.path.join(self.static_dir, path))
+        if path in self._module_cache:
+            return self._module_cache[path]
+
+        def loader(rel, importer_dir):
+            target = os.path.normpath(os.path.join(
+                importer_dir or os.path.dirname(path), rel))
+            rel_to_static = os.path.relpath(target, self.static_dir)
+            return self.load_module(rel_to_static)
+
+        interp = Interpreter(loader=loader, extra_globals=self.globals)
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        exports, _ = interp.run_module(src, os.path.dirname(path))
+        self._module_cache[path] = exports
+        return exports
+
+    def load_app(self, name):
+        return self.load_module(os.path.join("apps", name))
+
+    # --------------------------------------------------------- fetch
+    def _fetch(self, path, opts=UNDEFINED):
+        opts = opts if isinstance(opts, JSObject) else JSObject()
+        method = to_js_string(opts["method"]) \
+            if "method" in opts and opts["method"] is not UNDEFINED \
+            else "GET"
+        headers = {}
+        if "headers" in opts and isinstance(opts["headers"], JSObject):
+            for k, v in opts["headers"].items():
+                headers[to_js_string(k)] = to_js_string(v)
+        body = b""
+        if "body" in opts and opts["body"] not in (None, UNDEFINED):
+            body = to_js_string(opts["body"]).encode()
+        url = to_js_string(path)
+        if not url.startswith("/"):
+            url = "/" + url
+        split = urlsplit(url)
+        query = {k: v[-1] for k, v in parse_qs(split.query).items()}
+        # the identity header the mesh's auth proxy injects in front of
+        # every backend — the browser itself never sends it
+        headers.setdefault("kubeflow-userid", self.user)
+        cookie = self.document["cookie"]
+        if cookie:
+            headers["Cookie"] = cookie
+        from kubeflow_tpu.web.http import Request
+        self.requests.append((method, url))
+        resp = self.app.handle(
+            Request(method, split.path, headers, body, query))
+        set_cookie = resp.headers.get("Set-Cookie")
+        if set_cookie:
+            self.document["cookie"] = set_cookie
+        return JSPromise(self._make_response(resp))
+
+    def _make_response(self, resp):
+        status = resp.status
+        body = resp.body
+
+        def js_json():
+            try:
+                return JSPromise(_from_python(_json.loads(body)))
+            except ValueError:
+                return JSPromise(error=make_error("invalid json"),
+                                 rejected=True)
+
+        return JSObject({
+            "ok": 200 <= status < 300,
+            "status": float(status),
+            "statusText": _STATUS_TEXT.get(status, str(status)),
+            "json": js_json,
+            "text": lambda: JSPromise(body.decode()),
+        })
+
+    # -------------------------------------------------------- timers
+    def _set_timeout(self, fn, ms=0.0):
+        self._timer_seq += 1
+        tid = float(self._timer_seq)
+        heapq.heappush(self._timers,
+                       (self.clock + (ms or 0.0), tid, fn))
+        return tid
+
+    def _clear_timeout(self, tid=UNDEFINED):
+        if tid in (None, UNDEFINED):
+            return
+        self._timers = [t for t in self._timers if t[1] != tid]
+        heapq.heapify(self._timers)
+
+    def advance(self, ms):
+        """Move the virtual clock forward, firing due timers in order
+        (timers re-armed during the run fire too if they come due)."""
+        self.clock += float(ms)
+        for _ in range(10000):
+            if not self._timers or self._timers[0][0] > self.clock:
+                return
+            _, _, fn = heapq.heappop(self._timers)
+            call_value(fn, UNDEFINED, [])
+        raise JSMiniError("timer storm: >10000 timers in one advance()")
+
+    # ------------------------------------------------- test utilities
+    def query(self, selector):
+        found = self.document._query_all(selector)
+        return found[0] if found else None
+
+    def query_all(self, selector):
+        return self.document._query_all(selector)
+
+    def text(self, el=None):
+        # identity check, not truthiness: an Element with no dict
+        # props is a falsy empty dict
+        target = self.document.body if el is None else el
+        return target._text_content()
+
+    def click(self, target):
+        el = self.query(target) if isinstance(target, str) else target
+        if el is None:
+            raise AssertionError(f"no element for {target!r}")
+        return el._fire("click")
+
+    def set_value(self, target, value):
+        el = self.query(target) if isinstance(target, str) else target
+        if el is None:
+            raise AssertionError(f"no element for {target!r}")
+        el["value"] = to_js_string(value)
+        el._fire("input")
+        el._fire("change")
+
+    def set_checked(self, target, checked):
+        el = self.query(target) if isinstance(target, str) else target
+        el["checked"] = bool(checked)
+        el._fire("change", {"target": el})
+
+    def keydown(self, target, key, ctrl=False):
+        el = self.query(target) if isinstance(target, str) else target
+        return el._fire("keydown", {"key": key, "ctrlKey": ctrl})
+
+    def go(self, path):
+        """Navigate the hash router from the outside."""
+        self.location["hash"] = path
+
+    def snackbar(self):
+        el = self.query("#kf-snackbar")
+        return el._text_content() if el is not None else ""

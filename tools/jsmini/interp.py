@@ -11,6 +11,7 @@ import json
 import math
 import os
 import re
+import urllib.parse
 
 from .parser import parse_module
 
@@ -176,10 +177,22 @@ class JSPromise:
     the await (or routes to .catch). Rejection is a FLAG, not an
     error-is-None check — `Promise.reject(null)` must stay rejected."""
 
-    def __init__(self, value=None, error=None, rejected=False):
+    def __init__(self, value=None, error=None, rejected=False,
+                 pending=False):
         self.value = value
         self.error = error          # the rejection reason (any JS value)
         self.rejected = rejected or error is not None
+        # `new Promise(executor)` whose executor did not settle
+        # synchronously: there is no event loop to settle it later, so
+        # any consumption fails loudly instead of yielding undefined
+        self.pending = pending
+
+    def _check_settled(self):
+        if self.pending:
+            raise JSMiniError(
+                "promise is still pending — jsmini promises settle "
+                "synchronously (the executor must call resolve/reject "
+                "before returning; trigger the settling action first)")
 
     @staticmethod
     def _run(handler, arg):
@@ -193,6 +206,7 @@ class JSPromise:
         return out if isinstance(out, JSPromise) else JSPromise(out)
 
     def then(self, on_ok=None, on_err=None):
+        self._check_settled()
         if self.rejected:
             if on_err not in (None, UNDEFINED):
                 return self._run(on_err, self.error)
@@ -203,6 +217,17 @@ class JSPromise:
 
     def catch(self, on_err):
         return self.then(None, on_err)
+
+    def settle(self, value=UNDEFINED):
+        if self.pending:
+            self.pending = False
+            self.value = value
+
+    def settle_rejected(self, error=UNDEFINED):
+        if self.pending:
+            self.pending = False
+            self.rejected = True
+            self.error = error
 
     def finally_(self, fn):
         try:
@@ -216,10 +241,29 @@ def promise_resolve(v=UNDEFINED):
     return v if isinstance(v, JSPromise) else JSPromise(v)
 
 
+def promise_executor(executor):
+    """`new Promise(executor)` with the sync-settle model: the executor
+    runs NOW; resolve/reject settle the returned promise in place, so a
+    handler invoked from inside the executor (e.g. an auto-clicked
+    dialog button) settles it before the constructor returns. If
+    nothing settles it, consumption raises via _check_settled."""
+    p = JSPromise(pending=True)
+
+    def resolve(v=UNDEFINED):
+        p.settle(v)
+
+    def reject(e=UNDEFINED):
+        p.settle_rejected(e)
+
+    call_value(executor, UNDEFINED, [resolve, reject])
+    return p
+
+
 def promise_all(arr):
     out = JSArray()
     for x in arr:
         if isinstance(x, JSPromise):
+            x._check_settled()
             if x.rejected:
                 return JSPromise(error=x.error, rejected=True)
             out.append(x.value)
@@ -626,7 +670,9 @@ def _array_method(arr, name):
                 else:
                     out.append(x)
             return out
-        return JSArray(go(arr, int(to_number(depth))))
+        # float depth so flat(Infinity) works (h() flattens children
+        # with it); comparison/decrement stay well-defined on inf
+        return JSArray(go(arr, to_number(depth)))
 
     def reduce(fn, *init):
         it = list(arr)
@@ -702,8 +748,10 @@ def get_member(obj, name, interp=None):
         m = STRING_METHODS.get(name)
         if m is not None:
             return m(obj)
-        raise JSThrow(make_error(
-            f"string method {name} not supported", TYPE_ERROR_CLASS))
+        # real-JS semantics: unknown members read as undefined (code
+        # legitimately probes, e.g. `x.phase || String(x)` duck-typing
+        # a status that may be an object or a plain string)
+        return UNDEFINED
     if isinstance(obj, JSArray):
         if name == "length":
             return float(len(obj))
@@ -756,6 +804,10 @@ def get_member(obj, name, interp=None):
     if isinstance(obj, float):
         if name == "toFixed":
             return lambda d=0.0: f"{obj:.{int(d)}f}"
+        if name == "toPrecision":
+            return lambda p=UNDEFINED: (
+                num_to_str(obj) if p is UNDEFINED
+                else _to_precision(obj, int(to_number(p))))
         if name == "toString":
             return lambda base=10.0: (num_to_str(obj) if base == 10
                                       else _to_base(obj, int(base)))
@@ -767,6 +819,26 @@ def get_member(obj, name, interp=None):
     if callable(obj):
         return UNDEFINED
     raise JSMiniError(f"member access on {type(obj).__name__}")
+
+
+def _to_precision(x, p):
+    """Number.prototype.toPrecision: fixed notation (zero-padded to p
+    significant digits) inside the JS threshold, exponential outside.
+    Round FIRST, then pick notation from the rounded value — a carry
+    past a power of ten ((9.99).toPrecision(2) === "10") must not gain
+    an extra digit; exponents print without zero padding ("1.2e+2")."""
+    if math.isnan(x):
+        return "NaN"
+    if math.isinf(x):
+        return "Infinity" if x > 0 else "-Infinity"
+    if x == 0:
+        return f"{0:.{p - 1}f}" if p > 1 else "0"
+    rounded = float(f"{x:.{p - 1}e}")
+    e = math.floor(math.log10(abs(rounded)))
+    if e < -6 or e >= p:
+        mant, exp = f"{rounded:.{p - 1}e}".split("e")
+        return f"{mant}e{int(exp):+d}"
+    return f"{rounded:.{max(p - 1 - e, 0)}f}"
 
 
 def _to_base(f, base):
@@ -903,12 +975,16 @@ def make_globals(interp):
         }),
         "undefined": UNDEFINED,
         "globalThis": UNDEFINED,
-        "Promise": JSObject({
+        "Promise": _CallableObject(promise_executor, {
             "resolve": promise_resolve,
             "reject": lambda v=UNDEFINED: JSPromise(error=v,
                                                     rejected=True),
             "all": promise_all,
         }),
+        "encodeURIComponent": lambda s: urllib.parse.quote(
+            to_js_string(s), safe="!'()*-._~"),
+        "decodeURIComponent": lambda s: urllib.parse.unquote(
+            to_js_string(s)),
     }
     num = g["Number"]
 
@@ -989,14 +1065,18 @@ def _parse_int(s, base=10.0):
 # ---------------------------------------------------------- interpreter
 
 class Interpreter:
-    def __init__(self, loader=None):
+    def __init__(self, loader=None, extra_globals=None):
         self.loader = loader
+        # host-injected globals (document/window/fetch… from the DOM
+        # harness); merged AFTER the standard set so a page can shadow
+        self.extra_globals = extra_globals or {}
 
     # -- module execution
     def run_module(self, src, module_dir=None):
         ast = parse_module(src)
         env = Env()
         env.vars.update(make_globals(self))
+        env.vars.update(self.extra_globals)
         exports = {}
         hoisted = []
         for st in ast[1]:
@@ -1540,6 +1620,7 @@ class Interpreter:
     def e_await(self, node, env):
         v = self.eval(node[1], env)
         if isinstance(v, JSPromise):
+            v._check_settled()
             if v.rejected:
                 raise JSThrow(v.error)
             return v.value
