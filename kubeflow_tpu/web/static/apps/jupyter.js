@@ -10,7 +10,8 @@
 
 import {
   age, api, clear, currentNamespace, eventsTable, Field, FieldGroup, h,
-  indexPage, LogsViewer, Router, RowList, snack, statusIcon, tabPanel,
+  indexPage, LogsViewer, Router, RowList, snack, statusIcon, t,
+  tabPanel,
   validators, YamlEditor, yamlDump,
 } from "../lib/components.js";
 
@@ -235,7 +236,7 @@ async function formView(el) {
   const buildBody = () => {
     const groups = [basics, workspace, advanced];
     if (!groups.every((g) => g.validate()) || !datavols.validate()) {
-      snack("fix the highlighted fields", "error");
+      snack(t("fix the highlighted fields"), "error");
       return null;
     }
     const b = basics.values();

@@ -4,7 +4,7 @@
 
 import {
   age, api, currentNamespace, Field, FieldGroup, h, indexPage, Router, snack,
-  statusIcon, validators,
+  statusIcon, t, validators,
 } from "../lib/components.js";
 
 const outlet = document.getElementById("app");
@@ -12,31 +12,32 @@ let router = null;
 
 async function indexView(el) {
   await indexPage(el, {
-    newLabel: "New tensorboard",
+    newLabel: t("New tensorboard"),
     onNew: () => router.go("/new"),
     table: {
-      empty: "no tensorboards in this namespace",
+      empty: t("no tensorboards in this namespace"),
       load: async (ns) =>
         (await api("GET", `api/namespaces/${ns}/tensorboards`))
           .tensorboards,
       columns: [
-        { key: "status", label: "Status", sort: false,
+        { key: "status", label: t("Status"), sort: false,
           render: (r) => statusIcon(r.status) },
-        { key: "name", label: "Name" },
-        { key: "logspath", label: "Logs path" },
-        { key: "age", label: "Created", render: (r) => age(r.age) },
+        { key: "name", label: t("Name") },
+        { key: "logspath", label: t("Logs path") },
+        { key: "age", label: t("Created"), render: (r) => age(r.age) },
       ],
       actions: [
-        { id: "connect", label: "connect", cls: "primary",
+        { id: "connect", label: t("connect"), cls: "primary",
           show: (r) => r.status && r.status.phase === "ready",
           run: (r) => window.open(
             `/tensorboard/${currentNamespace()}/${r.name}/`, "_blank") },
-        { id: "delete", label: "delete", cls: "danger", confirm: true,
+        { id: "delete", label: t("delete"), cls: "danger",
+          confirm: true,
           run: async (r) => {
             await api("DELETE",
               `api/namespaces/${currentNamespace()}/tensorboards/` +
               r.name);
-            snack(`deleted ${r.name}`, "success");
+            snack(t("deleted {name}", { name: r.name }), "success");
           } },
       ],
     },
@@ -46,9 +47,9 @@ async function indexView(el) {
 async function formView(el) {
   const ns = currentNamespace();
   const fields = new FieldGroup([
-    new Field({ id: "name", label: "Name",
+    new Field({ id: "name", label: t("Name"),
       checks: [validators.required, validators.dns1123] }),
-    new Field({ id: "logspath", label: "Logs path",
+    new Field({ id: "logspath", label: t("Logs path"),
       value: "pvc://workspace/logs",
       hint: "pvc://<claim>/<subpath> or gs://bucket/path — TPU " +
         "profiler dumps land under <logs>/plugins/profile" }),
@@ -59,7 +60,7 @@ async function formView(el) {
     try {
       await api("POST", `api/namespaces/${ns}/tensorboards`,
         { name: v.name, logspath: v.logspath });
-      snack(`created ${v.name}`, "success");
+      snack(t("created {name}", { name: v.name }), "success");
       router.go("/");
     } catch (e) {
       snack(String(e.message || e), "error");
@@ -67,13 +68,15 @@ async function formView(el) {
   };
   el.append(
     h("div.kf-toolbar", {},
-      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
-      h("h2", {}, `New tensorboard in ${ns}`)),
+      h("button.ghost", { onclick: () => router.go("/") },
+        t("← back")),
+      h("h2", {}, t("New tensorboard in {ns}", { ns }))),
     h("div.kf-section", {}, fields.fields.map((f) => f.element)),
     h("div.kf-form-actions", {},
       h("button.primary", { id: "submit-tensorboard", onclick: submit },
-        "Create"),
-      h("button.ghost", { onclick: () => router.go("/") }, "Cancel")));
+        t("Create")),
+      h("button.ghost", { onclick: () => router.go("/") },
+        t("Cancel"))));
 }
 
 router = new Router(outlet, [

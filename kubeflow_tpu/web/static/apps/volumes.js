@@ -4,7 +4,7 @@
 
 import {
   api, currentNamespace, eventsTable, Field, FieldGroup, h, indexPage,
-  Router, snack, statusIcon, tabPanel, validators,
+  Router, snack, statusIcon, t, tabPanel, validators,
 } from "../lib/components.js";
 
 const outlet = document.getElementById("app");
@@ -12,35 +12,36 @@ let router = null;
 
 async function indexView(el) {
   await indexPage(el, {
-    newLabel: "New volume",
+    newLabel: t("New volume"),
     onNew: () => router.go("/new"),
     table: {
-      empty: "no volumes in this namespace",
+      empty: t("no volumes in this namespace"),
       load: async (ns) =>
         (await api("GET", `api/namespaces/${ns}/pvcs`)).pvcs,
       columns: [
-        { key: "status", label: "Status", sort: false,
+        { key: "status", label: t("Status"), sort: false,
           render: (r) => statusIcon(
             (r.status || "").toLowerCase ? (r.status || "").toLowerCase()
                                          : r.status) },
-        { key: "name", label: "Name",
+        { key: "name", label: t("Name"),
           render: (r) => h("a", {
             href: `#/details/${encodeURIComponent(r.name)}`,
           }, r.name) },
-        { key: "capacity", label: "Size" },
-        { key: "class", label: "Storage class" },
-        { key: "modes", label: "Access modes",
+        { key: "capacity", label: t("Size") },
+        { key: "class", label: t("Storage class") },
+        { key: "modes", label: t("Access modes"),
           render: (r) => (r.modes || []).join(", ") },
-        { key: "usedBy", label: "Used by",
+        { key: "usedBy", label: t("Used by"),
           render: (r) => (r.usedBy || []).join(", ") || "—" },
       ],
       actions: [
-        { id: "delete", label: "delete", cls: "danger",
-          confirm: "Deleting a PVC that a notebook mounts will break it.",
+        { id: "delete", label: t("delete"), cls: "danger",
+          confirm:
+            t("Deleting a PVC that a notebook mounts will break it."),
           run: async (r) => {
             await api("DELETE",
               `api/namespaces/${currentNamespace()}/pvcs/${r.name}`);
-            snack(`deleted ${r.name}`, "success");
+            snack(t("deleted {name}", { name: r.name }), "success");
           } },
       ],
     },
@@ -57,20 +58,20 @@ async function formView(el) {
     classes = null;   // listing restricted: fall back to free text
   }
   const scField = classes
-    ? new Field({ id: "storageClass", label: "Storage class",
+    ? new Field({ id: "storageClass", label: t("Storage class"),
         value: "",
-        options: [{ value: "", label: "(cluster default)" },
+        options: [{ value: "", label: t("(cluster default)") },
                   ...classes],
         checks: [validators.optional] })
     : new Field({ id: "storageClass",
-        label: "Storage class (blank = default)", value: "",
+        label: t("Storage class (blank = default)"), value: "",
         checks: [validators.optional] });
   const fields = new FieldGroup([
-    new Field({ id: "name", label: "Name",
+    new Field({ id: "name", label: t("Name"),
       checks: [validators.required, validators.dns1123] }),
-    new Field({ id: "size", label: "Size", value: "10Gi",
+    new Field({ id: "size", label: t("Size"), value: "10Gi",
       checks: [validators.quantity] }),
-    new Field({ id: "mode", label: "Access mode",
+    new Field({ id: "mode", label: t("Access mode"),
       options: ["ReadWriteOnce", "ReadWriteMany", "ReadOnlyMany"] }),
     scField,
   ]);
@@ -82,7 +83,7 @@ async function formView(el) {
         name: v.name, size: v.size, mode: v.mode,
         class: v.storageClass || undefined,
       });
-      snack(`created ${v.name}`, "success");
+      snack(t("created {name}", { name: v.name }), "success");
       router.go("/");
     } catch (e) {
       snack(String(e.message || e), "error");
@@ -90,13 +91,15 @@ async function formView(el) {
   };
   el.append(
     h("div.kf-toolbar", {},
-      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
-      h("h2", {}, `New volume in ${ns}`)),
+      h("button.ghost", { onclick: () => router.go("/") },
+        t("← back")),
+      h("h2", {}, t("New volume in {ns}", { ns }))),
     h("div.kf-section", {}, fields.fields.map((f) => f.element)),
     h("div.kf-form-actions", {},
       h("button.primary", { id: "submit-volume", onclick: submit },
-        "Create"),
-      h("button.ghost", { onclick: () => router.go("/") }, "Cancel")));
+        t("Create")),
+      h("button.ghost", { onclick: () => router.go("/") },
+        t("Cancel"))));
 }
 
 async function detailsView(el, params) {
@@ -104,10 +107,12 @@ async function detailsView(el, params) {
   const name = params.name;
   el.append(
     h("div.kf-toolbar", {},
-      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
+      h("button.ghost", { onclick: () => router.go("/") },
+        t("← back")),
       h("h2", {}, name)),
     tabPanel([
-      { id: "pods", label: "Pods using this volume", render: (pane) => {
+      { id: "pods", label: t("Pods using this volume"),
+        render: (pane) => {
         (async () => {
           const data = await api("GET",
             `api/namespaces/${ns}/pvcs/${name}/pods`);
@@ -115,10 +120,10 @@ async function detailsView(el, params) {
           pane.append(h("div.kf-section", {},
             pods.length
               ? h("ul", {}, pods.map((p) => h("li", {}, p)))
-              : h("p.kf-empty", {}, "not mounted by any pod")));
+              : h("p.kf-empty", {}, t("not mounted by any pod"))));
         })();
       } },
-      { id: "events", label: "Events", render: (pane) => {
+      { id: "events", label: t("Events"), render: (pane) => {
         (async () => {
           const data = await api("GET",
             `api/namespaces/${ns}/pvcs/${name}/events`);

@@ -14,6 +14,10 @@ import {
 
 import { age, duration, formatTimestamp } from "./datetime.js";
 
+import { locale, locales, setLocale, t } from "./i18n.js";
+
+export { locale, locales, setLocale, t };
+
 export { age, duration, formatTimestamp };
 
 /* ------------------------------------------------------ status icons */
@@ -44,7 +48,7 @@ export async function namespaceSelector(onChange) {
     id: "ns-select",
     onchange: () => { setNamespace(select.value); onChange(select.value); },
   }, names.map((n) => h("option", { value: n, selected: n === ns }, n)));
-  return { element: h("label.ns-label", {}, "namespace ", select),
+  return { element: h("label.ns-label", {}, t("namespace "), select),
            value: () => select.value };
 }
 
@@ -102,7 +106,7 @@ export class ResourceTable {
     if (!rows.length) {
       this.tbody.append(h("tr", {}, h("td.kf-empty", {
         colSpan: this.cfg.columns.length + 1,
-      }, this.cfg.empty || "nothing here yet")));
+      }, this.cfg.empty || t("nothing here yet"))));
       return;
     }
     for (const row of rows) {
@@ -163,20 +167,21 @@ export class LogsViewer {
   /* Polls a logs endpoint, renders tail-follow text (logs-viewer
    * component; backend route jupyter.py get_logs). */
   constructor(loadFn) {
-    this.pre = h("pre.kf-logs", {}, "loading logs…");
+    this.pre = h("pre.kf-logs", {}, t("loading logs…"));
     this.follow = true;
     this.element = h("div", {},
       h("div.kf-logs-bar", {},
         h("label", {},
           h("input", { type: "checkbox", checked: true,
             onchange: (e) => { this.follow = e.target.checked; } }),
-          " follow"),
-        h("button.ghost", { onclick: () => this.download() }, "download"),
+          t(" follow")),
+        h("button.ghost", { onclick: () => this.download() },
+          t("download")),
       ),
       this.pre);
     this.poller = new Poller(async () => {
       const text = await loadFn();
-      this.pre.textContent = text || "(no logs)";
+      this.pre.textContent = text || t("(no logs)");
       if (this.follow) this.pre.scrollTop = this.pre.scrollHeight;
     }, 4000);
     this.poller.kick();
@@ -200,14 +205,16 @@ export class LogsViewer {
 export function eventsTable(events) {
   return h("table.kf-table", {},
     h("thead", {}, h("tr", {},
-      ["type", "reason", "message", "when"].map((c) => h("th", {}, c)))),
+      ["type", "reason", "message", "when"]
+        .map((c) => h("th", {}, t(c))))),
     h("tbody", {},
       (events || []).length ? events.map((e) => h("tr", {},
         h("td", {}, e.type || ""),
         h("td", {}, e.reason || ""),
         h("td", {}, e.message || ""),
         h("td", {}, e.lastTimestamp || e.firstTimestamp || ""),
-      )) : h("tr", {}, h("td.kf-empty", { colSpan: 4 }, "no events"))));
+      )) : h("tr", {}, h("td.kf-empty", { colSpan: 4 },
+        t("no events")))));
 }
 
 /* ----------------------------------------------------- conditions table */
@@ -219,7 +226,7 @@ export function conditionsTable(conditions) {
   return h("table.kf-table.kf-conditions", {},
     h("thead", {}, h("tr", {},
       ["type", "status", "reason", "message", "last transition"]
-        .map((c) => h("th", {}, c)))),
+        .map((c) => h("th", {}, t(c))))),
     h("tbody", {},
       (conditions || []).length ? conditions.map((c) => h("tr", {},
         h("td", {}, c.type || ""),
@@ -232,7 +239,7 @@ export function conditionsTable(conditions) {
         h("td", { title: c.lastTransitionTime || "" },
           age(c.lastTransitionTime)),
       )) : h("tr", {}, h("td.kf-empty", { colSpan: 5 },
-        "no conditions"))));
+        t("no conditions")))));
 }
 
 /* -------------------------------------------------------- details list */
@@ -271,7 +278,7 @@ export function panel(title, body, { open = true } = {}) {
 
 export function loadingSpinner(label) {
   return h("div.kf-spinner", {}, h("span.kf-spinner-dot"),
-    label || "loading…");
+    label || t("loading…"));
 }
 
 /* ---------------------------------------------------------- tab panel */
@@ -302,11 +309,11 @@ export function tabPanel(tabs) {
 /* ------------------------------------------------------- form controls */
 
 export const validators = {
-  required: (v) => (v ? "" : "required"),
+  required: (v) => (v ? "" : t("required")),
   dns1123: (v) => (/^[a-z0-9]([-a-z0-9]*[a-z0-9])?$/.test(v)
-    ? "" : "lowercase alphanumeric and '-', must start/end alphanumeric"),
+    ? "" : t("lowercase alphanumeric and '-', must start/end alphanumeric")),
   quantity: (v) => (/^[0-9]+(\.[0-9]+)?(m|Mi|Gi|Ti|G|M|k|Ki)?$/.test(v)
-    ? "" : "not a valid quantity (e.g. 0.5, 500m, 1Gi)"),
+    ? "" : t("not a valid quantity (e.g. 0.5, 500m, 1Gi)")),
   optional: () => "",
 };
 
@@ -506,7 +513,8 @@ export class YamlEditor {
     const items = completionsAt(truncated, line, prefix, this.kind);
     if (!items.length) {
       this.setStatus(this.kindName()
-        ? "no completions here" : "no schema for this document",
+        ? t("no completions here")
+        : t("no schema for this document"),
       "warn");
       return;
     }
@@ -581,11 +589,11 @@ export class YamlEditor {
       const doc = this.parsed();
       const warns = schemaLint(doc, this.kind);
       if (warns.length) {
-        this.setStatus(`yaml ok · schema: ${warns[0]}`
+        this.setStatus(`${t("yaml ok")} · schema: ${warns[0]}`
           + (warns.length > 1 ? ` (+${warns.length - 1} more)` : ""),
         "warn");
       } else {
-        this.setStatus("yaml ok", "");
+        this.setStatus(t("yaml ok"), "");
       }
       return true;
     } catch (e) {

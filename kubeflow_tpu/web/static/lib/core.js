@@ -5,6 +5,8 @@
  * foundations (Angular services: backend.service, snack-bar, poller —
  * components/crud-web-apps/common/frontend/kubeflow-common-lib). */
 
+import { t } from "./i18n.js";
+
 export function esc(v) {
   return String(v ?? "").replace(/[&<>"']/g, (c) => ({
     "&": "&amp;", "<": "&lt;", ">": "&gt;",
@@ -141,9 +143,9 @@ export function confirmDialog({ title, body, action, danger }) {
         h("h3", {}, title),
         h("p", {}, body || ""),
         h("div.kf-dialog-actions", {},
-          h("button.ghost", { onclick: () => close(false) }, "Cancel"),
+          h("button.ghost", { onclick: () => close(false) }, t("Cancel")),
           h("button" + (danger ? ".danger" : ".primary"),
-            { onclick: () => close(true) }, action || "OK"),
+            { onclick: () => close(true) }, action || t("OK")),
         ),
       ),
     );

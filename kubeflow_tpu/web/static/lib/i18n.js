@@ -1,0 +1,129 @@
+/* Message catalog — the runtime analogue of the reference's Angular
+ * i18n build (volumes/tensorboards frontends ship French catalogs:
+ * components/crud-web-apps/volumes/frontend/i18n/fr/messages.fr.xlf).
+ * The reference bakes one locale per build; a no-build ES-module app
+ * resolves the locale at runtime instead: localStorage kf-locale,
+ * else the browser language, else English.
+ *
+ * Keys ARE the English strings (gettext style) so call sites stay
+ * readable and untranslated keys degrade to English, matching the xlf
+ * source/target model. `{name}`-style placeholders substitute after
+ * lookup so translations can reorder them. */
+
+const FR = {
+  /* shared lib (kubeflow-common-lib surface) */
+  "namespace ": "espace de noms ",
+  "Cancel": "Annuler",
+  "OK": "OK",
+  "delete": "supprimer",
+  "edit": "modifier",
+  "connect": "connecter",
+  "start": "démarrer",
+  "stop": "arrêter",
+  "remove": "retirer",
+  "loading…": "chargement…",
+  "loading logs…": "chargement des journaux…",
+  "(no logs)": "(aucun journal)",
+  " follow": " suivre",
+  "download": "télécharger",
+  "nothing here yet": "rien ici pour l'instant",
+  "no events": "aucun événement",
+  "no conditions": "aucune condition",
+  "type": "type",
+  "reason": "raison",
+  "message": "message",
+  "when": "quand",
+  "status": "état",
+  "last transition": "dernière transition",
+  "yaml ok": "yaml valide",
+  "no completions here": "aucune complétion ici",
+  "no schema for this document": "aucun schéma pour ce document",
+  "fix the highlighted fields": "corrigez les champs en surbrillance",
+  "required": "requis",
+  "lowercase alphanumeric and '-', must start/end alphanumeric":
+    "alphanumérique minuscule et '-', doit commencer/finir " +
+    "alphanumérique",
+  "not a valid quantity (e.g. 0.5, 500m, 1Gi)":
+    "quantité invalide (ex. 0.5, 500m, 1Gi)",
+
+  /* volumes web app (reference messages.fr.xlf scope) */
+  "New volume": "Nouveau volume",
+  "New volume in {ns}": "Nouveau volume dans {ns}",
+  "no volumes in this namespace": "aucun volume dans cet espace de noms",
+  "Status": "État",
+  "Name": "Nom",
+  "Size": "Taille",
+  "Storage class": "Classe de stockage",
+  "Access modes": "Modes d'accès",
+  "Used by": "Utilisé par",
+  "Created": "Créé",
+  "Create": "Créer",
+  "Type": "Type",
+  "Volume name": "Nom du volume",
+  "Existing PVC": "PVC existant",
+  "Mount path": "Chemin de montage",
+  "Access mode": "Mode d'accès",
+  "(cluster default)": "(défaut du cluster)",
+  "Storage class (blank = default)":
+    "Classe de stockage (vide = défaut)",
+  "created {name}": "{name} créé",
+  "deleted {name}": "{name} supprimé",
+  "← back": "← retour",
+  "Deleting a PVC that a notebook mounts will break it.":
+    "Supprimer un PVC monté par un notebook le cassera.",
+  "Pods using this volume": "Pods utilisant ce volume",
+  "Events": "Événements",
+  "not mounted by any pod": "monté par aucun pod",
+
+  /* tensorboards web app (reference twa i18n scope) */
+  "New tensorboard": "Nouveau tensorboard",
+  "New tensorboard in {ns}": "Nouveau tensorboard dans {ns}",
+  "no tensorboards in this namespace":
+    "aucun tensorboard dans cet espace de noms",
+  "Logs path": "Chemin des journaux",
+};
+
+const CATALOGS = { en: null, fr: FR };   // en: identity
+
+let cached = null;   // resolved once; setLocale invalidates
+
+export function locale() {
+  /* try/catch, not typeof guards: the pure-JS test tier loads this
+   * module without a DOM, where localStorage/navigator throw.
+   * Resolution is cached — t() runs per rendered string, and a poll
+   * tick re-renders whole tables */
+  if (cached !== null) return cached;
+  let saved = null;
+  try { saved = localStorage.getItem("kf-locale"); } catch (e) { /* */ }
+  if (saved && CATALOGS[saved] !== undefined) {
+    cached = saved;
+    return cached;
+  }
+  let nav = "en";
+  try {
+    nav = (window.navigator && window.navigator.language) || "en";
+  } catch (e) { /* no DOM */ }
+  const lang = nav.split("-")[0];
+  cached = CATALOGS[lang] !== undefined ? lang : "en";
+  return cached;
+}
+
+export function setLocale(l) {
+  localStorage.setItem("kf-locale", l);
+  cached = null;
+}
+
+export function locales() {
+  return Object.keys(CATALOGS);
+}
+
+export function t(key, subs) {
+  const cat = CATALOGS[locale()];
+  let out = (cat && cat[key] !== undefined) ? cat[key] : key;
+  if (subs) {
+    for (const [k, v] of Object.entries(subs)) {
+      out = out.replace("{" + k + "}", String(v));
+    }
+  }
+  return out;
+}

@@ -569,7 +569,8 @@ class Window(EventTargetObject):
 
     def __contains__(self, name):
         return name in ("addEventListener", "removeEventListener",
-                        "open", "location")
+                        "open", "location") \
+            or dict.__contains__(self, name)
 
     def __getitem__(self, name):
         if name == "addEventListener":
@@ -580,6 +581,8 @@ class Window(EventTargetObject):
             return self._open
         if name == "location":
             return self._page.location
+        if dict.__contains__(self, name):   # navigator etc., test-set
+            return dict.__getitem__(self, name)
         return UNDEFINED
 
     def _open(self, url, target=UNDEFINED):
