@@ -324,8 +324,23 @@ def bench_serving(steps, batch):
             _, elapsed, failures = post()
             lat.append(elapsed)
             retried += failures
-        post(bin_payload)      # warm the binary path
-        bin_lat = sorted(post(bin_payload)[1] for _ in range(steps))
+        # fp32 and int8 binary-path probes are INTERLEAVED in one loop:
+        # tunnel weather swings ±45% between runs (BASELINE r4 note),
+        # and the r4 artifact measured int8 minutes after fp32 — the
+        # recorded +44% did not reproduce under same-weather probing
+        # (hack/int8_lab.py r5: device-side int8 is 0.95x fp32, HTTP
+        # paths equal within noise). Interleaving makes the comparison
+        # weather-proof by construction.
+        int8_url = (f"http://127.0.0.1:{port}/v1/models/"
+                    f"resnet50-int8:predict")
+        post(bin_payload)                    # warm the binary path
+        post(bin_payload, to_url=int8_url)   # warm/compile int8
+        bin_samples, int8_samples = [], []
+        for _ in range(steps):
+            bin_samples.append(post(bin_payload)[1])
+            int8_samples.append(post(bin_payload, to_url=int8_url)[1])
+        bin_lat = sorted(bin_samples)
+        int8_lat = sorted(int8_samples)
 
         # pipelined stream route (serving.py :predictStream): one
         # keep-alive connection, NDJSON of b64 requests, decode of
@@ -355,9 +370,16 @@ def bench_serving(steps, batch):
                     f"{data[:300]!r}")
             return dt_s
 
+        # streams interleaved fp/int8 for the same reason; two runs
+        # each, adjacent in time, averaged
         run_stream(2)                       # warm
-        stream_s = run_stream(steps)
-        stream_pps = steps * batch / stream_s
+        run_stream(2, model="resnet50-int8")
+        stream_runs, int8_stream_runs = [], []
+        for _ in range(2):
+            stream_runs.append(run_stream(steps))
+            int8_stream_runs.append(
+                run_stream(steps, model="resnet50-int8"))
+        stream_pps = steps * batch * 2 / sum(stream_runs)
 
         # sequential b64 over ONE persistent connection — the
         # measurement that actually exercises HTTP/1.1 keep-alive
@@ -378,21 +400,12 @@ def bench_serving(steps, batch):
         ka_lat = sorted(ka_post() for _ in range(steps))
         ka.close()
 
-        # int8 path: warm, then b64 latency + stream throughput +
-        # accuracy delta vs the fp32 model on the identical input
-        int8_url = (f"http://127.0.0.1:{port}/v1/models/"
-                    f"resnet50-int8:predict")
+        # int8 accuracy delta vs the fp32 model on the identical input
         fp32_probs = np.asarray(predict(arr))
         int8_probs = np.asarray(predict_int8(arr))
         top1_agree = float(
             (fp32_probs.argmax(-1) == int8_probs.argmax(-1)).mean())
         max_prob_delta = float(np.max(np.abs(fp32_probs - int8_probs)))
-
-        post(bin_payload, to_url=int8_url)  # warm/compile
-        int8_lat = sorted(post(bin_payload, to_url=int8_url)[1]
-                          for _ in range(steps))
-        run_stream(2, model="resnet50-int8")
-        int8_stream_s = run_stream(steps, model="resnet50-int8")
     finally:
         server.stop()
     dt = sum(lat)       # successful attempts only (see post())
@@ -434,7 +447,8 @@ def bench_serving(steps, batch):
                        "int8_b64_p50_ms": round(
                            1000 * int8_lat[len(int8_lat) // 2], 1),
                        "int8_stream_predictions_per_sec": round(
-                           steps * batch / int8_stream_s, 1),
+                           steps * batch * 2 / sum(int8_stream_runs),
+                           1),
                        "int8_top1_agreement": round(top1_agree, 4),
                        "int8_max_prob_delta": round(
                            max_prob_delta, 5)}}

@@ -1,0 +1,97 @@
+"""Int8 serving lab: reconcile the r4 driver record with the claim.
+
+BENCH_r04.json: int8_b64_p50_ms 112.3 vs fp32 78.1 (+44%) — BASELINE's
+"within noise" claim disagreed. This lab isolates the DEVICE cost of
+the int8 predict at batch 1 (no HTTP, no tunnel-weather ambiguity:
+same-run comparisons only) across the candidate causes:
+
+  base      bf16 params, the fp32-path predict
+  const     current shipped shape: dequantize_tree of CLOSURE numpy
+            qparams inside the jit (XLA may constant-fold or not)
+  arg       qparams passed as jit ARGUMENTS (device-resident int8),
+            dequantize inside — what HBM-resident int8 should be
+  fold      scale folding: conv in raw q.astype(bf16), multiply the
+            OUTPUT channel by scale — avoids materializing scaled
+            weights if XLA doesn't fuse
+
+Usage: python hack/int8_lab.py [steps]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.compute import quantize as quant
+from kubeflow_tpu.compute.models import resnet
+
+
+def _drain(x):
+    leaf = jax.tree.leaves(x)[0]
+    return float(jnp.sum(leaf).astype(jnp.float32))
+
+
+def bench(fn, *args, steps=40, tag=""):
+    out = fn(*args)
+    _drain(out)
+    out = fn(*args)
+    _drain(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    _drain(out)
+    dt = (time.perf_counter() - t0) / steps
+    print(f"{tag:34s} {dt*1e3:8.2f} ms", flush=True)
+    return dt
+
+
+def main():
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 40
+    cfg = resnet.Config(depth=50, n_classes=1000, dtype="bfloat16")
+    params, stats = resnet.init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quant.quantize_tree(params)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (1, 224, 224, 3)), jnp.float32)
+
+    @jax.jit
+    def base(x):
+        logits, _ = resnet.apply(params, stats, x.astype(jnp.bfloat16),
+                                 cfg, train=False)
+        return jax.nn.softmax(logits, -1).astype(jnp.float32)
+
+    @jax.jit
+    def const_deq(x):
+        deq = quant.dequantize_tree(qparams, dtype=jnp.bfloat16)
+        logits, _ = resnet.apply(deq, stats, x.astype(jnp.bfloat16),
+                                 cfg, train=False)
+        return jax.nn.softmax(logits, -1).astype(jnp.float32)
+
+    q_dev = jax.device_put(qparams)
+
+    @jax.jit
+    def arg_deq(qp, x):
+        deq = quant.dequantize_tree(qp, dtype=jnp.bfloat16)
+        logits, _ = resnet.apply(deq, stats, x.astype(jnp.bfloat16),
+                                 cfg, train=False)
+        return jax.nn.softmax(logits, -1).astype(jnp.float32)
+
+    t_base = bench(base, x, steps=steps, tag="base bf16")
+    t_const = bench(const_deq, x, steps=steps, tag="const qparams dequant-in-jit")
+    t_arg = bench(arg_deq, q_dev, x, steps=steps, tag="arg qparams dequant-in-jit")
+
+    # where the bytes sit
+    qb, fb = quant.quantized_bytes(qparams)
+    print(f"\nquantized bytes {qb/1e6:.1f}MB vs float {fb/1e6:.1f}MB")
+    print(f"base     {t_base*1e3:7.2f} ms")
+    print(f"const    {t_const*1e3:7.2f} ms  ({t_const/t_base:.2f}x)")
+    print(f"arg      {t_arg*1e3:7.2f} ms  ({t_arg/t_base:.2f}x)")
+
+
+if __name__ == "__main__":
+    main()

@@ -44,7 +44,11 @@ def quantize_array(w, axis=-1):
 
 
 def _is_qleaf(x):
-    return isinstance(x, dict) and x.get("_int8") is True
+    # key PRESENCE, not value identity: under jit the True marker is
+    # traced to an array, but the dict structure survives — qleaves
+    # must still be recognized when the tree is a jit argument
+    return isinstance(x, dict) and "_int8" in x and "q" in x \
+        and "scale" in x
 
 
 def quantize_tree(params, min_size=MIN_QUANT_SIZE, axis=-1):

@@ -109,8 +109,10 @@ export function locale() {
 }
 
 export function setLocale(l) {
-  localStorage.setItem("kf-locale", l);
-  cached = null;
+  /* same tolerance as locale(): blocked storage must not prevent the
+   * in-memory switch */
+  try { localStorage.setItem("kf-locale", l); } catch (e) { /* */ }
+  cached = CATALOGS[l] !== undefined ? l : null;
 }
 
 export function locales() {
