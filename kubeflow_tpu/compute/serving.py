@@ -160,16 +160,72 @@ class _Batcher:
         self.q.put(None)
 
 
+def tree_bytes(params):
+    """Host-tree byte size (== device residency once loaded); int8
+    trees count their int8 bytes via quantized_bytes."""
+    from . import quantize as _q
+    return _q.quantized_bytes(params)[0]
+
+
+class ModelTooLargeError(Exception):
+    """The model alone exceeds the server's byte budget."""
+
+
 class ServedModel:
-    def __init__(self, name, predict_fn, version=1, batching=False,
-                 max_batch=64, batch_timeout_ms=5.0):
+    """One model. Two construction modes:
+
+    - closure (``predict_fn``): always resident, bytes unmanaged —
+      the original register() contract.
+    - managed (``make_fn`` + ``host_params``): the server owns device
+      residency. Weights live on device only while loaded; the predict
+      program takes them as ARGUMENTS (not jit constants), so
+      ``unload()`` actually frees the HBM — this is what the int8
+      4× byte saving buys (multi-model co-residency under a budget,
+      BASELINE r5 int8 note)."""
+
+    def __init__(self, name, predict_fn=None, version=1, batching=False,
+                 max_batch=64, batch_timeout_ms=5.0, make_fn=None,
+                 host_params=None):
         self.name = name
         self.version = version
-        self._fn = jax.jit(predict_fn)
         self.device_calls = 0
+        self.loads = 0
+        self.evictions = 0
+        self.last_used = time.monotonic()
+        if make_fn is not None:
+            self._managed = True
+            self._make_fn = jax.jit(make_fn)   # (params, x) -> out
+            self._host_params = host_params
+            self.resident_bytes = tree_bytes(host_params)
+            self._dev_params = None
+            self._fn = None
+        else:
+            self._managed = False
+            self._fn = jax.jit(predict_fn)
+            self.resident_bytes = 0
+            self._dev_params = None
+        self._ensure = None            # server residency hook
         self._batcher = _Batcher(
             self._run, max_batch=max_batch,
             timeout_s=batch_timeout_ms / 1000.0) if batching else None
+
+    @property
+    def loaded(self):
+        return (not self._managed) or self._dev_params is not None
+
+    def load(self):
+        if not self._managed or self._dev_params is not None:
+            return
+        self._dev_params = jax.device_put(self._host_params)
+        self.loads += 1
+
+    def unload(self):
+        """Drop the device copy; the weights' HBM is freed once no
+        in-flight dispatch still holds the old reference (dispatches
+        that already grabbed it complete safely)."""
+        if self._managed:
+            self._dev_params = None
+            self.evictions += 1
 
     def _run(self, x):
         out, n = self.dispatch(x)
@@ -180,12 +236,26 @@ class ServedModel:
         WITHOUT blocking on the result (JAX dispatch is async) —
         returns (device_future, rows). The stream route pipelines by
         dispatching request k+1 while k executes."""
+        if self._managed:
+            if self._ensure is not None:
+                # the hook returns the device tree PINNED under the
+                # residency lock — re-reading _dev_params here would
+                # race a concurrent eviction (budget overshoot or a
+                # None deref); holding this reference keeps the
+                # weights alive through our launch even if evicted
+                params = self._ensure(self)
+            else:
+                self.load()
+                params = self._dev_params
+        self.last_used = time.monotonic()
         n = x.shape[0]
         bucket = next((b for b in BATCH_BUCKETS if b >= n), n)
         if bucket > n:
             pad = np.zeros((bucket - n,) + x.shape[1:], x.dtype)
             x = np.concatenate([x, pad], axis=0)
         self.device_calls += 1
+        if self._managed:
+            return self._make_fn(params, x), n
         return self._fn(x), n
 
     @staticmethod
@@ -258,12 +328,20 @@ def _encode_tensor(x):
 
 class ModelServer:
     """Registry + HTTP server. ``server.register("mnist", fn)`` then
-    ``server.start(port)``; reference clients work unchanged."""
+    ``server.start(port)``; reference clients work unchanged.
 
-    def __init__(self):
+    ``budget_bytes`` bounds the device bytes of MANAGED models
+    (``register_loadable``): a predict on an unloaded model loads it,
+    evicting least-recently-used managed models until it fits — the
+    TF-Serving model-server semantics the reference delegates to,
+    with int8 quantization as the density lever."""
+
+    def __init__(self, budget_bytes=None):
         self._models = {}
         self._httpd = None
         self._thread = None
+        self.budget_bytes = budget_bytes
+        self._residency_lock = threading.Lock()
 
     def register(self, name, predict_fn, version=1, **model_kwargs):
         old = self._models.get(name)
@@ -272,13 +350,64 @@ class ModelServer:
         if old is not None:
             old.close()    # don't leak the displaced model's batcher
 
+    def register_loadable(self, name, make_fn, params, version=1,
+                          preload=False, **model_kwargs):
+        """Register a residency-managed model: ``make_fn(params, x)``
+        is the predict program, ``params`` the HOST tree (float or
+        quantize.quantize_tree output). Weights go on device on first
+        predict (or now, with ``preload``) and can be evicted."""
+        old = self._models.get(name)
+        model = ServedModel(name, version=version, make_fn=make_fn,
+                            host_params=params, **model_kwargs)
+        model._ensure = self._ensure_loaded
+        self._models[name] = model
+        if old is not None:
+            old.close()
+        if preload:
+            self._ensure_loaded(model)
+        return model
+
     def models(self):
         return dict(self._models)
+
+    # --------------------------------------------------- residency
+    def resident_bytes(self):
+        return sum(m.resident_bytes for m in self._models.values()
+                   if m._managed and m.loaded)
+
+    def _ensure_loaded(self, model):
+        """Make ``model`` device-resident under the byte budget,
+        evicting LRU managed models as needed, and return the pinned
+        device tree. Serialized: concurrent loads would both pass the
+        budget check and overshoot."""
+        with self._residency_lock:
+            if model.loaded:
+                return model._dev_params
+            budget = self.budget_bytes
+            if budget is not None:
+                if model.resident_bytes > budget:
+                    raise ModelTooLargeError(
+                        f"model {model.name} needs "
+                        f"{model.resident_bytes} bytes; budget is "
+                        f"{budget}")
+                loaded = sorted(
+                    (m for m in self._models.values()
+                     if m._managed and m.loaded and m is not model),
+                    key=lambda m: m.last_used)
+                in_use = sum(m.resident_bytes for m in loaded)
+                for victim in loaded:
+                    if in_use + model.resident_bytes <= budget:
+                        break
+                    victim.unload()
+                    in_use -= victim.resident_bytes
+            model.load()
+            return model._dev_params
 
     # -------------------------------------------------------- HTTP
 
     def _handler(self):
         models = self._models
+        server = self
 
         class Handler(BaseHTTPRequestHandler):
             # HTTP/1.1: connections persist across requests (every
@@ -330,6 +459,17 @@ class ModelServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            @staticmethod
+            def _residency(model):
+                return {
+                    "managed": model._managed,
+                    "loaded": model.loaded,
+                    "resident_bytes": model.resident_bytes
+                    if model._managed else None,
+                    "loads": model.loads,
+                    "evictions": model.evictions,
+                }
+
             def do_GET(self):
                 # /v1/models/<name> → model version status
                 parts = self.path.strip("/").split("/")
@@ -337,11 +477,32 @@ class ModelServer:
                     model = models.get(parts[2])
                     if model is None:
                         return self._send(404, {"error": "model not found"})
+                    # state stays AVAILABLE for evicted managed models:
+                    # a predict lazily reloads them, so they ARE
+                    # servable — readiness probes keyed on the
+                    # TF-Serving state enum must not pull the server
+                    # out of rotation. Residency lives in its own block.
                     return self._send(200, {"model_version_status": [{
                         "version": str(model.version),
                         "state": "AVAILABLE",
                         "status": {"error_code": "OK", "error_message": ""},
-                    }]})
+                    }], "residency": self._residency(model)})
+                if parts == ["v1", "models"]:
+                    # registry listing with residency state — what an
+                    # operator needs to see the byte budget working
+                    return self._send(200, {
+                        "budget_bytes": server.budget_bytes,
+                        "resident_bytes": server.resident_bytes(),
+                        "models": [{
+                            "name": m.name,
+                            "version": str(m.version),
+                            # operator view: RESIDENT/EVICTED is the
+                            # device truth; servability is the status
+                            # route's AVAILABLE
+                            "state": "RESIDENT" if m.loaded
+                            else "EVICTED",
+                            **self._residency(m),
+                        } for m in models.values()]})
                 if parts == ["healthz"]:
                     return self._send(200, {"status": "ok"})
                 self._send(404, {"error": "not found"})
@@ -379,6 +540,10 @@ class ModelServer:
                     out, infer = model.predict_raw(x)
                 except ValueError as e:     # scalar/ragged instances
                     return self._send(400, {"error": str(e)})
+                except ModelTooLargeError as e:
+                    # permanent capacity condition, not an inference
+                    # failure: 507 so retry loops keyed on 500 stop
+                    return self._send(507, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 — wire boundary
                     return self._send(500,
                                       {"error": f"inference failed: {e}"})
