@@ -1,0 +1,142 @@
+"""r5 LM lab: clear the 100k line (VERDICT r5 item 3).
+
+r4 state: 89.4k tok/s (91.7 ms/step) at the b8 bench shape; need
+≤82 ms-equivalent. Pieces (same-run step timings, drain idiom):
+
+  step    the r4 bench shape (b8, MHA, dense CE)
+  gqa     GQA sweep at b8 (n_kv_heads 8/4/2/1)
+  ladder  the FULL r5 ladder from BASELINE's LM note: b8 GQA sweep,
+          b16 dense/chunked CE × MHA/GQA, b32 probe — the rows that
+          justified the b16+GQA8:2 flagship
+  trace   dump a 5-step xplane trace of the bench step to
+          /tmp/lm_trace for op_profile parsing (the 62% matmul /
+          15.8% flash / 9.2% elementwise / 5.4% copy breakdown)
+
+Usage: python hack/lm_r5_lab.py [piece ...]   (default: step gqa)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.compute import mesh as mesh_lib
+from kubeflow_tpu.compute import train
+from kubeflow_tpu.compute.models import transformer
+
+S = 1024
+PEAK = 197e12
+
+
+def _drain(x):
+    leaf = jax.tree.leaves(x)[0]
+    return float(jnp.sum(leaf).astype(jnp.float32))
+
+
+def cfg_for(n_kv_heads=0, chunked=False):
+    return transformer.Config(
+        vocab_size=32768, d_model=1024, n_layers=12, n_heads=8,
+        n_kv_heads=n_kv_heads, max_seq=S, dtype="bfloat16",
+        attention="flash", remat=False, scan_layers=False,
+        chunked_ce=chunked)
+
+
+def step_time(cfg, batch=8, steps=15, tag=""):
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=-1))
+    opt = train.make_optimizer(learning_rate=3e-4, warmup_steps=10,
+                               total_steps=10_000)
+    state = train.init_state(
+        lambda k: transformer.init_params(cfg, k), opt, mesh,
+        transformer.logical_axes(cfg), jax.random.PRNGKey(0))
+    step = train.make_train_step(
+        train.plain_loss(transformer.loss_fn, cfg), opt, mesh)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (batch, S), 0,
+                              cfg.vocab_size)
+    data = {"tokens": toks, "targets": jnp.roll(toks, -1, 1)}
+    try:
+        for _ in range(3):
+            state, metrics = step(state, data)
+            _drain(metrics)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, data)
+        _drain(metrics)
+        dt = (time.perf_counter() - t0) / steps
+        tps = batch * S / dt
+        mfu = tps * transformer.flops_per_token(cfg) / PEAK
+        print(f"{tag:34s} {dt*1e3:7.2f} ms  {tps:9.0f} tok/s  "
+              f"mfu={mfu:.3f}  "
+              f"params={transformer.param_count(cfg)/1e6:.0f}M",
+              flush=True)
+        return dt
+    except Exception as e:  # noqa: BLE001 — OOM probes must report
+        print(f"{tag:34s} FAIL {str(e)[:90]}", flush=True)
+        return None
+    finally:
+        del state, step
+
+
+def lab_step():
+    step_time(cfg_for(0), batch=8, tag="r4 bench shape (b8, MHA)")
+
+
+def lab_gqa():
+    for kv in (8, 4, 2, 1):
+        step_time(cfg_for(kv), batch=8, tag=f"b8 GQA n_kv_heads={kv}")
+
+
+def lab_ladder():
+    """Every row of BASELINE.md's r5 LM ladder."""
+    step_time(cfg_for(0), batch=8, tag="b8 MHA dense CE (r4 shape)")
+    for kv in (4, 2, 1):
+        step_time(cfg_for(kv), batch=8, tag=f"b8 GQA 8:{kv}")
+    step_time(cfg_for(0), batch=16, tag="b16 MHA dense CE")
+    step_time(cfg_for(2), batch=16,
+              tag="b16 GQA 8:2 dense CE (flagship)")
+    step_time(cfg_for(1), batch=16, tag="b16 MQA 8:1 dense CE")
+    step_time(cfg_for(0, chunked=True), batch=16,
+              tag="b16 MHA chunked CE")
+    step_time(cfg_for(2), batch=32, tag="b32 GQA 8:2 probe")
+
+
+def lab_trace():
+    """Dump a trace of the bench step for op_profile parsing."""
+    import shutil
+    cfg = cfg_for(0)
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=-1))
+    opt = train.make_optimizer(learning_rate=3e-4, warmup_steps=10,
+                               total_steps=10_000)
+    state = train.init_state(
+        lambda k: transformer.init_params(cfg, k), opt, mesh,
+        transformer.logical_axes(cfg), jax.random.PRNGKey(0))
+    step = train.make_train_step(
+        train.plain_loss(transformer.loss_fn, cfg), opt, mesh)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, S), 0,
+                              cfg.vocab_size)
+    data = {"tokens": toks, "targets": jnp.roll(toks, -1, 1)}
+    for _ in range(3):
+        state, metrics = step(state, data)
+        _drain(metrics)
+    out = "/tmp/lm_trace"
+    shutil.rmtree(out, ignore_errors=True)
+    jax.profiler.start_trace(out)
+    for _ in range(5):
+        state, metrics = step(state, data)
+    _drain(metrics)
+    jax.profiler.stop_trace()
+    print("trace written to", out)
+
+
+if __name__ == "__main__":
+    pieces = sys.argv[1:] or ["step", "gqa"]
+    known = sorted(n[4:] for n in globals() if n.startswith("lab_"))
+    for p in pieces:
+        fn = globals().get(f"lab_{p}")
+        if fn is None:
+            sys.exit(f"unknown piece {p!r}; pieces: {', '.join(known)}")
+        fn()

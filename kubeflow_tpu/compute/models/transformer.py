@@ -64,6 +64,11 @@ class Config:
     pipeline_microbatches: int = 0
 
     def __post_init__(self):
+        if self.n_kv_heads and self.n_heads % self.n_kv_heads:
+            raise ValueError(
+                f"n_kv_heads={self.n_kv_heads} must divide "
+                f"n_heads={self.n_heads} (GQA groups q heads evenly "
+                f"over kv heads)")
         if self.chunked_ce and self.vocab_size % self.ce_chunk:
             raise ValueError(
                 f"ce_chunk={self.ce_chunk} must divide "
