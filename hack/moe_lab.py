@@ -22,13 +22,13 @@ from kubeflow_tpu.compute.models import transformer  # noqa: E402
 STEPS = 20
 
 
-def bench(dropless):
+def bench(dropless, cf=1.25, gmm="auto", tag=None):
     cfg = transformer.Config(
         vocab_size=32000, d_model=1024, n_layers=8, n_heads=8,
         max_seq=1024, dtype="bfloat16", attention="flash",
         remat=False, scan_layers=False,
         moe_experts=8, moe_top_k=2, moe_dropless=dropless,
-        moe_capacity_factor=1.25)
+        moe_capacity_factor=cf, moe_gmm=gmm)
     opt = train.make_optimizer()
 
     from kubeflow_tpu.compute import mesh as mesh_lib
@@ -51,7 +51,8 @@ def bench(dropless):
     dt = (time.perf_counter() - t0) / STEPS
     toks = 8 * 1024 / dt
     n = transformer.param_count(cfg)
-    print(f"{'dropless' if dropless else 'capacity'}: "
+    label = tag or ('dropless' if dropless else f'capacity cf={cf}')
+    print(f"{label}: "
           f"{dt * 1000:.1f} ms/step, {toks / 1e3:.1f}k tok/s, "
           f"loss {loss:.3f} ({n / 1e6:.0f}M params incl. experts)")
     return dt
@@ -60,11 +61,12 @@ def bench(dropless):
 def main():
     print(f"backend: {jax.default_backend()}")
     cap = bench(False)
-    drop = bench(True)
-    print(f"dropless throughput vs capacity: {cap / drop:.3f}x "
-          f"({'non-regressing' if drop <= cap * 1.02 else 'regression '
-             'at this capacity factor - compare vs the lossless cf, '
-             'see BASELINE r4'})")
+    cap_lossless = bench(False, cf=2.0, tag="capacity cf=2.0 (lossless)")
+    drop = bench(True, tag="dropless (pallas gmm)")
+    drop_ragged = bench(True, gmm=False, tag="dropless (ragged_dot)")
+    print(f"dropless/gmm vs capacity cf=1.25: {cap / drop:.3f}x; "
+          f"vs cf=2.0 equal-quality: {cap_lossless / drop:.3f}x; "
+          f"gmm engine vs ragged engine: {drop_ragged / drop:.3f}x")
 
 
 if __name__ == "__main__":

@@ -26,6 +26,7 @@ from .. import attention as attn_lib
 from .. import sharding
 from ..mesh import EXPERT as EXPERT_AXIS
 from ..ops import flash_attention
+from ..ops import grouped_matmul as gmm_lib
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,10 +54,19 @@ class Config:
     moe_experts: int = 0
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
-    # dropless dispatch (megablocks-style sort + grouped matmul via
-    # lax.ragged_dot): every routed token is computed, no capacity
-    # buffers. capacity_factor is ignored when set.
+    # dropless dispatch (megablocks-style sort + grouped matmul):
+    # every routed token is computed, no capacity buffers.
+    # capacity_factor is ignored when set. moe_gmm picks the grouped-
+    # matmul engine: the Pallas block-diagonal kernel
+    # (ops/grouped_matmul.py — 1.5x lax.ragged_dot on v5e, BASELINE r5
+    # MoE note) or the ragged_dot primitive for comparison.
     moe_dropless: bool = False
+    # "auto": Pallas on TPU, ragged_dot elsewhere (interpret-mode
+    # Pallas under a multi-axis SPMD mesh aborts XLA:CPU — the CPU
+    # tier runs the kernel directly in tests instead). True forces
+    # Pallas (single-device CPU tests), False forces ragged_dot.
+    moe_gmm: object = "auto"
+    moe_gmm_block_m: int = 128
     # GPipe pipeline parallelism (compute/pipeline.py, ADR-7): layers
     # stage-shard over the ``pipeline`` mesh axis. 0/1 = off;
     # pipeline_microbatches 0 → = pipeline_stages.
@@ -64,6 +74,10 @@ class Config:
     pipeline_microbatches: int = 0
 
     def __post_init__(self):
+        if self.moe_gmm not in (True, False, "auto"):
+            raise ValueError(
+                f"moe_gmm must be True, False or 'auto', got "
+                f"{self.moe_gmm!r}")
         if self.n_kv_heads and self.n_heads % self.n_kv_heads:
             raise ValueError(
                 f"n_kv_heads={self.n_kv_heads} must divide "
@@ -341,24 +355,80 @@ def _dropless_moe(h, lp, config):
         flat = idx.reshape(-1)                       # [N*k] global ids
         loc = flat - shard * e_local
         mine = (loc >= 0) & (loc < e_local)
-        # stable sort by local expert; foreign rows form a trailing
-        # dummy group with zero weights
+        # group by local expert; foreign rows form a trailing dummy
+        # group with zero weights
         key = jnp.where(mine, loc, e_local)
+        f = wg.shape[-1]
+        # ONE grouped matmul for gate|up: halves the launches on the
+        # input side and doubles the N tile for the MXU
+        wgu = jnp.concatenate([wg, wu], axis=-1)     # [e, d, 2f]
+        zgu = jnp.zeros((1,) + wgu.shape[1:], wgu.dtype)
+        zd = jnp.zeros((1,) + wd.shape[1:], wd.dtype)
+        wgu_a = jnp.concatenate([wgu, zgu])
+        wd_a = jnp.concatenate([wd, zd])
+        # ragged_dot engine (the TPU grouped-matmul primitive) — the
+        # expert-parallel path; the Pallas gmm engine runs only in the
+        # no-EP fast path below (a Mosaic kernel cannot be auto-
+        # partitioned under the partial-manual shard_map)
         order = jnp.argsort(key, stable=True)
         counts = jnp.bincount(key, length=e_local + 1).astype(jnp.int32)
         tok = order // k
         xg = jnp.take(hf, tok, axis=0)
-        zg = jnp.zeros((1,) + wg.shape[1:], wg.dtype)
-        zd = jnp.zeros((1,) + wd.shape[1:], wd.dtype)
-        gate_h = lax.ragged_dot(xg, jnp.concatenate([wg, zg]), counts)
-        up_h = lax.ragged_dot(xg, jnp.concatenate([wu, zg]), counts)
-        rows = lax.ragged_dot(jax.nn.silu(gate_h) * up_h,
-                              jnp.concatenate([wd, zd]), counts)
+        gu = lax.ragged_dot(xg, wgu_a, counts)
+        gate_h, up_h = gu[..., :f], gu[..., f:]
+        rows = lax.ragged_dot(jax.nn.silu(gate_h) * up_h, wd_a, counts)
         scale = gates.reshape(-1)[order] * mine[order].astype(gates.dtype)
         rows = rows * scale.astype(rows.dtype)[:, None]
         out = jnp.zeros_like(hf).at[tok].add(rows)
         return lax.psum(out, EXPERT_AXIS)
 
+    def gmm_inline(wg, wu, wd, hf, idx, gates):
+        """No-EP fast path: the Pallas block-diagonal grouped matmul
+        (ops/grouped_matmul.py) — 1.5× the ragged_dot primitive at the
+        flagship shape (BASELINE r5 MoE note). Runs OUTSIDE any
+        shard_map (a Mosaic kernel cannot be auto-partitioned), so it
+        is only taken when the expert mesh axis is 1."""
+        flat = idx.reshape(-1)
+        n_rows = flat.shape[0]
+        f = wg.shape[-1]
+        wgu = jnp.concatenate([wg, wu], axis=-1)
+        bm = config.moe_gmm_block_m
+        pos, be, fst, lst, m_pad = gmm_lib.padded_group_layout(
+            flat, e, bm)
+        # scatter ONE int per row (dest→src map), then gather the
+        # activations — cheaper than scattering [m_pad, d] floats;
+        # unmapped padding rows point at a trailing zero row
+        inv = jnp.full((m_pad,), n_rows // k, jnp.int32) \
+            .at[pos].set(jnp.arange(n_rows, dtype=jnp.int32) // k)
+        hf_aug = jnp.concatenate(
+            [hf, jnp.zeros((1, hf.shape[1]), hf.dtype)])
+        x_pad = jnp.take(hf_aug, inv, axis=0)
+        gu = gmm_lib.gmm(x_pad, wgu, be, fst, lst, bm)
+        act = jax.nn.silu(gu[..., :f]) * gu[..., f:]
+        rows_pad = gmm_lib.gmm(act, wd, be, fst, lst, bm)
+        rows = rows_pad[pos] * gates.reshape(-1).astype(
+            rows_pad.dtype)[:, None]
+        # rows are back in SOURCE order: the k choices of one token
+        # are adjacent, so the combine is a reshape-sum, not a scatter
+        return rows.reshape(n_rows // k, k, -1).sum(axis=1)
+
+    def _mesh_trivial():
+        # ALL axes, not just expert: a Mosaic kernel cannot be auto-
+        # partitioned, so any sharded axis (data on a dp slice, tensor
+        # on a tp mesh) would crash or silently all-gather hf
+        mesh = jax.sharding.get_abstract_mesh()
+        return mesh is None or all(
+            s == 1 for s in dict(mesh.shape).values())
+
+    def _gmm_shapes_ok():
+        # Mosaic lane tiles are 128-wide; ragged_dot accepts any shape
+        ff = config.ff_dim
+        return d % 128 == 0 and ff % 128 == 0
+
+    use_gmm = (config.moe_gmm is True
+               or (config.moe_gmm == "auto"
+                   and jax.default_backend() == "tpu"
+                   and _gmm_shapes_ok()))
     if _axis_is_manual(EXPERT_AXIS):
         # already inside a manual region that owns ``expert`` (the
         # pipeline shard_map) — weights arrive pre-localized; run the
@@ -366,6 +436,13 @@ def _dropless_moe(h, lp, config):
         out = manual(lp["we_gate"].astype(dt), lp["we_up"].astype(dt),
                      lp["we_down"].astype(dt), hf.astype(dt),
                      flat_idx, flat_gate.astype(dt))
+    elif use_gmm and _mesh_trivial():
+        # even forced-True yields to a sharded mesh: the kernel cannot
+        # run under auto-SPMD, so EP/dp/tp meshes take the ragged path
+        out = gmm_inline(lp["we_gate"].astype(dt),
+                         lp["we_up"].astype(dt),
+                         lp["we_down"].astype(dt), hf.astype(dt),
+                         flat_idx, flat_gate.astype(dt))
     else:
         from jax.sharding import PartitionSpec as P
         sm = jax.shard_map(
