@@ -128,3 +128,166 @@ def _pipeline_manual(layer_fn, n_micro, n_layers, axis, local, x):
         jnp.where(stage == n_stages - 1, out, jnp.zeros_like(out)), axis)
     aux = lax.psum(aux_sum, axis) / (n_layers * m)
     return out.reshape(x.shape), aux
+
+
+# ------------------------------------------------------------- 1F1B
+
+def train_1f1b(embed_fn, layer_fn, loss_fn, params, tokens, targets,
+               n_microbatches, axis=mesh_lib.PIPELINE,
+               aux_weight=0.0):
+    """One 1F1B pipeline training step: returns ``(loss, grads)``.
+
+    The GPipe path above differentiates THROUGH the tick scan, so
+    autodiff stacks residuals for every tick — activation memory grows
+    with the microbatch count M. 1F1B interleaves each microbatch's
+    backward into the schedule as soon as its cotangent exists, so at
+    most ``2P-1`` stage inputs are live per stage and the tick scan
+    carries gradients instead of residuals: activation memory is
+    bounded by the PIPELINE DEPTH, not by M (the bubble is unchanged —
+    1F1B is the memory schedule, not a throughput trick). The stage
+    backward recomputes its forward from the saved stage INPUT
+    (per-stage remat, the standard 1F1B companion).
+
+    Because the backward starts before all outputs exist, the loss
+    must live INSIDE the schedule: the last stage applies
+    ``loss_fn(head_params, y, targets_mb)`` per microbatch and seeds
+    its cotangent immediately (1/M so the sum is the global mean).
+
+    ``params``: ``{"embed", "layers", "head"}``; ``layers`` leaves
+    carry the leading layer dim (sharded over ``axis``); embed/head
+    are replicated. ``embed_fn(ep, tokens_mb) -> x0``;
+    ``layer_fn(lp, x) -> (y, aux)``; ``loss_fn(hp, y, tgt_mb) ->
+    scalar mean loss``. The per-layer ``aux`` (MoE load balancing)
+    joins the objective as ``aux_weight * mean(aux)`` with gradients
+    flowing — at the GPipe path callers add it themselves; here the
+    loss lives inside the schedule, so the weight must come in.
+    Schedule: stage s forwards microbatch f at tick ``s + f`` and
+    backwards b at ``2(P-1) - s + b`` — the last stage turns a
+    microbatch around in its own tick.
+    """
+    if tokens.shape[0] % n_microbatches:
+        raise ValueError(
+            f"batch {tokens.shape[0]} not divisible by "
+            f"n_microbatches={n_microbatches}")
+    layer_specs = jax.tree.map(lambda _: P(axis), params["layers"])
+    specs = {"embed": jax.tree.map(lambda _: P(), params["embed"]),
+             "layers": layer_specs,
+             "head": jax.tree.map(lambda _: P(), params["head"])}
+
+    fn = functools.partial(_train_1f1b_manual, embed_fn, layer_fn,
+                           loss_fn, n_microbatches, axis, aux_weight)
+    sm = jax.shard_map(
+        fn, in_specs=(specs, P(), P()),
+        out_specs=(P(), specs), axis_names={axis}, check_vma=False)
+    return sm(params, tokens, targets)
+
+
+def _train_1f1b_manual(embed_fn, layer_fn, loss_fn, n_micro, axis,
+                       aux_weight, params, tokens, targets):
+    n_stages = lax.axis_size(axis)
+    stage = lax.axis_index(axis)
+    m = n_micro
+    p = n_stages
+    mb = tokens.shape[0] // m
+    toks = tokens.reshape(m, mb, *tokens.shape[1:])
+    tgts = targets.reshape(m, mb, *targets.shape[1:])
+    local = params["layers"]          # this stage's [L/P, ...] block
+    eparams, hparams = params["embed"], params["head"]
+    n_local = jax.tree.leaves(local)[0].shape[0]
+    n_layers_total = n_local * p
+
+    def stage_fwd(lp, xin):
+        def one(carry, layer):
+            y, aux = layer_fn(layer, carry)
+            return y, aux
+        y, auxs = lax.scan(one, xin, lp)
+        return y, jnp.sum(auxs).astype(jnp.float32)
+
+    probe = embed_fn(eparams, toks[0])
+    act_shape, act_dtype = probe.shape, probe.dtype
+    ring = 2 * p                      # ≥ max in-flight per stage
+    perm_fwd = [(i, i + 1) for i in range(p - 1)]
+    perm_bwd = [(i + 1, i) for i in range(p - 1)]
+    ticks = m + 2 * (p - 1)
+
+    def tick(carry, t):
+        (recv_f, recv_b, resid, g_l, g_e, g_h, loss_sum) = carry
+
+        # ---------------- forward half: microbatch f = t - stage
+        f = t - stage
+        fvalid = (f >= 0) & (f < m)
+        fidx = jnp.clip(f, 0, m - 1)
+        tok_f = lax.dynamic_index_in_dim(toks, fidx, 0, keepdims=False)
+        x0 = embed_fn(eparams, tok_f)
+        xin = jnp.where(stage == 0, x0, recv_f)
+        slot_f = fidx % ring
+        old = lax.dynamic_index_in_dim(resid, slot_f, 0,
+                                       keepdims=False)
+        resid = lax.dynamic_update_index_in_dim(
+            resid, jnp.where(fvalid, xin, old), slot_f, 0)
+        y, aux_f = stage_fwd(local, xin)
+        # last stage: loss + its cotangent for THIS microbatch, now
+        tgt_f = lax.dynamic_index_in_dim(tgts, fidx, 0, keepdims=False)
+        loss_f, head_vjp = jax.vjp(
+            lambda hp, yy: loss_fn(hp, yy, tgt_f), hparams, y)
+        dh_f, dy_f = head_vjp(jnp.float32(1.0 / m))
+        last = stage == p - 1
+        loss_sum = loss_sum + jnp.where(
+            fvalid & last, loss_f / m, 0.0)
+        # per-layer aux joins the objective stage-locally (psum'd at
+        # the end); its gradient is seeded in the backward half below
+        loss_sum = loss_sum + jnp.where(
+            fvalid, aux_weight * aux_f / (n_layers_total * m), 0.0)
+        g_h = jax.tree.map(
+            lambda g, d: g + jnp.where(fvalid & last, d, 0.0),
+            g_h, dh_f)
+
+        # --------------- backward half: microbatch b = t-2(P-1)+stage
+        b = t - 2 * (p - 1) + stage
+        bvalid = (b >= 0) & (b < m)
+        bidx = jnp.clip(b, 0, m - 1)
+        slot_b = bidx % ring
+        xin_b_saved = lax.dynamic_index_in_dim(resid, slot_b, 0,
+                                               keepdims=False)
+        # the last stage turns the microbatch around within this tick
+        xin_b = jnp.where(last, xin, xin_b_saved)
+        cot = jnp.where(last, dy_f.astype(act_dtype),
+                        recv_b)
+        (yb, _auxb), stage_vjp = jax.vjp(stage_fwd, local, xin_b)
+        del yb                         # remat: recompute, keep nothing
+        dlocal, dxin = stage_vjp(
+            (cot.astype(act_dtype),
+             jnp.float32(aux_weight / (n_layers_total * m))))
+        g_l = jax.tree.map(
+            lambda g, d: g + jnp.where(bvalid, d, 0.0), g_l, dlocal)
+        # embedding gradient materializes at stage 0
+        tok_b = lax.dynamic_index_in_dim(toks, bidx, 0, keepdims=False)
+        _, embed_vjp = jax.vjp(embed_fn, eparams, tok_b)
+        de, = embed_vjp(dxin)[:1]
+        g_e = jax.tree.map(
+            lambda g, d: g + jnp.where(bvalid & (stage == 0), d, 0.0),
+            g_e, de)
+
+        recv_f2 = lax.ppermute(y, axis, perm_fwd)
+        recv_b2 = lax.ppermute(dxin, axis, perm_bwd)
+        return (recv_f2, recv_b2, resid, g_l, g_e, g_h,
+                loss_sum), None
+
+    zero_act = jnp.zeros(act_shape, act_dtype)
+    carry0 = (
+        zero_act, zero_act,
+        jnp.zeros((ring,) + act_shape, act_dtype),
+        jax.tree.map(lambda a: jnp.zeros_like(a, jnp.float32), local),
+        jax.tree.map(lambda a: jnp.zeros_like(a, jnp.float32), eparams),
+        jax.tree.map(lambda a: jnp.zeros_like(a, jnp.float32), hparams),
+        jnp.zeros((), jnp.float32),
+    )
+    (_, _, _, g_l, g_e, g_h, loss_sum), _ = lax.scan(
+        tick, carry0, jnp.arange(ticks))
+
+    # loss lives on the last stage; embed grads on stage 0; head grads
+    # on the last stage; layer grads are stage-local (stay sharded)
+    loss = lax.psum(loss_sum, axis)
+    g_e = lax.psum(g_e, axis)
+    g_h = lax.psum(g_h, axis)
+    return loss, {"embed": g_e, "layers": g_l, "head": g_h}

@@ -78,6 +78,156 @@ class TestPipelineMatchesScan:
         assert np.isclose(plain[0], piped[0], rtol=1e-5)
 
 
+class Test1F1B:
+    """train_1f1b (compute/pipeline.py): same math as the plain model,
+    activation memory bounded by pipeline depth instead of microbatch
+    count (the r5 VERDICT item: 1F1B peak-memory < GPipe at equal
+    loss)."""
+
+    D, V, L, S = 16, 32, 4, 8
+
+    def _params(self, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        scale = 0.3
+        return {
+            "embed": {"emb": jax.random.normal(
+                ks[0], (self.V, self.D)) * scale},
+            "layers": {"w": jax.random.normal(
+                ks[1], (self.L, self.D, self.D)) * scale},
+            "head": {"out": jax.random.normal(
+                ks[2], (self.D, self.V)) * scale},
+        }
+
+    @staticmethod
+    def _embed(ep, tok):
+        return ep["emb"][tok]
+
+    @staticmethod
+    def _layer(lp, x):
+        return x + jnp.tanh(x @ lp["w"]), jnp.float32(0.0)
+
+    @classmethod
+    def _loss(cls, hp, y, tgt):
+        logits = y @ hp["out"]
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        lab = jnp.take_along_axis(logits, tgt[..., None], -1)[..., 0]
+        return jnp.mean(logz - lab)
+
+    def _data(self, batch=8, seed=3):
+        tok = jax.random.randint(jax.random.PRNGKey(seed),
+                                 (batch, self.S), 0, self.V)
+        return tok, jnp.roll(tok, -1, axis=1)
+
+    def _plain(self, params, tok, tgt):
+        def loss_fn(p):
+            x = self._embed(p["embed"], tok)
+            def one(c, w):
+                y, _ = self._layer({"w": w}, c)
+                return y, None
+            y, _ = jax.lax.scan(one, x, p["layers"]["w"])
+            return self._loss(p["head"], y, tgt)
+        return jax.value_and_grad(loss_fn)(params)
+
+    def test_loss_and_grads_match_plain(self):
+        from kubeflow_tpu.compute import pipeline
+        params = self._params()
+        tok, tgt = self._data(batch=8)
+        mesh = _mesh(pipeline=2)
+        with jax.set_mesh(mesh):
+            loss, grads = jax.jit(lambda p: pipeline.train_1f1b(
+                self._embed, self._layer, self._loss, p, tok, tgt,
+                n_microbatches=4))(params)
+        loss_ref, grads_ref = self._plain(params, tok, tgt)
+        assert np.isclose(float(loss), float(loss_ref), rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(grads),
+                        jax.tree.leaves(grads_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_four_stages_many_microbatches(self):
+        from kubeflow_tpu.compute import pipeline
+        params = self._params(seed=5)
+        tok, tgt = self._data(batch=16, seed=6)
+        mesh = _mesh(pipeline=4)
+        with jax.set_mesh(mesh):
+            loss, grads = jax.jit(lambda p: pipeline.train_1f1b(
+                self._embed, self._layer, self._loss, p, tok, tgt,
+                n_microbatches=8))(params)
+        loss_ref, grads_ref = self._plain(params, tok, tgt)
+        assert np.isclose(float(loss), float(loss_ref), rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(grads),
+                        jax.tree.leaves(grads_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_aux_loss_flows_gradients(self):
+        """MoE-style per-layer aux joins the objective via aux_weight
+        with gradients, matching a plain reference that adds
+        weight * mean(aux)."""
+        from kubeflow_tpu.compute import pipeline
+        W = 0.3
+        params = self._params()
+        tok, tgt = self._data(batch=8)
+
+        def layer_aux(lp, x):
+            y = x + jnp.tanh(x @ lp["w"])
+            return y, jnp.mean(x ** 2)          # param-dependent aux
+
+        def plain(p):
+            x = self._embed(p["embed"], tok)
+            def one(c, w):
+                y, aux = layer_aux({"w": w}, c)
+                return y, aux
+            y, auxs = jax.lax.scan(one, x, p["layers"]["w"])
+            return self._loss(p["head"], y, tgt) + W * jnp.mean(auxs)
+
+        loss_ref, grads_ref = jax.value_and_grad(plain)(params)
+        mesh = _mesh(pipeline=2)
+        with jax.set_mesh(mesh):
+            loss, grads = jax.jit(lambda p: pipeline.train_1f1b(
+                self._embed, layer_aux, self._loss, p, tok, tgt,
+                n_microbatches=4, aux_weight=W))(params)
+        assert np.isclose(float(loss), float(loss_ref), rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(grads),
+                        jax.tree.leaves(grads_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_peak_memory_below_gpipe_at_equal_loss(self):
+        """The 1F1B claim itself: same loss, smaller activation
+        footprint. GPipe-through-autodiff stacks residuals per tick
+        (∝ microbatches); 1F1B's tick scan carries gradients and a
+        depth-bounded ring. Compared via the compiler's own memory
+        analysis on identical shapes with MANY microbatches."""
+        from kubeflow_tpu.compute import pipeline
+        params = self._params()
+        n_micro = 16
+        tok, tgt = self._data(batch=64)
+        mesh = _mesh(pipeline=2)
+
+        def gpipe_loss(p):
+            x = self._embed(p["embed"], tok)
+            y, _ = pipeline.pipelined_layers(
+                self._layer, {"w": p["layers"]["w"]}, x, n_micro)
+            return self._loss(p["head"], y, tgt)
+
+        with jax.set_mesh(mesh):
+            gpipe = jax.jit(jax.value_and_grad(gpipe_loss)) \
+                .lower(params).compile()
+            f1b = jax.jit(lambda p: pipeline.train_1f1b(
+                self._embed, self._layer, self._loss, p, tok, tgt,
+                n_microbatches=n_micro)).lower(params).compile()
+            loss_g = float(gpipe(params)[0])
+            loss_f = float(f1b(params)[0])
+        assert np.isclose(loss_g, loss_f, rtol=1e-5)
+        mem_g = gpipe.memory_analysis()
+        mem_f = f1b.memory_analysis()
+        assert mem_g is not None and mem_f is not None, \
+            "compiler memory analysis unavailable on this backend"
+        assert mem_f.temp_size_in_bytes < mem_g.temp_size_in_bytes, (
+            mem_f.temp_size_in_bytes, mem_g.temp_size_in_bytes)
+
+
 class TestPipelineComposition:
     def test_trains_with_data_and_tensor_axes(self):
         """pipeline×data×tensor mesh: full train step, loss decreases
