@@ -73,6 +73,26 @@ class TestTransformer:
                     state.params, toks)
         assert jnp.abs(ref - np.asarray(out)).max() < 1e-4
 
+    def test_gqa_tensor_parallel_matches_single_device(self):
+        """Grouped KV heads (GQA 4:2) sharded over the tensor axis —
+        the r5 flagship grouping composed with tp (kv-head repeat must
+        survive head-axis partitioning)."""
+        cfg = tiny_cfg(n_kv_heads=2)
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        toks = lm_batch()["tokens"]
+        ref = transformer.apply(params, toks, cfg)
+
+        mesh = M.make_mesh(data=4, tensor=2)   # 2 kv heads / 2 shards
+        state = T.init_state(
+            lambda k: transformer.init_params(cfg, k),
+            T.make_optimizer(), mesh, transformer.logical_axes(cfg),
+            jax.random.PRNGKey(0))
+        with jax.set_mesh(mesh):
+            out = jax.jit(
+                lambda p, t: transformer.apply(p, t, cfg))(
+                    state.params, toks)
+        assert jnp.abs(ref - np.asarray(out)).max() < 1e-4
+
     @pytest.mark.parametrize("attention", ["dense", "flash", "ring"])
     def test_training_reduces_loss(self, attention):
         cfg = tiny_cfg(attention=attention, max_seq=64)
