@@ -37,28 +37,13 @@ def main():
     junit = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
         REPO, "ci", "evidence", "kind_e2e_wire.xml")
 
-    from fake_apiserver import FakeApiServer
+    from fake_apiserver import (build_wire_harness,
+                                teardown_wire_harness)
 
-    from kubeflow_tpu.controllers import notebook, tpuslice
-    from kubeflow_tpu.controllers.workload_runtime import (
-        PodRuntimeReconciler, StatefulSetReconciler)
-    from kubeflow_tpu.core import Manager
-    from kubeflow_tpu.core.kubestore import KubeStore
-
-    server = FakeApiServer()
-    os.environ["KUBE_API_SERVER"] = server.url
-    os.environ["KUBE_TOKEN"] = "e2e-token"
-    os.environ["USE_ISTIO"] = "true"
-    os.environ["E2E_EXPECT_CASCADE"] = "false"   # no GC controller
-
-    store = KubeStore(base_url=server.url, token="e2e-token")
-    mgr = Manager(store)
-    mgr.add(notebook.NotebookReconciler())
-    mgr.add(tpuslice.TpuSliceReconciler())
-    mgr.add(tpuslice.StudyJobReconciler())
-    mgr.add(StatefulSetReconciler())
-    mgr.add(PodRuntimeReconciler())
-    mgr.start()
+    # the SAME harness the CI fixture uses (tests/test_e2e_wire.py) —
+    # one controller-set definition for both executors
+    server, store, mgr, env = build_wire_harness()
+    os.environ.update(env)
 
     import pytest
     rc = pytest.main([
@@ -66,10 +51,7 @@ def main():
         "-v", "--junitxml", junit,
     ])
 
-    mgr.stop()
-    for w in store._watches:
-        w.stop()
-    server.close()
+    teardown_wire_harness(server, store, mgr)
     return int(rc)
 
 

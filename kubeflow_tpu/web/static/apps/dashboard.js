@@ -4,7 +4,7 @@
  * iframe-container; backend routes web/dashboard.py). */
 
 import {
-  api, clear, confirmDialog, h, panel, Poller, Router, snack,
+  api, clear, confirmDialog, h, panel, Poller, Router, snack, t,
   YamlEditor,
 } from "../lib/components.js";
 
@@ -49,7 +49,7 @@ async function onboarding(el, info) {
 
 function nsTable(info) {
   return h("div.kf-section", {},
-    h("h2", {}, "My namespaces"),
+    h("h2", {}, t("My namespaces")),
     h("table.kf-table", {},
       h("thead", {}, h("tr", {},
         h("th", {}, "namespace"), h("th", {}, "role"))),
@@ -111,7 +111,7 @@ function contributorsPanel(info) {
       await api("POST", "api/workgroup/contributors",
         { namespace: nsSelect.value, contributor: email.value,
           role: role.value });
-      snack(`added ${email.value}`, "success");
+      snack(t("added {name}", { name: email.value }), "success");
       email.value = "";
       await refresh();
     } catch (e) {
@@ -128,14 +128,14 @@ function contributorsPanel(info) {
       list),
     h("div.kf-toolbar", {}, email, role,
       h("button.primary", { id: "add-contributor", onclick: add },
-        "Add contributor")));
+        t("Add contributor"))));
 }
 
 function launcher() {
   /* in-dashboard navigation: apps open in the iframe container
    * (reference iframe-container); the ↗ link opens them standalone */
   return h("div.kf-section", {},
-    h("h2", {}, "Applications"),
+    h("h2", {}, t("Applications")),
     h("div.kf-quick", {}, APPS.map((a) => h("div", {},
       h("a", { href: `#/app/${a.id}` }, `${a.label} — ${a.desc}`),
       " ",

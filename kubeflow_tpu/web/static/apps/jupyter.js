@@ -22,58 +22,58 @@ let router = null;
 
 async function indexView(el) {
   await indexPage(el, {
-    newLabel: "New notebook",
+    newLabel: t("New notebook"),
     onNew: () => router.go("/new"),
     pollMs: 6000,
     table: {
-      empty: "no notebooks in this namespace",
+      empty: t("no notebooks in this namespace"),
       load: async (ns) =>
         (await api("GET", `api/namespaces/${ns}/notebooks`)).notebooks,
       columns: [
-        { key: "status", label: "Status", sort: false,
+        { key: "status", label: t("Status"), sort: false,
           render: (r) => statusIcon(r.status) },
-        { key: "name", label: "Name",
+        { key: "name", label: t("Name"),
           render: (r) => h("a", {
             href: `#/details/${encodeURIComponent(r.name)}`,
           }, r.name) },
-        { key: "shortImage", label: "Image" },
-        { key: "cpu", label: "CPU" },
-        { key: "memory", label: "Memory" },
-        { key: "accelerators", label: "TPUs", sort: false,
+        { key: "shortImage", label: t("Image") },
+        { key: "cpu", label: t("CPU") },
+        { key: "memory", label: t("Memory") },
+        { key: "accelerators", label: t("TPUs"), sort: false,
           render: (r) => Object.entries(r.accelerators || {})
             .map(([k, v]) => `${v}× ${k.split("/")[0]}`)
             .join(", ") || "—" },
-        { key: "age", label: "Created", render: (r) => age(r.age) },
+        { key: "age", label: t("Created"), render: (r) => age(r.age) },
       ],
       actions: [
-        { id: "connect", label: "connect", cls: "primary",
+        { id: "connect", label: t("connect"), cls: "primary",
           show: (r) => r.status && r.status.phase === "ready",
           run: (r) => window.open(
             `/notebook/${currentNamespace()}/${r.name}/`, "_blank") },
-        { id: "start", label: "start",
+        { id: "start", label: t("start"),
           show: (r) => r.status && r.status.phase === "stopped",
           run: async (r) => {
             await api("PATCH",
               `api/namespaces/${currentNamespace()}/notebooks/${r.name}`,
               { stopped: false });
-            snack(`starting ${r.name}`, "success");
+            snack(t("starting {name}", { name: r.name }), "success");
           } },
-        { id: "stop", label: "stop",
+        { id: "stop", label: t("stop"),
           show: (r) => !r.status || r.status.phase !== "stopped",
-          confirm: "The notebook server will be scaled to zero; the " +
-            "workspace volume is kept.",
+          confirm: t("The notebook server will be scaled to zero; "
+            + "the workspace volume is kept."),
           run: async (r) => {
             await api("PATCH",
               `api/namespaces/${currentNamespace()}/notebooks/${r.name}`,
               { stopped: true });
-            snack(`stopping ${r.name}`, "success");
+            snack(t("stopping {name}", { name: r.name }), "success");
           } },
-        { id: "delete", label: "delete", cls: "danger", confirm:
-            "This deletes the notebook server. PVCs are not deleted.",
+        { id: "delete", label: t("delete"), cls: "danger", confirm:
+            t("This deletes the notebook server. PVCs are not deleted."),
           run: async (r) => {
             await api("DELETE",
               `api/namespaces/${currentNamespace()}/notebooks/${r.name}`);
-            snack(`deleted ${r.name}`, "success");
+            snack(t("deleted {name}", { name: r.name }), "success");
           } },
       ],
     },
@@ -162,16 +162,16 @@ async function formView(el) {
   const imageOptions = (cfg.image.options || []).map((o) => ({
     value: o, label: o.split("/").pop() }));
   const basics = new FieldGroup([
-    new Field({ id: "name", label: "Name",
+    new Field({ id: "name", label: t("Name"),
       checks: [validators.required, validators.dns1123] }),
-    new Field({ id: "image", label: "Image",
+    new Field({ id: "image", label: t("Image"),
       value: cfg.image.value, options: imageOptions }),
     new Field({ id: "customImage", label: "Custom image (overrides)",
       value: "", checks: [validators.optional] }),
-    new Field({ id: "cpu", label: "CPU", value: cfg.cpu.value,
+    new Field({ id: "cpu", label: t("CPU"), value: cfg.cpu.value,
       checks: [validators.quantity],
       hint: `limit = request × ${cfg.cpu.limitFactor}` }),
-    new Field({ id: "memory", label: "Memory", value: cfg.memory.value,
+    new Field({ id: "memory", label: t("Memory"), value: cfg.memory.value,
       checks: [validators.quantity],
       hint: `limit = request × ${cfg.memory.limitFactor}` }),
   ]);
@@ -274,7 +274,7 @@ async function formView(el) {
     if (!body) return;
     try {
       await api("POST", `api/namespaces/${ns}/notebooks`, body);
-      snack(`created ${body.name}`, "success");
+      snack(t("created {name}", { name: body.name }), "success");
       router.go("/");
     } catch (e) {
       snack(String(e.message || e), "error");

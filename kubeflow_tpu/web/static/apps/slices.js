@@ -9,7 +9,8 @@
 
 import {
   age, api, conditionsTable, currentNamespace, detailsList, duration,
-  eventsTable, h, indexPage, Router, snack, statusIcon, tabPanel,
+  eventsTable, h, indexPage, Router, snack, statusIcon, t,
+  tabPanel,
   YamlEditor, yamlDump,
 } from "../lib/components.js";
 
@@ -29,36 +30,36 @@ function phaseIcon(phase) {
 
 async function indexView(el) {
   await indexPage(el, {
-    newLabel: "New slice",
+    newLabel: t("New slice"),
     onNew: () => router.go("/new"),
     pollMs: 5000,
     table: {
-      empty: "no TPU slices in this namespace",
+      empty: t("no TPU slices in this namespace"),
       load: async (ns) =>
         (await api("GET", `api/namespaces/${ns}/tpuslices`)).tpuslices,
       columns: [
-        { key: "phase", label: "Status", sort: false,
+        { key: "phase", label: t("Status"), sort: false,
           render: (r) => phaseIcon(r.phase) },
-        { key: "name", label: "Name",
+        { key: "name", label: t("Name"),
           render: (r) => h("a", {
             href: `#/details/${encodeURIComponent(r.name)}`,
           }, r.name) },
-        { key: "accelerator", label: "Accelerator" },
-        { key: "topology", label: "Topology",
+        { key: "accelerator", label: t("Accelerator") },
+        { key: "topology", label: t("Topology"),
           render: (r) => `${r.topology} (${r.chips} chips)` },
-        { key: "readyWorkers", label: "Workers",
+        { key: "readyWorkers", label: t("Workers"),
           render: (r) => `${r.readyWorkers}/${r.workers}` },
-        { key: "restartCount", label: "Restarts",
+        { key: "restartCount", label: t("Restarts"),
           render: (r) => `${r.restartCount}/${r.maxRestarts}` },
-        { key: "age", label: "Created", render: (r) => age(r.age) },
+        { key: "age", label: t("Created"), render: (r) => age(r.age) },
       ],
       actions: [
-        { id: "delete", label: "delete", cls: "danger",
-          confirm: "Deletes the slice and all of its worker pods.",
+        { id: "delete", label: t("delete"), cls: "danger",
+          confirm: t("Deletes the slice and all of its worker pods."),
           run: async (r) => {
             await api("DELETE",
               `api/namespaces/${currentNamespace()}/tpuslices/${r.name}`);
-            snack(`deleted ${r.name}`, "success");
+            snack(t("deleted {name}", { name: r.name }), "success");
           } },
       ],
     },

@@ -9,7 +9,7 @@
 
 import {
   age, api, clear, conditionsTable, currentNamespace, detailsList,
-  duration, eventsTable, h, indexPage, Poller, Router, snack,
+  duration, eventsTable, h, indexPage, Poller, Router, snack, t,
   statusIcon, tabPanel, YamlEditor, yamlDump,
 } from "../lib/components.js";
 
@@ -28,38 +28,38 @@ function phaseIcon(phase) {
 
 async function indexView(el) {
   await indexPage(el, {
-    newLabel: "New study",
+    newLabel: t("New study"),
     onNew: () => router.go("/new"),
     pollMs: 5000,
     table: {
-      empty: "no studies in this namespace",
+      empty: t("no studies in this namespace"),
       load: async (ns) =>
         (await api("GET", `api/namespaces/${ns}/studyjobs`)).studyjobs,
       columns: [
-        { key: "phase", label: "Status", sort: false,
+        { key: "phase", label: t("Status"), sort: false,
           render: (r) => phaseIcon(r.phase) },
-        { key: "name", label: "Name",
+        { key: "name", label: t("Name"),
           render: (r) => h("a", {
             href: `#/details/${encodeURIComponent(r.name)}`,
           }, r.name) },
-        { key: "algorithm", label: "Algorithm",
+        { key: "algorithm", label: t("Algorithm"),
           render: (r) => r.algorithm +
             (r.earlyStopping ? ` + ${r.earlyStopping}` : "") },
-        { key: "completedTrials", label: "Trials",
+        { key: "completedTrials", label: t("Trials"),
           render: (r) => `${r.completedTrials}/${r.maxTrials}` },
-        { key: "bestValue", label: "Best",
+        { key: "bestValue", label: t("Best"),
           render: (r) => r.bestValue === null
             || r.bestValue === undefined
             ? "—" : `${r.objective}=${Number(r.bestValue).toPrecision(4)}` },
-        { key: "age", label: "Created", render: (r) => age(r.age) },
+        { key: "age", label: t("Created"), render: (r) => age(r.age) },
       ],
       actions: [
-        { id: "delete", label: "delete", cls: "danger",
-          confirm: "Deletes the study and its trial pods.",
+        { id: "delete", label: t("delete"), cls: "danger",
+          confirm: t("Deletes the study and its trial pods."),
           run: async (r) => {
             await api("DELETE",
               `api/namespaces/${currentNamespace()}/studyjobs/${r.name}`);
-            snack(`deleted ${r.name}`, "success");
+            snack(t("deleted {name}", { name: r.name }), "success");
           } },
       ],
     },

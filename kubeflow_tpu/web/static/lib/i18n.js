@@ -75,6 +75,48 @@ const FR = {
   "Events": "Événements",
   "not mounted by any pod": "monté par aucun pod",
 
+  /* jupyter web app */
+  "New notebook": "Nouveau notebook",
+  "no notebooks in this namespace":
+    "aucun notebook dans cet espace de noms",
+  "Image": "Image",
+  "CPU": "CPU",
+  "Memory": "Mémoire",
+  "TPUs": "TPU",
+  "starting {name}": "démarrage de {name}",
+  "stopping {name}": "arrêt de {name}",
+  "The notebook server will be scaled to zero; the workspace volume is kept.":
+    "Le serveur sera réduit à zéro ; le volume de travail est conservé.",
+  "This deletes the notebook server. PVCs are not deleted.":
+    "Supprime le serveur de notebook. Les PVC ne sont pas supprimés.",
+
+  /* studies web app */
+  "New study": "Nouvelle étude",
+  "no studies in this namespace":
+    "aucune étude dans cet espace de noms",
+  "Algorithm": "Algorithme",
+  "Trials": "Essais",
+  "Best": "Meilleur",
+  "Deletes the study and its trial pods.":
+    "Supprime l'étude et ses pods d'essai.",
+
+  /* slices web app */
+  "New slice": "Nouvelle tranche",
+  "no TPU slices in this namespace":
+    "aucune tranche TPU dans cet espace de noms",
+  "Accelerator": "Accélérateur",
+  "Topology": "Topologie",
+  "Workers": "Workers",
+  "Restarts": "Redémarrages",
+  "Deletes the slice and all of its worker pods.":
+    "Supprime la tranche et tous ses pods worker.",
+
+  /* dashboard */
+  "My namespaces": "Mes espaces de noms",
+  "Applications": "Applications",
+  "Add contributor": "Ajouter un contributeur",
+  "added {name}": "{name} ajouté",
+
   /* tensorboards web app (reference twa i18n scope) */
   "New tensorboard": "Nouveau tensorboard",
   "New tensorboard in {ns}": "Nouveau tensorboard dans {ns}",

@@ -308,3 +308,40 @@ def _plural_of(obj):
     kind = obj.get("kind", "")
     from kubeflow_tpu.core.kubestore import PLURALS
     return PLURALS.get(kind, kind.lower() + "s")
+
+
+def build_wire_harness():
+    """The standard wire stack for driving ci/kind/e2e_test.py without
+    a cluster: FakeApiServer + the controller set the KinD suite needs,
+    all watching over real HTTP. ONE definition — both the CI fixture
+    (tests/test_e2e_wire.py) and the evidence runner
+    (ci/kind/run_e2e_wire.py) must exercise the same controllers.
+    Returns (server, store, manager, env) with `env` the variables the
+    e2e module reads; caller applies env and later calls
+    teardown_wire_harness."""
+    from kubeflow_tpu.controllers import notebook, tpuslice
+    from kubeflow_tpu.controllers.workload_runtime import (
+        PodRuntimeReconciler, StatefulSetReconciler)
+    from kubeflow_tpu.core import Manager
+    from kubeflow_tpu.core.kubestore import KubeStore
+
+    server = FakeApiServer()
+    env = {"KUBE_API_SERVER": server.url, "KUBE_TOKEN": "e2e-token",
+           "USE_ISTIO": "true",
+           "E2E_EXPECT_CASCADE": "false"}   # fake has no GC controller
+    store = KubeStore(base_url=server.url, token="e2e-token")
+    mgr = Manager(store)
+    mgr.add(notebook.NotebookReconciler())
+    mgr.add(tpuslice.TpuSliceReconciler())
+    mgr.add(tpuslice.StudyJobReconciler())
+    mgr.add(StatefulSetReconciler())
+    mgr.add(PodRuntimeReconciler())
+    mgr.start()
+    return server, store, mgr, env
+
+
+def teardown_wire_harness(server, store, mgr):
+    mgr.stop()
+    for w in store._watches:
+        w.stop()
+    server.close()

@@ -673,6 +673,24 @@ class TestI18n:
         # nothing was sent — client validation blocked in French too
         assert store.list("v1", "PersistentVolumeClaim", "team-a") == []
 
+    def test_jupyter_index_actions_render_french(self, platform):
+        store, manager = platform
+        page = Page(jupyter.create_app(store))
+        page.local_storage._data["kf-locale"] = "fr"
+        page.load_app("jupyter.js")
+        page.go("/new")
+        page.set_value("#f-name", "nb-fr")
+        page.click("#submit-notebook")
+        assert "nb-fr créé" in page.snackbar()
+        manager.run_sync()
+        page.go("/")
+        text = page.text()
+        assert "Nouveau notebook" in text and "Mémoire" in text
+        actions = {to_python(b._dataset["action"]): page.text(b)
+                   for b in page.query_all("tbody button")}
+        assert actions["stop"] == "arrêter"
+        assert actions["delete"] == "supprimer"
+
     def test_navigator_language_fallback(self, platform):
         store, _ = platform
         page = Page(volumes.create_app(store))

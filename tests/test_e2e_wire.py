@@ -15,35 +15,19 @@ import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from fake_apiserver import FakeApiServer  # noqa: E402
-
-from kubeflow_tpu.controllers import notebook, tpuslice  # noqa: E402
-from kubeflow_tpu.controllers.workload_runtime import (  # noqa: E402
-    PodRuntimeReconciler, StatefulSetReconciler)
-from kubeflow_tpu.core import Manager  # noqa: E402
-from kubeflow_tpu.core.kubestore import KubeStore  # noqa: E402
+from fake_apiserver import (  # noqa: E402
+    build_wire_harness, teardown_wire_harness)
 
 
 @pytest.fixture()
 def wire(monkeypatch):
-    server = FakeApiServer()
-    monkeypatch.setenv("KUBE_API_SERVER", server.url)
-    monkeypatch.setenv("KUBE_TOKEN", "t")
-    monkeypatch.setenv("USE_ISTIO", "true")
-    monkeypatch.setenv("E2E_EXPECT_CASCADE", "false")  # fake has no GC
-    store = KubeStore(base_url=server.url, token="t")
-    mgr = Manager(store)
-    mgr.add(notebook.NotebookReconciler())
-    mgr.add(tpuslice.TpuSliceReconciler())
-    mgr.add(tpuslice.StudyJobReconciler())
-    mgr.add(StatefulSetReconciler())
-    mgr.add(PodRuntimeReconciler())
-    mgr.start()
+    # ONE harness definition shared with ci/kind/run_e2e_wire.py so
+    # the evidence runner and CI exercise the same controller set
+    server, store, mgr, env = build_wire_harness()
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
     yield store
-    mgr.stop()
-    for w in store._watches:
-        w.stop()
-    server.close()
+    teardown_wire_harness(server, store, mgr)
 
 
 def test_kind_e2e_suite_over_wire(wire):
