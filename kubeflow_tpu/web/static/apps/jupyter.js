@@ -87,14 +87,15 @@ function volumeRow(initial, pvcs) {
    * namespace's PVCs (the reference jupyter form's existing-volume
    * flow, frontend/src/app/pages/form volume section) and drops the
    * size field — the claim already has one. */
-  const typeField = new Field({ id: "type", label: "Type",
+  const typeField = new Field({ id: "type", label: t("Type"),
     value: initial.type || "new",
-    options: [{ value: "new", label: "New volume" },
-              { value: "existing", label: "Existing volume" }] });
-  const nameField = new Field({ id: "name", label: "Volume name",
+    options: [{ value: "new", label: t("New volume") },
+              { value: "existing",
+                label: t("Existing volume") }] });
+  const nameField = new Field({ id: "name", label: t("Volume name"),
     value: initial.name || "",
     checks: [validators.required, validators.dns1123] });
-  const pickField = new Field({ id: "pick", label: "Existing PVC",
+  const pickField = new Field({ id: "pick", label: t("Existing PVC"),
     help: "Mounts a claim that already exists in this namespace - "
       + "created from the Volumes app or a previous notebook.",
     value: initial.name || (pvcs[0] || {}).name || "",
@@ -103,9 +104,9 @@ function volumeRow(initial, pvcs) {
       label: p.name + (p.size ? ` (${p.size})` : ""),
     })),
     checks: [validators.required] });
-  const sizeField = new Field({ id: "size", label: "Size",
+  const sizeField = new Field({ id: "size", label: t("Size"),
     value: initial.size || "10Gi", checks: [validators.quantity] });
-  const mountField = new Field({ id: "mount", label: "Mount path",
+  const mountField = new Field({ id: "mount", label: t("Mount path"),
     value: initial.mount || "/data" });
 
   const sync = () => {
@@ -166,7 +167,8 @@ async function formView(el) {
       checks: [validators.required, validators.dns1123] }),
     new Field({ id: "image", label: t("Image"),
       value: cfg.image.value, options: imageOptions }),
-    new Field({ id: "customImage", label: "Custom image (overrides)",
+    new Field({ id: "customImage",
+      label: t("Custom image (overrides)"),
       value: "", checks: [validators.optional] }),
     new Field({ id: "cpu", label: t("CPU"), value: cfg.cpu.value,
       checks: [validators.quantity],
@@ -179,15 +181,16 @@ async function formView(el) {
   /* TPU picker: types from the deploy config, topologies narrowed to
    * what the cluster actually has when the scan found any */
   const types = cfg.accelerators.types || [];
-  const typeField = new Field({ id: "type", label: "TPU type",
+  const typeField = new Field({ id: "type", label: t("TPU type"),
     help: "Schedules the notebook onto hosts of this slice type via "
       + "the cloud.google.com/gke-tpu-accelerator node selector; "
       + "'None' runs CPU-only.",
-    options: [{ value: "none", label: "None" },
+    options: [{ value: "none", label: t("None") },
       ...types.map((t) => ({ value: t.id, label: t.uiName }))] });
-  const topoField = new Field({ id: "topology", label: "Topology",
+  const topoField = new Field({ id: "topology", label: t("Topology"),
     options: ["-"], checks: [validators.optional] });
-  const chipsField = new Field({ id: "num", label: "Chips per host",
+  const chipsField = new Field({ id: "num",
+    label: t("Chips per host"),
     value: "4", checks: [validators.optional],
     hint: "google.com/tpu resource limit" });
   const syncTopologies = () => {
@@ -202,12 +205,13 @@ async function formView(el) {
   syncTopologies();
 
   const workspace = new FieldGroup([
-    new Field({ id: "wsEnabled", label: "Create workspace volume",
+    new Field({ id: "wsEnabled", label: t("Create workspace volume"),
       type: "checkbox", value: true }),
-    new Field({ id: "wsSize", label: "Workspace size", value: "10Gi",
+    new Field({ id: "wsSize", label: t("Workspace size"), value: "10Gi",
       checks: [validators.quantity] }),
   ]);
-  const datavols = new RowList({ addLabel: "add data volume",
+  const datavols = new RowList({ id: "add-data-volume",
+    label: t("add data volume"),
     makeRow: (init) => volumeRow(init, existingPvcs) });
 
   const pdBoxes = podDefaults.map((pd) => {
@@ -219,17 +223,18 @@ async function formView(el) {
   const tolGroups = cfg.tolerationGroup.groups || [];
   const affOptions = cfg.affinityConfig.options || [];
   const advanced = new FieldGroup([
-    new Field({ id: "tolerationGroup", label: "Tolerations group",
+    new Field({ id: "tolerationGroup", label: t("Tolerations group"),
       value: cfg.tolerationGroup.value,
-      options: [{ value: "none", label: "None" },
+      options: [{ value: "none", label: t("None") },
         ...tolGroups.map((g) => ({ value: g.groupKey,
                                    label: g.displayName }))] }),
-    new Field({ id: "affinityConfig", label: "Affinity",
+    new Field({ id: "affinityConfig", label: t("Affinity"),
       value: cfg.affinityConfig.value,
-      options: [{ value: "none", label: "None" },
+      options: [{ value: "none", label: t("None") },
         ...affOptions.map((o) => ({ value: o.configKey,
                                     label: o.displayName }))] }),
-    new Field({ id: "shm", label: "Enable shared memory (/dev/shm)",
+    new Field({ id: "shm",
+      label: t("Enable shared memory (/dev/shm)"),
       type: "checkbox", value: cfg.shm.value }),
   ]);
 
@@ -288,7 +293,7 @@ async function formView(el) {
     try {
       await api("POST",
         `api/namespaces/${ns}/notebooks?dry_run=true`, body);
-      snack("configuration is valid", "success");
+      snack(t("configuration is valid"), "success");
     } catch (e) {
       snack(String(e.message || e), "error");
     }
@@ -311,37 +316,40 @@ async function formView(el) {
 
   el.append(
     h("div.kf-toolbar", {},
-      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
-      h("h2", {}, `New notebook in ${ns}`),
+      h("button.ghost", { onclick: () => router.go("/") },
+        t("← back")),
+      h("h2", {}, t("New notebook in {ns}", { ns })),
       h("span.kf-spacer"),
       h("button.ghost", { id: "edit-as-yaml", onclick: editAsYaml },
-        "Edit as YAML")),
+        t("Edit as YAML"))),
     h("div.kf-section", { id: "form-basics" },
-      h("h2", {}, "Notebook"),
+      h("h2", {}, t("Notebook")),
       basics.fields.map((f) => f.element)),
     h("div.kf-section", { id: "form-tpu" },
-      h("h2", {}, "TPU accelerator"),
+      h("h2", {}, t("TPU accelerator")),
       typeField.element, topoField.element, chipsField.element),
     h("div.kf-section", { id: "form-volumes" },
-      h("h2", {}, "Volumes"),
+      h("h2", {}, t("Volumes")),
       workspace.fields.map((f) => f.element),
       datavols.element),
     h("div.kf-section", { id: "form-configurations" },
-      h("h2", {}, "Configurations (PodDefaults)"),
+      h("h2", {}, t("Configurations (PodDefaults)")),
       pdBoxes.length
         ? pdBoxes.map((p) => h("label.kf-field", {},
             p.box, ` ${p.label}`, p.desc
               ? h("span.kf-field-hint", {}, ` — ${p.desc}`) : null))
-        : h("p.kf-field-hint", {}, "none available in this namespace")),
+        : h("p.kf-field-hint", {},
+            t("none available in this namespace"))),
     h("div.kf-section", { id: "form-advanced" },
-      h("h2", {}, "Advanced"),
+      h("h2", {}, t("Advanced")),
       advanced.fields.map((f) => f.element)),
     h("div.kf-form-actions", {},
       h("button.primary", { id: "submit-notebook", onclick: submit },
-        "Launch"),
+        t("Launch")),
       h("button.ghost", { id: "validate-notebook", onclick: validate },
-        "Validate (dry run)"),
-      h("button.ghost", { onclick: () => router.go("/") }, "Cancel")),
+        t("Validate (dry run)")),
+      h("button.ghost", { onclick: () => router.go("/") },
+        t("Cancel"))),
   );
 }
 
@@ -390,9 +398,10 @@ async function yamlFormView(el) {
       if (dryRun) {
         editor.setStatus(
           "dry run ok — schema and admission chain accept this", "");
-        snack("manifest is valid", "success");
+        snack(t("manifest is valid"), "success");
       } else {
-        snack(`created ${(cr.metadata || {}).name}`, "success");
+        snack(t("created {name}",
+          { name: (cr.metadata || {}).name }), "success");
         router.go("/");
       }
     } catch (e) {
@@ -403,15 +412,17 @@ async function yamlFormView(el) {
 
   el.append(
     h("div.kf-toolbar", {},
-      h("button.ghost", { onclick: () => router.go("/new") }, "← form"),
-      h("h2", {}, `New notebook in ${ns} (YAML)`)),
+      h("button.ghost", { onclick: () => router.go("/new") },
+        t("← form")),
+      h("h2", {}, t("New notebook in {ns}", { ns }) + " (YAML)")),
     h("div.kf-section", { id: "yaml-editor-section" }, editor.element),
     h("div.kf-form-actions", {},
       h("button.primary", { id: "yaml-create",
-        onclick: () => post(false) }, "Create"),
+        onclick: () => post(false) }, t("Create")),
       h("button.ghost", { id: "yaml-dryrun",
-        onclick: () => post(true) }, "Validate (dry run)"),
-      h("button.ghost", { onclick: () => router.go("/") }, "Cancel")),
+        onclick: () => post(true) }, t("Validate (dry run)")),
+      h("button.ghost", { onclick: () => router.go("/") },
+        t("Cancel"))),
   );
 }
 
@@ -436,7 +447,7 @@ async function detailsView(el, params) {
 
   const overview = (pane) => {
     pane.append(h("div.kf-section", {},
-      h("h2", {}, "Overview"),
+      h("h2", {}, t("Overview")),
       h("dl.kf-kv", {},
         h("dt", {}, "image"), h("dd", {}, container.image || ""),
         h("dt", {}, "cpu"), h("dd", {},
@@ -486,13 +497,14 @@ async function detailsView(el, params) {
 
   el.append(
     h("div.kf-toolbar", {},
-      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
+      h("button.ghost", { onclick: () => router.go("/") },
+        t("← back")),
       h("h2", {}, name, " "),
       statusIcon(statusSummary || { phase: "waiting" })),
     tabPanel([
-      { id: "overview", label: "Overview", render: overview },
-      { id: "logs", label: "Logs", render: logsTab },
-      { id: "events", label: "Events", render: eventsTab },
+      { id: "overview", label: t("Overview"), render: overview },
+      { id: "logs", label: t("Logs"), render: logsTab },
+      { id: "events", label: t("Events"), render: eventsTab },
       { id: "yaml", label: "YAML", render: yamlTab },
     ]).element,
   );

@@ -387,13 +387,19 @@ export class FieldGroup {
 
 /* Dynamic row list (volume rows in the spawn form: add/remove) */
 export class RowList {
-  constructor({ addLabel, makeRow }) {
+  constructor({ id, label, makeRow, addLabel, displayLabel }) {
+    /* preferred: explicit { id, label } — the DOM id is locale-stable
+     * and the label free to be a t() translation. { addLabel,
+     * displayLabel } kept for callers that derive both from the
+     * English string. */
+    const elemId = id || addLabel.replace(/\W+/g, "-").toLowerCase();
+    const shown = label || displayLabel || addLabel;
     this.rows = [];
     this.makeRow = makeRow;
     this.list = h("div.kf-rowlist");
     this.element = h("div", {}, this.list,
-      h("button.ghost", { id: addLabel.replace(/\W+/g, "-").toLowerCase(),
-        onclick: () => this.add() }, "+ " + addLabel));
+      h("button.ghost", { id: elemId,
+        onclick: () => this.add() }, "+ " + shown));
   }
 
   add(initial) {

@@ -90,6 +90,36 @@ const FR = {
   "This deletes the notebook server. PVCs are not deleted.":
     "Supprime le serveur de notebook. Les PVC ne sont pas supprimés.",
 
+  /* jupyter spawn form */
+  "New notebook in {ns}": "Nouveau notebook dans {ns}",
+  "Notebook": "Notebook",
+  "Custom image (overrides)": "Image personnalisée (prioritaire)",
+  "TPU accelerator": "Accélérateur TPU",
+  "TPU type": "Type de TPU",
+  "None": "Aucun",
+  "Chips per host": "Puces par hôte",
+  "Volumes": "Volumes",
+  "Create workspace volume": "Créer un volume de travail",
+  "Workspace size": "Taille de l'espace de travail",
+  "add data volume": "ajouter un volume de données",
+  "Existing volume": "Volume existant",
+  "Configurations (PodDefaults)": "Configurations (PodDefaults)",
+  "none available in this namespace":
+    "aucune disponible dans cet espace de noms",
+  "Advanced": "Avancé",
+  "Tolerations group": "Groupe de tolérances",
+  "Affinity": "Affinité",
+  "Enable shared memory (/dev/shm)":
+    "Activer la mémoire partagée (/dev/shm)",
+  "Launch": "Lancer",
+  "Validate (dry run)": "Valider (simulation)",
+  "Edit as YAML": "Éditer en YAML",
+  "← form": "← formulaire",
+  "configuration is valid": "la configuration est valide",
+  "manifest is valid": "le manifeste est valide",
+  "Overview": "Aperçu",
+  "Logs": "Journaux",
+
   /* studies web app */
   "New study": "Nouvelle étude",
   "no studies in this namespace":

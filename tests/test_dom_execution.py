@@ -673,6 +673,23 @@ class TestI18n:
         # nothing was sent — client validation blocked in French too
         assert store.list("v1", "PersistentVolumeClaim", "team-a") == []
 
+    def test_jupyter_spawn_form_renders_french(self, platform):
+        store, _ = platform
+        page = Page(jupyter.create_app(store))
+        page.local_storage._data["kf-locale"] = "fr"
+        page.load_app("jupyter.js")
+        page.go("/new")
+        text = page.text()
+        assert "Nouveau notebook dans team-a" in text
+        assert "Accélérateur TPU" in text
+        assert "Créer un volume de travail" in text
+        assert "Lancer" in text and "Valider (simulation)" in text
+        # volume rows: the picker speaks French too
+        page.click("#add-data-volume")
+        row_text = page.text(page.query(".kf-row"))
+        assert "Volume existant" in row_text
+        assert "Chemin de montage" in row_text
+
     def test_jupyter_index_actions_render_french(self, platform):
         store, manager = platform
         page = Page(jupyter.create_app(store))
