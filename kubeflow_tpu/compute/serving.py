@@ -50,13 +50,14 @@ class _Batcher:
         self.timeout_s = timeout_s
         self.q = queue.Queue()
         self._stop = False
+        self._accepting = True
         self.thread = threading.Thread(target=self._loop, daemon=True,
                                        name="serving-batcher")
         self.thread.start()
 
     def submit(self, x):
         """Blocking: returns (result_rows, device_ms_of_the_batch)."""
-        if self._stop or not self.thread.is_alive():
+        if not self._accepting or not self.thread.is_alive():
             raise RuntimeError("batcher stopped")
         done = threading.Event()
         slot = {"x": x, "done": done}
@@ -155,8 +156,13 @@ class _Batcher:
             for g in group:
                 g["done"].set()
 
-    def stop(self):
-        self._stop = True
+    def stop(self, graceful=False):
+        """``graceful``: reject new submissions but let already-queued
+        requests finish before the thread exits (version transitions
+        must not 500 in-flight work); default errors the queue out."""
+        self._accepting = False
+        if not graceful:
+            self._stop = True
         self.q.put(None)
 
 
@@ -284,9 +290,9 @@ class ServedModel:
         out, ms = self.predict_raw(instances)
         return out.tolist(), ms
 
-    def close(self):
+    def close(self, graceful=False):
         if self._batcher is not None:
-            self._batcher.stop()
+            self._batcher.stop(graceful=graceful)
 
 
 def _decode_tensor(t):
@@ -349,6 +355,12 @@ class ModelServer:
         # serving note.
         self.stream_group = stream_group
         self._residency_lock = threading.Lock()
+        self._pending = []     # preloading models, budget-counted
+        # displaced versions: an in-flight request that grabbed the
+        # old handle before the traffic flip may lazily RELOAD it
+        # after the unload — retired models stay budget-counted and
+        # are the first eviction victims (stale last_used)
+        self._retired = []
 
     def register(self, name, predict_fn, version=1, **model_kwargs):
         old = self._models.get(name)
@@ -362,16 +374,42 @@ class ModelServer:
         """Register a residency-managed model: ``make_fn(params, x)``
         is the predict program, ``params`` the HOST tree (float or
         quantize.quantize_tree output). Weights go on device on first
-        predict (or now, with ``preload``) and can be evicted."""
+        predict (or now, with ``preload``) and can be evicted.
+
+        Version transition semantics (re-registering a served name):
+        with ``preload`` the NEW version loads BEFORE the swap, so the
+        old version keeps serving until the replacement is resident
+        and the dict assignment flips traffic atomically — no cold
+        gap. This needs budget headroom for both copies during the
+        transition; under a tight budget the COLDEST managed models
+        evict first (the serving old version is the most-recently-used
+        and goes last). The displaced version's queued batched work
+        drains before its batcher stops, and its device copy is
+        unloaded so the budget accounting stays truthful even if a
+        caller retains the old handle."""
         old = self._models.get(name)
         model = ServedModel(name, version=version, make_fn=make_fn,
                             host_params=params, **model_kwargs)
         model._ensure = self._ensure_loaded
-        self._models[name] = model
-        if old is not None:
-            old.close()
         if preload:
-            self._ensure_loaded(model)
+            # count the incoming copy toward the budget for the whole
+            # preload→swap window (a concurrent load must neither
+            # overshoot nor evict a half-transitioned model)
+            self._pending.append(model)
+            try:
+                self._ensure_loaded(model)
+            except Exception:
+                self._pending.remove(model)
+                model.close()          # don't leak the batcher thread
+                raise
+        self._models[name] = model     # atomic traffic flip
+        if preload:
+            self._pending.remove(model)
+        if old is not None:
+            old.close(graceful=True)   # queued work finishes
+            if old._managed:
+                old.unload()           # free HBM; handle may outlive
+                self._retired.append(old)
         return model
 
     def models(self):
@@ -379,7 +417,9 @@ class ModelServer:
 
     # --------------------------------------------------- residency
     def resident_bytes(self):
-        return sum(m.resident_bytes for m in self._models.values()
+        return sum(m.resident_bytes
+                   for m in [*self._models.values(), *self._pending,
+                             *self._retired]
                    if m._managed and m.loaded)
 
     def _ensure_loaded(self, model):
@@ -397,11 +437,21 @@ class ModelServer:
                         f"model {model.name} needs "
                         f"{model.resident_bytes} bytes; budget is "
                         f"{budget}")
+                # pending (mid-transition) models count toward the
+                # budget but are never victims — evicting a model
+                # that is about to take traffic would defeat the
+                # preload
+                pending = [m for m in self._pending
+                           if m._managed and m.loaded
+                           and m is not model]
                 loaded = sorted(
-                    (m for m in self._models.values()
-                     if m._managed and m.loaded and m is not model),
+                    (m for m in [*self._models.values(),
+                                 *self._retired]
+                     if m._managed and m.loaded and m is not model
+                     and m not in self._pending),
                     key=lambda m: m.last_used)
-                in_use = sum(m.resident_bytes for m in loaded)
+                in_use = sum(m.resident_bytes
+                             for m in [*loaded, *pending])
                 for victim in loaded:
                     if in_use + model.resident_bytes <= budget:
                         break

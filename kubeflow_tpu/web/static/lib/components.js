@@ -387,19 +387,18 @@ export class FieldGroup {
 
 /* Dynamic row list (volume rows in the spawn form: add/remove) */
 export class RowList {
-  constructor({ id, label, makeRow, addLabel, displayLabel }) {
-    /* preferred: explicit { id, label } — the DOM id is locale-stable
-     * and the label free to be a t() translation. { addLabel,
-     * displayLabel } kept for callers that derive both from the
-     * English string. */
-    const elemId = id || addLabel.replace(/\W+/g, "-").toLowerCase();
-    const shown = label || displayLabel || addLabel;
+  constructor({ id, label, makeRow }) {
+    /* id is the locale-stable DOM id (falls back to a slug of label —
+     * fine for untranslated callers, pass id explicitly when label is
+     * a t() translation) */
+    const elemId = id || String(label).replace(/\W+/g, "-")
+      .toLowerCase();
     this.rows = [];
     this.makeRow = makeRow;
     this.list = h("div.kf-rowlist");
     this.element = h("div", {}, this.list,
       h("button.ghost", { id: elemId,
-        onclick: () => this.add() }, "+ " + shown));
+        onclick: () => this.add() }, "+ " + label));
   }
 
   add(initial) {

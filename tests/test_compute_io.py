@@ -653,6 +653,49 @@ class TestServingResidency:
         finally:
             server.stop()
 
+    def test_version_transition_preloads_before_atomic_swap(self):
+        """v2 registered with preload: v1 serves until v2 is resident,
+        then one dict assignment flips traffic — the TF-Serving
+        version-transition semantics."""
+        from kubeflow_tpu.compute import serving as sv
+        p1, p2 = self._params(1), self._params(2)
+        # budget fits both: the no-gap transition path
+        server = sv.ModelServer(
+            budget_bytes=int(sv.tree_bytes(p1) * 2.5))
+        m1 = server.register_loadable("m", self._make_fn(), p1,
+                                      version=1, preload=True)
+        port = server.start(port=0, host="127.0.0.1")
+        try:
+            x = np.random.default_rng(0).standard_normal(
+                (2, 64)).astype(np.float32)   # nonzero: v1 ≠ v2 output
+
+            def predict():
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/models/m:predict",
+                    data=json.dumps({"instances": x.tolist()}).encode(),
+                    headers={"Content-Type": "application/json"})
+                return np.asarray(json.load(
+                    urllib.request.urlopen(req))["predictions"])
+
+            out_v1 = predict()
+            assert self._status(port, "m")["model_version_status"][0][
+                "version"] == "1"
+            m2 = server.register_loadable("m", self._make_fn(), p2,
+                                          version=2, preload=True)
+            # v2 resident BEFORE the swap; v1 served through the
+            # preload (loads stayed 1 — no evict-reload cycle) and was
+            # unloaded exactly once AFTER the flip (budget truth)
+            assert m2.loaded
+            assert m1.loads == 1 and m1.evictions == 1
+            assert not m1.loaded
+            assert self._status(port, "m")["model_version_status"][0][
+                "version"] == "2"
+            out_v2 = predict()
+            assert not np.allclose(out_v1, out_v2)   # new weights
+            assert m2.loads == 1                     # no cold reload
+        finally:
+            server.stop()
+
     def test_unmanaged_models_unaffected_by_budget(self):
         from kubeflow_tpu.compute import serving as sv
         cfg = mlp.Config(in_dim=16, hidden=8, n_classes=4)
