@@ -224,6 +224,10 @@ class ServedModel:
             return
         self._dev_params = jax.device_put(self._host_params)
         self.loads += 1
+        # a freshly loaded model must not be the coldest LRU victim
+        # (a straggler's lazy reload would otherwise evict the version
+        # that just took traffic)
+        self.last_used = time.monotonic()
 
     def unload(self):
         """Drop the device copy; the weights' HBM is freed once no
@@ -354,7 +358,10 @@ class ModelServer:
         # pipelines worse against the tunnel RTT. See BASELINE r5
         # serving note.
         self.stream_group = stream_group
-        self._residency_lock = threading.Lock()
+        # RLock: register_loadable holds it across its preload's
+        # _ensure_loaded call so pending/swap/retire mutations are
+        # atomic against concurrent loads and /v1/models reads
+        self._residency_lock = threading.RLock()
         self._pending = []     # preloading models, budget-counted
         # displaced versions: an in-flight request that grabbed the
         # old handle before the traffic flip may lazily RELOAD it
@@ -392,24 +399,42 @@ class ModelServer:
                             host_params=params, **model_kwargs)
         model._ensure = self._ensure_loaded
         if preload:
-            # count the incoming copy toward the budget for the whole
-            # preload→swap window (a concurrent load must neither
-            # overshoot nor evict a half-transitioned model)
-            self._pending.append(model)
-            try:
-                self._ensure_loaded(model)
-            except Exception:
+            # hold the residency lock across preload→swap: the
+            # incoming copy is budget-counted (pending) the whole
+            # window, never double-counted, and concurrent loads see
+            # a consistent pending/models/retired set
+            with self._residency_lock:
+                self._pending.append(model)
+                try:
+                    self._ensure_loaded(model)
+                except Exception:
+                    self._pending.remove(model)
+                    model.close()      # don't leak the batcher thread
+                    raise
+                self._models[name] = model   # atomic traffic flip
                 self._pending.remove(model)
-                model.close()          # don't leak the batcher thread
-                raise
-        self._models[name] = model     # atomic traffic flip
-        if preload:
-            self._pending.remove(model)
+        else:
+            with self._residency_lock:
+                self._models[name] = model
         if old is not None:
-            old.close(graceful=True)   # queued work finishes
+            old.close(graceful=True)   # stop ACCEPTING, drain FIFO
+            if old._batcher is not None:
+                # wait for the drain before touching residency: a
+                # queued straggler must not have to cold-reload the
+                # version we are about to unload
+                old._batcher.thread.join(timeout=30)
             if old._managed:
-                old.unload()           # free HBM; handle may outlive
-                self._retired.append(old)
+                with self._residency_lock:
+                    old.unload()       # free HBM; handle may outlive
+                    # bounded retention: one retired entry per name
+                    # (an UNBATCHED in-flight handler can still
+                    # lazily reload it — counted + evictable until
+                    # the next transition purges it)
+                    for prev in [m for m in self._retired
+                                 if m.name == name]:
+                        prev.unload()
+                        self._retired.remove(prev)
+                    self._retired.append(old)
         return model
 
     def models(self):
@@ -417,10 +442,14 @@ class ModelServer:
 
     # --------------------------------------------------- residency
     def resident_bytes(self):
-        return sum(m.resident_bytes
-                   for m in [*self._models.values(), *self._pending,
-                             *self._retired]
-                   if m._managed and m.loaded)
+        with self._residency_lock:
+            seen, total = set(), 0
+            for m in [*self._models.values(), *self._pending,
+                      *self._retired]:
+                if m._managed and m.loaded and id(m) not in seen:
+                    seen.add(id(m))
+                    total += m.resident_bytes
+            return total
 
     def _ensure_loaded(self, model):
         """Make ``model`` device-resident under the byte budget,
@@ -457,6 +486,18 @@ class ModelServer:
                         break
                     victim.unload()
                     in_use -= victim.resident_bytes
+                if in_use + model.resident_bytes > budget:
+                    # every victim is gone and it still doesn't fit —
+                    # the remainder is unevictable (mid-transition
+                    # pending copies). Refuse instead of silently
+                    # overshooting the budget; retry after the
+                    # transition completes.
+                    raise ModelTooLargeError(
+                        f"model {model.name} needs "
+                        f"{model.resident_bytes} bytes but only "
+                        f"{budget - in_use} are free "
+                        f"({in_use} held, partly by an in-flight "
+                        f"version transition); transient — retry")
             model.load()
             return model._dev_params
 

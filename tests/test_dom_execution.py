@@ -593,6 +593,58 @@ class TestSharedComponentsDom:
         assert "spec: " in to_python(area["value"])
         assert menu["hidden"] is True
 
+    def test_namespace_switch_reloads_the_table(self, platform):
+        """The namespace selector drives a fresh load (common-lib
+        namespace-select contract): rows swap to the new namespace's
+        resources and the choice persists in localStorage."""
+        store, manager = platform
+        store.create({"apiVersion": "kubeflow.org/v1", "kind": "Profile",
+                      "metadata": {"name": "team-b"},
+                      "spec": {"owner": {"kind": "User",
+                                         "name": ALICE}}})
+        manager.run_sync()
+        for ns, name in (("team-a", "pvc-a"), ("team-b", "pvc-b")):
+            store.create({"apiVersion": "v1",
+                          "kind": "PersistentVolumeClaim",
+                          "metadata": {"name": name, "namespace": ns},
+                          "spec": {}, "status": {"phase": "Bound"}})
+        page = volumes_page(store)
+        assert "pvc-a" in page.text() and "pvc-b" not in page.text()
+        page.set_value("#ns-select", "team-b")
+        assert "pvc-b" in page.text() and "pvc-a" not in page.text()
+        assert page.local_storage._data["kf-namespace"] == "team-b"
+        # a later app load honors the stored choice
+        page2 = Page(volumes.create_app(store))
+        page2.local_storage._data["kf-namespace"] = "team-b"
+        page2.load_app("volumes.js")
+        assert "pvc-b" in page2.text()
+
+    def test_yaml_editor_value_completion_enum(self, platform):
+        """Ctrl-Space in VALUE position completes from the schema's
+        enum leaf (lib/schema.js valueContext path) — the r4 feature,
+        now executed at the DOM level in the Studies editor
+        (kind=StudyJob carries enum leaves)."""
+        store, _ = platform
+        page = Page(studies.create_app(store))
+        page.load_app("studies.js")
+        page.go("/new")
+        area = page.query(".kf-editor-text")
+        page.set_value(area,
+                       "kind: StudyJob\nspec:\n  objective:\n"
+                       "    type: m")
+        end = float(len(to_python(area["value"])))
+        area["selectionStart"] = end
+        area["selectionEnd"] = end
+        page.keydown(area, " ", ctrl=True)
+        menu = page.query(".kf-editor-menu")
+        assert menu["hidden"] is False
+        items = [page.text(i) for i in menu._query_all(".kf-menu-item")]
+        assert items == ["maximize", "minimize"]
+        page.keydown(area, "ArrowDown")
+        page.keydown(area, "Enter")
+        # value mode inserts the bare value, no trailing colon
+        assert to_python(area["value"]).endswith("type: minimize")
+
     def test_snack_clears_after_timeout(self, platform):
         store, _ = platform
         page = volumes_page(store)
