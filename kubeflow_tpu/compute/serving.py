@@ -174,7 +174,12 @@ def tree_bytes(params):
 
 
 class ModelTooLargeError(Exception):
-    """The model alone exceeds the server's byte budget."""
+    """The model alone exceeds the server's byte budget (permanent)."""
+
+
+class CapacityBusyError(Exception):
+    """Budget temporarily exhausted by unevictable mid-transition
+    copies — retry after the transition completes (503, not 507)."""
 
 
 class ServedModel:
@@ -394,16 +399,20 @@ class ModelServer:
         drains before its batcher stops, and its device copy is
         unloaded so the budget accounting stays truthful even if a
         caller retains the old handle."""
-        old = self._models.get(name)
         model = ServedModel(name, version=version, make_fn=make_fn,
                             host_params=params, **model_kwargs)
         model._ensure = self._ensure_loaded
-        if preload:
-            # hold the residency lock across preload→swap: the
-            # incoming copy is budget-counted (pending) the whole
-            # window, never double-counted, and concurrent loads see
-            # a consistent pending/models/retired set
-            with self._residency_lock:
+        # ONE lock scope for read-old → preload → flip → retire:
+        # concurrent re-registrations of the same name serialize (the
+        # loser's old is the winner's model, properly retired, never
+        # leaked), the incoming copy is budget-counted (pending) for
+        # the whole preload window, and the displaced copy moves to
+        # _retired AT the flip — still device-resident while its
+        # batcher drains, so it stays visible to the budget and
+        # evictable under pressure the entire time
+        with self._residency_lock:
+            old = self._models.get(name)
+            if preload:
                 self._pending.append(model)
                 try:
                     self._ensure_loaded(model)
@@ -413,28 +422,28 @@ class ModelServer:
                     raise
                 self._models[name] = model   # atomic traffic flip
                 self._pending.remove(model)
-        else:
-            with self._residency_lock:
+            else:
                 self._models[name] = model
+            if old is not None and old._managed:
+                # bounded retention: one retired entry per name (an
+                # in-flight handler can still lazily reload a retired
+                # model — counted + evictable until the next
+                # transition purges it)
+                for prev in [m for m in self._retired
+                             if m.name == name]:
+                    prev.unload()
+                    self._retired.remove(prev)
+                self._retired.append(old)
         if old is not None:
             old.close(graceful=True)   # stop ACCEPTING, drain FIFO
             if old._batcher is not None:
-                # wait for the drain before touching residency: a
-                # queued straggler must not have to cold-reload the
-                # version we are about to unload
+                # wait for the drain before the unload: a queued
+                # straggler must not have to cold-reload the version
+                # we are about to unload
                 old._batcher.thread.join(timeout=30)
             if old._managed:
                 with self._residency_lock:
                     old.unload()       # free HBM; handle may outlive
-                    # bounded retention: one retired entry per name
-                    # (an UNBATCHED in-flight handler can still
-                    # lazily reload it — counted + evictable until
-                    # the next transition purges it)
-                    for prev in [m for m in self._retired
-                                 if m.name == name]:
-                        prev.unload()
-                        self._retired.remove(prev)
-                    self._retired.append(old)
         return model
 
     def models(self):
@@ -490,14 +499,13 @@ class ModelServer:
                     # every victim is gone and it still doesn't fit —
                     # the remainder is unevictable (mid-transition
                     # pending copies). Refuse instead of silently
-                    # overshooting the budget; retry after the
-                    # transition completes.
-                    raise ModelTooLargeError(
+                    # overshooting the budget.
+                    raise CapacityBusyError(
                         f"model {model.name} needs "
                         f"{model.resident_bytes} bytes but only "
                         f"{budget - in_use} are free "
                         f"({in_use} held, partly by an in-flight "
-                        f"version transition); transient — retry")
+                        f"version transition); retry shortly")
             model.load()
             return model._dev_params
 
@@ -642,6 +650,11 @@ class ModelServer:
                     # permanent capacity condition, not an inference
                     # failure: 507 so retry loops keyed on 500 stop
                     return self._send(507, {"error": str(e)})
+                except CapacityBusyError as e:
+                    # transient (mid-transition budget pressure):
+                    # 503 + Retry-After keeps retry loops going
+                    return self._send(503, {"error": str(e)},
+                                      (("Retry-After", "1"),))
                 except Exception as e:  # noqa: BLE001 — wire boundary
                     return self._send(500,
                                       {"error": f"inference failed: {e}"})

@@ -259,6 +259,75 @@ export function trialChart(trials, maximize, objectiveName) {
     legend);
 }
 
+/* ------------------------------------------------------- pbt lineage */
+
+export function pbtLineage(trials) {
+  /* Generation × member grid of a PBT study: one status-colored node
+   * per trial, an edge per checkpoint hand-off — gray for "continue"
+   * (the member kept its own weights), accent-colored for "exploit"
+   * (weights copied from a top-quantile survivor in the previous
+   * generation). Reads the same t.pbt = {generation, member, event,
+   * parent} fields as the trial table (controllers/hpo.pbt_next). */
+  const withPbt = trials.filter((t) => t.pbt);
+  if (withPbt.length < 2) return null;
+  const pop = Math.max(...withPbt.map((t) => t.pbt.member)) + 1;
+  const gens = Math.max(...withPbt.map((t) => t.pbt.generation)) + 1;
+  const L = 46, T = 18, colW = 92, rowH = 30, R = 12;
+  const W = L + R + Math.max(1, gens - 1) * colW + 24;
+  const H = T + pop * rowH + 26;
+  const X = (g) => L + g * colW;
+  const Y = (m) => T + m * rowH + rowH / 2;
+
+  const edges = [];
+  for (const t of withPbt) {
+    const p = t.pbt;
+    if (p.generation > 0 && p.parent !== undefined
+        && p.parent !== null) {
+      const parentMember = p.parent % pop;
+      edges.push(sv("line", {
+        x1: X(p.generation - 1) + 5, y1: Y(parentMember),
+        x2: X(p.generation) - 5, y2: Y(p.member),
+        stroke: p.event === "exploit" ? SERIES_BLUE : "#c9c9c4",
+        "stroke-width": p.event === "exploit" ? 2 : 1,
+        class: `pbt-edge pbt-${p.event}`,
+      }));
+    }
+  }
+  const nodes = withPbt.map((t) => {
+    const p = t.pbt;
+    const tip = `g${p.generation} m${p.member} · ${p.event}`
+      + (t.objectiveValue !== undefined
+        ? ` · ${Number(t.objectiveValue).toPrecision(4)}` : "")
+      + (t.parameters ? ` · ${JSON.stringify(t.parameters)}` : "");
+    return sv("g", {},
+      sv("circle", { cx: X(p.generation), cy: Y(p.member), r: 10,
+        fill: "transparent" }, sv("title", {}, tip)),
+      sv("circle", { cx: X(p.generation), cy: Y(p.member), r: 4.5,
+        fill: TRIAL_COLOR[t.state] || "#9a9a94",
+        stroke: "#fff", "stroke-width": 2 },
+      sv("title", {}, tip)));
+  });
+  const genLabels = [];
+  for (let g = 0; g < gens; g++) {
+    genLabels.push(sv("text", { x: X(g), y: H - 8,
+      "text-anchor": "middle", class: "kf-chart-label" }, `g${g}`));
+  }
+  const memberLabels = [];
+  for (let m = 0; m < pop; m++) {
+    memberLabels.push(sv("text", { x: L - 18, y: Y(m) + 4,
+      "text-anchor": "end", class: "kf-chart-label" }, `m${m}`));
+  }
+  return h("div.kf-chart", { id: "pbt-lineage" },
+    sv("svg", { viewBox: `0 0 ${W} ${H}`, role: "img",
+      "aria-label": "PBT lineage" },
+    edges, genLabels, memberLabels, nodes),
+    h("div.kf-chart-legend", {},
+      h("span.kf-legend-item", {}, h("span.kf-legend-line"),
+        " exploit (weights copied)"),
+      h("span.kf-legend-item", {}, "— continue")));
+}
+
+
 async function detailsView(el, params) {
   const ns = currentNamespace();
   const load = async () => api("GET",
@@ -354,7 +423,8 @@ async function detailsView(el, params) {
           head.map((c) => h("th", {}, c))));
       }
       clear(chartBox).append(
-        trialChart(trialList, maximize, summary.objective));
+        trialChart(trialList, maximize, summary.objective),
+        pbt ? (pbtLineage(trialList) || "") : "");
       trialRows(tbody, trialList, bestNow, pbt);
     };
     render(trials, best);

@@ -447,6 +447,52 @@ class TestStudiesApp:
         # per-trial table with sparkline characters from reports
         assert "▁" in page.text() or "█" in page.text()
 
+    def test_pbt_lineage_graph_renders_edges(self, platform):
+        """The PBT lineage view (r5 ROADMAP rung): generation×member
+        grid with continue/exploit edges from the same t.pbt fields
+        the trial table shows."""
+        store, _ = platform
+        trials = []
+        # gen 0: two init members; gen 1: m0 continues itself, m1
+        # exploits m0's checkpoint
+        pbts = [
+            (0, 0, "init", None), (1, 1, "init", None),
+            (2, 0, "continue", 0), (3, 1, "exploit", 0),
+        ]
+        for i, (idx, member, event, parent) in enumerate(pbts):
+            gen = 0 if idx < 2 else 1
+            pbt = {"generation": gen, "member": member,
+                   "event": event, "checkpoint": f"c/g{gen}-m{member}"}
+            if parent is not None:
+                pbt["parent"] = parent
+            trials.append({
+                "name": f"s-pbt-trial-{idx}", "index": idx,
+                "state": "Succeeded", "objectiveValue": 0.5 + idx / 10,
+                "parameters": {"lr": 0.01}, "pbt": pbt})
+        store.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "StudyJob",
+            "metadata": {"name": "s-pbt", "namespace": "team-a"},
+            "spec": {"maxTrialCount": 4, "parallelism": 2,
+                     "algorithm": {"name": "pbt", "population": 2},
+                     "objective": {"metricName": "obj",
+                                   "type": "maximize"}},
+            "status": {"phase": "Running", "completedTrials": 4,
+                       "trials": trials}})
+        page = Page(studies.create_app(store))
+        page.load_app("studies.js")
+        page.go("/details/s-pbt")
+        page.click('button[data-tab="trials"]')
+        lineage = page.query("#pbt-lineage")
+        assert lineage is not None
+        svg = lineage._query_all("svg")[0]
+        edges = svg._query_all("line.pbt-edge")
+        assert len(edges) == 2          # one continue + one exploit
+        kinds = sorted(e._attrs.get("class", "") for e in edges)
+        assert any("pbt-exploit" in k for k in kinds)
+        assert any("pbt-continue" in k for k in kinds)
+        assert len(svg._query_all("circle")) >= 8   # 4 nodes × 2 rings
+        assert "exploit (weights copied)" in page.text(lineage)
+
     def test_yaml_create_with_dry_run(self, platform):
         store, _ = platform
         page = Page(studies.create_app(store))
