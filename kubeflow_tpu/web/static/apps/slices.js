@@ -109,7 +109,8 @@ async function newView(el) {
           + "accept this", "");
         snack("slice spec is valid", "success");
       } else {
-        snack(`created ${(cr.metadata || {}).name}`, "success");
+        snack(t("created {name}",
+          { name: (cr.metadata || {}).name }), "success");
         router.go("/");
       }
     } catch (e) {
@@ -120,15 +121,17 @@ async function newView(el) {
 
   el.append(
     h("div.kf-toolbar", {},
-      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
-      h("h2", {}, `New TPU slice in ${ns}`)),
+      h("button.ghost", { onclick: () => router.go("/") },
+        t("← back")),
+      h("h2", {}, t("New TPU slice in {ns}", { ns }))),
     h("div.kf-section", { id: "slice-editor" }, editor.element),
     h("div.kf-form-actions", {},
       h("button.primary", { id: "slice-create",
-        onclick: () => post(false) }, "Create"),
+        onclick: () => post(false) }, t("Create")),
       h("button.ghost", { id: "slice-dryrun",
-        onclick: () => post(true) }, "Validate (dry run)"),
-      h("button.ghost", { onclick: () => router.go("/") }, "Cancel")),
+        onclick: () => post(true) }, t("Validate (dry run)")),
+      h("button.ghost", { onclick: () => router.go("/") },
+        t("Cancel"))),
   );
 }
 
@@ -203,14 +206,15 @@ async function detailsView(el, params) {
 
   el.append(
     h("div.kf-toolbar", {},
-      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
+      h("button.ghost", { onclick: () => router.go("/") },
+        t("← back")),
       h("h2", {}, params.name, " "),
       phaseIcon(summary.phase)),
     tabPanel([
-      { id: "overview", label: "Overview", render: overview },
-      { id: "workers", label: `Workers (${workers.length})`,
+      { id: "overview", label: t("Overview"), render: overview },
+      { id: "workers", label: t("Workers") + ` (${workers.length})`,
         render: workersTab },
-      { id: "events", label: "Events", render: eventsTab },
+      { id: "events", label: t("Events"), render: eventsTab },
       { id: "yaml", label: "YAML", render: yamlTab },
     ]).element,
   );

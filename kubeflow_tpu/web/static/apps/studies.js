@@ -115,9 +115,10 @@ async function newView(el) {
       if (dryRun) {
         editor.setStatus("dry run ok — sweep spec and admission "
           + "chain accept this", "");
-        snack("study spec is valid", "success");
+        snack(t("study spec is valid"), "success");
       } else {
-        snack(`created ${(cr.metadata || {}).name}`, "success");
+        snack(t("created {name}",
+          { name: (cr.metadata || {}).name }), "success");
         router.go("/");
       }
     } catch (e) {
@@ -128,15 +129,17 @@ async function newView(el) {
 
   el.append(
     h("div.kf-toolbar", {},
-      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
-      h("h2", {}, `New study in ${ns}`)),
+      h("button.ghost", { onclick: () => router.go("/") },
+        t("← back")),
+      h("h2", {}, t("New study in {ns}", { ns }))),
     h("div.kf-section", { id: "study-editor" }, editor.element),
     h("div.kf-form-actions", {},
       h("button.primary", { id: "study-create",
-        onclick: () => post(false) }, "Create"),
+        onclick: () => post(false) }, t("Create")),
       h("button.ghost", { id: "study-dryrun",
-        onclick: () => post(true) }, "Validate (dry run)"),
-      h("button.ghost", { onclick: () => router.go("/") }, "Cancel")),
+        onclick: () => post(true) }, t("Validate (dry run)")),
+      h("button.ghost", { onclick: () => router.go("/") },
+        t("Cancel"))),
   );
 }
 
@@ -323,8 +326,8 @@ export function pbtLineage(trials) {
     edges, genLabels, memberLabels, nodes),
     h("div.kf-chart-legend", {},
       h("span.kf-legend-item", {}, h("span.kf-legend-line"),
-        " exploit (weights copied)"),
-      h("span.kf-legend-item", {}, "— continue")));
+        " " + t("exploit (weights copied)")),
+      h("span.kf-legend-item", {}, "— " + t("continue (own weights)"))));
 }
 
 
@@ -454,14 +457,15 @@ async function detailsView(el, params) {
 
   el.append(
     h("div.kf-toolbar", {},
-      h("button.ghost", { onclick: () => router.go("/") }, "← back"),
+      h("button.ghost", { onclick: () => router.go("/") },
+        t("← back")),
       h("h2", {}, params.name, " "),
       phaseIcon(summary.phase)),
     tabPanel([
-      { id: "overview", label: "Overview", render: overview },
-      { id: "trials", label: `Trials (${trials.length})`,
+      { id: "overview", label: t("Overview"), render: overview },
+      { id: "trials", label: t("Trials") + ` (${trials.length})`,
         render: trialsTab },
-      { id: "events", label: "Events", render: eventsTab },
+      { id: "events", label: t("Events"), render: eventsTab },
       { id: "yaml", label: "YAML", render: yamlTab },
     ]).element,
   );

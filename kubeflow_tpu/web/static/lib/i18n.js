@@ -130,6 +130,11 @@ const FR = {
   "Deletes the study and its trial pods.":
     "Supprime l'étude et ses pods d'essai.",
 
+  "New study in {ns}": "Nouvelle étude dans {ns}",
+  "exploit (weights copied)": "exploitation (poids copiés)",
+  "continue (own weights)": "continuation (poids propres)",
+  "study spec is valid": "la spécification de l'étude est valide",
+
   /* slices web app */
   "New slice": "Nouvelle tranche",
   "no TPU slices in this namespace":
@@ -140,6 +145,8 @@ const FR = {
   "Restarts": "Redémarrages",
   "Deletes the slice and all of its worker pods.":
     "Supprime la tranche et tous ses pods worker.",
+
+  "New TPU slice in {ns}": "Nouvelle tranche TPU dans {ns}",
 
   /* dashboard */
   "My namespaces": "Mes espaces de noms",
