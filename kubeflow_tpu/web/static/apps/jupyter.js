@@ -96,8 +96,8 @@ function volumeRow(initial, pvcs) {
     value: initial.name || "",
     checks: [validators.required, validators.dns1123] });
   const pickField = new Field({ id: "pick", label: t("Existing PVC"),
-    help: "Mounts a claim that already exists in this namespace - "
-      + "created from the Volumes app or a previous notebook.",
+    help: t("Mounts a claim that already exists in this namespace "
+      + "- created from the Volumes app or a previous notebook."),
     value: initial.name || (pvcs[0] || {}).name || "",
     options: (pvcs.length ? pvcs : [{ name: "" }]).map((p) => ({
       value: p.name,
@@ -172,19 +172,21 @@ async function formView(el) {
       value: "", checks: [validators.optional] }),
     new Field({ id: "cpu", label: t("CPU"), value: cfg.cpu.value,
       checks: [validators.quantity],
-      hint: `limit = request × ${cfg.cpu.limitFactor}` }),
+      hint: t("limit = request × {factor}",
+        { factor: cfg.cpu.limitFactor }) }),
     new Field({ id: "memory", label: t("Memory"), value: cfg.memory.value,
       checks: [validators.quantity],
-      hint: `limit = request × ${cfg.memory.limitFactor}` }),
+      hint: t("limit = request × {factor}",
+        { factor: cfg.memory.limitFactor }) }),
   ]);
 
   /* TPU picker: types from the deploy config, topologies narrowed to
    * what the cluster actually has when the scan found any */
   const types = cfg.accelerators.types || [];
   const typeField = new Field({ id: "type", label: t("TPU type"),
-    help: "Schedules the notebook onto hosts of this slice type via "
-      + "the cloud.google.com/gke-tpu-accelerator node selector; "
-      + "'None' runs CPU-only.",
+    help: t("Schedules the notebook onto hosts of this slice type "
+      + "via the cloud.google.com/gke-tpu-accelerator node selector; "
+      + "'None' runs CPU-only."),
     options: [{ value: "none", label: t("None") },
       ...types.map((t) => ({ value: t.id, label: t.uiName }))] });
   const topoField = new Field({ id: "topology", label: t("Topology"),
@@ -192,7 +194,7 @@ async function formView(el) {
   const chipsField = new Field({ id: "num",
     label: t("Chips per host"),
     value: "4", checks: [validators.optional],
-    hint: "google.com/tpu resource limit" });
+    hint: t("google.com/tpu resource limit") });
   const syncTopologies = () => {
     const t = types.find((x) => x.id === typeField.value());
     const cluster = clusterAcc.find((x) => x.id === typeField.value());

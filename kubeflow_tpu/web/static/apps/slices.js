@@ -156,21 +156,23 @@ async function detailsView(el, params) {
 
   const overview = (pane) => {
     pane.append(h("div.kf-section", {},
-      h("h2", {}, "Overview"),
+      h("h2", {}, t("Overview")),
       detailsList([
-        ["accelerator", summary.accelerator],
-        ["topology",
-          `${summary.topology} — ${summary.chips} chips over `
-          + `${summary.workers} workers`],
-        ["ready", `${summary.readyWorkers}/${summary.workers}`],
-        ["up for",
+        [t("accelerator"), summary.accelerator],
+        [t("topology"),
+          `${summary.topology} — ` + t(
+            "{chips} chips over {workers} workers",
+            { chips: summary.chips, workers: summary.workers })],
+        [t("ready"), `${summary.readyWorkers}/${summary.workers}`],
+        [t("up for"),
           duration((ts.metadata || {}).creationTimestamp)],
-        ["restarts",
+        [t("restarts"),
           `${summary.restartCount}/${summary.maxRestarts}`
           + (summary.lastRestartReason
-            ? ` — last: ${summary.lastRestartReason}` : "")],
+            ? t(" — last: {reason}",
+                { reason: summary.lastRestartReason }) : "")],
       ]),
-      h("h2", {}, "Conditions"),
+      h("h2", {}, t("Conditions")),
       conditionsTable((ts.status || {}).conditions)));
   };
 

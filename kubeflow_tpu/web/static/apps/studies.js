@@ -350,23 +350,24 @@ async function detailsView(el, params) {
   const overview = (pane) => {
     const created = (study.metadata || {}).creationTimestamp;
     pane.append(h("div.kf-section", {},
-      h("h2", {}, "Overview"),
+      h("h2", {}, t("Overview")),
       detailsList([
-        ["algorithm", summary.algorithm],
-        ["early stopping", summary.earlyStopping || "off"],
-        ["objective",
-          `${(study.spec.objective || {}).type || "maximize"} `
+        [t("algorithm"), summary.algorithm],
+        [t("early stopping"), summary.earlyStopping || t("off")],
+        [t("objective"),
+          t((study.spec.objective || {}).type || "maximize") + " "
           + summary.objective],
-        ["progress",
+        [t("progress"),
           `${summary.completedTrials}/${summary.maxTrials}`],
-        ["running for", duration(created)],
-        ["best", best
-          ? `trial ${best.index}: ${summary.objective}=` +
-            `${Number(best.objectiveValue).toPrecision(5)} @ ` +
-            JSON.stringify(best.parameters)
+        [t("running for"), duration(created)],
+        [t("best"), best
+          ? t("trial {index}", { index: best.index })
+            + `: ${summary.objective}=`
+            + `${Number(best.objectiveValue).toPrecision(5)} @ `
+            + JSON.stringify(best.parameters)
           : null],
       ]),
-      h("h2", {}, "Conditions"),
+      h("h2", {}, t("Conditions")),
       conditionsTable((study.status || {}).conditions)));
   };
 

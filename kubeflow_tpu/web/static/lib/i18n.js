@@ -120,6 +120,16 @@ const FR = {
   "Overview": "Aperçu",
   "Logs": "Journaux",
 
+  "Schedules the notebook onto hosts of this slice type via the cloud.google.com/gke-tpu-accelerator node selector; 'None' runs CPU-only.":
+    "Planifie le notebook sur des hôtes de ce type de tranche via le "
+    + "sélecteur de nœud cloud.google.com/gke-tpu-accelerator ; "
+    + "« Aucun » s'exécute sur CPU uniquement.",
+  "google.com/tpu resource limit": "limite de ressource google.com/tpu",
+  "Mounts a claim that already exists in this namespace - created from the Volumes app or a previous notebook.":
+    "Monte un claim existant de cet espace de noms — créé depuis "
+    + "l'application Volumes ou un notebook précédent.",
+  "limit = request × {factor}": "limite = demande × {factor}",
+
   /* studies web app */
   "New study": "Nouvelle étude",
   "no studies in this namespace":
@@ -135,6 +145,18 @@ const FR = {
   "continue (own weights)": "continuation (poids propres)",
   "study spec is valid": "la spécification de l'étude est valide",
 
+  "algorithm": "algorithme",
+  "early stopping": "arrêt anticipé",
+  "off": "désactivé",
+  "objective": "objectif",
+  "progress": "progression",
+  "running for": "en cours depuis",
+  "best": "meilleur",
+  "maximize": "maximiser",
+  "minimize": "minimiser",
+  "trial {index}": "essai {index}",
+  "Conditions": "Conditions",
+
   /* slices web app */
   "New slice": "Nouvelle tranche",
   "no TPU slices in this namespace":
@@ -147,6 +169,15 @@ const FR = {
     "Supprime la tranche et tous ses pods worker.",
 
   "New TPU slice in {ns}": "Nouvelle tranche TPU dans {ns}",
+
+  "accelerator": "accélérateur",
+  "{chips} chips over {workers} workers":
+    "{chips} puces sur {workers} workers",
+  " — last: {reason}": " — dernier : {reason}",
+  "topology": "topologie",
+  "ready": "prêts",
+  "up for": "actif depuis",
+  "restarts": "redémarrages",
 
   /* dashboard */
   "My namespaces": "Mes espaces de noms",
