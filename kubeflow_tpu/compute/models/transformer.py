@@ -349,37 +349,44 @@ def _dropless_moe(h, lp, config):
     flat_idx = expert_idx.reshape(b * s, k)
     flat_gate = gate_vals.reshape(b * s, k)
 
+    def _ragged_core(wg, wu, wd, hf, key, gates, n_groups, mine):
+        """ONE grouped-matmul sequence shared by the EP shard_map body
+        and the no-EP inline path (ragged_dot engine — the TPU
+        grouped-matmul primitive): sort by group key, fused gate|up
+        ragged_dot, down-projection ragged_dot, gate scaling, scatter-
+        add combine. A trailing zero-weight dummy group absorbs
+        foreign rows (``key == n_groups``); ``mine`` masks their
+        contribution (None = all rows local)."""
+        f = wg.shape[-1]
+        wgu = jnp.concatenate([wg, wu], axis=-1)     # [e, d, 2f]
+        zgu = jnp.zeros((1,) + wgu.shape[1:], wgu.dtype)
+        zd = jnp.zeros((1,) + wd.shape[1:], wd.dtype)
+        order = jnp.argsort(key, stable=True)
+        counts = jnp.bincount(key, length=n_groups + 1).astype(
+            jnp.int32)
+        tok = order // k
+        xg = jnp.take(hf, tok, axis=0)
+        gu = lax.ragged_dot(xg, jnp.concatenate([wgu, zgu]), counts)
+        rows = lax.ragged_dot(
+            jax.nn.silu(gu[..., :f]) * gu[..., f:],
+            jnp.concatenate([wd, zd]), counts)
+        scale = gates.reshape(-1)[order]
+        if mine is not None:
+            scale = scale * mine[order].astype(scale.dtype)
+        rows = rows * scale.astype(rows.dtype)[:, None]
+        return jnp.zeros_like(hf).at[tok].add(rows)
+
     def manual(wg, wu, wd, hf, idx, gates):
+        # expert-parallel body (inside the shard_map); the Pallas gmm
+        # engine runs only in the no-EP fast path (a Mosaic kernel
+        # cannot be auto-partitioned under the partial-manual wrapper)
         shard = lax.axis_index(EXPERT_AXIS)
         e_local = wg.shape[0]
         flat = idx.reshape(-1)                       # [N*k] global ids
         loc = flat - shard * e_local
         mine = (loc >= 0) & (loc < e_local)
-        # group by local expert; foreign rows form a trailing dummy
-        # group with zero weights
         key = jnp.where(mine, loc, e_local)
-        f = wg.shape[-1]
-        # ONE grouped matmul for gate|up: halves the launches on the
-        # input side and doubles the N tile for the MXU
-        wgu = jnp.concatenate([wg, wu], axis=-1)     # [e, d, 2f]
-        zgu = jnp.zeros((1,) + wgu.shape[1:], wgu.dtype)
-        zd = jnp.zeros((1,) + wd.shape[1:], wd.dtype)
-        wgu_a = jnp.concatenate([wgu, zgu])
-        wd_a = jnp.concatenate([wd, zd])
-        # ragged_dot engine (the TPU grouped-matmul primitive) — the
-        # expert-parallel path; the Pallas gmm engine runs only in the
-        # no-EP fast path below (a Mosaic kernel cannot be auto-
-        # partitioned under the partial-manual shard_map)
-        order = jnp.argsort(key, stable=True)
-        counts = jnp.bincount(key, length=e_local + 1).astype(jnp.int32)
-        tok = order // k
-        xg = jnp.take(hf, tok, axis=0)
-        gu = lax.ragged_dot(xg, wgu_a, counts)
-        gate_h, up_h = gu[..., :f], gu[..., f:]
-        rows = lax.ragged_dot(jax.nn.silu(gate_h) * up_h, wd_a, counts)
-        scale = gates.reshape(-1)[order] * mine[order].astype(gates.dtype)
-        rows = rows * scale.astype(rows.dtype)[:, None]
-        out = jnp.zeros_like(hf).at[tok].add(rows)
+        out = _ragged_core(wg, wu, wd, hf, key, gates, e_local, mine)
         return lax.psum(out, EXPERT_AXIS)
 
     def gmm_inline(wg, wu, wd, hf, idx, gates):
@@ -412,6 +419,15 @@ def _dropless_moe(h, lp, config):
         # are adjacent, so the combine is a reshape-sum, not a scatter
         return rows.reshape(n_rows // k, k, -1).sum(axis=1)
 
+    def ragged_inline(wg, wu, wd, hf, idx, gates):
+        """No-EP ragged path WITHOUT the shard_map: with the expert
+        axis at 1 the partial-manual wrapper adds nothing and XLA's
+        partitioner rejects the manual psum on some odd-size auto
+        meshes (RET_CHECK IsManualSubgroup, seen at data=7) — plain
+        SPMD ops partition fine everywhere."""
+        return _ragged_core(wg, wu, wd, hf, idx.reshape(-1), gates,
+                            e, None)
+
     def _mesh_trivial():
         # ALL axes, not just expert: a Mosaic kernel cannot be auto-
         # partitioned, so any sharded axis (data on a dp slice, tensor
@@ -419,6 +435,10 @@ def _dropless_moe(h, lp, config):
         mesh = jax.sharding.get_abstract_mesh()
         return mesh is None or all(
             s == 1 for s in dict(mesh.shape).values())
+
+    def _expert_axis_trivial():
+        mesh = jax.sharding.get_abstract_mesh()
+        return mesh is None or dict(mesh.shape).get(EXPERT_AXIS, 1) == 1
 
     def _gmm_shapes_ok():
         # Mosaic lane tiles are 128-wide; ragged_dot accepts any shape
@@ -443,6 +463,11 @@ def _dropless_moe(h, lp, config):
                          lp["we_up"].astype(dt),
                          lp["we_down"].astype(dt), hf.astype(dt),
                          flat_idx, flat_gate.astype(dt))
+    elif _expert_axis_trivial():
+        out = ragged_inline(lp["we_gate"].astype(dt),
+                            lp["we_up"].astype(dt),
+                            lp["we_down"].astype(dt), hf.astype(dt),
+                            flat_idx, flat_gate.astype(dt))
     else:
         from jax.sharding import PartitionSpec as P
         sm = jax.shard_map(
