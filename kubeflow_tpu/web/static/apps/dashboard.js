@@ -30,10 +30,11 @@ async function onboarding(el, info) {
   const name = h("input", { id: "workgroup-name",
     value: (info.user || "user").split("@")[0].replace(/\./g, "-") });
   el.append(h("div.kf-section", { id: "onboarding" },
-    h("h2", {}, `Welcome, ${info.user}`),
-    h("p", {}, "You have no namespace yet. Create your workgroup to " +
-      "get a namespace with quotas, service accounts and routing."),
-    h("div.kf-field", {}, h("label", {}, "Namespace name"), name),
+    h("h2", {}, t("Welcome, {user}", { user: info.user })),
+    h("p", {}, t("You have no namespace yet. Create your workgroup "
+      + "to get a namespace with quotas, service accounts and "
+      + "routing.")),
+    h("div.kf-field", {}, h("label", {}, t("Namespace name")), name),
     h("button.primary", { id: "create-workgroup", onclick: async () => {
       try {
         const out = await api("POST", "api/workgroup/create",
@@ -43,7 +44,7 @@ async function onboarding(el, info) {
       } catch (e) {
         snack(String(e.message || e), "error");
       }
-    } }, "Create workgroup")));
+    } }, t("Create workgroup"))));
   return true;
 }
 
@@ -52,7 +53,7 @@ function nsTable(info) {
     h("h2", {}, t("My namespaces")),
     h("table.kf-table", {},
       h("thead", {}, h("tr", {},
-        h("th", {}, "namespace"), h("th", {}, "role"))),
+        h("th", {}, t("namespace")), h("th", {}, t("role")))),
       h("tbody", {}, info.namespaces.map((n) => h("tr", {},
         h("td", {}, n.namespace), h("td", {}, n.role))))));
 }
@@ -77,7 +78,7 @@ function contributorsPanel(info) {
 
   const refresh = async () => {
     const ns = nsSelect.value;
-    title.textContent = `Contributors of ${ns}`;
+    title.textContent = t("Contributors of {ns}", { ns });
     const data = await api("GET",
       `api/workgroup/contributors?namespace=${ns}`);
     clear(list);
@@ -87,8 +88,9 @@ function contributorsPanel(info) {
         h("td.kf-actions", {}, h("button.ghost", {
           onclick: async () => {
             const ok = await confirmDialog({
-              title: `Remove ${c.user} from ${ns}?`,
-              action: "Remove", danger: true });
+              title: t("Remove {user} from {ns}?",
+                { user: c.user, ns }),
+              action: t("remove"), danger: true });
             if (!ok) return;
             try {
               await api("DELETE", "api/workgroup/contributors",
@@ -97,11 +99,11 @@ function contributorsPanel(info) {
             } catch (e) {
               fail(e);
             }
-          } }, "remove"))));
+          } }, t("remove")))));
     }
     if (!data.contributors.length) {
       list.append(h("tr", {},
-        h("td.kf-empty", { colSpan: 3 }, "no contributors yet")));
+        h("td.kf-empty", { colSpan: 3 }, t("no contributors yet"))));
     }
   };
 
@@ -124,7 +126,7 @@ function contributorsPanel(info) {
     h("div.kf-toolbar", {}, title, h("span.kf-spacer"), nsSelect),
     h("table.kf-table", {},
       h("thead", {}, h("tr", {},
-        h("th", {}, "user"), h("th", {}, "role"), h("th", {}, ""))),
+        h("th", {}, t("user")), h("th", {}, t("role")), h("th", {}, ""))),
       list),
     h("div.kf-toolbar", {}, email, role,
       h("button.primary", { id: "add-contributor", onclick: add },
@@ -157,7 +159,7 @@ function iframeView(el, params) {
   el.append(
     h("div.kf-toolbar", {},
       h("button.ghost", { onclick: () => { location.hash = "#/"; } },
-        "← dashboard"),
+        t("← dashboard")),
       h("h2", {}, app.label)),
     h("iframe.kf-app-frame", {
       src: app.href,
@@ -172,10 +174,10 @@ async function activityFeed(el, info) {
   const table = h("table.kf-table", {},
     h("thead", {}, h("tr", {},
       ["type", "reason", "message", "when"].map(
-        (c) => h("th", {}, c)))),
+        (c) => h("th", {}, t(c))))),
     list);
   el.append(h("div.kf-section", {},
-    panel(`Recent activity in ${ns}`, table)));
+    panel(t("Recent activity in {ns}", { ns }), table)));
   const poller = new Poller(async () => {
     const events = await api("GET", `api/activities/${ns}`);
     clear(list).append(...events.slice(0, 12).map((e) => h("tr", {},
@@ -185,7 +187,7 @@ async function activityFeed(el, info) {
       h("td", {}, e.lastTimestamp || ""))));
     if (!events.length) {
       list.append(h("tr", {},
-        h("td.kf-empty", { colSpan: 4 }, "no recent events")));
+        h("td.kf-empty", { colSpan: 4 }, t("no recent events"))));
     }
   }, 15000, list);
   poller.kick();
@@ -233,8 +235,8 @@ async function podDefaultsView(el) {
   }
   const names = info.namespaces.map((n) => n.namespace);
   if (!names.length) {
-    el.append(h("p.kf-empty", {}, "no namespace yet — create your " +
-      "workgroup first"));
+    el.append(h("p.kf-empty", {},
+      t("no namespace yet — create your workgroup first")));
     return;
   }
   const nsSelect = h("select", { id: "pd-ns",
@@ -257,35 +259,36 @@ async function podDefaultsView(el) {
           .map(([k, v]) => `${k}=${v}`).join(", ")),
         h("td.kf-actions", {},
           h("button.ghost", { dataset: { action: "edit" },
-            onclick: () => edit(pd) }, "edit"),
+            onclick: () => edit(pd) }, t("edit")),
           h("button.danger", { dataset: { action: "delete" },
             onclick: async () => {
               const ok = await confirmDialog({
-                title: `Delete PodDefault ${md.name}?`,
-                body: "Notebooks keep whatever it already injected.",
-                action: "Delete", danger: true });
+                title: t("Delete PodDefault {name}?", { name: md.name }),
+                body: t("Notebooks keep whatever it already injected."),
+                action: t("delete"), danger: true });
               if (!ok) return;
               try {
                 await api("DELETE",
                   `api/namespaces/${ns}/poddefaults/${md.name}`);
-                snack(`deleted ${md.name}`, "success");
+                snack(t("deleted {name}", { name: md.name }), "success");
                 await list();
               } catch (e) { fail(e); }
-            } }, "delete"))));
+            } }, t("delete")))));
     }
     if (!data.poddefaults.length) {
       rows.append(h("tr", {},
-        h("td.kf-empty", { colSpan: 4 }, "no poddefaults in " + ns)));
+        h("td.kf-empty", { colSpan: 4 },
+        t("no poddefaults in {ns}", { ns }))));
     }
     clear(body).append(
       h("div.kf-card", {}, h("table.kf-table", {},
         h("thead", {}, h("tr", {},
-          ["name", "description", "selector", ""].map(
+          [t("name"), t("description"), t("selector"), ""].map(
             (c) => h("th", {}, c)))),
         rows)),
       h("div.kf-form-actions", {},
         h("button.primary", { id: "new-poddefault",
-          onclick: () => edit(null) }, "+ New PodDefault")));
+          onclick: () => edit(null) }, t("+ New PodDefault"))));
   };
 
   const edit = (existing) => {
@@ -309,10 +312,10 @@ async function podDefaultsView(el) {
       try {
         await api(method, url + (dryRun ? "?dry_run=true" : ""), cr);
         if (dryRun) {
-          editor.setStatus("dry run ok", "");
-          snack("manifest is valid", "success");
+          editor.setStatus(t("dry run ok"), "");
+          snack(t("manifest is valid"), "success");
         } else {
-          snack(`saved ${name}`, "success");
+          snack(t("saved {name}", { name }), "success");
           await list();
         }
       } catch (e) {
@@ -323,22 +326,23 @@ async function podDefaultsView(el) {
     clear(body).append(
       h("div.kf-section", { id: "pd-editor" },
         h("h2", {}, existing
-          ? `Edit ${existing.metadata.name}` : "New PodDefault"),
+          ? t("Edit {name}", { name: existing.metadata.name })
+          : t("New PodDefault")),
         editor.element,
         h("div.kf-form-actions", {},
           h("button.primary", { id: "pd-save",
-            onclick: () => save(false) }, "Save"),
+            onclick: () => save(false) }, t("Save")),
           h("button.ghost", { id: "pd-dryrun",
-            onclick: () => save(true) }, "Validate (dry run)"),
+            onclick: () => save(true) }, t("Validate (dry run)")),
           h("button.ghost", { onclick: () => list().catch(fail) },
-            "Cancel"))));
+            t("Cancel")))));
   };
 
   el.append(
     h("div.kf-toolbar", {},
       h("button.ghost", { onclick: () => { location.hash = "#/"; } },
-        "← dashboard"),
-      h("h2", {}, "PodDefaults"),
+        t("← dashboard")),
+      h("h2", {}, t("PodDefaults")),
       h("span.kf-spacer"), nsSelect),
     body);
   await list().catch(fail);

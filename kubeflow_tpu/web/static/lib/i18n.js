@@ -184,6 +184,39 @@ const FR = {
   "Applications": "Applications",
   "Add contributor": "Ajouter un contributeur",
   "added {name}": "{name} ajouté",
+  "Welcome, {user}": "Bienvenue, {user}",
+  "You have no namespace yet. Create your workgroup to get a namespace with quotas, service accounts and routing.":
+    "Vous n'avez pas encore d'espace de noms. Créez votre groupe de "
+    + "travail pour obtenir un espace de noms avec quotas, comptes de "
+    + "service et routage.",
+  "Namespace name": "Nom de l'espace de noms",
+  "Create workgroup": "Créer le groupe de travail",
+  "namespace": "espace de noms",
+  "role": "rôle",
+  "user": "utilisateur",
+  "Contributors of {ns}": "Contributeurs de {ns}",
+  "no contributors yet": "aucun contributeur pour l'instant",
+  "Recent activity in {ns}": "Activité récente dans {ns}",
+  "no recent events": "aucun événement récent",
+  "PodDefaults": "PodDefaults",
+  "← dashboard": "← tableau de bord",
+  "+ New PodDefault": "+ Nouveau PodDefault",
+  "no poddefaults in {ns}": "aucun PodDefault dans {ns}",
+  "name": "nom",
+  "description": "description",
+  "selector": "sélecteur",
+  "Save": "Enregistrer",
+  "saved {name}": "{name} enregistré",
+  "Edit {name}": "Modifier {name}",
+  "New PodDefault": "Nouveau PodDefault",
+  "Delete PodDefault {name}?": "Supprimer le PodDefault {name} ?",
+  "Remove {user} from {ns}?": "Retirer {user} de {ns} ?",
+  "no namespace yet — create your workgroup first":
+    "pas encore d'espace de noms — créez d'abord votre groupe de "
+    + "travail",
+  "dry run ok": "simulation réussie",
+  "Notebooks keep whatever it already injected.":
+    "Les notebooks conservent ce qui a déjà été injecté.",
 
   /* tensorboards web app (reference twa i18n scope) */
   "New tensorboard": "Nouveau tensorboard",
