@@ -23,6 +23,7 @@ substrate:
 import base64
 import json
 import queue
+import random
 import sys
 import threading
 import time
@@ -373,6 +374,11 @@ class ModelServer:
         # after the unload — retired models stay budget-counted and
         # are the first eviction victims (stale last_used)
         self._retired = []
+        # canary deployments: name -> {"model", "weight"}; routed a
+        # weighted fraction of predict traffic until promoted/rolled
+        # back. Injectable RNG so tests can drive the split.
+        self._canaries = {}
+        self._canary_rng = random.Random()
 
     def register(self, name, predict_fn, version=1, **model_kwargs):
         old = self._models.get(name)
@@ -424,37 +430,128 @@ class ModelServer:
                 self._pending.remove(model)
             else:
                 self._models[name] = model
-            if old is not None and old._managed:
-                # bounded retention: one retired entry per name (an
-                # in-flight handler can still lazily reload a retired
-                # model — counted + evictable until the next
-                # transition purges it)
-                for prev in [m for m in self._retired
-                             if m.name == name]:
-                    prev.unload()
-                    self._retired.remove(prev)
-                self._retired.append(old)
+            if old is not None:
+                self._mark_retired(old)
         if old is not None:
-            old.close(graceful=True)   # stop ACCEPTING, drain FIFO
-            if old._batcher is not None:
-                # wait for the drain before the unload: a queued
-                # straggler must not have to cold-reload the version
-                # we are about to unload
-                old._batcher.thread.join(timeout=30)
-            if old._managed:
-                with self._residency_lock:
-                    old.unload()       # free HBM; handle may outlive
+            self._drain_and_unload(old)
         return model
 
     def models(self):
         return dict(self._models)
 
+    # ----------------------------------------------------- canaries
+    def register_canary(self, name, make_fn, params, version,
+                        weight=0.1, preload=True, **model_kwargs):
+        """Deploy ``version`` as a CANARY for served name ``name``:
+        a ``weight`` fraction of predict traffic routes to it (the
+        rest stays on the stable version) until :meth:`promote_canary`
+        flips all traffic or :meth:`rollback_canary` discards it.
+        Responses carry ``X-Served-Version`` so clients and monitors
+        can attribute results. The canary is residency-managed like
+        any loadable model (budget-counted, evictable, lazily
+        reloaded)."""
+        if name not in self._models:
+            raise KeyError(f"no stable model {name!r} to canary")
+        if not 0.0 <= weight <= 1.0:
+            raise ValueError(f"weight must be in [0, 1], got {weight}")
+        model = ServedModel(name, version=version, make_fn=make_fn,
+                            host_params=params, **model_kwargs)
+        model._ensure = self._ensure_loaded
+        with self._residency_lock:
+            prev = self._canaries.pop(name, None)
+            self._canaries[name] = {"model": model, "weight": weight}
+            if preload:
+                try:
+                    self._ensure_loaded(model)
+                except Exception:
+                    self._canaries.pop(name, None)
+                    if prev is not None:
+                        self._canaries[name] = prev
+                    model.close()
+                    raise
+        if prev is not None:
+            with self._residency_lock:
+                self._mark_retired(prev["model"])
+            self._drain_and_unload(prev["model"])
+        return model
+
+    def set_canary_weight(self, name, weight):
+        if not 0.0 <= weight <= 1.0:
+            raise ValueError(f"weight must be in [0, 1], got {weight}")
+        with self._residency_lock:
+            self._canaries[name]["weight"] = weight
+
+    def promote_canary(self, name):
+        """All traffic to the canary; the previous stable version is
+        drained and retired exactly like a version transition."""
+        with self._residency_lock:
+            entry = self._canaries.pop(name)
+            model = entry["model"]
+            old = self._models.get(name)
+            self._models[name] = model
+            if old is not None:
+                self._mark_retired(old)
+        if old is not None:
+            self._drain_and_unload(old)
+        return model
+
+    def rollback_canary(self, name):
+        """Discard the canary; stable keeps serving untouched. The
+        canary is retired (not dropped): an in-flight request that
+        already routed to it may lazily reload, and those bytes must
+        stay budget-visible."""
+        with self._residency_lock:
+            entry = self._canaries.pop(name)
+            self._mark_retired(entry["model"])
+        self._drain_and_unload(entry["model"])
+
+    def _route(self, name, model):
+        """Pick stable vs canary for one predict call."""
+        entry = self._canaries.get(name)
+        if entry is not None \
+                and self._canary_rng.random() < entry["weight"]:
+            return entry["model"]
+        return model
+
+    def _mark_retired(self, old):
+        """Register a displaced managed model as retired: budget-
+        counted + evictable, bounded to one entry per name. Call
+        inside the flip's lock scope so the copy stays budget-visible
+        from the instant it leaves the registry (the RLock re-enters
+        safely)."""
+        if not old._managed:
+            return
+        with self._residency_lock:
+            for prev in [m for m in self._retired
+                         if m.name == old.name and m is not old]:
+                prev.unload()
+                self._retired.remove(prev)
+            self._retired.append(old)
+
+    def _drain_and_unload(self, old):
+        """The ONE drain path for any displaced model (version
+        transition, canary promote/replace/rollback): stop accepting,
+        let the queued batched work finish — joining the batcher
+        BEFORE the unload so a queued straggler never cold-reloads
+        the copy we are freeing — then drop the device bytes."""
+        old.close(graceful=True)       # stop ACCEPTING, drain FIFO
+        if old._batcher is not None:
+            old._batcher.thread.join(timeout=30)
+        if old._managed:
+            with self._residency_lock:
+                old.unload()
+
     # --------------------------------------------------- residency
+    def _all_managed(self):
+        """Every model that can hold device bytes (registry, pending
+        transitions, retired stragglers, canaries)."""
+        return [*self._models.values(), *self._pending, *self._retired,
+                *(c["model"] for c in self._canaries.values())]
+
     def resident_bytes(self):
         with self._residency_lock:
             seen, total = set(), 0
-            for m in [*self._models.values(), *self._pending,
-                      *self._retired]:
+            for m in self._all_managed():
                 if m._managed and m.loaded and id(m) not in seen:
                     seen.add(id(m))
                     total += m.resident_bytes
@@ -482,12 +579,15 @@ class ModelServer:
                 pending = [m for m in self._pending
                            if m._managed and m.loaded
                            and m is not model]
-                loaded = sorted(
-                    (m for m in [*self._models.values(),
-                                 *self._retired]
-                     if m._managed and m.loaded and m is not model
-                     and m not in self._pending),
-                    key=lambda m: m.last_used)
+                seen = set()
+                candidates = []
+                for m in self._all_managed():
+                    if m._managed and m.loaded and m is not model \
+                            and m not in self._pending \
+                            and id(m) not in seen:
+                        seen.add(id(m))
+                        candidates.append(m)
+                loaded = sorted(candidates, key=lambda m: m.last_used)
                 in_use = sum(m.resident_bytes
                              for m in [*loaded, *pending])
                 for victim in loaded:
@@ -588,11 +688,18 @@ class ModelServer:
                     # servable — readiness probes keyed on the
                     # TF-Serving state enum must not pull the server
                     # out of rotation. Residency lives in its own block.
-                    return self._send(200, {"model_version_status": [{
+                    canary = server._canaries.get(parts[2])
+                    payload = {"model_version_status": [{
                         "version": str(model.version),
                         "state": "AVAILABLE",
                         "status": {"error_code": "OK", "error_message": ""},
-                    }], "residency": self._residency(model)})
+                    }], "residency": self._residency(model)}
+                    if canary is not None:
+                        payload["canary"] = {
+                            "version": str(canary["model"].version),
+                            "weight": canary["weight"],
+                            **self._residency(canary["model"])}
+                    return self._send(200, payload)
                 if parts == ["v1", "models"]:
                     # registry listing with residency state — what an
                     # operator needs to see the byte budget working
@@ -608,7 +715,14 @@ class ModelServer:
                             "state": "RESIDENT" if m.loaded
                             else "EVICTED",
                             **self._residency(m),
-                        } for m in models.values()]})
+                        } for m in models.values()] + [{
+                            "name": f"{name}@canary",
+                            "version": str(c["model"].version),
+                            "weight": c["weight"],
+                            "state": "RESIDENT" if c["model"].loaded
+                            else "EVICTED",
+                            **self._residency(c["model"]),
+                        } for name, c in server._canaries.items()]})
                 if parts == ["healthz"]:
                     return self._send(200, {"status": "ok"})
                 self._send(404, {"error": "not found"})
@@ -622,6 +736,9 @@ class ModelServer:
                 model = models.get(name)
                 if model is None:
                     return self._send(404, {"error": "model not found"})
+                # canary split: a weighted fraction of traffic serves
+                # from the canary version (resolved per request)
+                model = server._route(name, model)
                 if self._reject_chunked():
                     return
                 if verb == "predictStream":
@@ -668,7 +785,8 @@ class ModelServer:
                 else:
                     payload = {"predictions": out.tolist()}
                 self._send(200, payload,
-                           (("X-Inference-Time-Ms", f"{infer:.1f}"),))
+                           (("X-Inference-Time-Ms", f"{infer:.1f}"),
+                            ("X-Served-Version", str(model.version))))
 
             def _predict_stream(self, model):
                 """Batched-pipelined predict over one connection: the
@@ -718,6 +836,9 @@ class ModelServer:
                 self.send_header("Content-Type",
                                  "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
+                # canary attribution works on streams too
+                self.send_header("X-Served-Version",
+                                 str(model.version))
                 self.end_headers()
 
                 # deadlock guard: half-duplex clients upload the whole

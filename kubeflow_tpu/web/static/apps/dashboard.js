@@ -90,7 +90,7 @@ function contributorsPanel(info) {
             const ok = await confirmDialog({
               title: t("Remove {user} from {ns}?",
                 { user: c.user, ns }),
-              action: t("remove"), danger: true });
+              action: t("Remove"), danger: true });
             if (!ok) return;
             try {
               await api("DELETE", "api/workgroup/contributors",
@@ -265,7 +265,7 @@ async function podDefaultsView(el) {
               const ok = await confirmDialog({
                 title: t("Delete PodDefault {name}?", { name: md.name }),
                 body: t("Notebooks keep whatever it already injected."),
-                action: t("delete"), danger: true });
+                action: t("Delete"), danger: true });
               if (!ok) return;
               try {
                 await api("DELETE",

@@ -211,6 +211,8 @@ const FR = {
   "New PodDefault": "Nouveau PodDefault",
   "Delete PodDefault {name}?": "Supprimer le PodDefault {name} ?",
   "Remove {user} from {ns}?": "Retirer {user} de {ns} ?",
+  "Remove": "Retirer",
+  "Delete": "Supprimer",
   "no namespace yet — create your workgroup first":
     "pas encore d'espace de noms — créez d'abord votre groupe de "
     + "travail",

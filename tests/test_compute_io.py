@@ -714,6 +714,121 @@ class TestServingResidency:
             server.stop()
 
 
+class TestServingCanary:
+    """Weighted canary routing over model versions (the fleet rung
+    after residency + transitions): a fraction of predict traffic
+    serves the canary until promote/rollback."""
+
+    CFG = mlp.Config(in_dim=16, hidden=8, n_classes=4)
+
+    def _fn(self):
+        cfg = self.CFG
+        return lambda p, x: jax.nn.softmax(mlp.apply(p, x, cfg), -1)
+
+    def _params(self, seed):
+        return jax.tree.map(np.asarray, mlp.init_params(
+            self.CFG, jax.random.PRNGKey(seed)))
+
+    def _server(self, weight):
+        import random as _random
+        from kubeflow_tpu.compute import serving as sv
+        server = sv.ModelServer()
+        server._canary_rng = _random.Random(0)   # deterministic split
+        server.register_loadable("m", self._fn(), self._params(1),
+                                 version=1, preload=True)
+        server.register_canary("m", self._fn(), self._params(2),
+                               version=2, weight=weight)
+        port = server.start(port=0, host="127.0.0.1")
+        return server, port
+
+    @staticmethod
+    def _predict_version(port):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/m:predict",
+            data=json.dumps(
+                {"instances": np.zeros((1, 16)).tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req)
+        resp.read()
+        return resp.headers["X-Served-Version"]
+
+    def test_weight_splits_traffic_and_header_attributes(self):
+        server, port = self._server(weight=0.5)
+        try:
+            versions = [self._predict_version(port) for _ in range(40)]
+            assert set(versions) == {"1", "2"}
+            # seeded rng: the split is in the right ballpark
+            canary_frac = versions.count("2") / len(versions)
+            assert 0.2 < canary_frac < 0.8
+            status = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/models/m"))
+            assert status["canary"]["version"] == "2"
+            assert status["canary"]["weight"] == 0.5
+            listing = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/models"))
+            names = {m["name"] for m in listing["models"]}
+            assert "m@canary" in names
+        finally:
+            server.stop()
+
+    def test_weight_zero_and_one_are_deterministic(self):
+        server, port = self._server(weight=0.0)
+        try:
+            assert {self._predict_version(port)
+                    for _ in range(10)} == {"1"}
+            server.set_canary_weight("m", 1.0)
+            assert {self._predict_version(port)
+                    for _ in range(10)} == {"2"}
+        finally:
+            server.stop()
+
+    def test_promote_flips_all_traffic_and_retires_stable(self):
+        server, port = self._server(weight=0.2)
+        try:
+            m2 = server.promote_canary("m")
+            assert server.models()["m"] is m2
+            assert {self._predict_version(port)
+                    for _ in range(10)} == {"2"}
+            assert "m" not in server._canaries
+            status = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/models/m"))
+            assert status["model_version_status"][0]["version"] == "2"
+            assert "canary" not in status
+        finally:
+            server.stop()
+
+    def test_rollback_discards_canary_untouched_stable(self):
+        server, port = self._server(weight=0.5)
+        try:
+            server.rollback_canary("m")
+            assert {self._predict_version(port)
+                    for _ in range(10)} == {"1"}
+            assert "m" not in server._canaries
+        finally:
+            server.stop()
+
+    def test_canary_counts_toward_budget(self):
+        from kubeflow_tpu.compute import serving as sv
+        p1 = self._params(1)
+        one = sv.tree_bytes(p1)
+        server = sv.ModelServer(budget_bytes=int(one * 2.5))
+        server.register_loadable("m", self._fn(), p1, version=1,
+                                 preload=True)
+        before = server.resident_bytes()
+        server.register_canary("m", self._fn(), self._params(2),
+                               version=2, weight=0.5)
+        assert server.resident_bytes() == before + one
+        server.rollback_canary("m")
+        assert server.resident_bytes() == before
+
+    def test_canary_without_stable_rejected(self):
+        from kubeflow_tpu.compute import serving as sv
+        server = sv.ModelServer()
+        with pytest.raises(KeyError):
+            server.register_canary("nope", self._fn(),
+                                   self._params(1), version=2)
+
+
 class TestInt8Quantization:
     """Weight-only int8 (compute/quantize.py): int8 weights + per-
     channel scales dequantized inside jit; accuracy pinned vs fp32."""
