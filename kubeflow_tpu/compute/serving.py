@@ -458,20 +458,23 @@ class ModelServer:
                             host_params=params, **model_kwargs)
         model._ensure = self._ensure_loaded
         with self._residency_lock:
+            if preload:
+                # load BEFORE publishing: traffic must never route to
+                # a canary that is still loading (latency blip) or
+                # whose preload fails (the client would eat the error)
+                self._pending.append(model)
+                try:
+                    self._ensure_loaded(
+                        model, protect=self._models.get(name))
+                except Exception:
+                    model.close()   # don't leak the batcher thread
+                    raise           # nothing published
+                finally:
+                    self._pending.remove(model)
             prev = self._canaries.pop(name, None)
             self._canaries[name] = {"model": model, "weight": weight}
-            if preload:
-                try:
-                    self._ensure_loaded(model)
-                except Exception:
-                    self._canaries.pop(name, None)
-                    if prev is not None:
-                        self._canaries[name] = prev
-                    model.close()
-                    raise
         if prev is not None:
-            with self._residency_lock:
-                self._mark_retired(prev["model"])
+            self._mark_retired(prev["model"])
             self._drain_and_unload(prev["model"])
         return model
 
@@ -557,11 +560,14 @@ class ModelServer:
                     total += m.resident_bytes
             return total
 
-    def _ensure_loaded(self, model):
+    def _ensure_loaded(self, model, protect=None):
         """Make ``model`` device-resident under the byte budget,
         evicting LRU managed models as needed, and return the pinned
-        device tree. Serialized: concurrent loads would both pass the
-        budget check and overshoot."""
+        device tree. ``protect`` marks one model as unevictable for
+        this load (a canary preload must not evict the stable it
+        shadows — the stable keeps serving the 1-weight traffic and
+        would thrash). Serialized: concurrent loads would both pass
+        the budget check and overshoot."""
         with self._residency_lock:
             if model.loaded:
                 return model._dev_params
@@ -583,11 +589,15 @@ class ModelServer:
                 candidates = []
                 for m in self._all_managed():
                     if m._managed and m.loaded and m is not model \
+                            and m is not protect \
                             and m not in self._pending \
                             and id(m) not in seen:
                         seen.add(id(m))
                         candidates.append(m)
                 loaded = sorted(candidates, key=lambda m: m.last_used)
+                if protect is not None and protect._managed \
+                        and protect.loaded:
+                    pending = [*pending, protect]
                 in_use = sum(m.resident_bytes
                              for m in [*loaded, *pending])
                 for victim in loaded:
@@ -702,7 +712,12 @@ class ModelServer:
                     return self._send(200, payload)
                 if parts == ["v1", "models"]:
                     # registry listing with residency state — what an
-                    # operator needs to see the byte budget working
+                    # operator needs to see the byte budget working.
+                    # Snapshot under the lock: a canary deploy on
+                    # another thread must not resize the dicts mid-
+                    # iteration.
+                    with server._residency_lock:
+                        canary_items = list(server._canaries.items())
                     return self._send(200, {
                         "budget_bytes": server.budget_bytes,
                         "resident_bytes": server.resident_bytes(),
@@ -722,7 +737,7 @@ class ModelServer:
                             "state": "RESIDENT" if c["model"].loaded
                             else "EVICTED",
                             **self._residency(c["model"]),
-                        } for name, c in server._canaries.items()]})
+                        } for name, c in canary_items]})
                 if parts == ["healthz"]:
                     return self._send(200, {"status": "ok"})
                 self._send(404, {"error": "not found"})

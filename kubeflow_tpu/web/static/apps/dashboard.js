@@ -4,7 +4,8 @@
  * iframe-container; backend routes web/dashboard.py). */
 
 import {
-  api, clear, confirmDialog, h, panel, Poller, Router, snack, t,
+  api, clear, confirmDialog, h, panel, Poller, Router,
+  SERIES_BLUE, snack, sv, t,
   YamlEditor,
 } from "../lib/components.js";
 
@@ -193,15 +194,76 @@ async function activityFeed(el, info) {
   poller.kick();
 }
 
+
+export function metricChart(points, label) {
+  /* Single-series change-over-time (dataviz method): fewer than two
+   * points is NOT a chart — render a stat tile (hero number). With a
+   * real series: 2px line in series-1 blue, recessive grid, text-token
+   * labels, a direct label on the last value (no legend — one series,
+   * the title names it), <title> tooltips on oversized hit circles,
+   * and a table view behind a <details> for accessibility. */
+  if (!points.length) return null;
+  if (points.length < 2) {
+    return h("div.kf-stat", { id: "metric-stat" },
+      h("div.n", {}, String(points[0].value)),
+      h("div.label", {}, label));
+  }
+  const W = 560, H = 160, L = 46, R = 14, T = 12, B = 26;
+  const vals = points.map((p) => p.value);
+  let lo = Math.min(...vals, 0), hi = Math.max(...vals);
+  if (hi === lo) hi = lo + 1;
+  const X = (i) => L + i / (points.length - 1) * (W - L - R);
+  const Y = (v) => T + (hi - v) / (hi - lo) * (H - T - B);
+  const ticks = [0, 1, 2].map((k2) => lo + (k2 / 2) * (hi - lo));
+  const grid = ticks.map((v) => sv("line", {
+    x1: L, x2: W - R, y1: Y(v), y2: Y(v),
+    stroke: "#e8e8e4", "stroke-width": 1 }));
+  const yLabels = ticks.map((v) => sv("text", {
+    x: L - 6, y: Y(v) + 4, "text-anchor": "end",
+    class: "kf-chart-label" }, Number(v).toPrecision(3)));
+  const hhmm = (ts) => String(ts).slice(11, 16);
+  const xLabels = [0, points.length - 1].map((i) => sv("text", {
+    x: X(i), y: H - 8, "text-anchor": "middle",
+    class: "kf-chart-label" }, hhmm(points[i].timestamp)));
+  const d = points.map((p, i) =>
+    `${i ? "L" : "M"} ${X(i)} ${Y(p.value)}`).join(" ");
+  const line = sv("path", { d, fill: "none", stroke: SERIES_BLUE,
+    "stroke-width": 2 });
+  const dots = points.map((p, i) => sv("g", {},
+    sv("circle", { cx: X(i), cy: Y(p.value), r: 10,
+      fill: "transparent" },
+    sv("title", {}, `${hhmm(p.timestamp)} · ${p.value}`))));
+  const last = points[points.length - 1];
+  const lastLabel = sv("text", {
+    x: Math.min(X(points.length - 1) + 6, W - 4),
+    y: Y(last.value) - 6, class: "kf-chart-label kf-chart-best" },
+  String(last.value));
+  return h("div.kf-chart", { id: "metric-chart" },
+    sv("svg", { viewBox: `0 0 ${W} ${H}`, role: "img",
+      "aria-label": label },
+    grid, yLabels, xLabels, line, lastLabel, dots),
+    h("details", {}, h("summary", {}, label),
+      h("table.kf-table", {},
+        h("tbody", {}, points.map((p) => h("tr", {},
+          h("td", {}, String(p.timestamp)),
+          h("td", {}, String(p.value))))))));
+}
+
 async function metricsPanel(el, info) {
   const ns = (info.namespaces[0] || {}).namespace;
   try {
+    // the route returns a bare array of {timestamp, value} points;
+    // querying runningpods — a metric the default StoreMetricsService
+    // actually provides (a cloud impl returns a real time series)
     const data = await api("GET",
-      "api/metrics/podcpu" + (ns ? `?namespace=${ns}` : ""));
-    const series = data.series || data.points || [];
-    el.append(h("div.kf-section", {},
-      h("h2", {}, "Pod CPU (15m)"),
-      h("code.kf-yaml", {}, JSON.stringify(series, null, 1))));
+      "api/metrics/runningpods" + (ns ? `?namespace=${ns}` : ""));
+    const points = Array.isArray(data)
+      ? data : (data.series || data.points || []);
+    const chart = metricChart(points, t("Running pods"));
+    if (chart) {
+      el.append(h("div.kf-section", {},
+        h("h2", {}, t("Running pods")), chart));
+    }
   } catch (e) {
     /* metrics service not configured: the reference hides the panel */
   }

@@ -9,7 +9,8 @@
 
 import {
   age, api, clear, conditionsTable, currentNamespace, detailsList,
-  duration, eventsTable, h, indexPage, Poller, Router, snack, t,
+  duration, eventsTable, h, indexPage, Poller, Router,
+  SERIES_BLUE, snack, sv, t,
   statusIcon, tabPanel, YamlEditor, yamlDump,
 } from "../lib/components.js";
 
@@ -164,19 +165,6 @@ function sparkline(reports) {
  * colors; icon/label pairing in the legend, never color alone) */
 const TRIAL_COLOR = { Succeeded: "#0ca30c", EarlyStopped: "#fab219",
                       Failed: "#d03b3b" };
-const SERIES_BLUE = "#2a78d6";   /* best-so-far line (categorical #1) */
-
-function sv(name, attrs, ...children) {
-  const el = document.createElementNS("http://www.w3.org/2000/svg",
-    name);
-  for (const [k, v] of Object.entries(attrs || {})) {
-    el.setAttribute(k, String(v));
-  }
-  for (const c of children.flat()) {
-    if (c != null) el.append(c);
-  }
-  return el;
-}
 
 export function trialChart(trials, maximize, objectiveName) {
   /* live per-trial objective chart: one dot per completed trial

@@ -276,6 +276,23 @@ export function panel(title, body, { open = true } = {}) {
     h("summary", {}, title), h("div.kf-panel-body", {}, body));
 }
 
+/* SVG element helper + series-1 of the validated categorical palette
+ * (dataviz reference instance) — shared by the studies and dashboard
+ * charts */
+export const SERIES_BLUE = "#2a78d6";
+
+export function sv(name, attrs, ...children) {
+  const el = document.createElementNS("http://www.w3.org/2000/svg",
+    name);
+  for (const [k, v] of Object.entries(attrs || {})) {
+    el.setAttribute(k, String(v));
+  }
+  for (const c of children.flat()) {
+    if (c != null) el.append(c);
+  }
+  return el;
+}
+
 export function loadingSpinner(label) {
   return h("div.kf-spinner", {}, h("span.kf-spinner-dot"),
     label || t("loading…"));

@@ -199,6 +199,7 @@ const FR = {
   "Recent activity in {ns}": "Activité récente dans {ns}",
   "no recent events": "aucun événement récent",
   "PodDefaults": "PodDefaults",
+  "Running pods": "Pods en cours d'exécution",
   "← dashboard": "← tableau de bord",
   "+ New PodDefault": "+ Nouveau PodDefault",
   "no poddefaults in {ns}": "aucun PodDefault dans {ns}",

@@ -821,6 +821,23 @@ class TestServingCanary:
         server.rollback_canary("m")
         assert server.resident_bytes() == before
 
+    def test_over_budget_canary_refused_stable_protected(self):
+        """A canary preload must not evict the stable it shadows (the
+        stable keeps serving the 1-weight traffic and would thrash):
+        with budget for one copy the canary is refused, nothing is
+        published, the stable stays loaded."""
+        from kubeflow_tpu.compute import serving as sv
+        p1 = self._params(1)
+        server = sv.ModelServer(
+            budget_bytes=int(sv.tree_bytes(p1) * 1.2))
+        server.register_loadable("m", self._fn(), p1, version=1,
+                                 preload=True)
+        with pytest.raises(sv.CapacityBusyError):
+            server.register_canary("m", self._fn(), self._params(2),
+                                   version=2, weight=0.5)
+        assert "m" not in server._canaries
+        assert server.models()["m"].loaded
+
     def test_canary_without_stable_rejected(self):
         from kubeflow_tpu.compute import serving as sv
         server = sv.ModelServer()

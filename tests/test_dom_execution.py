@@ -367,6 +367,50 @@ class TestDashboardApp:
         page.click(".kf-toolbar button.ghost")
         assert page.location["hash"] == "#/"
 
+    def test_metrics_panel_stat_tile_for_single_point(self, platform):
+        """The default StoreMetricsService returns one point — not a
+        chart, a stat tile (dataviz: a single number is a hero
+        number). Also pins the payload fix: the route returns a BARE
+        array, which the old panel misread as empty."""
+        store, manager = platform
+        store.create({"apiVersion": "v1", "kind": "Pod",
+                      "metadata": {"name": "p1", "namespace": "team-a"},
+                      "spec": {"containers": []}})
+        manager.run_sync()
+        page = self._page(store)
+        tile = page.query("#metric-stat")
+        assert tile is not None
+        # uses the existing dashboard-card classes (.kf-stat .n)
+        assert page.text(tile._query_all(".n")[0]) == "1"
+
+    def test_metrics_panel_line_chart_for_series(self, platform):
+        """A metrics service returning a real time series renders the
+        line chart: 2px series-1 line, point tooltips, last-value
+        direct label, table view behind <details>."""
+        store, _ = platform
+
+        class SeriesMetrics:
+            def available(self):
+                return True
+
+            def query(self, metric, namespace=None, interval="15m"):
+                return [{"timestamp": f"2026-07-31T00:0{i}:00Z",
+                         "value": v}
+                        for i, v in enumerate([2, 5, 3, 7])]
+
+        page = Page(dashboard.create_app(
+            store, metrics_service=SeriesMetrics()))
+        page.load_app("dashboard.js")
+        chart = page.query("#metric-chart")
+        assert chart is not None
+        svg = chart._query_all("svg")[0]
+        path = svg._query_all("path")[0]
+        assert path._attrs["stroke"] == "#2a78d6"   # series-1 slot
+        assert len(svg._query_all("circle")) == 4   # one hit per point
+        assert "7" in page.text(chart)              # last-value label
+        rows = chart._query_all("details table tr")
+        assert len(rows) == 4                       # table view exists
+
     def test_activity_feed_polls_events(self, platform):
         store, _ = platform
         store.create({"apiVersion": "v1", "kind": "Event",
