@@ -564,11 +564,16 @@ class ModelServer:
         """Make ``model`` device-resident under the byte budget,
         evicting LRU managed models as needed, and return the pinned
         device tree. ``protect`` marks one model as unevictable for
-        this load (a canary preload must not evict the stable it
-        shadows — the stable keeps serving the 1-weight traffic and
-        would thrash). Serialized: concurrent loads would both pass
+        this load; when loading a CANARY (preload OR a lazy reload
+        after eviction) the stable it shadows is protected
+        automatically — the stable keeps serving the 1-weight traffic
+        and would thrash. Serialized: concurrent loads would both pass
         the budget check and overshoot."""
         with self._residency_lock:
+            if protect is None:
+                entry = self._canaries.get(model.name)
+                if entry is not None and entry["model"] is model:
+                    protect = self._models.get(model.name)
             if model.loaded:
                 return model._dev_params
             budget = self.budget_bytes
@@ -713,10 +718,11 @@ class ModelServer:
                 if parts == ["v1", "models"]:
                     # registry listing with residency state — what an
                     # operator needs to see the byte budget working.
-                    # Snapshot under the lock: a canary deploy on
-                    # another thread must not resize the dicts mid-
+                    # Snapshot BOTH dicts under the lock: a deploy on
+                    # another thread must not resize them mid-
                     # iteration.
                     with server._residency_lock:
+                        model_items = list(models.values())
                         canary_items = list(server._canaries.items())
                     return self._send(200, {
                         "budget_bytes": server.budget_bytes,
@@ -730,7 +736,7 @@ class ModelServer:
                             "state": "RESIDENT" if m.loaded
                             else "EVICTED",
                             **self._residency(m),
-                        } for m in models.values()] + [{
+                        } for m in model_items] + [{
                             "name": f"{name}@canary",
                             "version": str(c["model"].version),
                             "weight": c["weight"],

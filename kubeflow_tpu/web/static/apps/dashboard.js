@@ -140,12 +140,13 @@ function launcher() {
   return h("div.kf-section", {},
     h("h2", {}, t("Applications")),
     h("div.kf-quick", {}, APPS.map((a) => h("div", {},
-      h("a", { href: `#/app/${a.id}` }, `${a.label} — ${a.desc}`),
+      h("a", { href: `#/app/${a.id}` }, `${a.label} — ${t(a.desc)}`),
       " ",
-      h("a", { href: a.href, target: "_blank", title: "open standalone" },
+      h("a", { href: a.href, target: "_blank",
+        title: t("open standalone") },
         "↗"))),
       h("div", {}, h("a", { href: "#/poddefaults" },
-        "PodDefaults — author admission-plane configurations"))));
+        t("PodDefaults — author admission-plane configurations")))));
 }
 
 function iframeView(el, params) {
@@ -154,7 +155,8 @@ function iframeView(el, params) {
    * origin under their path prefixes */
   const app = APPS.find((a) => a.id === params.app);
   if (!app) {
-    el.append(h("p", {}, `unknown app ${params.app}`));
+    el.append(h("p", {},
+      t("unknown app {app}", { app: params.app })));
     return;
   }
   el.append(
@@ -234,9 +236,14 @@ export function metricChart(points, label) {
       fill: "transparent" },
     sv("title", {}, `${hhmm(p.timestamp)} · ${p.value}`))));
   const last = points[points.length - 1];
+  // end-anchor when near the right edge so the label never clips
+  // outside the viewBox (SVG overflow is hidden)
+  const lx = X(points.length - 1) + 6;
+  const clip = lx > W - 44;
   const lastLabel = sv("text", {
-    x: Math.min(X(points.length - 1) + 6, W - 4),
-    y: Y(last.value) - 6, class: "kf-chart-label kf-chart-best" },
+    x: clip ? W - 4 : lx, y: Y(last.value) - 6,
+    "text-anchor": clip ? "end" : "start",
+    class: "kf-chart-label kf-chart-best" },
   String(last.value));
   return h("div.kf-chart", { id: "metric-chart" },
     sv("svg", { viewBox: `0 0 ${W} ${H}`, role: "img",

@@ -200,6 +200,15 @@ const FR = {
   "no recent events": "aucun événement récent",
   "PodDefaults": "PodDefaults",
   "Running pods": "Pods en cours d'exécution",
+  "spawn TPU notebooks": "lancer des notebooks TPU",
+  "manage PVCs": "gérer les PVC",
+  "profiles + training curves": "profils + courbes d'entraînement",
+  "HPO sweeps (StudyJob)": "balayages HPO (StudyJob)",
+  "multi-host training gangs": "gangs d'entraînement multi-hôtes",
+  "open standalone": "ouvrir en autonome",
+  "PodDefaults — author admission-plane configurations":
+    "PodDefaults — éditer les configurations du plan d'admission",
+  "unknown app {app}": "application inconnue {app}",
   "← dashboard": "← tableau de bord",
   "+ New PodDefault": "+ Nouveau PodDefault",
   "no poddefaults in {ns}": "aucun PodDefault dans {ns}",
