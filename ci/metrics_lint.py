@@ -1,0 +1,46 @@
+"""CI lint: every registered metric family must have a Prometheus-legal
+name (``^[a-z_][a-z0-9_]*$``) and non-empty help text.
+
+Registration already enforces this (obs/metrics.py raises), so the lint
+mostly guards two drift paths: a family added to a registry assembled
+by hand (bypassing Registry._register) and a future relaxation of the
+registration check. Importing every instrumented layer below populates
+the process-global registry with the real production families — what a
+scrape of any ``/metrics`` endpoint would serve.
+
+    python -m ci.metrics_lint
+"""
+
+import os
+import sys
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    # import side effects register each layer's module-level families
+    import kubeflow_tpu.compute.serving   # noqa: F401
+    import kubeflow_tpu.core.manager      # noqa: F401
+    import kubeflow_tpu.core.workqueue    # noqa: F401
+    import kubeflow_tpu.web.http          # noqa: F401
+    from kubeflow_tpu.controllers.metrics import NotebookMetrics
+    from kubeflow_tpu.obs import metrics as obs_metrics
+
+    # the notebook families live in caller-owned registries; lint them
+    # on a scratch one so the controller domain is covered too
+    scratch = obs_metrics.Registry()
+    NotebookMetrics(scratch)
+
+    problems = obs_metrics.REGISTRY.lint() + scratch.lint()
+    checked = len(obs_metrics.REGISTRY._metrics) + len(scratch._metrics)
+    if problems:
+        print("metrics lint FAILED:")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print(f"metrics lint OK: {checked} families checked")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,6 +67,21 @@ def build_workflow(component, repo_url="https://example.com/repo.git",
                            "volumeMounts": [{"name": "src",
                                              "mountPath": "/src"}]}})
         tasks.append(_task("unit-tests", "unit-tests", ["checkout"]))
+    # observability gate: every registered metric family must have a
+    # Prometheus-legal name + help text (ci/metrics_lint.py) — images
+    # don't build from a commit whose /metrics surface is malformed
+    templates.append(
+        {"name": "metrics-lint",
+         "container": {"image": PYTHON_IMAGE,
+                       "workingDir": "/src",
+                       "command": ["sh", "-c",
+                                   "pip install -q pyyaml optax flax "
+                                   "&& python -m ci.metrics_lint"],
+                       "env": [{"name": "JAX_PLATFORMS",
+                                "value": "cpu"}],
+                       "volumeMounts": [{"name": "src",
+                                         "mountPath": "/src"}]}})
+    tasks.append(_task("metrics-lint", "metrics-lint", ["checkout"]))
     kaniko_args = [f"--context=/src/{context}",
                    f"--destination=kubeflowtpu/{component}:$(TAG)"]
     if no_push:
@@ -77,7 +92,8 @@ def build_workflow(component, repo_url="https://example.com/repo.git",
                        "volumeMounts": [{"name": "src",
                                          "mountPath": "/src"}]}})
     tasks.append(_task("build-image", "build-image",
-                       ["unit-tests"] if test_cmd else ["checkout"]))
+                       (["unit-tests"] if test_cmd else ["checkout"])
+                       + ["metrics-lint"]))
 
     return {
         "apiVersion": "argoproj.io/v1alpha1",

@@ -457,6 +457,18 @@ def _dropless_moe(h, lp, config):
                      lp["we_down"].astype(dt), hf.astype(dt),
                      flat_idx, flat_gate.astype(dt))
     elif use_gmm and _mesh_trivial():
+        if jax.default_backend() == "tpu" and not _gmm_shapes_ok():
+            # only the branch that actually invokes the Pallas kernel
+            # enforces the tiling (a forced-True config on a sharded
+            # mesh legitimately falls through to the ragged paths
+            # below); without this the constraint surfaces as a deep
+            # Mosaic lane-tiling error (ADVICE r5). CPU interpret mode
+            # has no lane tiling, so tiny-dim CPU tests stay legal.
+            raise ValueError(
+                f"moe_gmm=True needs d_model and ff_dim to be "
+                f"multiples of 128 (Mosaic lane tiles are 128 wide), "
+                f"got d_model={d}, ff_dim={config.ff_dim}; use "
+                f"moe_gmm='auto' to fall back to ragged_dot")
         # even forced-True yields to a sharded mesh: the kernel cannot
         # run under auto-SPMD, so EP/dp/tp meshes take the ragged path
         out = gmm_inline(lp["we_gate"].astype(dt),

@@ -47,18 +47,40 @@ def _gmm_kernel(be_ref, x_ref, w_ref, o_ref):
         preferred_element_type=jnp.float32).astype(o_ref.dtype)
 
 
+def _fit_or_raise(kernel, n, block_m, bn, need_fn, budget):
+    """Budget-check the small-n fallback divisor: returning a tile set
+    that exceeds VMEM would fail later inside Mosaic with an opaque
+    allocation error (ADVICE r5). Raise here, naming the knob — the
+    caller owns block_m (it is baked into the group layout), so this
+    function cannot shrink it silently. ``need_fn(bm)`` gives the tile
+    bytes at this bn for a candidate block_m."""
+    need = need_fn(block_m)
+    if need <= budget:
+        return bn
+    fit_bm = next((bm for bm in (256, 128, 64, 32, 16, 8)
+                   if bm < block_m and need_fn(bm) <= budget), None)
+    hint = (f"; block_m={fit_bm} would fit" if fit_bm
+            else "; no block_m fits — shrink n or k")
+    raise ValueError(
+        f"{kernel}: tiles for n={n}, block_m={block_m} need {need} "
+        f"bytes of VMEM (budget {budget}){hint}")
+
+
 def _wide_n(n, k, block_m, itemsize=2, budget=11 << 20):
     """Widest divisor of n whose double-buffered tiles fit VMEM:
     w (1,k,bn) + x (bm,k) + out (bm,bn), all ×2 for pipelining. A wide
     n block minimizes x refetch traffic (x streams once per n tile)."""
+    def need(bn, bm):
+        return 2 * itemsize * (k * bn + bm * k + bm * bn)
     # lane-dim blocks must be multiples of 128 (Mosaic tiling)
     for bn in (4096, 2816, 2048, 1408, 1024, 512, 256, 128):
         if bn > n or n % bn:
             continue
-        need = 2 * itemsize * (k * bn + block_m * k + block_m * bn)
-        if need <= budget:
+        if need(bn, block_m) <= budget:
             return bn
-    return _pick_block(n)
+    bn = _pick_block(n)
+    return _fit_or_raise("gmm", n, block_m, bn,
+                         lambda bm: need(bn, bm), budget)
 
 
 def _gmm_raw(x, w, block_expert, block_m):
@@ -112,15 +134,18 @@ def _tgmm_wide_n(n, k, block_m, itemsize=2, budget=11 << 20):
     """Widest divisor of n fitting VMEM for the dw pass: fp32 acc
     (k, bn) + fp32 out (k, bn) + x (bm, k) + dy (bm, bn), in/out ×2
     for pipelining."""
+    def need(bn, bm):
+        return (4 * k * bn                       # acc
+                + 2 * 4 * k * bn                 # out (double-buffered)
+                + 2 * itemsize * bm * (k + bn))
     for bn in (4096, 2816, 2048, 1408, 1024, 512, 256, 128):
         if bn > n or n % bn:
             continue
-        need = (4 * k * bn                       # acc
-                + 2 * 4 * k * bn                 # out (double-buffered)
-                + 2 * itemsize * block_m * (k + bn))
-        if need <= budget:
+        if need(bn, block_m) <= budget:
             return bn
-    return _pick_block(n)
+    bn = _pick_block(n)
+    return _fit_or_raise("tgmm", n, block_m, bn,
+                         lambda bm: need(bn, bm), budget)
 
 
 def _tgmm(x, dy, block_expert, first, last, n_experts, block_m):

@@ -22,15 +22,48 @@ substrate:
 
 import base64
 import json
+import logging
 import queue
 import random
 import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
 import jax
 import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..obs import tracing
+
+log = logging.getLogger("kubeflow_tpu.serving")
+
+# serving-side latency/throughput families, labeled stable-vs-canary so
+# a canary regression separates from the stable baseline on the same
+# chart (the dashboard's metrics panel reads these)
+_REQUEST_SECONDS = obs_metrics.REGISTRY.histogram(
+    "serving_request_duration_seconds",
+    "End-to-end predict latency (batching wait + device time)",
+    ("model", "track"))
+_QUEUE_WAIT_SECONDS = obs_metrics.REGISTRY.histogram(
+    "serving_batch_queue_wait_seconds",
+    "Time a predict request waited in the dynamic batcher before its "
+    "device batch launched",
+    ("model", "track"),
+    buckets=(1e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 0.01, 0.025, 0.05, 0.1,
+             0.5, 1.0))
+_BATCH_ROWS = obs_metrics.REGISTRY.histogram(
+    "serving_batch_size_rows",
+    "Rows per device dispatch after dynamic-batch coalescing "
+    "(pre-padding)",
+    ("model", "track"),
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+_DRAIN_TIMEOUT_TOTAL = obs_metrics.REGISTRY.counter(
+    "serving_drain_timeout_total",
+    "Retired model batchers whose drain did not finish within the "
+    "join window (unload skipped, copy left resident)",
+    ("model",))
 
 #: dtypes accepted on the binary tensor path (little-endian raw bytes)
 TENSOR_DTYPES = {"float32", "float16", "int32", "int8", "uint8"}
@@ -45,10 +78,12 @@ class _Batcher:
     grouped by item shape; the window closes at ``max_batch`` rows or
     ``timeout_s`` after the first request, whichever first."""
 
-    def __init__(self, run_fn, max_batch=64, timeout_s=0.005):
+    def __init__(self, run_fn, max_batch=64, timeout_s=0.005,
+                 owner=None):
         self.run = run_fn             # (ndarray) -> ndarray
         self.max_batch = max_batch
         self.timeout_s = timeout_s
+        self.owner = owner            # ServedModel, for metric labels
         self.q = queue.Queue()
         self._stop = False
         self._accepting = True
@@ -57,11 +92,22 @@ class _Batcher:
         self.thread.start()
 
     def submit(self, x):
-        """Blocking: returns (result_rows, device_ms_of_the_batch)."""
+        """Blocking: returns (result_rows, device_ms_of_the_batch).
+
+        TOCTOU note: the ``_accepting``/``is_alive`` check below and
+        the ``q.put`` are not atomic — ``stop()`` can flip
+        ``_accepting`` (or the loop thread can exit) between them, so
+        a slot may land in the queue after the check passed. That is
+        safe, not racy-by-accident: the loop's ``finally`` runs
+        ``_drain()``, which errors out every queued slot, and the
+        wait below re-checks thread liveness — so a late submit either
+        completes (graceful stop still flushes the FIFO) or raises
+        "batcher stopped"; it never hangs. The up-front check is only
+        a fast-fail courtesy, not the correctness boundary."""
         if not self._accepting or not self.thread.is_alive():
             raise RuntimeError("batcher stopped")
         done = threading.Event()
-        slot = {"x": x, "done": done}
+        slot = {"x": x, "done": done, "t": time.perf_counter()}
         self.q.put(slot)
         # never block forever: if the loop thread dies between the
         # liveness check above and the put, nothing will drain the slot
@@ -139,6 +185,13 @@ class _Batcher:
 
     def _run_group(self, group):
         try:
+            if self.owner is not None:
+                now = time.perf_counter()
+                wait = _QUEUE_WAIT_SECONDS.labels(self.owner.name,
+                                                  self.owner.track)
+                for g in group:
+                    if "t" in g:
+                        wait.observe(now - g["t"])
             x = np.concatenate([g["x"] for g in group], axis=0) \
                 if len(group) > 1 else group[0]["x"]
             t0 = time.perf_counter()
@@ -200,6 +253,7 @@ class ServedModel:
                  host_params=None):
         self.name = name
         self.version = version
+        self.track = "stable"   # "canary" while shadowing a stable
         self.device_calls = 0
         self.loads = 0
         self.evictions = 0
@@ -219,7 +273,8 @@ class ServedModel:
         self._ensure = None            # server residency hook
         self._batcher = _Batcher(
             self._run, max_batch=max_batch,
-            timeout_s=batch_timeout_ms / 1000.0) if batching else None
+            timeout_s=batch_timeout_ms / 1000.0,
+            owner=self) if batching else None
 
     @property
     def loaded(self):
@@ -265,6 +320,9 @@ class ServedModel:
                 params = self._dev_params
         self.last_used = time.monotonic()
         n = x.shape[0]
+        # one observation per DEVICE call (batcher groups, stream
+        # groups, and solo predicts all funnel through here)
+        _BATCH_ROWS.labels(self.name, self.track).observe(n)
         bucket = next((b for b in BATCH_BUCKETS if b >= n), n)
         if bucket > n:
             pad = np.zeros((bucket - n,) + x.shape[1:], x.dtype)
@@ -290,11 +348,18 @@ class ServedModel:
         if x.ndim == 0:
             raise ValueError(
                 "instances must be a list of inputs, got a scalar")
-        if self._batcher is not None:
-            return self._batcher.submit(x)
         t0 = time.perf_counter()
-        out = self._run(x)
-        return out, 1000 * (time.perf_counter() - t0)
+        with tracing.span("serving.dispatch", model=self.name,
+                          track=self.track, version=self.version,
+                          rows=int(x.shape[0])):
+            if self._batcher is not None:
+                result = self._batcher.submit(x)
+            else:
+                out = self._run(x)
+                result = out, 1000 * (time.perf_counter() - t0)
+        _REQUEST_SECONDS.labels(self.name, self.track).observe(
+            time.perf_counter() - t0)
+        return result
 
     def predict_timed(self, instances):
         out, ms = self.predict_raw(instances)
@@ -456,6 +521,7 @@ class ModelServer:
             raise ValueError(f"weight must be in [0, 1], got {weight}")
         model = ServedModel(name, version=version, make_fn=make_fn,
                             host_params=params, **model_kwargs)
+        model.track = "canary"     # metric/trace attribution
         model._ensure = self._ensure_loaded
         with self._residency_lock:
             if preload:
@@ -490,6 +556,8 @@ class ModelServer:
         with self._residency_lock:
             entry = self._canaries.pop(name)
             model = entry["model"]
+            # promoted: new observations attribute to the stable series
+            model.track = "stable"
             old = self._models.get(name)
             self._models[name] = model
             if old is not None:
@@ -536,10 +604,28 @@ class ModelServer:
         transition, canary promote/replace/rollback): stop accepting,
         let the queued batched work finish — joining the batcher
         BEFORE the unload so a queued straggler never cold-reloads
-        the copy we are freeing — then drop the device bytes."""
+        the copy we are freeing — then drop the device bytes.
+
+        If the join times out (a wedged device call, a pathological
+        backlog), the unload is SKIPPED: the batcher thread may still
+        be running work that holds the device tree, and yanking it
+        would reintroduce the straggler-cold-reload race. The retired
+        copy stays budget-counted and evictable-but-resident — with a
+        stale ``last_used`` it is the first LRU victim once the
+        thread actually exits — and the timeout is logged + counted
+        (``serving_drain_timeout_total``) so operators see leaked
+        residency instead of silently over-budget HBM."""
         old.close(graceful=True)       # stop ACCEPTING, drain FIFO
         if old._batcher is not None:
             old._batcher.thread.join(timeout=30)
+            if old._batcher.thread.is_alive():
+                _DRAIN_TIMEOUT_TOTAL.labels(old.name).inc()
+                log.warning(
+                    "model %s v%s: batcher did not drain within 30s; "
+                    "skipping unload (copy stays evictable-but-"
+                    "resident until the thread exits)",
+                    old.name, old.version)
+                return
         if old._managed:
             with self._residency_lock:
                 old.unload()
@@ -661,11 +747,21 @@ class ModelServer:
                     return True
                 return False
 
-            def _send(self, code, payload, extra_headers=()):
-                body = json.dumps(payload).encode()
+            def _send(self, code, payload, extra_headers=(),
+                      content_type="application/json"):
+                body = payload if isinstance(payload, bytes) \
+                    else json.dumps(payload).encode()
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
+                sp = tracing.current_span()
+                if sp is not None:
+                    # responses stitch into the caller's W3C trace
+                    self.send_header("traceparent",
+                                     tracing.format_traceparent(sp))
+                    sp.attrs["code"] = code
+                    if code >= 500:
+                        sp.status = "error"
                 if code >= 400:
                     # error paths may not have drained the request body
                     # (e.g. 404 before the read) — reusing the
@@ -692,8 +788,25 @@ class ModelServer:
                 }
 
             def do_GET(self):
+                split = urlsplit(self.path)
+                query = {k: v[-1]
+                         for k, v in parse_qs(split.query).items()}
+                # the platform-wide observability surface rides the
+                # serving port too: scrape + trace without a sidecar
+                parts = split.path.strip("/").split("/")
+                if parts == ["metrics"]:
+                    return self._send(
+                        200,
+                        obs_metrics.REGISTRY.exposition().encode(),
+                        content_type=obs_metrics.TEXT_CONTENT_TYPE)
+                if parts == ["debug", "traces"]:
+                    tid = query.get("trace_id") or None
+                    if query.get("format") == "chrome":
+                        return self._send(
+                            200, tracing.TRACES.chrome_trace(tid))
+                    return self._send(
+                        200, {"traces": tracing.TRACES.traces(tid)})
                 # /v1/models/<name> → model version status
-                parts = self.path.strip("/").split("/")
                 if len(parts) == 3 and parts[:2] == ["v1", "models"]:
                     model = models.get(parts[2])
                     if model is None:
@@ -749,6 +862,17 @@ class ModelServer:
                 self._send(404, {"error": "not found"})
 
             def do_POST(self):
+                # server span: continues the caller's trace when the
+                # request carries a W3C traceparent (e.g. the web tier
+                # proxying a predict); serving.dispatch nests under it
+                with tracing.span(
+                        f"http POST {urlsplit(self.path).path}",
+                        traceparent=self.headers.get("traceparent"),
+                        app="model-server") as sp:
+                    self._handle_post()
+                    sp.attrs.setdefault("code", 200)  # stream path
+
+            def _handle_post(self):
                 parts = self.path.strip("/").split("/")
                 if (len(parts) != 3 or parts[:2] != ["v1", "models"]
                         or ":" not in parts[2]):
@@ -860,6 +984,10 @@ class ModelServer:
                 # canary attribution works on streams too
                 self.send_header("X-Served-Version",
                                  str(model.version))
+                sp = tracing.current_span()
+                if sp is not None:
+                    self.send_header("traceparent",
+                                     tracing.format_traceparent(sp))
                 self.end_headers()
 
                 # deadlock guard: half-duplex clients upload the whole

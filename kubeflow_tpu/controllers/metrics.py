@@ -1,99 +1,18 @@
-"""Prometheus-style metrics, dependency-free.
+"""Notebook-controller metric families.
 
-Counter/Gauge with label values and a text-format exposition, matching the
-metric families the reference exports (components/notebook-controller/pkg/
-metrics/metrics.go:27-56: notebook_create_total, notebook_create_failed_total,
-notebook_culling_total, last_notebook_culling_timestamp_seconds, and the
-scrape-time notebook_running gauge computed from live StatefulSets
-metrics.go:74-99).
+The Counter/Gauge/Registry machinery that used to live here is now
+kubeflow_tpu/obs/metrics.py (grown with Histogram support and a
+process-global default registry, shared by every layer); this module
+keeps the controller-domain families — the ones the reference exports
+from components/notebook-controller/pkg/metrics/metrics.go:27-56
+(notebook_create_total, notebook_create_failed_total,
+notebook_culling_total, last_notebook_culling_timestamp_seconds, and
+the scrape-time notebook_running gauge computed from live StatefulSets
+metrics.go:74-99) — and re-exports the classes for existing importers.
 """
 
-import threading
-
-
-class _Metric:
-    def __init__(self, name, help_text, label_names):
-        self.name = name
-        self.help = help_text
-        self.label_names = tuple(label_names)
-        self._values = {}
-        self._lock = threading.Lock()
-
-    def labels(self, *values):
-        if len(values) != len(self.label_names):
-            raise ValueError(f"{self.name}: expected labels "
-                             f"{self.label_names}, got {values}")
-        return _Child(self, tuple(str(v) for v in values))
-
-    def value(self, *values):
-        return self._values.get(tuple(str(v) for v in values), 0.0)
-
-    def samples(self):
-        with self._lock:
-            return dict(self._values)
-
-
-class _Child:
-    def __init__(self, metric, key):
-        self._m = metric
-        self._key = key
-
-    def inc(self, amount=1.0):
-        with self._m._lock:
-            self._m._values[self._key] = \
-                self._m._values.get(self._key, 0.0) + amount
-
-    def set(self, value):
-        with self._m._lock:
-            self._m._values[self._key] = float(value)
-
-
-class Counter(_Metric):
-    type_name = "counter"
-
-
-class Gauge(_Metric):
-    type_name = "gauge"
-
-
-class Registry:
-    def __init__(self):
-        self._metrics = []
-        self._collect_hooks = []
-
-    def counter(self, name, help_text, label_names=()):
-        c = Counter(name, help_text, label_names)
-        self._metrics.append(c)
-        return c
-
-    def gauge(self, name, help_text, label_names=()):
-        g = Gauge(name, help_text, label_names)
-        self._metrics.append(g)
-        return g
-
-    def add_collect_hook(self, fn):
-        """fn() runs before exposition — used for scrape-time gauges like
-        notebook_running (reference metrics.go:74-99)."""
-        self._collect_hooks.append(fn)
-
-    def exposition(self):
-        for fn in self._collect_hooks:
-            fn()
-        lines = []
-        for metric in self._metrics:
-            lines.append(f"# HELP {metric.name} {metric.help}")
-            lines.append(f"# TYPE {metric.name} {metric.type_name}")
-            samples = metric.samples()
-            if not samples and not metric.label_names:
-                lines.append(f"{metric.name} 0")
-            for key, value in sorted(samples.items()):
-                if metric.label_names:
-                    labels = ",".join(
-                        f'{n}="{v}"' for n, v in zip(metric.label_names, key))
-                    lines.append(f"{metric.name}{{{labels}}} {value:g}")
-                else:
-                    lines.append(f"{metric.name} {value:g}")
-        return "\n".join(lines) + "\n"
+from ..obs.metrics import (Counter, Gauge, Histogram,  # noqa: F401
+                           Registry, default_registry)
 
 
 class NotebookMetrics:

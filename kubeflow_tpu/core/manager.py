@@ -19,11 +19,28 @@ import time
 from dataclasses import dataclass, field
 
 from . import meta as m
+from ..obs import metrics as obs_metrics
+from ..obs import tracing
 from .errors import ConflictError, NotFoundError
 from .store import DELETED
 from .workqueue import RateLimitingQueue
 
 log = logging.getLogger("kubeflow_tpu.core")
+
+# controller-runtime-compatible reconcile families (the names Grafana
+# dashboards for kubebuilder controllers already query)
+_RECONCILE_TOTAL = obs_metrics.REGISTRY.counter(
+    "controller_runtime_reconcile_total",
+    "Total number of reconciliations per controller",
+    ("controller", "result"))
+_RECONCILE_TIME = obs_metrics.REGISTRY.histogram(
+    "controller_runtime_reconcile_time_seconds",
+    "Length of time per reconciliation per controller",
+    ("controller",))
+_RECONCILE_ERRORS = obs_metrics.REGISTRY.counter(
+    "controller_runtime_reconcile_errors_total",
+    "Total number of reconciliation errors per controller",
+    ("controller",))
 
 
 @dataclass(frozen=True)
@@ -103,7 +120,7 @@ class _Controller:
     def __init__(self, reconciler, workers=1):
         self.reconciler = reconciler
         self.name = reconciler.name
-        self.queue = RateLimitingQueue()
+        self.queue = RateLimitingQueue(name=reconciler.name)
         self.sources = []
         self.workers = workers
         self.inflight = 0
@@ -116,33 +133,49 @@ class _Controller:
             self.queue.add(req)
 
     def process_one(self, req):
-        try:
-            result = self.reconciler.reconcile(req)
-        except ConflictError:
-            # stale cache write — requeue immediately; the standard
-            # optimistic-concurrency dance (SURVEY.md §5)
-            self.queue.add_rate_limited(req)
-            return
-        except NotFoundError:
-            self.queue.forget(req)
-            return
-        except Exception:
-            log.exception("[%s] reconcile %s failed", self.name, req)
-            self.queue.add_rate_limited(req)
-            return
-        # controller-runtime ordering: Requeue=true re-adds RATE-LIMITED
-        # without Forget, so successive voluntary requeues back off
-        # exponentially (a pod that can never fit its node settles at
-        # max_delay instead of busy-polling); forget only on clean
-        # completion or an explicit requeue_after tick.
-        if result is not None and result.requeue and not (
-                result.requeue_after and result.requeue_after > 0):
-            self.queue.add_rate_limited(req)
-            return
-        self.queue.forget(req)
-        if result is not None:
-            if result.requeue_after and result.requeue_after > 0:
-                self.queue.add_after(req, result.requeue_after)
+        start = time.perf_counter()
+        outcome = "success"
+        with tracing.span("reconcile", controller=self.name,
+                          request=repr(req)) as sp:
+            try:
+                result = self.reconciler.reconcile(req)
+            except ConflictError:
+                # stale cache write — requeue immediately; the standard
+                # optimistic-concurrency dance (SURVEY.md §5)
+                outcome = "requeue"
+                self.queue.add_rate_limited(req)
+            except NotFoundError:
+                # object vanished mid-flight: clean terminal state
+                self.queue.forget(req)
+            except Exception:
+                outcome = "error"
+                log.exception("[%s] reconcile %s failed", self.name, req)
+                self.queue.add_rate_limited(req)
+            else:
+                # controller-runtime ordering: Requeue=true re-adds
+                # RATE-LIMITED without Forget, so successive voluntary
+                # requeues back off exponentially (a pod that can never
+                # fit its node settles at max_delay instead of
+                # busy-polling); forget only on clean completion or an
+                # explicit requeue_after tick.
+                if result is not None and result.requeue and not (
+                        result.requeue_after and result.requeue_after > 0):
+                    outcome = "requeue"
+                    self.queue.add_rate_limited(req)
+                else:
+                    self.queue.forget(req)
+                    if result is not None:
+                        if result.requeue_after and result.requeue_after > 0:
+                            outcome = "requeue_after"
+                            self.queue.add_after(req, result.requeue_after)
+            sp.attrs["result"] = outcome
+            if outcome == "error":
+                sp.status = "error"
+        _RECONCILE_TOTAL.labels(self.name, outcome).inc()
+        if outcome == "error":
+            _RECONCILE_ERRORS.labels(self.name).inc()
+        _RECONCILE_TIME.labels(self.name).observe(
+            time.perf_counter() - start)
 
 
 class Manager:

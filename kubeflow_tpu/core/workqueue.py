@@ -10,9 +10,27 @@ import heapq
 import threading
 import time
 
+from ..obs import metrics as obs_metrics
+
+# client-go workqueue metric families (controller-runtime exports the
+# same names; see docs/observability.md)
+_DEPTH = obs_metrics.REGISTRY.gauge(
+    "workqueue_depth", "Current depth of the workqueue", ("name",))
+_ADDS = obs_metrics.REGISTRY.counter(
+    "workqueue_adds_total", "Total number of adds handled by the "
+    "workqueue", ("name",))
+_QUEUE_DURATION = obs_metrics.REGISTRY.histogram(
+    "workqueue_queue_duration_seconds",
+    "How long an item stays in the workqueue before being requested",
+    ("name",),
+    buckets=(1e-4, 1e-3, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0))
+_RETRIES = obs_metrics.REGISTRY.counter(
+    "workqueue_retries_total", "Total number of rate-limited retries "
+    "handled by the workqueue", ("name",))
+
 
 class RateLimitingQueue:
-    def __init__(self, base_delay=0.005, max_delay=16.0):
+    def __init__(self, base_delay=0.005, max_delay=16.0, name="default"):
         self._cond = threading.Condition()
         self._queue = []          # FIFO of ready items
         self._dirty = set()       # items waiting or needing reprocess
@@ -23,6 +41,14 @@ class RateLimitingQueue:
         self._base_delay = base_delay
         self._max_delay = max_delay
         self._shutdown = False
+        self.name = name
+        self._added_at = {}       # item -> monotonic enqueue time
+
+    def _note_enqueued(self, item):
+        # call with the lock held, right after item lands in _queue
+        self._added_at.setdefault(item, time.monotonic())
+        _ADDS.labels(self.name).inc()
+        _DEPTH.labels(self.name).set(len(self._queue))
 
     def add(self, item):
         with self._cond:
@@ -31,6 +57,7 @@ class RateLimitingQueue:
             self._dirty.add(item)
             if item not in self._processing:
                 self._queue.append(item)
+                self._note_enqueued(item)
                 self._cond.notify()
 
     def add_after(self, item, delay):
@@ -47,6 +74,7 @@ class RateLimitingQueue:
     def add_rate_limited(self, item):
         fails = self._failures.get(item, 0)
         self._failures[item] = fails + 1
+        _RETRIES.labels(self.name).inc()
         self.add_after(item, min(self._base_delay * (2 ** fails),
                                  self._max_delay))
 
@@ -61,6 +89,7 @@ class RateLimitingQueue:
                 self._dirty.add(item)
                 if item not in self._processing:
                     self._queue.append(item)
+                    self._note_enqueued(item)
 
     def get(self, block=True, timeout=None):
         """Pop the next ready item; returns None on shutdown/timeout."""
@@ -72,6 +101,11 @@ class RateLimitingQueue:
                     item = self._queue.pop(0)
                     self._dirty.discard(item)
                     self._processing.add(item)
+                    added = self._added_at.pop(item, None)
+                    if added is not None:
+                        _QUEUE_DURATION.labels(self.name).observe(
+                            time.monotonic() - added)
+                    _DEPTH.labels(self.name).set(len(self._queue))
                     return item
                 if self._shutdown or not block:
                     return None
@@ -90,6 +124,7 @@ class RateLimitingQueue:
             self._processing.discard(item)
             if item in self._dirty:
                 self._queue.append(item)
+                self._note_enqueued(item)
                 self._cond.notify()
 
     def empty(self):

@@ -1,0 +1,27 @@
+"""Platform observability: metrics + in-process tracing (stdlib-only).
+
+One process-global metric registry (``obs.metrics.REGISTRY``) and one
+span ring buffer (``obs.tracing.TRACES``) shared by every layer:
+
+- core/manager.py + core/workqueue.py publish the controller-runtime
+  families (reconcile totals/latency, workqueue depth/queue duration),
+- web/http.py times every request, speaks W3C ``traceparent``, and
+  serves ``/metrics`` + ``/debug/traces`` on every App,
+- compute/serving.py publishes predict latency / queue-wait /
+  batch-size histograms (stable vs canary) on the model server.
+
+See docs/observability.md for the family table and trace workflow.
+"""
+
+from .metrics import (DEFAULT_BUCKETS, REGISTRY, TEXT_CONTENT_TYPE,
+                      Counter, Gauge, Histogram, Registry,
+                      default_registry)
+from .tracing import (TRACES, Span, TraceBuffer, current_span,
+                      format_traceparent, parse_traceparent, span)
+
+__all__ = [
+    "DEFAULT_BUCKETS", "REGISTRY", "TEXT_CONTENT_TYPE", "Counter",
+    "Gauge", "Histogram", "Registry", "default_registry",
+    "TRACES", "Span", "TraceBuffer", "current_span",
+    "format_traceparent", "parse_traceparent", "span",
+]

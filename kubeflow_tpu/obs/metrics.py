@@ -1,0 +1,272 @@
+"""Prometheus-style metrics, dependency-free.
+
+Counter/Gauge/Histogram with label values and a text-format exposition.
+Grew out of the notebook-controller metric registry (reference
+components/notebook-controller/pkg/metrics/metrics.go:27-56); promoted
+here so every layer (core controllers, web apps, the model server)
+shares ONE process-global registry and one ``/metrics`` surface, the
+way controller-runtime binds every controller's families to a single
+prometheus.Registry behind one metrics endpoint.
+
+Histogram follows Prometheus bucket semantics exactly: cumulative
+``<name>_bucket{le="..."}`` series ending at ``le="+Inf"``, plus
+``<name>_sum`` and ``<name>_count`` — what a real Prometheus scrape of
+controller-runtime's ``*_seconds`` families looks like.
+
+Metric names are validated at registration (``^[a-z_][a-z0-9_]*$``,
+non-empty help) so the CI lint (ci/metrics_lint.py) can never find a
+family that was registered but unscrapeable.
+"""
+
+import re
+import threading
+
+_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
+_LABEL_RE = re.compile(r"^[a-z_][a-zA-Z0-9_]*$")
+
+#: Prometheus client default buckets — right-sized for request latency
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0)
+
+#: exposition Content-Type (Prometheus text format 0.0.4)
+TEXT_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _fmt_labels(names, values, extra=()):
+    pairs = [f'{n}="{v}"' for n, v in zip(names, values)]
+    pairs += [f'{n}="{v}"' for n, v in extra]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+class _Metric:
+    def __init__(self, name, help_text, label_names):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._values = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values):
+        if len(values) != len(self.label_names):
+            raise ValueError(f"{self.name}: expected labels "
+                             f"{self.label_names}, got {values}")
+        return self._child_cls(self, tuple(str(v) for v in values))
+
+    def value(self, *values):
+        return self._values.get(tuple(str(v) for v in values), 0.0)
+
+    def samples(self):
+        with self._lock:
+            return dict(self._values)
+
+    def expose(self, lines):
+        samples = self.samples()
+        if not samples and not self.label_names:
+            lines.append(f"{self.name} 0")
+        for key, value in sorted(samples.items()):
+            lines.append(f"{self.name}"
+                         f"{_fmt_labels(self.label_names, key)} {value:g}")
+
+
+class _Child:
+    def __init__(self, metric, key):
+        self._m = metric
+        self._key = key
+
+    def inc(self, amount=1.0):
+        with self._m._lock:
+            self._m._values[self._key] = \
+                self._m._values.get(self._key, 0.0) + amount
+
+    def set(self, value):
+        with self._m._lock:
+            self._m._values[self._key] = float(value)
+
+
+_Metric._child_cls = _Child
+
+
+class Counter(_Metric):
+    type_name = "counter"
+
+    def inc(self, amount=1.0):
+        self.labels().inc(amount)
+
+
+class Gauge(_Metric):
+    type_name = "gauge"
+
+    def set(self, value):
+        self.labels().set(value)
+
+
+class _HistogramChild:
+    def __init__(self, metric, key):
+        self._m = metric
+        self._key = key
+
+    def observe(self, value):
+        value = float(value)
+        m = self._m
+        with m._lock:
+            state = m._values.get(self._key)
+            if state is None:
+                state = m._values[self._key] = \
+                    {"buckets": [0] * len(m.buckets), "sum": 0.0,
+                     "count": 0}
+            for i, le in enumerate(m.buckets):
+                if value <= le:
+                    state["buckets"][i] += 1
+            state["sum"] += value
+            state["count"] += 1
+
+
+class Histogram(_Metric):
+    """Prometheus histogram: cumulative buckets + sum + count.
+
+    ``buckets`` are upper bounds; ``+Inf`` is implicit (it IS the
+    count). Observations are O(len(buckets)) under the metric lock —
+    fine for the ≤20-bucket families this platform registers.
+    """
+
+    type_name = "histogram"
+    _child_cls = _HistogramChild
+
+    def __init__(self, name, help_text, label_names,
+                 buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_text, label_names)
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError(f"{name}: histogram needs >= 1 bucket")
+        self.buckets = bounds
+
+    def observe(self, value):
+        self.labels().observe(value)
+
+    def samples(self):
+        # deep-copy per-key state: observe() mutates the inner dicts in
+        # place, and a scrape reading them outside the lock could see a
+        # torn (non-cumulative) histogram
+        with self._lock:
+            return {k: {"buckets": list(v["buckets"]), "sum": v["sum"],
+                        "count": v["count"]}
+                    for k, v in self._values.items()}
+
+    def value(self, *values):
+        """Observation count for the label set (0 if never observed)."""
+        state = self._values.get(tuple(str(v) for v in values))
+        return 0 if state is None else state["count"]
+
+    def expose(self, lines):
+        samples = self.samples()
+        if not samples and not self.label_names:
+            # an unobserved label-less histogram still exposes its
+            # (empty) buckets, like prometheus/client_python
+            samples = {(): {"buckets": [0] * len(self.buckets),
+                            "sum": 0.0, "count": 0}}
+        for key, state in sorted(samples.items()):
+            for le, n in zip(self.buckets, state["buckets"]):
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(self.label_names, key, [('le', f'{le:g}')])}"
+                    f" {n}")
+            lines.append(
+                f"{self.name}_bucket"
+                f"{_fmt_labels(self.label_names, key, [('le', '+Inf')])}"
+                f" {state['count']}")
+            labels = _fmt_labels(self.label_names, key)
+            lines.append(f"{self.name}_sum{labels} {state['sum']:g}")
+            lines.append(f"{self.name}_count{labels} {state['count']}")
+
+
+class Registry:
+    def __init__(self):
+        self._metrics = []
+        self._by_name = {}
+        self._collect_hooks = []
+        self._lock = threading.Lock()
+
+    def _register(self, cls, name, help_text, label_names, **kwargs):
+        if not _NAME_RE.match(name or ""):
+            raise ValueError(
+                f"metric name {name!r} must match {_NAME_RE.pattern}")
+        if not (help_text or "").strip():
+            raise ValueError(f"metric {name} needs non-empty help text")
+        for ln in label_names:
+            if not _LABEL_RE.match(ln or ""):
+                raise ValueError(
+                    f"{name}: label name {ln!r} must match "
+                    f"{_LABEL_RE.pattern}")
+        with self._lock:
+            existing = self._by_name.get(name)
+            if existing is not None:
+                # idempotent re-registration (same shape) returns the
+                # live family — module-level families stay singletons
+                # even if an entrypoint imports twice
+                same_shape = (type(existing) is cls
+                              and existing.label_names
+                              == tuple(label_names))
+                if same_shape and cls is Histogram:
+                    same_shape = existing.buckets == tuple(
+                        sorted(float(b)
+                               for b in kwargs.get("buckets",
+                                                   DEFAULT_BUCKETS)))
+                if same_shape:
+                    return existing
+                raise ValueError(
+                    f"metric {name} already registered as "
+                    f"{type(existing).__name__}{existing.label_names}")
+            metric = cls(name, help_text, label_names, **kwargs)
+            self._metrics.append(metric)
+            self._by_name[name] = metric
+            return metric
+
+    def counter(self, name, help_text, label_names=()):
+        return self._register(Counter, name, help_text, label_names)
+
+    def gauge(self, name, help_text, label_names=()):
+        return self._register(Gauge, name, help_text, label_names)
+
+    def histogram(self, name, help_text, label_names=(),
+                  buckets=DEFAULT_BUCKETS):
+        return self._register(Histogram, name, help_text, label_names,
+                              buckets=buckets)
+
+    def add_collect_hook(self, fn):
+        """fn() runs before exposition — used for scrape-time gauges like
+        notebook_running (reference metrics.go:74-99)."""
+        self._collect_hooks.append(fn)
+
+    def lint(self):
+        """Return a list of problems (CI gate; registration already
+        validates, so this also covers registries assembled by hand)."""
+        problems = []
+        for metric in self._metrics:
+            if not _NAME_RE.match(metric.name or ""):
+                problems.append(
+                    f"{metric.name!r}: name must match {_NAME_RE.pattern}")
+            if not (metric.help or "").strip():
+                problems.append(f"{metric.name}: missing help text")
+            for ln in metric.label_names:
+                if not _LABEL_RE.match(ln or ""):
+                    problems.append(f"{metric.name}: bad label {ln!r}")
+        return problems
+
+    def exposition(self):
+        for fn in self._collect_hooks:
+            fn()
+        lines = []
+        for metric in self._metrics:
+            lines.append(f"# HELP {metric.name} {metric.help}")
+            lines.append(f"# TYPE {metric.name} {metric.type_name}")
+            metric.expose(lines)
+        return "\n".join(lines) + "\n"
+
+
+#: the process-global default registry every layer registers into;
+#: ``/metrics`` on any web App or the ModelServer serves THIS
+REGISTRY = Registry()
+
+
+def default_registry():
+    return REGISTRY

@@ -1,0 +1,189 @@
+"""In-process span tracing, dependency-free.
+
+The paper's platform delegates request tracing to whatever the mesh and
+Tensorboard's profile plugin provide; this module gives the
+reproduction its own end-to-end story: one request is followed from the
+web tier through a reconcile to a serving dispatch with nothing but a
+contextvar and a ring buffer.
+
+- ``span(name, **attrs)``: context manager. Parent/child linkage rides
+  a contextvar, so nesting works across any call depth in one thread
+  (and across ``contextvars.copy_context()`` if a caller propagates
+  deliberately).
+- W3C trace context: ``parse_traceparent`` / ``format_traceparent``
+  implement the ``00-<trace-id>-<parent-id>-<flags>`` header; the web
+  middleware extracts it on ingress and injects it on responses, so an
+  external client (or an upstream mesh proxy) stitches our spans into
+  its own trace.
+- ``TraceBuffer``: bounded ring buffer of COMPLETED spans. ``traces()``
+  groups by trace id for the ``/debug/traces`` JSON view;
+  ``chrome_trace()`` emits Chrome trace-event format, openable in
+  Perfetto — complementing compute/profiler.py's XLA traces (device
+  timeline there, platform timeline here).
+
+Spans are cheap (one dict append on exit) and always-on; sampling can
+be layered later by swapping the buffer.
+"""
+
+import contextvars
+import os
+import re
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+_CURRENT = contextvars.ContextVar("kubeflow_tpu_obs_span", default=None)
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+def parse_traceparent(header):
+    """W3C traceparent → (trace_id, parent_span_id) or None.
+
+    Rejects malformed headers, the forbidden version ``ff``, and
+    all-zero ids (the spec's "invalid" sentinels) — a bad header means
+    "start a fresh trace", never an exception on the request path."""
+    if not header:
+        return None
+    mo = _TRACEPARENT_RE.match(header.strip().lower())
+    if mo is None:
+        return None
+    version, trace_id, span_id, _flags = mo.groups()
+    if version == "ff" or set(trace_id) == {"0"} or set(span_id) == {"0"}:
+        return None
+    return trace_id, span_id
+
+
+def format_traceparent(span):
+    return f"00-{span.trace_id}-{span.span_id}-01"
+
+
+class Span:
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start",
+                 "end", "attrs", "status", "thread")
+
+    def __init__(self, name, trace_id, parent_id, attrs):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = os.urandom(8).hex()
+        self.parent_id = parent_id
+        self.start = time.time()
+        self.end = None
+        self.attrs = attrs
+        self.status = "ok"
+        self.thread = threading.current_thread().name
+
+    @property
+    def duration(self):
+        return ((self.end if self.end is not None else time.time())
+                - self.start)
+
+    def to_dict(self):
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start": self.start,
+            "duration_ms": round(self.duration * 1000, 3),
+            "status": self.status,
+            "thread": self.thread,
+            "attrs": {k: v for k, v in self.attrs.items()},
+        }
+
+
+class TraceBuffer:
+    """Bounded ring buffer of completed spans (oldest evicted first)."""
+
+    def __init__(self, capacity=4096):
+        self._spans = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def add(self, span):
+        with self._lock:
+            self._spans.append(span)
+
+    def clear(self):
+        with self._lock:
+            self._spans.clear()
+
+    def spans(self, trace_id=None):
+        with self._lock:
+            snapshot = list(self._spans)
+        if trace_id is not None:
+            snapshot = [s for s in snapshot if s.trace_id == trace_id]
+        return snapshot
+
+    def traces(self, trace_id=None, limit=50):
+        """Group completed spans by trace id, most recently finished
+        trace first, spans within a trace in start order."""
+        groups = {}
+        for s in self.spans(trace_id):
+            groups.setdefault(s.trace_id, []).append(s)
+        out = []
+        for tid, spans in groups.items():
+            spans.sort(key=lambda s: s.start)
+            out.append({"trace_id": tid,
+                        "spans": [s.to_dict() for s in spans]})
+        # recency = latest end time in the trace (duration is in ms)
+        out.sort(key=lambda t: max(sp["start"] + sp["duration_ms"] / 1000
+                                   for sp in t["spans"]), reverse=True)
+        return out[:limit]
+
+    def chrome_trace(self, trace_id=None):
+        """Chrome trace-event JSON (load in Perfetto / chrome://tracing):
+        one complete ('X') event per span, microsecond timestamps."""
+        events = []
+        for s in self.spans(trace_id):
+            events.append({
+                "name": s.name,
+                "cat": s.trace_id,
+                "ph": "X",
+                "ts": s.start * 1e6,
+                "dur": s.duration * 1e6,
+                "pid": os.getpid(),
+                "tid": s.thread,
+                "args": {**s.attrs, "span_id": s.span_id,
+                         "parent_id": s.parent_id,
+                         "status": s.status},
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+#: the process-global buffer ``/debug/traces`` serves
+TRACES = TraceBuffer()
+
+
+def current_span():
+    return _CURRENT.get()
+
+
+@contextmanager
+def span(name, traceparent=None, buffer=None, **attrs):
+    """Open a span. An in-process parent (contextvar) wins; otherwise a
+    valid ``traceparent`` header continues the remote trace; otherwise
+    a fresh trace starts. The completed span lands in ``buffer``
+    (default: the global ring)."""
+    parent = _CURRENT.get()
+    if parent is not None:
+        trace_id, parent_id = parent.trace_id, parent.span_id
+    else:
+        remote = parse_traceparent(traceparent)
+        if remote is not None:
+            trace_id, parent_id = remote
+        else:
+            trace_id, parent_id = os.urandom(16).hex(), None
+    s = Span(name, trace_id, parent_id, dict(attrs))
+    token = _CURRENT.set(s)
+    try:
+        yield s
+    except BaseException as e:
+        s.status = "error"
+        s.attrs.setdefault("error", f"{type(e).__name__}: {e}")
+        raise
+    finally:
+        s.end = time.time()
+        _CURRENT.reset(token)
+        (TRACES if buffer is None else buffer).add(s)

@@ -10,11 +10,25 @@ the mesh exactly like the reference fronts gunicorn.
 import json
 import re
 import threading
+import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
 from ..core.errors import ApiError
+from ..obs import metrics as obs_metrics
+from ..obs import tracing
+
+# one family across every App; the app label separates backends the
+# way the reference separates scrape jobs
+_HTTP_REQUESTS = obs_metrics.REGISTRY.counter(
+    "http_requests_total",
+    "Total HTTP requests handled by the web tier",
+    ("app", "method", "code"))
+_HTTP_LATENCY = obs_metrics.REGISTRY.histogram(
+    "http_request_duration_seconds",
+    "HTTP request latency through App.handle (middleware included)",
+    ("app", "method", "code"))
 
 
 class HTTPError(Exception):
@@ -93,6 +107,37 @@ class App:
         self._routes = []  # (method, regex, fn)
         self._before = []
         self._after = []
+        self.registry = obs_metrics.REGISTRY
+        self.traces = tracing.TRACES
+        self._install_observability()
+
+    def _install_observability(self):
+        """Built-in ``/metrics`` + ``/debug/traces`` on every App.
+        Both bypass before_request hooks (``_obs_internal``): a
+        Prometheus scraper or an engineer's browser carries neither the
+        mesh identity header nor a CSRF cookie — the reference serves
+        controller metrics on a separate unauthenticated port for the
+        same reason."""
+
+        def metrics_route(request):
+            return Response(self.registry.exposition(), headers={
+                "Content-Type": obs_metrics.TEXT_CONTENT_TYPE})
+
+        def traces_route(request):
+            trace_id = request.query.get("trace_id") or None
+            if request.query.get("format") == "chrome":
+                # save-as file → open in Perfetto / chrome://tracing
+                return Response(self.traces.chrome_trace(trace_id))
+            try:
+                limit = int(request.query.get("limit", 50))
+            except ValueError:
+                raise HTTPError(400, "limit must be an integer")
+            return {"traces": self.traces.traces(trace_id, limit=limit)}
+
+        metrics_route._obs_internal = True
+        traces_route._obs_internal = True
+        self.get("/metrics")(metrics_route)
+        self.get("/debug/traces")(traces_route)
 
     def route(self, method, pattern):
         compiled = _compile(pattern)
@@ -155,9 +200,38 @@ class App:
     # ------------------------------------------------------- dispatch
 
     def handle(self, request):
-        response = self._dispatch(request)
-        for hook in self._after:
-            response = hook(request, response) or response
+        """Middleware shell around dispatch: opens the server span
+        (continuing the caller's W3C ``traceparent`` if one arrived),
+        times the request into the HTTP histogram family, and injects
+        ``traceparent`` into the response so downstream hops / clients
+        can stitch the trace."""
+        if request.path.rstrip("/") in ("/metrics", "/debug/traces"):
+            # self-inspection traffic is neither traced nor counted: a
+            # 15s scrape interval would otherwise fill the span ring
+            # with scrape spans and evict the application traces the
+            # endpoint exists to show
+            response = self._dispatch(request)
+            for hook in self._after:
+                response = hook(request, response) or response
+            return response
+        start = time.perf_counter()
+        with tracing.span(
+                f"http {request.method} {request.path}",
+                traceparent=request.header("traceparent"),
+                app=self.name, method=request.method,
+                path=request.path) as sp:
+            response = self._dispatch(request)
+            for hook in self._after:
+                response = hook(request, response) or response
+            sp.attrs["code"] = response.status
+            if response.status >= 500:
+                sp.status = "error"
+            response.headers.setdefault(
+                "traceparent", tracing.format_traceparent(sp))
+        code = str(response.status)
+        _HTTP_REQUESTS.labels(self.name, request.method, code).inc()
+        _HTTP_LATENCY.labels(self.name, request.method, code).observe(
+            time.perf_counter() - start)
         return response
 
     def _dispatch(self, request):
@@ -178,10 +252,11 @@ class App:
                     f"{request.path} not found")
             fn, params = match
             request.params = params
-            for hook in self._before:
-                out = hook(request)
-                if isinstance(out, Response):
-                    return out
+            if not getattr(fn, "_obs_internal", False):
+                for hook in self._before:
+                    out = hook(request)
+                    if isinstance(out, Response):
+                        return out
             out = fn(request, **params)
             return out if isinstance(out, Response) else Response(out)
         except HTTPError as e:

@@ -18,7 +18,7 @@ from ..api import builtin, profile as papi
 from ..core import meta as m
 from ..core.errors import AlreadyExistsError, NotFoundError
 from . import crud_backend as cb
-from .http import App, HTTPError, Response
+from .http import App, HTTPError
 
 PROFILE_API = f"{papi.GROUP}/{papi.VERSION}"
 RBAC_API = "rbac.authorization.k8s.io/v1"
@@ -152,18 +152,16 @@ def create_app(store):
     app.store = store
     cb.install_security(app)
 
-    request_count = {"count": 0}
+    # kfam_requests_total now lives in the process-global registry and
+    # is served by the App's built-in /metrics (one unified surface)
+    # alongside the http_requests_total{app="kfam"} family
+    from ..obs import metrics as obs_metrics
+    requests_total = obs_metrics.REGISTRY.counter(
+        "kfam_requests_total", "Total requests to the kfam API")
 
     @app.before_request
     def count(request):
-        request_count["count"] += 1
-
-    @app.get("/metrics")
-    def metrics(request):
-        return Response(
-            "# TYPE kfam_requests_total counter\n"
-            f"kfam_requests_total {request_count['count']}\n",
-            headers={"Content-Type": "text/plain; version=0.0.4"})
+        requests_total.inc()
 
     @app.get("/kfam/v1/role/clusteradmin")
     def clusteradmin(request):

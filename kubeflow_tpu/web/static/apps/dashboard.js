@@ -223,7 +223,13 @@ export function metricChart(points, label) {
   const yLabels = ticks.map((v) => sv("text", {
     x: L - 6, y: Y(v) + 4, "text-anchor": "end",
     class: "kf-chart-label" }, Number(v).toPrecision(3)));
-  const hhmm = (ts) => String(ts).slice(11, 16);
+  // only ISO-shaped timestamps have a clock at chars 11-16; epoch
+  // numbers or locale strings fall back to the raw value (ADVICE r5)
+  const isoRe = /^\d{4}-\d{2}-\d{2}[T ]\d{2}:\d{2}/;
+  const hhmm = (ts) => {
+    const s = String(ts);
+    return isoRe.test(s) ? s.slice(11, 16) : s;
+  };
   const xLabels = [0, points.length - 1].map((i) => sv("text", {
     x: X(i), y: H - 8, "text-anchor": "middle",
     class: "kf-chart-label" }, hhmm(points[i].timestamp)));

@@ -1,0 +1,357 @@
+"""Observability layer (ISSUE 1): Histogram bucket/exposition
+semantics, W3C traceparent propagation through the App middleware,
+controller-runtime reconcile families via run_sync(), and the serving
+latency/batch-size families on the ModelServer.
+
+Process-global registry note: module-level families accumulate across
+tests, so assertions use unique label values (controller/model/app
+names) or fresh Registry instances — never absolute global totals.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.core import manager as manager_mod
+from kubeflow_tpu.core.manager import Reconciler, Result
+from kubeflow_tpu.obs import metrics as obsm
+from kubeflow_tpu.obs import tracing
+from kubeflow_tpu.web import http
+
+
+# ------------------------------------------------------------- metrics
+
+class TestHistogram:
+    def test_bucket_exposition_semantics(self):
+        reg = obsm.Registry()
+        h = reg.histogram("t_seconds", "latency", buckets=(0.1, 1, 10))
+        for v in (0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        text = reg.exposition()
+        assert "# TYPE t_seconds histogram" in text
+        # cumulative counts per upper bound, +Inf == count
+        assert 't_seconds_bucket{le="0.1"} 1' in text
+        assert 't_seconds_bucket{le="1"} 2' in text
+        assert 't_seconds_bucket{le="10"} 3' in text
+        assert 't_seconds_bucket{le="+Inf"} 4' in text
+        assert "t_seconds_sum 55.55" in text
+        assert "t_seconds_count 4" in text
+
+    def test_boundary_observation_is_le(self):
+        reg = obsm.Registry()
+        h = reg.histogram("b_seconds", "h", buckets=(1.0, 2.0))
+        h.observe(1.0)   # le is INCLUSIVE
+        text = reg.exposition()
+        assert 'b_seconds_bucket{le="1"} 1' in text
+
+    def test_labeled_histogram(self):
+        reg = obsm.Registry()
+        h = reg.histogram("r_seconds", "h", ("app",), buckets=(1.0,))
+        h.labels("jwa").observe(0.5)
+        h.labels("jwa").observe(3.0)
+        text = reg.exposition()
+        assert 'r_seconds_bucket{app="jwa",le="1"} 1' in text
+        assert 'r_seconds_bucket{app="jwa",le="+Inf"} 2' in text
+        assert 'r_seconds_count{app="jwa"} 2' in text
+        assert h.value("jwa") == 2
+
+    def test_unobserved_labelless_exposes_zero(self):
+        reg = obsm.Registry()
+        reg.histogram("idle_seconds", "h", buckets=(1.0,))
+        text = reg.exposition()
+        assert 'idle_seconds_bucket{le="+Inf"} 0' in text
+        assert "idle_seconds_count 0" in text
+
+    def test_counter_gauge_exposition_unchanged(self):
+        # the notebook-controller families must expose byte-identically
+        reg = obsm.Registry()
+        c = reg.counter("nb_total", "notebooks", ("namespace",))
+        c.labels("default").inc()
+        c.labels("default").inc()
+        assert 'nb_total{namespace="default"} 2' in reg.exposition()
+
+    def test_name_and_help_validation(self):
+        reg = obsm.Registry()
+        with pytest.raises(ValueError, match="must match"):
+            reg.counter("Bad-Name", "help")
+        with pytest.raises(ValueError, match="help"):
+            reg.gauge("fine_name", "   ")
+        with pytest.raises(ValueError, match="label"):
+            reg.counter("ok_name", "help", ("bad-label",))
+
+    def test_reregistration(self):
+        reg = obsm.Registry()
+        a = reg.counter("dup_total", "h", ("x",))
+        assert reg.counter("dup_total", "h", ("x",)) is a
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("dup_total", "h", ("x",))
+
+    def test_lint_clean_on_global_registry(self):
+        assert obsm.REGISTRY.lint() == []
+
+
+# ------------------------------------------------------------- tracing
+
+class TestTracing:
+    def test_parse_traceparent(self):
+        tid, sid = "ab" * 16, "cd" * 8
+        assert tracing.parse_traceparent(f"00-{tid}-{sid}-01") == (tid, sid)
+        for bad in (None, "", "garbage", f"ff-{tid}-{sid}-01",
+                    f"00-{'0'*32}-{sid}-01", f"00-{tid}-{'0'*16}-01"):
+            assert tracing.parse_traceparent(bad) is None
+
+    def test_nesting_links_parent_child(self):
+        buf = tracing.TraceBuffer()
+        with tracing.span("outer", buffer=buf) as outer:
+            with tracing.span("inner", buffer=buf) as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_id == outer.span_id
+        spans = buf.spans()
+        assert [s.name for s in spans] == ["inner", "outer"]
+
+    def test_remote_parent_via_traceparent(self):
+        buf = tracing.TraceBuffer()
+        tid, sid = "12" * 16, "34" * 8
+        with tracing.span("srv", buffer=buf,
+                          traceparent=f"00-{tid}-{sid}-01") as s:
+            assert (s.trace_id, s.parent_id) == (tid, sid)
+
+    def test_error_status_and_reraise(self):
+        buf = tracing.TraceBuffer()
+        with pytest.raises(RuntimeError):
+            with tracing.span("boom", buffer=buf):
+                raise RuntimeError("x")
+        s = buf.spans()[0]
+        assert s.status == "error" and "RuntimeError" in s.attrs["error"]
+
+    def test_ring_buffer_bounded(self):
+        buf = tracing.TraceBuffer(capacity=3)
+        for i in range(5):
+            with tracing.span(f"s{i}", buffer=buf):
+                pass
+        assert [s.name for s in buf.spans()] == ["s2", "s3", "s4"]
+
+    def test_chrome_trace_events(self):
+        buf = tracing.TraceBuffer()
+        with tracing.span("ev", buffer=buf, foo="bar"):
+            pass
+        ct = buf.chrome_trace()
+        assert len(ct["traceEvents"]) == 1
+        ev = ct["traceEvents"][0]
+        assert ev["ph"] == "X" and ev["name"] == "ev"
+        assert ev["args"]["foo"] == "bar"
+
+
+# ----------------------------------------------------- App middleware
+
+class TestAppObservability:
+    def _app(self, name="obs-app"):
+        app = http.App(name)
+
+        @app.get("/hello")
+        def hello(request):
+            return {"ok": True}
+
+        return app
+
+    def test_metrics_route_is_prometheus_text(self):
+        c = http.TestClient(self._app())
+        c.get("/hello")
+        r = c.get("/metrics")
+        assert r.status == 200
+        assert "text/plain" in r.headers["Content-Type"]
+        text = r.body.decode()
+        assert "# TYPE http_request_duration_seconds histogram" in text
+        assert "http_request_duration_seconds_bucket" in text
+
+    def test_traceparent_roundtrip_and_trace_endpoint(self):
+        tid, sid = "ef" * 16, "ab" * 8
+        c = http.TestClient(self._app("obs-tp"))
+        r = c.get("/hello",
+                  headers={"traceparent": f"00-{tid}-{sid}-01"})
+        # injection: response continues OUR span on the caller's trace
+        assert r.headers["traceparent"].startswith(f"00-{tid}-")
+        assert r.headers["traceparent"] != f"00-{tid}-{sid}-01"
+        t = c.get(f"/debug/traces?trace_id={tid}")
+        traces = t.json["traces"]
+        assert len(traces) == 1
+        spans = traces[0]["spans"]
+        srv = [s for s in spans if s["name"] == "http GET /hello"][0]
+        assert srv["parent_id"] == sid        # extraction: remote parent
+        assert srv["attrs"]["code"] == 200
+
+    def test_chrome_export(self):
+        c = http.TestClient(self._app("obs-chrome"))
+        c.get("/hello")
+        r = c.get("/debug/traces?format=chrome")
+        assert {"traceEvents", "displayTimeUnit"} <= set(r.json)
+
+    def test_observability_routes_bypass_before_hooks(self):
+        # a Prometheus scraper has no identity header; /metrics and
+        # /debug/traces must not 401 behind install_security-style hooks
+        app = self._app("obs-auth")
+
+        @app.before_request
+        def deny_all(request):
+            raise http.HTTPError(401, "no identity")
+
+        c = http.TestClient(app)
+        assert c.get("/hello").status == 401
+        assert c.get("/metrics").status == 200
+        assert c.get("/debug/traces").status == 200
+
+    def test_http_metrics_label_by_code(self):
+        app = self._app("obs-codes")
+        c = http.TestClient(app)
+        c.get("/hello")
+        c.get("/nope")
+        text = c.get("/metrics").body.decode()
+        assert ('http_requests_total{app="obs-codes",method="GET",'
+                'code="200"} 1') in text
+        assert ('http_requests_total{app="obs-codes",method="GET",'
+                'code="404"} 1') in text
+
+
+# ------------------------------------------- reconcile instrumentation
+
+class _PingReconciler(Reconciler):
+    name = "obs-ping"
+
+    def __init__(self):
+        self.calls = 0
+
+    def reconcile(self, req):
+        self.calls += 1
+        if req.name == "boom":
+            raise RuntimeError("injected")
+        return Result()
+
+    def setup(self, builder):
+        builder.watch_for("v1", "ConfigMap")
+
+
+class TestReconcileMetrics:
+    def test_run_sync_emits_controller_runtime_families(self, store,
+                                                        manager):
+        rec = _PingReconciler()
+        base_ok = manager_mod._RECONCILE_TOTAL.value("obs-ping",
+                                                     "success")
+        base_hist = manager_mod._RECONCILE_TIME.value("obs-ping")
+        manager.add(rec)
+        manager.start_sync()
+        store.create({"apiVersion": "v1", "kind": "ConfigMap",
+                      "metadata": {"name": "cm1",
+                                   "namespace": "default"}})
+        manager.run_sync()
+        assert rec.calls >= 1
+        got = manager_mod._RECONCILE_TOTAL.value("obs-ping", "success")
+        assert got - base_ok == rec.calls
+        assert manager_mod._RECONCILE_TIME.value("obs-ping") \
+            - base_hist == rec.calls
+        text = obsm.REGISTRY.exposition()
+        assert ('controller_runtime_reconcile_total{'
+                'controller="obs-ping",result="success"}') in text
+        assert ("controller_runtime_reconcile_time_seconds_bucket"
+                in text)
+        # workqueue families carry the controller's queue name
+        assert 'workqueue_depth{name="obs-ping"} 0' in text
+        assert ('workqueue_queue_duration_seconds_count'
+                '{name="obs-ping"}') in text
+
+    def test_error_outcome_and_span(self, store, manager):
+        rec = _PingReconciler()
+        base_err = manager_mod._RECONCILE_TOTAL.value("obs-ping",
+                                                      "error")
+        manager.add(rec)
+        manager.start_sync()
+        store.create({"apiVersion": "v1", "kind": "ConfigMap",
+                      "metadata": {"name": "boom",
+                                   "namespace": "default"}})
+        manager.run_sync()
+        assert manager_mod._RECONCILE_TOTAL.value("obs-ping", "error") \
+            > base_err
+        errs = [s for s in tracing.TRACES.spans()
+                if s.name == "reconcile"
+                and s.attrs.get("controller") == "obs-ping"
+                and s.attrs.get("result") == "error"]
+        assert errs and errs[-1].status == "error"
+
+
+# --------------------------------------------------- serving families
+
+class TestServingMetrics:
+    def test_latency_queue_wait_and_batch_size(self):
+        from kubeflow_tpu.compute import serving
+        server = serving.ModelServer()
+        server.register("obs-echo", lambda x: x * 2.0, batching=True)
+        model = server.models()["obs-echo"]
+        out, _ms = model.predict_raw(np.ones((3, 2), np.float32))
+        assert out.shape == (3, 2)
+        text = obsm.REGISTRY.exposition()
+        assert ('serving_request_duration_seconds_count'
+                '{model="obs-echo",track="stable"} 1') in text
+        assert ('serving_batch_queue_wait_seconds_count'
+                '{model="obs-echo",track="stable"} 1') in text
+        # 3 rows coalesced into one device dispatch
+        assert ('serving_batch_size_rows_bucket'
+                '{model="obs-echo",track="stable",le="4"} 1') in text
+        model.close()
+
+    def test_model_server_metrics_and_trace_endpoints(self):
+        from kubeflow_tpu.compute import serving
+        server = serving.ModelServer()
+        server.register("obs-wire", lambda x: x + 1.0)
+        port = server.start(port=0, host="127.0.0.1")
+        try:
+            tid = "77" * 16
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/obs-wire:predict",
+                data=json.dumps({"instances": [[1.0]]}).encode(),
+                headers={"Content-Type": "application/json",
+                         "traceparent": f"00-{tid}-{'88' * 8}-01"})
+            resp = urllib.request.urlopen(req)
+            assert json.loads(resp.read())["predictions"] == [[2.0]]
+            assert resp.headers["traceparent"].startswith(f"00-{tid}-")
+
+            scrape = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics")
+            assert "text/plain" in scrape.headers["Content-Type"]
+            text = scrape.read().decode()
+            assert ('serving_request_duration_seconds_bucket'
+                    '{model="obs-wire"') in text
+
+            t = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/traces?trace_id={tid}"
+            ).read())
+            spans = t["traces"][0]["spans"]
+            srv = [s for s in spans
+                   if s["name"].startswith("http POST")][0]
+            disp = [s for s in spans
+                    if s["name"] == "serving.dispatch"][0]
+            # acceptance: HTTP handling + serving dispatch, linked
+            assert srv["parent_id"] == "88" * 8
+            assert disp["parent_id"] == srv["span_id"]
+            assert disp["attrs"]["track"] == "stable"
+        finally:
+            server.stop()
+
+    def test_canary_track_label(self):
+        from kubeflow_tpu.compute import serving
+        server = serving.ModelServer()
+        server.register_loadable(
+            "obs-cn", lambda p, x: x * p["w"],
+            {"w": np.float32(2.0)})
+        server.register_canary(
+            "obs-cn", lambda p, x: x * p["w"],
+            {"w": np.float32(3.0)}, version=2, weight=1.0)
+        server._canary_rng.seed(0)
+        model = server._route("obs-cn", server.models()["obs-cn"])
+        assert model.track == "canary"
+        model.predict_raw(np.ones((1, 1), np.float32))
+        text = obsm.REGISTRY.exposition()
+        assert ('serving_request_duration_seconds_count'
+                '{model="obs-cn",track="canary"} 1') in text
+        server.promote_canary("obs-cn")
+        assert model.track == "stable"
