@@ -19,10 +19,12 @@ def main():
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     # import side effects register each layer's module-level families
-    import kubeflow_tpu.compute.serving   # noqa: F401
-    import kubeflow_tpu.core.manager      # noqa: F401
-    import kubeflow_tpu.core.workqueue    # noqa: F401
-    import kubeflow_tpu.web.http          # noqa: F401
+    import kubeflow_tpu.compute.serving       # noqa: F401
+    import kubeflow_tpu.controllers.tpuslice  # noqa: F401
+    import kubeflow_tpu.core.manager          # noqa: F401
+    import kubeflow_tpu.core.workqueue        # noqa: F401
+    import kubeflow_tpu.sched.controller      # noqa: F401
+    import kubeflow_tpu.web.http              # noqa: F401
     from kubeflow_tpu.controllers.metrics import NotebookMetrics
     from kubeflow_tpu.obs import metrics as obs_metrics
 
@@ -33,6 +35,18 @@ def main():
 
     problems = obs_metrics.REGISTRY.lint() + scratch.lint()
     checked = len(obs_metrics.REGISTRY._metrics) + len(scratch._metrics)
+
+    # drift guard for the scheduler + gang domains: these families are
+    # what docs/scheduling.md and the queue dashboards promise exist —
+    # a rename or accidental drop must fail the build, not the scrape
+    required = {
+        "sched_admitted_total", "sched_preempted_total",
+        "sched_queue_wait_seconds", "sched_quota_chips",
+        "tpuslice_gang_restarts_total",
+    }
+    registered = {metric.name for metric in obs_metrics.REGISTRY._metrics}
+    for name in sorted(required - registered):
+        problems.append(f"required family {name} is not registered")
     if problems:
         print("metrics lint FAILED:")
         for p in problems:

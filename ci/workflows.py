@@ -24,7 +24,7 @@ COMPONENTS = {
     "secure-notebook-controller": ("python -m pytest tests/test_secure_notebook.py -q", "."),
     "profile-controller": ("python -m pytest tests/test_profile_controller.py -q", "."),
     "tensorboard-controller": ("python -m pytest tests/test_tensorboard_controller.py -q", "."),
-    "tpuslice-controller": ("python -m pytest tests/test_tpuslice_controller.py -q", "."),
+    "tpuslice-controller": ("python -m pytest tests/test_tpuslice_controller.py tests/test_sched_queue.py -q", "."),
     "admission-webhook": ("python -m pytest tests/test_admission_webhook.py -q", "."),
     "web-apps": ("python -m pytest tests/test_web_apps.py -q", "."),
     "compute": ("python -m pytest tests/ -q -k 'compute'", "."),

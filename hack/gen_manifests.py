@@ -82,6 +82,8 @@ WEB_APPS = {
                         "port": 5000, "prefix": "/studies"},
     "slices-web-app": {"image": PLATFORM_IMAGE,
                        "port": 5000, "prefix": "/slices"},
+    "queues-web-app": {"image": PLATFORM_IMAGE,
+                       "port": 5000, "prefix": "/queues"},
     "access-management": {"image": PLATFORM_IMAGE,
                           "port": 8081, "prefix": "/kfam"},
     "centraldashboard": {"image": PLATFORM_IMAGE,

@@ -41,26 +41,47 @@ def workers_for(accelerator, topology):
     return max(1, total // chips_per_host)
 
 
+def gang_chips(accelerator, topology):
+    """Full gang footprint in chips — workers x chips-per-worker, the
+    all-or-nothing admission unit the queue scheduler (sched/) charges
+    against a tenant's quota."""
+    chips_per_host = ACCELERATOR_HOSTS.get(accelerator, (4, None))[0]
+    return workers_for(accelerator, topology) * chips_per_host
+
+
 def new_slice(name, namespace, accelerator, topology, pod_spec,
-              labels=None):
+              labels=None, queue=None, priority=None, suspend=False):
+    """``queue`` opts the gang into the admission queue (sched/): no
+    pods exist until the queue admits its full footprint. ``priority``
+    orders the queue and arms preemption; ``suspend`` parks the slice
+    (Kueue's .spec.suspend) without deleting it."""
     md = {"name": name, "namespace": namespace}
     if labels:
         md["labels"] = dict(labels)
+    spec = {
+        "accelerator": accelerator,
+        "topology": topology,
+        "template": {"spec": pod_spec},
+    }
+    if queue is not None:
+        spec["queue"] = queue
+    if priority is not None:
+        spec["priority"] = int(priority)
+    if suspend:
+        spec["suspend"] = True
+    phase = "Suspended" if suspend else ("Queued" if queue else "Pending")
     return {
         "apiVersion": f"{GROUP}/{VERSION}", "kind": SLICE_KIND,
         "metadata": md,
-        "spec": {
-            "accelerator": accelerator,
-            "topology": topology,
-            "template": {"spec": pod_spec},
-        },
-        "status": {"conditions": [], "readyWorkers": 0, "phase": "Pending"},
+        "spec": spec,
+        "status": {"conditions": [], "readyWorkers": 0, "phase": phase},
     }
 
 
 def new_study(name, namespace, objective, parameters, trial_template,
               max_trials=10, parallelism=None, algorithm="random",
-              seed=0, accelerator=None, chips_per_trial=None):
+              seed=0, accelerator=None, chips_per_trial=None,
+              queue=None, priority=None):
     """parameters: list of {name, type: double|int|categorical, min, max,
     values}; trial_template: pod spec template whose container args may use
     ``{{param}}`` placeholders (katib_studyjob_test.py idiom).
@@ -80,6 +101,12 @@ def new_study(name, namespace, objective, parameters, trial_template,
         spec["accelerator"] = accelerator
     if chips_per_trial is not None:
         spec["chipsPerTrial"] = chips_per_trial
+    if queue is not None:
+        # trials share the study's queue: the admission envelope is
+        # parallelTrialCount x chipsPerTrial, admitted all-or-nothing
+        spec["queue"] = queue
+    if priority is not None:
+        spec["priority"] = int(priority)
     return {
         "apiVersion": f"{GROUP}/{VERSION}", "kind": STUDY_KIND,
         "metadata": {"name": name, "namespace": namespace},

@@ -135,9 +135,14 @@ def tensorboard_controller(argv=()):
 
 def tpuslice_controller(argv=()):
     from ..controllers import tpuslice
+    from ..sched import QueueReconciler
     _serve_health()
+    # the admission queue runs beside the workload reconcilers: one
+    # lease covers all three so admission decisions and pod creation
+    # can never split-brain across replicas
     mgr, _ = _run_manager([tpuslice.TpuSliceReconciler(),
-                           tpuslice.StudyJobReconciler()])
+                           tpuslice.StudyJobReconciler(),
+                           QueueReconciler()])
     _block(mgr.stop)
 
 
@@ -183,6 +188,11 @@ def studies_web_app(argv=()):
     _web(studies.create_app, 5000)
 
 
+def queues_web_app(argv=()):
+    from ..web import queues
+    _web(queues.create_app, 5000)
+
+
 def access_management(argv=()):
     from ..web import kfam
     _web(kfam.create_app, 8081)
@@ -211,6 +221,7 @@ COMPONENTS = {
     "tensorboards-web-app": tensorboards_web_app,
     "studies-web-app": studies_web_app,
     "slices-web-app": slices_web_app,
+    "queues-web-app": queues_web_app,
     "access-management": access_management,
     "centraldashboard": centraldashboard,
 }

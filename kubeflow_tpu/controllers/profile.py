@@ -277,7 +277,12 @@ class ProfileReconciler(Reconciler):
         m.set_controller_reference(owner_rb, profile)
         helper.create_or_update(self.store, owner_rb, self._copy_rolebinding)
 
-        # resource quota (go:253-280) — TPU chips budget rides this
+        # resource quota (go:253-280) — TPU chips budget rides this.
+        # The `or {}` folds BOTH pruning transitions onto the delete
+        # path: resourceQuotaSpec removed entirely AND hard emptied
+        # ({} / null) after having been set — either must delete the
+        # live quota, or the tenant keeps a stale chips budget the
+        # admission queue (sched/) would still enforce
         hard = m.deep_get(profile, "spec", "resourceQuotaSpec", "hard") or {}
         if hard:
             quota = builtin.resource_quota(papi.QUOTA_NAME, name, hard)

@@ -36,8 +36,16 @@ from ..core import meta as m
 from ..core import reconcilehelper as helper
 from ..core.errors import NotFoundError
 from ..core.manager import EventRecorder, Reconciler, Request, Result
+from ..obs import metrics as obs_metrics
 
 log = logging.getLogger("kubeflow_tpu.controllers.tpuslice")
+
+#: gang restarts per slice, beside the GangRestart event (events get
+#: GC'd; the counter is the durable crash-loop signal dashboards alert on)
+GANG_RESTARTS = obs_metrics.REGISTRY.counter(
+    "tpuslice_gang_restarts_total",
+    "Gang restarts performed per TpuSlice",
+    ("namespace", "slice"))
 
 #: pod-template annotation carrying the gang restart generation — bumping
 #: it (plus deleting the gang's pods) is how the controller restarts the
@@ -46,6 +54,26 @@ GANG_GENERATION = "kubeflow.org/gang-generation"
 
 #: default restart budget before the slice goes terminally Failed
 DEFAULT_MAX_RESTARTS = 5
+
+
+def update_status_preserving_admission(store, obj, status):
+    """Write a workload's status WITHOUT clobbering ``status.admission``.
+
+    The status subresource is last-writer-wins and two controllers
+    write these objects: the workload reconciler (phase/readiness) and
+    the QueueReconciler (admission). The admission record is the
+    queue's alone — overlay whatever the live object carries at write
+    time, so a reconcile racing an admission flip can never erase it
+    (the MODIFIED event from the queue's write re-wakes this reconciler
+    and the pod-side converges on the fresh decision)."""
+    live = store.try_get(obj["apiVersion"], obj["kind"], m.name_of(obj),
+                         m.namespace_of(obj))
+    if live is not None:
+        admission = m.deep_get(live, "status", "admission")
+        if admission is not None:
+            status["admission"] = admission
+    obj["status"] = status
+    store.update_status(obj)
 
 
 def generate_headless_service(ts):
@@ -146,6 +174,52 @@ class TpuSliceReconciler(Reconciler):
         return self.store.list("v1", "Pod", namespace,
                                label_selector={"tpu-slice": name})
 
+    def _hold(self, ts, req, old_status, admission, workers,
+              restart_count, last_reason, suspended):
+        """Queued/Suspended/preempted: ensure nothing of the gang is
+        materialized. Deleting the StatefulSet cascades to its pods
+        (ownerReference GC); stray pods are swept directly so a
+        preempted gang's chips actually drain — the scheduler keeps its
+        footprint charged until they do."""
+        if self.store.try_get("apps/v1", "StatefulSet", req.name,
+                              req.namespace) is not None:
+            try:
+                self.store.delete("apps/v1", "StatefulSet", req.name,
+                                  req.namespace)
+            except NotFoundError:
+                pass
+        for p in self._gang_pods(req.name, req.namespace):
+            if m.deep_get(p, "metadata", "deletionTimestamp"):
+                continue
+            try:
+                self.store.delete("v1", "Pod", m.name_of(p),
+                                  req.namespace)
+            except NotFoundError:
+                pass
+        phase = "Suspended" if suspended else "Queued"
+        status = {
+            "readyWorkers": 0,
+            "workers": workers,
+            "phase": phase,
+            "restartCount": restart_count,
+            "conditions": [{
+                "type": "Ready", "status": "False",
+                "reason": phase,
+                "lastTransitionTime": m.now_iso(),
+            }],
+        }
+        if admission is not None:
+            status["admission"] = admission
+        if last_reason:
+            status["lastRestartReason"] = last_reason
+        old_cmp = dict(old_status)
+        old_cmp.pop("conditions", None)
+        new_cmp = dict(status)
+        new_cmp.pop("conditions", None)
+        if new_cmp != old_cmp:
+            update_status_preserving_admission(self.store, ts, status)
+        return Result()
+
     def reconcile(self, req):
         ts = self.store.try_get(self.API, tsapi.SLICE_KIND, req.name,
                                 req.namespace)
@@ -163,6 +237,22 @@ class TpuSliceReconciler(Reconciler):
         last_reason = old_status.get("lastRestartReason")
         max_restarts = m.deep_get(ts, "spec", "maxRestarts",
                                   default=DEFAULT_MAX_RESTARTS)
+
+        # ---- admission gate (sched/): a queue-managed slice creates
+        # NO pods until the QueueReconciler admits its full footprint;
+        # revoked admission (preemption) tears the gang down. The gate
+        # sits between "CR exists" and "pods exist" — Service/
+        # PodDefault/StatefulSet are all withheld, not just pods.
+        queue_managed = bool(m.deep_get(ts, "spec", "queue"))
+        suspended = bool(m.deep_get(ts, "spec", "suspend"))
+        admission = old_status.get("admission")
+        admitted = not suspended and (
+            not queue_managed or bool((admission or {}).get("admitted")))
+        terminal = old_status.get("phase") in ("Succeeded", "Failed")
+        if not admitted and not terminal:
+            return self._hold(ts, req, old_status, admission,
+                              workers, restart_count, last_reason,
+                              suspended)
 
         # ---- gang failure detection (SURVEY §5 slice-failure row).
         # One dead worker wedges XLA collectives for the whole slice: a
@@ -202,6 +292,7 @@ class TpuSliceReconciler(Reconciler):
                 restarting = True
                 restart_count += 1
                 last_reason = failures[0]
+                GANG_RESTARTS.labels(req.namespace, req.name).inc()
                 self.recorder.event(
                     ts, "Warning", "GangRestart",
                     f"{last_reason}; restarting gang "
@@ -243,7 +334,9 @@ class TpuSliceReconciler(Reconciler):
         elif ready >= workers:
             phase = "Running"
         else:
-            phase = "Pending"
+            # queue-managed gangs surface the post-admission phase
+            # (Suspended → Queued → Admitted → Running, docs/scheduling.md)
+            phase = "Admitted" if queue_managed else "Pending"
         status = {
             "readyWorkers": ready,
             "workers": workers,
@@ -255,6 +348,8 @@ class TpuSliceReconciler(Reconciler):
                 "lastTransitionTime": m.now_iso(),
             }],
         }
+        if admission is not None:
+            status["admission"] = admission
         if last_reason:
             status["lastRestartReason"] = last_reason
         old_cmp = dict(old_status)
@@ -262,8 +357,7 @@ class TpuSliceReconciler(Reconciler):
         new_cmp = dict(status)
         new_cmp.pop("conditions", None)
         if new_cmp != old_cmp:
-            ts["status"] = status
-            self.store.update_status(ts)
+            update_status_preserving_admission(self.store, ts, status)
         return Result()
 
 
@@ -794,6 +888,17 @@ class StudyJobReconciler(Reconciler):
         metric_name = objective.get("metricName", "objective")
         maximize = objective.get("type", "maximize") == "maximize"
 
+        # ---- admission gate (sched/): trials share the study's queue —
+        # a queue-managed study launches NO trial pods until the queue
+        # admits its parallel envelope (parallelTrialCount x
+        # chipsPerTrial). Trials already running keep running (studies
+        # release chips between trials and are not preemption victims).
+        queue_managed = bool(spec.get("queue"))
+        suspended = bool(spec.get("suspend"))
+        admission = m.deep_get(study, "status", "admission")
+        admitted = not suspended and (
+            not queue_managed or bool((admission or {}).get("admitted")))
+
         # snapshot before the collect loop mutates trial dicts in place:
         # the dirty check below must see the pre-reconcile state or an
         # update that only touches trial fields is silently skipped
@@ -911,7 +1016,7 @@ class StudyJobReconciler(Reconciler):
         ckroot = (m.deep_get(spec, "algorithm", "checkpointDir",
                              default="") or
                   f"/tmp/pbt/{req.namespace}/{req.name}")
-        while next_index < max_trials and active < parallelism:
+        while admitted and next_index < max_trials and active < parallelism:
             pbt_meta = None
             if algorithm == "pbt":
                 values, pbt_meta = self._pbt_values(
@@ -961,27 +1066,34 @@ class StudyJobReconciler(Reconciler):
         finished = completed >= max_trials
         prior = m.deep_get(study, "status", "conditions", default=[]) or []
         cond_type = "Completed" if finished else "Running"
+        if not finished and not admitted and not trials:
+            # nothing launched yet and the queue has not admitted us
+            cond_type = "Suspended" if suspended else "Queued"
         if prior and prior[-1].get("type") == cond_type:
             transition = prior[-1].get("lastTransitionTime") or m.now_iso()
         else:
             transition = m.now_iso()
+        phase = "Completed" if finished else "Running"
+        if cond_type in ("Queued", "Suspended"):
+            phase = cond_type
         status = {
             "trials": [trials[i] for i in sorted(trials)],
             "completedTrials": completed,
-            "phase": "Completed" if finished else "Running",
+            "phase": phase,
             "conditions": [{
                 "type": cond_type,
                 "status": "True",
                 "lastTransitionTime": transition,
             }],
         }
+        if admission is not None:
+            status["admission"] = admission
         if best is not None:
             status["bestTrial"] = {"index": best["index"],
                                    "parameters": best["parameters"],
                                    "objectiveValue": best["objectiveValue"]}
         if status != prior_status:
-            study["status"] = status
-            self.store.update_status(study)
+            update_status_preserving_admission(self.store, study, status)
         if es_enabled and any(t.get("state") == "Running"
                               for t in trials.values()):
             # kubelet log growth emits no watch events: the medianstop

@@ -32,9 +32,20 @@ DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
 TEXT_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
+def _escape_label_value(value):
+    """Prometheus text-format 0.0.4 label-value escaping: backslash,
+    double-quote, and newline. Label VALUES are arbitrary user text
+    (e.g. spec.queue flows into the sched_* families) — one unescaped
+    quote or embedded newline would corrupt the whole exposition for
+    every family in the process."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(names, values, extra=()):
-    pairs = [f'{n}="{v}"' for n, v in zip(names, values)]
-    pairs += [f'{n}="{v}"' for n, v in extra]
+    pairs = [f'{n}="{_escape_label_value(v)}"'
+             for n, v in zip(names, values)]
+    pairs += [f'{n}="{_escape_label_value(v)}"' for n, v in extra]
     return "{" + ",".join(pairs) + "}" if pairs else ""
 
 

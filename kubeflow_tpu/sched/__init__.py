@@ -1,0 +1,24 @@
+"""Gang-aware admission queue — a Kueue-class quota scheduler for TPU
+slices (SURVEY §5 partial-gang starvation; NotebookOS arXiv:2503.20591
+admission gating; Maple arXiv:2510.08842 heterogeneous brokering).
+
+The subsystem sits between "CR exists" and "pods exist" for every gang
+workload:
+
+- ``quota``  — chip-quota ledger keyed by Profile namespace, with
+  cohorts and borrowing (Kueue ClusterQueue/cohort semantics).
+- ``queue``  — the pure planner: priority-ordered FIFO queues,
+  all-or-nothing gang admission, bounded backfill past a blocked head,
+  and preemption victim selection.
+- ``controller`` — the ``QueueReconciler`` that snapshots the store,
+  runs the planner, and applies admissions/preemptions to workload
+  status (plus the ``sched_*`` metric families).
+
+Workloads opt in by setting ``spec.queue``; a workload without a queue
+is admitted implicitly (its chips are still charged to the ledger so
+queue-managed gangs can't oversubscribe around it).
+"""
+
+from .controller import QueueReconciler          # noqa: F401
+from .queue import Gang, Plan, plan              # noqa: F401
+from .quota import QuotaLedger                   # noqa: F401

@@ -1,0 +1,323 @@
+"""QueueReconciler — drives the admission planner against the store.
+
+A cluster-level singleton reconcile (every watched event maps to ONE
+request) so each pass sees a consistent snapshot of quota + workloads
+and the plan is a pure function of it. Quota-freeing events — a gang
+finishing, its pods draining after preemption, a Profile quota edit —
+all funnel into the same request and re-run admission.
+
+Admission state is persisted on the workload's ``status.admission``:
+
+    {"admitted": bool, "seq": N,            # arrival order, stable
+     "admittedAt": iso, "admittedSeq": M,   # admission order
+     "bypass": K,                           # backfill bumps suffered
+     "reason": "..."}                       # why still queued
+
+The workload's own reconciler (controllers/tpuslice.py) owns the pod
+side: it creates nothing until ``admitted`` and tears the gang down
+when admission is revoked — so the scheduler never touches pods
+directly and "admitted" is the single control point between "CR
+exists" and "pods exist".
+"""
+
+import calendar
+import logging
+import time
+
+from ..api import profile as papi
+from ..api import tpuslice as tsapi
+from ..core import meta as m
+from ..core.manager import EventRecorder, Reconciler, Request, Result
+from ..obs import metrics as obs_metrics
+from . import queue as squeue
+from .quota import COHORT_ANNOTATION, QuotaLedger
+
+log = logging.getLogger("kubeflow_tpu.sched")
+
+SLICE_API = f"{tsapi.GROUP}/{tsapi.VERSION}"
+PROFILE_API = f"{papi.GROUP}/{papi.VERSION}"
+
+_ADMITTED = obs_metrics.REGISTRY.counter(
+    "sched_admitted_total",
+    "Gang workloads admitted by the TPU admission queue",
+    ("queue",))
+_PREEMPTED = obs_metrics.REGISTRY.counter(
+    "sched_preempted_total",
+    "Admitted gang workloads preempted for higher-priority arrivals",
+    ("queue",))
+_QUEUE_WAIT = obs_metrics.REGISTRY.histogram(
+    "sched_queue_wait_seconds",
+    "Seconds from workload creation to queue admission",
+    ("queue",),
+    buckets=(1, 5, 15, 60, 300, 900, 3600, 14400, 86400))
+_QUOTA_CHIPS = obs_metrics.REGISTRY.gauge(
+    "sched_quota_chips",
+    "Chip quota accounting per namespace (state: used|reserved|free)",
+    ("namespace", "state"))
+
+
+def _parse_iso(ts):
+    try:
+        return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+    except (TypeError, ValueError):
+        return None
+
+
+def _int(value, default=0):
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return default
+
+
+def slice_footprint(spec):
+    """Full gang footprint in chips: workers x chips-per-worker. The
+    admission unit — a TpuSlice is admitted all-or-nothing."""
+    accelerator = spec.get("accelerator", "") or ""
+    topology = spec.get("topology") or "2x2"
+    try:
+        return tsapi.gang_chips(accelerator, topology)
+    except ValueError:
+        return 0
+
+
+def study_footprint(spec):
+    """A StudyJob's admission envelope: its parallel trials' chips."""
+    max_trials = _int(spec.get("maxTrialCount", 0))
+    parallel = _int(spec.get("parallelTrialCount", max_trials),
+                    default=max_trials)
+    chips = _int(spec.get("chipsPerTrial", 1) or 1, default=1)
+    return max(0, min(parallel, max_trials) * chips)
+
+
+def build_ledger(store):
+    """Nominal quotas + cohorts from the tenancy layer: the Profile's
+    ``google.com/tpu`` hard limit is authoritative; a bare
+    ``kf-resource-quota`` ResourceQuota (kubectl-managed namespace)
+    is honored as fallback."""
+    nominal, cohorts = {}, {}
+    for prof in store.list(PROFILE_API, papi.KIND):
+        ns = m.name_of(prof)
+        hard = m.deep_get(prof, "spec", "resourceQuotaSpec", "hard") or {}
+        if "google.com/tpu" in hard:
+            nominal[ns] = _int(hard["google.com/tpu"], default=0)
+        cohort = m.annotations_of(prof).get(COHORT_ANNOTATION)
+        if cohort:
+            cohorts[ns] = cohort
+    for rq in store.list("v1", "ResourceQuota"):
+        if m.name_of(rq) != papi.QUOTA_NAME:
+            continue
+        ns = m.namespace_of(rq)
+        hard = m.deep_get(rq, "spec", "hard") or {}
+        if ns not in nominal and "google.com/tpu" in hard:
+            nominal[ns] = _int(hard["google.com/tpu"], default=0)
+    return QuotaLedger(nominal, cohorts)
+
+
+def _live_gang_pods(store, namespace, label, name):
+    for pod in store.list("v1", "Pod", namespace,
+                          label_selector={label: name}):
+        if m.deep_get(pod, "metadata", "deletionTimestamp"):
+            continue
+        if m.deep_get(pod, "status", "phase") in ("Succeeded", "Failed"):
+            continue
+        return True
+    return False
+
+
+def _gang_from(obj, kind, chips, terminal_phases, pod_label, store):
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    admission = status.get("admission") or {}
+    ns, name = m.namespace_of(obj), m.name_of(obj)
+    qname = spec.get("queue")
+    managed = bool(qname)
+    admitted = admission.get("admitted", False) if managed else True
+    suspended = bool(spec.get("suspend"))
+    terminal = status.get("phase") in terminal_phases
+    # suspension revokes admission: the workload holds no grant, but
+    # while its pods are still draining it is "releasing" — charged —
+    # so suspending an admitted gang can never double-book its chips
+    effective = admitted and not suspended
+    releasing = (not effective and not terminal
+                 and _live_gang_pods(store, ns, pod_label, name))
+    return squeue.Gang(
+        key=f"{kind}/{ns}/{name}",
+        namespace=ns, name=name, kind=kind,
+        queue=qname or "default",
+        chips=chips,
+        priority=_int(spec.get("priority", 0)),
+        seq=_int(admission.get("seq", 0)),
+        admitted=effective,
+        admitted_seq=_int(admission.get("admittedSeq", 0)),
+        releasing=releasing,
+        terminal=terminal,
+        suspended=suspended,
+        managed=managed,
+        # studies release chips between trials and checkpoint per trial;
+        # evicting mid-trial would burn the trial, so only TpuSlice
+        # gangs (which gang-restart from checkpoints anyway) are victims
+        preemptible=(kind == tsapi.SLICE_KIND),
+        bypass=_int(admission.get("bypass", 0)))
+
+
+def build_state(store):
+    """Snapshot the world: (gangs, ledger, objects-by-key). Shared by
+    the reconciler and web/queues.py so both see the same math."""
+    ledger = build_ledger(store)
+    gangs, objs = [], {}
+    for ts in store.list(SLICE_API, tsapi.SLICE_KIND):
+        g = _gang_from(ts, tsapi.SLICE_KIND,
+                       slice_footprint(ts.get("spec") or {}),
+                       ("Succeeded", "Failed"), "tpu-slice", store)
+        gangs.append(g)
+        objs[g.key] = ts
+    for sj in store.list(SLICE_API, tsapi.STUDY_KIND):
+        g = _gang_from(sj, tsapi.STUDY_KIND,
+                       study_footprint(sj.get("spec") or {}),
+                       ("Completed", "Failed"), "studyjob", store)
+        gangs.append(g)
+        objs[g.key] = sj
+    return gangs, ledger, objs
+
+
+class QueueReconciler(Reconciler):
+    """The admission control loop. Singleton request: any event on a
+    workload, its pods, or the quota source re-plans the cluster."""
+
+    name = "queue-scheduler"
+    REQUEST = Request("tpu-admission-queue")
+
+    def __init__(self, max_bypass=squeue.MAX_BYPASS):
+        self.max_bypass = max_bypass
+
+    def setup(self, builder):
+        self.recorder = EventRecorder(self.store, self.name)
+        builder.watch_mapped(SLICE_API, tsapi.SLICE_KIND, self._map_any)
+        builder.watch_mapped(SLICE_API, tsapi.STUDY_KIND, self._map_any)
+        builder.watch_mapped(PROFILE_API, papi.KIND, self._map_any)
+        builder.watch_mapped("v1", "ResourceQuota", self._map_any)
+        builder.watch_mapped("v1", "Pod", self._map_gang_pod)
+
+    def _map_any(self, ev):
+        yield self.REQUEST
+
+    def _map_gang_pod(self, ev):
+        # only gang-workload pods can free or hold queue-relevant chips
+        labels = m.labels_of(ev.object)
+        if "tpu-slice" in labels or "studyjob" in labels:
+            yield self.REQUEST
+
+    # ------------------------------------------------------------- status
+
+    def _update_admission(self, obj, updates, drop=()):
+        """Merge ``updates`` into the LIVE object's admission record.
+
+        Always re-reads: the snapshot this plan ran on may predate an
+        earlier write in the same pass (seq assignment happens before
+        admissions/blocked-reasons), and basing the dict on a stale
+        copy would silently erase those fields."""
+        live = self.store.try_get(obj["apiVersion"], obj["kind"],
+                                  m.name_of(obj), m.namespace_of(obj))
+        if live is None:
+            return
+        status = live.setdefault("status", {})
+        admission = dict(status.get("admission") or {})
+        admission.update(updates)
+        for key in drop:
+            admission.pop(key, None)
+        if status.get("admission") == admission:
+            return
+        status["admission"] = admission
+        self.store.update_status(live)
+
+    def _assign_seqs(self, gangs, objs):
+        """First sighting of a managed workload: persist its arrival
+        order. New arrivals are sequenced by creation time (name as the
+        deterministic tiebreak within one clock tick)."""
+        known = [g.seq for g in gangs if g.seq]
+        next_seq = max(known, default=0) + 1
+        fresh = [g for g in gangs
+                 if g.managed and not g.seq and not g.terminal]
+        fresh.sort(key=lambda g: (
+            m.deep_get(objs[g.key], "metadata", "creationTimestamp",
+                       default=""), g.namespace, g.name))
+        for g in fresh:
+            g.seq = next_seq
+            next_seq += 1
+            self._update_admission(objs[g.key],
+                                   {"admitted": False, "seq": g.seq})
+
+    # ---------------------------------------------------------- reconcile
+
+    def reconcile(self, req):
+        gangs, ledger, objs = build_state(self.store)
+        self._assign_seqs(gangs, objs)
+
+        # suspension formally revokes the admission grant (not just the
+        # planner's view of it): a stale admitted:true would otherwise
+        # let an unsuspended workload recreate its pods with NO
+        # re-admission pass — quota overcommit. Resuming goes back
+        # through Queued, exactly the docs/scheduling.md state machine.
+        for g in gangs:
+            if not (g.managed and g.suspended):
+                continue
+            obj = objs[g.key]
+            if m.deep_get(obj, "status", "admission", "admitted"):
+                self._update_admission(
+                    obj, {"admitted": False, "reason": "suspended"},
+                    drop=("admittedAt", "admittedSeq"))
+
+        result = squeue.plan(gangs, ledger, max_bypass=self.max_bypass)
+
+        next_adm = max((g.admitted_seq for g in gangs), default=0) + 1
+        for g in result.admit:
+            obj = objs[g.key]
+            self._update_admission(
+                obj, {"admitted": True, "seq": g.seq,
+                      "admittedAt": m.now_iso(),
+                      "admittedSeq": next_adm},
+                drop=("reason", "bypass"))
+            next_adm += 1
+            self.recorder.event(
+                obj, "Normal", "Admitted",
+                f"admitted by queue {g.queue!r} "
+                f"({g.chips} chips, priority {g.priority})")
+            _ADMITTED.labels(g.queue).inc()
+            created = _parse_iso(m.deep_get(obj, "metadata",
+                                            "creationTimestamp"))
+            if created is not None:
+                _QUEUE_WAIT.labels(g.queue).observe(
+                    max(0.0, time.time() - created))
+
+        requeue_seq = max((g.seq for g in gangs), default=0) + 1
+        for g, reason in result.preempt:
+            obj = objs[g.key]
+            # requeued at the tail: a preempted gang re-arrives, it does
+            # not keep its original slot (or it would instantly starve
+            # the workload that preempted it). "reason" tracks the
+            # CURRENT blocker (later passes overwrite it);
+            # "lastPreemption" is the durable record of the eviction.
+            self._update_admission(
+                obj, {"admitted": False, "seq": requeue_seq,
+                      "reason": reason, "lastPreemption": reason},
+                drop=("admittedAt", "admittedSeq"))
+            requeue_seq += 1
+            self.recorder.event(obj, "Warning", "Preempted", reason)
+            _PREEMPTED.labels(g.queue).inc()
+
+        for key, count in result.bypass.items():
+            self._update_admission(objs[key], {"bypass": count})
+
+        for key, reason in result.blocked.items():
+            self._update_admission(objs[key], {"reason": reason})
+
+        namespaces = set(ledger.nominal) | {g.namespace for g in gangs}
+        for ns in namespaces:
+            report = ledger.report(ns, result.reserved.get(ns, 0))
+            if report["nominal"] is None:
+                continue        # unconstrained: no meaningful gauge
+            _QUOTA_CHIPS.labels(ns, "used").set(report["used"])
+            _QUOTA_CHIPS.labels(ns, "reserved").set(report["reserved"])
+            _QUOTA_CHIPS.labels(ns, "free").set(report["free"])
+        return Result()

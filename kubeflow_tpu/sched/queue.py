@@ -1,0 +1,207 @@
+"""The admission planner — pure scheduling logic, no store access.
+
+Models Kueue's workload lifecycle for gangs: a workload (one TpuSlice
+gang or one StudyJob's parallel-trial envelope) is **pending** until
+its FULL chip footprint fits its namespace's quota (all-or-nothing —
+partial gangs are exactly the SURVEY §5 starvation deadlock this
+subsystem exists to prevent), then **admitted**; a preempted or
+revoked workload is **releasing** until its pods actually drain (its
+chips stay charged so a successor is never admitted while the victim's
+pods still hold hardware — "never both have pods" is the invariant the
+acceptance tests assert).
+
+Ordering is priority-then-arrival per (namespace, queue). Two relief
+valves keep utilization and fairness:
+
+- **Backfill**: a smaller gang behind a blocked head may be admitted
+  out of order if it fits right now — but each backfill bumps the
+  head's ``bypass`` count, and once that reaches ``MAX_BYPASS`` the
+  queue hard-blocks behind the head. Backfill can therefore never
+  starve the head: it is bypassed at most MAX_BYPASS times, after
+  which every completion's freed chips are reserved for it.
+- **Preemption**: a pending gang that cannot fit may evict admitted
+  gangs of strictly lower priority from its cohort. Victims are taken
+  lowest-priority first, youngest-admission first, and only when the
+  haul actually reaches the needed footprint (no pointless evictions).
+"""
+
+from dataclasses import dataclass, field
+
+#: how many times a blocked queue head may be backfilled past before
+#: the queue hard-blocks behind it (the anti-starvation budget)
+MAX_BYPASS = 8
+
+
+@dataclass
+class Gang:
+    """One schedulable workload as the planner sees it."""
+
+    key: str                 # "Kind/namespace/name" — stable identity
+    namespace: str
+    name: str
+    kind: str = "TpuSlice"
+    queue: str = "default"
+    chips: int = 0           # full gang footprint (workers x chips/worker)
+    priority: int = 0
+    seq: int = 0             # arrival order (monotonic, persisted)
+    admitted: bool = False
+    admitted_seq: int = 0    # admission order (youngest-victim tiebreak)
+    releasing: bool = False  # revoked/preempted, pods still draining
+    terminal: bool = False   # Succeeded/Failed/Completed — holds nothing
+    suspended: bool = False  # spec.suspend: parked, never considered
+    managed: bool = True     # False: no spec.queue — implicitly admitted
+    preemptible: bool = True
+    bypass: int = 0          # times backfilled past while blocked head
+
+
+@dataclass
+class Plan:
+    admit: list = field(default_factory=list)       # [Gang]
+    preempt: list = field(default_factory=list)     # [(Gang, reason)]
+    bypass: dict = field(default_factory=dict)      # key -> new count
+    positions: dict = field(default_factory=dict)   # key -> 1-based pos
+    reserved: dict = field(default_factory=dict)    # namespace -> chips
+    blocked: dict = field(default_factory=dict)     # key -> reason
+
+
+def _order(pending):
+    return sorted(pending, key=lambda g: (-g.priority, g.seq, g.key))
+
+
+def _victims_for(gang, candidates, deficit):
+    """Greedy victim pick: lowest priority first, youngest admission
+    first; returns the chosen victims or [] when even taking everything
+    eligible would not cover the deficit."""
+    eligible = sorted(
+        (v for v in candidates if v.priority < gang.priority),
+        key=lambda v: (v.priority, -v.admitted_seq, v.key))
+    chosen, freed = [], 0
+    for v in eligible:
+        chosen.append(v)
+        freed += v.chips
+        if freed >= deficit:
+            return chosen
+    return []
+
+
+def plan(gangs, ledger, max_bypass=MAX_BYPASS):
+    """One scheduling pass over a consistent snapshot.
+
+    Charges active footprints into ``ledger`` (mutating it), then
+    decides admissions, preemptions, bypass bumps, queue positions and
+    per-namespace reservations. Deterministic: same snapshot, same
+    plan.
+    """
+    out = Plan()
+
+    active = [g for g in gangs
+              if not g.terminal and (g.admitted or g.releasing)]
+    for g in active:
+        ledger.charge(g.namespace, g.chips)
+
+    pending = _order(
+        g for g in gangs
+        if g.managed and not g.admitted and not g.releasing
+        and not g.terminal and not g.suspended)
+
+    # ---- preemption pass: only the single highest-priority non-fitting
+    # gang per cohort may select victims per round — over-preempting for
+    # the whole backlog at once would evict gangs whose chips the next
+    # round may find it never needed.
+    cohorts_releasing = {ledger.cohort_of(g.namespace)
+                         for g in active if g.releasing}
+    cohorts_claimed = set()
+    for g in pending:
+        if ledger.fits(g.namespace, g.chips):
+            continue
+        cohort = ledger.cohort_of(g.namespace)
+        if cohort in cohorts_claimed:
+            continue
+        cohorts_claimed.add(cohort)
+        if cohort in cohorts_releasing:
+            # chips are already draining toward this cohort; preempting
+            # more before they land would double-evict
+            out.blocked[g.key] = "waiting for preempted chips to drain"
+            continue
+        total = ledger.cohort_total(g.namespace)
+        if total is not None and g.chips > total:
+            out.blocked[g.key] = (
+                f"needs {g.chips} chips but the cohort quota is only "
+                f"{total} — can never be admitted")
+            continue
+        head = ledger.headroom(g.namespace)
+        deficit = g.chips - (head if head is not None else 0)
+        candidates = [v for v in active
+                      if v.admitted and not v.releasing and v.preemptible
+                      and v.namespace in ledger.members(g.namespace)]
+        victims = _victims_for(g, candidates, deficit)
+        for v in victims:
+            out.preempt.append((v, f"preempted by higher-priority "
+                                   f"{g.namespace}/{g.name} "
+                                   f"(priority {g.priority} > "
+                                   f"{v.priority})"))
+        if not victims:
+            out.blocked.setdefault(
+                g.key,
+                f"insufficient quota (needs {g.chips}, headroom "
+                f"{max(0, head or 0)}) and no lower-priority victims")
+
+    # ---- admission pass: strict (priority, arrival) order per queue,
+    # with bounded backfill past a blocked head
+    heads = {}          # (namespace, queue) -> blocked head Gang
+    bypass_new = {}     # head key -> pending bypass count
+    for g in pending:
+        qkey = (g.namespace, g.queue)
+        total = ledger.cohort_total(g.namespace)
+        if total is not None and g.chips > total:
+            # impossible footprint: never admissible, so it must not
+            # become a queue head and park everyone behind it forever
+            out.blocked[g.key] = (
+                f"needs {g.chips} chips but the cohort quota is only "
+                f"{total} — can never be admitted")
+            continue
+        head = heads.get(qkey)
+        if head is None:
+            if ledger.fits(g.namespace, g.chips):
+                ledger.charge(g.namespace, g.chips)
+                out.admit.append(g)
+            else:
+                heads[qkey] = g
+                out.blocked.setdefault(
+                    g.key, f"insufficient quota (needs {g.chips}, "
+                           f"headroom {max(0, ledger.headroom(g.namespace) or 0)})")
+            continue
+        # behind a blocked head: backfill only while the head's
+        # anti-starvation budget lasts
+        spent = bypass_new.get(head.key, head.bypass)
+        if spent >= max_bypass:
+            out.blocked.setdefault(
+                g.key, f"queue blocked behind {head.name} "
+                       f"(backfill budget exhausted)")
+            continue
+        if ledger.fits(g.namespace, g.chips):
+            ledger.charge(g.namespace, g.chips)
+            out.admit.append(g)
+            bypass_new[head.key] = spent + 1
+        else:
+            out.blocked.setdefault(
+                g.key, f"insufficient quota behind {head.name}")
+    out.bypass = bypass_new
+
+    # ---- positions + reservations
+    admitted_now = {g.key for g in out.admit}
+    counters = {}
+    for g in pending:
+        if g.key in admitted_now:
+            continue
+        qkey = (g.namespace, g.queue)
+        counters[qkey] = counters.get(qkey, 0) + 1
+        out.positions[g.key] = counters[qkey]
+    for head in heads.values():
+        room = ledger.headroom(head.namespace)
+        if room is None:
+            continue
+        out.reserved[head.namespace] = (
+            out.reserved.get(head.namespace, 0)
+            + min(max(0, room), head.chips))
+    return out

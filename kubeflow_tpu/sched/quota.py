@@ -1,0 +1,111 @@
+"""Chip-quota ledger — the capacity half of the admission queue.
+
+Quotas are keyed by namespace because the platform's tenancy unit is
+the Profile (controllers/profile.py): a Profile's
+``spec.resourceQuotaSpec.hard["google.com/tpu"]`` is the tenant's
+*nominal* chip quota. Cohorts (Kueue semantics) let tenants borrow:
+namespaces sharing a cohort pool their nominal chips, and any member
+may run past its own nominal as long as the cohort total holds. A
+namespace with no nominal quota is unconstrained (admission always
+fits) and neither lends to nor borrows from anyone.
+
+The ledger is a pure value object: the planner charges admitted gangs
+into it and asks ``fits``; nothing here touches the store.
+"""
+
+#: Profile annotation naming the cohort a tenant's quota pools into
+COHORT_ANNOTATION = "scheduling.kubeflow.org/cohort"
+
+
+class QuotaLedger:
+    """Tracks chips in use per namespace against nominal quotas.
+
+    ``nominal``: {namespace: chips or None} — None means unconstrained.
+    ``cohorts``: {namespace: cohort-name} — absent means the namespace
+    pools only with itself.
+    """
+
+    def __init__(self, nominal=None, cohorts=None):
+        self.nominal = dict(nominal or {})
+        self.cohorts = dict(cohorts or {})
+        self._used = {}
+
+    def cohort_of(self, namespace):
+        return self.cohorts.get(namespace) or f"ns:{namespace}"
+
+    def members(self, namespace):
+        """Namespaces pooling quota with ``namespace`` (inclusive).
+        Only quota-carrying members count — an unconstrained namespace
+        has nothing to lend and no reason to borrow."""
+        cohort = self.cohort_of(namespace)
+        out = {namespace}
+        for ns, c in self.cohorts.items():
+            if c == cohort and self.nominal.get(ns) is not None:
+                out.add(ns)
+        return out
+
+    # ------------------------------------------------------------ charging
+
+    def charge(self, namespace, chips):
+        self._used[namespace] = self._used.get(namespace, 0) + int(chips)
+
+    def release(self, namespace, chips):
+        self._used[namespace] = max(
+            0, self._used.get(namespace, 0) - int(chips))
+
+    def used(self, namespace):
+        return self._used.get(namespace, 0)
+
+    # ------------------------------------------------------------ capacity
+
+    def cohort_total(self, namespace):
+        """Pooled nominal chips of the namespace's cohort, or None when
+        the namespace itself is unconstrained."""
+        if self.nominal.get(namespace) is None:
+            return None
+        return sum(self.nominal[ns] or 0 for ns in self.members(namespace))
+
+    def cohort_used(self, namespace):
+        return sum(self.used(ns) for ns in self.members(namespace))
+
+    def headroom(self, namespace):
+        """Chips still admissible for the namespace right now (own
+        nominal plus whatever cohort peers leave unused), or None when
+        unconstrained."""
+        total = self.cohort_total(namespace)
+        if total is None:
+            return None
+        return total - self.cohort_used(namespace)
+
+    def ceiling(self, namespace):
+        """What the namespace could hold in total at this instant:
+        its current usage plus headroom. None when unconstrained."""
+        head = self.headroom(namespace)
+        if head is None:
+            return None
+        return self.used(namespace) + max(0, head)
+
+    def max_ceiling(self, namespace):
+        """The largest footprint this namespace could EVER admit — the
+        full cohort pool with every peer idle. A gang above this can
+        never be admitted regardless of churn (the 422 guard in
+        web/slices.py). None when unconstrained."""
+        return self.cohort_total(namespace)
+
+    def fits(self, namespace, chips):
+        head = self.headroom(namespace)
+        return True if head is None else int(chips) <= head
+
+    def report(self, namespace, reserved=0):
+        """Quota usage snapshot for one namespace — the shape the
+        ``sched_quota_chips`` gauge and web/queues.py serve."""
+        head = self.headroom(namespace)
+        free = None if head is None else max(0, head - reserved)
+        return {
+            "nominal": self.nominal.get(namespace),
+            "cohort": self.cohorts.get(namespace),
+            "used": self.used(namespace),
+            "reserved": reserved,
+            "free": free,
+            "ceiling": self.ceiling(namespace),
+        }

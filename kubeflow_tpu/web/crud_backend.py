@@ -71,12 +71,12 @@ CLUSTER_ROLES = {
     "kubeflow-admin": {"verbs": _EDIT_VERBS, "resources": {"*"}},
     "kubeflow-edit": {"verbs": _EDIT_VERBS, "resources": {
         "notebooks", "tensorboards", "persistentvolumeclaims",
-        "poddefaults", "tpuslices", "studyjobs", "pods", "pods/log",
-        "events", "configmaps", "secrets", "services"}},
+        "poddefaults", "tpuslices", "studyjobs", "queues", "pods",
+        "pods/log", "events", "configmaps", "secrets", "services"}},
     "kubeflow-view": {"verbs": _VIEW_VERBS, "resources": {
         "notebooks", "tensorboards", "persistentvolumeclaims",
-        "poddefaults", "tpuslices", "studyjobs", "pods", "pods/log",
-        "events", "configmaps", "services"}},
+        "poddefaults", "tpuslices", "studyjobs", "queues", "pods",
+        "pods/log", "events", "configmaps", "services"}},
     "cluster-admin": {"verbs": _EDIT_VERBS | {"*"}, "resources": {"*"}},
 }
 
@@ -99,6 +99,7 @@ RESOURCE_GROUPS = {
     "notebooks": "kubeflow.org", "tensorboards": "kubeflow.org",
     "poddefaults": "kubeflow.org", "profiles": "kubeflow.org",
     "tpuslices": "kubeflow.org", "studyjobs": "kubeflow.org",
+    "queues": "kubeflow.org",
 }
 
 

@@ -1,0 +1,86 @@
+"""Queues web app backend — admission-queue visibility per namespace.
+
+The management surface for the gang-aware admission queue (sched/):
+per-namespace quota usage (used / reserved / free chips, cohort), each
+queue's entries with their state and 1-based queue position, and a
+per-workload drill-down. Read-only by design: admission decisions
+belong to the QueueReconciler; operators influence them through the
+workload spec (queue / priority / suspend), not this API.
+
+Positions and quota math come from the SAME snapshot+planner the
+scheduler runs (sched.controller.build_state + sched.queue.plan), so
+what this app reports is exactly what the next scheduling pass sees.
+"""
+
+from ..sched import controller as schedctl
+from ..sched import queue as squeue
+from . import crud_backend as cb
+from .http import HTTPError
+
+
+def _state_of(gang, workload_phase):
+    if gang.terminal:
+        return workload_phase or "Terminal"
+    if gang.suspended:
+        return "Suspended"
+    if gang.releasing:
+        return "Releasing"
+    if gang.admitted:
+        return "Admitted"
+    return "Queued"
+
+
+def _entry(gang, obj, positions):
+    status = obj.get("status") or {}
+    admission = status.get("admission") or {}
+    return {
+        "name": gang.name,
+        "kind": gang.kind,
+        "namespace": gang.namespace,
+        "queue": gang.queue,
+        "chips": gang.chips,
+        "priority": gang.priority,
+        "state": _state_of(gang, status.get("phase")),
+        "phase": status.get("phase", ""),
+        "position": positions.get(gang.key),
+        "bypass": admission.get("bypass", 0),
+        "reason": admission.get("reason", ""),
+        "admittedAt": admission.get("admittedAt", ""),
+    }
+
+
+def _namespace_view(store, ns):
+    gangs, ledger, objs = schedctl.build_state(store)
+    result = squeue.plan(gangs, ledger)
+    queues = {}
+    for g in sorted(gangs, key=lambda g: (g.queue, -g.priority, g.seq,
+                                          g.name)):
+        if g.namespace != ns or not g.managed:
+            continue
+        queues.setdefault(g.queue, []).append(
+            _entry(g, objs[g.key], result.positions))
+    return {
+        "quota": ledger.report(ns, result.reserved.get(ns, 0)),
+        "queues": [{"name": name, "entries": entries}
+                   for name, entries in sorted(queues.items())],
+    }
+
+
+def create_app(store):
+    app = cb.create_app("queues-web-app", store)
+
+    @app.get("/api/namespaces/<ns>/queues")
+    def list_queues(request, ns):
+        cb.ensure_authorized(store, request, "list", "queues", ns)
+        return cb.success(_namespace_view(store, ns))
+
+    @app.get("/api/namespaces/<ns>/queues/<name>")
+    def get_queue(request, ns, name):
+        cb.ensure_authorized(store, request, "get", "queues", ns)
+        view = _namespace_view(store, ns)
+        for q in view["queues"]:
+            if q["name"] == name:
+                return cb.success({"queue": q, "quota": view["quota"]})
+        raise HTTPError(404, f"queue {ns}/{name} has no entries")
+
+    return app

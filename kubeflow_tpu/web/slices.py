@@ -107,6 +107,24 @@ def create_app(store):
         except ValueError:
             raise HTTPError(400, f"invalid topology {topology!r} "
                                  f"(expected e.g. 2x2 or 2x2x4)")
+        # a queue-managed gang whose footprint exceeds the namespace's
+        # maximum quota ceiling (own nominal + full cohort pool) can
+        # NEVER be admitted — reject at submit instead of parking it
+        # Queued forever (422: the CR is well-formed, the quota refuses
+        # it). Slices without spec.queue bypass the admission queue and
+        # keep the legacy accept-then-ResourceQuota behavior.
+        from ..sched.controller import build_ledger, slice_footprint
+        chips = slice_footprint(ts.get("spec") or {})
+        ceiling = (build_ledger(store).max_ceiling(ns)
+                   if m.deep_get(ts, "spec", "queue") else None)
+        if ceiling is not None and chips > ceiling:
+            raise HTTPError(
+                422, f"gang footprint of {chips} chips "
+                     f"(topology {topology or '2x2'}) exceeds the "
+                     f"namespace quota ceiling of {ceiling} chips — "
+                     f"this slice can never be admitted; shrink the "
+                     f"topology or raise the Profile's google.com/tpu "
+                     f"quota")
         store.create(ts, dry_run=True)
         if request.query.get("dry_run", "").lower() != "true":
             store.create(ts)

@@ -46,6 +46,20 @@ class TestHistogram:
         text = reg.exposition()
         assert 'b_seconds_bucket{le="1"} 1' in text
 
+    def test_label_values_are_escaped_in_exposition(self):
+        """Label VALUES are arbitrary user text (spec.queue flows into
+        the sched_* families): quote/backslash/newline must be escaped
+        per Prometheus text 0.0.4 or one hostile queue name corrupts
+        every scrape of the process."""
+        reg = obsm.Registry()
+        c = reg.counter("esc_total", "h", ("queue",))
+        c.labels('a"b\\c\nd').inc()
+        text = reg.exposition()
+        assert 'esc_total{queue="a\\"b\\\\c\\nd"} 1' in text
+        # no raw newline leaked into the middle of a sample line
+        assert not any(line.startswith("d")
+                       for line in text.splitlines())
+
     def test_labeled_histogram(self):
         reg = obsm.Registry()
         h = reg.histogram("r_seconds", "h", ("app",), buckets=(1.0,))

@@ -98,6 +98,38 @@ class TestReconcile:
         assert store.try_get("v1", "ResourceQuota", papi.QUOTA_NAME,
                              "team-a") is None
 
+    def test_quota_removed_when_hard_emptied_in_place(self, store,
+                                                      manager):
+        """Both pruning transitions must delete (ISSUE 2 satellite):
+        the sibling test drops resourceQuotaSpec entirely; this one
+        keeps the key and empties ``hard`` after it had limits — the
+        kubectl-edit shape. A stale quota would keep budgeting chips
+        the admission queue then enforces against nothing."""
+        setup_manager(store, manager)
+        store.create(make_profile(quota={"cpu": "1",
+                                         "google.com/tpu": "8"}))
+        manager.run_sync()
+        assert store.try_get("v1", "ResourceQuota", papi.QUOTA_NAME,
+                             "team-a")
+        profile = store.get("kubeflow.org/v1", "Profile", "team-a")
+        profile["spec"]["resourceQuotaSpec"]["hard"] = {}
+        store.update(profile)
+        manager.run_sync()
+        assert store.try_get("v1", "ResourceQuota", papi.QUOTA_NAME,
+                             "team-a") is None
+        # hard: null (the other kubectl way to empty it) also prunes
+        store.create(make_profile(name="team-b",
+                                  quota={"google.com/tpu": "4"}))
+        manager.run_sync()
+        assert store.try_get("v1", "ResourceQuota", papi.QUOTA_NAME,
+                             "team-b")
+        profile = store.get("kubeflow.org/v1", "Profile", "team-b")
+        profile["spec"]["resourceQuotaSpec"]["hard"] = None
+        store.update(profile)
+        manager.run_sync()
+        assert store.try_get("v1", "ResourceQuota", papi.QUOTA_NAME,
+                             "team-b") is None
+
     def test_owner_annotation_repaired(self, store, manager):
         setup_manager(store, manager)
         store.create(make_profile())

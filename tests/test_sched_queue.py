@@ -1,0 +1,585 @@
+"""Admission-queue tests: quota ledger cohorts/borrowing, the pure
+planner (gang all-or-nothing, priority FIFO, bounded backfill,
+preemption victim ordering), and the full control loop against the
+fake apiserver — two gangs over quota never both hold pods, queue
+position is visible via the queues web app, admission follows
+completion, and a higher-priority arrival preempts and requeues.
+
+Marker-free on purpose (ISSUE 2 satellite): this whole module runs in
+tier-1.
+"""
+
+import random
+
+import pytest
+
+from kubeflow_tpu.api import profile as papi
+from kubeflow_tpu.api import tpuslice as tsapi
+from kubeflow_tpu.controllers import workload_runtime
+from kubeflow_tpu.controllers.tpuslice import (GANG_RESTARTS,
+                                               StudyJobReconciler,
+                                               TpuSliceReconciler)
+from kubeflow_tpu.core import meta as m
+from kubeflow_tpu.sched import QueueReconciler, QuotaLedger
+from kubeflow_tpu.sched import controller as schedctl
+from kubeflow_tpu.sched import queue as squeue
+from kubeflow_tpu.sched.quota import COHORT_ANNOTATION
+from kubeflow_tpu.web import http, queues as queues_web, slices as slices_web
+
+SLICE_API = f"{tsapi.GROUP}/{tsapi.VERSION}"
+
+
+def gang(name, chips, ns="team-a", queue="default", priority=0, seq=0,
+         **kw):
+    return squeue.Gang(key=f"TpuSlice/{ns}/{name}", namespace=ns,
+                       name=name, queue=queue, chips=chips,
+                       priority=priority, seq=seq, **kw)
+
+
+class TestQuotaLedger:
+    def test_nominal_bounds_admission(self):
+        led = QuotaLedger({"team-a": 8})
+        assert led.fits("team-a", 8)
+        led.charge("team-a", 8)
+        assert not led.fits("team-a", 1)
+        assert led.headroom("team-a") == 0
+
+    def test_no_quota_is_unconstrained(self):
+        led = QuotaLedger({})
+        led.charge("free-ns", 10_000)
+        assert led.fits("free-ns", 10_000)
+        assert led.headroom("free-ns") is None
+        assert led.max_ceiling("free-ns") is None
+
+    def test_cohort_borrowing(self):
+        led = QuotaLedger({"team-a": 8, "team-b": 8},
+                          {"team-a": "research", "team-b": "research"})
+        # a may run past its nominal 8 using b's idle chips
+        assert led.fits("team-a", 16)
+        led.charge("team-a", 16)
+        # pool exhausted: b can't start anything
+        assert not led.fits("team-b", 1)
+
+    def test_unquotaed_namespace_neither_lends_nor_borrows(self):
+        led = QuotaLedger({"team-a": 8},
+                          {"team-a": "research", "free-ns": "research"})
+        assert led.cohort_total("team-a") == 8
+        led.charge("free-ns", 100)      # unconstrained usage
+        assert led.fits("team-a", 8)    # ...doesn't eat a's pool
+
+    def test_report_shape(self):
+        led = QuotaLedger({"team-a": 8})
+        led.charge("team-a", 4)
+        rep = led.report("team-a", reserved=2)
+        assert rep == {"nominal": 8, "cohort": None, "used": 4,
+                       "reserved": 2, "free": 2, "ceiling": 8}
+
+
+class TestPlanner:
+    def test_gang_admission_is_all_or_nothing(self):
+        led = QuotaLedger({"team-a": 16})
+        a, b = gang("a", 16, seq=1), gang("b", 16, seq=2)
+        plan = squeue.plan([a, b], led)
+        assert [g.name for g in plan.admit] == ["a"]
+        assert plan.positions[b.key] == 1
+        assert "insufficient quota" in plan.blocked[b.key]
+
+    def test_priority_orders_the_queue(self):
+        led = QuotaLedger({"team-a": 8})
+        lo = gang("lo", 8, priority=0, seq=1)
+        hi = gang("hi", 8, priority=5, seq=2)
+        plan = squeue.plan([lo, hi], led)
+        assert [g.name for g in plan.admit] == ["hi"]
+
+    def test_fifo_within_priority(self):
+        led = QuotaLedger({"team-a": 8})
+        first = gang("first", 8, seq=1)
+        second = gang("second", 8, seq=2)
+        plan = squeue.plan([second, first], led)
+        assert [g.name for g in plan.admit] == ["first"]
+
+    def test_backfill_past_blocked_head_bumps_bypass(self):
+        led = QuotaLedger({"team-a": 12})
+        running = gang("running", 8, seq=1, admitted=True)
+        head = gang("head", 8, seq=2)       # needs 8, only 4 free
+        small = gang("small", 4, seq=3)     # fits the leftover
+        plan = squeue.plan([running, head, small], led)
+        assert [g.name for g in plan.admit] == ["small"]
+        assert plan.bypass == {head.key: 1}
+        assert plan.positions[head.key] == 1
+
+    def test_exhausted_bypass_budget_blocks_backfill(self):
+        led = QuotaLedger({"team-a": 12})
+        running = gang("running", 8, seq=1, admitted=True)
+        head = gang("head", 8, seq=2, bypass=squeue.MAX_BYPASS)
+        small = gang("small", 4, seq=3)
+        plan = squeue.plan([running, head, small], led)
+        assert plan.admit == []
+        assert "backfill budget exhausted" in plan.blocked[small.key]
+
+    def test_blocked_head_reserves_free_chips(self):
+        led = QuotaLedger({"team-a": 12})
+        running = gang("running", 8, seq=1, admitted=True)
+        head = gang("head", 8, seq=2, bypass=squeue.MAX_BYPASS)
+        plan = squeue.plan([running, head], led)
+        assert plan.reserved == {"team-a": 4}
+
+    def test_impossible_gang_never_blocks_the_queue(self):
+        led = QuotaLedger({"team-a": 8})
+        huge = gang("huge", 32, seq=1)
+        ok = gang("ok", 8, seq=2)
+        plan = squeue.plan([huge, ok], led)
+        assert [g.name for g in plan.admit] == ["ok"]
+        assert "can never be admitted" in plan.blocked[huge.key]
+        assert plan.bypass == {}    # admitting past it is not backfill
+
+    def test_preemption_picks_lowest_priority_then_youngest(self):
+        led = QuotaLedger({"team-a": 12})
+        v_old = gang("v-old", 4, seq=1, priority=0, admitted=True,
+                     admitted_seq=1)
+        v_young = gang("v-young", 4, seq=2, priority=0, admitted=True,
+                       admitted_seq=2)
+        v_mid = gang("v-mid", 4, seq=3, priority=5, admitted=True,
+                     admitted_seq=3)
+        hi = gang("hi", 8, seq=4, priority=10)
+        plan = squeue.plan([v_old, v_young, v_mid, hi], led)
+        names = [g.name for g, _ in plan.preempt]
+        # lowest priority first; within a priority the youngest
+        # admission goes first; the prio-5 victim is spared entirely
+        assert names == ["v-young", "v-old"]
+        assert plan.admit == []     # chips drain before the successor
+
+    def test_no_pointless_preemption(self):
+        led = QuotaLedger({"team-a": 12})
+        peer = gang("peer", 4, seq=1, priority=10, admitted=True,
+                    admitted_seq=1)     # equal priority: not a victim
+        victim = gang("victim", 4, seq=2, priority=0, admitted=True,
+                      admitted_seq=2)
+        hi = gang("hi", 12, seq=3, priority=10)
+        plan = squeue.plan([peer, victim, hi], led)
+        # even evicting every eligible victim (4 chips) cannot cover
+        # the 12-chip ask: nobody is evicted for nothing
+        assert plan.preempt == []
+        assert "no lower-priority victims" in plan.blocked[hi.key]
+
+    def test_releasing_chips_stay_charged(self):
+        led = QuotaLedger({"team-a": 16})
+        draining = gang("draining", 16, seq=1, releasing=True)
+        nxt = gang("next", 16, seq=2, priority=10)
+        plan = squeue.plan([draining, nxt], led)
+        assert plan.admit == []
+        assert plan.preempt == []
+        assert "drain" in plan.blocked[nxt.key]
+
+    def test_suspended_and_terminal_hold_nothing(self):
+        led = QuotaLedger({"team-a": 16})
+        parked = gang("parked", 16, seq=1, suspended=True)
+        done = gang("done", 16, seq=2, admitted=True, terminal=True)
+        fresh = gang("fresh", 16, seq=3)
+        plan = squeue.plan([parked, done, fresh], led)
+        assert [g.name for g in plan.admit] == ["fresh"]
+
+    def test_unmanaged_gang_charges_but_never_queues(self):
+        led = QuotaLedger({"team-a": 16})
+        legacy = gang("legacy", 8, seq=0, managed=False, admitted=True)
+        queued = gang("queued", 16, seq=1)
+        plan = squeue.plan([legacy, queued], led)
+        assert plan.admit == []     # legacy's 8 chips are real
+        assert plan.positions[queued.key] == 1
+
+
+class TestPlannerInvariants:
+    """Randomized-arrival battery (ISSUE 2 satellite): drive a
+    simulated cluster through plan() rounds and assert the fairness
+    invariants — quota never oversubscribed, the head is bypassed at
+    most MAX_BYPASS times, arrival order holds within a priority
+    class, and everything eventually admits once arrivals stop."""
+
+    QUOTA = 16
+
+    def _simulate(self, rng, rounds=120, arrival_stop=60):
+        world = {}      # name -> dict(chips, priority, seq, admitted,
+                        #              admitted_seq, bypass, done)
+        seq = adm_seq = 0
+        admitted_order = []
+        max_bypass_seen = 0
+        n = 0
+        for step in range(rounds):
+            if step < arrival_stop and rng.random() < 0.6:
+                n += 1
+                seq += 1
+                world[f"g{n}"] = {
+                    "chips": rng.choice([4, 4, 8, 16]),
+                    "priority": rng.choice([0, 0, 0, 1, 2]),
+                    "seq": seq, "admitted": False, "admitted_seq": 0,
+                    "bypass": 0, "done": False}
+            # random completions free quota
+            for w in world.values():
+                if w["admitted"] and not w["done"] and rng.random() < 0.35:
+                    w["done"] = True
+            gangs = {
+                name: gang(name, w["chips"], priority=w["priority"],
+                           seq=w["seq"], admitted=w["admitted"],
+                           admitted_seq=w["admitted_seq"],
+                           terminal=w["done"], bypass=w["bypass"])
+                for name, w in world.items()}
+            plan = squeue.plan(list(gangs.values()),
+                               QuotaLedger({"team-a": self.QUOTA}))
+            in_use = sum(w["chips"] for w in world.values()
+                         if w["admitted"] and not w["done"])
+            for g in plan.admit:
+                adm_seq += 1
+                world[g.name].update(admitted=True,
+                                     admitted_seq=adm_seq)
+                admitted_order.append(g.name)
+                in_use += g.chips
+            assert in_use <= self.QUOTA, "quota oversubscribed"
+            for key, count in plan.bypass.items():
+                name = key.rsplit("/", 1)[-1]
+                world[name]["bypass"] = count
+                max_bypass_seen = max(max_bypass_seen, count)
+                assert count <= squeue.MAX_BYPASS
+        return world, admitted_order, max_bypass_seen
+
+    @pytest.mark.parametrize("seed", [1, 7, 23, 99])
+    def test_head_never_starved_and_quota_respected(self, seed):
+        rng = random.Random(seed)
+        world, admitted_order, _ = self._simulate(rng)
+        # after arrivals stop and completions drain, EVERY gang was
+        # admitted — the bypass budget turned backfill off in time
+        assert all(w["admitted"] for w in world.values()), [
+            n for n, w in world.items() if not w["admitted"]]
+        # within one (priority, chips) class admission follows arrival
+        by_class = {}
+        for name in admitted_order:
+            w = world[name]
+            by_class.setdefault((w["priority"], w["chips"]),
+                                []).append(w["seq"])
+        for seqs in by_class.values():
+            assert seqs == sorted(seqs), by_class
+
+    def test_backfill_actually_happens(self):
+        # sanity against a vacuous invariant: some run does backfill
+        _, _, max_bypass = self._simulate(random.Random(5))
+        assert max_bypass >= 1
+
+
+# --------------------------------------------------------- integration
+
+
+def quota_profile(store, ns="team-a", chips=16, cohort=None):
+    prof = papi.new(ns, "alice@example.com",
+                    quota={"google.com/tpu": str(chips)})
+    if cohort:
+        m.set_annotation(prof, COHORT_ANNOTATION, cohort)
+    store.create(prof)
+
+
+def make_slice(name, topology="4x4", priority=None, queue="default",
+               ns="team-a", suspend=False):
+    return tsapi.new_slice(
+        name, ns, "tpu-v5-lite-podslice", topology,
+        {"containers": [{"name": "worker", "image": "jax-tpu:latest"}]},
+        queue=queue, priority=priority, suspend=suspend)
+
+
+def gang_pods(store, name, ns="team-a"):
+    """Live (chip-holding) gang pods: deleted or terminal pods have
+    released their devices and don't count against the invariant."""
+    return [p for p in store.list("v1", "Pod", ns,
+                                  label_selector={"tpu-slice": name})
+            if not m.deep_get(p, "metadata", "deletionTimestamp")
+            and m.deep_get(p, "status", "phase") not in ("Succeeded",
+                                                         "Failed")]
+
+
+def get_slice(store, name, ns="team-a"):
+    return store.get(SLICE_API, tsapi.SLICE_KIND, name, ns)
+
+
+class TestAdmissionControlLoop:
+    """The acceptance scenario against the fake apiserver: quota 16,
+    two 16-chip gangs."""
+
+    @pytest.fixture(autouse=True)
+    def _no_auth(self, monkeypatch):
+        monkeypatch.setenv("APP_DISABLE_AUTH", "true")
+        monkeypatch.setenv("APP_SECURE_COOKIES", "false")
+
+    def _mgr(self, store, manager):
+        manager.add(TpuSliceReconciler())
+        manager.add(StudyJobReconciler())
+        manager.add(workload_runtime.StatefulSetReconciler())
+        manager.add(workload_runtime.PodRuntimeReconciler())
+        manager.add(QueueReconciler())
+        manager.start_sync()
+        return manager
+
+    def _pump(self, store, manager, names, max_rounds=60):
+        """Drive to quiescence ONE round at a time, asserting after
+        every round that the over-quota gangs never hold pods
+        simultaneously."""
+        for _ in range(max_rounds):
+            progressed = manager.run_sync(max_rounds=1)
+            with_pods = [n for n in names if gang_pods(store, n)]
+            assert len(with_pods) <= 1, (
+                f"gangs {with_pods} hold pods simultaneously")
+            if not progressed:
+                return
+        raise AssertionError("controllers never went quiescent")
+
+    def test_second_gang_queues_then_admits_on_completion(
+            self, store, manager):
+        self._mgr(store, manager)
+        quota_profile(store, chips=16)
+        store.create(make_slice("gang-a"))
+        self._pump(store, manager, ["gang-a", "gang-b"])
+        assert get_slice(store, "gang-a")["status"]["phase"] == "Running"
+        assert len(gang_pods(store, "gang-a")) == 4
+
+        store.create(make_slice("gang-b"))
+        self._pump(store, manager, ["gang-a", "gang-b"])
+        b = get_slice(store, "gang-b")
+        assert b["status"]["phase"] == "Queued"
+        assert b["status"]["admission"]["admitted"] is False
+        assert gang_pods(store, "gang-b") == []
+
+        # queue position + quota usage visible through the web app
+        c = http.TestClient(queues_web.create_app(store))
+        r = c.get("/api/namespaces/team-a/queues")
+        assert r.status == 200
+        assert r.json["quota"]["used"] == 16
+        assert r.json["quota"]["nominal"] == 16
+        entries = {e["name"]: e
+                   for q in r.json["queues"] for e in q["entries"]}
+        assert entries["gang-b"]["state"] == "Queued"
+        assert entries["gang-b"]["position"] == 1
+        assert entries["gang-a"]["state"] == "Admitted"
+        assert entries["gang-a"]["position"] is None
+
+        # gang-a completes -> chips free -> gang-b admits automatically
+        for p in gang_pods(store, "gang-a"):
+            p["status"]["phase"] = "Succeeded"
+            store.update_status(p)
+        self._pump(store, manager, ["gang-b"])   # a's Succeeded pods stay
+        assert get_slice(store, "gang-a")["status"]["phase"] == "Succeeded"
+        b = get_slice(store, "gang-b")
+        assert b["status"]["phase"] == "Running"
+        assert b["status"]["admission"]["admitted"] is True
+        assert len(gang_pods(store, "gang-b")) == 4
+
+    def test_higher_priority_preempts_and_requeues(self, store, manager):
+        before = schedctl._PREEMPTED.value("default")
+        self._mgr(store, manager)
+        quota_profile(store, chips=16)
+        store.create(make_slice("low", priority=0))
+        self._pump(store, manager, ["low", "high"])
+        assert len(gang_pods(store, "low")) == 4
+
+        store.create(make_slice("high", priority=10))
+        self._pump(store, manager, ["low", "high"])
+
+        high = get_slice(store, "high")
+        assert high["status"]["phase"] == "Running"
+        assert len(gang_pods(store, "high")) == 4
+        low = get_slice(store, "low")
+        assert low["status"]["phase"] == "Queued"
+        assert low["status"]["admission"]["admitted"] is False
+        assert "preempted" in low["status"]["admission"]["lastPreemption"]
+        assert gang_pods(store, "low") == []
+        # requeued BEHIND high: the victim re-arrived, it didn't keep
+        # its original slot
+        assert low["status"]["admission"]["seq"] > \
+            high["status"]["admission"]["seq"]
+        events = [e for e in store.list("v1", "Event", "team-a")
+                  if e.get("reason") == "Preempted"]
+        assert events and "higher-priority" in events[0]["message"]
+        assert schedctl._PREEMPTED.value("default") == before + 1
+
+        # and the victim comes back once the preemptor finishes
+        for p in gang_pods(store, "high"):
+            p["status"]["phase"] = "Succeeded"
+            store.update_status(p)
+        self._pump(store, manager, ["high", "low"])
+        assert get_slice(store, "low")["status"]["phase"] == "Running"
+
+    def test_suspend_parks_then_release_admits(self, store, manager):
+        self._mgr(store, manager)
+        quota_profile(store, chips=16)
+        store.create(make_slice("parked", suspend=True))
+        self._pump(store, manager, ["parked"])
+        ts = get_slice(store, "parked")
+        assert ts["status"]["phase"] == "Suspended"
+        assert gang_pods(store, "parked") == []
+        del ts["spec"]["suspend"]
+        store.update(ts)
+        self._pump(store, manager, ["parked"])
+        assert get_slice(store, "parked")["status"]["phase"] == "Running"
+
+    def test_suspend_after_admission_revokes_and_readmits_via_queue(
+            self, store, manager):
+        """Suspending an ADMITTED gang must revoke its grant: the freed
+        chips go to the next gang, and un-suspending re-enters through
+        Queued (no stale admitted:true shortcut that would overcommit
+        the quota)."""
+        self._mgr(store, manager)
+        quota_profile(store, chips=16)
+        store.create(make_slice("gang-a"))
+        store.create(make_slice("gang-b"))
+        self._pump(store, manager, ["gang-a", "gang-b"])
+        assert len(gang_pods(store, "gang-a")) == 4
+        assert get_slice(store, "gang-b")["status"]["phase"] == "Queued"
+
+        a = get_slice(store, "gang-a")
+        a["spec"]["suspend"] = True
+        store.update(a)
+        self._pump(store, manager, ["gang-a", "gang-b"])
+        a = get_slice(store, "gang-a")
+        assert a["status"]["phase"] == "Suspended"
+        assert a["status"]["admission"]["admitted"] is False
+        assert get_slice(store, "gang-b")["status"]["phase"] == "Running"
+
+        a = get_slice(store, "gang-a")
+        del a["spec"]["suspend"]
+        store.update(a)
+        self._pump(store, manager, ["gang-a", "gang-b"])
+        # b still holds the quota: a must WAIT, not resume on the spot
+        a = get_slice(store, "gang-a")
+        assert a["status"]["phase"] == "Queued"
+        assert gang_pods(store, "gang-a") == []
+        for p in gang_pods(store, "gang-b"):
+            p["status"]["phase"] = "Succeeded"
+            store.update_status(p)
+        self._pump(store, manager, ["gang-a"])
+        assert get_slice(store, "gang-a")["status"]["phase"] == "Running"
+
+    def test_queued_study_launches_no_trials_until_admitted(
+            self, store, manager):
+        self._mgr(store, manager)
+        quota_profile(store, chips=16)
+        store.create(make_slice("gang-a"))
+        self._pump(store, manager, ["gang-a"])
+        study = tsapi.new_study(
+            "sweep", "team-a",
+            objective={"type": "maximize", "metricName": "acc"},
+            parameters=[{"name": "lr", "type": "double",
+                         "min": 0.01, "max": 0.1}],
+            trial_template={"spec": {"containers": [{
+                "name": "t", "image": "trial:1",
+                "args": ["--lr={{lr}}"]}]}},
+            max_trials=2, parallelism=2, queue="default")
+        store.create(study)
+        manager.run_sync()
+        got = store.get(SLICE_API, tsapi.STUDY_KIND, "sweep", "team-a")
+        assert got["status"]["phase"] == "Queued"
+        assert [p for p in store.list("v1", "Pod", "team-a")
+                if m.labels_of(p).get("studyjob")] == []
+
+        for p in gang_pods(store, "gang-a"):
+            p["status"]["phase"] = "Succeeded"
+            store.update_status(p)
+        manager.run_sync()
+        got = store.get(SLICE_API, tsapi.STUDY_KIND, "sweep", "team-a")
+        assert got["status"]["admission"]["admitted"] is True
+        trial_pods = [p for p in store.list("v1", "Pod", "team-a")
+                      if m.labels_of(p).get("studyjob") == "sweep"]
+        assert len(trial_pods) == 2
+
+    def test_admitted_counter_and_quota_gauge(self, store, manager):
+        before = schedctl._ADMITTED.value("default")
+        self._mgr(store, manager)
+        quota_profile(store, chips=16)
+        store.create(make_slice("gang-a"))
+        manager.run_sync()
+        assert schedctl._ADMITTED.value("default") == before + 1
+        assert schedctl._QUEUE_WAIT.value("default") >= 1
+        assert schedctl._QUOTA_CHIPS.value("team-a", "used") == 16
+        assert schedctl._QUOTA_CHIPS.value("team-a", "free") == 0
+
+    def test_unmanaged_slice_still_charges_the_ledger(self, store,
+                                                      manager):
+        """A legacy slice (no spec.queue) bypasses the queue but its
+        chips are real: a queue-managed gang behind it must wait."""
+        self._mgr(store, manager)
+        quota_profile(store, chips=16)
+        legacy = tsapi.new_slice(
+            "legacy", "team-a", "tpu-v5-lite-podslice", "4x4",
+            {"containers": [{"name": "w", "image": "i"}]})
+        store.create(legacy)
+        store.create(make_slice("managed"))
+        self._pump(store, manager, ["managed"])   # legacy is exempt
+        assert len(gang_pods(store, "legacy")) == 4
+        got = get_slice(store, "managed")
+        assert got["status"]["phase"] == "Queued"
+        assert gang_pods(store, "managed") == []
+
+
+class TestGangRestartCounter:
+    def test_counter_increments_with_event(self, store, manager):
+        from kubeflow_tpu.controllers.admission import PodDefaultWebhook
+        PodDefaultWebhook(store).install()
+        manager.add(TpuSliceReconciler())
+        manager.add(workload_runtime.StatefulSetReconciler())
+        manager.add(workload_runtime.PodRuntimeReconciler())
+        manager.start_sync()
+        before = GANG_RESTARTS.value("default", "s1")
+        store.create(tsapi.new_slice(
+            "s1", "default", "tpu-v5-lite-podslice", "4x4",
+            {"containers": [{"name": "w", "image": "i"}]}))
+        manager.run_sync()
+        pod = store.get("v1", "Pod", "s1-2", "default")
+        pod["status"] = {"phase": "Failed", "containerStatuses": [
+            {"name": "w", "ready": False, "restartCount": 0,
+             "state": {"terminated": {"exitCode": 17}}}]}
+        store.update(pod)
+        manager.run_sync()
+        assert GANG_RESTARTS.value("default", "s1") == before + 1
+        ts = store.get(SLICE_API, tsapi.SLICE_KIND, "s1", "default")
+        assert ts["status"]["restartCount"] == 1
+
+
+class TestPostSliceQuotaCeiling:
+    """web/slices.py satellite: a gang that can NEVER be admitted is a
+    422 at submit, naming the ceiling."""
+
+    @pytest.fixture(autouse=True)
+    def _no_auth(self, monkeypatch):
+        monkeypatch.setenv("APP_DISABLE_AUTH", "true")
+        monkeypatch.setenv("APP_SECURE_COOKIES", "false")
+
+    def _post(self, store, topology, ns="team-a"):
+        c = http.TestClient(slices_web.create_app(store))
+        body = tsapi.new_slice("big", ns, "tpu-v5-lite-podslice",
+                               topology, {"containers": [{}]},
+                               queue="default")
+        return c.post(f"/api/namespaces/{ns}/tpuslices", json_body=body)
+
+    def test_over_ceiling_is_422_naming_the_ceiling(self, store):
+        quota_profile(store, chips=8)
+        r = self._post(store, "4x4")        # 16 chips > 8 ceiling
+        assert r.status == 422
+        assert "16 chips" in r.json["log"]
+        assert "ceiling of 8" in r.json["log"]
+        assert store.try_get(SLICE_API, tsapi.SLICE_KIND, "big",
+                             "team-a") is None
+
+    def test_cohort_borrowing_raises_the_ceiling(self, store):
+        quota_profile(store, ns="team-a", chips=8, cohort="research")
+        quota_profile(store, ns="team-b", chips=8, cohort="research")
+        r = self._post(store, "4x4")        # 16 <= 8+8 pooled
+        assert r.status == 200
+
+    def test_no_quota_accepts_any_topology(self, store):
+        r = self._post(store, "8x8")
+        assert r.status == 200
+
+    def test_unmanaged_slice_keeps_legacy_accept_behavior(self, store):
+        """No spec.queue -> the admission queue never gates it, so the
+        'can never be admitted' rejection does not apply; the passive
+        ResourceQuota remains the only governor (legacy behavior)."""
+        quota_profile(store, chips=8)
+        c = http.TestClient(slices_web.create_app(store))
+        body = tsapi.new_slice("big", "team-a", "tpu-v5-lite-podslice",
+                               "4x4", {"containers": [{}]})
+        r = c.post("/api/namespaces/team-a/tpuslices", json_body=body)
+        assert r.status == 200
