@@ -412,6 +412,89 @@ def bench_serving(steps, batch):
         ka_lat = sorted(ka_post() for _ in range(steps))
         ka.close()
 
+        # raw octet-stream unary (application/x-tensor): dtype/shape in
+        # headers, body is the little-endian buffer — no JSON parse, no
+        # base64 on either leg. Same keep-alive discipline as ka_post
+        # so the delta vs b64_keepalive isolates the codec cost.
+        raw_body = arr.tobytes()
+
+        def raw_headers(a):
+            return {"Content-Type": "application/x-tensor",
+                    "X-Tensor-Dtype": str(a.dtype),
+                    "X-Tensor-Shape": ",".join(str(d) for d in a.shape)}
+
+        def raw_post(conn, body=raw_body, headers=None):
+            t1 = time.perf_counter()
+            conn.request("POST", "/v1/models/resnet50:predict",
+                         body, headers or raw_headers(arr))
+            r = conn.getresponse()
+            r.read()
+            if r.status != 200:
+                raise RuntimeError(f"raw predict HTTP {r.status}")
+            return time.perf_counter() - t1
+
+        rawc = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=120)
+        raw_post(rawc)                       # warm on this socket
+        raw_lat = sorted(raw_post(rawc) for _ in range(steps))
+        rawc.close()
+
+        # cross-request continuous batching: concurrent keep-alive
+        # clients on the raw path; the batcher coalesces their unary
+        # requests into shape-bucketed device batches. Occupancy comes
+        # from the serving_batch_occupancy_requests histogram (delta
+        # over the concurrent window). Warm EVERY padded bucket the
+        # coalesced windows can land on (batch..n_clients*batch rows,
+        # capped by max_batch) so no XLA compile lands inside the
+        # timed run.
+        import threading as _threading
+        n_clients, per_client = 8, max(4, steps // 2)
+        # window cap comes from the served model's batcher, not a
+        # duplicated constant — warm-up and dispatch stay in lockstep
+        batcher = server.models()["resnet50"]._batcher
+        max_rows = batcher.max_batch if batcher else 64
+        lo = serving.bucket_for(batch)
+        hi = serving.bucket_for(min(max_rows, n_clients * batch))
+        wc = http.client.HTTPConnection("127.0.0.1", port, timeout=600)
+        for b in serving.BATCH_BUCKETS:
+            if lo <= b <= hi:
+                wa = np.repeat(arr, (b + batch - 1) // batch,
+                               axis=0)[:b]
+                raw_post(wc, wa.tobytes(), raw_headers(wa))
+        wc.close()
+        occ_hist = serving._BATCH_OCCUPANCY.samples().get(
+            ("resnet50", "stable"), {"sum": 0.0, "count": 0})
+        occ0_sum, occ0_n = occ_hist["sum"], occ_hist["count"]
+        errors = []
+
+        def client():
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=300)
+                for _ in range(per_client):
+                    raw_post(conn)
+                conn.close()
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        workers = [_threading.Thread(target=client)
+                   for _ in range(n_clients)]
+        t1 = time.perf_counter()
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        conc_dt = time.perf_counter() - t1
+        if errors:
+            raise RuntimeError(
+                f"concurrent raw predict failed: {errors[0]}")
+        occ_hist = serving._BATCH_OCCUPANCY.samples().get(
+            ("resnet50", "stable"), {"sum": 0.0, "count": 0})
+        occ_n = occ_hist["count"] - occ0_n
+        occ_mean = ((occ_hist["sum"] - occ0_sum) / occ_n
+                    if occ_n else 1.0)
+        conc_pps = n_clients * per_client * batch / conc_dt
+
         # int8 accuracy delta vs the fp32 model on the identical input
         fp32_probs = np.asarray(predict(arr))
         int8_probs = np.asarray(predict_int8(arr))
@@ -450,6 +533,21 @@ def bench_serving(steps, batch):
                            1000 * ka_lat[len(ka_lat) // 2], 1),
                        "b64_keepalive_predictions_per_sec": round(
                            steps * batch / sum(ka_lat), 1),
+                       # raw application/x-tensor octet stream, keep-
+                       # alive: the wire-cheap unary path (no JSON, no
+                       # base64) — p50 minus infer_p50 is the residual
+                       # wire overhead
+                       "raw_p50_ms": round(
+                           1000 * raw_lat[len(raw_lat) // 2], 1),
+                       "raw_predictions_per_sec": round(
+                           steps * batch / sum(raw_lat), 1),
+                       # 8 concurrent keep-alive raw clients: cross-
+                       # request continuous batching coalesces their
+                       # unary requests (occupancy 1.0 = no coalescing)
+                       "concurrent_raw_clients": n_clients,
+                       "concurrent_raw_predictions_per_sec": round(
+                           conc_pps, 1),
+                       "batch_occupancy_mean": round(occ_mean, 2),
                        # pipelined NDJSON stream (one connection,
                        # dispatch overlapped with decode) — the r4
                        # throughput rung

@@ -36,13 +36,23 @@ def main():
     problems = obs_metrics.REGISTRY.lint() + scratch.lint()
     checked = len(obs_metrics.REGISTRY._metrics) + len(scratch._metrics)
 
-    # drift guard for the scheduler + gang domains: these families are
-    # what docs/scheduling.md and the queue dashboards promise exist —
-    # a rename or accidental drop must fail the build, not the scrape
+    # drift guard for the scheduler + gang + serving domains: these
+    # families are what docs/scheduling.md, docs/observability.md and
+    # the dashboards promise exist — a rename or accidental drop must
+    # fail the build, not the scrape
     required = {
         "sched_admitted_total", "sched_preempted_total",
         "sched_queue_wait_seconds", "sched_quota_chips",
         "tpuslice_gang_restarts_total",
+        # serving wire + batching surface (docs/observability.md;
+        # bench.py reads serving_batch_occupancy_requests directly)
+        "serving_request_duration_seconds",
+        "serving_batch_queue_wait_seconds",
+        "serving_batch_size_rows",
+        "serving_drain_timeout_total",
+        "serving_decode_seconds",
+        "serving_wire_format_total",
+        "serving_batch_occupancy_requests",
     }
     registered = {metric.name for metric in obs_metrics.REGISTRY._metrics}
     for name in sorted(required - registered):

@@ -12,6 +12,7 @@ this module is the contract its PVC-mounted datasets plug into.
 
 import queue
 import threading
+import time
 
 import jax
 import numpy as np
@@ -43,21 +44,38 @@ def shard_batch(batch, mesh, spec=BATCH_SPEC):
 
 class Prefetcher:
     """Wrap a host-batch iterator; overlap host→HBM transfer with
-    compute by staying ``depth`` batches ahead."""
+    compute by staying ``depth`` batches ahead.
+
+    Supports the context-manager protocol: an abandoned iterator (early
+    ``break``, an exception in the training loop) would otherwise leak
+    the pump thread blocked forever on its full queue — ``with``
+    (or an explicit :meth:`close`) unblocks and joins it."""
 
     _DONE = object()
 
     def __init__(self, iterator, mesh, spec=BATCH_SPEC, depth=2):
         self._q = queue.Queue(maxsize=depth)
         self._err = None
+        self._closed = False
 
         def pump():
             try:
                 for item in iterator:
+                    if self._closed:
+                        return
                     self._q.put(shard_batch(item, mesh, spec))
+                    # re-check AFTER the (blocking) put: close() is
+                    # what unblocked it, and pulling one more item
+                    # would consume a batch from the source (and block
+                    # close() for a full production cycle on a slow
+                    # loader)
+                    if self._closed:
+                        return
             except Exception as e:  # surfaced on next()
                 self._err = e
             finally:
+                # close() keeps draining until this thread exits, so
+                # this put cannot wedge even on a full queue
                 self._q.put(self._DONE)
 
         self._thread = threading.Thread(target=pump, daemon=True)
@@ -67,12 +85,43 @@ class Prefetcher:
         return self
 
     def __next__(self):
+        if self._closed:
+            raise StopIteration
         item = self._q.get()
         if item is self._DONE:
             if self._err is not None:
                 raise self._err
             raise StopIteration
         return item
+
+    def close(self, timeout=5.0):
+        """Stop the pump thread: drain the queue to unblock a put on
+        a full queue, and join. Idempotent; safe after exhaustion.
+        Don't call concurrently with ``next()`` — close() consumes the
+        queue the consumer is waiting on.
+
+        ``timeout`` bounds the join: a pump wedged INSIDE the source
+        iterator (a stalled PVC/network read) cannot be interrupted,
+        and close() must not hang the caller's exit path on it — the
+        daemon thread is abandoned after the deadline (it dies with
+        the process)."""
+        self._closed = True
+        deadline = time.monotonic() + timeout
+        while self._thread.is_alive():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+            if time.monotonic() > deadline:
+                break
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 # ----------------------------------------------------- synthetic sources

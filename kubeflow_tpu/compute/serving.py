@@ -10,17 +10,30 @@ substrate:
 - per-model jitted predict fn (bf16 on MXU, donation-free, batched),
 - dynamic-batch bucketing to a few padded sizes so XLA compiles a
   handful of programs instead of one per request shape,
+- cross-request continuous batching ON BY DEFAULT: concurrent unary
+  requests (one ``ThreadingHTTPServer`` worker thread each, separate
+  keep-alive connections) coalesce into shape-bucketed device batches,
+  and the decode/collect of request group N overlaps device execution
+  of group N−1 — the double-buffered dispatch the stream route
+  pioneered, promoted to the unary path,
 - ``/v1/models/<name>`` status endpoint for readiness probes,
-- a binary tensor encoding riding the same route: JSON float lists
-  dominate predict latency at image sizes (BASELINE.md: ~60 ms device
-  vs ~150 ms p50), so in the spirit of TF-Serving's ``{"b64": ...}``
-  convention the body may carry the whole batch as
-  ``{"tensor": {"dtype", "shape", "b64"}}`` (base64 of the raw
-  little-endian buffer) and the response mirrors it. The reference
-  ``instances`` contract is untouched.
+- two binary tensor encodings riding the same route (the reference
+  ``instances`` contract is untouched — JSON float lists dominate
+  predict latency at image sizes, BASELINE.md: ~60 ms device vs
+  ~150 ms p50):
+
+  * ``{"tensor": {"dtype", "shape", "b64"}}`` — TF-Serving's
+    ``{"b64": ...}`` spirit: base64 of the raw little-endian buffer
+    inside the JSON body, mirrored on the response;
+  * ``Content-Type: application/x-tensor`` — the wire-cheap unary
+    path: dtype/shape ride ``X-Tensor-Dtype``/``X-Tensor-Shape``
+    headers and the body IS the little-endian buffer,
+    ``np.frombuffer`` straight off the socket with no JSON parse and
+    no base64 on either leg; the response mirrors the format.
 """
 
 import base64
+import collections
 import json
 import logging
 import queue
@@ -64,6 +77,26 @@ _DRAIN_TIMEOUT_TOTAL = obs_metrics.REGISTRY.counter(
     "Retired model batchers whose drain did not finish within the "
     "join window (unload skipped, copy left resident)",
     ("model",))
+_DECODE_SECONDS = obs_metrics.REGISTRY.histogram(
+    "serving_decode_seconds",
+    "Host time to turn one predict request body into an ndarray "
+    "(format: json = float lists, b64 = base64 tensor, binary = raw "
+    "octet-stream)",
+    ("format",),
+    buckets=(1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 0.01, 0.025,
+             0.05, 0.1, 0.25, 1.0))
+_WIRE_FORMAT_TOTAL = obs_metrics.REGISTRY.counter(
+    "serving_wire_format_total",
+    "Successfully decoded predict payloads by wire format "
+    "(json | b64 | binary; stream lines count per line)",
+    ("format",))
+_BATCH_OCCUPANCY = obs_metrics.REGISTRY.histogram(
+    "serving_batch_occupancy_requests",
+    "Requests coalesced into one device dispatch by cross-request "
+    "batching (1 = no coalescing; the continuous-batching win is this "
+    "distribution's mass above 1 under concurrent load)",
+    ("model", "track"),
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64))
 
 #: dtypes accepted on the binary tensor path (little-endian raw bytes)
 TENSOR_DTYPES = {"float32", "float16", "int32", "int8", "uint8"}
@@ -72,21 +105,46 @@ TENSOR_DTYPES = {"float32", "float16", "int32", "int8", "uint8"}
 BATCH_BUCKETS = (1, 8, 16, 32, 64, 256)
 
 
-class _Batcher:
-    """Dynamic request batching (TF-Serving's batching layer): coalesce
-    concurrent predict calls into one device invocation. Requests are
-    grouped by item shape; the window closes at ``max_batch`` rows or
-    ``timeout_s`` after the first request, whichever first."""
+def bucket_for(n):
+    """Smallest padded batch bucket that fits ``n`` rows (``n`` itself
+    past the largest bucket) — the ONE bucketing policy, shared by
+    dispatch and the bench/loadtest warm-up loops (which pre-compile
+    every bucket a timed run can land on)."""
+    return next((b for b in BATCH_BUCKETS if b >= n), n)
 
-    def __init__(self, run_fn, max_batch=64, timeout_s=0.005,
-                 owner=None):
-        self.run = run_fn             # (ndarray) -> ndarray
+
+class _Batcher:
+    """Cross-request continuous batching (TF-Serving's batching layer,
+    continuous-batching flavor): concurrent predict calls — one per
+    ``ThreadingHTTPServer`` worker thread on separate keep-alive
+    connections — coalesce into shape-bucketed device batches, and the
+    collect/decode of window N overlaps device execution of window N−1
+    (the double-buffered dispatch the stream route uses, promoted to
+    the unary path).
+
+    Window policy: with nothing in flight a request dispatches as soon
+    as the queue runs dry — a lone caller never pays the batching
+    timeout. While a batch executes, arrivals accumulate (the device
+    is busy anyway) until ``max_batch`` rows or ``timeout_s`` after
+    the window opened, whichever first. Slots bucket by item
+    shape+dtype inside the window (dtype matters: the tensor path can
+    carry uint8 etc., and ``np.concatenate`` would silently promote —
+    results must not depend on concurrent traffic); each bucket is one
+    device dispatch."""
+
+    def __init__(self, dispatch_fn, finalize_fn, max_batch=64,
+                 timeout_s=0.005, owner=None):
+        self.dispatch = dispatch_fn   # (ndarray) -> (device_future, n)
+        self.finalize = finalize_fn   # (device_future, n) -> ndarray
         self.max_batch = max_batch
         self.timeout_s = timeout_s
         self.owner = owner            # ServedModel, for metric labels
         self.q = queue.Queue()
         self._stop = False
         self._accepting = True
+        self._graceful_stop = False      # version transition, not a
+        self._dead = threading.Event()   # shutdown; loop has exited
+        self._inflight = collections.deque()  # dispatched, unfetched
         self.thread = threading.Thread(target=self._loop, daemon=True,
                                        name="serving-batcher")
         self.thread.start()
@@ -94,26 +152,25 @@ class _Batcher:
     def submit(self, x):
         """Blocking: returns (result_rows, device_ms_of_the_batch).
 
-        TOCTOU note: the ``_accepting``/``is_alive`` check below and
-        the ``q.put`` are not atomic — ``stop()`` can flip
-        ``_accepting`` (or the loop thread can exit) between them, so
-        a slot may land in the queue after the check passed. That is
-        safe, not racy-by-accident: the loop's ``finally`` runs
-        ``_drain()``, which errors out every queued slot, and the
-        wait below re-checks thread liveness — so a late submit either
-        completes (graceful stop still flushes the FIFO) or raises
-        "batcher stopped"; it never hangs. The up-front check is only
-        a fast-fail courtesy, not the correctness boundary."""
-        if not self._accepting or not self.thread.is_alive():
+        TOCTOU note: the ``_accepting``/``_dead`` check below and the
+        ``q.put`` are not atomic — ``stop()`` can flip ``_accepting``
+        (or the loop thread can die) between them. That is safe: the
+        loop's ``finally`` sets ``_dead`` BEFORE it drains, so a late
+        submit either lands in a queue the loop still drains (every
+        drained slot errors out) or observes ``_dead`` after its put
+        and drains the queue itself — either way the slot resolves and
+        the wait below cannot hang, with a dead loop surfacing
+        immediately instead of on a liveness poll."""
+        if not self._accepting or self._dead.is_set():
             raise RuntimeError("batcher stopped")
         done = threading.Event()
         slot = {"x": x, "done": done, "t": time.perf_counter()}
         self.q.put(slot)
-        # never block forever: if the loop thread dies between the
-        # liveness check above and the put, nothing will drain the slot
-        while not done.wait(0.5):
-            if not self.thread.is_alive() and not done.is_set():
-                raise RuntimeError("batcher stopped")
+        if self._dead.is_set():
+            # loop exited between the check and the put: its drain may
+            # have missed our slot — drain is idempotent, run it here
+            self._drain()
+        done.wait()
         if "error" in slot:
             raise slot["error"]
         return slot["out"], slot["ms"]
@@ -121,22 +178,42 @@ class _Batcher:
     def _loop(self):
         try:
             while not self._stop:
-                try:
-                    first = self.q.get(timeout=0.1)
-                except queue.Empty:
-                    continue
+                if self._inflight:
+                    # a batch is on the device: take more work if any
+                    # is already queued, else retire the oldest batch
+                    try:
+                        first = self.q.get_nowait()
+                    except queue.Empty:
+                        self._finalize_one()
+                        continue
+                else:
+                    try:
+                        first = self.q.get(timeout=0.1)
+                    except queue.Empty:
+                        continue
                 if first is None:
                     return
-                # everything below must never kill the thread: a dead
-                # batcher would hang every future predict on the model
+                # must never kill the thread: a dead batcher would
+                # hang every future predict on the model
                 try:
-                    self._collect_and_run(first)
-                except Exception as e:  # noqa: BLE001 — keep serving
-                    if "done" in first and not first["done"].is_set():
-                        first["error"] = e
-                        first["done"].set()
+                    self._collect_and_dispatch(first)
+                except Exception:  # noqa: BLE001 — keep serving
+                    pass   # every taken slot was resolved in the
+                           # collect's finally
         finally:
-            self._drain()
+            # order matters: set _dead first so a submit racing the
+            # exit sees it after its put and self-drains — no slot can
+            # land unobserved after the drain below runs
+            self._dead.set()
+            try:
+                while self._inflight:
+                    try:
+                        self._finalize_one()
+                    except BaseException:  # noqa: BLE001 — teardown:
+                        pass   # its finally resolved the group; keep
+                               # retiring the rest so no caller hangs
+            finally:
+                self._drain()
 
     def _drain(self):
         """Fail any queued requests on shutdown instead of leaving
@@ -151,52 +228,118 @@ class _Batcher:
             slot["error"] = RuntimeError("batcher stopped")
             slot["done"].set()
 
-    def _collect_and_run(self, first):
-        group = [first]
-        solo = []                  # different-shaped: run after group
-        rows = first["x"].shape[0]
-        stopping = False
-        deadline = time.monotonic() + self.timeout_s
-        while rows < self.max_batch:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                break
-            try:
-                nxt = self.q.get(timeout=remaining)
-            except queue.Empty:
-                break
-            if nxt is None:        # stop(): flush what we collected
-                stopping = True
-                break
-            if nxt["x"].shape[1:] != first["x"].shape[1:] \
-                    or nxt["x"].dtype != first["x"].dtype:
-                # dtype matters too: the tensor path can carry uint8
-                # etc., and np.concatenate would silently promote —
-                # results must not depend on concurrent traffic
-                solo.append(nxt)
-                continue
-            group.append(nxt)
-            rows += nxt["x"].shape[0]
-        self._run_group(group)
-        for s in solo:
-            self._run_group([s])
-        if stopping:
-            self._stop = True
-
-    def _run_group(self, group):
+    def _collect_and_dispatch(self, first):
+        taken = [first]
         try:
-            if self.owner is not None:
-                now = time.perf_counter()
-                wait = _QUEUE_WAIT_SECONDS.labels(self.owner.name,
-                                                  self.owner.track)
-                for g in group:
-                    if "t" in g:
-                        wait.observe(now - g["t"])
+            def key(s):
+                return (s["x"].shape[1:], s["x"].dtype)
+
+            # groups: same-key slot lists, each capped at max_batch
+            # rows so a coalesced batch never overshoots its padded
+            # bucket (two 40-row requests must NOT concat to 80 and
+            # pad to bucket 256 — an unwarmed compile + 3x wasted
+            # compute); overflow opens a fresh group for the key
+            groups = []
+            fillable = {}       # key -> index into groups
+
+            def add(slot):
+                k = key(slot)
+                n = slot["x"].shape[0]
+                i = fillable.get(k)
+                if i is not None and sum(
+                        g["x"].shape[0] for g in groups[i]) + n \
+                        <= self.max_batch:
+                    groups[i].append(slot)
+                else:
+                    fillable[k] = len(groups)
+                    groups.append([slot])
+
+            add(first)
+            rows = first["x"].shape[0]
+            stopping = False
+            deadline = time.monotonic() + self.timeout_s
+            while rows < self.max_batch:
+                if self._inflight:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        nxt = self.q.get(timeout=remaining)
+                    except queue.Empty:
+                        break
+                else:
+                    # device idle: dispatch the moment the queue runs
+                    # dry — a lone request never waits out the window
+                    try:
+                        nxt = self.q.get_nowait()
+                    except queue.Empty:
+                        break
+                if nxt is None:    # stop(): flush what we collected
+                    stopping = True
+                    break
+                taken.append(nxt)
+                add(nxt)
+                rows += nxt["x"].shape[0]
+            for group in groups:
+                self._dispatch_group(group)
+                # double buffering: keep exactly one batch on the
+                # device; fetching older results here means the next
+                # window's collect (and the HTTP threads' decode)
+                # overlaps this batch's execution
+                while len(self._inflight) > 1:
+                    self._finalize_one()
+            if stopping:
+                self._stop = True
+        finally:
+            # resolve every slot this window consumed: a crash above
+            # (even a BaseException) must not leave a caller blocked
+            err = sys.exc_info()[1]
+            for s in taken:
+                if not s.get("launched") and not s["done"].is_set():
+                    s["error"] = err or RuntimeError("batcher stopped")
+                    s["done"].set()
+
+    def _dispatch_group(self, group):
+        """One shape bucket → one async device launch, pushed onto the
+        in-flight queue. Dispatch failures resolve the whole group."""
+        if self.owner is not None:
+            now = time.perf_counter()
+            wait = _QUEUE_WAIT_SECONDS.labels(self.owner.name,
+                                              self.owner.track)
+            for g in group:
+                wait.observe(now - g["t"])
+            _BATCH_OCCUPANCY.labels(
+                self.owner.name, self.owner.track).observe(len(group))
+        try:
             x = np.concatenate([g["x"] for g in group], axis=0) \
                 if len(group) > 1 else group[0]["x"]
             t0 = time.perf_counter()
-            out = np.asarray(self.run(x))
-            ms = 1000 * (time.perf_counter() - t0)
+            fut, n = self.dispatch(x)
+        except Exception as e:  # noqa: BLE001 — propagate per-request
+            for g in group:
+                g["error"] = e
+                g["done"].set()
+            return
+        for g in group:
+            g["launched"] = True
+        self._inflight.append(
+            {"group": group, "fut": fut, "rows": n, "t0": t0})
+
+    def _finalize_one(self):
+        """Block on the oldest in-flight batch, resolve its slots.
+        Exceptions propagate per-request (the loop keeps serving); a
+        BaseException additionally re-raises after the finally records
+        it — mirroring _collect_and_dispatch, so no failure class can
+        resolve a slot with neither result nor error (or leave it
+        unresolved)."""
+        rec = self._inflight.popleft()
+        group = rec["group"]
+        try:
+            out = self.finalize(rec["fut"], rec["rows"])
+            # dispatch→fetch wall time: device execution plus any
+            # pipeline overlap the loop spent collecting the next
+            # window — what the X-Inference-Time-Ms header reports
+            ms = 1000 * (time.perf_counter() - rec["t0"])
             off = 0
             for g in group:
                 n = g["x"].shape[0]
@@ -207,15 +350,23 @@ class _Batcher:
             for g in group:
                 g["error"] = e
         finally:
+            err = sys.exc_info()[1]   # BaseException path only: a
+            # plain Exception was caught (and cleared) above
             for g in group:
+                if "out" not in g and "error" not in g:
+                    g["error"] = err or RuntimeError("batcher stopped")
                 g["done"].set()
 
     def stop(self, graceful=False):
         """``graceful``: reject new submissions but let already-queued
-        requests finish before the thread exits (version transitions
-        must not 500 in-flight work); default errors the queue out."""
+        requests finish before the thread exits, and let stragglers
+        that already resolved the model fall back to the direct run
+        path (version transitions must not 500 in-flight work);
+        default errors the queue out and refuses fallback (shutdown)."""
         self._accepting = False
-        if not graceful:
+        if graceful:
+            self._graceful_stop = True
+        else:
             self._stop = True
         self.q.put(None)
 
@@ -248,7 +399,7 @@ class ServedModel:
       4× byte saving buys (multi-model co-residency under a budget,
       BASELINE r5 int8 note)."""
 
-    def __init__(self, name, predict_fn=None, version=1, batching=False,
+    def __init__(self, name, predict_fn=None, version=1, batching=True,
                  max_batch=64, batch_timeout_ms=5.0, make_fn=None,
                  host_params=None):
         self.name = name
@@ -271,8 +422,13 @@ class ServedModel:
             self.resident_bytes = 0
             self._dev_params = None
         self._ensure = None            # server residency hook
+        # cross-request batching is the default: concurrent unary
+        # requests (one HTTP worker thread each) coalesce into shape-
+        # bucketed device batches, with the next window's decode
+        # overlapping this batch's execution. batching=False keeps the
+        # direct call path (embedded callers that batch themselves).
         self._batcher = _Batcher(
-            self._run, max_batch=max_batch,
+            self.dispatch, self.finalize, max_batch=max_batch,
             timeout_s=batch_timeout_ms / 1000.0,
             owner=self) if batching else None
 
@@ -323,7 +479,7 @@ class ServedModel:
         # one observation per DEVICE call (batcher groups, stream
         # groups, and solo predicts all funnel through here)
         _BATCH_ROWS.labels(self.name, self.track).observe(n)
-        bucket = next((b for b in BATCH_BUCKETS if b >= n), n)
+        bucket = bucket_for(n)
         if bucket > n:
             pad = np.zeros((bucket - n,) + x.shape[1:], x.dtype)
             x = np.concatenate([x, pad], axis=0)
@@ -353,7 +509,21 @@ class ServedModel:
                           track=self.track, version=self.version,
                           rows=int(x.shape[0])):
             if self._batcher is not None:
-                result = self._batcher.submit(x)
+                try:
+                    result = self._batcher.submit(x)
+                except RuntimeError as e:
+                    if "batcher stopped" not in str(e) \
+                            or not self._batcher._graceful_stop:
+                        raise
+                    # straggler: a handler resolved this model just
+                    # before a version swap gracefully stopped its
+                    # batcher. The model itself still serves (retired
+                    # copies stay loadable) — run direct instead of
+                    # 500ing work that predates the transition,
+                    # matching the pre-batching-default semantics.
+                    # Hard stops (server shutdown) still refuse.
+                    out = self._run(x)
+                    result = out, 1000 * (time.perf_counter() - t0)
             else:
                 out = self._run(x)
                 result = out, 1000 * (time.perf_counter() - t0)
@@ -392,7 +562,10 @@ def _decode_tensor(t):
         .reshape(shape)
 
 
-def _encode_tensor(x):
+def _encode_tensor_bytes(x):
+    """ndarray → ``(dtype_name, shape, little-endian bytes)`` — the
+    raw half of both binary response formats (the octet-stream body IS
+    these bytes; the b64 JSON contract wraps them in base64)."""
     x = np.ascontiguousarray(x)
     if x.dtype.name not in TENSOR_DTYPES:
         x = x.astype(np.float32)
@@ -402,9 +575,53 @@ def _encode_tensor(x):
         # so a big-endian host must be caught via sys.byteorder
         x = x.astype(x.dtype.newbyteorder("<"))
     # native/little-endian arrays serialize without an extra copy —
-    # this is the hot path the binary contract exists to make cheap
-    return {"dtype": x.dtype.name, "shape": list(x.shape),
-            "b64": base64.b64encode(x.tobytes()).decode()}
+    # this is the hot path the binary contracts exist to make cheap
+    return x.dtype.name, list(x.shape), x.tobytes()
+
+
+def _encode_tensor(x):
+    dtype, shape, data = _encode_tensor_bytes(x)
+    return {"dtype": dtype, "shape": shape,
+            "b64": base64.b64encode(data).decode()}
+
+
+def _parse_tensor_headers(headers):
+    """``X-Tensor-Dtype``/``X-Tensor-Shape`` → (little-endian np.dtype,
+    shape list); malformed → ValueError (→ HTTP 400, never 500: every
+    defect here is the caller's)."""
+    dtype = (headers.get("X-Tensor-Dtype") or "").strip()
+    if dtype not in TENSOR_DTYPES:
+        raise ValueError(f"X-Tensor-Dtype must be one of "
+                         f"{sorted(TENSOR_DTYPES)}, got {dtype!r}")
+    raw = (headers.get("X-Tensor-Shape") or "").strip()
+    if not raw:
+        raise ValueError("X-Tensor-Shape header required "
+                         "(comma-separated dims, e.g. '8,224,224,3')")
+    try:
+        shape = [int(d) for d in raw.split(",")]
+    except ValueError:
+        raise ValueError("X-Tensor-Shape must be comma-separated "
+                         f"ints, got {raw!r}") from None
+    if any(d < 0 for d in shape):
+        raise ValueError(f"X-Tensor-Shape dims must be >= 0, got {raw!r}")
+    return np.dtype(dtype).newbyteorder("<"), shape
+
+
+def _decode_tensor_stream(headers, rfile, length):
+    """Octet-stream request body → ndarray, wire-cheap: no JSON, no
+    base64 — ``np.frombuffer`` straight over the bytes read off the
+    socket (the padded batch buffer is assembled from this view by the
+    dispatch path). Malformed → ValueError (→ 400)."""
+    dtype, shape = _parse_tensor_headers(headers)
+    want = int(np.prod(shape)) * dtype.itemsize
+    if length != want:
+        raise ValueError(f"Content-Length is {length} bytes, "
+                         f"shape×dtype needs {want}")
+    data = rfile.read(length) if length else b""
+    if len(data) != length:
+        raise ValueError(f"body is {len(data)} bytes, "
+                         f"Content-Length said {length}")
+    return np.frombuffer(data, dtype=dtype).reshape(shape)
 
 
 class ModelServer:
@@ -450,7 +667,11 @@ class ModelServer:
         self._models[name] = ServedModel(name, predict_fn, version,
                                          **model_kwargs)
         if old is not None:
-            old.close()    # don't leak the displaced model's batcher
+            # graceful: queued batched predicts on the displaced model
+            # finish instead of erroring — version transitions must
+            # not 500 in-flight work (matters now that batching is the
+            # default; register_loadable drains the same way)
+            old.close(graceful=True)
 
     def register_loadable(self, name, make_fn, params, version=1,
                           preload=False, **model_kwargs):
@@ -890,10 +1111,18 @@ class ModelServer:
                     return self._predict_stream(model)
                 if verb != "predict":
                     return self._send(400, {"error": f"verb {verb}"})
+                ctype = (self.headers.get("Content-Type") or "") \
+                    .split(";")[0].strip().lower()
+                if ctype == "application/x-tensor":
+                    # raw octet-stream: dtype/shape in headers, the
+                    # body IS the little-endian buffer — no JSON, no
+                    # base64 on either leg
+                    return self._predict_binary(model)
                 # 400 = the caller's fault (malformed body); 500 = ours
                 # (inference failed) — clients like the reference's
                 # test_tf_serving retry loop key off the distinction
                 binary = False
+                t_dec = time.perf_counter()
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length) or b"{}")
@@ -901,30 +1130,27 @@ class ModelServer:
                         binary = True
                         x = _decode_tensor(req["tensor"])
                     else:
-                        x = req["instances"]
+                        # materialize here so the decode metric covers
+                        # the full body→ndarray cost (the list→array
+                        # conversion dominates at image sizes — the
+                        # very cost the binary formats delete);
+                        # predict_raw's asarray is then a no-op
+                        x = np.asarray(req["instances"])
                 except (ValueError, KeyError, TypeError) as e:
                     return self._send(400, {"error": f"bad request: {e}"})
-                try:
-                    out, infer = model.predict_raw(x)
-                except ValueError as e:     # scalar/ragged instances
-                    return self._send(400, {"error": str(e)})
-                except ModelTooLargeError as e:
-                    # permanent capacity condition, not an inference
-                    # failure: 507 so retry loops keyed on 500 stop
-                    return self._send(507, {"error": str(e)})
-                except CapacityBusyError as e:
-                    # transient (mid-transition budget pressure):
-                    # 503 + Retry-After keeps retry loops going
-                    return self._send(503, {"error": str(e)},
-                                      (("Retry-After", "1"),))
-                except Exception as e:  # noqa: BLE001 — wire boundary
-                    return self._send(500,
-                                      {"error": f"inference failed: {e}"})
+                fmt = "b64" if binary else "json"
+                _WIRE_FORMAT_TOTAL.labels(fmt).inc()
+                _DECODE_SECONDS.labels(fmt).observe(
+                    time.perf_counter() - t_dec)
+                result = self._predict_guarded(model, x)
+                if result is None:
+                    return      # taxonomy response already sent
                 # success write OUTSIDE the try: a client reset mid-body
                 # must not trigger a second (500) response on the wire
                 # (device-time header: JSON transport dominates at image
                 # sizes on the instances path, the breakdown keeps that
                 # visible; the tensor path exists to remove it)
+                out, infer = result
                 if binary:
                     payload = {"tensor": _encode_tensor(out)}
                 else:
@@ -932,6 +1158,75 @@ class ModelServer:
                 self._send(200, payload,
                            (("X-Inference-Time-Ms", f"{infer:.1f}"),
                             ("X-Served-Version", str(model.version))))
+
+            def _predict_guarded(self, model, x):
+                """The ONE unary predict error taxonomy, shared by the
+                JSON and octet-stream routes so they can never
+                diverge: 400 = the caller's fault (scalar/ragged
+                input), 507 = permanent capacity (model alone exceeds
+                the budget — retry loops keyed on 500 must stop),
+                503 + Retry-After = transient mid-transition budget
+                pressure, 500 = inference failed. Returns
+                ``(out, infer_ms)``, or None after sending the error
+                response."""
+                try:
+                    return model.predict_raw(x)
+                except ValueError as e:
+                    self._send(400, {"error": str(e)})
+                except ModelTooLargeError as e:
+                    self._send(507, {"error": str(e)})
+                except CapacityBusyError as e:
+                    self._send(503, {"error": str(e)},
+                               (("Retry-After", "1"),))
+                except Exception as e:  # noqa: BLE001 — wire boundary
+                    self._send(500, {"error": f"inference failed: {e}"})
+                return None
+
+            def _predict_binary(self, model):
+                """Zero-copy unary predict (``application/x-tensor``):
+                request dtype/shape ride ``X-Tensor-*`` headers, the
+                body is the raw little-endian buffer, and the response
+                mirrors the format. The error taxonomy matches the
+                JSON route (400 caller / 500 server / 503+507
+                capacity) so retry loops work unchanged."""
+                t_dec = time.perf_counter()
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    x = _decode_tensor_stream(self.headers, self.rfile,
+                                              length)
+                except (ValueError, TypeError) as e:
+                    # drain the unread body before answering: closing
+                    # the socket with inbound bytes still pending can
+                    # RST away the buffered 400 on large payloads, and
+                    # the client would see a reset instead of the
+                    # documented error detail
+                    try:
+                        left = int(self.headers.get(
+                            "Content-Length", 0))
+                    except (ValueError, TypeError):
+                        left = 0
+                    while left > 0:
+                        chunk = self.rfile.read(min(left, 1 << 20))
+                        if not chunk:
+                            break
+                        left -= len(chunk)
+                    return self._send(400, {"error": f"bad request: {e}"})
+                _WIRE_FORMAT_TOTAL.labels("binary").inc()
+                _DECODE_SECONDS.labels("binary").observe(
+                    time.perf_counter() - t_dec)
+                result = self._predict_guarded(model, x)
+                if result is None:
+                    return      # taxonomy response already sent
+                out, infer = result
+                dtype, shape, payload = _encode_tensor_bytes(out)
+                self._send(
+                    200, payload,
+                    (("X-Tensor-Dtype", dtype),
+                     ("X-Tensor-Shape",
+                      ",".join(str(d) for d in shape)),
+                     ("X-Inference-Time-Ms", f"{infer:.1f}"),
+                     ("X-Served-Version", str(model.version))),
+                    content_type="application/x-tensor")
 
             def _predict_stream(self, model):
                 """Batched-pipelined predict over one connection: the
@@ -946,7 +1241,6 @@ class ModelServer:
                 ~6× the per-request rate on a v5e — BASELINE r4), and
                 the next group is decoded+dispatched while the previous
                 one's results are fetched and written."""
-                import collections
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                 except (ValueError, TypeError) as e:
@@ -1039,6 +1333,8 @@ class ModelServer:
                         return
                     xs = [x for x, _ in group]
                     x = np.concatenate(xs, 0) if len(xs) > 1 else xs[0]
+                    _BATCH_OCCUPANCY.labels(
+                        model.name, model.track).observe(len(group))
                     try:
                         fut, _ = model.dispatch(x)
                         pending.append(
@@ -1061,6 +1357,7 @@ class ModelServer:
                             continue
                         maybe_truncated = True
                     try:
+                        t_dec = time.perf_counter()
                         req = json.loads(ln)
                         if "tensor" in req:
                             binary = True
@@ -1070,6 +1367,10 @@ class ModelServer:
                             x = np.asarray(req["instances"])
                             if x.ndim == 0:
                                 raise ValueError("scalar instances")
+                        fmt = "b64" if binary else "json"
+                        _WIRE_FORMAT_TOTAL.labels(fmt).inc()
+                        _DECODE_SECONDS.labels(fmt).observe(
+                            time.perf_counter() - t_dec)
                     except Exception as e:  # noqa: BLE001 — per-line
                         flush_group()
                         if maybe_truncated:
@@ -1114,5 +1415,10 @@ class ModelServer:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd = None
-        for model in self._models.values():
+        # canaries own batcher threads too (batching is the default);
+        # retired/pending copies were already closed when displaced
+        with self._residency_lock:
+            models = [*self._models.values(),
+                      *(c["model"] for c in self._canaries.values())]
+        for model in models:
             model.close()

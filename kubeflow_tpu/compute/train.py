@@ -158,6 +158,38 @@ def make_train_step(loss_fn, optimizer, mesh, accum_steps=1):
     return run
 
 
+def fit(state, step_fn, batches, mesh, steps=None, spec=None,
+        prefetch_depth=2, on_step=None):
+    """Run a training loop over host batches with prefetch overlap.
+
+    ``batches`` is a host-batch iterator; it is wrapped in a
+    ``data.Prefetcher`` (host→HBM copy overlaps compute) under its
+    context manager, so the pump thread is released on every exit
+    path — normal exhaustion, the ``steps`` cap, an ``on_step`` early
+    stop, or an exception — instead of leaking blocked on a full
+    queue.
+
+    ``on_step(step_count, metrics)`` runs after every step; returning
+    False stops the loop (the early-stopping hook trial workloads
+    use). Returns ``(state, last_metrics)``.
+    """
+    from . import data as data_lib
+
+    kwargs = {} if spec is None else {"spec": spec}
+    metrics = None
+    done = 0
+    with data_lib.Prefetcher(batches, mesh, depth=prefetch_depth,
+                             **kwargs) as pf:
+        for batch in pf:
+            state, metrics = step_fn(state, batch)
+            done += 1
+            if on_step is not None and on_step(done, metrics) is False:
+                break
+            if steps is not None and done >= steps:
+                break
+    return state, metrics
+
+
 def make_eval_step(loss_fn, mesh):
     jitted = jax.jit(
         lambda params, extra, batch: loss_fn(params, extra, batch)[1][0])

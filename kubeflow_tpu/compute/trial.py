@@ -96,9 +96,15 @@ def run_mnist_trial(hp=None, steps=30):
     x = jax.random.normal(key, (64, 28, 28, 1))
     y = jax.random.randint(key, (64,), 0, 10)
     batch = {"image": x, "label": y}
-    metrics = {}
-    for _ in range(steps):
-        state, metrics = step(state, batch)
+
+    def batches():
+        for _ in range(steps):
+            yield batch
+
+    # train.fit wraps the source in a Prefetcher under its context
+    # manager: the pump thread is joined even if a step raises, so a
+    # failed trial never leaks a thread wedged on the batch queue
+    state, metrics = train.fit(state, step, batches(), mesh)
     loss = float(metrics["loss"])
     report(loss, extra={"accuracy": float(metrics["accuracy"])})
     return loss

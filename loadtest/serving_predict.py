@@ -1,0 +1,197 @@
+#!/usr/bin/env python3
+"""Model-server predict load test.
+
+Companion to ``start_notebooks.py`` for the serving tier: drives the
+unary predict route with N concurrent keep-alive clients and reports
+throughput, latency percentiles, and the batch occupancy the
+cross-request continuous batcher achieved (requests coalesced per
+device dispatch — the number bench.py asserts is > 1 under load).
+
+By default it spins an in-process ``ModelServer`` with a small jitted
+MLP (CPU-safe; the point is the host/wire path, not the model) and
+hits it over real HTTP on localhost. ``--url`` points it at a running
+server instead.
+
+Wire formats (``--format``):
+
+- ``raw``  — ``application/x-tensor`` octet stream (default): dtype/
+  shape in headers, the body is the little-endian buffer. The
+  wire-cheap path.
+- ``b64``  — ``{"tensor": {dtype, shape, b64}}`` JSON body.
+- ``json`` — the reference ``{"instances": [...]}`` contract.
+
+    python loadtest/serving_predict.py --clients 16 --requests 50
+    python loadtest/serving_predict.py --format json --rows 4
+    python loadtest/serving_predict.py --url http://host:8500 --model m
+"""
+
+import argparse
+import base64
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+from urllib.parse import urlsplit
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_argparser():
+    ap = argparse.ArgumentParser(prog="serving_predict")
+    ap.add_argument("--clients", type=int, default=8,
+                    help="concurrent keep-alive connections")
+    ap.add_argument("--requests", type=int, default=50,
+                    help="requests per client")
+    ap.add_argument("--rows", type=int, default=1,
+                    help="batch rows per request")
+    ap.add_argument("--in-dim", type=int, default=64,
+                    help="feature dim of the in-process model")
+    ap.add_argument("--format", choices=("raw", "b64", "json"),
+                    default="raw")
+    ap.add_argument("--url", default="",
+                    help="target a running server (default: spin an "
+                         "in-process ModelServer on localhost)")
+    ap.add_argument("--model", default="loadtest",
+                    help="served model name (with --url)")
+    return ap
+
+
+def make_request_body(fmt, x):
+    """→ (body_bytes, headers) for one predict request."""
+    if fmt == "raw":
+        return x.tobytes(), {
+            "Content-Type": "application/x-tensor",
+            "X-Tensor-Dtype": str(x.dtype),
+            "X-Tensor-Shape": ",".join(str(d) for d in x.shape)}
+    if fmt == "b64":
+        body = json.dumps({"tensor": {
+            "dtype": str(x.dtype), "shape": list(x.shape),
+            "b64": base64.b64encode(x.tobytes()).decode()}})
+        return body.encode(), {"Content-Type": "application/json"}
+    body = json.dumps({"instances": x.tolist()})
+    return body.encode(), {"Content-Type": "application/json"}
+
+
+def main(argv=None):
+    args = build_argparser().parse_args(argv)
+    import numpy as np
+
+    server = None
+    if args.url:
+        split = urlsplit(args.url)
+        host, port = split.hostname, split.port or 8500
+        name = args.model
+    else:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        from kubeflow_tpu.compute import serving
+        from kubeflow_tpu.compute.models import mlp
+
+        cfg = mlp.Config(in_dim=args.in_dim, hidden=128, n_classes=16)
+        params = mlp.init_params(cfg, jax.random.PRNGKey(0))
+        server = serving.ModelServer()
+        name = args.model
+        server.register(name, lambda x: jax.nn.softmax(
+            mlp.apply(params, x, cfg), axis=-1))
+        host, port = "127.0.0.1", server.start(port=0, host="127.0.0.1")
+
+    x = np.random.default_rng(0).standard_normal(
+        (args.rows, args.in_dim)).astype(np.float32)
+    body, headers = make_request_body(args.format, x)
+    path = f"/v1/models/{name}:predict"
+
+    lat, errors = [], []
+    lat_lock = threading.Lock()
+
+    def client():
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=300)
+            mine = []
+            for _ in range(args.requests):
+                t1 = time.perf_counter()
+                conn.request("POST", path, body, headers)
+                r = conn.getresponse()
+                r.read()
+                if r.status != 200:
+                    raise RuntimeError(f"HTTP {r.status}")
+                mine.append(time.perf_counter() - t1)
+            conn.close()
+            with lat_lock:
+                lat.extend(mine)
+        except Exception as e:  # noqa: BLE001 — reported in the result
+            errors.append(f"{type(e).__name__}: {e}")
+
+    # warm outside the timed window: the first request pays the jit
+    # compile, and cross-request batching coalesces concurrent rows
+    # into LARGER padded buckets — pre-compile every bucket the timed
+    # run can land on (same discipline as bench.py's concurrent phase)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from kubeflow_tpu.compute import serving as _serving
+    if server is not None:
+        batcher = server.models()[name]._batcher
+        max_rows = batcher.max_batch if batcher else 64
+    else:
+        max_rows = 64            # remote server: assume the default
+    lo = _serving.bucket_for(args.rows)
+    hi = _serving.bucket_for(min(max_rows, args.clients * args.rows))
+    warm = http.client.HTTPConnection(host, port, timeout=300)
+    for b in _serving.BATCH_BUCKETS:
+        if lo <= b <= hi:
+            wx = np.repeat(x, (b + args.rows - 1) // args.rows,
+                           axis=0)[:b]
+            wbody, wheaders = make_request_body(args.format, wx)
+            warm.request("POST", path, wbody, wheaders)
+            r = warm.getresponse()
+            r.read()
+            if r.status != 200:
+                raise SystemExit(f"warm-up failed: HTTP {r.status}")
+    warm.close()
+
+    occ0 = (0.0, 0)
+    if server is not None:
+        from kubeflow_tpu.compute import serving as _sv
+        s = _sv._BATCH_OCCUPANCY.samples().get(
+            (name, "stable"), {"sum": 0.0, "count": 0})
+        occ0 = (s["sum"], s["count"])
+
+    workers = [threading.Thread(target=client)
+               for _ in range(args.clients)]
+    t0 = time.perf_counter()
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    wall = time.perf_counter() - t0
+
+    result = {
+        "clients": args.clients, "requests_per_client": args.requests,
+        "rows": args.rows, "format": args.format,
+        "errors": errors[:3], "wall_s": round(wall, 3),
+    }
+    if lat:
+        lat.sort()
+        result.update({
+            "predictions_per_sec": round(
+                len(lat) * args.rows / wall, 1),
+            "p50_ms": round(1000 * lat[len(lat) // 2], 2),
+            "p99_ms": round(
+                1000 * lat[min(len(lat) - 1, int(len(lat) * 0.99))], 2),
+        })
+    if server is not None:
+        from kubeflow_tpu.compute import serving as _sv
+        s = _sv._BATCH_OCCUPANCY.samples().get(
+            (name, "stable"), {"sum": 0.0, "count": 0})
+        n = s["count"] - occ0[1]
+        result["batch_occupancy_mean"] = round(
+            (s["sum"] - occ0[0]) / n, 2) if n else None
+        server.stop()
+    print(json.dumps(result))
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

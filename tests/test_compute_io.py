@@ -115,6 +115,115 @@ class TestData:
         batch = next(data_lib.mnist(None))
         assert batch["image"].shape == (128, 28, 28, 1)
 
+    def test_prefetcher_close_unblocks_abandoned_pump(self):
+        """An abandoned iterator leaves the pump thread blocked on its
+        full queue forever; close() must unblock AND join it."""
+        mesh = M.make_mesh(data=8)
+        # unbounded source, tiny queue: after one next() the pump is
+        # guaranteed to be wedged on q.put
+        pf = data_lib.Prefetcher(
+            data_lib.synthetic_lm(8, 16, 32), mesh, depth=1)
+        next(pf)
+        assert pf._thread.is_alive()
+        pf.close()
+        assert not pf._thread.is_alive()
+        # closed prefetcher ends iteration instead of hanging
+        with pytest.raises(StopIteration):
+            next(pf)
+        pf.close()   # idempotent
+
+    def test_prefetcher_close_does_not_overpull_source(self):
+        """close() must not advance the source iterator again after
+        unblocking the pump's pending put — one extra pull would
+        consume a batch from a shared/resumable loader and block
+        close() for a full production cycle."""
+        import time
+
+        mesh = M.make_mesh(data=8)
+        pulled = []
+
+        def source():
+            i = 0
+            while True:
+                pulled.append(i)
+                yield {"x": np.full((8, 2), float(i), np.float32)}
+                i += 1
+
+        pf = data_lib.Prefetcher(source(), mesh, depth=1)
+        next(pf)
+        # wait for the pump to wedge on its full queue (pull count
+        # stops moving)
+        last = -1
+        for _ in range(200):
+            if len(pulled) == last:
+                break
+            last = len(pulled)
+            time.sleep(0.01)
+        pf.close()
+        assert len(pulled) == last
+
+    def test_prefetcher_context_manager(self):
+        mesh = M.make_mesh(data=8)
+        with data_lib.Prefetcher(data_lib.synthetic_lm(8, 16, 32),
+                                 mesh, depth=1) as pf:
+            batch = next(pf)
+            assert batch["tokens"].shape == (8, 16)
+            thread = pf._thread
+        assert not thread.is_alive()
+
+    def test_prefetcher_close_after_exhaustion_is_noop(self):
+        mesh = M.make_mesh(data=8)
+        with data_lib.Prefetcher(
+                data_lib.synthetic_lm(8, 16, 32, steps=2), mesh) as pf:
+            assert len(list(pf)) == 2
+
+
+class TestFit:
+    """train.fit: the loop helper that owns the Prefetcher lifecycle."""
+
+    def test_fit_runs_and_releases_pump_on_early_stop(self):
+        mesh = M.make_mesh(data=8)
+        calls = []
+
+        def fake_step(state, batch):
+            calls.append(batch["tokens"].shape)
+            return state + 1, {"loss": float(state)}
+
+        state, metrics = train.fit(
+            0, fake_step, data_lib.synthetic_lm(8, 16, 32), mesh,
+            steps=3)
+        assert state == 3 and len(calls) == 3
+        assert metrics == {"loss": 2.0}
+
+    def test_fit_on_step_false_stops(self):
+        mesh = M.make_mesh(data=8)
+
+        def fake_step(state, batch):
+            return state + 1, {}
+
+        state, _ = train.fit(
+            0, fake_step, data_lib.synthetic_lm(8, 16, 32), mesh,
+            on_step=lambda done, m: done < 2)
+        assert state == 2
+
+    def test_fit_releases_pump_when_step_raises(self):
+        mesh = M.make_mesh(data=8)
+        import threading as _threading
+        before = _threading.active_count()
+
+        def boom(state, batch):
+            raise RuntimeError("step died")
+
+        with pytest.raises(RuntimeError, match="step died"):
+            train.fit(0, boom, data_lib.synthetic_lm(8, 16, 32), mesh)
+        # the pump thread did not leak past the context manager
+        deadline = 0
+        while _threading.active_count() > before and deadline < 100:
+            import time as _time
+            _time.sleep(0.01)
+            deadline += 1
+        assert _threading.active_count() <= before
+
 
 class TestServing:
     def test_rest_predict_contract(self):
