@@ -20,6 +20,7 @@ def main():
 
     # import side effects register each layer's module-level families
     import kubeflow_tpu.compute.serving       # noqa: F401
+    import kubeflow_tpu.compute.sweep         # noqa: F401
     import kubeflow_tpu.controllers.tpuslice  # noqa: F401
     import kubeflow_tpu.core.manager          # noqa: F401
     import kubeflow_tpu.core.workqueue        # noqa: F401
@@ -53,6 +54,11 @@ def main():
         "serving_decode_seconds",
         "serving_wire_format_total",
         "serving_batch_occupancy_requests",
+        # vectorized HPO sweep surface (compute/sweep.py; bench.py's
+        # study mode and docs/observability.md promise these)
+        "sweep_trials_per_program",
+        "sweep_bucket_occupancy_ratio",
+        "sweep_compile_cache_total",
     }
     registered = {metric.name for metric in obs_metrics.REGISTRY._metrics}
     for name in sorted(required - registered):

@@ -81,7 +81,7 @@ def new_slice(name, namespace, accelerator, topology, pod_spec,
 def new_study(name, namespace, objective, parameters, trial_template,
               max_trials=10, parallelism=None, algorithm="random",
               seed=0, accelerator=None, chips_per_trial=None,
-              queue=None, priority=None):
+              queue=None, priority=None, vectorize=None):
     """parameters: list of {name, type: double|int|categorical, min, max,
     values}; trial_template: pod spec template whose container args may use
     ``{{param}}`` placeholders (katib_studyjob_test.py idiom).
@@ -107,6 +107,10 @@ def new_study(name, namespace, objective, parameters, trial_template,
         spec["queue"] = queue
     if priority is not None:
         spec["priority"] = int(priority)
+    if vectorize is not None:
+        # pack shape-compatible trials into vmapped sweep pods
+        # (compute/sweep.py; controllers/tpuslice.py _launch_sweeps)
+        spec["vectorize"] = bool(vectorize)
     return {
         "apiVersion": f"{GROUP}/{VERSION}", "kind": STUDY_KIND,
         "metadata": {"name": name, "namespace": namespace},

@@ -37,6 +37,63 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+
+def _polyfill_jax_api():
+    """jax 0.4.x compatibility shims, additive only (a jax that already
+    has the explicit-mesh API keeps its own implementations).
+
+    The compute layer is written against ``jax.set_mesh`` /
+    ``jax.shard_map`` / ``jax.sharding.get_abstract_mesh`` /
+    ``jax.lax.axis_size``; on 0.4.x those map onto the legacy ambient
+    mesh context (``with mesh:``) and
+    ``jax.experimental.shard_map.shard_map`` with its ``auto`` axis set.
+    """
+    from jax._src import mesh as _mesh_src
+
+    def _ambient():
+        return _mesh_src.thread_resources.env.physical_mesh
+
+    if not hasattr(jax, "set_mesh"):
+        # Mesh is itself a context manager establishing the ambient
+        # mesh — exactly what every ``with jax.set_mesh(mesh):`` needs
+        jax.set_mesh = lambda mesh: mesh
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _legacy
+
+        def _shard_map(f, mesh=None, *, in_specs, out_specs,
+                       axis_names=None, check_vma=True):
+            def call(*args):
+                amb = mesh if mesh is not None else _ambient()
+                if amb is None or amb.empty:
+                    raise ValueError(
+                        "shard_map needs a mesh: pass mesh= or call "
+                        "under jax.set_mesh(mesh)")
+                manual = (set(axis_names) if axis_names
+                          else set(amb.axis_names))
+                auto = frozenset(amb.axis_names) - manual
+                return _legacy(f, amb, in_specs=in_specs,
+                               out_specs=out_specs,
+                               check_rep=bool(check_vma),
+                               auto=auto)(*args)
+            return call
+        jax.shard_map = _shard_map
+
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        def _get_abstract_mesh():
+            amb = _ambient()
+            return None if amb.empty else amb
+        jax.sharding.get_abstract_mesh = _get_abstract_mesh
+
+    if not hasattr(jax.lax, "axis_size"):
+        # psum of a Python literal constant-folds to the axis size
+        # inside a manual region and raises NameError outside — the
+        # same contract axis_size has
+        jax.lax.axis_size = lambda name: jax.lax.psum(1, name)
+
+
+_polyfill_jax_api()
+
 DATA = "data"
 PIPELINE = "pipeline"
 FSDP = "fsdp"
@@ -179,6 +236,40 @@ def multislice_layout(groups, fsdp=1, sequence=1, tensor=1, expert=1):
     ordered = [d for g in groups for d in g]
     return ordered, MeshSpec(data=data, fsdp=fsdp, sequence=sequence,
                              tensor=tensor, expert=expert)
+
+
+#: default persistent compile-cache location, under the workspace PVC
+#: when one is mounted (docs/user-guide.md: slice workers mount it at
+#: /workspace) so repeated buckets and RESTARTED workers skip XLA
+#: compilation entirely — the cache survives the pod
+WORKSPACE_CACHE_DIR = "/workspace/.jax-compile-cache"
+_FALLBACK_CACHE_DIR = "/tmp/jax-compile-cache"
+
+
+def setup_compilation_cache(cache_dir=None, min_compile_secs=0.5):
+    """Enable JAX's persistent compilation cache and return its path.
+
+    Resolution order: explicit ``cache_dir`` argument >
+    ``JAX_COMPILATION_CACHE_DIR`` env (empty string opts out, returning
+    None) > the workspace PVC (``/workspace/.jax-compile-cache``) when
+    mounted > a host-local /tmp fallback. Safe to call more than once;
+    called by the workload entrypoints (slice_worker, sweep) so a
+    restarted worker's first program is a disk hit, not a recompile.
+    """
+    if cache_dir is None:
+        env = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        if env is not None:
+            if not env:
+                return None     # explicit opt-out
+            cache_dir = env
+        elif os.path.isdir(os.path.dirname(WORKSPACE_CACHE_DIR)):
+            cache_dir = WORKSPACE_CACHE_DIR
+        else:
+            cache_dir = _FALLBACK_CACHE_DIR
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_secs))
+    return cache_dir
 
 
 def distributed_env():

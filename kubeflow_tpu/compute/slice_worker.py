@@ -71,6 +71,12 @@ def main(argv=None):
     from . import train
     from .models import transformer
 
+    # persistent compile cache under the workspace PVC: a restarted
+    # gang's first step is a disk hit instead of a full XLA recompile
+    # (the gang-restart recovery path repays the slowest part of
+    # resume); JAX_COMPILATION_CACHE_DIR="" opts out
+    mesh_lib.setup_compilation_cache()
+
     joined = mesh_lib.initialize_distributed()
     pid = jax.process_index()
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(

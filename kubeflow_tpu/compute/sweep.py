@@ -1,0 +1,291 @@
+"""Vectorized HPO sweeps — many trials as ONE dense XLA program.
+
+The sequential StudyJob path pays full XLA compilation per trial, runs
+one tiny program, and idles the chip between trials — the Podracer/
+Anakin anti-pattern (PAPERS.md, arxiv 2104.06272). This engine stacks a
+whole sweep into vmapped programs instead:
+
+- **Bucketing**: trials are grouped by the hyperparameters that change
+  compiled *shapes* (``hidden`` & friends — anything not in
+  ``CONTINUOUS_KEYS``). Trials that differ only in continuous knobs
+  (``lr``, ``weight_decay``, ``clip_norm``) share one compilation.
+- **Vectorized optimizer**: the continuous knobs become per-trial
+  *array elements*; ``train.make_optimizer`` is built per trial under
+  ``vmap`` with traced scalars (its schedule is traceable by design),
+  so K optimizers run as one batched update.
+- **One program per bucket**: params/opt_state carry a leading trial
+  axis sharded over the mesh ``data`` axis — a slice trains its whole
+  bucket in parallel, K is padded up to a multiple of the axis size
+  when needed (padding replicates the last trial and is dropped from
+  results).
+- **Persistent compile cache**: entrypoints call
+  ``mesh.setup_compilation_cache()`` so a repeated bucket shape — or a
+  restarted worker — is a disk hit, not a recompile. Hits/misses are
+  observable as ``sweep_compile_cache_total{result}``.
+
+Per-trial objectives fan back out through the EXISTING trial contract:
+one parseable ``trial-metric`` stdout line per trial (``trial.report``
+with its index), so the StudyJob metrics collector and medianstop
+parsing are untouched.
+
+Worker entry: ``python -m kubeflow_tpu.compute.sweep`` with
+``TRIAL_SWEEP_PARAMETERS`` holding the JSON trial list (the env the
+StudyJobReconciler injects into a packed sweep pod).
+"""
+
+import json
+import os
+
+from ..obs import metrics as obs_metrics
+
+#: hyperparameters that stay *continuous* under vectorization — they
+#: become per-trial arrays inside one program. Everything else changes
+#: compiled shapes (or the program itself) and defines the bucket key.
+CONTINUOUS_KEYS = ("lr", "weight_decay", "clip_norm")
+
+#: trials packed into one vectorized program (one histogram sample per
+#: program launch)
+TRIALS_PER_PROGRAM = obs_metrics.REGISTRY.histogram(
+    "sweep_trials_per_program",
+    "Trials packed into one vectorized sweep program",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+
+#: live-trial fraction of the padded trial axis (1.0 = no padding; the
+#: axis pads up to a multiple of the mesh data-axis size)
+BUCKET_OCCUPANCY = obs_metrics.REGISTRY.histogram(
+    "sweep_bucket_occupancy_ratio",
+    "Live-trial fraction of the padded vectorized trial axis",
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+
+#: persistent XLA compile-cache outcomes observed in this process
+#: (fed by jax's monitoring events; counts every jit in the process,
+#: which for a sweep worker is the sweep programs themselves)
+COMPILE_CACHE = obs_metrics.REGISTRY.counter(
+    "sweep_compile_cache_total",
+    "Persistent XLA compile-cache hits/misses observed in-process",
+    ("result",))
+
+_CACHE_EVENTS = {
+    "/jax/compilation_cache/cache_hits": "hit",
+    "/jax/compilation_cache/cache_misses": "miss",
+}
+_cache_listener_installed = False
+
+
+def install_cache_listener():
+    """Feed jax's compilation-cache monitoring events into the
+    ``sweep_compile_cache_total`` family. Idempotent; a jax without the
+    monitoring hooks (or with them moved) degrades to no counts."""
+    global _cache_listener_installed
+    if _cache_listener_installed:
+        return
+    try:
+        from jax._src import monitoring
+    except ImportError:     # pragma: no cover - jax internals moved
+        return
+
+    def on_event(event, **kwargs):
+        result = _CACHE_EVENTS.get(event)
+        if result:
+            COMPILE_CACHE.labels(result).inc()
+
+    monitoring.register_event_listener(on_event)
+    _cache_listener_installed = True
+
+
+# ------------------------------------------------------------- bucketing
+
+def bucket_key(params, continuous=CONTINUOUS_KEYS):
+    """The shape signature of one trial's hyperparameters: everything
+    that is not a continuous knob, as a sorted, hashable tuple."""
+    return tuple(sorted(
+        (k, v) for k, v in params.items() if k not in continuous))
+
+
+def bucket_trials(trials, continuous=CONTINUOUS_KEYS):
+    """Group ``[(index, params), ...]`` into shape buckets.
+
+    Returns ``[(key, members)]`` with ``members`` preserving input
+    order — trials in one bucket run as ONE vmapped program; two trials
+    with different shape signatures are never mixed (the invariant
+    tests/test_compute_sweep.py pins).
+    """
+    buckets = {}
+    for index, params in trials:
+        buckets.setdefault(
+            bucket_key(params, continuous), []).append((index, params))
+    # repr-keyed sort: deterministic bucket order even when two keys
+    # mix value types (("hidden", 64) vs ("hidden", "a") won't compare)
+    return sorted(buckets.items(), key=lambda kv: repr(kv[0]))
+
+
+# ------------------------------------------------- vectorized execution
+
+def _pad_members(members, multiple):
+    """Pad a bucket to a multiple of the trial-shard size by repeating
+    the last member (its result is computed and dropped)."""
+    if multiple <= 1 or len(members) % multiple == 0:
+        return list(members)
+    pad = multiple - len(members) % multiple
+    return list(members) + [members[-1]] * pad
+
+
+def _hp_arrays(members, defaults):
+    """Continuous hyperparams as stacked per-trial arrays."""
+    import jax.numpy as jnp
+    out = {}
+    for key, default in defaults.items():
+        out[key] = jnp.asarray(
+            [float(p.get(key, default)) for _, p in members],
+            jnp.float32)
+    return out
+
+
+def run_mnist_sweep(trial_params, steps=30, mesh=None):
+    """Run K mnist trials (the default StudyJob objective) vectorized.
+
+    ``trial_params`` is a list of hyperparameter dicts (or
+    ``(index, dict)`` pairs). Returns one result dict per input trial,
+    in input order: ``{"index", "objective", "metrics"}`` — each
+    objective equal (within float tolerance) to what
+    ``trial.run_mnist_trial`` computes for the same hyperparameters,
+    because both run the identical model, init key, batch and
+    optimizer; the sweep merely batches them into one program per
+    shape bucket.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from . import mesh as mesh_lib
+    from . import train
+    from .models import mlp
+
+    normalized = []
+    for i, entry in enumerate(trial_params):
+        if isinstance(entry, tuple):
+            index, params = entry
+        else:
+            index, params = i, entry
+        normalized.append(
+            (index, dict({"lr": 1e-2, "hidden": 64}, **(params or {}))))
+
+    if mesh is None:
+        mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=-1))
+    data_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+        mesh_lib.DATA, 1)
+    trial_shard = NamedSharding(mesh, P(mesh_lib.DATA))
+
+    # the mnist objective's fixed data (identical to run_mnist_trial)
+    key = jax.random.PRNGKey(1)
+    batch = {"image": jax.random.normal(key, (64, 28, 28, 1)),
+             "label": jax.random.randint(key, (64,), 0, 10)}
+
+    results = {}
+    for _, members in bucket_trials(normalized):
+        padded = _pad_members(members, data_size)
+        TRIALS_PER_PROGRAM.observe(len(members))
+        BUCKET_OCCUPANCY.observe(len(members) / len(padded))
+        k = len(padded)
+        hidden = int(padded[0][1]["hidden"])
+        cfg = mlp.Config(in_dim=784, hidden=hidden, n_classes=10)
+        hps = _hp_arrays(padded, {"lr": 1e-2, "weight_decay": 0.01,
+                                  "clip_norm": 1.0})
+        loss_fn = train.plain_loss(mlp.loss_fn, cfg)
+
+        def make_opt(hp):
+            # the exact optimizer run_mnist_trial builds, with the
+            # continuous knobs as (possibly traced) scalars
+            return train.make_optimizer(
+                learning_rate=hp["lr"], warmup_steps=2,
+                total_steps=steps, weight_decay=hp["weight_decay"],
+                clip_norm=hp["clip_norm"])
+
+        def per_trial(hp, params, opt_state):
+            grad_fn = jax.value_and_grad(
+                lambda p: loss_fn(p, {}, batch), has_aux=True)
+            (loss, (metrics, _)), grads = grad_fn(params)
+            updates, opt_state = make_opt(hp).update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, dict(metrics)
+
+        def program(hps, params, opt_state):
+            # the WHOLE bucket is one dense XLA computation — steps
+            # rolled into a scan around the vmapped trial step, so a
+            # sweep costs one compile + one dispatch (the Anakin
+            # many-experiments-one-program shape), not steps×trials
+            # dispatches
+            def body(carry, _):
+                params, opt_state = carry
+                params, opt_state, metrics = jax.vmap(per_trial)(
+                    hps, params, opt_state)
+                return (params, opt_state), metrics
+            (params, opt_state), metrics = jax.lax.scan(
+                body, (params, opt_state), None, length=steps)
+            return params, opt_state, jax.tree.map(
+                lambda a: a[-1], metrics)
+
+        keys = jnp.stack([jax.random.PRNGKey(0)] * k)
+        with jax.set_mesh(mesh):
+            params = jax.jit(
+                jax.vmap(lambda kk: mlp.init_params(cfg, kk)),
+                out_shardings=trial_shard)(keys)
+            opt_state = jax.jit(
+                jax.vmap(lambda hp, p: make_opt(hp).init(p)),
+                out_shardings=trial_shard)(hps, params)
+            _, _, metrics = jax.jit(program, donate_argnums=(1, 2))(
+                hps, params, opt_state)
+        metrics = jax.tree.map(lambda m: m[:len(members)], metrics)
+        for j, (index, _) in enumerate(members):
+            per = {name: float(vals[j])
+                   for name, vals in metrics.items()}
+            results[index] = {"index": index,
+                              "objective": per["loss"],
+                              "metrics": per}
+    return [results[index] for index, _ in normalized]
+
+
+# ----------------------------------------------------- report + worker
+
+def report_sweep(results, name=None):
+    """Fan per-trial objectives out through the single-trial contract:
+    one ``trial-metric`` line per trial, each carrying its trial index
+    (``trial.report`` — the collector parses name/value exactly as for
+    a lone trial; the index routes the value to the right StudyJob
+    trial record)."""
+    from . import trial as trial_lib
+    for r in results:
+        extra = {k: v for k, v in r["metrics"].items() if k != "loss"}
+        trial_lib.report(r["objective"], name=name, extra=extra or None,
+                         trial=r["index"])
+
+
+def trials_from_env():
+    """Decode ``TRIAL_SWEEP_PARAMETERS``: a JSON list of
+    ``{"index": i, "parameters": {...}}`` records (the packed-pod
+    contract the StudyJobReconciler injects)."""
+    blob = os.environ.get("TRIAL_SWEEP_PARAMETERS")
+    if not blob:
+        return []
+    return [(int(t["index"]), dict(t.get("parameters") or {}))
+            for t in json.loads(blob)]
+
+
+def main():
+    from . import mesh as mesh_lib
+    install_cache_listener()
+    mesh_lib.setup_compilation_cache()
+    trials = trials_from_env()
+    if not trials:
+        raise SystemExit(
+            "sweep worker: TRIAL_SWEEP_PARAMETERS is empty — nothing "
+            "to run")
+    steps = int(os.environ.get("TRIAL_SWEEP_STEPS", "30"))
+    report_sweep(run_mnist_sweep(trials, steps=steps))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -36,12 +36,30 @@ class TrainState:
     extra: dict  # mutable model state (e.g. BN batch_stats); {} if none
 
 
+def warmup_cosine(peak, warmup_steps, total_steps):
+    """``optax.warmup_cosine_decay_schedule(0, peak, …, end_value=0)``
+    built from its traceable parts: the optax convenience wrapper
+    Python-branches on ``peak == 0``, so a *traced* peak (the vectorized
+    sweep threads per-trial learning rates through vmap, sweep.py)
+    cannot pass through it. Identical math — linear warmup joined to a
+    cosine decay with alpha 0."""
+    decay = max(total_steps, warmup_steps + 1) - warmup_steps
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, peak, warmup_steps),
+         optax.cosine_decay_schedule(peak, decay, alpha=0.0)],
+        [warmup_steps])
+
+
 def make_optimizer(learning_rate=3e-4, warmup_steps=100,
                    total_steps=100_000, weight_decay=0.01, b1=0.9,
                    b2=0.95, clip_norm=1.0):
-    """AdamW + global-norm clip + warmup-cosine — the standard recipe."""
-    sched = optax.warmup_cosine_decay_schedule(
-        0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1))
+    """AdamW + global-norm clip + warmup-cosine — the standard recipe.
+
+    Every continuous knob (``learning_rate``, ``weight_decay``,
+    ``clip_norm``) may be a traced scalar: the vectorized sweep engine
+    builds this exact optimizer per trial under ``vmap`` with the knobs
+    as per-trial array elements (compute/sweep.py)."""
+    sched = warmup_cosine(learning_rate, warmup_steps, total_steps)
     return optax.chain(
         optax.clip_by_global_norm(clip_norm),
         optax.adamw(sched, b1=b1, b2=b2, weight_decay=weight_decay))
@@ -54,17 +72,25 @@ def init_state(init_params_fn, optimizer, mesh, logical_axes, key,
     full copy), opt_state inherits the params sharding by propagation."""
     shardings = sharding_lib.tree_shardings(mesh, logical_axes, rules)
     replicated = NamedSharding(mesh, PartitionSpec())
+
+    def commit(x):
+        # every leaf must end up NamedSharded on THIS mesh: scalar
+        # leaves of jit(optimizer.init) come back uncommitted
+        # (SingleDeviceSharding), which would (a) leave the train
+        # step's pinned-sharding fast path unused and (b) make a
+        # fresh-init state lower to different StableHLO than an
+        # orbax-restored one — unstable persistent-compile-cache keys
+        sh = getattr(x, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.mesh == mesh:
+            return x
+        return jax.device_put(x, replicated)
+
     with jax.set_mesh(mesh):
         params = jax.jit(init_params_fn, out_shardings=shardings)(key)
-        opt_state = jax.jit(optimizer.init)(params)
-        step = jnp.zeros((), jnp.int32)
+        opt_state = jax.tree.map(commit, jax.jit(optimizer.init)(params))
+        step = jax.device_put(jnp.zeros((), jnp.int32), replicated)
     # put extra on the mesh (replicated) unless the caller pre-sharded it
-    extra = jax.tree.map(
-        lambda x: x if (isinstance(getattr(x, "sharding", None),
-                                   NamedSharding)
-                        and x.sharding.mesh == mesh)
-        else jax.device_put(x, replicated),
-        extra if extra is not None else {})
+    extra = jax.tree.map(commit, extra if extra is not None else {})
     return TrainState(step=step, params=params, opt_state=opt_state,
                       extra=extra)
 

@@ -37,20 +37,28 @@ def params(defaults=None):
     return out
 
 
-def report(value, name=None, extra=None, step=None):
+def report(value, name=None, extra=None, step=None, trial=None):
     """Report the objective. With ``step`` this is an INTERMEDIATE
     report (per-epoch progress): it goes to stdout only and feeds the
     early-stopping service (controllers/hpo.py medianstop) — the
     collector never mistakes it for the final objective. Without
-    ``step`` it is the final report, written to METRICS_PATH too."""
+    ``step`` it is the final report, written to METRICS_PATH too.
+
+    ``trial`` routes the line in a vectorized sweep pod running many
+    trials (compute/sweep.py): the payload carries the trial index and
+    METRICS_PATH is skipped (one file cannot serve K trials; the
+    stdout line is the sweep contract). Single-trial reports
+    (``trial=None``) are byte-identical to before."""
     name = name or os.environ.get("TRIAL_OBJECTIVE_NAME", "objective")
     payload = {"name": name, "value": float(value)}
     if step is not None:
         payload["step"] = int(step)
+    if trial is not None:
+        payload["trial"] = int(trial)
     if extra:
         payload["extra"] = {k: float(v) for k, v in extra.items()}
     print(METRIC_LINE_PREFIX + json.dumps(payload), flush=True)
-    if step is not None:
+    if step is not None or trial is not None:
         return payload
     path = os.environ.get("METRICS_PATH", "/tmp/trial-metrics.json")
     try:
@@ -83,11 +91,18 @@ def run_mnist_trial(hp=None, steps=30):
     from . import train
     from .models import mlp
 
-    hp = params(dict({"lr": 1e-2, "hidden": 64}, **(hp or {})))
+    hp = params(dict({"lr": 1e-2, "hidden": 64, "weight_decay": 0.01,
+                      "clip_norm": 1.0}, **(hp or {})))
     cfg = mlp.Config(in_dim=784, hidden=int(hp["hidden"]), n_classes=10)
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(data=-1))
+    # every continuous knob the vectorized sweep threads per-trial
+    # (compute/sweep.py CONTINUOUS_KEYS) is honored here too — the
+    # "vectorized K trials == K independent trials" invariant requires
+    # the two paths to build the identical optimizer
     opt = train.make_optimizer(learning_rate=float(hp["lr"]),
-                               warmup_steps=2, total_steps=steps)
+                               warmup_steps=2, total_steps=steps,
+                               weight_decay=float(hp["weight_decay"]),
+                               clip_norm=float(hp["clip_norm"]))
     state = train.init_state(lambda k: mlp.init_params(cfg, k), opt, mesh,
                              mlp.logical_axes(cfg), jax.random.PRNGKey(0))
     step = train.make_train_step(train.plain_loss(mlp.loss_fn, cfg), opt,

@@ -28,6 +28,7 @@ re-home). Design:
   conditions).
 """
 
+import json
 import logging
 import re
 
@@ -615,6 +616,12 @@ def validate_study_spec(spec):
     int(spec.get("parallelTrialCount", 0))
     int(spec.get("chipsPerTrial", 1) or 1)
     int(m.deep_get(spec, "algorithm", "seed", default=0) or 0)
+    if spec.get("vectorize") and \
+            m.deep_get(spec, "algorithm", "name") == "pbt":
+        # pbt's generation barrier + per-member checkpoint lineage is
+        # sequenced per trial; packing a generation into one program
+        # would break the exploit/explore flow
+        raise ValueError("vectorize is not supported with pbt")
     if m.deep_get(spec, "algorithm", "name") == "pbt":
         pop = int(m.deep_get(spec, "algorithm", "population",
                              default=0) or 0)
@@ -699,7 +706,7 @@ class StudyJobReconciler(Reconciler):
     def _trial_name(self, study_name, i):
         return f"{study_name}-trial-{i}"
 
-    def _read_trial_logs(self, pod, namespace):
+    def _read_trial_logs(self, pod, namespace, tail_lines=200):
         """Fetch a trial pod's log tail. Cluster mode reads the kubelet
         log endpoint (KubeStore.read_pod_log — works on running pods
         too); the in-process runtimes publish via the
@@ -722,7 +729,8 @@ class StudyJobReconciler(Reconciler):
                 containers[0].get("name"))
         try:
             return reader(m.name_of(pod), namespace,
-                          container=container, tail_lines=200) or ""
+                          container=container,
+                          tail_lines=tail_lines) or ""
         except Exception:
             log.warning(
                 "studyjob: reading logs of trial pod %s/%s failed",
@@ -763,6 +771,8 @@ class StudyJobReconciler(Reconciler):
             if not parsed or parsed.get("name") != metric_name \
                     or not isinstance(parsed.get("value"), (int, float)):
                 continue
+            if parsed.get("trial") is not None:
+                continue    # sweep-indexed lines route via _scrape_sweep
             step = parsed.get("step")
             if step is None:
                 if not terminal_gated:
@@ -773,6 +783,52 @@ class StudyJobReconciler(Reconciler):
 
     def _metric_from_logs(self, pod, namespace, metric_name):
         return self._scrape_trial(pod, namespace, metric_name)[0]
+
+    def _scrape_sweep(self, pod, namespace, metric_name):
+        """One pass over a packed sweep pod's log tail →
+        ``{trial_index: final_value}``. A sweep pod runs MANY trials as
+        one vectorized program (compute/sweep.py) and fans objectives
+        out as one ``trial-metric`` line per trial, each carrying its
+        ``trial`` index — the same line grammar the single-trial
+        scraper parses, plus the routing key. Step-less lines are only
+        trusted once the pod's logs are final (identical gating to
+        ``_scrape_trial``).
+
+        Returns ``(finals, has_logs)``; ``has_logs`` distinguishes
+        "the pod's logs were read and this member never reported"
+        from "the log read itself came back empty" — a transient
+        kubelet failure on a terminal pod must not fail the bucket."""
+        if pod is None:
+            return {}, False
+        from ..compute.trial import parse_metric_line
+        if getattr(self.store, "read_pod_log", None) is not None:
+            terminal_gated = m.deep_get(pod, "status", "phase") not in (
+                "Succeeded", "Failed")
+        else:
+            terminal_gated = m.annotations_of(pod).get(
+                "kubeflow.org/pod-logs-partial") == "true"
+        if terminal_gated:
+            # nothing in a live tail is trustworthy (sweep pods emit
+            # finals only), so skip the log round-trip entirely — the
+            # same short-circuit _scrape_trial takes without reports
+            return {}, False
+        # the tail must hold EVERY member's final line plus incidental
+        # output (shutdown warnings etc.) — the single-trial default of
+        # 200 silently drops members of big buckets past the tail
+        n_members = len([x for x in m.annotations_of(pod).get(
+            "kubeflow.org/sweep-trials", "").split(",") if x])
+        text = self._read_trial_logs(
+            pod, namespace, tail_lines=max(200, 10 * n_members))
+        finals = {}
+        for line in text.splitlines():
+            parsed = parse_metric_line(line)
+            if not parsed or parsed.get("name") != metric_name \
+                    or not isinstance(parsed.get("value"), (int, float)) \
+                    or not isinstance(parsed.get("trial"), int):
+                continue
+            if parsed.get("step") is None:
+                finals[parsed["trial"]] = float(parsed["value"])
+        return finals, bool(text.strip())
 
     def _pbt_values(self, spec, trials, next_index, seed, population,
                     parameters, maximize, ckroot):
@@ -850,6 +906,54 @@ class StudyJobReconciler(Reconciler):
                   "pbt_generation": generation, "pbt_member": member}
         return values, {"status": status, "render": render}
 
+    def _launch_sweeps(self, req, study, spec, trials, batch,
+                       metric_name):
+        """Create one packed sweep pod per shape bucket of ``batch``
+        (``[(index, values)]``), recording each member trial's routing
+        via its ``sweep`` field.
+
+        The pod runs the vectorized sweep worker: the trial template is
+        rendered with the bucket's SHARED shape parameters (continuous
+        knobs reach the worker per-trial through the
+        ``TRIAL_SWEEP_PARAMETERS`` env, the packed-pod contract), takes
+        the standard exclusive-chip placement, and defaults its command
+        to ``python -m kubeflow_tpu.compute.sweep`` when the template
+        does not name one."""
+        from ..compute import sweep as sweep_lib
+        for bkey, members in sweep_lib.bucket_trials(batch):
+            pod_name = f"{req.name}-sweep-{members[0][0]}"
+            template = render_template(
+                spec.get("trialTemplate")
+                or {"spec": {"containers": [{}]}},
+                dict(bkey))
+            pod_spec = apply_trial_placement(
+                m.deep_copy(template.get("spec") or {}), spec, req.name)
+            container = pod_spec["containers"][0]
+            if not container.get("command") and not container.get("args"):
+                container["command"] = [
+                    "python", "-m", "kubeflow_tpu.compute.sweep"]
+            env = container.setdefault("env", [])
+            env.append({"name": "TRIAL_SWEEP_PARAMETERS",
+                        "value": json.dumps(
+                            [{"index": i, "parameters": v}
+                             for i, v in members])})
+            if not any(e.get("name") == "TRIAL_OBJECTIVE_NAME"
+                       for e in env):
+                env.append({"name": "TRIAL_OBJECTIVE_NAME",
+                            "value": metric_name})
+            pod = builtin.pod(
+                pod_name, req.namespace, pod_spec,
+                labels={"studyjob": req.name,
+                        "studyjob-sweep": str(members[0][0])},
+                annotations={"kubeflow.org/sweep-trials": ",".join(
+                    str(i) for i, _ in members)})
+            m.set_controller_reference(pod, study)
+            if self.store.try_get("v1", "Pod", pod_name,
+                                  req.namespace) is None:
+                self.store.create(pod)
+            for i, _ in members:
+                trials[i]["sweep"] = pod_name
+
     def reconcile(self, req):
         study = self.store.try_get(self.API, tsapi.STUDY_KIND, req.name,
                                    req.namespace)
@@ -912,12 +1016,26 @@ class StudyJobReconciler(Reconciler):
         # trial pod's logs for the `trial-metric {...}` stdout line
         # (compute/trial.py report(); Katib's metrics-collector idiom,
         # here without a sidecar)
+        sweep_finals = {}   # sweep pod name -> (finals, has_logs)
+        retry_scrape = False
+        # empty-log retry budget for TERMINAL sweep pods, kept
+        # in-memory (a status-persisted counter would re-wake this
+        # reconciler off its own write and burn the budget instantly);
+        # a restarted controller simply grants a fresh budget
+        retry_counts = getattr(self, "_sweep_scrape_retries", None)
+        if retry_counts is None:
+            retry_counts = self._sweep_scrape_retries = {}
         for i, trial in trials.items():
             if trial.get("state") in ("Succeeded", "Failed",
                                       "EarlyStopped"):
                 continue
             tname = self._trial_name(req.name, i)
-            pod = self.store.try_get("v1", "Pod", tname, req.namespace)
+            # a packed trial's process lives in its sweep pod
+            # (compute/sweep.py): collection routes through that pod's
+            # trial-indexed metric lines instead of a per-trial pod
+            sweep_pod = trial.get("sweep")
+            pod = self.store.try_get("v1", "Pod", sweep_pod or tname,
+                                     req.namespace)
             if pod is not None:
                 # surface placement: where the scheduler put the trial
                 # and which chips the device plugin handed it (published
@@ -937,6 +1055,49 @@ class StudyJobReconciler(Reconciler):
                 # later crashed in teardown
                 trial["state"] = "Succeeded"
                 trial["objectiveValue"] = float(cm["data"][metric_name])
+                continue
+            if sweep_pod:
+                pod_key = (req.namespace, sweep_pod)
+                phase = m.deep_get(pod, "status", "phase") \
+                    if pod is not None else None
+                if sweep_pod not in sweep_finals:
+                    sweep_finals[sweep_pod] = self._scrape_sweep(
+                        pod, req.namespace, metric_name)
+                    if phase == "Succeeded":
+                        # once per pod per pass: spend (or clear) the
+                        # empty-log retry budget
+                        if sweep_finals[sweep_pod][1]:
+                            retry_counts.pop(pod_key, None)
+                        else:
+                            retry_counts[pod_key] = \
+                                retry_counts.get(pod_key, 0) + 1
+                finals, has_logs = sweep_finals[sweep_pod]
+                if i in finals:
+                    trial["state"] = "Succeeded"
+                    trial["objectiveValue"] = finals[i]
+                elif phase == "Failed":
+                    # a crash fails every unreported member (its
+                    # partial lines, if any, are untrustworthy —
+                    # same rule as the single-trial path)
+                    trial["state"] = "Failed"
+                elif phase == "Succeeded":
+                    if has_logs or retry_counts.get(pod_key, 0) > 5:
+                        # clean exit whose (readable) logs skipped this
+                        # member — or a pod whose logs stayed empty
+                        # through every retry (a non-sweep-aware
+                        # command that printed nothing, a permanently
+                        # broken log feed): the objective will never
+                        # arrive
+                        trial["state"] = "Failed"
+                    else:
+                        # the log read came back EMPTY: a transient
+                        # kubelet failure must not permanently fail a
+                        # bucket whose results sit in the pod's logs —
+                        # leave Running and requeue a re-scrape (a
+                        # terminal pod emits no further watch events),
+                        # bounded so a genuinely silent pod still
+                        # terminates the study
+                        retry_scrape = True
                 continue
             if pod is not None and \
                     m.deep_get(pod, "status", "phase") == "Failed":
@@ -973,7 +1134,11 @@ class StudyJobReconciler(Reconciler):
             from . import hpo
             for i, trial in trials.items():
                 if trial.get("state") != "Running" \
-                        or not trial.get("reports"):
+                        or not trial.get("reports") \
+                        or trial.get("sweep"):
+                    # packed trials complete as one program: deleting
+                    # the shared sweep pod would kill the whole bucket,
+                    # so early stopping only judges per-pod trials
                     continue
                 peers = [[(s, v) for s, v in (t.get("reports") or [])]
                          for j, t in trials.items() if j != i]
@@ -1016,6 +1181,29 @@ class StudyJobReconciler(Reconciler):
         ckroot = (m.deep_get(spec, "algorithm", "checkpointDir",
                              default="") or
                   f"/tmp/pbt/{req.namespace}/{req.name}")
+        vectorize = bool(spec.get("vectorize")) and algorithm != "pbt"
+        if vectorize:
+            # ---- vectorized packing (compute/sweep.py): sample every
+            # launchable trial now, bucket by the shape-inducing
+            # hyperparameters, and run each bucket as ONE pod holding
+            # one vmapped program — trials that differ only in
+            # continuous knobs (lr/weight_decay/clip_norm) share a
+            # single XLA compilation and one chip allocation.
+            batch = []
+            while admitted and next_index < max_trials \
+                    and active < parallelism:
+                values = sample_parameters(
+                    parameters, next_index, seed, algorithm,
+                    history=history, maximize=maximize)
+                batch.append((next_index, values))
+                trials[next_index] = {"index": next_index,
+                                      "parameters": values,
+                                      "state": "Running"}
+                active += 1
+                next_index += 1
+            if batch:
+                self._launch_sweeps(req, study, spec, trials, batch,
+                                    metric_name)
         while admitted and next_index < max_trials and active < parallelism:
             pbt_meta = None
             if algorithm == "pbt":
@@ -1094,11 +1282,13 @@ class StudyJobReconciler(Reconciler):
                                    "objectiveValue": best["objectiveValue"]}
         if status != prior_status:
             update_status_preserving_admission(self.store, study, status)
-        if es_enabled and any(t.get("state") == "Running"
-                              for t in trials.values()):
+        if retry_scrape or (
+                es_enabled and any(t.get("state") == "Running"
+                                   for t in trials.values())):
             # kubelet log growth emits no watch events: the medianstop
             # feed must be polled while trials run (the in-process
             # runtime's annotation mirror generates events, but cluster
-            # mode would starve without this)
+            # mode would starve without this); likewise a terminal
+            # sweep pod whose log read transiently failed
             return Result(requeue_after=2.0)
         return Result()

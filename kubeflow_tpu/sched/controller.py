@@ -161,6 +161,29 @@ def _gang_from(obj, kind, chips, terminal_phases, pod_label, store):
         bypass=_int(admission.get("bypass", 0)))
 
 
+def overlay_seqs(gangs, objs):
+    """Assign in-memory arrival seqs to fresh managed workloads (seq
+    0), mutating the Gang objects; returns the freshly-sequenced gangs.
+
+    One definition serves two callers: the QueueReconciler persists the
+    result to ``status.admission.seq``, and the queues web app overlays
+    it read-only — WITHOUT this, a raw snapshot ranks every
+    not-yet-sequenced workload (seq 0) ahead of the whole queue in the
+    planner's (priority, seq) order, so the position view would show
+    fresh arrivals at the front until the controller's write lands."""
+    known = [g.seq for g in gangs if g.seq]
+    next_seq = max(known, default=0) + 1
+    fresh = [g for g in gangs
+             if g.managed and not g.seq and not g.terminal]
+    fresh.sort(key=lambda g: (
+        m.deep_get(objs[g.key], "metadata", "creationTimestamp",
+                   default=""), g.namespace, g.name))
+    for g in fresh:
+        g.seq = next_seq
+        next_seq += 1
+    return fresh
+
+
 def build_state(store):
     """Snapshot the world: (gangs, ledger, objects-by-key). Shared by
     the reconciler and web/queues.py so both see the same math."""
@@ -234,17 +257,10 @@ class QueueReconciler(Reconciler):
     def _assign_seqs(self, gangs, objs):
         """First sighting of a managed workload: persist its arrival
         order. New arrivals are sequenced by creation time (name as the
-        deterministic tiebreak within one clock tick)."""
-        known = [g.seq for g in gangs if g.seq]
-        next_seq = max(known, default=0) + 1
-        fresh = [g for g in gangs
-                 if g.managed and not g.seq and not g.terminal]
-        fresh.sort(key=lambda g: (
-            m.deep_get(objs[g.key], "metadata", "creationTimestamp",
-                       default=""), g.namespace, g.name))
-        for g in fresh:
-            g.seq = next_seq
-            next_seq += 1
+        deterministic tiebreak within one clock tick) — the in-memory
+        assignment is ``overlay_seqs``, shared with the read-only
+        queues web view."""
+        for g in overlay_seqs(gangs, objs):
             self._update_admission(objs[g.key],
                                    {"admitted": False, "seq": g.seq})
 
@@ -313,10 +329,20 @@ class QueueReconciler(Reconciler):
             self._update_admission(objs[key], {"reason": reason})
 
         namespaces = set(ledger.nominal) | {g.namespace for g in gangs}
-        for ns in namespaces:
+        # namespaces that reported gauges before are revisited even
+        # when gone from the snapshot: a gauge keeps its last value
+        # forever, so a removed quota would otherwise show phantom
+        # used/free chips until process restart
+        reported = {key[0] for key in _QUOTA_CHIPS.samples()}
+        for ns in namespaces | reported:
             report = ledger.report(ns, result.reserved.get(ns, 0))
             if report["nominal"] is None:
-                continue        # unconstrained: no meaningful gauge
+                # unconstrained: no meaningful gauge — zero any stale
+                # label sets left from when this namespace had a quota
+                if ns in reported:
+                    for state in ("used", "reserved", "free"):
+                        _QUOTA_CHIPS.labels(ns, state).set(0)
+                continue
             _QUOTA_CHIPS.labels(ns, "used").set(report["used"])
             _QUOTA_CHIPS.labels(ns, "reserved").set(report["reserved"])
             _QUOTA_CHIPS.labels(ns, "free").set(report["free"])

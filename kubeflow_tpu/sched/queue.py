@@ -131,8 +131,14 @@ def plan(gangs, ledger, max_bypass=MAX_BYPASS):
             continue
         head = ledger.headroom(g.namespace)
         deficit = g.chips - (head if head is not None else 0)
+        # victims must be MANAGED: an unmanaged gang (no spec.queue) is
+        # implicitly admitted — revoking a grant it never had is a
+        # no-op the workload reconciler ignores, so "evicting" one
+        # frees nothing and the preemptor livelocks re-selecting it
+        # every pass
         candidates = [v for v in active
-                      if v.admitted and not v.releasing and v.preemptible
+                      if v.managed and v.admitted and not v.releasing
+                      and v.preemptible
                       and v.namespace in ledger.members(g.namespace)]
         victims = _victims_for(g, candidates, deficit)
         for v in victims:

@@ -51,6 +51,11 @@ def _entry(gang, obj, positions):
 
 def _namespace_view(store, ns):
     gangs, ledger, objs = schedctl.build_state(store)
+    # overlay the arrival seqs the controller would assign: a raw
+    # snapshot leaves fresh workloads at seq 0, which would sort them
+    # ahead of the WHOLE queue in the planner's (priority, seq) order
+    # until the controller persists their seq
+    schedctl.overlay_seqs(gangs, objs)
     result = squeue.plan(gangs, ledger)
     queues = {}
     for g in sorted(gangs, key=lambda g: (g.queue, -g.priority, g.seq,

@@ -1,0 +1,144 @@
+#!/usr/bin/env python3
+"""StudyJob sweep load test — vectorized vs per-trial-pod HPO.
+
+Drives the REAL control plane end to end: an in-process apiserver +
+StudyJobReconciler + ProcessPodRuntime executing trial pods as live
+subprocesses, exactly the stack the e2e tier uses. Submits N studies
+over the same hyperparameter grid twice — once with ``vectorize:
+true`` (packed sweep pods, one vmapped program per shape bucket,
+compute/sweep.py) and once per-trial — and reports wall-clock
+trials/hour for each plus the speedup, INCLUDING all controller,
+scrape and process-spawn overhead (bench.py's study mode measures the
+pod payloads alone; this measures the platform).
+
+    python loadtest/studyjob_sweep.py --studies 2 --trials 8
+    python loadtest/studyjob_sweep.py --sequential-too
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_argparser():
+    ap = argparse.ArgumentParser(prog="studyjob_sweep")
+    ap.add_argument("--studies", type=int, default=1,
+                    help="concurrent StudyJobs per phase")
+    ap.add_argument("--trials", type=int, default=8,
+                    help="maxTrialCount per study")
+    ap.add_argument("--steps", type=int, default=30,
+                    help="train steps per trial")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-phase completion deadline (s)")
+    ap.add_argument("--sequential-too", action="store_true",
+                    help="also run the per-trial-pod phase and report "
+                         "the speedup (slower: one process per trial)")
+    ap.add_argument("--workdir", default="/tmp/studyjob-sweep-loadtest")
+    return ap
+
+
+def make_study(name, trials, steps, vectorize):
+    from kubeflow_tpu.api import tpuslice as tsapi
+    study = tsapi.new_study(
+        name, "default",
+        objective={"type": "minimize", "metricName": "objective"},
+        parameters=[
+            {"name": "lr", "type": "double", "min": 1e-4, "max": 1e-2,
+             "scale": "log", "steps": max(2, trials // 2)},
+            {"name": "hidden", "type": "categorical",
+             "values": [64, 128]},
+        ],
+        trial_template={"spec": {"containers": [{
+            "name": "trial", "image": "local",
+            "command": [sys.executable, "-m",
+                        "kubeflow_tpu.compute.sweep" if vectorize
+                        else "kubeflow_tpu.compute.trial"],
+            "env": [{"name": "TRIAL_SWEEP_STEPS", "value": str(steps)}]
+            if vectorize else
+            [{"name": "TRIAL_PARAMETERS",
+              "value": '{"lr": {{lr}}, "hidden": {{hidden}}}'}],
+        }]}},
+        max_trials=trials, parallelism=trials, algorithm="grid",
+        vectorize=vectorize or None)
+    return study
+
+
+def run_phase(label, vectorize, args):
+    from kubeflow_tpu import api
+    from kubeflow_tpu.controllers.process_runtime import \
+        ProcessPodRuntime
+    from kubeflow_tpu.controllers.tpuslice import StudyJobReconciler
+    from kubeflow_tpu.core.manager import Manager
+    from kubeflow_tpu.core.store import ObjectStore
+
+    workdir = os.path.join(args.workdir, label)
+    os.makedirs(workdir, exist_ok=True)
+    store = ObjectStore()
+    api.register_all(store)
+    runtime = ProcessPodRuntime(gang_label="studyjob", workdir=workdir,
+                                extra_env={"PYTHONPATH": REPO})
+    mgr = Manager(store)
+    mgr.add(StudyJobReconciler())
+    mgr.add(runtime)
+    mgr.start()
+    names = [f"{label}-{i}" for i in range(args.studies)]
+    n_trials = args.studies * args.trials
+    t0 = time.perf_counter()
+    try:
+        for name in names:
+            store.create(make_study(name, args.trials, args.steps,
+                                    vectorize))
+        deadline = time.time() + args.timeout
+        while time.time() < deadline:
+            phases = [
+                (store.get("kubeflow.org/v1alpha1", "StudyJob", n,
+                           "default").get("status") or {}).get("phase")
+                for n in names]
+            if all(p in ("Completed", "Failed") for p in phases):
+                break
+            time.sleep(0.5)
+        else:
+            raise RuntimeError(f"{label}: studies still running at "
+                               f"the {args.timeout:.0f}s deadline")
+        dt = time.perf_counter() - t0
+        ok = failed = 0
+        for n in names:
+            status = store.get("kubeflow.org/v1alpha1", "StudyJob", n,
+                               "default")["status"]
+            for t in status.get("trials") or []:
+                if t.get("state") == "Succeeded":
+                    ok += 1
+                else:
+                    failed += 1
+    finally:
+        runtime.close()
+        mgr.stop()
+    return {"label": label, "wall_s": round(dt, 2),
+            "trials_ok": ok, "trials_failed": failed,
+            "trials_per_hr": round(n_trials / dt * 3600, 0)}
+
+
+def main(argv=None):
+    args = build_argparser().parse_args(argv)
+    vec = run_phase("vectorized", True, args)
+    print(vec)
+    if vec["trials_failed"]:
+        return 1
+    if args.sequential_too:
+        seq = run_phase("sequential", False, args)
+        print(seq)
+        if seq["trials_failed"]:
+            return 1
+        print({"speedup": round(
+            vec["trials_per_hr"] / max(seq["trials_per_hr"], 1), 2)})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -583,3 +583,89 @@ class TestPostSliceQuotaCeiling:
                                "4x4", {"containers": [{}]})
         r = c.post("/api/namespaces/team-a/tpuslices", json_body=body)
         assert r.status == 200
+
+
+class TestPreemptionVictimEligibility:
+    """ROADMAP item (a): an unmanaged gang (no spec.queue) is
+    implicitly admitted — revoking a grant it never had is a no-op the
+    workload reconciler ignores, so picking one as a preemption victim
+    frees nothing and livelocks the preemptor re-selecting it forever."""
+
+    def test_unmanaged_gangs_are_never_victims(self):
+        led = QuotaLedger({"team-a": 8})
+        legacy = gang("legacy", 8, admitted=True, managed=False)
+        hi = gang("hi", 8, priority=5, seq=1)
+        plan = squeue.plan([legacy, hi], led)
+        assert plan.preempt == []
+        assert "no lower-priority victims" in plan.blocked[hi.key]
+
+    def test_managed_victim_still_chosen_over_unmanaged(self):
+        led = QuotaLedger({"team-a": 16})
+        legacy = gang("legacy", 8, admitted=True, managed=False)
+        low = gang("low", 8, admitted=True, admitted_seq=1)
+        hi = gang("hi", 8, priority=5, seq=1)
+        plan = squeue.plan([legacy, low, hi], led)
+        assert [v.name for v, _ in plan.preempt] == ["low"]
+
+
+class TestQuotaGaugeLifecycle:
+    """ROADMAP item (b): removing a namespace's quota must zero its
+    sched_quota_chips label sets — a gauge keeps its last value
+    forever, so `continue` left phantom used/free chips on dashboards."""
+
+    def _mgr(self, store, manager):
+        manager.add(QueueReconciler())
+        manager.start_sync()
+        return manager
+
+    def test_gauges_zeroed_when_quota_removed(self, store, manager):
+        self._mgr(store, manager)
+        quota_profile(store, chips=16)
+        slice_ = make_slice("gang-a")
+        store.create(slice_)
+        manager.run_sync()
+        assert schedctl._QUOTA_CHIPS.value("team-a", "used") == 16
+        store.delete(f"{papi.GROUP}/{papi.VERSION}", papi.KIND,
+                     "team-a")
+        manager.run_sync()
+        for state in ("used", "reserved", "free"):
+            assert schedctl._QUOTA_CHIPS.value("team-a", state) == 0
+
+
+class TestQueuesViewSeqOverlay:
+    """ROADMAP item (c): the position view must assign in-memory seqs
+    before planning — a raw snapshot leaves fresh workloads at seq 0,
+    sorting them ahead of the WHOLE queue until the controller's
+    persisted seq lands."""
+
+    @pytest.fixture(autouse=True)
+    def _no_auth(self, monkeypatch):
+        monkeypatch.setenv("APP_DISABLE_AUTH", "true")
+        monkeypatch.setenv("APP_SECURE_COOKIES", "false")
+
+    def test_fresh_workload_queues_behind_the_veteran(self, store):
+        quota_profile(store, chips=16)
+        running = make_slice("running")
+        running["status"] = {"admission": {"admitted": True, "seq": 1,
+                                           "admittedSeq": 1}}
+        store.create(running)
+        veteran = make_slice("veteran")
+        veteran["status"] = {"admission": {"admitted": False, "seq": 2}}
+        store.create(veteran)
+        store.create(make_slice("fresh"))   # no persisted seq yet
+        c = http.TestClient(queues_web.create_app(store))
+        r = c.get("/api/namespaces/team-a/queues")
+        assert r.status == 200
+        entries = {e["name"]: e
+                   for q in r.json["queues"] for e in q["entries"]}
+        assert entries["veteran"]["position"] == 1
+        assert entries["fresh"]["position"] == 2
+
+    def test_view_does_not_persist_overlaid_seqs(self, store):
+        quota_profile(store, chips=16)
+        store.create(make_slice("fresh"))
+        c = http.TestClient(queues_web.create_app(store))
+        assert c.get("/api/namespaces/team-a/queues").status == 200
+        live = get_slice(store, "fresh")
+        # read-only view: the store object still has no admission seq
+        assert m.deep_get(live, "status", "admission", "seq") is None

@@ -1295,3 +1295,237 @@ class TestPBTLineageSafety:
         assert meta_best["event"] == "continue"
         assert meta_mid["event"] == "continue"     # median survives
         assert meta_worst["event"] == "exploit"
+
+
+class TestVectorizedStudy:
+    """spec.vectorize: shape-compatible pending trials pack into ONE
+    sweep pod per bucket (compute/sweep.py), objectives fan back in
+    through trial-indexed metric lines — collector and best-trial
+    selection behave exactly as for per-trial pods."""
+
+    def _mgr(self, store, manager):
+        manager.add(StudyJobReconciler())
+        manager.add(PodRuntimeReconciler())
+        manager.start_sync()
+        return manager
+
+    def _study(self, store, max_trials=4, parallelism=4, **kw):
+        study = tsapi.new_study(
+            "vec", "default",
+            objective={"type": "maximize", "metricName": "accuracy"},
+            parameters=[
+                {"name": "lr", "type": "double", "min": 0.001,
+                 "max": 0.1, "scale": "log"},
+                {"name": "hidden", "type": "categorical",
+                 "values": [64, 128]},
+            ],
+            trial_template={"spec": {"containers": [{
+                "name": "trial", "image": "sweep:1"}]}},
+            max_trials=max_trials, parallelism=parallelism,
+            algorithm="grid", vectorize=True, **kw)
+        store.create(study)
+        return study
+
+    def _sweep_pods(self, store):
+        from kubeflow_tpu.core import meta as m
+        return sorted(
+            (p for p in store.list("v1", "Pod", "default")
+             if m.name_of(p).startswith("vec-sweep-")),
+            key=lambda p: m.name_of(p))
+
+    def _finish(self, store, pod, values, partial=False):
+        """Publish a sweep pod's trial-indexed final lines."""
+        import json as _json
+        from kubeflow_tpu.core import meta as m
+        lines = "\n".join(
+            "trial-metric " + _json.dumps(
+                {"name": "accuracy", "value": v, "trial": i})
+            for i, v in values.items())
+        m.set_annotation(pod, "kubeflow.org/pod-logs", lines)
+        if partial:
+            m.set_annotation(pod, "kubeflow.org/pod-logs-partial",
+                             "true")
+        else:
+            pod["status"] = {"phase": "Succeeded"}
+        store.update(pod)
+
+    def test_buckets_become_one_pod_each(self, store, manager):
+        import json as _json
+        self._mgr(store, manager)
+        self._study(store)      # grid over 2 hiddens x 2 lrs
+        manager.run_sync()
+        pods = self._sweep_pods(store)
+        assert len(pods) == 2   # one per hidden bucket
+        seen = set()
+        for pod in pods:
+            env = {e["name"]: e.get("value")
+                   for e in pod["spec"]["containers"][0]["env"]}
+            members = _json.loads(env["TRIAL_SWEEP_PARAMETERS"])
+            hiddens = {t["parameters"]["hidden"] for t in members}
+            assert len(hiddens) == 1        # never mixes shapes
+            assert env["TRIAL_OBJECTIVE_NAME"] == "accuracy"
+            seen |= {t["index"] for t in members}
+            # packed pod still takes exclusive chip placement
+            limits = pod["spec"]["containers"][0]["resources"]["limits"]
+            assert limits["google.com/tpu"] == "1"
+            assert pod["spec"]["containers"][0]["command"] == [
+                "python", "-m", "kubeflow_tpu.compute.sweep"]
+        assert seen == {0, 1, 2, 3}
+        study = store.get("kubeflow.org/v1alpha1", "StudyJob", "vec",
+                          "default")
+        trials = study["status"]["trials"]
+        assert all(t["sweep"].startswith("vec-sweep-") for t in trials)
+        assert all(t["state"] == "Running" for t in trials)
+
+    def test_objectives_fan_back_to_their_trials(self, store, manager):
+        self._mgr(store, manager)
+        self._study(store)
+        manager.run_sync()
+        study = store.get("kubeflow.org/v1alpha1", "StudyJob", "vec",
+                          "default")
+        by_pod = {}
+        for t in study["status"]["trials"]:
+            by_pod.setdefault(t["sweep"], []).append(t["index"])
+        for pod in self._sweep_pods(store):
+            members = by_pod[pod["metadata"]["name"]]
+            self._finish(store, pod,
+                         {i: 0.5 + 0.1 * i for i in members})
+        manager.run_sync()
+        study = store.get("kubeflow.org/v1alpha1", "StudyJob", "vec",
+                          "default")
+        assert study["status"]["phase"] == "Completed"
+        for t in study["status"]["trials"]:
+            assert t["state"] == "Succeeded"
+            assert t["objectiveValue"] == 0.5 + 0.1 * t["index"]
+        assert study["status"]["bestTrial"]["index"] == 3
+
+    def test_partial_live_logs_never_complete_swept_trials(
+            self, store, manager):
+        self._mgr(store, manager)
+        self._study(store)
+        manager.run_sync()
+        pod = self._sweep_pods(store)[0]
+        import json as _json
+        members = [int(x) for x in pod["metadata"]["annotations"]
+                   ["kubeflow.org/sweep-trials"].split(",")]
+        self._finish(store, pod, {members[0]: 0.9}, partial=True)
+        manager.run_sync()
+        study = store.get("kubeflow.org/v1alpha1", "StudyJob", "vec",
+                          "default")
+        states = {t["index"]: t["state"]
+                  for t in study["status"]["trials"]}
+        assert states[members[0]] == "Running"
+
+    def test_failed_sweep_pod_fails_unreported_members(
+            self, store, manager):
+        self._mgr(store, manager)
+        self._study(store)
+        manager.run_sync()
+        pod = self._sweep_pods(store)[0]
+        members = [int(x) for x in pod["metadata"]["annotations"]
+                   ["kubeflow.org/sweep-trials"].split(",")]
+        # pod crashes after reporting only its first member
+        import json as _json
+        from kubeflow_tpu.core import meta as m
+        line = "trial-metric " + _json.dumps(
+            {"name": "accuracy", "value": 0.7, "trial": members[0]})
+        m.set_annotation(pod, "kubeflow.org/pod-logs", line)
+        pod["status"] = {"phase": "Failed"}
+        store.update(pod)
+        manager.run_sync()
+        study = store.get("kubeflow.org/v1alpha1", "StudyJob", "vec",
+                          "default")
+        states = {t["index"]: t["state"]
+                  for t in study["status"]["trials"]}
+        assert states[members[0]] == "Succeeded"   # its line was final
+        for i in members[1:]:
+            assert states[i] == "Failed"
+
+    def test_metrics_configmap_still_wins(self, store, manager):
+        self._mgr(store, manager)
+        self._study(store)
+        manager.run_sync()
+        cm = builtin.config_map("vec-trial-0-metrics", "default",
+                                {"accuracy": "0.99"},
+                                labels={"studyjob": "vec"})
+        store.create(cm)
+        manager.run_sync()
+        study = store.get("kubeflow.org/v1alpha1", "StudyJob", "vec",
+                          "default")
+        t0 = study["status"]["trials"][0]
+        assert t0["state"] == "Succeeded"
+        assert t0["objectiveValue"] == 0.99
+
+    def test_vectorize_with_pbt_is_invalid_spec(self, store, manager):
+        self._mgr(store, manager)
+        study = tsapi.new_study(
+            "vec", "default",
+            objective={"type": "maximize", "metricName": "accuracy"},
+            parameters=[{"name": "lr", "type": "double", "min": 0.001,
+                         "max": 0.1}],
+            trial_template={"spec": {"containers": [{}]}},
+            max_trials=4, parallelism=2, algorithm="pbt",
+            vectorize=True)
+        study["spec"]["algorithm"]["population"] = 2
+        store.create(study)
+        manager.run_sync()
+        study = store.get("kubeflow.org/v1alpha1", "StudyJob", "vec",
+                          "default")
+        assert study["status"]["phase"] == "Failed"
+        cond = study["status"]["conditions"][0]
+        assert cond["reason"] == "InvalidSpec"
+        assert "vectorize" in cond["message"]
+
+    def test_template_command_wins_over_default(self, store, manager):
+        self._mgr(store, manager)
+        study = tsapi.new_study(
+            "vec", "default",
+            objective={"type": "maximize", "metricName": "accuracy"},
+            parameters=[{"name": "hidden", "type": "categorical",
+                         "values": [64]}],
+            trial_template={"spec": {"containers": [{
+                "name": "trial", "image": "custom:1",
+                "command": ["/app/sweep-worker", "--hidden={{hidden}}"],
+            }]}},
+            max_trials=2, parallelism=2, algorithm="grid",
+            vectorize=True)
+        store.create(study)
+        manager.run_sync()
+        pod = self._sweep_pods(store)[0]
+        cmd = pod["spec"]["containers"][0]["command"]
+        # user command kept, shape params rendered into it
+        assert cmd == ["/app/sweep-worker", "--hidden=64"]
+
+    def test_empty_log_read_on_terminal_pod_does_not_fail_bucket(
+            self, store, manager):
+        """A transient kubelet/log failure on a Succeeded sweep pod
+        returns empty logs — the bucket's members must stay Running
+        (requeued for a re-scrape), not go terminally Failed while
+        their objectives sit unread in the pod's logs."""
+        from kubeflow_tpu.core import meta as m
+        self._mgr(store, manager)
+        self._study(store)
+        manager.run_sync()
+        pod = self._sweep_pods(store)[0]
+        members = [int(x) for x in pod["metadata"]["annotations"]
+                   ["kubeflow.org/sweep-trials"].split(",")]
+        # terminal pod, but no logs readable yet
+        pod["status"] = {"phase": "Succeeded"}
+        store.update(pod)
+        manager.run_sync()
+        study = store.get("kubeflow.org/v1alpha1", "StudyJob", "vec",
+                          "default")
+        states = {t["index"]: t["state"]
+                  for t in study["status"]["trials"]}
+        for i in members:
+            assert states[i] == "Running"
+        # logs become readable: the re-scrape completes the bucket
+        pod = store.get("v1", "Pod", pod["metadata"]["name"], "default")
+        self._finish(store, pod, {i: 0.5 for i in members})
+        manager.run_sync()
+        study = store.get("kubeflow.org/v1alpha1", "StudyJob", "vec",
+                          "default")
+        states = {t["index"]: t["state"]
+                  for t in study["status"]["trials"]}
+        for i in members:
+            assert states[i] == "Succeeded"
