@@ -159,27 +159,43 @@ def bench_lm(steps, batch):
     # lax.scan body once, so it undercounts scanned+remat'd models —
     # reported raw in the detail for transparency.)
     mfu = tps * transformer.flops_per_token(cfg) / _peak_flops()
+    mfu_live = _live_mfu_check(
+        "bench-lm", transformer.flops_per_token(cfg) * batch
+        * cfg.max_seq, steps, dt, mfu)
     return {"metric": "lm_train_tokens_per_sec", "value": round(tps, 0),
             "unit": "tokens/sec",
             "vs_baseline": round(tps / LM_BASELINE_TOKENS, 3),
             "detail": {"params": transformer.param_count(cfg),
                        "batch": batch, "seq": cfg.max_seq,
                        "step_ms": round(1000 * dt / steps, 2),
-                       "mfu": round(mfu, 3)}}
+                       "mfu": round(mfu, 3),
+                       "mfu_live": round(mfu_live, 3)}}
 
 
 def _peak_flops():
-    """bf16 peak per chip: v5e 197 TFLOPs, v4 275, v5p 459."""
-    kind = jax.devices()[0].device_kind.lower()
-    if "v5 lite" in kind or "v5e" in kind:
-        return 197e12
-    if "v4" in kind:
-        return 275e12
-    if "v5" in kind or "v5p" in kind:
-        return 459e12
-    if "v6" in kind:
-        return 918e12
-    return 197e12
+    """bf16 peak per chip — ONE definition shared with the live
+    ``train_mfu`` gauge (compute/telemetry.py), so offline and live
+    MFU can only diverge if the flops-model *wiring* breaks (which
+    the lm mode asserts on)."""
+    from kubeflow_tpu.compute import telemetry as telem
+    return telem.peak_flops()
+
+
+def _live_mfu_check(model, flops_per_step, steps, dt, mfu_offline):
+    """Feed the live telemetry path with the measured loop and return
+    the ``train_mfu`` gauge value; raises if live and offline MFU
+    diverge >10% — the guard that the live gauge's flops model and
+    denominator stay wired to the same math bench publishes."""
+    from kubeflow_tpu.compute import telemetry as telem
+    tele = telem.TrainTelemetry(model, flops_per_step=flops_per_step)
+    tele.observe_steps(steps, dt)
+    live = tele.live_mfu()
+    if mfu_offline > 0 and abs(live - mfu_offline) > 0.1 * mfu_offline:
+        raise RuntimeError(
+            f"live train_mfu gauge {live:.4f} diverges >10% from "
+            f"offline MFU {mfu_offline:.4f} for {model} — the "
+            f"flops-model wiring (telemetry vs bench) is broken")
+    return live
 
 
 def bench_bert(steps, batch):
@@ -212,6 +228,9 @@ def bench_bert(steps, batch):
     tps = steps * batch * cfg.max_seq / dt
     # 6ND convention (see bench_lm on why not XLA cost analysis here)
     mfu = tps * bert.flops_per_token(cfg) / _peak_flops()
+    mfu_live = _live_mfu_check(
+        "bench-bert", bert.flops_per_token(cfg) * batch * cfg.max_seq,
+        steps, dt, mfu)
     return {"metric": "bert_base_pretrain_tokens_per_sec",
             "value": round(tps, 0), "unit": "tokens/sec",
             "vs_baseline": round(tps / LM_BASELINE_TOKENS, 3),
@@ -219,7 +238,8 @@ def bench_bert(steps, batch):
                        "seq": cfg.max_seq,
                        "samples_per_sec": round(steps * batch / dt, 1),
                        "step_ms": round(1000 * dt / steps, 2),
-                       "mfu": round(mfu, 3)}}
+                       "mfu": round(mfu, 3),
+                       "mfu_live": round(mfu_live, 3)}}
 
 
 def bench_serving(steps, batch):

@@ -1,18 +1,39 @@
 """CI lint: every registered metric family must have a Prometheus-legal
-name (``^[a-z_][a-z0-9_]*$``) and non-empty help text.
+name (``^[a-z_][a-z0-9_]*$``) and non-empty help text, the documented
+family table must not drift from the code, and the required families
+must stay registered.
 
-Registration already enforces this (obs/metrics.py raises), so the lint
-mostly guards two drift paths: a family added to a registry assembled
-by hand (bypassing Registry._register) and a future relaxation of the
-registration check. Importing every instrumented layer below populates
+Registration already enforces the name/help rules (obs/metrics.py
+raises), so the lint mostly guards drift paths: a family added to a
+registry assembled by hand (bypassing Registry._register), a future
+relaxation of the registration check, a family renamed in code but not
+in docs/observability.md (or vice versa), and a required family
+silently dropped. Importing every instrumented layer below populates
 the process-global registry with the real production families — what a
-scrape of any ``/metrics`` endpoint would serve.
+scrape of any ``/metrics`` endpoint (or the fleet hub's merged one)
+would serve.
 
     python -m ci.metrics_lint
 """
 
 import os
+import re
 import sys
+
+#: families documented in docs/observability.md's tables — one row per
+#: family, first cell the backticked name
+_DOC_FAMILY_RE = re.compile(r"^\|\s*`([a-z_][a-z0-9_]*)`\s*\|")
+
+
+def documented_families(repo_root):
+    path = os.path.join(repo_root, "docs", "observability.md")
+    families = set()
+    with open(path) as f:
+        for line in f:
+            mo = _DOC_FAMILY_RE.match(line)
+            if mo:
+                families.add(mo.group(1))
+    return families
 
 
 def main():
@@ -21,9 +42,11 @@ def main():
     # import side effects register each layer's module-level families
     import kubeflow_tpu.compute.serving       # noqa: F401
     import kubeflow_tpu.compute.sweep         # noqa: F401
+    import kubeflow_tpu.compute.telemetry     # noqa: F401
     import kubeflow_tpu.controllers.tpuslice  # noqa: F401
     import kubeflow_tpu.core.manager          # noqa: F401
     import kubeflow_tpu.core.workqueue        # noqa: F401
+    import kubeflow_tpu.obs.aggregate         # noqa: F401
     import kubeflow_tpu.sched.controller      # noqa: F401
     import kubeflow_tpu.web.http              # noqa: F401
     from kubeflow_tpu.controllers.metrics import NotebookMetrics
@@ -37,10 +60,10 @@ def main():
     problems = obs_metrics.REGISTRY.lint() + scratch.lint()
     checked = len(obs_metrics.REGISTRY._metrics) + len(scratch._metrics)
 
-    # drift guard for the scheduler + gang + serving domains: these
-    # families are what docs/scheduling.md, docs/observability.md and
-    # the dashboards promise exist — a rename or accidental drop must
-    # fail the build, not the scrape
+    # drift guard for the scheduler + gang + serving + fleet domains:
+    # these families are what docs/scheduling.md, docs/observability.md
+    # and the dashboards promise exist — a rename or accidental drop
+    # must fail the build, not the scrape
     required = {
         "sched_admitted_total", "sched_preempted_total",
         "sched_queue_wait_seconds", "sched_quota_chips",
@@ -59,16 +82,42 @@ def main():
         "sweep_trials_per_program",
         "sweep_bucket_occupancy_ratio",
         "sweep_compile_cache_total",
+        # fleet telemetry plane (compute/telemetry.py feeds the train
+        # families; obs/aggregate.py counts skipped shards; bench.py
+        # cross-checks train_mfu against its offline computation)
+        "train_step_seconds",
+        "train_mfu",
+        "train_compile_seconds_total",
+        "train_goodput_seconds_total",
+        "obs_shard_read_errors_total",
     }
     registered = {metric.name for metric in obs_metrics.REGISTRY._metrics}
+    scratch_names = {metric.name for metric in scratch._metrics}
     for name in sorted(required - registered):
         problems.append(f"required family {name} is not registered")
+
+    # docs <-> code drift: every family the docs table documents must
+    # exist in the codebase, and every required family must be
+    # documented (a family nobody can look up is a family nobody uses)
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    documented = documented_families(repo_root)
+    for name in sorted(documented - registered - scratch_names):
+        problems.append(
+            f"docs/observability.md documents family {name} which is "
+            f"not registered anywhere in the codebase")
+    for name in sorted(required - documented):
+        problems.append(
+            f"required family {name} is missing from the "
+            f"docs/observability.md family table")
+
     if problems:
         print("metrics lint FAILED:")
         for p in problems:
             print(f"  - {p}")
         return 1
-    print(f"metrics lint OK: {checked} families checked")
+    print(f"metrics lint OK: {checked} families checked, "
+          f"{len(documented)} documented")
     return 0
 
 

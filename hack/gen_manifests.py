@@ -84,6 +84,12 @@ WEB_APPS = {
                        "port": 5000, "prefix": "/slices"},
     "queues-web-app": {"image": PLATFORM_IMAGE,
                        "port": 5000, "prefix": "/queues"},
+    # fleet telemetry hub (web/metrics_hub.py): merges the per-pod
+    # shard files workers export to the workspace PVC into one
+    # /metrics + /debug/traces; the dashboard menu links it
+    "metrics-hub": {"image": PLATFORM_IMAGE,
+                    "port": 5000, "prefix": "/metrics-hub",
+                    "env": {"OBS_EXPORT_DIR": "/workspace/obs/shards"}},
     "access-management": {"image": PLATFORM_IMAGE,
                           "port": 8081, "prefix": "/kfam"},
     "centraldashboard": {"image": PLATFORM_IMAGE,
@@ -147,8 +153,12 @@ def deployment(name, image, env=None, port=None, args=None,
     container = {
         "name": name,
         "image": image,
+        # POD_NAME names the telemetry shard (obs/export.py): replicas
+        # of one component must never share a shard file
         "env": [{"name": k, "value": v}
-                for k, v in sorted((env or {}).items())],
+                for k, v in sorted((env or {}).items())]
+        + [{"name": "POD_NAME", "valueFrom": {"fieldRef": {
+            "fieldPath": "metadata.name"}}}],
         "resources": {"requests": {"cpu": "100m", "memory": "128Mi"},
                       "limits": {"cpu": "1", "memory": "1Gi"}},
         "livenessProbe": {"httpGet": {"path": "/healthz",
@@ -298,7 +308,8 @@ def main():
     for name, spec in WEB_APPS.items():
         docs = rbac(name)
         docs.append(deployment(name, spec["image"],
-                               {"USERID_HEADER": "kubeflow-userid"},
+                               {"USERID_HEADER": "kubeflow-userid",
+                                **spec.get("env", {})},
                                port=spec["port"], args=[name]))
         docs.append(service(name, 80, target=spec["port"]))
         docs.append(virtual_service(name, spec["prefix"], 80))

@@ -37,10 +37,15 @@ def new(name, namespace, selector, desc="", **fields):
 
 
 def tpu_worker_pod_default(namespace, slice_name, num_workers,
-                           chips_per_host=4, topology="2x2x1"):
+                           chips_per_host=4, topology="2x2x1",
+                           extra_env=None):
     """PodDefault that wires a pod into a TPU pod-slice: worker identity via
     the downward API ordinal, peer discovery via the slice headless
-    service. Pods opt in with label ``tpu-slice: <slice_name>``."""
+    service. Pods opt in with label ``tpu-slice: <slice_name>``.
+
+    ``extra_env`` appends additional injected env (the TpuSlice
+    controller uses it for the fleet-telemetry contract: TRACEPARENT /
+    OBS_GANG / POD_NAME)."""
     hostnames = ",".join(
         f"{slice_name}-{i}.{slice_name}.{namespace}.svc" for i in range(num_workers))
     return new(
@@ -57,6 +62,7 @@ def tpu_worker_pod_default(namespace, slice_name, num_workers,
             {"name": "JAX_COORDINATOR_ADDRESS",
              "value": f"{slice_name}-0.{slice_name}.{namespace}.svc:8476"},
             {"name": "JAX_NUM_PROCESSES", "value": str(num_workers)},
+            *(extra_env or []),
         ],
     )
 

@@ -50,6 +50,18 @@ def _run_manager(reconcilers, store=None, election_id=None):
     for r in reconcilers:
         mgr.add(r)
     mgr.start()
+    # fleet telemetry: controllers ship their registry + spans to the
+    # workspace shard dir so the metrics hub merges control-plane and
+    # worker state into one /metrics (no-op without a shard dir)
+    from ..obs import export as obs_export
+    exporter = obs_export.start_exporter(
+        fallback_pod=reconcilers[0].name if reconcilers else None)
+    stop = mgr.stop
+    if exporter is not None:
+        def stop(_mgr_stop=mgr.stop, _exp=exporter):
+            _mgr_stop()
+            _exp.stop()
+        mgr.stop = stop
     return mgr, store
 
 
@@ -155,12 +167,16 @@ def admission_webhook(argv=()):
     _block()
 
 
-def _web(create_app, default_port):
+def _web(create_app, default_port, export_shards=True):
     store = _store()
     app = create_app(store)
     httpd = app.serve(port=int(os.environ.get("PORT", default_port)))
     logging.info("%s serving on %s", app.name, httpd.server_address)
-    _block()
+    exporter = None
+    if export_shards:
+        from ..obs import export as obs_export
+        exporter = obs_export.start_exporter(fallback_pod=app.name)
+    _block(*((exporter.stop,) if exporter is not None else ()))
 
 
 def jupyter_web_app(argv=()):
@@ -193,6 +209,13 @@ def queues_web_app(argv=()):
     _web(queues.create_app, 5000)
 
 
+def metrics_hub(argv=()):
+    # the hub MERGES shards; it must not export one of its own (its
+    # process families already ride the merge as the local shard)
+    from ..web import metrics_hub as hub
+    _web(hub.create_app, 5000, export_shards=False)
+
+
 def access_management(argv=()):
     from ..web import kfam
     _web(kfam.create_app, 8081)
@@ -222,6 +245,7 @@ COMPONENTS = {
     "studies-web-app": studies_web_app,
     "slices-web-app": slices_web_app,
     "queues-web-app": queues_web_app,
+    "metrics-hub": metrics_hub,
     "access-management": access_management,
     "centraldashboard": centraldashboard,
 }

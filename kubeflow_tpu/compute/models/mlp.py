@@ -18,6 +18,13 @@ class Config:
     dtype: str = "float32"
 
 
+def param_count(config):
+    dims = ([config.in_dim] + [config.hidden] * config.n_layers
+            + [config.n_classes])
+    return sum(d_in * d_out + d_out
+               for d_in, d_out in zip(dims[:-1], dims[1:]))
+
+
 def logical_axes(config):
     layers = []
     for _ in range(config.n_layers + 1):

@@ -11,9 +11,23 @@ plumbing.
 
 import contextlib
 import os
+import threading
 import time
 
 import jax
+
+
+class ProfilerActiveError(RuntimeError):
+    """A second ``trace()`` was opened while one is already capturing.
+
+    JAX's profiler is process-global: nesting ``start_trace`` fails
+    deep inside the C++ session with an opaque error (or silently
+    corrupts the capture on some versions). This named error fails
+    fast at the platform boundary instead."""
+
+
+_active_lock = threading.Lock()
+_active_base = None
 
 
 def trace_dir(base=None):
@@ -33,15 +47,32 @@ def trace(logdir=None):
 
     jax writes under <base>/plugins/profile/... itself, which is where
     trace_dir() points the Tensorboard profile plugin.
+
+    Crash-safe: ``stop_trace`` runs even when the enclosed step raises,
+    so a failed workload still flushes a readable (partial) trace and
+    the process-global profiler session is released for the next
+    attempt. Opening a second ``trace()`` while one is active raises
+    ``ProfilerActiveError`` instead of a deep JAX failure.
     """
+    global _active_base
     base = logdir or os.environ.get("TENSORBOARD_LOGDIR", "./logs")
-    os.makedirs(base, exist_ok=True)
-    jax.profiler.start_trace(
-        base, create_perfetto_link=False, create_perfetto_trace=False)
+    with _active_lock:
+        if _active_base is not None:
+            raise ProfilerActiveError(
+                f"a profiler trace is already capturing to "
+                f"{_active_base!r}; close it before opening another "
+                f"(jax's profiler session is process-global)")
+        os.makedirs(base, exist_ok=True)
+        jax.profiler.start_trace(
+            base, create_perfetto_link=False,
+            create_perfetto_trace=False)
+        _active_base = base
     try:
         yield base
     finally:
-        jax.profiler.stop_trace()
+        with _active_lock:
+            _active_base = None
+            jax.profiler.stop_trace()
 
 
 class StepTimer:

@@ -1409,12 +1409,20 @@ class ModelServer:
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
+        # fleet telemetry: the serving families join the hub's merged
+        # /metrics the same way the training workers' do (no-op when
+        # no shard directory resolves — e.g. unit tests)
+        from ..obs import export as obs_export
+        self._exporter = obs_export.start_exporter()
         return self._httpd.server_address[1]
 
     def stop(self):
         if self._httpd:
             self._httpd.shutdown()
             self._httpd = None
+        if getattr(self, "_exporter", None) is not None:
+            self._exporter.stop()
+            self._exporter = None
         # canaries own batcher threads too (batching is the default);
         # retired/pending copies were already closed when displaced
         with self._residency_lock:

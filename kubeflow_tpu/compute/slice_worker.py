@@ -65,9 +65,12 @@ def main(argv=None):
 
     import numpy as np
 
+    from ..obs import export as obs_export
+    from ..obs import tracing
     from . import checkpoint as ckpt_lib
     from . import data as data_lib
     from . import mesh as mesh_lib
+    from . import telemetry as telem
     from . import train
     from .models import transformer
 
@@ -134,25 +137,59 @@ def main(argv=None):
             log_f.flush()
         print(line, flush=True)
 
+    # fleet telemetry: shard exporter (no-op without a workspace),
+    # step/MFU/goodput families, and the gang trace continued from the
+    # controller-injected TRACEPARENT so the worker's compile/step/ckpt
+    # spans land on the workload's timeline
+    exporter = obs_export.start_exporter()
+    global_batch_rows = args.batch_per_process * jax.process_count()
+    tele = telem.TrainTelemetry(
+        "transformer",
+        flops_per_step=(transformer.flops_per_token(cfg)
+                        * global_batch_rows * args.seq),
+        # flops_per_step is the GLOBAL batch, so the MFU denominator
+        # must be the gang's aggregate peak, not one chip's
+        peak=telem.peak_flops() * jax.device_count(),
+        resumed=resumed)
+
     log(event="joined", joined=joined, resumed=resumed,
         start_step=int(state.step), processes=jax.process_count(),
         devices=len(jax.devices()), mesh=str(dict(
             zip(mesh.axis_names, mesh.devices.shape))))
 
-    while int(state.step) < args.steps:
-        step_no = int(state.step)
-        if step_no == fault_at:
-            log(event="fault-injected", step=step_no)
-            os._exit(17)
-        state, metrics = step_fn(state, global_batch(step_no))
-        ckpt.save(state)
-        log(event="step", step=int(state.step),
-            loss=float(metrics["loss"]))
+    try:
+        with tracing.span("slice-worker",
+                          traceparent=os.environ.get("TRACEPARENT"),
+                          worker=my_id, resumed=resumed,
+                          start_step=int(state.step)):
+            first = True
+            while int(state.step) < args.steps:
+                step_no = int(state.step)
+                if step_no == fault_at:
+                    log(event="fault-injected", step=step_no)
+                    os._exit(17)
+                span_name = ("train.compile" if first
+                             else "train.step")
+                with tracing.span(span_name, step=step_no):
+                    state, metrics = step_fn(
+                        state, global_batch(step_no))
+                    loss = float(metrics["loss"])   # sync the step
+                tele.step()
+                first = False
+                t_ck = time.perf_counter()
+                with tracing.span("train.checkpoint",
+                                  step=int(state.step)):
+                    ckpt.save(state)
+                tele.checkpoint(time.perf_counter() - t_ck)
+                log(event="step", step=int(state.step), loss=loss)
 
-    if int(state.step) not in ckpt.all_steps():
-        ckpt.save(state, force=True)
-    ckpt.close()
-    log(event="done", step=int(state.step))
+            if int(state.step) not in ckpt.all_steps():
+                ckpt.save(state, force=True)
+            ckpt.close()
+        log(event="done", step=int(state.step))
+    finally:
+        if exporter is not None:
+            exporter.stop()
     if log_f:
         log_f.close()
     return 0

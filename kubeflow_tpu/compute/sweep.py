@@ -35,6 +35,7 @@ StudyJobReconciler injects into a packed sweep pod).
 
 import json
 import os
+import time
 
 from ..obs import metrics as obs_metrics
 
@@ -274,7 +275,13 @@ def trials_from_env():
 
 
 def main():
+    from ..obs import export as obs_export
+    from ..obs import tracing
     from . import mesh as mesh_lib
+    from . import telemetry as telem
+
+    exporter = obs_export.start_exporter()
+    tele = telem.TrainTelemetry("sweep-mlp")
     install_cache_listener()
     mesh_lib.setup_compilation_cache()
     trials = trials_from_env()
@@ -283,7 +290,24 @@ def main():
             "sweep worker: TRIAL_SWEEP_PARAMETERS is empty — nothing "
             "to run")
     steps = int(os.environ.get("TRIAL_SWEEP_STEPS", "30"))
-    report_sweep(run_mnist_sweep(trials, steps=steps))
+    try:
+        # one span on the study's gang trace per packed pod. Goodput:
+        # spawn → program dispatch is the startup/compile window;
+        # program wall time books as compute via observe_steps (the
+        # scan runs `steps` real steps — any in-dispatch XLA compile
+        # rides along, small in practice since the workspace compile
+        # cache is warm for repeat sweeps)
+        with tracing.span("sweep-worker",
+                          traceparent=os.environ.get("TRACEPARENT"),
+                          trials=len(trials), steps=steps):
+            tele.step()
+            t0 = time.perf_counter()
+            results = run_mnist_sweep(trials, steps=steps)
+            tele.observe_steps(steps, time.perf_counter() - t0)
+        report_sweep(results)
+    finally:
+        if exporter is not None:
+            exporter.stop()
     return 0
 
 

@@ -1,0 +1,164 @@
+"""Training-loop telemetry: live step time, MFU, and the goodput ledger.
+
+The currency of TPU efficiency claims is MFU and step time (PAPERS.md,
+Gemma-on-TPU); until now the platform computed MFU only offline in
+bench.py. This module makes them live, scrapeable signals fed by the
+actual training loops (train.fit, compute/slice_worker.py) and shipped
+fleet-wide through obs/export.py:
+
+- ``train_step_seconds{model}`` — per-step wall time histogram (the
+  first step is excluded: it is compile, accounted separately).
+- ``train_mfu{model}`` — live MFU gauge: the caller's analytic
+  flops-per-step over the EMA step time and the chip's bf16 peak —
+  the same flops model bench.py uses offline, so the two must agree
+  (bench asserts it).
+- ``train_compile_seconds_total{model}`` — wall time from workload
+  start to the end of the first step (imports + trace + XLA compile).
+- ``train_goodput_seconds_total{gang,state}`` — the per-gang goodput
+  ledger, state ∈ compute|compile|checkpoint|queue_wait|suspended|
+  restart. The train loop feeds compute/compile/checkpoint/restart;
+  the admission scheduler (sched/controller.py) feeds queue_wait and
+  suspended — so "what fraction of admitted chip-time did useful
+  work" is one PromQL expression over a single family:
+
+      train_goodput_seconds_total{state="compute"}
+        / ignoring(state) sum without(state)(train_goodput_seconds_total)
+"""
+
+import os
+import time
+
+from ..obs import metrics as obs_metrics
+# the goodput ledger lives in obs/ so the scheduler can feed it
+# without importing the jax stack; re-exported here for the training
+# side, which reads/writes it through this module
+from ..obs.goodput import (GOODPUT, GOODPUT_STATES,  # noqa: F401
+                           record_goodput)
+
+STEP_SECONDS = obs_metrics.REGISTRY.histogram(
+    "train_step_seconds",
+    "Training step wall time (compile step excluded)",
+    ("model",),
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0))
+
+MFU_GAUGE = obs_metrics.REGISTRY.gauge(
+    "train_mfu",
+    "Live model FLOPs utilization (analytic flops/step over EMA step "
+    "time and chip bf16 peak)",
+    ("model",))
+
+COMPILE_SECONDS = obs_metrics.REGISTRY.counter(
+    "train_compile_seconds_total",
+    "Wall seconds from workload start to the end of the first "
+    "(compiling) step",
+    ("model",))
+
+def peak_flops(device=None):
+    """bf16 peak FLOPs per chip: v5e 197 TF, v4 275, v5p 459, v6e 918.
+    THE flops-model denominator — bench.py and the live gauge share it
+    so offline and live MFU cannot drift apart silently."""
+    import jax
+    device = device or jax.devices()[0]
+    kind = device.device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v4" in kind:
+        return 275e12
+    if "v5" in kind or "v5p" in kind:
+        return 459e12
+    if "v6" in kind:
+        return 918e12
+    return 197e12
+
+
+class TrainTelemetry:
+    """Per-workload telemetry feeder for a training loop.
+
+    ``gang`` defaults to the ``OBS_GANG`` env the controllers inject
+    (``<namespace>/<workload>``); without one the goodput ledger is
+    skipped and only the model-keyed families are fed. ``flops_per_step``
+    (analytic, model-level) enables the live MFU gauge.
+
+    The accounting mark starts at ``OBS_SPAWNED_AT`` (the runtime
+    stamps the exec time into the child env) or object creation — so
+    interpreter + import time lands in the first step's compile bucket
+    instead of silently vanishing from the ledger.
+    """
+
+    def __init__(self, model, gang=None, flops_per_step=None,
+                 peak=None, resumed=False, ema=0.9):
+        self.model = str(model)
+        self.gang = gang if gang is not None \
+            else os.environ.get("OBS_GANG")
+        self.flops_per_step = flops_per_step
+        self._peak = peak
+        #: a resumed gang's time-to-first-step is restart recovery
+        #: (restore + cache-hit compile), not fresh compilation
+        self.startup_state = "restart" if resumed else "compile"
+        self._ema = float(ema)
+        self.ema_step = None
+        self._first_done = False
+        spawned = os.environ.get("OBS_SPAWNED_AT")
+        try:
+            self._mark = float(spawned) if spawned else time.time()
+        except ValueError:
+            self._mark = time.time()
+
+    def _peak_flops(self):
+        if self._peak is None:
+            self._peak = peak_flops()
+        return self._peak
+
+    def step(self, seconds=None):
+        """Record one completed training step. The FIRST call closes
+        the startup window (mark → now) as compile/restart; later
+        calls feed the step histogram, the goodput compute state and
+        the live MFU gauge. ``seconds`` defaults to time since the
+        previous call (loops that don't time themselves)."""
+        now = time.time()
+        elapsed = now - self._mark if seconds is None \
+            else float(seconds)
+        if not self._first_done:
+            self._first_done = True
+            startup = now - self._mark
+            COMPILE_SECONDS.labels(self.model).inc(startup)
+            record_goodput(self.gang, self.startup_state, startup)
+            self._mark = now
+            return
+        self._mark = now
+        STEP_SECONDS.labels(self.model).observe(elapsed)
+        record_goodput(self.gang, "compute", elapsed)
+        self.ema_step = (elapsed if self.ema_step is None
+                         else self._ema * self.ema_step
+                         + (1 - self._ema) * elapsed)
+        if self.flops_per_step and self.ema_step:
+            MFU_GAUGE.labels(self.model).set(
+                self.flops_per_step / self.ema_step
+                / self._peak_flops())
+
+    def observe_steps(self, n, total_seconds):
+        """Bulk-feed ``n`` equal steps (bench: the loop is async, only
+        the drained total is a real wall time). Does not touch the
+        first-step compile classification."""
+        if n <= 0:
+            return
+        per = float(total_seconds) / n
+        for _ in range(int(n)):
+            STEP_SECONDS.labels(self.model).observe(per)
+            self.ema_step = (per if self.ema_step is None
+                             else self._ema * self.ema_step
+                             + (1 - self._ema) * per)
+        record_goodput(self.gang, "compute", float(total_seconds))
+        if self.flops_per_step and self.ema_step:
+            MFU_GAUGE.labels(self.model).set(
+                self.flops_per_step / self.ema_step
+                / self._peak_flops())
+
+    def checkpoint(self, seconds):
+        """Wall time spent in a (synchronous) checkpoint save."""
+        self._mark = time.time()    # ckpt time must not pollute steps
+        record_goodput(self.gang, "checkpoint", float(seconds))
+
+    def live_mfu(self):
+        return MFU_GAUGE.value(self.model)

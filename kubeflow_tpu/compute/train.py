@@ -185,7 +185,7 @@ def make_train_step(loss_fn, optimizer, mesh, accum_steps=1):
 
 
 def fit(state, step_fn, batches, mesh, steps=None, spec=None,
-        prefetch_depth=2, on_step=None):
+        prefetch_depth=2, on_step=None, telemetry=None):
     """Run a training loop over host batches with prefetch overlap.
 
     ``batches`` is a host-batch iterator; it is wrapped in a
@@ -197,7 +197,10 @@ def fit(state, step_fn, batches, mesh, steps=None, spec=None,
 
     ``on_step(step_count, metrics)`` runs after every step; returning
     False stops the loop (the early-stopping hook trial workloads
-    use). Returns ``(state, last_metrics)``.
+    use). ``telemetry`` (a ``telemetry.TrainTelemetry``) gets one
+    ``step()`` per loop iteration — the first closes the compile
+    window, the rest feed ``train_step_seconds``/``train_mfu`` and the
+    goodput ledger. Returns ``(state, last_metrics)``.
     """
     from . import data as data_lib
 
@@ -209,6 +212,8 @@ def fit(state, step_fn, batches, mesh, steps=None, spec=None,
         for batch in pf:
             state, metrics = step_fn(state, batch)
             done += 1
+            if telemetry is not None:
+                telemetry.step()
             if on_step is not None and on_step(done, metrics) is False:
                 break
             if steps is not None and done >= steps:

@@ -84,6 +84,17 @@ def parse_metric_line(line):
 
 def run_mnist_trial(hp=None, steps=30):
     """Default objective: MLP on synthetic MNIST; returns final loss."""
+    from ..obs import export as obs_export
+    from ..obs import tracing
+    from . import telemetry as telem
+
+    # fleet telemetry BEFORE the jax import: the compile window in the
+    # goodput ledger should include interpreter+jax import time (the
+    # real cost of a cold trial pod), and the exporter publishes even
+    # a crashed trial's partial state
+    exporter = obs_export.start_exporter()
+    tele = telem.TrainTelemetry("mnist-mlp")
+
     import jax
     import jax.numpy as jnp
 
@@ -111,6 +122,9 @@ def run_mnist_trial(hp=None, steps=30):
     x = jax.random.normal(key, (64, 28, 28, 1))
     y = jax.random.randint(key, (64,), 0, 10)
     batch = {"image": x, "label": y}
+    # arm the live train_mfu gauge now that the model shape is known
+    # (6ND convention, same flops model bench.py uses)
+    tele.flops_per_step = 6.0 * mlp.param_count(cfg) * x.shape[0]
 
     def batches():
         for _ in range(steps):
@@ -118,12 +132,23 @@ def run_mnist_trial(hp=None, steps=30):
 
     # train.fit wraps the source in a Prefetcher under its context
     # manager: the pump thread is joined even if a step raises, so a
-    # failed trial never leaks a thread wedged on the batch queue
-    state, metrics = train.fit(state, step, batches(), mesh)
-    loss = float(metrics["loss"])
-    report(loss, extra={"accuracy": float(metrics["accuracy"])})
+    # failed trial never leaks a thread wedged on the batch queue.
+    # The root span continues the controller-injected TRACEPARENT so
+    # the trial's timeline stitches onto the StudyJob's gang trace.
+    try:
+        with tracing.span("trial", traceparent=os.environ.get(
+                "TRACEPARENT"), steps=steps):
+            state, metrics = train.fit(state, step, batches(), mesh,
+                                       telemetry=tele)
+            loss = float(metrics["loss"])
+        report(loss, extra={"accuracy": float(metrics["accuracy"])})
+    finally:
+        if exporter is not None:
+            exporter.stop()
     return loss
 
 
 if __name__ == "__main__":
-    run_mnist_trial()
+    # TRIAL_STEPS mirrors the sweep worker's TRIAL_SWEEP_STEPS: the
+    # trial template sizes the workload without a custom command
+    run_mnist_trial(steps=int(os.environ.get("TRIAL_STEPS", "30")))

@@ -38,6 +38,7 @@ import re
 import socket
 import subprocess
 import threading
+import time
 
 from ..core import meta as m
 from ..core.errors import ApiError, ConflictError, NotFoundError
@@ -144,6 +145,10 @@ class ProcessPodRuntime(Reconciler):
             env.pop(k, None)
         env.update(container_env(pod, container))
         env.update(self.extra_env)
+        # telemetry spawn anchor (compute/telemetry.py): interpreter +
+        # import time lands in the goodput compile window instead of
+        # vanishing between "pod created" and "first metric"
+        env.setdefault("OBS_SPAWNED_AT", f"{time.time():.3f}")
 
         if "JAX_COORDINATOR_ADDRESS" in env:
             gang = m.labels_of(pod).get(self.gang_label, name)
@@ -163,7 +168,8 @@ class ProcessPodRuntime(Reconciler):
                                 stdout=log_f, stderr=log_f)
         log_f.close()
         record = {"uid": m.uid_of(pod), "proc": proc,
-                  "log_path": log_path, "ns": ns, "name": name}
+                  "log_path": log_path, "ns": ns, "name": name,
+                  "started_at": time.time()}
         self._children[(ns, name)] = record
         threading.Thread(target=self._reap, args=(record,),
                          daemon=True,
@@ -196,8 +202,12 @@ class ProcessPodRuntime(Reconciler):
                         "ready": False,
                         "restartCount": 0,
                         "image": container.get("image", ""),
-                        "state": {"terminated": {"exitCode": rc,
-                                                 "finishedAt": now}},
+                        "state": {"terminated": {
+                            "exitCode": rc,
+                            "startedAt": time.strftime(
+                                "%Y-%m-%dT%H:%M:%SZ",
+                                time.gmtime(record["started_at"])),
+                            "finishedAt": now}},
                     }],
                 }
                 self.store.update(pod)

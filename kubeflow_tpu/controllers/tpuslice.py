@@ -38,6 +38,7 @@ from ..core import reconcilehelper as helper
 from ..core.errors import NotFoundError
 from ..core.manager import EventRecorder, Reconciler, Request, Result
 from ..obs import metrics as obs_metrics
+from ..obs import tracing
 
 log = logging.getLogger("kubeflow_tpu.controllers.tpuslice")
 
@@ -55,6 +56,43 @@ GANG_GENERATION = "kubeflow.org/gang-generation"
 
 #: default restart budget before the slice goes terminally Failed
 DEFAULT_MAX_RESTARTS = 5
+
+
+def telemetry_env(kind, namespace, name, epoch=0):
+    """The fleet-telemetry env a workload controller injects into its
+    pods: TRACEPARENT carries the workload's deterministic trace id
+    (gang-wide trace stitching — worker spans continue the trace the
+    controller and scheduler also derive), OBS_GANG keys the goodput
+    ledger (``train_goodput_seconds_total{gang}``) jointly fed by the
+    train loop and the admission paths, POD_NAME names the telemetry
+    shard (downward API)."""
+    return [
+        {"name": "TRACEPARENT",
+         "value": tracing.workload_traceparent(kind, namespace, name,
+                                               epoch)},
+        {"name": "OBS_GANG", "value": f"{namespace}/{name}"},
+        {"name": "POD_NAME", "valueFrom": {"fieldRef": {
+            "fieldPath": "metadata.name"}}},
+    ]
+
+
+def _merge_env(env, extra):
+    """Append ``extra`` entries whose names are not already declared
+    (template/user env wins, same setdefault contract as placement)."""
+    declared = {e.get("name") for e in env}
+    env.extend(e for e in extra if e["name"] not in declared)
+    return env
+
+
+def phase_marker_span(kind, namespace, name, epoch, phase, **attrs):
+    """Drop a zero-ish-duration marker span on the workload's derived
+    trace when its phase changes — the controller's contribution to
+    the stitched gang timeline (admit → schedule → compile → step)."""
+    tp = tracing.workload_traceparent(kind, namespace, name, epoch)
+    with tracing.span(f"{kind.lower()}.{phase.lower()}",
+                      traceparent=tp, workload=f"{namespace}/{name}",
+                      phase=phase, **attrs):
+        pass
 
 
 def update_status_preserving_admission(store, obj, status):
@@ -218,6 +256,9 @@ class TpuSliceReconciler(Reconciler):
         new_cmp = dict(status)
         new_cmp.pop("conditions", None)
         if new_cmp != old_cmp:
+            if phase != old_status.get("phase"):
+                phase_marker_span(tsapi.SLICE_KIND, req.namespace,
+                                  req.name, restart_count, phase)
             update_status_preserving_admission(self.store, ts, status)
         return Result()
 
@@ -299,10 +340,14 @@ class TpuSliceReconciler(Reconciler):
                     f"{last_reason}; restarting gang "
                     f"(generation {restart_count})")
 
-        # PodDefault must exist before pods are admitted
+        # PodDefault must exist before pods are admitted; the
+        # telemetry env rides it so every worker continues the gang's
+        # derived trace and feeds the per-gang goodput ledger
         pd = pdapi.tpu_worker_pod_default(
             req.namespace, req.name, workers,
-            chips_per_host=chips_per_host, topology=topology)
+            chips_per_host=chips_per_host, topology=topology,
+            extra_env=telemetry_env(tsapi.SLICE_KIND, req.namespace,
+                                    req.name, restart_count))
         m.set_controller_reference(pd, ts)
         helper.create_or_update(self.store, pd)
 
@@ -353,11 +398,20 @@ class TpuSliceReconciler(Reconciler):
             status["admission"] = admission
         if last_reason:
             status["lastRestartReason"] = last_reason
+        if restarting:
+            phase_marker_span(tsapi.SLICE_KIND, req.namespace, req.name,
+                              restart_count, "Restarting",
+                              reason=last_reason,
+                              generation=restart_count)
         old_cmp = dict(old_status)
         old_cmp.pop("conditions", None)
         new_cmp = dict(status)
         new_cmp.pop("conditions", None)
         if new_cmp != old_cmp:
+            if phase != old_status.get("phase"):
+                phase_marker_span(tsapi.SLICE_KIND, req.namespace,
+                                  req.name, restart_count, phase,
+                                  ready=ready, workers=workers)
             update_status_preserving_admission(self.store, ts, status)
         return Result()
 
@@ -941,6 +995,9 @@ class StudyJobReconciler(Reconciler):
                        for e in env):
                 env.append({"name": "TRIAL_OBJECTIVE_NAME",
                             "value": metric_name})
+            _merge_env(env, telemetry_env(
+                tsapi.STUDY_KIND, req.namespace, req.name,
+                members[0][0]))
             pod = builtin.pod(
                 pod_name, req.namespace, pod_spec,
                 labels={"studyjob": req.name,
@@ -1222,11 +1279,14 @@ class StudyJobReconciler(Reconciler):
             template = render_template(
                 spec.get("trialTemplate") or {"spec": {"containers": [{}]}},
                 render_values)
+            pod_spec = apply_trial_placement(
+                m.deep_copy(template.get("spec") or {}), spec,
+                req.name)
+            _merge_env(pod_spec["containers"][0].setdefault("env", []),
+                       telemetry_env(tsapi.STUDY_KIND, req.namespace,
+                                     req.name, next_index))
             pod = builtin.pod(
-                tname, req.namespace,
-                apply_trial_placement(
-                    m.deep_copy(template.get("spec") or {}), spec,
-                    req.name),
+                tname, req.namespace, pod_spec,
                 labels={"studyjob": req.name,
                         "studyjob-trial": str(next_index)})
             m.set_controller_reference(pod, study)

@@ -8,20 +8,32 @@ span ring buffer (``obs.tracing.TRACES``) shared by every layer:
 - web/http.py times every request, speaks W3C ``traceparent``, and
   serves ``/metrics`` + ``/debug/traces`` on every App,
 - compute/serving.py publishes predict latency / queue-wait /
-  batch-size histograms (stable vs canary) on the model server.
+  batch-size histograms (stable vs canary) on the model server,
+- export.py snapshots the registry + span ring to atomically-renamed
+  per-pod shard files under the workspace, and aggregate.py merges
+  them fleet-wide (counters summed with restart detection, histograms
+  bucket-wise, gauges last-write-wins with staleness eviction) for
+  web/metrics_hub.py's fleet ``/metrics`` + ``/debug/traces``.
 
 See docs/observability.md for the family table and trace workflow.
 """
 
+from .aggregate import Aggregator
+from .export import ShardExporter, resolve_dir, start_exporter
 from .metrics import (DEFAULT_BUCKETS, REGISTRY, TEXT_CONTENT_TYPE,
                       Counter, Gauge, Histogram, Registry,
                       default_registry)
 from .tracing import (TRACES, Span, TraceBuffer, current_span,
-                      format_traceparent, parse_traceparent, span)
+                      derive_span_id, derive_trace_id,
+                      format_traceparent, parse_traceparent, span,
+                      workload_traceparent)
 
 __all__ = [
     "DEFAULT_BUCKETS", "REGISTRY", "TEXT_CONTENT_TYPE", "Counter",
     "Gauge", "Histogram", "Registry", "default_registry",
     "TRACES", "Span", "TraceBuffer", "current_span",
+    "derive_span_id", "derive_trace_id",
     "format_traceparent", "parse_traceparent", "span",
+    "workload_traceparent",
+    "Aggregator", "ShardExporter", "resolve_dir", "start_exporter",
 ]

@@ -1,0 +1,420 @@
+"""Shard aggregation — the hub side of the fleet telemetry plane.
+
+Merges the per-pod shard files obs/export.py writes into one fleet-wide
+exposition and one stitched trace view, with the merge semantics a real
+federation layer needs:
+
+- **counters** (and every histogram series — buckets/sum/count are
+  cumulative too): summed across pods, with restart detection. A pod
+  restart re-exports from zero under the same pod name; the aggregator
+  detects it by the shard's process ``epoch`` changing (or, belt and
+  braces, by a monotone series decreasing) and folds the pre-restart
+  total into a per-pod ``base`` so fleet counters never go backwards.
+- **histograms**: merged bucket-wise — each ``_bucket{le=...}`` series
+  is itself a cumulative counter, so the counter merge above IS the
+  bucket-wise merge; exposition regroups them per label set in bucket
+  order.
+- **gauges**: last-write-wins by shard snapshot time, with staleness
+  eviction — a gauge from a shard older than ``stale_after`` (dead or
+  wedged worker) drops out of the fleet view instead of reporting a
+  phantom live value. Counters from stale shards are kept: completed
+  work stays counted.
+
+A torn / truncated / unparseable shard (worker died mid-write, disk
+glitch) increments ``obs_shard_read_errors_total{pod}`` and is skipped
+— the hub's ``/metrics`` never 500s because one worker had a bad day.
+"""
+
+import json
+import math
+import os
+import re
+import time
+
+from . import export as export_lib
+from . import metrics as obs_metrics
+
+#: shard files that could not be read/parsed this scrape, by pod (the
+#: pod is taken from the filename — the file contents are the thing
+#: that's broken). Lives in the hub's own registry, so it shows up in
+#: the merged exposition via the hub's local shard.
+SHARD_READ_ERRORS = obs_metrics.REGISTRY.counter(
+    "obs_shard_read_errors_total",
+    "Telemetry shard files skipped because they were torn or "
+    "unparseable",
+    ("pod",))
+
+#: default gauge staleness horizon (seconds): ~12 export intervals
+DEFAULT_STALE_AFTER = 60.0
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"         # series name
+    r"(?:\{(.*)\})?"                       # optional label block
+    r"\s+(-?[0-9.eE+-]+|NaN|[+-]?Inf)$")   # value
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(value):
+    return re.sub(r"\\(.)",
+                  lambda m: {"n": "\n"}.get(m.group(1), m.group(1)),
+                  value)
+
+
+def _parse_value(text):
+    if text == "NaN":
+        return float("nan")
+    if text.endswith("Inf"):
+        return float("-inf") if text.startswith("-") else float("inf")
+    return float(text)
+
+
+class Shard:
+    """One parsed shard: identity header + families + flat samples."""
+
+    def __init__(self, pod, epoch, ts):
+        self.pod = pod
+        self.epoch = epoch
+        self.ts = ts
+        self.meta = {}      # family -> (type, help)
+        self.samples = []   # (series_name, labels_tuple, value)
+
+
+def parse_shard(text):
+    """Parse a metric shard (header + Prometheus text 0.0.4). Raises
+    ValueError on anything torn — the aggregator's skip signal."""
+    lines = text.splitlines()
+    if not lines:
+        raise ValueError("empty shard")
+    header = export_lib.parse_header(lines[0])
+    if header is None:
+        raise ValueError("missing shard header")
+    shard = Shard(*header)
+    family = None
+    for line in lines[1:]:
+        line = line.rstrip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            family = parts[2]
+            shard.meta[family] = ("untyped",
+                                  parts[3] if len(parts) > 3 else "")
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            family = parts[2]
+            mtype = parts[3] if len(parts) > 3 else "untyped"
+            shard.meta[family] = (mtype,
+                                  shard.meta.get(family, ("", ""))[1])
+            continue
+        if line.startswith("#"):
+            continue
+        mo = _SAMPLE_RE.match(line)
+        if mo is None:
+            raise ValueError(f"unparseable sample line {line!r}")
+        name, label_block, value = mo.groups()
+        labels = []
+        if label_block:
+            matched_len = 0
+            for lm in _LABEL_RE.finditer(label_block):
+                labels.append((lm.group(1), _unescape(lm.group(2))))
+                matched_len = lm.end()
+            # the label regex silently skipping garbage would make a
+            # torn line parse as a different series — reject instead
+            rest = label_block[matched_len:].strip(", ")
+            if rest:
+                raise ValueError(f"unparseable labels {label_block!r}")
+        shard.samples.append((name, tuple(labels), _parse_value(value)))
+    return shard
+
+
+def read_shards(directory, errors_counter=SHARD_READ_ERRORS,
+                cache=None):
+    """Read every ``*.prom`` shard under ``directory``; torn/partial
+    shards are counted per pod and skipped. Returns parsed shards.
+
+    ``cache`` (a dict the caller owns, e.g. the hub's) memoizes parses
+    by (mtime, size): a fleet of finished pods costs one stat per
+    scrape instead of a full re-parse — and a persistently-torn file
+    is counted once per version, not once per scrape."""
+    shards = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return shards
+    seen = set()
+    for fn in names:
+        if not fn.endswith(".prom"):
+            continue
+        pod = fn[:-len(".prom")]
+        path = os.path.join(directory, fn)
+        seen.add(fn)
+        try:
+            st = os.stat(path)
+            version = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            errors_counter.labels(pod).inc()
+            continue
+        if cache is not None and fn in cache \
+                and cache[fn][0] == version:
+            shard = cache[fn][1]
+            if shard is not None:
+                shards.append(shard)
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="strict") as f:
+                shard = parse_shard(f.read())
+            shards.append(shard)
+        except (OSError, ValueError, UnicodeDecodeError):
+            shard = None
+            errors_counter.labels(pod).inc()
+        if cache is not None:
+            cache[fn] = (version, shard)
+    if cache is not None:
+        for fn in list(cache):
+            if fn not in seen:
+                del cache[fn]
+    return shards
+
+
+def local_shard(pod, epoch, registry=None):
+    """The calling process's registry as a synthetic shard, so the hub
+    merges its own families through the same code path (no special
+    cases, no double counting)."""
+    registry = registry or obs_metrics.REGISTRY
+    now = time.time()
+    return parse_shard(export_lib.format_header(
+        export_lib.pod_name(pod), epoch, now) + "\n"
+        + registry.exposition())
+
+
+def _family_of(series):
+    """Histogram series share their family's TYPE line: map
+    ``x_bucket``/``x_sum``/``x_count`` back to ``x`` when needed."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if series.endswith(suffix):
+            return series[:-len(suffix)]
+    return series
+
+
+class Aggregator:
+    """Stateful shard merger (one per hub process: restart detection
+    needs memory of each pod's previous epoch and totals)."""
+
+    def __init__(self, stale_after=DEFAULT_STALE_AFTER):
+        self.stale_after = float(stale_after)
+        self._pod_epoch = {}            # pod -> epoch last seen
+        self._mono = {}                 # (series, labels) -> {pod: {base,last}}
+        self._meta = {}                 # family -> (type, help)
+
+    # ---------------------------------------------------------- update
+
+    def update(self, shards, now=None):
+        """Fold a fresh read of the shard directory into the merge
+        state, then return the merged exposition text."""
+        now = time.time() if now is None else now
+        gauges = {}     # (family, labels) -> (ts, value)
+        for shard in shards:
+            prev_epoch = self._pod_epoch.get(shard.pod)
+            if prev_epoch is not None and shard.epoch != prev_epoch:
+                # pod restarted: its monotone series start over — fold
+                # the previous life's totals into the base
+                for series in self._mono.values():
+                    state = series.get(shard.pod)
+                    if state is not None:
+                        state["base"] += state["last"]
+                        state["last"] = 0.0
+            self._pod_epoch[shard.pod] = shard.epoch
+            for family, meta in shard.meta.items():
+                known = self._meta.get(family)
+                if known is None or (known[0] == "untyped"
+                                     and meta[0] != "untyped"):
+                    self._meta[family] = meta
+            for series, labels, value in shard.samples:
+                mtype = self._meta.get(_family_of(series),
+                                       ("untyped", ""))[0]
+                if mtype in ("counter", "histogram"):
+                    per_pod = self._mono.setdefault((series, labels), {})
+                    state = per_pod.setdefault(
+                        shard.pod, {"base": 0.0, "last": 0.0})
+                    if value < state["last"]:
+                        # decrease without an epoch change: restart we
+                        # could not otherwise see (clock-identical
+                        # epoch) — same fold
+                        state["base"] += state["last"]
+                    state["last"] = value
+                else:
+                    # gauge / untyped: last-write-wins by snapshot
+                    # time, stale shards evicted from the live view
+                    if now - shard.ts > self.stale_after:
+                        continue
+                    key = (series, labels)
+                    if key not in gauges or shard.ts > gauges[key][0]:
+                        gauges[key] = (shard.ts, value)
+        return self._exposition(gauges)
+
+    # ------------------------------------------------------ exposition
+
+    def _merged_mono(self):
+        out = {}
+        for (series, labels), per_pod in self._mono.items():
+            out[(series, labels)] = sum(
+                s["base"] + s["last"] for s in per_pod.values())
+        return out
+
+    @staticmethod
+    def _le_key(labels):
+        for name, value in labels:
+            if name == "le":
+                return (math.inf if value == "+Inf"
+                        else float(value))
+        return math.inf
+
+    def _exposition(self, gauges):
+        mono = self._merged_mono()
+        by_family = {}
+        for (series, labels), value in mono.items():
+            by_family.setdefault(_family_of(series), []).append(
+                (series, labels, value))
+        for (series, labels), (_ts, value) in gauges.items():
+            by_family.setdefault(_family_of(series), []).append(
+                (series, labels, value))
+        lines = []
+        for family in sorted(by_family):
+            mtype, help_text = self._meta.get(family, ("untyped", ""))
+            lines.append(f"# HELP {family} {help_text}")
+            lines.append(f"# TYPE {family} {mtype}")
+            samples = by_family[family]
+            if mtype == "histogram":
+                # regroup: per non-le label set — buckets in le order,
+                # then sum, then count (Prometheus exposition shape)
+                samples.sort(key=lambda s: (
+                    tuple((k, v) for k, v in s[1] if k != "le"),
+                    {f"{family}_bucket": 0, f"{family}_sum": 1,
+                     f"{family}_count": 2}.get(s[0], 3),
+                    self._le_key(s[1])))
+            else:
+                samples.sort(key=lambda s: (s[0], s[1]))
+            for series, labels, value in samples:
+                label_block = "".join(
+                    [obs_metrics._fmt_labels(
+                        [k for k, _ in labels],
+                        [v for _, v in labels])]) if labels else ""
+                lines.append(f"{series}{label_block} "
+                             f"{obs_metrics._fmt_value(value)}")
+        return "\n".join(lines) + "\n"
+
+
+def prune_shards(directory, older_than, now=None):
+    """Delete shard files (``.prom``/``.spans.json``, plus orphaned
+    ``.tmp`` from writers that died mid-write) not touched for
+    ``older_than`` seconds. The hub calls this AFTER folding a read
+    into its aggregator, whose in-memory state keeps the dead pods'
+    counter totals — so a cluster churning thousands of short trials
+    doesn't re-parse every pod that ever lived on every scrape.
+    Returns the pruned filenames."""
+    now = time.time() if now is None else now
+    pruned = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return pruned
+    for fn in names:
+        if not fn.endswith((".prom", ".spans.json", ".tmp")):
+            continue
+        path = os.path.join(directory, fn)
+        try:
+            if now - os.stat(path).st_mtime > older_than:
+                os.unlink(path)
+                pruned.append(fn)
+        except OSError:
+            pass
+    return pruned
+
+
+# -------------------------------------------------------------- traces
+
+def read_span_shards(directory, errors_counter=SHARD_READ_ERRORS):
+    """Read every ``*.spans.json`` shard; torn files counted+skipped.
+    Returns ``[(pod, [span_dict, ...]), ...]``."""
+    out = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for fn in names:
+        if not fn.endswith(".spans.json"):
+            continue
+        pod = fn[:-len(".spans.json")]
+        try:
+            with open(os.path.join(directory, fn)) as f:
+                doc = json.load(f)
+            spans = doc["spans"]
+            if not isinstance(spans, list):
+                raise ValueError("spans is not a list")
+            out.append((doc.get("pod", pod), spans))
+        except (OSError, ValueError, KeyError):
+            errors_counter.labels(pod).inc()
+    return out
+
+
+def merge_spans(directory, local_traces=None, local_pod="local"):
+    """All fleet spans as ``(pod, span_dict)`` pairs, deduplicated by
+    span id (a pod's shard and the hub's own ring may both hold a
+    span)."""
+    merged = []
+    seen = set()
+    shards = read_span_shards(directory) if directory else []
+    if local_traces is not None:
+        shards = shards + [(local_pod,
+                            [s.to_dict() for s in local_traces.spans()])]
+    for pod, spans in shards:
+        for span in spans:
+            sid = span.get("span_id")
+            if sid in seen:
+                continue
+            seen.add(sid)
+            merged.append((pod, span))
+    return merged
+
+
+def traces_view(merged, trace_id=None, limit=50):
+    """The ``/debug/traces`` JSON shape over merged fleet spans."""
+    groups = {}
+    for pod, span in merged:
+        if trace_id is not None and span.get("trace_id") != trace_id:
+            continue
+        groups.setdefault(span.get("trace_id"), []).append(
+            dict(span, pod=pod))
+    out = []
+    for tid, spans in groups.items():
+        spans.sort(key=lambda s: s.get("start", 0))
+        out.append({"trace_id": tid, "spans": spans})
+    out.sort(key=lambda t: max(
+        (sp.get("start", 0) + sp.get("duration_ms", 0) / 1000
+         for sp in t["spans"]), default=0), reverse=True)
+    return out[:limit]
+
+
+def chrome_trace(merged, trace_id=None):
+    """Chrome trace-event JSON over merged fleet spans: one process
+    row per POD (controller and each worker side by side — the
+    admit→schedule→compile→step gang timeline in Perfetto)."""
+    events = []
+    for pod, span in merged:
+        if trace_id is not None and span.get("trace_id") != trace_id:
+            continue
+        events.append({
+            "name": span.get("name"),
+            "cat": span.get("trace_id"),
+            "ph": "X",
+            "ts": span.get("start", 0) * 1e6,
+            "dur": span.get("duration_ms", 0) * 1e3,
+            "pid": pod,
+            "tid": span.get("thread", "main"),
+            "args": {**(span.get("attrs") or {}),
+                     "span_id": span.get("span_id"),
+                     "parent_id": span.get("parent_id"),
+                     "status": span.get("status")},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

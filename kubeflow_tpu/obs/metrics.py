@@ -49,6 +49,21 @@ def _fmt_labels(names, values, extra=()):
     return "{" + ",".join(pairs) + "}" if pairs else ""
 
 
+def _fmt_value(value):
+    """Exposition value formatting: integral values stay terse (``1``),
+    everything else keeps full float precision via the shortest
+    round-trip repr — ``%g``'s 6 significant digits would corrupt
+    unix-timestamp gauges (process_start_time_seconds) and large
+    counters by thousands."""
+    if value != value:
+        return "NaN"
+    if value in (float("inf"), float("-inf")):
+        return "+Inf" if value > 0 else "-Inf"
+    if value == int(value) and abs(value) < 2 ** 53:
+        return str(int(value))
+    return repr(value)
+
+
 class _Metric:
     def __init__(self, name, help_text, label_names):
         self.name = name
@@ -76,7 +91,8 @@ class _Metric:
             lines.append(f"{self.name} 0")
         for key, value in sorted(samples.items()):
             lines.append(f"{self.name}"
-                         f"{_fmt_labels(self.label_names, key)} {value:g}")
+                         f"{_fmt_labels(self.label_names, key)} "
+                         f"{_fmt_value(value)}")
 
 
 class _Child:
@@ -186,7 +202,8 @@ class Histogram(_Metric):
                 f"{_fmt_labels(self.label_names, key, [('le', '+Inf')])}"
                 f" {state['count']}")
             labels = _fmt_labels(self.label_names, key)
-            lines.append(f"{self.name}_sum{labels} {state['sum']:g}")
+            lines.append(f"{self.name}_sum{labels} "
+                         f"{_fmt_value(state['sum'])}")
             lines.append(f"{self.name}_count{labels} {state['count']}")
 
 

@@ -26,6 +26,7 @@ be layered later by swapping the buffer.
 """
 
 import contextvars
+import hashlib
 import os
 import re
 import threading
@@ -58,6 +59,36 @@ def parse_traceparent(header):
 
 def format_traceparent(span):
     return f"00-{span.trace_id}-{span.span_id}-01"
+
+
+def derive_trace_id(*parts):
+    """Deterministic 32-hex trace id from identity parts. The fleet
+    trace-stitching contract: every process that knows a workload's
+    (kind, namespace, name) derives the SAME trace id, so controller
+    spans, scheduler spans and worker spans land on one timeline
+    without any id having to travel through the store."""
+    digest = hashlib.sha256("\x1f".join(str(p) for p in parts)
+                            .encode()).hexdigest()[:32]
+    # the spec's all-zero trace id is invalid; astronomically unlikely,
+    # but a derived id must never be the sentinel
+    return digest if set(digest) != {"0"} else "1" + digest[1:]
+
+
+def derive_span_id(*parts):
+    """Deterministic 16-hex span id (same derivation, span width)."""
+    digest = hashlib.sha256(("span:" + "\x1f".join(str(p) for p in parts))
+                            .encode()).hexdigest()[:16]
+    return digest if set(digest) != {"0"} else "1" + digest[1:]
+
+
+def workload_traceparent(kind, namespace, name, epoch=0):
+    """The ``TRACEPARENT`` value a controller injects into a workload's
+    pod env (and uses for its own spans about that workload): trace id
+    from the workload identity, parent span id from identity + epoch
+    (gang generation / launch batch), so a restarted gang's spans hang
+    off a fresh parent on the SAME trace."""
+    return (f"00-{derive_trace_id(kind, namespace, name)}"
+            f"-{derive_span_id(kind, namespace, name, epoch)}-01")
 
 
 class Span:
@@ -162,19 +193,20 @@ def current_span():
 
 @contextmanager
 def span(name, traceparent=None, buffer=None, **attrs):
-    """Open a span. An in-process parent (contextvar) wins; otherwise a
-    valid ``traceparent`` header continues the remote trace; otherwise
-    a fresh trace starts. The completed span lands in ``buffer``
-    (default: the global ring)."""
+    """Open a span. An explicit valid ``traceparent`` wins — the
+    caller is deliberately pointing at another trace (a controller
+    dropping a marker on a workload's derived trace from inside its
+    own reconcile span); otherwise the in-process parent (contextvar)
+    continues; otherwise a fresh trace starts. The completed span
+    lands in ``buffer`` (default: the global ring)."""
+    remote = parse_traceparent(traceparent)
     parent = _CURRENT.get()
-    if parent is not None:
+    if remote is not None:
+        trace_id, parent_id = remote
+    elif parent is not None:
         trace_id, parent_id = parent.trace_id, parent.span_id
     else:
-        remote = parse_traceparent(traceparent)
-        if remote is not None:
-            trace_id, parent_id = remote
-        else:
-            trace_id, parent_id = os.urandom(16).hex(), None
+        trace_id, parent_id = os.urandom(16).hex(), None
     s = Span(name, trace_id, parent_id, dict(attrs))
     token = _CURRENT.set(s)
     try:

@@ -28,7 +28,9 @@ from ..api import profile as papi
 from ..api import tpuslice as tsapi
 from ..core import meta as m
 from ..core.manager import EventRecorder, Reconciler, Request, Result
+from ..obs import goodput
 from ..obs import metrics as obs_metrics
+from ..obs import tracing
 from . import queue as squeue
 from .quota import COHORT_ANNOTATION, QuotaLedger
 
@@ -259,10 +261,12 @@ class QueueReconciler(Reconciler):
         order. New arrivals are sequenced by creation time (name as the
         deterministic tiebreak within one clock tick) — the in-memory
         assignment is ``overlay_seqs``, shared with the read-only
-        queues web view."""
+        queues web view. ``queuedAt`` anchors the goodput ledger's
+        queue_wait accounting (see the admit loop)."""
         for g in overlay_seqs(gangs, objs):
             self._update_admission(objs[g.key],
-                                   {"admitted": False, "seq": g.seq})
+                                   {"admitted": False, "seq": g.seq,
+                                    "queuedAt": m.now_iso()})
 
     # ---------------------------------------------------------- reconcile
 
@@ -280,25 +284,54 @@ class QueueReconciler(Reconciler):
                 continue
             obj = objs[g.key]
             if m.deep_get(obj, "status", "admission", "admitted"):
+                # suspendedAt anchors the goodput ledger's "suspended"
+                # accounting when the workload is later re-admitted
                 self._update_admission(
-                    obj, {"admitted": False, "reason": "suspended"},
+                    obj, {"admitted": False, "reason": "suspended",
+                          "suspendedAt": m.now_iso()},
                     drop=("admittedAt", "admittedSeq"))
 
         result = squeue.plan(gangs, ledger, max_bypass=self.max_bypass)
 
+        # the goodput ledger's scheduler-fed states: queue_wait from
+        # queuedAt (seq assignment / preemption requeue) → admission,
+        # suspended from suspendedAt → admission. Jointly with the
+        # train-loop states (compute/compile/checkpoint/restart) the
+        # family sums to the workload's admitted wall-clock.
         next_adm = max((g.admitted_seq for g in gangs), default=0) + 1
         for g in result.admit:
             obj = objs[g.key]
+            admission = m.deep_get(obj, "status", "admission") or {}
+            now = time.time()
+            gang_key = f"{g.namespace}/{g.name}"
+            suspended_at = _parse_iso(admission.get("suspendedAt"))
+            queued_at = _parse_iso(admission.get("queuedAt"))
+            if suspended_at is not None:
+                goodput.record_goodput(gang_key, "suspended",
+                                     max(0.0, now - suspended_at))
+            elif queued_at is not None:
+                goodput.record_goodput(gang_key, "queue_wait",
+                                     max(0.0, now - queued_at))
             self._update_admission(
                 obj, {"admitted": True, "seq": g.seq,
                       "admittedAt": m.now_iso(),
                       "admittedSeq": next_adm},
-                drop=("reason", "bypass"))
+                drop=("reason", "bypass", "queuedAt", "suspendedAt"))
             next_adm += 1
             self.recorder.event(
                 obj, "Normal", "Admitted",
                 f"admitted by queue {g.queue!r} "
                 f"({g.chips} chips, priority {g.priority})")
+            # marker span on the workload's derived trace: the
+            # admission decision is the first event of the stitched
+            # gang timeline the metrics hub renders
+            with tracing.span(
+                    "sched.admit",
+                    traceparent=tracing.workload_traceparent(
+                        g.kind, g.namespace, g.name, g.seq),
+                    workload=gang_key, queue=g.queue, chips=g.chips,
+                    priority=g.priority):
+                pass
             _ADMITTED.labels(g.queue).inc()
             created = _parse_iso(m.deep_get(obj, "metadata",
                                             "creationTimestamp"))
@@ -316,7 +349,8 @@ class QueueReconciler(Reconciler):
             # "lastPreemption" is the durable record of the eviction.
             self._update_admission(
                 obj, {"admitted": False, "seq": requeue_seq,
-                      "reason": reason, "lastPreemption": reason},
+                      "reason": reason, "lastPreemption": reason,
+                      "queuedAt": m.now_iso()},
                 drop=("admittedAt", "admittedSeq"))
             requeue_seq += 1
             self.recorder.event(obj, "Warning", "Preempted", reason)

@@ -20,6 +20,26 @@ from .http import App, HTTPError
 
 PROFILE_API = f"{papi.GROUP}/{papi.VERSION}"
 
+#: sidebar links the frontend renders (reference: the centraldashboard
+#: dashboard-links ConfigMap / menuLinks). Served from /api/env-info so
+#: a new web app (and its istio prefix) is one entry here.
+MENU_LINKS = [
+    {"type": "item", "link": "/jupyter/", "text": "Notebooks",
+     "icon": "book"},
+    {"type": "item", "link": "/tensorboards/", "text": "Tensorboards",
+     "icon": "assessment"},
+    {"type": "item", "link": "/volumes/", "text": "Volumes",
+     "icon": "device:storage"},
+    {"type": "item", "link": "/slices/", "text": "TPU Slices",
+     "icon": "memory"},
+    {"type": "item", "link": "/studies/", "text": "Studies",
+     "icon": "kubeflow:katib"},
+    {"type": "item", "link": "/queues/", "text": "Queues",
+     "icon": "icons:list"},
+    {"type": "item", "link": "/metrics-hub/", "text": "Metrics Hub",
+     "icon": "icons:timeline"},
+]
+
 
 class MetricsService:
     """Interface: node CPU / pod CPU / pod memory time series
@@ -91,6 +111,7 @@ def create_app(store, metrics_service=None):
                          "kubeflowVersion": "1.7.0"},
             "namespaces": namespaces,
             "isClusterAdmin": user == kfam_lib.cluster_admin(),
+            "menuLinks": MENU_LINKS,
         }
 
     @app.get("/api/workgroup/exists")

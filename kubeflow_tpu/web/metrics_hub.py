@@ -1,0 +1,148 @@
+"""Metrics hub — the fleet-wide scrape surface.
+
+Every other App serves its OWN process registry on ``/metrics``; this
+app serves the whole fleet's: it reads the per-pod shard files workers
+export under the workspace (obs/export.py), merges them with the real
+federation semantics (obs/aggregate.py — counters summed with restart
+detection, histograms bucket-wise, gauges last-write-wins with
+staleness eviction) and exposes:
+
+- ``GET /metrics``       — one merged Prometheus exposition; the hub's
+  own process families ride along as a synthetic local shard. Never
+  500s on a torn shard: the bad file is skipped and counted in
+  ``obs_shard_read_errors_total{pod}``.
+- ``GET /debug/traces``  — fleet span view: span shards from every pod
+  merged with the hub's own ring; ``format=chrome`` renders one
+  Perfetto timeline with one process row per pod, so a gang's
+  admit→schedule→compile→step story reads end to end.
+- ``GET /api/fleet``     — shard inventory (pod, snapshot age, epoch)
+  for dashboards and debugging dead exporters.
+- ``GET /``              — a minimal HTML index linking the above.
+
+One knob: the shard directory (``OBS_EXPORT_DIR`` /
+``$WORKSPACE/obs/shards`` — same resolution the exporters use, so
+pointing hub and workers at one PVC path is zero-config).
+"""
+
+import os
+import time
+
+from ..obs import aggregate, export, tracing
+from ..obs import metrics as obs_metrics
+from .http import App, Response
+
+_INDEX_HTML = """<!doctype html>
+<title>kubeflow-tpu metrics hub</title>
+<h1>Fleet telemetry hub</h1>
+<ul>
+<li><a href="metrics">/metrics</a> — merged fleet exposition</li>
+<li><a href="debug/traces">/debug/traces</a> — stitched traces (JSON)</li>
+<li><a href="debug/traces?format=chrome">/debug/traces?format=chrome</a>
+ — Chrome trace (open in <a href="https://ui.perfetto.dev">Perfetto</a>)
+</li>
+<li><a href="api/fleet">/api/fleet</a> — shard inventory</li>
+</ul>
+<p>Shard dir: <code>{shard_dir}</code> — see docs/observability.md
+"Fleet metrics".</p>
+"""
+
+
+class FleetRegistry:
+    """Duck-typed stand-in for ``obs.metrics.Registry`` on the hub App:
+    ``exposition()`` returns the merged fleet view instead of the
+    process-local one. The hub's own registry joins the merge as a
+    synthetic shard, so its families (http_*,
+    obs_shard_read_errors_total, ...) appear exactly once."""
+
+    def __init__(self, shard_dir, pod, registry=None,
+                 stale_after=None):
+        self.shard_dir = shard_dir
+        self.pod = pod
+        self.registry = registry or obs_metrics.REGISTRY
+        if stale_after is None:
+            stale_after = float(os.environ.get(
+                "OBS_STALE_AFTER", aggregate.DEFAULT_STALE_AFTER))
+        self.aggregator = aggregate.Aggregator(stale_after=stale_after)
+        #: shard files untouched this long are deleted AFTER their
+        #: counters are folded into the aggregator (0 = keep forever)
+        self.retention = float(os.environ.get("OBS_SHARD_RETENTION",
+                                              "0"))
+        self.epoch = time.time()
+        self._cache = {}    # filename -> ((mtime_ns, size), Shard|None)
+
+    def exposition(self):
+        shards = (aggregate.read_shards(self.shard_dir,
+                                        cache=self._cache)
+                  if self.shard_dir else [])
+        shards.append(aggregate.local_shard(self.pod, self.epoch,
+                                            self.registry))
+        text = self.aggregator.update(shards)
+        if self.retention > 0 and self.shard_dir:
+            aggregate.prune_shards(self.shard_dir, self.retention)
+        return text
+
+
+class FleetTraces:
+    """Duck-typed stand-in for ``obs.tracing.TraceBuffer`` on the hub
+    App: merges span shards with the hub's own ring buffer."""
+
+    def __init__(self, shard_dir, pod, local=None):
+        self.shard_dir = shard_dir
+        self.pod = pod
+        self.local = local if local is not None else tracing.TRACES
+
+    def _merged(self):
+        return aggregate.merge_spans(self.shard_dir, self.local,
+                                     local_pod=self.pod)
+
+    # App.traces duck type (web/http.py traces_route)
+    def traces(self, trace_id=None, limit=50):
+        return aggregate.traces_view(self._merged(), trace_id, limit)
+
+    def chrome_trace(self, trace_id=None):
+        return aggregate.chrome_trace(self._merged(), trace_id)
+
+
+def create_app(store=None, shard_dir=None):
+    """``store`` is accepted (and ignored) for cmd/_web symmetry with
+    the other web apps — the hub reads the filesystem, not the API."""
+    shard_dir = shard_dir or export.resolve_dir() or ""
+    pod = export.pod_name(fallback="metrics-hub")
+    # the hub runs no exporter, so stamp its own process-start anchor
+    # here — the unset label-less gauge would otherwise expose 0 from
+    # the synthetic local shard and win last-write-wins on every scrape
+    export.PROCESS_START.set(export.process_start_time() or time.time())
+    app = App("metrics-hub")
+    # the built-in /metrics + /debug/traces routes read these two
+    # attributes — swapping them in IS the fleet wiring
+    app.registry = FleetRegistry(shard_dir, pod)
+    app.traces = FleetTraces(shard_dir, pod)
+    app.shard_dir = shard_dir
+
+    @app.get("/")
+    def index(request):
+        return Response(_INDEX_HTML.format(shard_dir=shard_dir or
+                                           "(unset — local view only)"),
+                        headers={"Content-Type": "text/html"})
+
+    @app.get("/api/fleet")
+    def fleet(request):
+        now = time.time()
+        pods = []
+        for shard in (aggregate.read_shards(shard_dir)
+                      if shard_dir else []):
+            pods.append({
+                "pod": shard.pod,
+                "epoch": shard.epoch,
+                "snapshot_ts": shard.ts,
+                "age_seconds": round(now - shard.ts, 3),
+                "stale": now - shard.ts
+                > app.registry.aggregator.stale_after,
+                "families": len(shard.meta),
+            })
+        errors = {pod[0]: int(count) for pod, count
+                  in aggregate.SHARD_READ_ERRORS.samples().items()}
+        return {"shardDir": shard_dir, "pods": pods,
+                "readErrors": errors}
+
+    return app

@@ -1,0 +1,264 @@
+#!/usr/bin/env python3
+"""Fleet telemetry plane load test — controller + real subprocess pods.
+
+Drives the whole telemetry plane end to end, the way docs/observability
+"Fleet metrics" describes it: an in-process control plane (StudyJob +
+queue reconcilers, ProcessPodRuntime executing trial pods as live
+subprocesses) with every process exporting metric/span shards to one
+directory, then a metrics hub merging them. Asserts the ISSUE-level
+acceptance:
+
+- the hub's single ``/metrics`` exposition carries
+  ``train_step_seconds``, ``train_mfu`` and
+  ``train_goodput_seconds_total`` samples from EVERY worker pod,
+- each pod's goodput states sum to its process wall-clock within
+  ``--tolerance`` (default 5%),
+- the hub's ``/debug/traces?format=chrome`` export holds one merged
+  Chrome trace whose controller spans (``sched.admit``) and worker
+  spans (``trial`` → ``train.*``) share the workload's derived trace
+  id — the admit → compile → step timeline renders end to end in
+  Perfetto.
+
+    python loadtest/fleet_telemetry.py --trials 2 --steps 2000
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: goodput states fed by the worker itself — these partition the pod's
+#: own wall-clock (queue_wait/suspended are scheduler-side, pre-spawn)
+WORKER_STATES = ("compute", "compile", "checkpoint", "restart")
+
+
+def build_argparser():
+    ap = argparse.ArgumentParser(prog="fleet_telemetry")
+    ap.add_argument("--trials", type=int, default=2,
+                    help="parallel trial pods (>= 2: the fleet view "
+                         "must merge multiple real processes)")
+    ap.add_argument("--steps", type=int, default=2000,
+                    help="train steps per trial (long enough that "
+                         "compute dominates the goodput ledger)")
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="study completion deadline (s)")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="max relative gap between a pod's goodput "
+                         "ledger and its wall-clock")
+    ap.add_argument("--workdir", default="/tmp/fleet-telemetry-loadtest")
+    return ap
+
+
+def make_study(name, trials, steps):
+    from kubeflow_tpu.api import tpuslice as tsapi
+    return tsapi.new_study(
+        name, "default",
+        objective={"type": "minimize", "metricName": "objective"},
+        parameters=[
+            {"name": "lr", "type": "double", "min": 1e-3, "max": 1e-2,
+             "scale": "log", "steps": trials},
+        ],
+        trial_template={"spec": {"containers": [{
+            "name": "trial", "image": "local",
+            "command": [sys.executable, "-m",
+                        "kubeflow_tpu.compute.trial"],
+            "env": [
+                {"name": "TRIAL_PARAMETERS", "value": '{"lr": {{lr}}}'},
+                {"name": "TRIAL_STEPS", "value": str(steps)},
+                # parallel local pods must not race for the host's
+                # single-client device transport — this is a telemetry
+                # acceptance, not a device test
+                {"name": "JAX_PLATFORMS", "value": "cpu"},
+            ],
+        }]}},
+        max_trials=trials, parallelism=trials, algorithm="grid",
+        queue="fleet")
+    # queue-managed: the admission path feeds queue_wait into the same
+    # goodput family the workers feed, and sched.admit opens the trace
+
+
+def _admitted(store, kind, name, ns="default"):
+    from kubeflow_tpu.core import meta as m
+    obj = store.try_get("kubeflow.org/v1alpha1", kind, name, ns)
+    return bool(m.deep_get(obj or {}, "status", "admission",
+                           "admitted"))
+
+
+def _wait_for(store, cond, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.2)
+    raise RuntimeError(f"timed out waiting for {what}")
+
+
+def run(args):
+    shard_dir = os.path.join(args.workdir, "shards")
+    os.makedirs(shard_dir, exist_ok=True)
+    # children inherit the parent env: one setting points every
+    # process — controller and trial pods — at the same shard dir
+    os.environ["OBS_EXPORT_DIR"] = shard_dir
+    os.environ["OBS_EXPORT_INTERVAL"] = "1.0"
+
+    from kubeflow_tpu import api
+    from kubeflow_tpu.api import profile as papi
+    from kubeflow_tpu.controllers.process_runtime import \
+        ProcessPodRuntime
+    from kubeflow_tpu.controllers.tpuslice import StudyJobReconciler
+    from kubeflow_tpu.core.manager import Manager
+    from kubeflow_tpu.core.store import ObjectStore
+    from kubeflow_tpu.obs import export as obs_export
+    from kubeflow_tpu.obs import tracing
+    from kubeflow_tpu.sched import QueueReconciler
+    from kubeflow_tpu.web import metrics_hub
+    from kubeflow_tpu.web.http import TestClient
+
+    store = ObjectStore()
+    api.register_all(store)
+    store.create(papi.new("default", "loadtest",
+                          quota={"google.com/tpu": "16"}))
+    runtime = ProcessPodRuntime(gang_label="studyjob",
+                                workdir=args.workdir,
+                                extra_env={"PYTHONPATH": REPO})
+    mgr = Manager(store)
+    mgr.add(QueueReconciler())
+    mgr.add(StudyJobReconciler())
+    mgr.add(runtime)
+    mgr.start()
+    exporter = obs_export.start_exporter(pod="controller", interval=1.0)
+
+    study_name = "fleet-accept"
+    t0 = time.perf_counter()
+    try:
+        # a blocker gang holds the whole quota so the study actually
+        # WAITS: the queue_wait goodput entry must come from a real
+        # scheduler decision, not a same-cycle admit
+        from kubeflow_tpu.api import tpuslice as tsapi
+        blocker = tsapi.new_slice(
+            "blocker", "default", "tpu-v5-lite-podslice", "4x4",
+            {"containers": [{"name": "worker", "image": "local"}]},
+            queue="fleet")
+        store.create(blocker)
+        _wait_for(store, lambda: _admitted(store, "TpuSlice",
+                                           "blocker"), 30,
+                  "blocker admission")
+        store.create(make_study(study_name, args.trials, args.steps))
+        time.sleep(3.0)     # the study queues behind the blocker
+        assert not _admitted(store, "StudyJob", study_name), (
+            "study admitted despite exhausted quota")
+        store.delete("kubeflow.org/v1alpha1", "TpuSlice", "blocker",
+                     "default")
+        deadline = time.time() + args.timeout
+        while time.time() < deadline:
+            status = store.get("kubeflow.org/v1alpha1", "StudyJob",
+                               study_name, "default").get("status") or {}
+            if status.get("phase") in ("Completed", "Failed"):
+                break
+            time.sleep(0.5)
+        else:
+            raise RuntimeError(f"study still running at the "
+                               f"{args.timeout:.0f}s deadline")
+        if status.get("phase") != "Completed":
+            raise RuntimeError(f"study failed: {status}")
+    finally:
+        runtime.close()
+        mgr.stop()
+        if exporter is not None:
+            exporter.stop()
+    wall = time.perf_counter() - t0
+
+    # ---- the hub view -------------------------------------------------
+    from kubeflow_tpu.obs import aggregate
+    hub = TestClient(metrics_hub.create_app(shard_dir=shard_dir))
+    r = hub.get("/metrics")
+    assert r.status == 200, f"/metrics {r.status}"
+    merged = r.body.decode()
+    for family in ("train_step_seconds", "train_mfu",
+                   "train_goodput_seconds_total"):
+        assert family in merged, f"{family} missing from the hub view"
+
+    shards = {s.pod: s for s in aggregate.read_shards(shard_dir)}
+    workers = {p: s for p, s in shards.items()
+               if p.startswith(f"{study_name}-trial-")}
+    assert len(workers) >= args.trials, (
+        f"expected >= {args.trials} worker shards, got "
+        f"{sorted(shards)}")
+    assert "controller" in shards, "controller shard missing"
+
+    report = {"workers": {}, "wall_s": round(wall, 2)}
+    for pod, shard in sorted(workers.items()):
+        families = {name for name, _labels, _v in shard.samples}
+        for family in ("train_step_seconds_count", "train_mfu",
+                       "train_goodput_seconds_total"):
+            assert family in families, f"{pod}: no {family} samples"
+        ledger = {
+            dict(labels)["state"]: value
+            for name, labels, value in shard.samples
+            if name == "train_goodput_seconds_total"}
+        accounted = sum(ledger.get(s, 0.0) for s in WORKER_STATES)
+        # true pod wall-clock: runtime spawn stamp (the exporter
+        # publishes it as the standard process-start family) → the
+        # shard's final flush
+        start = next(v for name, _labels, v in shard.samples
+                     if name == "process_start_time_seconds")
+        pod_wall = shard.ts - start
+        assert pod_wall > 0, (
+            f"{pod}: nonsensical wall-clock {pod_wall:.2f}s "
+            f"(start {start}, last flush {shard.ts})")
+        gap = abs(accounted - pod_wall) / pod_wall
+        report["workers"][pod] = {
+            "ledger_s": round(accounted, 2),
+            "wall_s": round(pod_wall, 2),
+            "gap": round(gap, 4),
+            "states": {s: round(v, 2) for s, v in ledger.items()},
+        }
+        assert gap <= args.tolerance, (
+            f"{pod}: goodput ledger {accounted:.2f}s vs wall-clock "
+            f"{pod_wall:.2f}s — gap {gap:.1%} > {args.tolerance:.0%}")
+
+    # queue_wait must come from the OTHER side (the scheduler) yet land
+    # on the same gang key in the same family
+    gang = f"default/{study_name}"
+    assert (f'train_goodput_seconds_total{{gang="{gang}",'
+            f'state="queue_wait"}}') in merged, (
+        "scheduler-fed queue_wait missing from the merged ledger")
+
+    # ---- stitched trace ----------------------------------------------
+    r = hub.get("/debug/traces?format=chrome")
+    assert r.status == 200, f"/debug/traces {r.status}"
+    trace = json.loads(r.body.decode())
+    trace_id = tracing.derive_trace_id("StudyJob", "default", study_name)
+    pids = {e["pid"] for e in trace["traceEvents"]
+            if e.get("cat") == trace_id}
+    names = {e["name"] for e in trace["traceEvents"]
+             if e.get("cat") == trace_id}
+    assert "controller" in pids, (
+        f"no controller span on gang trace {trace_id}: {pids}")
+    worker_pids = {p for p in pids if p != "controller"}
+    assert len(worker_pids) >= args.trials, (
+        f"expected every worker on gang trace {trace_id}, got {pids}")
+    assert "sched.admit" in names and "trial" in names, names
+    report["trace"] = {"trace_id": trace_id, "pids": sorted(pids),
+                       "spans": sorted(names)}
+    return report
+
+
+def main(argv=None):
+    args = build_argparser().parse_args(argv)
+    if args.trials < 2:
+        raise SystemExit("--trials must be >= 2 (fleet = many pods)")
+    report = run(args)
+    print(json.dumps(report, indent=2))
+    print("fleet telemetry acceptance OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,11 @@
-"""Observability layer (ISSUE 1): Histogram bucket/exposition
+"""Observability layer (ISSUE 1 + ISSUE 6): Histogram bucket/exposition
 semantics, W3C traceparent propagation through the App middleware,
-controller-runtime reconcile families via run_sync(), and the serving
-latency/batch-size families on the ModelServer.
+controller-runtime reconcile families via run_sync(), the serving
+latency/batch-size families on the ModelServer, and the fleet plane —
+shard export/aggregation semantics (counter restart detection,
+bucket-wise histogram merge, gauge staleness eviction, label-escape
+round-trip, torn-shard robustness), the metrics hub, train telemetry
+and the crash-safe profiler guard.
 
 Process-global registry note: module-level families accumulate across
 tests, so assertions use unique label values (controller/model/app
@@ -9,6 +13,7 @@ names) or fresh Registry instances — never absolute global totals.
 """
 
 import json
+import os
 import urllib.request
 
 import numpy as np
@@ -16,6 +21,7 @@ import pytest
 
 from kubeflow_tpu.core import manager as manager_mod
 from kubeflow_tpu.core.manager import Reconciler, Result
+from kubeflow_tpu.obs import aggregate, export
 from kubeflow_tpu.obs import metrics as obsm
 from kubeflow_tpu.obs import tracing
 from kubeflow_tpu.web import http
@@ -369,3 +375,412 @@ class TestServingMetrics:
                 '{model="obs-cn",track="canary"} 1') in text
         server.promote_canary("obs-cn")
         assert model.track == "stable"
+
+
+# -------------------------------------------------- fleet shard export
+
+def _shard(tmp_path, pod, build, epoch=None, ts=None, traces=None):
+    """Write one shard from a scratch registry built by ``build``."""
+    reg = obsm.Registry()
+    build(reg)
+    exp = export.ShardExporter(str(tmp_path), pod=pod, registry=reg,
+                               traces=traces)
+    if epoch is not None:
+        exp.epoch = epoch
+    exp.write_once()
+    if ts is not None:
+        # rewrite the header with a forged snapshot time (staleness
+        # tests) — keeping the body byte-identical
+        path = exp.metrics_path
+        with open(path) as f:
+            lines = f.read().splitlines(keepends=True)
+        lines[0] = export.format_header(pod, exp.epoch, ts) + "\n"
+        with open(path, "w") as f:
+            f.write("".join(lines))
+    return exp
+
+
+class TestShardExport:
+    def test_write_once_atomic_header_roundtrip(self, tmp_path):
+        exp = _shard(tmp_path, "w-0",
+                     lambda r: r.counter("x_total", "h").inc(3))
+        assert os.path.exists(exp.metrics_path)
+        assert not [f for f in os.listdir(tmp_path)
+                    if f.endswith(".tmp")]
+        with open(exp.metrics_path) as f:
+            first = f.readline()
+        pod, epoch, ts = export.parse_header(first)
+        assert pod == "w-0" and abs(epoch - exp.epoch) < 0.01
+        [shard] = aggregate.read_shards(str(tmp_path))
+        assert ("x_total", (), 3.0) in shard.samples
+
+    def test_resolve_dir_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("OBS_EXPORT_DIR", str(tmp_path))
+        assert export.resolve_dir() == str(tmp_path)
+        monkeypatch.setenv("OBS_EXPORT_DIR", "")
+        assert export.resolve_dir() is None   # explicit opt-out
+        assert export.start_exporter() is None
+        monkeypatch.delenv("OBS_EXPORT_DIR")
+        monkeypatch.setenv("WORKSPACE", str(tmp_path))
+        assert export.resolve_dir() == os.path.join(
+            str(tmp_path), "obs", "shards")
+
+    def test_pod_name_sanitized(self, monkeypatch):
+        monkeypatch.setenv("OBS_POD_NAME", "ns/pod:0 weird")
+        assert "/" not in export.pod_name()
+        assert export.pod_name() == "ns_pod_0_weird"
+
+    def test_pod_name_env_beats_component_fallback(self, monkeypatch):
+        # replicas of one component must not share a shard file: the
+        # downward-API POD_NAME wins over the component-name fallback
+        monkeypatch.setenv("POD_NAME", "jupyter-web-app-7d9f-x2k")
+        assert export.pod_name(fallback="jupyter-web-app") == \
+            "jupyter-web-app-7d9f-x2k"
+        monkeypatch.delenv("POD_NAME")
+        assert export.pod_name(fallback="jupyter-web-app") == \
+            "jupyter-web-app"
+
+    def test_spans_shard(self, tmp_path):
+        buf = tracing.TraceBuffer()
+        with tracing.span("w", buffer=buf):
+            pass
+        _shard(tmp_path, "w-1", lambda r: None, traces=buf)
+        [(pod, spans)] = aggregate.read_span_shards(str(tmp_path))
+        assert pod == "w-1" and spans[0]["name"] == "w"
+
+    def test_process_start_anchor_exported(self, tmp_path,
+                                           monkeypatch):
+        # global-registry exporters publish the runtime's spawn stamp
+        # as the standard process-start family: shard ts minus it is
+        # the pod's true wall-clock (the goodput acceptance anchor)
+        monkeypatch.setenv("OBS_SPAWNED_AT", "1234.5")
+        exp = export.ShardExporter(str(tmp_path), pod="w-3")
+        exp.write_once()
+        exp.stop(flush=False)
+        shard = next(s for s in aggregate.read_shards(str(tmp_path))
+                     if s.pod == "w-3")
+        assert ("process_start_time_seconds", (), 1234.5) \
+            in shard.samples
+
+
+# ----------------------------------------------- aggregation semantics
+
+class TestAggregation:
+    def test_counters_sum_across_pods(self, tmp_path):
+        for pod, n in (("a", 5), ("b", 2)):
+            _shard(tmp_path, pod, lambda r, n=n: r.counter(
+                "jobs_total", "h", ("q",)).labels("x").inc(n))
+        text = aggregate.Aggregator().update(
+            aggregate.read_shards(str(tmp_path)))
+        assert 'jobs_total{q="x"} 7' in text
+
+    def test_counter_restart_detection_epoch(self, tmp_path):
+        agg = aggregate.Aggregator()
+        _shard(tmp_path, "a", lambda r: r.counter(
+            "jobs_total", "h").inc(5), epoch=100.0)
+        agg.update(aggregate.read_shards(str(tmp_path)))
+        # pod restarts: same pod name, new epoch, counter back at 1 —
+        # the fleet total must fold the previous life in (5 + 1)
+        _shard(tmp_path, "a", lambda r: r.counter(
+            "jobs_total", "h").inc(1), epoch=200.0)
+        text = agg.update(aggregate.read_shards(str(tmp_path)))
+        assert "jobs_total 6" in text
+
+    def test_counter_restart_detection_decrease(self, tmp_path):
+        agg = aggregate.Aggregator()
+        _shard(tmp_path, "a", lambda r: r.counter(
+            "jobs_total", "h").inc(5), epoch=100.0)
+        agg.update(aggregate.read_shards(str(tmp_path)))
+        # identical epoch but the value went DOWN: still a restart
+        _shard(tmp_path, "a", lambda r: r.counter(
+            "jobs_total", "h").inc(2), epoch=100.0)
+        text = agg.update(aggregate.read_shards(str(tmp_path)))
+        assert "jobs_total 7" in text
+
+    def test_histogram_bucket_wise_merge(self, tmp_path):
+        def build_a(r):
+            h = r.histogram("lat_seconds", "h", ("m",),
+                            buckets=(0.1, 1.0))
+            h.labels("x").observe(0.05)
+            h.labels("x").observe(5.0)
+
+        def build_b(r):
+            h = r.histogram("lat_seconds", "h", ("m",),
+                            buckets=(0.1, 1.0))
+            h.labels("x").observe(0.5)
+
+        _shard(tmp_path, "a", build_a)
+        _shard(tmp_path, "b", build_b)
+        text = aggregate.Aggregator().update(
+            aggregate.read_shards(str(tmp_path)))
+        assert 'lat_seconds_bucket{m="x",le="0.1"} 1' in text
+        assert 'lat_seconds_bucket{m="x",le="1"} 2' in text
+        assert 'lat_seconds_bucket{m="x",le="+Inf"} 3' in text
+        assert 'lat_seconds_count{m="x"} 3' in text
+        assert 'lat_seconds_sum{m="x"} 5.55' in text
+
+    def test_gauge_staleness_eviction_and_lww(self, tmp_path):
+        import time as _time
+        now = _time.time()
+
+        def build(value):
+            return lambda r: r.gauge("temp", "h", ("m",)).labels(
+                "x").set(value)
+
+        _shard(tmp_path, "old", build(1.0), ts=now - 3600)
+        _shard(tmp_path, "mid", build(2.0), ts=now - 10)
+        _shard(tmp_path, "new", build(3.0), ts=now - 1)
+        agg = aggregate.Aggregator(stale_after=60)
+        text = agg.update(aggregate.read_shards(str(tmp_path)),
+                          now=now)
+        # last write wins among fresh shards; the stale pod's value is
+        # evicted entirely (never resurrected as the winner)
+        assert 'temp{m="x"} 3' in text
+        assert 'temp{m="x"} 1' not in text
+
+    def test_stale_shard_counters_still_counted(self, tmp_path):
+        import time as _time
+        now = _time.time()
+        _shard(tmp_path, "dead", lambda r: r.counter(
+            "jobs_total", "h").inc(4), ts=now - 3600)
+        text = aggregate.Aggregator(stale_after=60).update(
+            aggregate.read_shards(str(tmp_path)), now=now)
+        assert "jobs_total 4" in text    # completed work stays counted
+
+    def test_label_escaping_roundtrip_through_shard(self, tmp_path):
+        hostile = 'a"b\\c\nd'
+        _shard(tmp_path, "a", lambda r: r.counter(
+            "esc_total", "h", ("queue",)).labels(hostile).inc())
+        [shard] = aggregate.read_shards(str(tmp_path))
+        [(name, labels, value)] = [
+            s for s in shard.samples if s[0] == "esc_total"]
+        assert labels == (("queue", hostile),)
+        text = aggregate.Aggregator().update([shard])
+        # re-exposed form is byte-identical to the process-local one
+        assert 'esc_total{queue="a\\"b\\\\c\\nd"} 1' in text
+
+    def test_read_shards_cache_and_prune(self, tmp_path):
+        _shard(tmp_path, "a", lambda r: r.counter(
+            "jobs_total", "h").inc(5))
+        cache = {}
+        [s1] = aggregate.read_shards(str(tmp_path), cache=cache)
+        [s2] = aggregate.read_shards(str(tmp_path), cache=cache)
+        assert s2 is s1    # unchanged file → memoized parse
+        agg = aggregate.Aggregator()
+        agg.update([s1])
+        # prune the dead pod's files; its counters survive in the
+        # aggregator's folded state
+        assert "a.prom" in aggregate.prune_shards(str(tmp_path),
+                                                  older_than=0)
+        assert aggregate.read_shards(str(tmp_path), cache=cache) == []
+        assert "a.prom" not in cache
+        assert "jobs_total 5" in agg.update([])
+
+    def test_timestamp_precision_survives_exposition(self, tmp_path):
+        # %g's 6 significant digits would mangle a unix-timestamp
+        # gauge by thousands of seconds; exposition must round-trip
+        # floats exactly (shortest repr, like the Go client)
+        stamp = 1785765461.601
+        _shard(tmp_path, "a", lambda r: r.gauge(
+            "start_seconds", "h").set(stamp))
+        [shard] = aggregate.read_shards(str(tmp_path))
+        assert ("start_seconds", (), stamp) in shard.samples
+        text = aggregate.Aggregator().update([shard])
+        assert f"start_seconds {stamp!r}" in text
+
+    def test_torn_shard_counted_and_skipped(self, tmp_path):
+        _shard(tmp_path, "good", lambda r: r.counter(
+            "jobs_total", "h").inc(1))
+        for name, content in (
+                ("torn", '# kubeflow-tpu-shard pod="torn" epoch=1 '
+                         'ts=1\njobs_total{q="x" 5\n'),
+                ("noheader", "jobs_total 5\n"),
+                ("binary", "\x00\xff garbage")):
+            with open(os.path.join(str(tmp_path), f"{name}.prom"), "w",
+                      errors="surrogateescape") as f:
+                f.write(content)
+        errors = obsm.Registry().counter(
+            "obs_shard_read_errors_total", "h", ("pod",))
+        shards = aggregate.read_shards(str(tmp_path),
+                                       errors_counter=errors)
+        assert [s.pod for s in shards] == ["good"]
+        for pod in ("torn", "noheader", "binary"):
+            assert errors.value(pod) == 1
+
+
+# --------------------------------------------------------- metrics hub
+
+class TestMetricsHub:
+    def _hub(self, tmp_path):
+        from kubeflow_tpu.web import metrics_hub
+        return http.TestClient(
+            metrics_hub.create_app(shard_dir=str(tmp_path)))
+
+    def test_merged_metrics_from_multiple_pods(self, tmp_path):
+        for pod, secs in (("worker-0", 30.0), ("worker-1", 12.0)):
+            _shard(tmp_path, pod, lambda r, s=secs: r.counter(
+                "train_goodput_seconds_total", "h",
+                ("gang", "state")).labels("default/s1",
+                                          "compute").inc(s))
+        r = self._hub(tmp_path).get("/metrics")
+        assert r.status == 200
+        assert "text/plain" in r.headers["Content-Type"]
+        assert ('train_goodput_seconds_total{gang="default/s1",'
+                'state="compute"} 42') in r.body.decode()
+
+    def test_never_500s_on_torn_shard(self, tmp_path):
+        with open(os.path.join(str(tmp_path), "dead.prom"), "w") as f:
+            f.write("not a shard at all")
+        c = self._hub(tmp_path)
+        r = c.get("/metrics")
+        assert r.status == 200
+        assert ('obs_shard_read_errors_total{pod="dead"} 1'
+                in r.body.decode())
+        fleet = c.get("/api/fleet").json
+        assert fleet["readErrors"].get("dead", 0) >= 1
+
+    def test_trace_stitching_across_pods(self, tmp_path):
+        tp = tracing.workload_traceparent("TpuSlice", "default", "s1",
+                                          0)
+        tid = tp.split("-")[1]
+        buf = tracing.TraceBuffer()
+        with tracing.span("slice-worker", buffer=buf, traceparent=tp):
+            pass
+        _shard(tmp_path, "worker-0", lambda r: None, traces=buf)
+        buf2 = tracing.TraceBuffer()
+        with tracing.span("sched.admit", buffer=buf2, traceparent=tp):
+            pass
+        _shard(tmp_path, "controller", lambda r: None, traces=buf2)
+        c = self._hub(tmp_path)
+        traces = c.get(f"/debug/traces?trace_id={tid}").json["traces"]
+        assert len(traces) == 1
+        names = {s["name"] for s in traces[0]["spans"]}
+        assert {"slice-worker", "sched.admit"} <= names
+        chrome = c.get(
+            f"/debug/traces?format=chrome&trace_id={tid}").json
+        pids = {e["pid"] for e in chrome["traceEvents"]}
+        assert {"worker-0", "controller"} <= pids
+
+    def test_explicit_traceparent_overrides_ambient_parent(self):
+        # a controller dropping a marker on a workload's derived trace
+        # from inside its own reconcile span must land on the WORKLOAD
+        # trace — explicit traceparent beats the contextvar parent
+        buf = tracing.TraceBuffer()
+        tp = tracing.workload_traceparent("TpuSlice", "ns", "w", 1)
+        want = tp.split("-")[1]
+        with tracing.span("reconcile", buffer=buf) as ambient:
+            with tracing.span("marker", traceparent=tp,
+                              buffer=buf) as s:
+                assert s.trace_id == want != ambient.trace_id
+            # without one, the in-process parent still wins
+            with tracing.span("child", buffer=buf) as child:
+                assert child.trace_id == ambient.trace_id
+                assert child.parent_id == ambient.span_id
+
+    def test_derived_traceparent_stable_and_valid(self):
+        tp1 = tracing.workload_traceparent("StudyJob", "ns", "s", 3)
+        tp2 = tracing.workload_traceparent("StudyJob", "ns", "s", 4)
+        assert tracing.parse_traceparent(tp1) is not None
+        # same workload → same trace id; different epoch → new parent
+        assert tp1.split("-")[1] == tp2.split("-")[1]
+        assert tp1.split("-")[2] != tp2.split("-")[2]
+        other = tracing.workload_traceparent("TpuSlice", "ns", "s", 3)
+        assert other.split("-")[1] != tp1.split("-")[1]
+
+
+# ----------------------------------------------------- train telemetry
+
+class TestTrainTelemetry:
+    def test_first_step_is_compile_then_compute(self):
+        from kubeflow_tpu.compute import telemetry as telem
+        tele = telem.TrainTelemetry("tm-a", gang="tns/g1",
+                                    flops_per_step=1e12, peak=2e12)
+        base_steps = telem.STEP_SECONDS.value("tm-a")
+        tele.step()               # closes the compile window
+        assert telem.STEP_SECONDS.value("tm-a") == base_steps
+        assert telem.COMPILE_SECONDS.value("tm-a") >= 0
+        tele.step(0.5)
+        tele.step(0.5)
+        assert telem.STEP_SECONDS.value("tm-a") == base_steps + 2
+        # MFU = flops / ema_step / peak = 1e12 / 0.5 / 2e12 = 1.0
+        assert abs(tele.live_mfu() - 1.0) < 1e-9
+        assert telem.GOODPUT.value("tns/g1", "compute") == \
+            pytest.approx(1.0)
+
+    def test_goodput_states_and_resumed(self):
+        from kubeflow_tpu.compute import telemetry as telem
+        tele = telem.TrainTelemetry("tm-b", gang="tns/g2",
+                                    resumed=True)
+        tele.step()               # resumed: startup lands in restart
+        tele.checkpoint(0.25)
+        assert telem.GOODPUT.value("tns/g2", "restart") >= 0
+        assert telem.GOODPUT.value("tns/g2", "checkpoint") == \
+            pytest.approx(0.25)
+        with pytest.raises(ValueError, match="unknown goodput state"):
+            telem.record_goodput("tns/g2", "napping", 1.0)
+
+    def test_no_gang_no_ledger(self):
+        from kubeflow_tpu.compute import telemetry as telem
+        before = dict(telem.GOODPUT.samples())
+        tele = telem.TrainTelemetry("tm-c", gang=None)
+        tele.gang = None          # even if OBS_GANG leaked into env
+        tele.step()
+        tele.step(0.1)
+        assert dict(telem.GOODPUT.samples()) == before
+
+
+# ------------------------------------------------- crash-safe profiler
+
+class TestProfilerTrace:
+    @pytest.fixture
+    def fake_jax_profiler(self, monkeypatch):
+        from kubeflow_tpu.compute import profiler
+        calls = {"start": 0, "stop": 0}
+        monkeypatch.setattr(
+            "jax.profiler.start_trace",
+            lambda *a, **k: calls.__setitem__(
+                "start", calls["start"] + 1))
+        monkeypatch.setattr(
+            "jax.profiler.stop_trace",
+            lambda: calls.__setitem__("stop", calls["stop"] + 1))
+        monkeypatch.setattr(profiler, "_active_base", None)
+        return calls
+
+    def test_stop_runs_when_step_raises(self, tmp_path,
+                                        fake_jax_profiler):
+        from kubeflow_tpu.compute import profiler
+        with pytest.raises(RuntimeError, match="boom"):
+            with profiler.trace(str(tmp_path)):
+                raise RuntimeError("boom")
+        assert fake_jax_profiler == {"start": 1, "stop": 1}
+        # the session is released: a new trace can start
+        with profiler.trace(str(tmp_path)):
+            pass
+        assert fake_jax_profiler == {"start": 2, "stop": 2}
+
+    def test_double_start_raises_named_error(self, tmp_path,
+                                             fake_jax_profiler):
+        from kubeflow_tpu.compute import profiler
+        with profiler.trace(str(tmp_path)):
+            with pytest.raises(profiler.ProfilerActiveError,
+                               match="already capturing"):
+                with profiler.trace(str(tmp_path)):
+                    pass
+        # the failed second start must NOT have stopped the first: one
+        # start, one stop
+        assert fake_jax_profiler == {"start": 1, "stop": 1}
+
+    def test_failed_start_leaves_profiler_inactive(self, tmp_path,
+                                                   monkeypatch):
+        from kubeflow_tpu.compute import profiler
+
+        def bad_start(*a, **k):
+            raise RuntimeError("backend says no")
+
+        monkeypatch.setattr("jax.profiler.start_trace", bad_start)
+        monkeypatch.setattr("jax.profiler.stop_trace", lambda: None)
+        monkeypatch.setattr(profiler, "_active_base", None)
+        with pytest.raises(RuntimeError, match="backend says no"):
+            with profiler.trace(str(tmp_path)):
+                pass
+        assert profiler._active_base is None
