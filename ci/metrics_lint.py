@@ -21,8 +21,11 @@ import re
 import sys
 
 #: families documented in docs/observability.md's tables — one row per
-#: family, first cell the backticked name
-_DOC_FAMILY_RE = re.compile(r"^\|\s*`([a-z_][a-z0-9_]*)`\s*\|")
+#: family: first cell the backticked name, second the metric type (the
+#: type cell distinguishes family rows from other tables, e.g. the
+#: latency-anatomy phase glossary)
+_DOC_FAMILY_RE = re.compile(
+    r"^\|\s*`([a-z_][a-z0-9_]*)`\s*\|\s*(?:counter|gauge|histogram)\s*\|")
 
 
 def documented_families(repo_root):
@@ -47,6 +50,7 @@ def main():
     import kubeflow_tpu.core.manager          # noqa: F401
     import kubeflow_tpu.core.workqueue        # noqa: F401
     import kubeflow_tpu.obs.aggregate         # noqa: F401
+    import kubeflow_tpu.obs.slo               # noqa: F401
     import kubeflow_tpu.sched.controller      # noqa: F401
     import kubeflow_tpu.web.http              # noqa: F401
     from kubeflow_tpu.controllers.metrics import NotebookMetrics
@@ -90,11 +94,44 @@ def main():
         "train_compile_seconds_total",
         "train_goodput_seconds_total",
         "obs_shard_read_errors_total",
+        # latency anatomy + SLO plane (ISSUE 8): the deadline-shed
+        # counter and the SLO source feed obs/slo.py's default SLOs;
+        # the slo_* gauges are what /api/alerts and dashboards read
+        "serving_requests_total",
+        "serving_deadline_exceeded_total",
+        "slo_burn_rate",
+        "slo_error_budget_remaining",
     }
     registered = {metric.name for metric in obs_metrics.REGISTRY._metrics}
     scratch_names = {metric.name for metric in scratch._metrics}
     for name in sorted(required - registered):
         problems.append(f"required family {name} is not registered")
+
+    # exemplar syntax: every " # " suffix anywhere in an exposition
+    # must parse as an OpenMetrics exemplar, or a scraper chokes on
+    # the whole page. Validate the live registry's exposition PLUS a
+    # synthetic histogram that exercises both the bucket and +Inf
+    # exemplar paths (the live registry may have none at lint time).
+    from kubeflow_tpu.obs import aggregate as obs_aggregate
+    exemplar_reg = obs_metrics.Registry()
+    eh = exemplar_reg.histogram("lint_exemplar_seconds", "lint probe",
+                                buckets=(0.1, 1.0))
+    eh.observe(0.05, trace_id="ab" * 16)
+    eh.observe(5.0, trace_id="cd" * 16)
+    for text in (obs_metrics.REGISTRY.exposition(),
+                 exemplar_reg.exposition()):
+        for line in text.splitlines():
+            if line.startswith("#") or " # " not in line:
+                continue
+            mo = obs_aggregate._SAMPLE_RE.match(line)
+            if mo is None or mo.group(4) is None:
+                problems.append(
+                    f"unparseable exemplar sample line: {line!r}")
+            elif obs_aggregate._EXEMPLAR_RE.match(mo.group(4)) is None:
+                problems.append(
+                    f"malformed exemplar suffix: {mo.group(4)!r}")
+    if eh.value() != 2:
+        problems.append("exemplar probe histogram lost observations")
 
     # docs <-> code drift: every family the docs table documents must
     # exist in the codebase, and every required family must be

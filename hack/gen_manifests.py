@@ -86,10 +86,16 @@ WEB_APPS = {
                        "port": 5000, "prefix": "/queues"},
     # fleet telemetry hub (web/metrics_hub.py): merges the per-pod
     # shard files workers export to the workspace PVC into one
-    # /metrics + /debug/traces; the dashboard menu links it
+    # /metrics + /debug/traces + /debug/latency, and runs the SLO
+    # burn-rate engine behind /api/alerts; the dashboard menu links
+    # it. The SLO_* knobs are the SRE-workbook page-alert defaults
+    # (obs/slo.py), spelled out here so operators see where to retune.
     "metrics-hub": {"image": PLATFORM_IMAGE,
                     "port": 5000, "prefix": "/metrics-hub",
-                    "env": {"OBS_EXPORT_DIR": "/workspace/obs/shards"}},
+                    "env": {"OBS_EXPORT_DIR": "/workspace/obs/shards",
+                            "SLO_WINDOW_FAST": "300",
+                            "SLO_WINDOW_SLOW": "3600",
+                            "SLO_BURN_THRESHOLD": "14.4"}},
     "access-management": {"image": PLATFORM_IMAGE,
                           "port": 8081, "prefix": "/kfam"},
     "centraldashboard": {"image": PLATFORM_IMAGE,

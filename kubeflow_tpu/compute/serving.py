@@ -34,6 +34,7 @@ substrate:
 
 import base64
 import collections
+import contextlib
 import json
 import logging
 import queue
@@ -97,6 +98,17 @@ _BATCH_OCCUPANCY = obs_metrics.REGISTRY.histogram(
     "distribution's mass above 1 under concurrent load)",
     ("model", "track"),
     buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64))
+_REQUESTS_TOTAL = obs_metrics.REGISTRY.counter(
+    "serving_requests_total",
+    "Predict-route responses by model and HTTP status code — the "
+    "serving error-ratio SLO source (obs/slo.py)",
+    ("model", "code"))
+_DEADLINE_EXCEEDED = obs_metrics.REGISTRY.counter(
+    "serving_deadline_exceeded_total",
+    "Predict requests resolved 504 because their X-Request-Deadline-Ms "
+    "expired while queued in the batcher (shed before dispatch, "
+    "freeing the batch slot instead of computing a dead answer)",
+    ("model",))
 
 #: dtypes accepted on the binary tensor path (little-endian raw bytes)
 TENSOR_DTYPES = {"float32", "float16", "int32", "int8", "uint8"}
@@ -149,8 +161,14 @@ class _Batcher:
                                        name="serving-batcher")
         self.thread.start()
 
-    def submit(self, x):
+    def submit(self, x, rt=None, deadline=None):
         """Blocking: returns (result_rows, device_ms_of_the_batch).
+
+        ``rt`` (obs.tracing.RequestTrace) collects the batching phases
+        (queue_wait / dispatch / device) for the request's latency
+        anatomy; ``deadline`` (time.monotonic seconds) sheds the
+        request with DeadlineExceededError if it expires before its
+        batch dispatches.
 
         TOCTOU note: the ``_accepting``/``_dead`` check below and the
         ``q.put`` are not atomic — ``stop()`` can flip ``_accepting``
@@ -164,7 +182,8 @@ class _Batcher:
         if not self._accepting or self._dead.is_set():
             raise RuntimeError("batcher stopped")
         done = threading.Event()
-        slot = {"x": x, "done": done, "t": time.perf_counter()}
+        slot = {"x": x, "done": done, "t": time.perf_counter(),
+                "tw": time.time(), "rt": rt, "deadline": deadline}
         self.q.put(slot)
         if self._dead.is_set():
             # loop exited between the check and the put: its drain may
@@ -301,7 +320,33 @@ class _Batcher:
 
     def _dispatch_group(self, group):
         """One shape bucket → one async device launch, pushed onto the
-        in-flight queue. Dispatch failures resolve the whole group."""
+        in-flight queue. Dispatch failures resolve the whole group.
+
+        Load shedding happens HERE, at the last moment before the
+        device is committed: a request whose propagated deadline
+        expired while it queued resolves 504 instead of occupying
+        batch rows — under overload the freed slots go to requests
+        whose callers are still waiting."""
+        if any(g.get("deadline") is not None for g in group):
+            now_m = time.monotonic()
+            live = []
+            for g in group:
+                dl = g.get("deadline")
+                if dl is not None and now_m >= dl:
+                    if self.owner is not None:
+                        _DEADLINE_EXCEEDED.labels(
+                            self.owner.name).inc()
+                    waited = time.perf_counter() - g["t"]
+                    g["error"] = DeadlineExceededError(
+                        f"deadline expired while queued for batching "
+                        f"(waited {waited * 1000:.0f} ms)")
+                    g["done"].set()
+                else:
+                    live.append(g)
+            group = live
+            if not group:
+                return
+        now_w = time.time()
         if self.owner is not None:
             now = time.perf_counter()
             wait = _QUEUE_WAIT_SECONDS.labels(self.owner.name,
@@ -310,6 +355,9 @@ class _Batcher:
                 wait.observe(now - g["t"])
             _BATCH_OCCUPANCY.labels(
                 self.owner.name, self.owner.track).observe(len(group))
+        for g in group:
+            if g.get("rt") is not None:
+                g["rt"].phase("batch.queue_wait", g["tw"], now_w)
         try:
             x = np.concatenate([g["x"] for g in group], axis=0) \
                 if len(group) > 1 else group[0]["x"]
@@ -320,10 +368,14 @@ class _Batcher:
                 g["error"] = e
                 g["done"].set()
             return
+        tw1 = time.time()
         for g in group:
             g["launched"] = True
+            if g.get("rt") is not None:
+                g["rt"].phase("batch.dispatch", now_w, tw1)
         self._inflight.append(
-            {"group": group, "fut": fut, "rows": n, "t0": t0})
+            {"group": group, "fut": fut, "rows": n, "t0": t0,
+             "tw0": tw1})
 
     def _finalize_one(self):
         """Block on the oldest in-flight batch, resolve its slots.
@@ -340,12 +392,17 @@ class _Batcher:
             # pipeline overlap the loop spent collecting the next
             # window — what the X-Inference-Time-Ms header reports
             ms = 1000 * (time.perf_counter() - rec["t0"])
+            end_w = time.time()
             off = 0
             for g in group:
                 n = g["x"].shape[0]
                 g["out"] = out[off:off + n]
                 g["ms"] = ms
                 off += n
+                if g.get("rt") is not None:
+                    # same window as ms: launch → fetch complete (any
+                    # double-buffering overlap is attributed here too)
+                    g["rt"].phase("device", rec["tw0"], end_w)
         except Exception as e:  # noqa: BLE001 — propagate per-request
             for g in group:
                 g["error"] = e
@@ -376,6 +433,11 @@ def tree_bytes(params):
     trees count their int8 bytes via quantized_bytes."""
     from . import quantize as _q
     return _q.quantized_bytes(params)[0]
+
+
+class DeadlineExceededError(Exception):
+    """The request's propagated deadline expired while it sat in the
+    batch queue — resolved 504 without a device dispatch."""
 
 
 class ModelTooLargeError(Exception):
@@ -496,21 +558,31 @@ class ServedModel:
     def predict(self, instances):
         return self.predict_timed(instances)[0]
 
-    def predict_raw(self, x):
+    def predict_raw(self, x, rt=None, deadline=None):
         """→ (ndarray, device_ms) — the binary-path core; the JSON path
         wraps it. Timing returned per-call (no shared state: the HTTP
-        server is threaded)."""
+        server is threaded).
+
+        ``rt`` (obs.tracing.RequestTrace) collects the per-phase
+        latency anatomy instead of a span — on the sampled-out hot
+        path NO span objects are allocated anywhere below here.
+        Embedded callers without a recorder keep the old always-on
+        ``serving.dispatch`` span. ``deadline`` (time.monotonic) sheds
+        the request in the batch queue (DeadlineExceededError)."""
         x = np.asarray(x)
         if x.ndim == 0:
             raise ValueError(
                 "instances must be a list of inputs, got a scalar")
         t0 = time.perf_counter()
-        with tracing.span("serving.dispatch", model=self.name,
-                          track=self.track, version=self.version,
-                          rows=int(x.shape[0])):
+        span_cm = (tracing.span("serving.dispatch", model=self.name,
+                                track=self.track, version=self.version,
+                                rows=int(x.shape[0]))
+                   if rt is None else contextlib.nullcontext())
+        with span_cm:
             if self._batcher is not None:
                 try:
-                    result = self._batcher.submit(x)
+                    result = self._batcher.submit(x, rt=rt,
+                                                  deadline=deadline)
                 except RuntimeError as e:
                     if "batcher stopped" not in str(e) \
                             or not self._batcher._graceful_stop:
@@ -522,13 +594,21 @@ class ServedModel:
                     # 500ing work that predates the transition,
                     # matching the pre-batching-default semantics.
                     # Hard stops (server shutdown) still refuse.
+                    tw = time.time()
                     out = self._run(x)
                     result = out, 1000 * (time.perf_counter() - t0)
+                    if rt is not None:
+                        rt.phase("device", tw)
             else:
+                tw = time.time()
                 out = self._run(x)
                 result = out, 1000 * (time.perf_counter() - t0)
+                if rt is not None:
+                    rt.phase("device", tw)
+        elapsed = time.perf_counter() - t0
         _REQUEST_SECONDS.labels(self.name, self.track).observe(
-            time.perf_counter() - t0)
+            elapsed,
+            trace_id=rt.exemplar(elapsed) if rt is not None else None)
         return result
 
     def predict_timed(self, instances):
@@ -607,21 +687,37 @@ def _parse_tensor_headers(headers):
     return np.dtype(dtype).newbyteorder("<"), shape
 
 
-def _decode_tensor_stream(headers, rfile, length):
-    """Octet-stream request body → ndarray, wire-cheap: no JSON, no
-    base64 — ``np.frombuffer`` straight over the bytes read off the
-    socket (the padded batch buffer is assembled from this view by the
-    dispatch path). Malformed → ValueError (→ 400)."""
+def _decode_tensor_stream(headers, rfile, length, rt=None):
+    """Octet-stream request body → ``(ndarray, decode_seconds)``,
+    wire-cheap: no JSON, no base64 — ``np.frombuffer`` straight over
+    the bytes read off the socket (the padded batch buffer is
+    assembled from this view by the dispatch path). Malformed →
+    ValueError (→ 400). ``rt`` records the ``http.read``/``decode``
+    anatomy phases; ``decode_seconds`` excludes the socket read so
+    ``serving_decode_seconds{format="binary"}`` measures the same leg
+    as the JSON formats — pure body→ndarray (≈ 0 here — that IS the
+    point of the binary format, and both the metric and the anatomy
+    show it)."""
+    t0 = time.perf_counter()
     dtype, shape = _parse_tensor_headers(headers)
     want = int(np.prod(shape)) * dtype.itemsize
     if length != want:
         raise ValueError(f"Content-Length is {length} bytes, "
                          f"shape×dtype needs {want}")
+    t_read = time.time()
+    read_s = time.perf_counter()
     data = rfile.read(length) if length else b""
+    read_s = time.perf_counter() - read_s
     if len(data) != length:
         raise ValueError(f"body is {len(data)} bytes, "
                          f"Content-Length said {length}")
-    return np.frombuffer(data, dtype=dtype).reshape(shape)
+    if rt is not None:
+        rt.phase("http.read", t_read)
+    t_dec = time.time()
+    arr = np.frombuffer(data, dtype=dtype).reshape(shape)
+    if rt is not None:
+        rt.phase("decode", t_dec, format="binary")
+    return arr, time.perf_counter() - t0 - read_s
 
 
 class ModelServer:
@@ -975,7 +1071,11 @@ class ModelServer:
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
-                sp = tracing.current_span()
+                # POSTs carry the request recorder (RequestTrace duck-
+                # types format_traceparent); GETs fall back to any
+                # ambient span
+                sp = getattr(self, "_rt", None) or \
+                    tracing.current_span()
                 if sp is not None:
                     # responses stitch into the caller's W3C trace
                     self.send_header("traceparent",
@@ -995,7 +1095,11 @@ class ModelServer:
                 for k, v in extra_headers:
                     self.send_header(k, v)
                 self.end_headers()
+                rt = getattr(self, "_rt", None)
+                t_write = time.time()
                 self.wfile.write(body)
+                if rt is not None:
+                    rt.phase("http.write", t_write)
 
             @staticmethod
             def _residency(model):
@@ -1027,6 +1131,12 @@ class ModelServer:
                             200, tracing.TRACES.chrome_trace(tid))
                     return self._send(
                         200, {"traces": tracing.TRACES.traces(tid)})
+                if parts == ["debug", "latency"]:
+                    # per-phase p50/p95/p99 from the span ring: the
+                    # request latency anatomy (docs/observability.md)
+                    return self._send(200, tracing.latency_summary(
+                        tracing.TRACES.span_dicts(),
+                        path=query.get("path")))
                 # /v1/models/<name> → model version status
                 if len(parts) == 3 and parts[:2] == ["v1", "models"]:
                     model = models.get(parts[2])
@@ -1083,17 +1193,58 @@ class ModelServer:
                 self._send(404, {"error": "not found"})
 
             def do_POST(self):
-                # server span: continues the caller's trace when the
-                # request carries a W3C traceparent (e.g. the web tier
-                # proxying a predict); serving.dispatch nests under it
-                with tracing.span(
-                        f"http POST {urlsplit(self.path).path}",
-                        traceparent=self.headers.get("traceparent"),
-                        app="model-server") as sp:
+                # request recorder: continues the caller's trace when
+                # the request carries a W3C traceparent (e.g. the web
+                # tier proxying a predict), decides head sampling from
+                # the trace id, and collects the per-phase latency
+                # anatomy. A sampled-out fast request allocates NO
+                # span objects — the ring only sees sampled-in, slow,
+                # or errored requests (OBS_TRACE_SAMPLE /
+                # OBS_TRACE_SLOW_MS).
+                rt = tracing.RequestTrace(
+                    f"http POST {urlsplit(self.path).path}",
+                    traceparent=self.headers.get("traceparent"),
+                    app="model-server")
+                self._rt = rt
+                try:
                     self._handle_post()
-                    sp.attrs.setdefault("code", 200)  # stream path
+                except BaseException as e:
+                    rt.status = "error"
+                    rt.attrs.setdefault("error",
+                                        f"{type(e).__name__}: {e}")
+                    raise
+                finally:
+                    # keep-alive: this handler instance persists across
+                    # requests on the connection — a stale recorder
+                    # must not leak into the next request's _send
+                    self._rt = None
+                    rt.attrs.setdefault("code", 200)  # stream path
+                    model = rt.attrs.get("model")
+                    if model is not None:
+                        # the error-ratio SLO source: one count per
+                        # predict-route response, by final status
+                        _REQUESTS_TOTAL.labels(
+                            model, str(rt.attrs["code"])).inc()
+                    rt.finish()
+
+            def _parse_deadline(self):
+                """``X-Request-Deadline-Ms`` → absolute time.monotonic
+                deadline (None = no deadline; malformed → ValueError
+                → 400). The client's remaining budget propagates so
+                the batcher can shed work nobody is waiting for."""
+                raw = self.headers.get("X-Request-Deadline-Ms")
+                if raw is None or not raw.strip():
+                    return None
+                try:
+                    ms = float(raw)
+                except ValueError:
+                    raise ValueError(
+                        f"X-Request-Deadline-Ms must be a number of "
+                        f"milliseconds, got {raw!r}") from None
+                return time.monotonic() + max(0.0, ms) / 1000.0
 
             def _handle_post(self):
+                rt = self._rt
                 parts = self.path.strip("/").split("/")
                 if (len(parts) != 3 or parts[:2] != ["v1", "models"]
                         or ":" not in parts[2]):
@@ -1105,27 +1256,37 @@ class ModelServer:
                 # canary split: a weighted fraction of traffic serves
                 # from the canary version (resolved per request)
                 model = server._route(name, model)
+                rt.attrs["model"] = name
+                rt.attrs["track"] = model.track
                 if self._reject_chunked():
                     return
                 if verb == "predictStream":
                     return self._predict_stream(model)
                 if verb != "predict":
                     return self._send(400, {"error": f"verb {verb}"})
+                try:
+                    deadline = self._parse_deadline()
+                except ValueError as e:
+                    return self._send(400, {"error": f"bad request: {e}"})
                 ctype = (self.headers.get("Content-Type") or "") \
                     .split(";")[0].strip().lower()
                 if ctype == "application/x-tensor":
                     # raw octet-stream: dtype/shape in headers, the
                     # body IS the little-endian buffer — no JSON, no
                     # base64 on either leg
-                    return self._predict_binary(model)
+                    return self._predict_binary(model, deadline)
                 # 400 = the caller's fault (malformed body); 500 = ours
                 # (inference failed) — clients like the reference's
                 # test_tf_serving retry loop key off the distinction
                 binary = False
-                t_dec = time.perf_counter()
                 try:
                     length = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(length) or b"{}")
+                    t_read = time.time()
+                    raw = self.rfile.read(length) if length else b""
+                    rt.phase("http.read", t_read)
+                    t_dec = time.perf_counter()
+                    tw_dec = time.time()
+                    req = json.loads(raw or b"{}")
                     if "tensor" in req:
                         binary = True
                         x = _decode_tensor(req["tensor"])
@@ -1142,7 +1303,8 @@ class ModelServer:
                 _WIRE_FORMAT_TOTAL.labels(fmt).inc()
                 _DECODE_SECONDS.labels(fmt).observe(
                     time.perf_counter() - t_dec)
-                result = self._predict_guarded(model, x)
+                rt.phase("decode", tw_dec, format=fmt)
+                result = self._predict_guarded(model, x, deadline)
                 if result is None:
                     return      # taxonomy response already sent
                 # success write OUTSIDE the try: a client reset mid-body
@@ -1151,28 +1313,34 @@ class ModelServer:
                 # sizes on the instances path, the breakdown keeps that
                 # visible; the tensor path exists to remove it)
                 out, infer = result
+                t_enc = time.time()
                 if binary:
                     payload = {"tensor": _encode_tensor(out)}
                 else:
                     payload = {"predictions": out.tolist()}
+                rt.phase("encode", t_enc, format=fmt)
                 self._send(200, payload,
                            (("X-Inference-Time-Ms", f"{infer:.1f}"),
                             ("X-Served-Version", str(model.version))))
 
-            def _predict_guarded(self, model, x):
+            def _predict_guarded(self, model, x, deadline=None):
                 """The ONE unary predict error taxonomy, shared by the
                 JSON and octet-stream routes so they can never
                 diverge: 400 = the caller's fault (scalar/ragged
-                input), 507 = permanent capacity (model alone exceeds
-                the budget — retry loops keyed on 500 must stop),
-                503 + Retry-After = transient mid-transition budget
-                pressure, 500 = inference failed. Returns
-                ``(out, infer_ms)``, or None after sending the error
-                response."""
+                input), 504 = the caller's propagated deadline expired
+                in the batch queue (shed, never dispatched), 507 =
+                permanent capacity (model alone exceeds the budget —
+                retry loops keyed on 500 must stop), 503 + Retry-After
+                = transient mid-transition budget pressure, 500 =
+                inference failed. Returns ``(out, infer_ms)``, or None
+                after sending the error response."""
                 try:
-                    return model.predict_raw(x)
+                    return model.predict_raw(x, rt=self._rt,
+                                             deadline=deadline)
                 except ValueError as e:
                     self._send(400, {"error": str(e)})
+                except DeadlineExceededError as e:
+                    self._send(504, {"error": str(e)})
                 except ModelTooLargeError as e:
                     self._send(507, {"error": str(e)})
                 except CapacityBusyError as e:
@@ -1182,18 +1350,17 @@ class ModelServer:
                     self._send(500, {"error": f"inference failed: {e}"})
                 return None
 
-            def _predict_binary(self, model):
+            def _predict_binary(self, model, deadline=None):
                 """Zero-copy unary predict (``application/x-tensor``):
                 request dtype/shape ride ``X-Tensor-*`` headers, the
                 body is the raw little-endian buffer, and the response
                 mirrors the format. The error taxonomy matches the
-                JSON route (400 caller / 500 server / 503+507
-                capacity) so retry loops work unchanged."""
-                t_dec = time.perf_counter()
+                JSON route (400 caller / 504 deadline / 500 server /
+                503+507 capacity) so retry loops work unchanged."""
                 try:
                     length = int(self.headers.get("Content-Length", 0))
-                    x = _decode_tensor_stream(self.headers, self.rfile,
-                                              length)
+                    x, dec_s = _decode_tensor_stream(
+                        self.headers, self.rfile, length, rt=self._rt)
                 except (ValueError, TypeError) as e:
                     # drain the unread body before answering: closing
                     # the socket with inbound bytes still pending can
@@ -1212,13 +1379,14 @@ class ModelServer:
                         left -= len(chunk)
                     return self._send(400, {"error": f"bad request: {e}"})
                 _WIRE_FORMAT_TOTAL.labels("binary").inc()
-                _DECODE_SECONDS.labels("binary").observe(
-                    time.perf_counter() - t_dec)
-                result = self._predict_guarded(model, x)
+                _DECODE_SECONDS.labels("binary").observe(dec_s)
+                result = self._predict_guarded(model, x, deadline)
                 if result is None:
                     return      # taxonomy response already sent
                 out, infer = result
+                t_enc = time.time()
                 dtype, shape, payload = _encode_tensor_bytes(out)
+                self._rt.phase("encode", t_enc, format="binary")
                 self._send(
                     200, payload,
                     (("X-Tensor-Dtype", dtype),
@@ -1278,7 +1446,8 @@ class ModelServer:
                 # canary attribution works on streams too
                 self.send_header("X-Served-Version",
                                  str(model.version))
-                sp = tracing.current_span()
+                sp = getattr(self, "_rt", None) or \
+                    tracing.current_span()
                 if sp is not None:
                     self.send_header("traceparent",
                                      tracing.format_traceparent(sp))

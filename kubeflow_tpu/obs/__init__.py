@@ -23,17 +23,20 @@ from .export import ShardExporter, resolve_dir, start_exporter
 from .metrics import (DEFAULT_BUCKETS, REGISTRY, TEXT_CONTENT_TYPE,
                       Counter, Gauge, Histogram, Registry,
                       default_registry)
-from .tracing import (TRACES, Span, TraceBuffer, current_span,
-                      derive_span_id, derive_trace_id,
-                      format_traceparent, parse_traceparent, span,
+from .slo import SLO, BurnRateEngine, default_engine, default_slos
+from .tracing import (PHASE_NAMES, TRACES, RequestTrace, Span,
+                      TraceBuffer, current_span, derive_span_id,
+                      derive_trace_id, format_traceparent,
+                      latency_summary, parse_traceparent, span,
                       workload_traceparent)
 
 __all__ = [
     "DEFAULT_BUCKETS", "REGISTRY", "TEXT_CONTENT_TYPE", "Counter",
     "Gauge", "Histogram", "Registry", "default_registry",
-    "TRACES", "Span", "TraceBuffer", "current_span",
-    "derive_span_id", "derive_trace_id",
-    "format_traceparent", "parse_traceparent", "span",
-    "workload_traceparent",
+    "PHASE_NAMES", "TRACES", "RequestTrace", "Span", "TraceBuffer",
+    "current_span", "derive_span_id", "derive_trace_id",
+    "format_traceparent", "latency_summary", "parse_traceparent",
+    "span", "workload_traceparent",
     "Aggregator", "ShardExporter", "resolve_dir", "start_exporter",
+    "SLO", "BurnRateEngine", "default_engine", "default_slos",
 ]

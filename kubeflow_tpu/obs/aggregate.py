@@ -20,6 +20,11 @@ federation layer needs:
   phantom live value. Counters from stale shards are kept: completed
   work stays counted.
 
+- **exemplars**: OpenMetrics ``# {trace_id="..."} v ts`` suffixes on
+  histogram bucket lines pass through the merge last-write-wins by
+  snapshot time, so the hub's p99 buckets still link to a trace in the
+  fleet ``/debug/traces`` view.
+
 A torn / truncated / unparseable shard (worker died mid-write, disk
 glitch) increments ``obs_shard_read_errors_total{pod}`` and is skipped
 — the hub's ``/metrics`` never 500s because one worker had a bad day.
@@ -49,9 +54,19 @@ DEFAULT_STALE_AFTER = 60.0
 
 _SAMPLE_RE = re.compile(
     r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"         # series name
-    r"(?:\{(.*)\})?"                       # optional label block
-    r"\s+(-?[0-9.eE+-]+|NaN|[+-]?Inf)$")   # value
+    r"(?:\{(.*?)\})?"                      # optional label block (lazy:
+    r"\s+(-?[0-9.eE+-]+|NaN|[+-]?Inf)"     # an exemplar has braces too)
+    r"(?:\s+#\s+(.+))?$")                  # optional OpenMetrics exemplar
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+#: OpenMetrics exemplar suffix (after the ``# ``): a label set, the
+#: exemplar value, an optional unix timestamp. The aggregator rejects
+#: anything else as torn — a malformed exemplar would corrupt the
+#: re-exposed text for every downstream scraper.
+_EXEMPLAR_RE = re.compile(
+    r'^\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*)?\}'
+    r"\s+(-?[0-9.eE+-]+|NaN|[+-]?Inf)(?:\s+-?[0-9.eE+-]+)?$")
 
 
 def _unescape(value):
@@ -77,6 +92,7 @@ class Shard:
         self.ts = ts
         self.meta = {}      # family -> (type, help)
         self.samples = []   # (series_name, labels_tuple, value)
+        self.exemplars = {}  # (series, labels_tuple) -> raw suffix str
 
 
 def parse_shard(text):
@@ -112,7 +128,7 @@ def parse_shard(text):
         mo = _SAMPLE_RE.match(line)
         if mo is None:
             raise ValueError(f"unparseable sample line {line!r}")
-        name, label_block, value = mo.groups()
+        name, label_block, value, exemplar = mo.groups()
         labels = []
         if label_block:
             matched_len = 0
@@ -124,7 +140,12 @@ def parse_shard(text):
             rest = label_block[matched_len:].strip(", ")
             if rest:
                 raise ValueError(f"unparseable labels {label_block!r}")
-        shard.samples.append((name, tuple(labels), _parse_value(value)))
+        key = (name, tuple(labels))
+        shard.samples.append((*key, _parse_value(value)))
+        if exemplar is not None:
+            if _EXEMPLAR_RE.match(exemplar) is None:
+                raise ValueError(f"unparseable exemplar {exemplar!r}")
+            shard.exemplars[key] = exemplar
     return shard
 
 
@@ -206,6 +227,7 @@ class Aggregator:
         self._pod_epoch = {}            # pod -> epoch last seen
         self._mono = {}                 # (series, labels) -> {pod: {base,last}}
         self._meta = {}                 # family -> (type, help)
+        self._exemplars = {}            # (series, labels) -> (ts, raw)
 
     # ---------------------------------------------------------- update
 
@@ -251,6 +273,12 @@ class Aggregator:
                     key = (series, labels)
                     if key not in gauges or shard.ts > gauges[key][0]:
                         gauges[key] = (shard.ts, value)
+            for key, raw in shard.exemplars.items():
+                # pass-through, last-write-wins by snapshot time: the
+                # freshest pod's exemplar represents the merged bucket
+                prev = self._exemplars.get(key)
+                if prev is None or shard.ts >= prev[0]:
+                    self._exemplars[key] = (shard.ts, raw)
         return self._exposition(gauges)
 
     # ------------------------------------------------------ exposition
@@ -262,6 +290,13 @@ class Aggregator:
                 s["base"] + s["last"] for s in per_pod.values())
         return out
 
+    def merged_samples(self):
+        """The merged monotone series (counters + every histogram
+        bucket/sum/count) as a flat ``{(series, labels): value}`` dict
+        — the SLO burn-rate engine's source (obs/slo.py reads counter
+        deltas; gauges are point-in-time and excluded)."""
+        return self._merged_mono()
+
     @staticmethod
     def _le_key(labels):
         for name, value in labels:
@@ -271,6 +306,7 @@ class Aggregator:
         return math.inf
 
     def _exposition(self, gauges):
+        emit_ex = obs_metrics.exemplars_enabled()
         mono = self._merged_mono()
         by_family = {}
         for (series, labels), value in mono.items():
@@ -300,8 +336,11 @@ class Aggregator:
                     [obs_metrics._fmt_labels(
                         [k for k, _ in labels],
                         [v for _, v in labels])]) if labels else ""
+                ex = (self._exemplars.get((series, labels))
+                      if emit_ex else None)
                 lines.append(f"{series}{label_block} "
-                             f"{obs_metrics._fmt_value(value)}")
+                             f"{obs_metrics._fmt_value(value)}"
+                             f"{' # ' + ex[1] if ex else ''}")
         return "\n".join(lines) + "\n"
 
 

@@ -9,7 +9,9 @@ shared workspace:
 
 - ``$WORKSPACE/obs/shards/<pod>.prom`` — the process registry in
   Prometheus text format 0.0.4 (byte-identical to what the process's
-  own ``/metrics`` would serve), preceded by one magic comment line
+  own ``/metrics`` would serve — OpenMetrics exemplar suffixes on
+  histogram buckets ride along and survive the hub merge), preceded
+  by one magic comment line
   carrying the pod name, the process epoch (restart detection) and the
   snapshot time (gauge staleness eviction):
 

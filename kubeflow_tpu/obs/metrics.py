@@ -16,10 +16,20 @@ controller-runtime's ``*_seconds`` families looks like.
 Metric names are validated at registration (``^[a-z_][a-z0-9_]*$``,
 non-empty help) so the CI lint (ci/metrics_lint.py) can never find a
 family that was registered but unscrapeable.
+
+Histogram observations may carry an OpenMetrics **exemplar**
+(``observe(value, trace_id=...)``): the bucket line the value lands in
+gains a ``# {trace_id="..."} <value> <ts>`` suffix, so a p99 bucket on
+a latency chart links straight to its trace in ``/debug/traces``. The
+serving/web middleware only attaches trace ids of KEPT traces (see
+obs/tracing.py sampling), and ci/metrics_lint.py validates the suffix
+syntax so the exposition stays parseable.
 """
 
+import os
 import re
 import threading
+import time
 
 _NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
 _LABEL_RE = re.compile(r"^[a-z_][a-zA-Z0-9_]*$")
@@ -30,6 +40,30 @@ DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
 
 #: exposition Content-Type (Prometheus text format 0.0.4)
 TEXT_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def env_float(name, default):
+    """Float env knob with a safe fallback — shared by the obs layer's
+    runtime-tunable settings (tracing sample rates, SLO windows,
+    exemplar gating)."""
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def exemplars_enabled():
+    """``OBS_EXEMPLARS`` (default on): emit OpenMetrics exemplar
+    suffixes on histogram bucket lines. Exemplars use OpenMetrics
+    syntax while the exposition Content-Type stays text 0.0.4 — this
+    platform's own scrapers (the metrics hub, ci/metrics_lint.py,
+    obs/aggregate.py) all parse them, but a STRICT external Prometheus
+    pointed directly at a pod's ``/metrics`` would reject the page;
+    such deployments set ``OBS_EXEMPLARS=0`` (read per exposition, so
+    it can be flipped live). The trace ids are still collected either
+    way — only the text suffix is gated."""
+    return os.environ.get("OBS_EXEMPLARS", "1").lower() not in (
+        "0", "false", "no", "off")
 
 
 def _escape_label_value(value):
@@ -127,12 +161,19 @@ class Gauge(_Metric):
         self.labels().set(value)
 
 
+def _fmt_exemplar(ex):
+    """OpenMetrics exemplar suffix: ``# {labels} value timestamp``."""
+    trace_id, value, ts = ex
+    return (f' # {{trace_id="{_escape_label_value(trace_id)}"}} '
+            f"{_fmt_value(value)} {_fmt_value(round(ts, 3))}")
+
+
 class _HistogramChild:
     def __init__(self, metric, key):
         self._m = metric
         self._key = key
 
-    def observe(self, value):
+    def observe(self, value, trace_id=None):
         value = float(value)
         m = self._m
         with m._lock:
@@ -141,11 +182,21 @@ class _HistogramChild:
                 state = m._values[self._key] = \
                     {"buckets": [0] * len(m.buckets), "sum": 0.0,
                      "count": 0}
+            first = None
             for i, le in enumerate(m.buckets):
                 if value <= le:
+                    if first is None:
+                        first = i
                     state["buckets"][i] += 1
             state["sum"] += value
             state["count"] += 1
+            if trace_id:
+                # latest exemplar per bucket the value belongs to
+                # (+Inf = index len(buckets)); exposition appends it
+                # to that bucket's line
+                state.setdefault("exemplars", {})[
+                    len(m.buckets) if first is None else first] = (
+                    str(trace_id), value, time.time())
 
 
 class Histogram(_Metric):
@@ -167,8 +218,8 @@ class Histogram(_Metric):
             raise ValueError(f"{name}: histogram needs >= 1 bucket")
         self.buckets = bounds
 
-    def observe(self, value):
-        self.labels().observe(value)
+    def observe(self, value, trace_id=None):
+        self.labels().observe(value, trace_id=trace_id)
 
     def samples(self):
         # deep-copy per-key state: observe() mutates the inner dicts in
@@ -176,7 +227,9 @@ class Histogram(_Metric):
         # torn (non-cumulative) histogram
         with self._lock:
             return {k: {"buckets": list(v["buckets"]), "sum": v["sum"],
-                        "count": v["count"]}
+                        "count": v["count"],
+                        **({"exemplars": dict(v["exemplars"])}
+                           if "exemplars" in v else {})}
                     for k, v in self._values.items()}
 
     def value(self, *values):
@@ -191,16 +244,22 @@ class Histogram(_Metric):
             # (empty) buckets, like prometheus/client_python
             samples = {(): {"buckets": [0] * len(self.buckets),
                             "sum": 0.0, "count": 0}}
+        emit_ex = exemplars_enabled()
         for key, state in sorted(samples.items()):
-            for le, n in zip(self.buckets, state["buckets"]):
+            exemplars = (state.get("exemplars") or {}) if emit_ex \
+                else {}
+            for i, (le, n) in enumerate(zip(self.buckets,
+                                            state["buckets"])):
+                ex = exemplars.get(i)
                 lines.append(
                     f"{self.name}_bucket"
                     f"{_fmt_labels(self.label_names, key, [('le', f'{le:g}')])}"
-                    f" {n}")
+                    f" {n}{_fmt_exemplar(ex) if ex else ''}")
+            ex = exemplars.get(len(self.buckets))
             lines.append(
                 f"{self.name}_bucket"
                 f"{_fmt_labels(self.label_names, key, [('le', '+Inf')])}"
-                f" {state['count']}")
+                f" {state['count']}{_fmt_exemplar(ex) if ex else ''}")
             labels = _fmt_labels(self.label_names, key)
             lines.append(f"{self.name}_sum{labels} "
                          f"{_fmt_value(state['sum'])}")

@@ -20,9 +20,18 @@ contextvar and a ring buffer.
   ``chrome_trace()`` emits Chrome trace-event format, openable in
   Perfetto — complementing compute/profiler.py's XLA traces (device
   timeline there, platform timeline here).
+- ``RequestTrace``: per-request latency anatomy with head sampling and
+  an always-keep-slow tail policy. Phases (``http.read``, ``decode``,
+  ``batch.queue_wait``, ``batch.dispatch``, ``device``, ``encode``,
+  ``http.write``) are recorded as plain tuples — a sampled-out request
+  allocates NO ``Span`` objects — and only materialize into the ring
+  when the request is head-sampled in (``OBS_TRACE_SAMPLE``), turned
+  out slow (``OBS_TRACE_SLOW_MS``), or errored. ``latency_summary``
+  decomposes p50/p95/p99 per phase for ``/debug/latency``.
 
-Spans are cheap (one dict append on exit) and always-on; sampling can
-be layered later by swapping the buffer.
+Spans opened via ``span()`` are cheap (one dict append on exit) and
+always-on; the high-QPS serving path goes through ``RequestTrace``
+instead, where sampling keeps the hot path allocation-free.
 """
 
 import contextvars
@@ -33,6 +42,8 @@ import threading
 import time
 from collections import deque
 from contextlib import contextmanager
+
+from . import metrics as obs_metrics
 
 _CURRENT = contextvars.ContextVar("kubeflow_tpu_obs_span", default=None)
 
@@ -95,12 +106,15 @@ class Span:
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "start",
                  "end", "attrs", "status", "thread")
 
-    def __init__(self, name, trace_id, parent_id, attrs):
+    def __init__(self, name, trace_id, parent_id, attrs, start=None,
+                 span_id=None):
         self.name = name
         self.trace_id = trace_id
-        self.span_id = os.urandom(8).hex()
+        # explicit ids/times let RequestTrace materialize a span
+        # post-hoc (the keep decision needs the full duration first)
+        self.span_id = span_id or os.urandom(8).hex()
         self.parent_id = parent_id
-        self.start = time.time()
+        self.start = time.time() if start is None else start
         self.end = None
         self.attrs = attrs
         self.status = "ok"
@@ -146,6 +160,11 @@ class TraceBuffer:
         if trace_id is not None:
             snapshot = [s for s in snapshot if s.trace_id == trace_id]
         return snapshot
+
+    def span_dicts(self, trace_id=None):
+        """Completed spans as dicts — the shape ``latency_summary``
+        and the fleet merge operate on."""
+        return [s.to_dict() for s in self.spans(trace_id)]
 
     def traces(self, trace_id=None, limit=50):
         """Group completed spans by trace id, most recently finished
@@ -219,3 +238,231 @@ def span(name, traceparent=None, buffer=None, **attrs):
         s.end = time.time()
         _CURRENT.reset(token)
         (TRACES if buffer is None else buffer).add(s)
+
+
+# ------------------------------------------------- request anatomy
+
+#: the latency-anatomy phase vocabulary (web/http.py +
+#: compute/serving.py emit exactly these; /debug/latency groups by
+#: them). Order is the unary predict pipeline order.
+PHASE_NAMES = ("http.read", "decode", "batch.queue_wait",
+               "batch.dispatch", "device", "encode", "http.write")
+
+
+def trace_sample_rate():
+    """``OBS_TRACE_SAMPLE``: fraction of request traces head-sampled
+    into the span ring (default 1.0 = everything; 0 = only the slow
+    tail). Read per request so operators can flip it live."""
+    return obs_metrics.env_float("OBS_TRACE_SAMPLE", 1.0)
+
+
+def slow_keep_ms():
+    """``OBS_TRACE_SLOW_MS``: requests at least this slow are kept
+    even when head sampling dropped them (the always-keep-slow tail —
+    the p99 outliers are exactly the traces worth reading). Negative
+    disables the tail policy."""
+    return obs_metrics.env_float("OBS_TRACE_SLOW_MS", 250.0)
+
+
+def head_sampled(trace_id, rate):
+    """Deterministic head-sampling decision from the trace id: every
+    hop of one trace (client, web tier, model server) computes the
+    same verdict, so a kept trace is complete rather than a random
+    subset of its spans."""
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    try:
+        return int(trace_id[-8:], 16) < rate * 0x100000000
+    except ValueError:
+        return True
+
+
+class RequestTrace:
+    """One request's latency anatomy + keep policy.
+
+    NOT a context manager on the thread contextvar: phases may be
+    recorded from other threads (the serving batcher records
+    ``batch.queue_wait``/``batch.dispatch``/``device`` from its loop
+    thread while the HTTP thread owns the request). Phases are plain
+    tuples; ``Span`` objects exist only if ``finish()`` decides to
+    keep the request — head-sampled in, slower than the tail
+    threshold, or errored. A sampled-out fast request therefore costs
+    one small object and a few tuple appends, never ring space.
+    """
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start",
+                 "attrs", "status", "sampled", "slow_s", "kept",
+                 "_phases")
+
+    def __init__(self, name, traceparent=None, sample_rate=None,
+                 slow_ms=None, **attrs):
+        remote = parse_traceparent(traceparent)
+        if remote is not None:
+            self.trace_id, self.parent_id = remote
+        else:
+            self.trace_id, self.parent_id = os.urandom(16).hex(), None
+        self.span_id = os.urandom(8).hex()
+        self.name = name
+        self.start = time.time()
+        self.attrs = dict(attrs)
+        self.status = "ok"
+        rate = trace_sample_rate() if sample_rate is None else sample_rate
+        self.sampled = head_sampled(self.trace_id, rate)
+        self.slow_s = (slow_keep_ms() if slow_ms is None
+                       else slow_ms) / 1000.0
+        self.kept = None          # decided by finish()
+        self._phases = []         # (name, start, end, attrs|None)
+
+    def phase(self, name, start, end=None, **attrs):
+        """Record one phase interval (wall-clock seconds). Appends are
+        GIL-atomic, so the batcher thread and the HTTP thread may both
+        record without a lock."""
+        self._phases.append((name, start,
+                             time.time() if end is None else end,
+                             attrs or None))
+
+    def keep(self, duration_ms):
+        return (self.sampled or self.status == "error"
+                or (self.slow_s >= 0
+                    and duration_ms >= self.slow_s * 1000.0))
+
+    def exemplar(self, duration_s):
+        """Trace id to attach as an OpenMetrics exemplar to a
+        histogram observation of ``duration_s`` — only when this
+        request will be visible in ``/debug/traces`` (an exemplar
+        pointing at a dropped trace is a dead link)."""
+        return self.trace_id if self.keep(duration_s * 1000.0) else None
+
+    def _emit_phases(self, buffer=None):
+        buf = TRACES if buffer is None else buffer
+        for name, s, e, attrs in self._phases:
+            ps = Span(name, self.trace_id, self.span_id,
+                      dict(attrs) if attrs else {}, start=s)
+            ps.end = e
+            buf.add(ps)
+
+    def finish(self, end=None, buffer=None):
+        """Close the request: decide keep (head sample OR slow tail OR
+        error) and, if kept, materialize the phase spans plus the root
+        span into the ring. Returns whether the trace was kept."""
+        end = time.time() if end is None else end
+        self.kept = self.keep((end - self.start) * 1000.0)
+        if self.kept:
+            self._emit_phases(buffer)
+            root = Span(self.name, self.trace_id, self.parent_id,
+                        self.attrs, start=self.start,
+                        span_id=self.span_id)
+            root.status = self.status
+            root.end = end
+            (TRACES if buffer is None else buffer).add(root)
+        return self.kept
+
+    def late_phase(self, name, start, end=None, buffer=None, **attrs):
+        """Record a phase that happens after ``finish()`` — the
+        ``http.write`` leg runs after the middleware closed the root.
+        Materialized directly (same keep verdict as the root)."""
+        if not self.kept:
+            return
+        ps = Span(name, self.trace_id, self.span_id,
+                  dict(attrs), start=start)
+        ps.end = time.time() if end is None else end
+        (TRACES if buffer is None else buffer).add(ps)
+
+    @contextmanager
+    def active(self, buffer=None):
+        """The web-middleware shape. Head-sampled IN: a real root span
+        rides the contextvar so nested ``span()`` children (reconciles,
+        dispatches) link exactly as before sampling existed. Sampled
+        OUT: nothing is allocated; on exit ``finish()`` still keeps the
+        request if it turned out slow or errored (the root is
+        materialized post-hoc; contextvar children opened meanwhile
+        started their own traces — the documented cost of dropping the
+        head sample)."""
+        if self.sampled:
+            s = Span(self.name, self.trace_id, self.parent_id,
+                     self.attrs, start=self.start, span_id=self.span_id)
+            token = _CURRENT.set(s)
+            try:
+                yield s
+            except BaseException as e:
+                self.status = s.status = "error"
+                s.attrs.setdefault("error", f"{type(e).__name__}: {e}")
+                raise
+            finally:
+                s.end = time.time()
+                _CURRENT.reset(token)
+                s.status = self.status if s.status == "ok" else s.status
+                self._emit_phases(buffer)
+                (TRACES if buffer is None else buffer).add(s)
+                self.kept = True
+        else:
+            try:
+                yield None
+            except BaseException as e:
+                self.status = "error"
+                self.attrs.setdefault("error",
+                                      f"{type(e).__name__}: {e}")
+                raise
+            finally:
+                self.finish(buffer=buffer)
+
+
+# ----------------------------------------------- latency decomposition
+
+def _pctl(sorted_values, q):
+    return sorted_values[min(len(sorted_values) - 1,
+                             int(q * len(sorted_values)))]
+
+
+def _stats(durations):
+    durations = sorted(durations)
+    return {"count": len(durations),
+            "mean_ms": round(sum(durations) / len(durations), 3),
+            "p50_ms": round(_pctl(durations, 0.50), 3),
+            "p95_ms": round(_pctl(durations, 0.95), 3),
+            "p99_ms": round(_pctl(durations, 0.99), 3)}
+
+
+def latency_summary(span_dicts, path=None, phases=PHASE_NAMES):
+    """Decompose request latency per phase from completed span dicts
+    (``TraceBuffer.span_dicts()`` locally, the merged fleet spans on
+    the metrics hub) — the ``/debug/latency`` payload.
+
+    ``path``: restrict to traces whose root (``http ...``) span name
+    contains the substring (e.g. ``:predict`` to exclude web-tier
+    traffic). Phases with a ``format`` attr additionally aggregate
+    under ``<phase>{format="..."}`` keys so decode cost splits by wire
+    format. ``phase_p50_sum_ms``/``phase_mean_sum_ms`` sum the base
+    phases only — the number to hold against the request p50 (the gap
+    between them is unattributed framework overhead)."""
+    if path is not None:
+        keep = {s.get("trace_id") for s in span_dicts
+                if (s.get("name") or "").startswith("http ")
+                and path in s["name"]}
+        span_dicts = [s for s in span_dicts
+                      if s.get("trace_id") in keep]
+    groups = {}
+    requests = []
+    for s in span_dicts:
+        name = s.get("name") or ""
+        dur = s.get("duration_ms")
+        if dur is None:
+            continue
+        if name in phases:
+            groups.setdefault(name, []).append(dur)
+            fmt = (s.get("attrs") or {}).get("format")
+            if fmt:
+                groups.setdefault(
+                    f'{name}{{format="{fmt}"}}', []).append(dur)
+        elif name.startswith("http "):
+            requests.append(dur)
+    out = {"phases": {n: _stats(d) for n, d in sorted(groups.items())},
+           "requests": _stats(requests) if requests else {"count": 0}}
+    base = [n for n in phases if n in groups]
+    out["phase_p50_sum_ms"] = round(
+        sum(out["phases"][n]["p50_ms"] for n in base), 3)
+    out["phase_mean_sum_ms"] = round(
+        sum(out["phases"][n]["mean_ms"] for n in base), 3)
+    return out

@@ -8,6 +8,7 @@ the mesh exactly like the reference fronts gunicorn.
 """
 
 import json
+import os
 import re
 import threading
 import time
@@ -29,6 +30,13 @@ _HTTP_LATENCY = obs_metrics.REGISTRY.histogram(
     "http_request_duration_seconds",
     "HTTP request latency through App.handle (middleware included)",
     ("app", "method", "code"))
+
+
+def _access_log_enabled():
+    """``ACCESS_LOG`` env knob (read per request so it can be flipped
+    live); off by default — and therefore off in tests."""
+    return os.environ.get("ACCESS_LOG", "").lower() in (
+        "1", "true", "yes", "on")
 
 
 class HTTPError(Exception):
@@ -134,10 +142,20 @@ class App:
                 raise HTTPError(400, "limit must be an integer")
             return {"traces": self.traces.traces(trace_id, limit=limit)}
 
+        def latency_route(request):
+            # per-phase p50/p95/p99 from this App's span source (the
+            # process ring, or the merged fleet spans on the hub —
+            # whatever duck-typed buffer self.traces is)
+            return tracing.latency_summary(
+                self.traces.span_dicts(),
+                path=request.query.get("path"))
+
         metrics_route._obs_internal = True
         traces_route._obs_internal = True
+        latency_route._obs_internal = True
         self.get("/metrics")(metrics_route)
         self.get("/debug/traces")(traces_route)
+        self.get("/debug/latency")(latency_route)
 
     def route(self, method, pattern):
         compiled = _compile(pattern)
@@ -200,38 +218,66 @@ class App:
     # ------------------------------------------------------- dispatch
 
     def handle(self, request):
-        """Middleware shell around dispatch: opens the server span
+        """Middleware shell around dispatch: opens the request trace
         (continuing the caller's W3C ``traceparent`` if one arrived),
         times the request into the HTTP histogram family, and injects
         ``traceparent`` into the response so downstream hops / clients
-        can stitch the trace."""
-        if request.path.rstrip("/") in ("/metrics", "/debug/traces"):
+        can stitch the trace.
+
+        Tracing is head-sampled (``OBS_TRACE_SAMPLE``) with an
+        always-keep-slow tail (``OBS_TRACE_SLOW_MS``): a sampled-in
+        request rides the contextvar exactly as before (nested spans
+        link); a sampled-out request allocates no span objects unless
+        it turns out slow or errored, in which case the root is
+        materialized post-hoc."""
+        if request.path.rstrip("/") in ("/metrics", "/debug/traces",
+                                        "/debug/latency",
+                                        "/api/alerts"):
             # self-inspection traffic is neither traced nor counted: a
-            # 15s scrape interval would otherwise fill the span ring
-            # with scrape spans and evict the application traces the
-            # endpoint exists to show
+            # 15s scrape (or alert-poll) interval would otherwise fill
+            # the span ring with scrape spans and evict the
+            # application traces the endpoint exists to show
             response = self._dispatch(request)
             for hook in self._after:
                 response = hook(request, response) or response
             return response
         start = time.perf_counter()
-        with tracing.span(
-                f"http {request.method} {request.path}",
-                traceparent=request.header("traceparent"),
-                app=self.name, method=request.method,
-                path=request.path) as sp:
+        rt = tracing.RequestTrace(
+            f"http {request.method} {request.path}",
+            traceparent=request.header("traceparent"),
+            app=self.name, method=request.method, path=request.path)
+        read_phase = request.context.get("http.read")
+        if read_phase:
+            # the socket read happened before the middleware ran
+            # (serve()'s handler timed it): widen the request window
+            # to cover it so the phases sum to the true wall time
+            rt.start = read_phase[0]
+            rt.phase("http.read", *read_phase)
+        request.trace = rt
+        with rt.active():
             response = self._dispatch(request)
             for hook in self._after:
                 response = hook(request, response) or response
-            sp.attrs["code"] = response.status
+            rt.attrs["code"] = response.status
             if response.status >= 500:
-                sp.status = "error"
+                rt.status = "error"
             response.headers.setdefault(
-                "traceparent", tracing.format_traceparent(sp))
+                "traceparent", tracing.format_traceparent(rt))
+        response.trace = rt    # serve() adds the http.write phase
+        elapsed = time.perf_counter() - start
         code = str(response.status)
         _HTTP_REQUESTS.labels(self.name, request.method, code).inc()
         _HTTP_LATENCY.labels(self.name, request.method, code).observe(
-            time.perf_counter() - start)
+            elapsed, trace_id=rt.exemplar(elapsed))
+        if _access_log_enabled():
+            # one greppable line per request on stdout (pod logs):
+            # the trace id is the join key into /debug/traces
+            print(json.dumps({
+                "ts": round(time.time(), 3), "app": self.name,
+                "method": request.method, "path": request.path,
+                "status": response.status,
+                "duration_ms": round(elapsed * 1000, 3),
+                "trace_id": rt.trace_id}), flush=True)
         return response
 
     def _dispatch(self, request):
@@ -291,11 +337,17 @@ class App:
             def _run(self):
                 split = urlsplit(self.path)
                 length = int(self.headers.get("Content-Length") or 0)
+                t_read = time.time()
                 body = self.rfile.read(length) if length else b""
+                read_end = time.time()
                 query = {k: v[-1]
                          for k, v in parse_qs(split.query).items()}
                 request = Request(self.command, split.path,
                                   dict(self.headers), body, query)
+                if length:
+                    # anatomy: the middleware attaches this as the
+                    # http.read phase and widens the request window
+                    request.context["http.read"] = (t_read, read_end)
                 response = app.handle(request)
                 self.send_response(response.status)
                 for k, v in response.headers.items():
@@ -303,7 +355,14 @@ class App:
                 self.send_header("Content-Length",
                                  str(len(response.body)))
                 self.end_headers()
+                t_write = time.time()
                 self.wfile.write(response.body)
+                rt = getattr(response, "trace", None)
+                if rt is not None:
+                    # the write happens after the middleware closed
+                    # the root span; late_phase applies the same keep
+                    # verdict the root got
+                    rt.late_phase("http.write", t_write)
 
             do_GET = do_POST = do_PATCH = do_DELETE = do_PUT = _run
 

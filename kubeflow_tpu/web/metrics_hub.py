@@ -15,19 +15,28 @@ staleness eviction) and exposes:
   merged with the hub's own ring; ``format=chrome`` renders one
   Perfetto timeline with one process row per pod, so a gang's
   admit→schedule→compile→step story reads end to end.
+- ``GET /debug/latency`` — fleet-wide request latency anatomy: per-
+  phase p50/p95/p99 decomposed from the merged spans
+  (``?path=:predict`` restricts to serving traffic).
+- ``GET /api/alerts``    — the SLO burn-rate engine's verdicts
+  (obs/slo.py): every registered SLO with its fast/slow-window burn
+  rates, AND-gated ``ok``/``burning`` state and remaining error
+  budget. Evaluated fresh against the shards as of the call.
 - ``GET /api/fleet``     — shard inventory (pod, snapshot age, epoch)
   for dashboards and debugging dead exporters.
 - ``GET /``              — a minimal HTML index linking the above.
 
 One knob: the shard directory (``OBS_EXPORT_DIR`` /
 ``$WORKSPACE/obs/shards`` — same resolution the exporters use, so
-pointing hub and workers at one PVC path is zero-config).
+pointing hub and workers at one PVC path is zero-config). The SLO
+engine honors ``SLO_WINDOW_FAST`` / ``SLO_WINDOW_SLOW`` /
+``SLO_BURN_THRESHOLD`` (obs/slo.py defaults: 300 s / 3600 s / 14.4).
 """
 
 import os
 import time
 
-from ..obs import aggregate, export, tracing
+from ..obs import aggregate, export, slo, tracing
 from ..obs import metrics as obs_metrics
 from .http import App, Response
 
@@ -40,10 +49,13 @@ _INDEX_HTML = """<!doctype html>
 <li><a href="debug/traces?format=chrome">/debug/traces?format=chrome</a>
  — Chrome trace (open in <a href="https://ui.perfetto.dev">Perfetto</a>)
 </li>
+<li><a href="debug/latency">/debug/latency</a> — fleet latency anatomy
+ (per-phase p50/p95/p99)</li>
+<li><a href="api/alerts">/api/alerts</a> — SLO burn-rate verdicts</li>
 <li><a href="api/fleet">/api/fleet</a> — shard inventory</li>
 </ul>
 <p>Shard dir: <code>{shard_dir}</code> — see docs/observability.md
-"Fleet metrics".</p>
+"Fleet metrics" and "SLOs &amp; alerts".</p>
 """
 
 
@@ -55,7 +67,7 @@ class FleetRegistry:
     obs_shard_read_errors_total, ...) appear exactly once."""
 
     def __init__(self, shard_dir, pod, registry=None,
-                 stale_after=None):
+                 stale_after=None, engine=None):
         self.shard_dir = shard_dir
         self.pod = pod
         self.registry = registry or obs_metrics.REGISTRY
@@ -63,6 +75,11 @@ class FleetRegistry:
             stale_after = float(os.environ.get(
                 "OBS_STALE_AFTER", aggregate.DEFAULT_STALE_AFTER))
         self.aggregator = aggregate.Aggregator(stale_after=stale_after)
+        #: SLO burn-rate engine fed the merged fleet counters on every
+        #: scrape; its slo_* gauges live in the hub's own registry and
+        #: ride the local shard into the NEXT merge (one-scrape lag —
+        #: /api/alerts evaluates fresh)
+        self.engine = engine
         #: shard files untouched this long are deleted AFTER their
         #: counters are folded into the aggregator (0 = keep forever)
         self.retention = float(os.environ.get("OBS_SHARD_RETENTION",
@@ -77,6 +94,8 @@ class FleetRegistry:
         shards.append(aggregate.local_shard(self.pod, self.epoch,
                                             self.registry))
         text = self.aggregator.update(shards)
+        if self.engine is not None:
+            self.engine.observe(self.aggregator.merged_samples())
         if self.retention > 0 and self.shard_dir:
             aggregate.prune_shards(self.shard_dir, self.retention)
         return text
@@ -102,6 +121,11 @@ class FleetTraces:
     def chrome_trace(self, trace_id=None):
         return aggregate.chrome_trace(self._merged(), trace_id)
 
+    def span_dicts(self, trace_id=None):
+        # latency_summary source (web/http.py latency_route)
+        return [dict(span, pod=pod) for pod, span in self._merged()
+                if trace_id is None or span.get("trace_id") == trace_id]
+
 
 def create_app(store=None, shard_dir=None):
     """``store`` is accepted (and ignored) for cmd/_web symmetry with
@@ -113,11 +137,23 @@ def create_app(store=None, shard_dir=None):
     # the synthetic local shard and win last-write-wins on every scrape
     export.PROCESS_START.set(export.process_start_time() or time.time())
     app = App("metrics-hub")
-    # the built-in /metrics + /debug/traces routes read these two
-    # attributes — swapping them in IS the fleet wiring
-    app.registry = FleetRegistry(shard_dir, pod)
+    # the built-in /metrics + /debug/traces + /debug/latency routes
+    # read these attributes — swapping them in IS the fleet wiring.
+    # The SLO engine ships the default objectives (serving latency /
+    # serving errors / scheduler queue-wait) and is fed the merged
+    # fleet counters on every scrape.
+    engine = slo.default_engine()
+    app.slo_engine = engine
+    app.registry = FleetRegistry(shard_dir, pod, engine=engine)
     app.traces = FleetTraces(shard_dir, pod)
     app.shard_dir = shard_dir
+
+    @app.get("/api/alerts")
+    def alerts(request):
+        # evaluate FRESH: re-merge the shard directory so the verdict
+        # reflects the fleet as of this call, not the last scrape
+        app.registry.exposition()
+        return engine.status()
 
     @app.get("/")
     def index(request):

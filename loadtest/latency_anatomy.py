@@ -1,0 +1,286 @@
+#!/usr/bin/env python3
+"""Latency anatomy + SLO burn-rate acceptance driver (ISSUE 8).
+
+One REAL serving pod (subprocess: ModelServer over HTTP + shard
+exporter) and the metrics hub in this process reading its shards —
+the fleet path, end to end. Three legs, matching the acceptance
+criteria:
+
+(a) **anatomy** — a sequential probe measures raw client p50, then the
+    fleet ``/debug/latency`` decomposition must explain it: per-phase
+    p50 sum within 10% of the measured p50, with ``decode`` +
+    ``http.*`` visibly separated from ``device``.
+(b) **SLO flip** — an injected error burst (magic input → 500s) flips
+    ``serving-predict-errors`` on ``/api/alerts`` from ``ok`` to
+    ``burning``; clean traffic flips it back once the fast window
+    drains (multi-window AND-gating, with ``SLO_WINDOW_FAST/SLOW``
+    shrunk so the story fits in seconds).
+(c) **exemplar** — the trace id riding the highest populated
+    ``serving_request_duration_seconds`` bucket as an OpenMetrics
+    exemplar (seeded by 4× slow outlier requests) resolves on the hub
+    ``/debug/traces`` to a full per-phase trace.
+
+The fake device is honestly ASYNC: dispatch launches a sleeper thread
+and returns immediately, finalize blocks — so device time lands in the
+``device`` phase the way a real accelerator launch does (a jitted
+sleep would run at trace time only; a blocking host callback would
+bill the launch).
+
+    python loadtest/latency_anatomy.py
+    python loadtest/latency_anatomy.py --device-ms 120 --probe 20
+"""
+
+import argparse
+import http.client
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+POISON = 666.0      # magic first feature → RuntimeError → 500
+SLOW = 777.0        # magic first feature → 4x device time (p99 seed)
+
+_EXEMPLAR_LINE = re.compile(
+    r'^serving_request_duration_seconds_bucket\{[^}]*le="([^"]+)"\}'
+    r'\s+\S+\s+#\s+\{trace_id="([0-9a-f]{32})"\}')
+
+
+def build_argparser():
+    ap = argparse.ArgumentParser(prog="latency_anatomy")
+    ap.add_argument("--device-ms", type=float, default=80.0,
+                    help="fake device time per dispatch")
+    ap.add_argument("--probe", type=int, default=14,
+                    help="sequential probe requests for the raw p50")
+    ap.add_argument("--in-dim", type=int, default=8)
+    ap.add_argument("--model", default="anatomy")
+    ap.add_argument("--fast-window", type=float, default=2.0)
+    ap.add_argument("--slow-window", type=float, default=10.0)
+    ap.add_argument("--worker", action="store_true",
+                    help=argparse.SUPPRESS)   # internal: the pod role
+    return ap
+
+
+# --------------------------------------------------------- worker (pod)
+
+def worker_main(args):
+    """The serving pod: ModelServer over real HTTP + shard exporter.
+    Speaks a one-word stdin protocol (FLUSH → snapshot now) and exits
+    on EOF with a final flush."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from kubeflow_tpu.compute import serving
+    from kubeflow_tpu.obs import export, tracing
+
+    class FakeDeviceModel(serving.ServedModel):
+        device_s = args.device_ms / 1000.0
+
+        def dispatch(self, x):
+            self.last_used = time.monotonic()
+            self.device_calls += 1
+            x = np.asarray(x)
+            if float(x[0, 0]) == POISON:
+                raise RuntimeError("injected error burst")
+            delay = self.device_s * (
+                4.0 if float(x[0, 0]) == SLOW else 1.0)
+            done = threading.Event()
+            box = {}
+
+            def run():
+                time.sleep(delay)
+                box["y"] = x * 2.0
+                done.set()
+
+            threading.Thread(target=run, daemon=True).start()
+            return (done, box), x.shape[0]
+
+        @staticmethod
+        def finalize(fut, n):
+            done, box = fut
+            done.wait()
+            return box["y"][:n]
+
+    server = serving.ModelServer()
+    server._models[args.model] = FakeDeviceModel(args.model,
+                                                 lambda x: x)
+    port = server.start(port=0, host="127.0.0.1")
+    exporter = export.ShardExporter(export.resolve_dir(),
+                                    traces=tracing.TRACES,
+                                    interval=0.4).start()
+    print(f"PORT {port}", flush=True)
+    for line in sys.stdin:
+        if line.strip() == "FLUSH":
+            exporter.write_once()
+            print("FLUSHED", flush=True)
+    exporter.stop()        # final flush
+    server.stop()
+    return 0
+
+
+# ------------------------------------------------------- parent (driver)
+
+class Pod:
+    def __init__(self, args, shard_dir):
+        env = dict(os.environ, OBS_EXPORT_DIR=shard_dir,
+                   POD_NAME="serving-pod-0", JAX_PLATFORMS="cpu")
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             "--model", args.model,
+             "--device-ms", str(args.device_ms)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            env=env, text=True)
+        for line in self.proc.stdout:
+            if line.startswith("PORT "):
+                self.port = int(line.split()[1])
+                break
+        else:
+            raise SystemExit("worker died before serving")
+
+    def flush(self):
+        self.proc.stdin.write("FLUSH\n")
+        self.proc.stdin.flush()
+        for line in self.proc.stdout:
+            if line.strip() == "FLUSHED":
+                return
+        raise SystemExit("worker died mid-flush")
+
+    def stop(self):
+        self.proc.stdin.close()
+        self.proc.wait(timeout=10)
+
+
+def main(argv=None):
+    args = build_argparser().parse_args(argv)
+    if args.worker:
+        return worker_main(args)
+
+    # knobs must be set before the hub app builds its SLO engine
+    os.environ["SLO_WINDOW_FAST"] = str(args.fast_window)
+    os.environ["SLO_WINDOW_SLOW"] = str(args.slow_window)
+    shard_dir = os.path.join(
+        tempfile.mkdtemp(prefix="latency-anatomy-"), "shards")
+    pod = Pod(args, shard_dir)
+
+    from kubeflow_tpu.web import http as webhttp
+    from kubeflow_tpu.web import metrics_hub
+    hub = webhttp.TestClient(metrics_hub.create_app(
+        shard_dir=shard_dir))
+
+    conn = http.client.HTTPConnection("127.0.0.1", pod.port,
+                                      timeout=60)
+    path = f"/v1/models/{args.model}:predict"
+
+    def predict(first=1.0, expect=200):
+        row = [first] + [0.0] * (args.in_dim - 1)
+        body = json.dumps({"instances": [row]}).encode()
+        t0 = time.perf_counter()
+        conn.request("POST", path, body,
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        r.read()
+        if r.status != expect:
+            raise SystemExit(
+                f"predict: HTTP {r.status}, wanted {expect}")
+        return (time.perf_counter() - t0) * 1000.0
+
+    def slo_state():
+        verdicts = hub.get("/api/alerts").json["slos"]
+        return {v["slo"]: v for v in verdicts}[
+            "serving-predict-errors"]
+
+    checks, result = [], {}
+
+    def check(name, ok, detail):
+        checks.append((name, bool(ok)))
+        result[name] = {"ok": bool(ok), **detail}
+
+    # ---- (a) anatomy: raw probe p50 vs fleet /debug/latency
+    for _ in range(2):
+        predict()                      # warm (first dispatch, buckets)
+    lat = sorted(predict() for _ in range(args.probe))
+    for _ in range(2):
+        predict(first=SLOW)            # p99 outliers seed exemplars
+    p50 = lat[len(lat) // 2]
+    pod.flush()
+    anatomy = hub.get(
+        f"/debug/latency?path={args.model}").json
+    phases = anatomy["phases"]
+    phase_sum = anatomy["phase_p50_sum_ms"]
+    wire = sum(phases[p]["p50_ms"] for p in
+               ("http.read", "decode", "encode", "http.write")
+               if p in phases)
+    check("anatomy", 0.9 * p50 <= phase_sum <= 1.05 * p50
+          and wire < 0.2 * phases["device"]["p50_ms"],
+          {"raw_p50_ms": round(p50, 2),
+           "phase_p50_sum_ms": phase_sum,
+           "device_p50_ms": phases["device"]["p50_ms"],
+           "wire_p50_ms": round(wire, 3),
+           "phases": {k: v["p50_ms"] for k, v in phases.items()}})
+
+    # ---- (b) SLO burn: ok -> burning -> ok
+    transitions = [slo_state()["state"]]
+    deadline = time.time() + 4 * args.fast_window
+    while time.time() < deadline and transitions[-1] != "ok":
+        predict()
+        time.sleep(0.2)
+        transitions.append(slo_state()["state"])
+    baseline_ok = transitions[-1] == "ok"
+    deadline = time.time() + 2 * args.slow_window
+    while time.time() < deadline and transitions[-1] != "burning":
+        for _ in range(3):
+            predict(first=POISON, expect=500)
+        time.sleep(0.3)
+        transitions.append(slo_state()["state"])
+    burst = slo_state()
+    burned = transitions[-1] == "burning"
+    deadline = time.time() + 3 * args.slow_window
+    while time.time() < deadline and transitions[-1] != "ok":
+        for _ in range(3):
+            predict()
+        time.sleep(0.3)
+        transitions.append(slo_state()["state"])
+    recovered = transitions[-1] == "ok"
+    squashed = [s for i, s in enumerate(transitions)
+                if i == 0 or s != transitions[i - 1]]
+    check("slo_flip", baseline_ok and burned and recovered,
+          {"transitions": squashed,
+           "burst_burn_rate": burst["burn_rate"],
+           "windows_s": burst["windows_s"]})
+
+    # ---- (c) p99 exemplar resolves to a full per-phase trace
+    pod.flush()
+    exemplars = []
+    for line in hub.get("/metrics").body.decode().splitlines():
+        mo = _EXEMPLAR_LINE.match(line)
+        if mo:
+            le = float("inf") if mo.group(1) == "+Inf" \
+                else float(mo.group(1))
+            exemplars.append((le, mo.group(2)))
+    tid = max(exemplars)[1] if exemplars else None
+    spans = []
+    if tid:
+        traces = hub.get(f"/debug/traces?trace_id={tid}").json[
+            "traces"]
+        spans = [s["name"] for t in traces for s in t["spans"]]
+    want = {"http.read", "decode", "batch.queue_wait", "device",
+            "encode", "http.write"}
+    check("exemplar", tid is not None and want <= set(spans),
+          {"trace_id": tid, "bucket_le": max(exemplars)[0]
+           if exemplars else None, "spans": sorted(set(spans))})
+
+    conn.close()
+    pod.stop()
+    result["ok"] = all(ok for _, ok in checks)
+    print(json.dumps(result, indent=2))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -348,12 +348,18 @@ class TestServingMetrics:
             spans = t["traces"][0]["spans"]
             srv = [s for s in spans
                    if s["name"].startswith("http POST")][0]
-            disp = [s for s in spans
-                    if s["name"] == "serving.dispatch"][0]
-            # acceptance: HTTP handling + serving dispatch, linked
+            # acceptance: HTTP handling + the per-phase latency
+            # anatomy, all linked under the root (ISSUE 8 replaced
+            # the monolithic serving.dispatch span with phases)
             assert srv["parent_id"] == "88" * 8
-            assert disp["parent_id"] == srv["span_id"]
-            assert disp["attrs"]["track"] == "stable"
+            assert srv["attrs"]["model"] == "obs-wire"
+            by_name = {s["name"]: s for s in spans}
+            for phase in ("http.read", "decode", "batch.queue_wait",
+                          "batch.dispatch", "device", "encode",
+                          "http.write"):
+                assert phase in by_name, f"missing phase {phase}"
+                assert by_name[phase]["parent_id"] == srv["span_id"]
+            assert by_name["decode"]["attrs"]["format"] == "json"
         finally:
             server.stop()
 
