@@ -521,6 +521,23 @@ def bench_serving(steps, batch):
         top1_agree = float(
             (fp32_probs.argmax(-1) == int8_probs.argmax(-1)).mean())
         max_prob_delta = float(np.max(np.abs(fp32_probs - int8_probs)))
+
+        # per-phase p50 breakdown off the server's own /debug/latency
+        # (PR 8 anatomy): recorded next to raw_p50_ms so the
+        # wire-overhead trajectory is tracked per LEG from this bench
+        # leg on, not as one lumped number
+        try:
+            anatomy = _json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/latency"
+                f"?path=resnet50", timeout=60))
+            phase_p50_ms = {
+                k: v["p50_ms"]
+                for k, v in (anatomy.get("phases") or {}).items()}
+            phase_p50_sum_ms = anatomy.get("phase_p50_sum_ms")
+        except OSError as e:
+            print(f"bench: /debug/latency fetch failed ({e}); "
+                  f"phase breakdown omitted")
+            phase_p50_ms, phase_p50_sum_ms = {}, None
     finally:
         server.stop()
     dt = sum(lat)       # successful attempts only (see post())
@@ -561,6 +578,12 @@ def bench_serving(steps, batch):
                            1000 * raw_lat[len(raw_lat) // 2], 1),
                        "raw_predictions_per_sec": round(
                            steps * batch / sum(raw_lat), 1),
+                       # per-phase p50s from /debug/latency: the
+                       # request anatomy this leg measured (http.read/
+                       # decode/queue/dispatch/device/encode/write) —
+                       # the wire-overhead trajectory per leg
+                       "phase_p50_ms": phase_p50_ms,
+                       "phase_p50_sum_ms": phase_p50_sum_ms,
                        # 8 concurrent keep-alive raw clients: cross-
                        # request continuous batching coalesces their
                        # unary requests (occupancy 1.0 = no coalescing)

@@ -44,8 +44,10 @@ def main():
 
     # import side effects register each layer's module-level families
     import kubeflow_tpu.compute.serving       # noqa: F401
+    import kubeflow_tpu.compute.serving_async  # noqa: F401
     import kubeflow_tpu.compute.sweep         # noqa: F401
     import kubeflow_tpu.compute.telemetry     # noqa: F401
+    import kubeflow_tpu.controllers.modeldeployment  # noqa: F401
     import kubeflow_tpu.controllers.tpuslice  # noqa: F401
     import kubeflow_tpu.core.manager          # noqa: F401
     import kubeflow_tpu.core.workqueue        # noqa: F401
@@ -53,6 +55,7 @@ def main():
     import kubeflow_tpu.obs.slo               # noqa: F401
     import kubeflow_tpu.sched.controller      # noqa: F401
     import kubeflow_tpu.web.http              # noqa: F401
+    import kubeflow_tpu.web.router            # noqa: F401
     from kubeflow_tpu.controllers.metrics import NotebookMetrics
     from kubeflow_tpu.obs import metrics as obs_metrics
 
@@ -101,6 +104,17 @@ def main():
         "serving_deadline_exceeded_total",
         "slo_burn_rate",
         "slo_error_budget_remaining",
+        # async serving transport + router/LB tier (ISSUE 9): the
+        # transport families expose connection/stall pressure on the
+        # event loop; the router families are the scale-out surface
+        # (per-replica routing, health, autoscale decisions)
+        "serving_transport_open_connections",
+        "serving_transport_read_stall_seconds",
+        "serving_transport_write_stall_seconds",
+        "router_requests_total",
+        "router_replica_healthy",
+        "router_outstanding_requests",
+        "router_autoscale_decisions_total",
     }
     registered = {metric.name for metric in obs_metrics.REGISTRY._metrics}
     scratch_names = {metric.name for metric in scratch._metrics}

@@ -96,6 +96,16 @@ WEB_APPS = {
                             "SLO_WINDOW_FAST": "300",
                             "SLO_WINDOW_SLOW": "3600",
                             "SLO_BURN_THRESHOLD": "14.4"}},
+    # serving router/LB (web/router.py): least-outstanding-requests
+    # routing over ModelDeployment replica endpoints (synced from the
+    # CR status), with per-replica health/drain awareness.
+    # ROUTER_BACKENDS pins a static replica set for environments
+    # without the controller; the health interval is the poll cadence
+    # for both membership sync and /healthz.
+    "model-router": {"image": PLATFORM_IMAGE,
+                     "port": 8500, "prefix": "/serving",
+                     "env": {"ROUTER_BACKENDS": "",
+                             "ROUTER_HEALTH_INTERVAL": "2.0"}},
     "access-management": {"image": PLATFORM_IMAGE,
                           "port": 8081, "prefix": "/kfam"},
     "centraldashboard": {"image": PLATFORM_IMAGE,
@@ -112,6 +122,8 @@ CRDS = [
      "Namespaced"),
     ("tpuslices", "TpuSlice", ["v1alpha1"], "v1alpha1", "Namespaced"),
     ("studyjobs", "StudyJob", ["v1alpha1"], "v1alpha1", "Namespaced"),
+    ("modeldeployments", "ModelDeployment", ["v1alpha1"], "v1alpha1",
+     "Namespaced"),
 ]
 
 

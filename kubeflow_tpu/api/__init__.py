@@ -6,7 +6,8 @@ All objects are unstructured dicts; this package provides constructors,
 defaulting, validation and version conversion.
 """
 
-from . import builtin, notebook, poddefault, profile, tensorboard, tpuslice
+from . import (builtin, modeldeployment, notebook, poddefault, profile,
+               tensorboard, tpuslice)
 
 GROUP = "kubeflow.org"
 
@@ -18,8 +19,10 @@ def register_all(store):
     tensorboard.register(store)
     poddefault.register(store)
     tpuslice.register(store)
+    modeldeployment.register(store)
     store.register_cluster_scoped("storage.k8s.io", "StorageClass")
 
 
-__all__ = ["GROUP", "builtin", "notebook", "poddefault", "profile",
-           "tensorboard", "tpuslice", "register_all"]
+__all__ = ["GROUP", "builtin", "modeldeployment", "notebook",
+           "poddefault", "profile", "tensorboard", "tpuslice",
+           "register_all"]

@@ -1,0 +1,84 @@
+"""ModelDeployment CRD — N ModelServer replicas behind the router.
+
+A ``ServedModel`` is one process; a ``ModelDeployment`` is the
+horizontal unit: the controller materializes ``spec.replicas`` model-
+server pods on TpuSlice chips (each pod one ModelServer speaking the
+async transport by default), publishes their endpoints in
+``status.endpoints`` for the router tier (``web/router.py``), and —
+when ``spec.autoscale`` is set — resizes the replica set from the
+serving backpressure signals (``serving_batch_queue_wait_seconds`` /
+``serving_batch_occupancy_requests``) aggregated off the fleet
+telemetry shards. Mirrors the reference platform's out-of-tree
+TF-Serving Deployment + Service pair (testing/test_tf_serving.py),
+done as a first-class TPU-native kind.
+"""
+
+GROUP = "kubeflow.org"
+KIND = "ModelDeployment"
+VERSION = "v1alpha1"
+
+#: the in-cluster serving port (pods have distinct IPs). Local runs
+#: (ProcessPodRuntime: every pod is 127.0.0.1) set ``spec.basePort``
+#: instead and replica i listens on basePort+i.
+DEFAULT_PORT = 8500
+
+
+def default_template():
+    """Pod template running the stock model-server entrypoint; the
+    controller injects MODEL_NAME/PORT/SERVING_TRANSPORT per replica."""
+    return {"spec": {"containers": [{
+        "name": "model-server",
+        "image": "kubeflowtpu/platform:latest",
+        "args": ["model-server"],
+    }]}}
+
+
+def new_deployment(name, namespace, model="default", replicas=1,
+                   min_replicas=None, max_replicas=None, template=None,
+                   base_port=None, autoscale=False, transport="async"):
+    """``model`` is the served-model name predicts route to;
+    ``replicas`` the desired ModelServer pod count (clamped to
+    [minReplicas, maxReplicas] when autoscaling); ``base_port`` makes
+    replica ``i`` listen on ``base_port + i`` for single-host runs;
+    ``transport`` picks the wire engine per replica (async | threaded);
+    ``autoscale`` lets the controller drive the replica count from the
+    serving queue-wait/occupancy histograms."""
+    if autoscale and max_replicas is None:
+        # the controller clamps to maxReplicas (default: replicas),
+        # so autoscale without headroom would be a silent no-op —
+        # give it room by default, loudly in the spec
+        max_replicas = max(int(replicas) * 2, int(replicas) + 1)
+    spec = {
+        "model": model,
+        "replicas": int(replicas),
+        "transport": transport,
+        "template": template or default_template(),
+    }
+    if min_replicas is not None:
+        spec["minReplicas"] = int(min_replicas)
+    if max_replicas is not None:
+        spec["maxReplicas"] = int(max_replicas)
+    if base_port is not None:
+        spec["basePort"] = int(base_port)
+    if autoscale:
+        spec["autoscale"] = True
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}", "kind": KIND,
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": spec,
+        "status": {"replicas": 0, "readyReplicas": 0, "endpoints": [],
+                   "phase": "Pending"},
+    }
+
+
+def replica_port(spec, index):
+    """The port replica ``index`` serves on (basePort+i locally, the
+    fixed serving port in-cluster)."""
+    base = spec.get("basePort")
+    if base is not None:
+        return int(base) + index
+    return DEFAULT_PORT
+
+
+def register(store):
+    pass

@@ -146,15 +146,18 @@ def tensorboard_controller(argv=()):
 
 
 def tpuslice_controller(argv=()):
-    from ..controllers import tpuslice
+    from ..controllers import modeldeployment, tpuslice
     from ..sched import QueueReconciler
     _serve_health()
     # the admission queue runs beside the workload reconcilers: one
-    # lease covers all three so admission decisions and pod creation
-    # can never split-brain across replicas
-    mgr, _ = _run_manager([tpuslice.TpuSliceReconciler(),
-                           tpuslice.StudyJobReconciler(),
-                           QueueReconciler()])
+    # lease covers all of them so admission decisions and pod creation
+    # can never split-brain across replicas. ModelDeployment rides the
+    # same manager — serving replicas are workload pods like any other
+    mgr, _ = _run_manager([
+        tpuslice.TpuSliceReconciler(),
+        tpuslice.StudyJobReconciler(),
+        QueueReconciler(),
+        modeldeployment.ModelDeploymentReconciler()])
     _block(mgr.stop)
 
 
@@ -231,8 +234,75 @@ def slice_worker(argv=()):
     raise SystemExit(sw.main(list(argv)))
 
 
+def model_server(argv=()):
+    """One ModelDeployment replica: a ModelServer on the async
+    transport (SERVING_TRANSPORT overrides), serving MODEL_NAME. The
+    stock image registers the demo MLP so the serving path is
+    exercisable end to end; real deployments point MODEL_MODULE at a
+    ``register(server)`` callable that installs their predict fns."""
+    import importlib
+
+    from ..compute import serving
+
+    server = serving.ModelServer()
+    name = os.environ.get("MODEL_NAME", "default")
+    module = os.environ.get("MODEL_MODULE", "")
+    device_ms = float(os.environ.get("MODEL_DEVICE_MS", "0") or 0)
+    if module:
+        importlib.import_module(module).register(server)
+    elif device_ms > 0:
+        # deterministic fake device for load/scale testing: each
+        # dispatched ROW costs device_ms, serialized on the batcher's
+        # dispatch thread — one replica's capacity is EXACTLY
+        # 1000/device_ms rows/s, so replica scaling is measurable
+        # without TPU hardware (and without the host CPU confounding
+        # the result)
+        import time as _time
+
+        import numpy as _np
+
+        class _SleeperModel(serving.ServedModel):
+            def dispatch(self, x):
+                self.last_used = _time.monotonic()
+                self.device_calls += 1
+                x = _np.asarray(x)
+                _time.sleep(device_ms * x.shape[0] / 1000.0)
+                return x * 2.0, x.shape[0]
+
+        server._models[name] = _SleeperModel(name, lambda x: x)
+    else:
+        # the stock-MLP branch is the only one that needs jax (the
+        # fake-device path exists to skip multi-second jit startup)
+        import jax
+
+        from ..compute.models import mlp
+        cfg = mlp.Config(
+            in_dim=int(os.environ.get("MODEL_IN_DIM", "64")),
+            hidden=int(os.environ.get("MODEL_HIDDEN", "128")),
+            n_classes=int(os.environ.get("MODEL_CLASSES", "16")))
+        params = mlp.init_params(cfg, jax.random.PRNGKey(0))
+        server.register(
+            name,
+            lambda x: jax.nn.softmax(mlp.apply(params, x, cfg),
+                                     axis=-1))
+    port = server.start(
+        port=int(os.environ.get("PORT", "8500")),
+        host=os.environ.get("HOST", "0.0.0.0"))
+    logging.info("model-server serving on :%d (%s transport)", port,
+                 server.transport)
+    print(f"PORT {port}", flush=True)    # local-pod discovery
+    _block(server.stop)
+
+
+def model_router(argv=()):
+    from ..web import router
+    _web(router.create_app, 8500)
+
+
 COMPONENTS = {
     "slice-worker": slice_worker,
+    "model-server": model_server,
+    "model-router": model_router,
     "notebook-controller": notebook_controller,
     "secure-notebook-controller": secure_notebook_controller,
     "profile-controller": profile_controller,

@@ -37,6 +37,7 @@ import collections
 import contextlib
 import json
 import logging
+import os
 import queue
 import random
 import sys
@@ -50,6 +51,7 @@ import numpy as np
 
 from ..obs import metrics as obs_metrics
 from ..obs import tracing
+from ..web.http import HTTPError, framed_body_length
 
 log = logging.getLogger("kubeflow_tpu.serving")
 
@@ -125,6 +127,27 @@ def bucket_for(n):
     return next((b for b in BATCH_BUCKETS if b >= n), n)
 
 
+class _CallbackSlot(threading.Event):
+    """A batch slot's done-event that additionally fires a one-shot
+    callback with the slot when set — how the async transport's event
+    loop learns (on the batcher's worker thread) that a non-blocking
+    submit resolved. Every resolution path already calls
+    ``slot["done"].set()``, so the callback inherits the full
+    resolution taxonomy (result, dispatch error, deadline shed, drain)
+    without touching any of those sites."""
+
+    def __init__(self, callback):
+        super().__init__()
+        self._callback = callback
+        self.slot = None
+
+    def set(self):
+        super().set()
+        cb, self._callback = self._callback, None
+        if cb is not None:
+            cb(self.slot)
+
+
 class _Batcher:
     """Cross-request continuous batching (TF-Serving's batching layer,
     continuous-batching flavor): concurrent predict calls — one per
@@ -193,6 +216,25 @@ class _Batcher:
         if "error" in slot:
             raise slot["error"]
         return slot["out"], slot["ms"]
+
+    def submit_async(self, x, rt=None, deadline=None, on_done=None):
+        """Non-blocking submit for the event-loop transport: returns
+        the slot immediately; ``on_done(slot)`` fires exactly once (on
+        the batcher's worker thread) when the slot resolves with
+        ``out``+``ms`` or ``error``. Raises RuntimeError("batcher
+        stopped") like :meth:`submit` when not accepting; the same
+        TOCTOU discipline applies (a put racing the loop's exit is
+        self-drained, so the callback always fires)."""
+        if not self._accepting or self._dead.is_set():
+            raise RuntimeError("batcher stopped")
+        done = _CallbackSlot(on_done)
+        slot = {"x": x, "done": done, "t": time.perf_counter(),
+                "tw": time.time(), "rt": rt, "deadline": deadline}
+        done.slot = slot
+        self.q.put(slot)
+        if self._dead.is_set():
+            self._drain()
+        return slot
 
     def _loop(self):
         try:
@@ -642,10 +684,14 @@ def _decode_tensor(t):
         .reshape(shape)
 
 
-def _encode_tensor_bytes(x):
-    """ndarray → ``(dtype_name, shape, little-endian bytes)`` — the
-    raw half of both binary response formats (the octet-stream body IS
-    these bytes; the b64 JSON contract wraps them in base64)."""
+def _encode_tensor_view(x):
+    """ndarray → ``(dtype_name, shape, little-endian memoryview)`` with
+    NO byte copy for native little-endian contiguous arrays: the view
+    ALIASES the result array's buffer (the array stays alive through
+    the view), so writing a binary response costs zero serialization —
+    the transport writes the header bytes and this view as separate
+    writes instead of concatenating header+payload into a fresh
+    buffer."""
     x = np.ascontiguousarray(x)
     if x.dtype.name not in TENSOR_DTYPES:
         x = x.astype(np.float32)
@@ -654,15 +700,25 @@ def _encode_tensor_bytes(x):
         # native-order dtypes report '=' regardless of host endianness,
         # so a big-endian host must be caught via sys.byteorder
         x = x.astype(x.dtype.newbyteorder("<"))
-    # native/little-endian arrays serialize without an extra copy —
-    # this is the hot path the binary contracts exist to make cheap
-    return x.dtype.name, list(x.shape), x.tobytes()
+    if x.size == 0:
+        # memoryview can't cast a zero-in-shape view; the empty bytes
+        # object costs nothing anyway
+        return x.dtype.name, list(x.shape), memoryview(b"")
+    return x.dtype.name, list(x.shape), memoryview(x).cast("B")
+
+
+def _encode_tensor_bytes(x):
+    """ndarray → ``(dtype_name, shape, little-endian bytes)`` — the
+    raw half of both binary response formats (the octet-stream body IS
+    these bytes; the b64 JSON contract wraps them in base64)."""
+    dtype, shape, view = _encode_tensor_view(x)
+    return dtype, shape, view.tobytes()
 
 
 def _encode_tensor(x):
-    dtype, shape, data = _encode_tensor_bytes(x)
+    dtype, shape, view = _encode_tensor_view(x)
     return {"dtype": dtype, "shape": shape,
-            "b64": base64.b64encode(data).decode()}
+            "b64": base64.b64encode(view).decode()}
 
 
 def _parse_tensor_headers(headers):
@@ -720,6 +776,104 @@ def _decode_tensor_stream(headers, rfile, length, rt=None):
     return arr, time.perf_counter() - t0 - read_s
 
 
+# ----------------------------------------------- shared wire contract
+#
+# Both transports — the threaded handler below and the selectors event
+# loop in serving_async.py — route through these helpers, so the
+# request/response contract (paths, formats, error taxonomy, response
+# bytes) is defined exactly once and can never diverge.
+
+def parse_predict_path(path):
+    """``/v1/models/<name>:<verb>`` → ``(name, verb)``, else None."""
+    parts = path.strip("/").split("/")
+    if (len(parts) != 3 or parts[:2] != ["v1", "models"]
+            or ":" not in parts[2]):
+        return None
+    name, verb = parts[2].rsplit(":", 1)
+    return name, verb
+
+
+def parse_deadline(raw):
+    """``X-Request-Deadline-Ms`` header value → absolute
+    ``time.monotonic`` deadline (None = no deadline; malformed →
+    ValueError → 400). The client's remaining budget propagates so the
+    batcher can shed work nobody is waiting for."""
+    if raw is None or not str(raw).strip():
+        return None
+    try:
+        ms = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"X-Request-Deadline-Ms must be a number of "
+            f"milliseconds, got {raw!r}") from None
+    return time.monotonic() + max(0.0, ms) / 1000.0
+
+
+def classify_predict_error(e):
+    """The ONE unary predict error taxonomy, shared by every transport
+    and route so they can never diverge: 400 = the caller's fault
+    (scalar/ragged/malformed input), 504 = the caller's propagated
+    deadline expired in the batch queue (shed, never dispatched),
+    507 = permanent capacity (the model alone exceeds the budget —
+    retry loops keyed on 500 must stop), 503 + Retry-After = transient
+    mid-transition budget pressure, 500 = inference failed.
+    → ``(status, payload, extra_headers)``."""
+    if isinstance(e, DeadlineExceededError):
+        return 504, {"error": str(e)}, ()
+    if isinstance(e, ModelTooLargeError):
+        return 507, {"error": str(e)}, ()
+    if isinstance(e, CapacityBusyError):
+        return 503, {"error": str(e)}, (("Retry-After", "1"),)
+    if isinstance(e, ValueError):
+        return 400, {"error": str(e)}, ()
+    return 500, {"error": f"inference failed: {e}"}, ()
+
+
+def decode_json_predict(raw):
+    """JSON predict body (the ``instances`` and b64 ``tensor``
+    contracts) → ``(ndarray, fmt)`` with the list→ndarray
+    materialization included, so the decode metric covers the full
+    body→ndarray cost. Malformed → ValueError/KeyError/TypeError
+    (caller maps to 400)."""
+    req = json.loads(raw or b"{}")
+    if "tensor" in req:
+        return _decode_tensor(req["tensor"]), "b64"
+    return np.asarray(req["instances"]), "json"
+
+
+def encode_predict_response(out, fmt, infer_ms, version):
+    """One predict result → ``(body_parts, extra_headers,
+    content_type)``; ``body_parts`` is a list of bytes/memoryview the
+    transport writes SEPARATELY (Content-Length = summed lengths). The
+    binary tensor payload rides as a memoryview aliasing the result
+    array's buffer — no header+payload concat and no ``tobytes()``
+    copy on either transport."""
+    common = (("X-Inference-Time-Ms", f"{infer_ms:.1f}"),
+              ("X-Served-Version", str(version)))
+    if fmt == "binary":
+        dtype, shape, view = _encode_tensor_view(out)
+        return [view], (
+            ("X-Tensor-Dtype", dtype),
+            ("X-Tensor-Shape", ",".join(str(d) for d in shape)),
+            *common), "application/x-tensor"
+    if fmt == "b64":
+        payload = {"tensor": _encode_tensor(out)}
+    else:
+        payload = {"predictions": out.tolist()}
+    return [json.dumps(payload).encode()], common, "application/json"
+
+
+def _residency(model):
+    return {
+        "managed": model._managed,
+        "loaded": model.loaded,
+        "resident_bytes": model.resident_bytes
+        if model._managed else None,
+        "loads": model.loads,
+        "evictions": model.evictions,
+    }
+
+
 class ModelServer:
     """Registry + HTTP server. ``server.register("mnist", fn)`` then
     ``server.start(port)``; reference clients work unchanged.
@@ -734,6 +888,9 @@ class ModelServer:
         self._models = {}
         self._httpd = None
         self._thread = None
+        self._transport = None    # AsyncTransport when transport=async
+        self.draining = False     # begin_drain() flips healthz so the
+                                  # router stops routing here
         self.budget_bytes = budget_bytes
         # rows coalesced per device call on :predictStream. Measured
         # r5, interleaved same-weather medians over 6 runs of 100 b64
@@ -1029,6 +1186,87 @@ class ModelServer:
 
     # -------------------------------------------------------- HTTP
 
+    def handle_get(self, path, query):
+        """Transport-neutral GET routing → ``(status, payload,
+        extra_headers, content_type)``. ``payload`` bytes pass through
+        verbatim; anything else the transport encodes with the SAME
+        ``json.dumps`` call, so responses stay byte-identical across
+        transports. The platform-wide observability surface rides the
+        serving port too: scrape + trace without a sidecar."""
+        parts = path.strip("/").split("/")
+        json_ct = "application/json"
+        if parts == ["metrics"]:
+            return (200, obs_metrics.REGISTRY.exposition().encode(),
+                    (), obs_metrics.TEXT_CONTENT_TYPE)
+        if parts == ["debug", "traces"]:
+            tid = query.get("trace_id") or None
+            if query.get("format") == "chrome":
+                return (200, tracing.TRACES.chrome_trace(tid), (),
+                        json_ct)
+            return (200, {"traces": tracing.TRACES.traces(tid)}, (),
+                    json_ct)
+        if parts == ["debug", "latency"]:
+            # per-phase p50/p95/p99 from the span ring: the request
+            # latency anatomy (docs/observability.md)
+            return (200, tracing.latency_summary(
+                tracing.TRACES.span_dicts(),
+                path=query.get("path")), (), json_ct)
+        # /v1/models/<name> → model version status
+        if len(parts) == 3 and parts[:2] == ["v1", "models"]:
+            model = self._models.get(parts[2])
+            if model is None:
+                return 404, {"error": "model not found"}, (), json_ct
+            # state stays AVAILABLE for evicted managed models: a
+            # predict lazily reloads them, so they ARE servable —
+            # readiness probes keyed on the TF-Serving state enum must
+            # not pull the server out of rotation. Residency lives in
+            # its own block.
+            canary = self._canaries.get(parts[2])
+            payload = {"model_version_status": [{
+                "version": str(model.version),
+                "state": "AVAILABLE",
+                "status": {"error_code": "OK", "error_message": ""},
+            }], "residency": _residency(model)}
+            if canary is not None:
+                payload["canary"] = {
+                    "version": str(canary["model"].version),
+                    "weight": canary["weight"],
+                    **_residency(canary["model"])}
+            return 200, payload, (), json_ct
+        if parts == ["v1", "models"]:
+            # registry listing with residency state — what an operator
+            # needs to see the byte budget working. Snapshot BOTH dicts
+            # under the lock: a deploy on another thread must not
+            # resize them mid-iteration.
+            with self._residency_lock:
+                model_items = list(self._models.values())
+                canary_items = list(self._canaries.items())
+            return 200, {
+                "budget_bytes": self.budget_bytes,
+                "resident_bytes": self.resident_bytes(),
+                "models": [{
+                    "name": m.name,
+                    "version": str(m.version),
+                    # operator view: RESIDENT/EVICTED is the device
+                    # truth; servability is the status route's
+                    # AVAILABLE
+                    "state": "RESIDENT" if m.loaded else "EVICTED",
+                    **_residency(m),
+                } for m in model_items] + [{
+                    "name": f"{name}@canary",
+                    "version": str(c["model"].version),
+                    "weight": c["weight"],
+                    "state": "RESIDENT" if c["model"].loaded
+                    else "EVICTED",
+                    **_residency(c["model"]),
+                } for name, c in canary_items]}, (), json_ct
+        if parts == ["healthz"]:
+            # the router's health poll keys off this: "draining" is
+            # alive-but-unroutable (finish in-flight, take no new)
+            return (200, {"status": "draining" if self.draining
+                          else "ok"}, (), json_ct)
+        return 404, {"error": "not found"}, (), json_ct
+
     def _handler(self):
         models = self._models
         server = self
@@ -1051,26 +1289,35 @@ class ModelServer:
             def log_message(self, *args):
                 pass
 
-            def _reject_chunked(self):
-                """HTTP/1.1 clients may legally send chunked request
-                bodies; this server sizes reads by Content-Length, so
-                answer 411 (and close) instead of silently treating
-                the body as empty and desyncing the connection."""
-                te = (self.headers.get("Transfer-Encoding") or "").lower()
-                if "chunked" in te:
-                    self._send(411, {"error":
-                                     "chunked request bodies not "
-                                     "supported; send Content-Length"})
-                    return True
-                return False
+            def _body_length(self):
+                """Shared framing contract (web.http.framed_body_
+                length): 411 for chunked/unframed bodies, 501 for
+                other transfer encodings — answered for the caller;
+                returns None after sending the error."""
+                try:
+                    return framed_body_length(self.command,
+                                              self.headers.get)
+                except HTTPError as e:
+                    self._send(e.status, {"error": e.message})
+                    return None
 
             def _send(self, code, payload, extra_headers=(),
                       content_type="application/json"):
-                body = payload if isinstance(payload, bytes) \
-                    else json.dumps(payload).encode()
+                if isinstance(payload, (list, tuple)):
+                    # pre-encoded body parts (encode_predict_response):
+                    # written SEPARATELY below — the binary tensor
+                    # payload is a memoryview of the result array, and
+                    # concatenating it with the head would copy the
+                    # tensor once per response
+                    parts = list(payload)
+                elif isinstance(payload, (bytes, memoryview)):
+                    parts = [payload]
+                else:
+                    parts = [json.dumps(payload).encode()]
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
-                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Content-Length",
+                                 str(sum(len(p) for p in parts)))
                 # POSTs carry the request recorder (RequestTrace duck-
                 # types format_traceparent); GETs fall back to any
                 # ambient span
@@ -1097,100 +1344,26 @@ class ModelServer:
                 self.end_headers()
                 rt = getattr(self, "_rt", None)
                 t_write = time.time()
-                self.wfile.write(body)
+                for part in parts:
+                    self.wfile.write(part)
                 if rt is not None:
                     rt.phase("http.write", t_write)
 
-            @staticmethod
-            def _residency(model):
-                return {
-                    "managed": model._managed,
-                    "loaded": model.loaded,
-                    "resident_bytes": model.resident_bytes
-                    if model._managed else None,
-                    "loads": model.loads,
-                    "evictions": model.evictions,
-                }
-
             def do_GET(self):
+                # consume any framed GET body before answering, or a
+                # keep-alive peer's next request parses body bytes as
+                # its request line (the async loop already does this)
+                length = self._body_length()
+                if length is None:
+                    return
+                if length:
+                    self.rfile.read(length)
                 split = urlsplit(self.path)
                 query = {k: v[-1]
                          for k, v in parse_qs(split.query).items()}
-                # the platform-wide observability surface rides the
-                # serving port too: scrape + trace without a sidecar
-                parts = split.path.strip("/").split("/")
-                if parts == ["metrics"]:
-                    return self._send(
-                        200,
-                        obs_metrics.REGISTRY.exposition().encode(),
-                        content_type=obs_metrics.TEXT_CONTENT_TYPE)
-                if parts == ["debug", "traces"]:
-                    tid = query.get("trace_id") or None
-                    if query.get("format") == "chrome":
-                        return self._send(
-                            200, tracing.TRACES.chrome_trace(tid))
-                    return self._send(
-                        200, {"traces": tracing.TRACES.traces(tid)})
-                if parts == ["debug", "latency"]:
-                    # per-phase p50/p95/p99 from the span ring: the
-                    # request latency anatomy (docs/observability.md)
-                    return self._send(200, tracing.latency_summary(
-                        tracing.TRACES.span_dicts(),
-                        path=query.get("path")))
-                # /v1/models/<name> → model version status
-                if len(parts) == 3 and parts[:2] == ["v1", "models"]:
-                    model = models.get(parts[2])
-                    if model is None:
-                        return self._send(404, {"error": "model not found"})
-                    # state stays AVAILABLE for evicted managed models:
-                    # a predict lazily reloads them, so they ARE
-                    # servable — readiness probes keyed on the
-                    # TF-Serving state enum must not pull the server
-                    # out of rotation. Residency lives in its own block.
-                    canary = server._canaries.get(parts[2])
-                    payload = {"model_version_status": [{
-                        "version": str(model.version),
-                        "state": "AVAILABLE",
-                        "status": {"error_code": "OK", "error_message": ""},
-                    }], "residency": self._residency(model)}
-                    if canary is not None:
-                        payload["canary"] = {
-                            "version": str(canary["model"].version),
-                            "weight": canary["weight"],
-                            **self._residency(canary["model"])}
-                    return self._send(200, payload)
-                if parts == ["v1", "models"]:
-                    # registry listing with residency state — what an
-                    # operator needs to see the byte budget working.
-                    # Snapshot BOTH dicts under the lock: a deploy on
-                    # another thread must not resize them mid-
-                    # iteration.
-                    with server._residency_lock:
-                        model_items = list(models.values())
-                        canary_items = list(server._canaries.items())
-                    return self._send(200, {
-                        "budget_bytes": server.budget_bytes,
-                        "resident_bytes": server.resident_bytes(),
-                        "models": [{
-                            "name": m.name,
-                            "version": str(m.version),
-                            # operator view: RESIDENT/EVICTED is the
-                            # device truth; servability is the status
-                            # route's AVAILABLE
-                            "state": "RESIDENT" if m.loaded
-                            else "EVICTED",
-                            **self._residency(m),
-                        } for m in model_items] + [{
-                            "name": f"{name}@canary",
-                            "version": str(c["model"].version),
-                            "weight": c["weight"],
-                            "state": "RESIDENT" if c["model"].loaded
-                            else "EVICTED",
-                            **self._residency(c["model"]),
-                        } for name, c in canary_items]})
-                if parts == ["healthz"]:
-                    return self._send(200, {"status": "ok"})
-                self._send(404, {"error": "not found"})
+                code, payload, extra, ctype = server.handle_get(
+                    split.path, query)
+                self._send(code, payload, extra, content_type=ctype)
 
             def do_POST(self):
                 # request recorder: continues the caller's trace when
@@ -1227,29 +1400,33 @@ class ModelServer:
                             model, str(rt.attrs["code"])).inc()
                     rt.finish()
 
-            def _parse_deadline(self):
-                """``X-Request-Deadline-Ms`` → absolute time.monotonic
-                deadline (None = no deadline; malformed → ValueError
-                → 400). The client's remaining budget propagates so
-                the batcher can shed work nobody is waiting for."""
-                raw = self.headers.get("X-Request-Deadline-Ms")
-                if raw is None or not raw.strip():
-                    return None
-                try:
-                    ms = float(raw)
-                except ValueError:
-                    raise ValueError(
-                        f"X-Request-Deadline-Ms must be a number of "
-                        f"milliseconds, got {raw!r}") from None
-                return time.monotonic() + max(0.0, ms) / 1000.0
-
             def _handle_post(self):
                 rt = self._rt
-                parts = self.path.strip("/").split("/")
-                if (len(parts) != 3 or parts[:2] != ["v1", "models"]
-                        or ":" not in parts[2]):
+                # framing FIRST, before any routing: the async loop
+                # validates framing at head-parse time, and the two
+                # transports must answer identically on every path —
+                # /admin/drain included (a drain runbook must not
+                # behave differently per deployment)
+                length = self._body_length()
+                if length is None:
+                    return
+                # route on the PATH component (query stripped), like
+                # the async loop — the transports must agree on e.g.
+                # /admin/drain?note=...
+                path = urlsplit(self.path).path
+                if path.strip("/").split("/") == ["admin", "drain"]:
+                    if length:
+                        # consume the body before answering: leaving
+                        # it unread desyncs this keep-alive connection
+                        # (the next request would parse body bytes as
+                        # its request line)
+                        self.rfile.read(length)
+                    server.begin_drain()
+                    return self._send(200, {"status": "draining"})
+                target = parse_predict_path(path)
+                if target is None:
                     return self._send(404, {"error": "not found"})
-                name, verb = parts[2].rsplit(":", 1)
+                name, verb = target
                 model = models.get(name)
                 if model is None:
                     return self._send(404, {"error": "model not found"})
@@ -1258,14 +1435,13 @@ class ModelServer:
                 model = server._route(name, model)
                 rt.attrs["model"] = name
                 rt.attrs["track"] = model.track
-                if self._reject_chunked():
-                    return
                 if verb == "predictStream":
-                    return self._predict_stream(model)
+                    return self._predict_stream(model, length)
                 if verb != "predict":
                     return self._send(400, {"error": f"verb {verb}"})
                 try:
-                    deadline = self._parse_deadline()
+                    deadline = parse_deadline(
+                        self.headers.get("X-Request-Deadline-Ms"))
                 except ValueError as e:
                     return self._send(400, {"error": f"bad request: {e}"})
                 ctype = (self.headers.get("Content-Type") or "") \
@@ -1274,32 +1450,19 @@ class ModelServer:
                     # raw octet-stream: dtype/shape in headers, the
                     # body IS the little-endian buffer — no JSON, no
                     # base64 on either leg
-                    return self._predict_binary(model, deadline)
+                    return self._predict_binary(model, deadline, length)
                 # 400 = the caller's fault (malformed body); 500 = ours
                 # (inference failed) — clients like the reference's
                 # test_tf_serving retry loop key off the distinction
-                binary = False
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
                     t_read = time.time()
                     raw = self.rfile.read(length) if length else b""
                     rt.phase("http.read", t_read)
                     t_dec = time.perf_counter()
                     tw_dec = time.time()
-                    req = json.loads(raw or b"{}")
-                    if "tensor" in req:
-                        binary = True
-                        x = _decode_tensor(req["tensor"])
-                    else:
-                        # materialize here so the decode metric covers
-                        # the full body→ndarray cost (the list→array
-                        # conversion dominates at image sizes — the
-                        # very cost the binary formats delete);
-                        # predict_raw's asarray is then a no-op
-                        x = np.asarray(req["instances"])
+                    x, fmt = decode_json_predict(raw)
                 except (ValueError, KeyError, TypeError) as e:
                     return self._send(400, {"error": f"bad request: {e}"})
-                fmt = "b64" if binary else "json"
                 _WIRE_FORMAT_TOTAL.labels(fmt).inc()
                 _DECODE_SECONDS.labels(fmt).observe(
                     time.perf_counter() - t_dec)
@@ -1314,14 +1477,10 @@ class ModelServer:
                 # visible; the tensor path exists to remove it)
                 out, infer = result
                 t_enc = time.time()
-                if binary:
-                    payload = {"tensor": _encode_tensor(out)}
-                else:
-                    payload = {"predictions": out.tolist()}
+                parts, extra, ctype = encode_predict_response(
+                    out, fmt, infer, model.version)
                 rt.phase("encode", t_enc, format=fmt)
-                self._send(200, payload,
-                           (("X-Inference-Time-Ms", f"{infer:.1f}"),
-                            ("X-Served-Version", str(model.version))))
+                self._send(200, parts, extra, content_type=ctype)
 
             def _predict_guarded(self, model, x, deadline=None):
                 """The ONE unary predict error taxonomy, shared by the
@@ -1337,20 +1496,13 @@ class ModelServer:
                 try:
                     return model.predict_raw(x, rt=self._rt,
                                              deadline=deadline)
-                except ValueError as e:
-                    self._send(400, {"error": str(e)})
-                except DeadlineExceededError as e:
-                    self._send(504, {"error": str(e)})
-                except ModelTooLargeError as e:
-                    self._send(507, {"error": str(e)})
-                except CapacityBusyError as e:
-                    self._send(503, {"error": str(e)},
-                               (("Retry-After", "1"),))
                 except Exception as e:  # noqa: BLE001 — wire boundary
-                    self._send(500, {"error": f"inference failed: {e}"})
+                    code, payload, extra = classify_predict_error(e)
+                    self._send(code, payload, extra)
                 return None
 
-            def _predict_binary(self, model, deadline=None):
+            def _predict_binary(self, model, deadline=None,
+                                length=0):
                 """Zero-copy unary predict (``application/x-tensor``):
                 request dtype/shape ride ``X-Tensor-*`` headers, the
                 body is the raw little-endian buffer, and the response
@@ -1358,7 +1510,6 @@ class ModelServer:
                 JSON route (400 caller / 504 deadline / 500 server /
                 503+507 capacity) so retry loops work unchanged."""
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
                     x, dec_s = _decode_tensor_stream(
                         self.headers, self.rfile, length, rt=self._rt)
                 except (ValueError, TypeError) as e:
@@ -1367,11 +1518,7 @@ class ModelServer:
                     # RST away the buffered 400 on large payloads, and
                     # the client would see a reset instead of the
                     # documented error detail
-                    try:
-                        left = int(self.headers.get(
-                            "Content-Length", 0))
-                    except (ValueError, TypeError):
-                        left = 0
+                    left = length
                     while left > 0:
                         chunk = self.rfile.read(min(left, 1 << 20))
                         if not chunk:
@@ -1385,18 +1532,16 @@ class ModelServer:
                     return      # taxonomy response already sent
                 out, infer = result
                 t_enc = time.time()
-                dtype, shape, payload = _encode_tensor_bytes(out)
+                # encode builds a memoryview ALIASING the result array
+                # (no tobytes copy); _send writes head and payload as
+                # two writes — the tensor is never concatenated into a
+                # response buffer
+                parts, extra, ctype = encode_predict_response(
+                    out, "binary", infer, model.version)
                 self._rt.phase("encode", t_enc, format="binary")
-                self._send(
-                    200, payload,
-                    (("X-Tensor-Dtype", dtype),
-                     ("X-Tensor-Shape",
-                      ",".join(str(d) for d in shape)),
-                     ("X-Inference-Time-Ms", f"{infer:.1f}"),
-                     ("X-Served-Version", str(model.version))),
-                    content_type="application/x-tensor")
+                self._send(200, parts, extra, content_type=ctype)
 
-            def _predict_stream(self, model):
+            def _predict_stream(self, model, length):
                 """Batched-pipelined predict over one connection: the
                 request body is NDJSON (one predict request per line,
                 same ``{"instances"|"tensor"}`` schema); the response
@@ -1409,11 +1554,6 @@ class ModelServer:
                 ~6× the per-request rate on a v5e — BASELINE r4), and
                 the next group is decoded+dispatched while the previous
                 one's results are fetched and written."""
-                try:
-                    length = int(self.headers.get("Content-Length", 0))
-                except (ValueError, TypeError) as e:
-                    return self._send(400, {"error": f"bad stream: {e}"})
-
                 def iter_lines(remaining):
                     # incremental ingest: decode/dispatch start on the
                     # first line, memory stays O(one line), and upload
@@ -1573,22 +1713,60 @@ class ModelServer:
 
         return Handler
 
-    def start(self, port=8500, host="0.0.0.0"):
-        self._httpd = ThreadingHTTPServer((host, port), self._handler())
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
-        self._thread.start()
+    def start(self, port=8500, host="0.0.0.0", transport=None):
+        """``transport`` picks the wire engine: ``"threaded"`` (the
+        original ThreadingHTTPServer — one worker thread per
+        connection) or ``"async"`` (serving_async.py — a single
+        selectors event loop: non-blocking accept/read/write,
+        keep-alive multiplexing, zero-copy ``application/x-tensor``
+        reads). Default comes from the ``SERVING_TRANSPORT`` env knob,
+        else threaded. Both speak the identical wire contract
+        (tests/test_serving_wire.py runs the conformance suite over
+        both)."""
+        transport = (transport or os.environ.get("SERVING_TRANSPORT")
+                     or "threaded").strip().lower()
+        if transport == "async":
+            from . import serving_async
+            self._transport = serving_async.AsyncTransport(
+                self, host=host, port=port)
+            actual = self._transport.start()
+        elif transport == "threaded":
+            self._httpd = ThreadingHTTPServer((host, port),
+                                              self._handler())
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True)
+            self._thread.start()
+            actual = self._httpd.server_address[1]
+        else:
+            raise ValueError(f"unknown serving transport "
+                             f"{transport!r} (threaded | async)")
+        self.transport = transport
         # fleet telemetry: the serving families join the hub's merged
         # /metrics the same way the training workers' do (no-op when
         # no shard directory resolves — e.g. unit tests)
         from ..obs import export as obs_export
         self._exporter = obs_export.start_exporter()
-        return self._httpd.server_address[1]
+        return actual
+
+    def begin_drain(self):
+        """Soft drain: the healthz payload flips to ``draining`` (the
+        router's health poll stops routing predicts here — the router
+        is the enforcement point), in-flight requests finish, and the
+        async transport reaps idle keep-alive connections + closes
+        every further response's connection. Health probes keep
+        answering; models stay registered and loaded — a drain is a
+        routing event, not a shutdown."""
+        self.draining = True
+        if self._transport is not None:
+            self._transport.drain()
 
     def stop(self):
         if self._httpd:
             self._httpd.shutdown()
             self._httpd = None
+        if self._transport is not None:
+            self._transport.stop()
+            self._transport = None
         if getattr(self, "_exporter", None) is not None:
             self._exporter.stop()
             self._exporter = None

@@ -1,0 +1,284 @@
+"""ModelDeployment controller: N model-server replicas + autoscaler.
+
+Materializes ``spec.replicas`` model-server pods (``<name>-replica-<i>``,
+label ``model-deployment: <name>``), mirrors readiness + endpoints into
+status for the router tier (``web/router.py``), and — when
+``spec.autoscale`` is set — drives the replica count from the serving
+plane's own backpressure histograms:
+
+- ``serving_batch_queue_wait_seconds`` rising means requests sit in the
+  batcher because the device can't keep up → scale up;
+- ``serving_batch_occupancy_requests`` near 1 with negligible queue
+  wait means replicas dispatch mostly-empty batches → scale down.
+
+Both families already ship from every ModelServer via the fleet
+telemetry shards (PR 1/PR 6); the autoscaler reads the SAME shard
+directory the metrics hub merges, as inter-reconcile DELTAS (counters
+are cumulative — absolute values would remember traffic from an hour
+ago). The decision itself is a pure function (``autoscale_decision``)
+so its hysteresis is unit-testable without a fleet.
+"""
+
+import logging
+import math
+import os
+
+from ..api import modeldeployment as mdapi
+from ..api.builtin import pod as new_pod
+from ..core import meta as m
+from ..core.errors import AlreadyExistsError, NotFoundError
+from ..core.manager import Reconciler, Request, Result
+from ..obs import metrics as obs_metrics
+
+log = logging.getLogger("kubeflow_tpu.controllers.modeldeployment")
+
+#: pods of a deployment carry this label -> deployment name
+LABEL = "model-deployment"
+
+_AUTOSCALE_TOTAL = obs_metrics.REGISTRY.counter(
+    "router_autoscale_decisions_total",
+    "ModelDeployment replica-count changes made by the autoscaler "
+    "(direction: up | down)",
+    ("deployment", "direction"))
+
+
+def autoscale_decision(queue_wait_p50_s, occupancy_mean, current,
+                       min_replicas, max_replicas,
+                       up_wait_s=0.02, down_wait_s=0.005,
+                       down_occupancy=1.5):
+    """Pure scaling policy → target replica count.
+
+    - no signal (``queue_wait_p50_s`` is None: no predict traffic this
+      window) → hold;
+    - queue-wait p50 above ``up_wait_s`` → +1 (requests are waiting on
+      a busy device; another replica absorbs the queue);
+    - queue-wait p50 under ``down_wait_s`` AND mean batch occupancy at
+      or under ``down_occupancy`` → −1 (the fleet dispatches
+      near-empty batches; fewer replicas re-densify them);
+    - anything between is the hysteresis band → hold.
+
+    One step per evaluation, clamped to [min, max] — the reconcile
+    cadence is the ramp limiter."""
+    lo = max(1, int(min_replicas))
+    hi = max(lo, int(max_replicas))
+    current = min(max(int(current), lo), hi)
+    if queue_wait_p50_s is None:
+        return current
+    if queue_wait_p50_s > up_wait_s and current < hi:
+        return current + 1
+    if queue_wait_p50_s < down_wait_s \
+            and (occupancy_mean or 1.0) <= down_occupancy \
+            and current > lo:
+        return current - 1
+    return current
+
+
+def _histogram_quantile(cumulative, q):
+    """Prometheus-style quantile from cumulative {le: count} bucket
+    deltas (le floats, +Inf included) → the smallest bound covering
+    quantile ``q`` (the upper bound, like histogram_quantile's linear
+    estimate rounded up — good enough to threshold on)."""
+    total = cumulative.get(math.inf, 0.0)
+    if total <= 0:
+        return None
+    want = q * total
+    for le in sorted(b for b in cumulative if b != math.inf):
+        if cumulative[le] >= want:
+            return le
+    return math.inf
+
+
+class ShardSignalReader:
+    """Reads the serving backpressure signals for one model off the
+    fleet telemetry shard directory, as deltas since the previous
+    call. Stateful per (reader, model)."""
+
+    def __init__(self, shard_dir=None):
+        self.shard_dir = shard_dir
+        self._prev = {}      # model -> {series_key: value}
+        self._cache = {}     # read_shards parse memoization
+
+    def __call__(self, model):
+        shard_dir = self.shard_dir or os.environ.get("OBS_EXPORT_DIR")
+        if not shard_dir or not os.path.isdir(shard_dir):
+            return None, None
+        from ..obs import aggregate
+        primed = model in self._prev
+        buckets = {}      # le -> summed cumulative count (delta)
+        occ = {"sum": 0.0, "count": 0.0}
+        cur = {}
+        for shard in aggregate.read_shards(shard_dir,
+                                           cache=self._cache):
+            for name, labels, value in shard.samples:
+                ld = dict(labels)
+                if ld.get("model") != model:
+                    continue
+                key = (shard.pod, name, labels)
+                cur[key] = value
+                prev = self._prev.get(model, {}).get(key, 0.0)
+                delta = max(0.0, value - prev)
+                if name == "serving_batch_queue_wait_seconds_bucket":
+                    le = float(ld.get("le", "inf").replace(
+                        "+Inf", "inf"))
+                    buckets[le] = buckets.get(le, 0.0) + delta
+                elif name == ("serving_batch_occupancy_requests"
+                              "_sum"):
+                    occ["sum"] += delta
+                elif name == ("serving_batch_occupancy_requests"
+                              "_count"):
+                    occ["count"] += delta
+        self._prev[model] = cur
+        if not primed:
+            # first observation (controller start/restart): the
+            # cumulative counters carry the fleet's ENTIRE history —
+            # judging them as a delta would scale on traffic from an
+            # hour ago. Prime the baseline and report no signal.
+            return None, None
+        p50 = _histogram_quantile(buckets, 0.5)
+        occ_mean = occ["sum"] / occ["count"] if occ["count"] else None
+        return p50, occ_mean
+
+
+class ModelDeploymentReconciler(Reconciler):
+    name = "modeldeployment-controller"
+    API = f"{mdapi.GROUP}/{mdapi.VERSION}"
+
+    def __init__(self, signals_fn=None, autoscale_interval=5.0):
+        #: ``signals_fn(model) -> (queue_wait_p50_s, occupancy_mean)``
+        #: — injectable for tests; default reads the telemetry shards
+        self.signals = signals_fn or ShardSignalReader()
+        self.autoscale_interval = autoscale_interval
+
+    def setup(self, builder):
+        builder.watch_for(self.API, mdapi.KIND)
+        builder.watch_mapped("v1", "Pod", self._map_pod)
+
+    def _map_pod(self, ev):
+        name = m.labels_of(ev.object).get(LABEL)
+        if name:
+            yield Request(name, m.namespace_of(ev.object))
+
+    # ------------------------------------------------------- replicas
+
+    def _replica_pod(self, md, index):
+        """One model-server pod: the deployment template with the
+        per-replica serving contract injected (PORT, MODEL_NAME,
+        SERVING_TRANSPORT — template-set values win)."""
+        spec = md.get("spec", {})
+        template = m.deep_copy(spec.get("template")
+                               or mdapi.default_template())
+        pod_spec = template.get("spec") or {}
+        containers = pod_spec.setdefault("containers", [{}])
+        env = containers[0].setdefault("env", [])
+        have = {e.get("name") for e in env}
+        inject = {
+            "MODEL_NAME": spec.get("model", "default"),
+            "PORT": str(mdapi.replica_port(spec, index)),
+            "SERVING_TRANSPORT": spec.get("transport", "async"),
+        }
+        for key, value in inject.items():
+            if key not in have:
+                env.append({"name": key, "value": value})
+        pod = new_pod(
+            f"{m.name_of(md)}-replica-{index}", m.namespace_of(md),
+            pod_spec,
+            labels={LABEL: m.name_of(md),
+                    "model-deployment-index": str(index)})
+        m.set_controller_reference(pod, md)
+        return pod
+
+    def reconcile(self, req):
+        md = self.store.try_get(self.API, mdapi.KIND, req.name,
+                                req.namespace)
+        if md is None:
+            return Result()
+        spec = md.get("spec", {})
+        status = dict(md.get("status") or {})
+        lo = int(spec.get("minReplicas", 1))
+        hi = int(spec.get("maxReplicas", spec.get("replicas", 1)))
+        autoscaling = bool(spec.get("autoscale"))
+        # the autoscaler's target only overrides spec.replicas WHILE
+        # autoscaling: flipping spec.autoscale off must hand control
+        # back to spec.replicas, not pin the last-scaled count forever
+        desired = int(spec.get("replicas", 1))
+        if autoscaling and status.get("targetReplicas"):
+            desired = int(status["targetReplicas"])
+        desired = min(max(desired, lo), max(lo, hi))
+
+        pods = {m.name_of(p): p for p in self.store.list(
+            "v1", "Pod", req.namespace,
+            label_selector={LABEL: req.name})}
+        for i in range(desired):
+            pod_name = f"{req.name}-replica-{i}"
+            if pod_name not in pods:
+                try:
+                    self.store.create(self._replica_pod(md, i))
+                except AlreadyExistsError:
+                    pass
+        for pod_name, p in pods.items():
+            idx = m.labels_of(p).get("model-deployment-index")
+            if idx is not None and int(idx) >= desired \
+                    and not m.deep_get(p, "metadata",
+                                       "deletionTimestamp"):
+                # scale down from the top: the router's health poll
+                # drops the endpoint; in-flight requests on it finish
+                # (the pod's server drains on SIGTERM)
+                try:
+                    self.store.delete("v1", "Pod", pod_name,
+                                      req.namespace)
+                except NotFoundError:
+                    pass
+
+        ready, endpoints = 0, []
+        for i in range(desired):
+            p = pods.get(f"{req.name}-replica-{i}")
+            if p is None:
+                continue
+            if m.deep_get(p, "status", "phase") == "Running":
+                ready += 1
+                ip = m.deep_get(p, "status", "podIP",
+                                default="127.0.0.1")
+                endpoints.append(
+                    f"{ip}:{mdapi.replica_port(spec, i)}")
+
+        new_status = {
+            "replicas": desired,
+            "readyReplicas": ready,
+            "endpoints": endpoints,
+            "phase": "Ready" if ready >= desired and desired > 0
+            else "Progressing",
+        }
+        if autoscaling and status.get("targetReplicas"):
+            new_status["targetReplicas"] = status["targetReplicas"]
+
+        if autoscaling and ready >= desired:
+            # only judge a stable fleet: mid-rollout queue waits are
+            # startup artifacts, not capacity signals
+            p50, occ = self.signals(spec.get("model", "default"))
+            target = autoscale_decision(p50, occ, desired, lo, hi)
+            if target != desired:
+                direction = "up" if target > desired else "down"
+                _AUTOSCALE_TOTAL.labels(req.name, direction).inc()
+                log.info("autoscale %s/%s: %d -> %d (queue_wait_p50="
+                         "%s occupancy=%s)", req.namespace, req.name,
+                         desired, target, p50, occ)
+                new_status["targetReplicas"] = target
+                new_status["lastScale"] = {
+                    "from": desired, "to": target,
+                    "queueWaitP50S": p50, "occupancyMean": occ,
+                    "at": m.now_iso()}
+        if status.get("lastScale") and "lastScale" not in new_status:
+            new_status["lastScale"] = status["lastScale"]
+
+        stale_target = (not autoscaling
+                        and "targetReplicas" in status)
+        changed = stale_target or any(
+            status.get(k) != v for k, v in new_status.items())
+        if changed:
+            merged = {**status, **new_status}
+            if stale_target:
+                merged.pop("targetReplicas", None)
+            md["status"] = merged
+            self.store.update_status(md)
+        return Result(requeue_after=self.autoscale_interval
+                      if autoscaling else 0.0)

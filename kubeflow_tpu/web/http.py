@@ -46,6 +46,95 @@ class HTTPError(Exception):
         self.message = message
 
 
+# ------------------------------------------------ shared wire parsing
+#
+# Both serving transports (the threaded handler in compute/serving.py
+# and the selectors event loop in compute/serving_async.py) and the
+# web tier's socket server parse requests through these two helpers so
+# the framing contract can never diverge between them.
+
+def parse_request_head(head):
+    """One HTTP/1.x request head (request line + header lines, WITHOUT
+    the terminating blank line) → ``(method, target, headers)`` with
+    header names lowercased. Malformed → ValueError."""
+    try:
+        text = head.decode("latin-1")
+    except (UnicodeDecodeError, AttributeError):
+        raise ValueError("undecodable request head")
+    lines = text.split("\r\n")
+    parts = lines[0].split(" ")
+    if len(parts) != 3 or not parts[2].startswith("HTTP/"):
+        raise ValueError(f"malformed request line {lines[0]!r}")
+    headers = {}
+    for ln in lines[1:]:
+        if not ln:
+            continue
+        name, sep, value = ln.partition(":")
+        if not sep or not name or name != name.strip() or " " in name:
+            raise ValueError(f"malformed header line {ln!r}")
+        headers[name.lower()] = value.strip()
+    return parts[0].upper(), parts[1], headers
+
+
+def max_body_bytes():
+    """``HTTP_MAX_BODY_BYTES`` (default 64 MiB): the largest body a
+    single request may DECLARE. Checked at head-parse time — before
+    any buffer is sized from the client's number — so a forged
+    Content-Length cannot commit memory (the async transport
+    preallocates its zero-copy landing buffer from this value). Read
+    per request so operators can raise it live for big tensors."""
+    try:
+        return int(os.environ.get("HTTP_MAX_BODY_BYTES", "")
+                   or (64 << 20))
+    except ValueError:
+        return 64 << 20
+
+
+def framed_body_length(method, get_header):
+    """Request-body framing contract, shared by every transport: the
+    body must be length-framed. → Content-Length (0 when the method
+    carries none); raises HTTPError with the documented taxonomy
+    otherwise:
+
+    - 411 for ``Transfer-Encoding: chunked`` (this platform sizes
+      reads by Content-Length; silently treating the body as empty
+      would desync the keep-alive connection),
+    - 501 for any other Transfer-Encoding,
+    - 411 for a body-carrying method (POST/PUT/PATCH) with no
+      Content-Length at all (no framing = unreadable body),
+    - 400 for a malformed/negative Content-Length,
+    - 413 for a Content-Length past ``HTTP_MAX_BODY_BYTES``.
+
+    ``get_header(name)`` abstracts the header container (email.Message
+    in the stdlib handlers, a plain lowercased dict in the async
+    loop)."""
+    te = (get_header("Transfer-Encoding") or "").strip().lower()
+    if te:
+        if "chunked" in te:
+            raise HTTPError(411, "chunked request bodies not "
+                                 "supported; send Content-Length")
+        raise HTTPError(501, f"Transfer-Encoding {te!r} not supported")
+    raw = get_header("Content-Length")
+    if raw is None or not str(raw).strip():
+        if method.upper() in ("POST", "PUT", "PATCH"):
+            raise HTTPError(411, "Content-Length required: request "
+                                 "bodies must be length-framed")
+        return 0
+    try:
+        length = int(str(raw).strip())
+    except ValueError:
+        raise HTTPError(400, f"malformed Content-Length {raw!r}") \
+            from None
+    if length < 0:
+        raise HTTPError(400, f"negative Content-Length {raw!r}")
+    limit = max_body_bytes()
+    if length > limit:
+        raise HTTPError(413, f"request body of {length} bytes "
+                             f"exceeds the {limit}-byte limit "
+                             f"(HTTP_MAX_BODY_BYTES)")
+    return length
+
+
 class Request:
     def __init__(self, method, path, headers=None, body=b"", query=None):
         self.method = method.upper()
@@ -331,12 +420,39 @@ class App:
         app = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive (every response carries
+            # Content-Length) + Nagle off: without these, each
+            # request pays a TCP setup and the Nagle × delayed-ACK
+            # stall — ruinous for the router data plane, which fronts
+            # predict traffic through this very server. The timeout
+            # reaps idle persistent connections.
+            protocol_version = "HTTP/1.1"
+            timeout = 60
+            disable_nagle_algorithm = True
+
             def log_message(self, *args):
                 pass
 
             def _run(self):
                 split = urlsplit(self.path)
-                length = int(self.headers.get("Content-Length") or 0)
+                try:
+                    length = framed_body_length(self.command,
+                                                self.headers.get)
+                except HTTPError as e:
+                    # the body is unread (unreadable, even): answer
+                    # and close — reusing the connection would parse
+                    # body bytes as the next request line
+                    body = json.dumps({"success": False,
+                                       "status": e.status,
+                                       "log": e.message}).encode()
+                    self.send_response(e.status)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.send_header("Connection", "close")
+                    self.close_connection = True
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 t_read = time.time()
                 body = self.rfile.read(length) if length else b""
                 read_end = time.time()

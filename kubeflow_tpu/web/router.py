@@ -1,0 +1,430 @@
+"""Serving router/LB tier: least-outstanding-requests over replicas.
+
+The single-process ModelServer is the hard ceiling for "millions of
+users"; a ModelDeployment (api/modeldeployment.py) gives N replicas,
+and this stdlib router is the tier in front of them:
+
+- **least-outstanding-requests routing**: each predict goes to the
+  healthy, non-draining replica with the fewest requests currently in
+  flight through this router — the classic latency-aware policy that
+  needs no clock math (a slow replica accumulates outstanding work and
+  stops receiving),
+- **health awareness**: a poll loop hits every replica's ``/healthz``;
+  a replica answering ``draining`` (ModelServer.begin_drain) or not
+  answering is taken out of rotation while its in-flight requests
+  finish — draining mid-load completes with zero 5xx from the drain,
+- **connection reuse**: a per-replica keep-alive connection pool, so
+  the router adds one hop, not one TCP handshake, per predict,
+- **store sync** (optional): with a store, replica endpoints follow
+  ``ModelDeployment.status.endpoints`` automatically; without one the
+  admin API (or ``ROUTER_BACKENDS``) manages them.
+
+The router is itself a ``web.http.App``: it inherits ``/metrics``,
+``/debug/traces`` and ``/debug/latency``, so the router hop shows up
+in the same latency anatomy as the replicas behind it.
+"""
+
+import http.client
+import json
+import logging
+import os
+import threading
+
+from ..obs import metrics as obs_metrics
+from .http import App, HTTPError, Response
+
+log = logging.getLogger("kubeflow_tpu.web.router")
+
+_ROUTED_TOTAL = obs_metrics.REGISTRY.counter(
+    "router_requests_total",
+    "Requests proxied per replica endpoint by final upstream status "
+    "(code=502 means the replica was unreachable)",
+    ("replica", "code"))
+_REPLICA_HEALTHY = obs_metrics.REGISTRY.gauge(
+    "router_replica_healthy",
+    "Replica health as seen by the router's poll loop (1 healthy, "
+    "0 unhealthy or draining)",
+    ("replica",))
+_OUTSTANDING = obs_metrics.REGISTRY.gauge(
+    "router_outstanding_requests",
+    "Predict requests currently in flight through the router per "
+    "replica — the least-outstanding routing signal",
+    ("replica",))
+
+#: request headers forwarded to the replica (hop-by-hop headers are not)
+_FORWARD_HEADERS = ("content-type", "x-tensor-dtype", "x-tensor-shape",
+                    "x-request-deadline-ms", "traceparent")
+#: response headers mirrored back to the client
+_MIRROR_HEADERS = ("Content-Type", "X-Tensor-Dtype", "X-Tensor-Shape",
+                   "X-Inference-Time-Ms", "X-Served-Version",
+                   "Retry-After")
+
+
+class Replica:
+    """One backend endpoint + its keep-alive connection pool."""
+
+    def __init__(self, endpoint):
+        self.endpoint = endpoint
+        host, sep, port = endpoint.rpartition(":")
+        if not sep or not host:
+            raise ValueError(
+                f"endpoint must be host:port, got {endpoint!r}")
+        self.host, self.port = host, int(port)   # ValueError on junk
+        self.healthy = None      # None = not yet polled (routable)
+        # two INDEPENDENT drain flags: an admin drain is sticky until
+        # membership changes (the health poll must never un-drain a
+        # replica an operator drained — and must not lose a drain that
+        # raced its snapshot); the replica's own healthz report clears
+        # when the replica recovers (e.g. a container restart on the
+        # same endpoint answers "ok" again and re-enters rotation)
+        self.drained = False             # set by RouterCore.drain()
+        self.reported_draining = False   # last healthz verdict
+        self.outstanding = 0
+        self._pool = []
+        self._lock = threading.Lock()
+
+    @property
+    def draining(self):
+        return self.drained or self.reported_draining
+
+    @draining.setter
+    def draining(self, value):
+        self.drained = bool(value)
+
+    @property
+    def routable(self):
+        return self.healthy is not False and not self.draining
+
+    def acquire(self, timeout):
+        with self._lock:
+            if self._pool:
+                return self._pool.pop()
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout)
+
+    def release(self, conn):
+        with self._lock:
+            if len(self._pool) < 16:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def close(self):
+        with self._lock:
+            pool, self._pool = self._pool, []
+        for conn in pool:
+            conn.close()
+
+
+class RouterCore:
+    """Replica set + routing policy + health poll. Pure of HTTP-app
+    concerns so tests drive it directly."""
+
+    def __init__(self, health_interval=2.0, timeout=300.0,
+                 health_timeout=2.0):
+        self.health_interval = health_interval
+        self.timeout = timeout
+        self.health_timeout = health_timeout
+        self._lock = threading.Lock()
+        self.replicas = {}       # endpoint -> Replica
+        self._rr = 0             # tie-break rotation
+        self._stop = threading.Event()
+        self._thread = None
+
+    # ---------------------------------------------------- membership
+
+    def set_backends(self, endpoints):
+        """Reconcile the replica set to exactly ``endpoints`` (stale
+        replicas drop out of rotation; their in-flight requests hold
+        their own connection and finish)."""
+        endpoints = [e.strip() for e in endpoints if e and e.strip()]
+        with self._lock:
+            for ep in endpoints:
+                if ep not in self.replicas:
+                    try:
+                        self.replicas[ep] = Replica(ep)
+                    except ValueError as e:
+                        # one malformed endpoint must not poison the
+                        # membership sync (or kill the poll loop)
+                        log.warning("ignoring bad backend: %s", e)
+            for ep in list(self.replicas):
+                if ep not in endpoints:
+                    self.replicas.pop(ep).close()
+                    _REPLICA_HEALTHY.labels(ep).set(0)
+                    _OUTSTANDING.labels(ep).set(0)
+
+    def drain(self, endpoint, propagate=True):
+        """Stop routing NEW requests to ``endpoint``; in-flight
+        requests complete untouched. ``propagate`` also tells the
+        replica itself to begin draining (POST /admin/drain), so its
+        healthz answers ``draining`` to every poller."""
+        with self._lock:
+            replica = self.replicas.get(endpoint)
+            if replica is None:
+                raise KeyError(endpoint)
+            replica.drained = True
+        _REPLICA_HEALTHY.labels(endpoint).set(0)
+        if propagate:
+            try:
+                conn = http.client.HTTPConnection(
+                    replica.host, replica.port,
+                    timeout=self.health_timeout)
+                conn.request("POST", "/admin/drain", b"{}",
+                             {"Content-Type": "application/json"})
+                conn.getresponse().read()
+                conn.close()
+            except OSError as e:
+                log.warning("drain propagation to %s failed: %s",
+                            endpoint, e)
+        return replica
+
+    # ------------------------------------------------------- routing
+
+    def pick(self, exclude=()):
+        """Healthy, non-draining replica with the fewest outstanding
+        requests; ties rotate DETERMINISTICALLY (endpoint sort order +
+        a pick counter — never ``hash()``, whose per-process salt
+        would make routing order irreproducible). → Replica | None."""
+        with self._lock:
+            candidates = [r for r in self.replicas.values()
+                          if r.routable and r.endpoint not in exclude]
+            if not candidates:
+                return None
+            least = min(r.outstanding for r in candidates)
+            ties = sorted((r for r in candidates
+                           if r.outstanding == least),
+                          key=lambda r: r.endpoint)
+            self._rr += 1
+            return ties[self._rr % len(ties)]
+
+    def _request_once(self, replica, method, path, body, headers,
+                      reuse):
+        """One upstream round trip; OSError propagates (the conn is
+        closed, never returned to the pool)."""
+        conn = replica.acquire(self.timeout) if reuse else \
+            http.client.HTTPConnection(replica.host, replica.port,
+                                       timeout=self.timeout)
+        try:
+            conn.request(method, path, body, headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            resp_headers = dict(resp.headers.items())
+        except (OSError, http.client.HTTPException):
+            # a replica dying mid-response raises HTTPException
+            # subclasses (IncompleteRead, BadStatusLine), not OSError
+            # — both mean the same thing here: this conn is toast
+            conn.close()
+            raise
+        if resp.will_close:
+            conn.close()
+        else:
+            replica.release(conn)
+        return resp.status, resp_headers, data
+
+    def forward(self, method, path, body, headers):
+        """Proxy one request → (status, response_headers, body_bytes).
+
+        A failure on a POOLED connection retries the SAME replica once
+        on a fresh connection first — a keep-alive the replica's idle
+        reaper closed is indistinguishable from a dead replica at the
+        socket level, and must not mark a healthy replica down. A
+        fresh-connection failure marks the replica unhealthy and the
+        request retries ONCE on another replica; with no routable
+        replica left the caller gets 503."""
+        tried = []
+        for _attempt in range(2):
+            replica = self.pick(exclude=tried)
+            if replica is None:
+                break
+            tried.append(replica.endpoint)
+            with self._lock:
+                replica.outstanding += 1
+            _OUTSTANDING.labels(replica.endpoint).set(
+                replica.outstanding)
+            try:
+                try:
+                    status, resp_headers, data = self._request_once(
+                        replica, method, path, body, headers,
+                        reuse=True)
+                except (OSError, http.client.HTTPException):
+                    status, resp_headers, data = self._request_once(
+                        replica, method, path, body, headers,
+                        reuse=False)
+                _ROUTED_TOTAL.labels(replica.endpoint,
+                                     str(status)).inc()
+                return status, resp_headers, data
+            except (OSError, http.client.HTTPException) as e:
+                with self._lock:
+                    replica.healthy = False
+                _REPLICA_HEALTHY.labels(replica.endpoint).set(0)
+                _ROUTED_TOTAL.labels(replica.endpoint, "502").inc()
+                log.warning("replica %s failed (%s); retrying on "
+                            "another", replica.endpoint, e)
+            finally:
+                with self._lock:
+                    replica.outstanding -= 1
+                _OUTSTANDING.labels(replica.endpoint).set(
+                    replica.outstanding)
+        if tried:
+            raise HTTPError(502, "every routable replica failed")
+        raise HTTPError(503, "no healthy replicas")
+
+    # -------------------------------------------------------- health
+
+    def check_health_once(self):
+        with self._lock:
+            replicas = list(self.replicas.values())
+        for replica in replicas:
+            healthy, reported = False, replica.reported_draining
+            try:
+                conn = http.client.HTTPConnection(
+                    replica.host, replica.port,
+                    timeout=self.health_timeout)
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                payload = json.loads(resp.read() or b"{}")
+                conn.close()
+                healthy = resp.status == 200
+                # the replica's own report: "ok" after a restart on
+                # the same endpoint CLEARS it (re-enters rotation);
+                # the admin `drained` flag is a separate bit this
+                # poll never touches — a drain racing this snapshot
+                # cannot be written back stale
+                reported = payload.get("status") == "draining"
+            except (OSError, ValueError, http.client.HTTPException):
+                healthy = False
+            with self._lock:
+                replica.healthy = healthy
+                replica.reported_draining = reported
+            _REPLICA_HEALTHY.labels(replica.endpoint).set(
+                1.0 if healthy and not replica.draining else 0.0)
+
+    def sync_from_store(self, store, namespace=None):
+        """Follow ModelDeployment.status.endpoints: the controller
+        writes them, the router routes to them — no second source of
+        truth."""
+        from ..api import modeldeployment as mdapi
+        endpoints = []
+        try:
+            deployments = store.list(
+                f"{mdapi.GROUP}/{mdapi.VERSION}", mdapi.KIND,
+                namespace)
+        except Exception as e:  # noqa: BLE001 — keep polling
+            log.debug("store sync failed: %s", e)
+            return
+        for md in deployments:
+            endpoints.extend(
+                (md.get("status") or {}).get("endpoints") or [])
+        if endpoints:
+            self.set_backends(endpoints)
+
+    def start(self, store=None, namespace=None):
+        if self._thread is not None:
+            return self
+        def loop():
+            while not self._stop.wait(self.health_interval):
+                # the poller must outlive any single bad iteration: a
+                # dead health thread would freeze membership AND
+                # health state while the router keeps routing
+                try:
+                    if store is not None:
+                        self.sync_from_store(store, namespace)
+                    self.check_health_once()
+                except Exception:  # noqa: BLE001 — keep polling
+                    log.exception("router health loop iteration "
+                                  "failed")
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="router-health")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._lock:
+            replicas = list(self.replicas.values())
+        for replica in replicas:
+            replica.close()
+
+    def snapshot(self):
+        with self._lock:
+            return [{
+                "endpoint": r.endpoint,
+                "healthy": r.healthy,
+                "draining": r.draining,
+                "outstanding": r.outstanding,
+            } for r in self.replicas.values()]
+
+
+def create_app(store=None, core=None, namespace=None):
+    """The router web app. With a ``store`` the replica set follows
+    ModelDeployment statuses; ``ROUTER_BACKENDS`` (comma-separated
+    ``host:port``) seeds/pins a static set. Compatible with
+    ``cmd._web`` (store-first signature)."""
+    app = App("model-router")
+    core = core or RouterCore(
+        health_interval=float(os.environ.get(
+            "ROUTER_HEALTH_INTERVAL", "2.0")))
+    app.router = core
+    backends = os.environ.get("ROUTER_BACKENDS", "")
+    if backends:
+        core.set_backends(backends.split(","))
+    core.start(store=store, namespace=namespace)
+
+    def proxy(request, rest):
+        path = "/v1/" + rest
+        headers = {}
+        for name in _FORWARD_HEADERS:
+            value = request.header(name)
+            if value is not None:
+                headers[name] = value
+        status, resp_headers, data = core.forward(
+            request.method, path, request.body, headers)
+        mirrored = {k: resp_headers[k] for k in _MIRROR_HEADERS
+                    if k in resp_headers}
+        return Response(data, status=status, headers=mirrored)
+
+    # the predict surface: every /v1/... verb proxies (predict,
+    # predictStream, model status); the router adds routing, not API.
+    # Caveat: the proxy is store-and-forward — a :predictStream
+    # response is buffered whole before relaying, losing the route's
+    # incremental TTFB (bulk throughput is preserved); stream clients
+    # that need first-line latency should hit a replica directly
+    app.post("/v1/<rest...>")(proxy)
+    app.get("/v1/<rest...>")(
+        lambda request, rest: proxy(request, rest))
+
+    @app.get("/healthz")
+    def healthz(request):
+        routable = sum(1 for r in core.snapshot()
+                       if r["healthy"] is not False
+                       and not r["draining"])
+        return {"status": "ok" if routable else "degraded",
+                "routable_replicas": routable}
+
+    @app.get("/admin/replicas")
+    def replicas(request):
+        return {"replicas": core.snapshot()}
+
+    @app.post("/admin/backends")
+    def backends_route(request):
+        body = request.json
+        if "backends" in body:
+            core.set_backends(list(body["backends"]))
+        else:
+            raise HTTPError(400, "expected {\"backends\": [...]}")
+        return {"replicas": core.snapshot()}
+
+    @app.post("/admin/drain/<endpoint>")
+    def drain_route(request, endpoint):
+        try:
+            core.drain(endpoint,
+                       propagate=request.query.get("propagate", "1")
+                       not in ("0", "false"))
+        except KeyError:
+            raise HTTPError(404, f"unknown replica {endpoint}")
+        return {"replicas": core.snapshot()}
+
+    return app

@@ -56,6 +56,23 @@ def build_argparser():
                          "in-process ModelServer on localhost)")
     ap.add_argument("--model", default="loadtest",
                     help="served model name (with --url)")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="multi-replica mode: drive the model-router "
+                         "over a ModelDeployment of N subprocess "
+                         "ModelServer pods (real control plane via "
+                         "ProcessPodRuntime) and report aggregate "
+                         "predictions/sec at 1 vs N replicas")
+    ap.add_argument("--transport", choices=("async", "threaded"),
+                    default="async",
+                    help="replica serving transport (multi-replica "
+                         "mode)")
+    ap.add_argument("--device-ms", type=float, default=10.0,
+                    help="fake device ms PER ROW on each replica "
+                         "(multi-replica mode): replica capacity is "
+                         "exactly 1000/device-ms rows/s, so replica "
+                         "scaling is measurable without TPUs")
+    ap.add_argument("--workdir",
+                    default="/tmp/serving-replicas-loadtest")
     return ap
 
 
@@ -75,8 +92,247 @@ def make_request_body(fmt, x):
     return body.encode(), {"Content-Type": "application/json"}
 
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port_base(count, tries=40):
+    """A base port with ``count`` consecutive free ports (replica i
+    listens on base+i — the ModelDeployment basePort contract)."""
+    import random
+    import socket
+    for _ in range(tries):
+        base = random.randint(20000, 55000)
+        socks = []
+        try:
+            for i in range(count):
+                s = socket.socket()
+                socks.append(s)     # before bind: close on failure too
+                s.bind(("127.0.0.1", base + i))
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port range found")
+
+
+def _wait_for(cond, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        out = cond()
+        if out:
+            return out
+        time.sleep(0.25)
+    raise RuntimeError(f"timed out waiting for {what}")
+
+
+def run_multi_replica(args):
+    """ISSUE 9 acceptance driver: the REAL stack end to end — a
+    ModelDeployment reconciled into subprocess model-server pods
+    (ProcessPodRuntime, the fleet_telemetry.py pattern), the router in
+    front, raw x-tensor load through it. Three legs:
+
+    1. 1 replica → aggregate predictions/sec,
+    2. scale the CR to N replicas → aggregate predictions/sec (the
+       acceptance wants ≥ 1.7x at N=2),
+    3. drain one replica mid-load through the router admin API —
+       in-flight requests complete, zero 5xx from the drain itself.
+    """
+    import numpy as np
+
+    from kubeflow_tpu import api
+    from kubeflow_tpu.api import modeldeployment as mdapi
+    from kubeflow_tpu.controllers.modeldeployment import \
+        ModelDeploymentReconciler
+    from kubeflow_tpu.controllers.process_runtime import \
+        ProcessPodRuntime
+    from kubeflow_tpu.core.manager import Manager
+    from kubeflow_tpu.core.store import ObjectStore
+    from kubeflow_tpu.web import router as router_lib
+
+    os.makedirs(args.workdir, exist_ok=True)
+    store = ObjectStore()
+    api.register_all(store)
+    runtime = ProcessPodRuntime(gang_label="model-deployment",
+                                workdir=args.workdir,
+                                extra_env={"PYTHONPATH": REPO})
+    mgr = Manager(store)
+    mgr.add(ModelDeploymentReconciler())
+    mgr.add(runtime)
+    mgr.start()
+
+    base_port = _free_port_base(args.replicas)
+    template = {"spec": {"containers": [{
+        "name": "model-server", "image": "local",
+        "command": [sys.executable, "-m", "kubeflow_tpu.cmd",
+                    "model-server"],
+        "env": [
+            {"name": "JAX_PLATFORMS", "value": "cpu"},
+            {"name": "MODEL_DEVICE_MS", "value": str(args.device_ms)},
+        ],
+    }]}}
+    md = mdapi.new_deployment(
+        "serve-scale", "default", model=args.model, replicas=1,
+        min_replicas=1, max_replicas=args.replicas,
+        template=template, base_port=base_port,
+        transport=args.transport)
+    store.create(md)
+
+    core = router_lib.RouterCore(health_interval=0.3)
+    app = router_lib.create_app(store=store, core=core)
+    httpd = app.serve(port=0, host="127.0.0.1")
+    router_port = httpd.server_address[1]
+
+    def routable():
+        return [r for r in core.snapshot()
+                if r["healthy"] and not r["draining"]]
+
+    # rows per request amortize the host-side wire cost so the DEVICE
+    # (1000/device_ms rows/s per replica) is what saturates — on a
+    # small host the scaling factor must measure replicas, not the
+    # driver's own CPU. 8 rows × 8 clients/replica = one max_batch
+    # window (64 rows, 640 ms at the default 10 ms/row), long enough
+    # that the between-window response round-trip is noise
+    n_rows = max(args.rows, 8)
+    x = np.random.default_rng(0).standard_normal(
+        (n_rows, args.in_dim)).astype(np.float32)
+    body, headers = make_request_body("raw", x)
+    path = f"/v1/models/{args.model}:predict"
+
+    # closed-loop clients are latency-bound: offered concurrency must
+    # saturate every replica's device for capacity to show
+    n_clients = max(args.clients, 8 * args.replicas)
+    n_requests = min(args.requests, 20)
+
+    failures = []
+
+    def measure(label):
+        lat, lock = [], threading.Lock()
+
+        def client():
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", router_port, timeout=120)
+                mine = []
+                for _ in range(n_requests):
+                    t1 = time.perf_counter()
+                    conn.request("POST", path, body, headers)
+                    r = conn.getresponse()
+                    r.read()
+                    if r.status != 200:
+                        failures.append(f"{label}: HTTP {r.status}")
+                        continue
+                    mine.append(time.perf_counter() - t1)
+                conn.close()
+                with lock:
+                    lat.extend(mine)
+            except Exception as e:  # noqa: BLE001 — reported
+                failures.append(f"{label}: {type(e).__name__}: {e}")
+
+        workers = [threading.Thread(target=client)
+                   for _ in range(n_clients)]
+        t0 = time.perf_counter()
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        wall = time.perf_counter() - t0
+        lat.sort()
+        return {
+            "predictions_per_sec": round(
+                len(lat) * n_rows / wall, 1),
+            "p50_ms": round(1000 * lat[len(lat) // 2], 2)
+            if lat else None,
+            "requests": len(lat),
+        }
+
+    report = {"replicas": args.replicas,
+              "transport": args.transport,
+              "clients": n_clients, "rows": n_rows,
+              "device_ms_per_row": args.device_ms}
+    try:
+        _wait_for(lambda: len(routable()) >= 1, 60,
+                  "first replica healthy via the router")
+        # warm the path (first dispatch per replica, router pools)
+        for _ in range(3):
+            c = http.client.HTTPConnection("127.0.0.1", router_port,
+                                           timeout=60)
+            c.request("POST", path, body, headers)
+            r = c.getresponse()
+            r.read()
+            if r.status != 200:
+                raise RuntimeError(f"warm-up HTTP {r.status}")
+            c.close()
+        report["single"] = measure("single")
+
+        # ---- scale the CR: the controller materializes the pods,
+        # the router follows status.endpoints on its own
+        latest = store.get(f"{mdapi.GROUP}/{mdapi.VERSION}",
+                           mdapi.KIND, "serve-scale", "default")
+        latest["spec"]["replicas"] = args.replicas
+        store.update(latest)
+        _wait_for(lambda: len(routable()) >= args.replicas, 90,
+                  f"{args.replicas} replicas healthy via the router")
+        for ep in [r["endpoint"] for r in core.snapshot()]:
+            host, _, port = ep.rpartition(":")
+            c = http.client.HTTPConnection(host, int(port),
+                                           timeout=60)
+            c.request("POST", path, body, headers)
+            c.getresponse().read()
+            c.close()
+        report["scaled"] = measure("scaled")
+        report["scaling_factor"] = round(
+            report["scaled"]["predictions_per_sec"]
+            / max(report["single"]["predictions_per_sec"], 1e-9), 2)
+
+        # ---- drain one replica mid-load: zero 5xx from the drain
+        drain_errors = []
+        victim = routable()[0]["endpoint"]
+
+        def drain_midload():
+            time.sleep(0.4)
+            c = http.client.HTTPConnection("127.0.0.1", router_port,
+                                           timeout=30)
+            c.request("POST", f"/admin/drain/{victim}", b"",
+                      {"Content-Type": "application/json",
+                       "Content-Length": "0"})
+            r = c.getresponse()
+            r.read()
+            if r.status != 200:
+                drain_errors.append(f"admin drain HTTP {r.status}")
+            c.close()
+
+        drainer = threading.Thread(target=drain_midload)
+        drainer.start()
+        before = len(failures)
+        report["drain_phase"] = measure("drain")
+        drainer.join()
+        report["drain_5xx"] = len(failures) - before
+        report["drain_errors"] = drain_errors
+        report["post_drain_routable"] = len(routable())
+        ok = (report["scaling_factor"] >= 1.7
+              and report["drain_5xx"] == 0 and not drain_errors
+              and not failures)
+        report["failures"] = failures[:5]
+        report["ok"] = ok
+    finally:
+        httpd.shutdown()
+        core.stop()
+        runtime.close()
+        mgr.stop()
+    return report
+
+
 def main(argv=None):
     args = build_argparser().parse_args(argv)
+    if args.replicas:
+        if args.replicas < 2:
+            raise SystemExit("--replicas must be >= 2 (scale-out = "
+                             "many replicas)")
+        report = run_multi_replica(args)
+        print(json.dumps(report, indent=2))
+        return 0 if report["ok"] else 1
     import numpy as np
 
     server = None

@@ -1,0 +1,433 @@
+"""ModelDeployment scale-out: reconciler, autoscaler, router tier.
+
+ISSUE 9's horizontal half: the ModelDeployment CRD materializes N
+model-server replica pods and publishes endpoints; the router routes
+least-outstanding with health/drain awareness; the autoscaler judges
+replica count from the serving queue-wait/occupancy signals. Pure
+policy is unit-tested, the replica/router data plane over REAL
+ModelServer instances (async transport) on localhost.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.api import modeldeployment as mdapi
+from kubeflow_tpu.compute import serving
+from kubeflow_tpu.controllers.modeldeployment import (
+    LABEL, ModelDeploymentReconciler, autoscale_decision,
+    _histogram_quantile)
+from kubeflow_tpu.core import meta as m
+from kubeflow_tpu.web import router as router_lib
+from kubeflow_tpu.web.http import TestClient
+
+API = f"{mdapi.GROUP}/{mdapi.VERSION}"
+
+
+def _deploy_manager(store, manager, signals_fn=None):
+    rec = ModelDeploymentReconciler(signals_fn=signals_fn)
+    manager.add(rec)
+    manager.start_sync()
+    return rec
+
+
+class TestAutoscaleDecision:
+    """The scaling policy is a pure function: thresholds + hysteresis
+    + clamping, no cluster required."""
+
+    def test_queue_wait_scales_up(self):
+        assert autoscale_decision(0.05, 4.0, 2, 1, 4) == 3
+
+    def test_idle_low_occupancy_scales_down(self):
+        assert autoscale_decision(0.001, 1.1, 3, 1, 4) == 2
+
+    def test_hysteresis_band_holds(self):
+        # between down_wait and up_wait: hold
+        assert autoscale_decision(0.01, 1.0, 2, 1, 4) == 2
+        # fast queue but batches still dense: hold (shrinking would
+        # re-queue the dense traffic)
+        assert autoscale_decision(0.001, 3.0, 2, 1, 4) == 2
+
+    def test_no_signal_holds(self):
+        assert autoscale_decision(None, None, 2, 1, 4) == 2
+
+    def test_clamped_to_bounds(self):
+        assert autoscale_decision(9.9, 9.0, 4, 1, 4) == 4
+        assert autoscale_decision(0.0, 1.0, 1, 1, 4) == 1
+        # out-of-range current snaps into bounds first
+        assert autoscale_decision(None, None, 7, 1, 4) == 4
+
+    def test_histogram_quantile(self):
+        buckets = {0.001: 10.0, 0.01: 60.0, 0.1: 100.0,
+                   float("inf"): 100.0}
+        assert _histogram_quantile(buckets, 0.5) == 0.01
+        assert _histogram_quantile({float("inf"): 0.0}, 0.5) is None
+
+
+class TestModelDeploymentReconciler:
+    def test_materializes_replica_pods_with_serving_contract(
+            self, store, manager):
+        _deploy_manager(store, manager)
+        store.create(mdapi.new_deployment(
+            "serve", "default", model="mnist", replicas=2,
+            base_port=9000, transport="async"))
+        manager.run_sync()
+
+        for i in range(2):
+            pod = store.get("v1", "Pod", f"serve-replica-{i}",
+                            "default")
+            assert m.labels_of(pod)[LABEL] == "serve"
+            env = {e["name"]: e.get("value") for e in
+                   pod["spec"]["containers"][0]["env"]}
+            assert env["MODEL_NAME"] == "mnist"
+            assert env["PORT"] == str(9000 + i)
+            assert env["SERVING_TRANSPORT"] == "async"
+            owner = m.controller_owner(pod)
+            assert owner and owner["kind"] == "ModelDeployment"
+
+        md = store.get(API, "ModelDeployment", "serve", "default")
+        assert md["status"]["replicas"] == 2
+        assert md["status"]["phase"] == "Progressing"  # pods not Running
+
+    def test_running_pods_become_ready_endpoints(self, store, manager):
+        _deploy_manager(store, manager)
+        store.create(mdapi.new_deployment(
+            "eps", "default", replicas=2, base_port=9100))
+        manager.run_sync()
+        for i in range(2):
+            pod = store.get("v1", "Pod", f"eps-replica-{i}", "default")
+            pod["status"] = {"phase": "Running", "podIP": "127.0.0.1"}
+            store.update_status(pod)
+        manager.run_sync()
+        md = store.get(API, "ModelDeployment", "eps", "default")
+        assert md["status"]["readyReplicas"] == 2
+        assert md["status"]["endpoints"] == [
+            "127.0.0.1:9100", "127.0.0.1:9101"]
+        assert md["status"]["phase"] == "Ready"
+
+    def test_scale_down_deletes_top_replicas(self, store, manager):
+        _deploy_manager(store, manager)
+        store.create(mdapi.new_deployment(
+            "down", "default", replicas=3, base_port=9200))
+        manager.run_sync()
+        md = store.get(API, "ModelDeployment", "down", "default")
+        md["spec"]["replicas"] = 1
+        store.update(md)
+        manager.run_sync()
+        assert store.try_get("v1", "Pod", "down-replica-0",
+                             "default") is not None
+        assert store.try_get("v1", "Pod", "down-replica-1",
+                             "default") is None
+        assert store.try_get("v1", "Pod", "down-replica-2",
+                             "default") is None
+
+    def test_autoscale_bumps_target_and_materializes(self, store,
+                                                     manager):
+        signals = {"value": (0.08, 6.0)}   # heavy queue wait
+        _deploy_manager(store, manager,
+                        signals_fn=lambda model: signals["value"])
+        store.create(mdapi.new_deployment(
+            "auto", "default", replicas=1, min_replicas=1,
+            max_replicas=3, base_port=9300, autoscale=True))
+        manager.run_sync()
+        pod = store.get("v1", "Pod", "auto-replica-0", "default")
+        pod["status"] = {"phase": "Running", "podIP": "127.0.0.1"}
+        store.update_status(pod)
+        manager.run_sync()
+        md = store.get(API, "ModelDeployment", "auto", "default")
+        assert md["status"]["targetReplicas"] == 2
+        assert md["status"]["lastScale"]["to"] == 2
+        manager.run_sync()    # target is acted on
+        assert store.try_get("v1", "Pod", "auto-replica-1",
+                             "default") is not None
+        # once the new replica runs and the pressure clears, the
+        # autoscaler holds (hysteresis band)
+        pod = store.get("v1", "Pod", "auto-replica-1", "default")
+        pod["status"] = {"phase": "Running", "podIP": "127.0.0.1"}
+        store.update_status(pod)
+        signals["value"] = (0.01, 2.0)
+        manager.run_sync()
+        md = store.get(API, "ModelDeployment", "auto", "default")
+        assert md["status"]["targetReplicas"] == 2
+
+    def test_disabling_autoscale_returns_control_to_spec(
+            self, store, manager):
+        """Review regression: a stale autoscaler target must not pin
+        the replica count after spec.autoscale is switched off."""
+        signals = {"value": (0.08, 6.0)}
+        _deploy_manager(store, manager,
+                        signals_fn=lambda model: signals["value"])
+        store.create(mdapi.new_deployment(
+            "pin", "default", replicas=1, min_replicas=1,
+            max_replicas=3, base_port=9400, autoscale=True))
+        manager.run_sync()
+        pod = store.get("v1", "Pod", "pin-replica-0", "default")
+        pod["status"] = {"phase": "Running", "podIP": "127.0.0.1"}
+        store.update_status(pod)
+        manager.run_sync()
+        md = store.get(API, "ModelDeployment", "pin", "default")
+        assert md["status"]["targetReplicas"] == 2
+        # operator pins capacity by hand: autoscale off, replicas 3
+        md["spec"]["autoscale"] = False
+        md["spec"]["replicas"] = 3
+        store.update(md)
+        manager.run_sync()
+        md = store.get(API, "ModelDeployment", "pin", "default")
+        assert "targetReplicas" not in md["status"]
+        assert md["status"]["replicas"] == 3
+        assert store.try_get("v1", "Pod", "pin-replica-2",
+                             "default") is not None
+
+
+def _replica_server(version):
+    server = serving.ModelServer()
+    server.register("m", lambda x: x * 2.0, version=version)
+    port = server.start(port=0, host="127.0.0.1", transport="async")
+    return server, port
+
+
+class TestRouterCore:
+    def test_pick_least_outstanding_skips_unroutable(self):
+        core = router_lib.RouterCore()
+        core.set_backends(["h:1", "h:2", "h:3"])
+        a, b, c = (core.replicas["h:1"], core.replicas["h:2"],
+                   core.replicas["h:3"])
+        a.outstanding, b.outstanding, c.outstanding = 3, 1, 0
+        c.draining = True
+        assert core.pick() is b
+        b.healthy = False
+        assert core.pick() is a
+        a.draining = True
+        assert core.pick() is None
+
+    def test_set_backends_reconciles_membership(self):
+        core = router_lib.RouterCore()
+        core.set_backends(["h:1", "h:2"])
+        core.set_backends(["h:2", "h:3"])
+        assert sorted(core.replicas) == ["h:2", "h:3"]
+
+    def test_set_backends_tolerates_malformed_endpoint(self):
+        """Review regression: one port-less endpoint must not poison
+        the membership sync (or kill the health poll loop)."""
+        core = router_lib.RouterCore()
+        core.set_backends(["10.0.0.1", "h:2", ":9", "junk:port"])
+        assert sorted(core.replicas) == ["h:2"]
+
+    def test_forward_retries_once_on_dead_replica(self):
+        server, port = _replica_server(version=1)
+        try:
+            core = router_lib.RouterCore(timeout=30)
+            # a dead endpoint and a live one: the dead pick must be
+            # marked unhealthy and the request must still succeed
+            core.set_backends(["127.0.0.1:1", f"127.0.0.1:{port}"])
+            # force the dead replica to be the deterministic first
+            # pick (strictly least outstanding)
+            core.replicas[f"127.0.0.1:{port}"].outstanding = 1
+            x = np.ones((1, 2), np.float32)
+            status, headers, body = core.forward(
+                "POST", "/v1/models/m:predict", x.tobytes(),
+                {"Content-Type": "application/x-tensor",
+                 "X-Tensor-Dtype": "float32",
+                 "X-Tensor-Shape": "1,2"})
+            assert status == 200
+            np.testing.assert_array_equal(
+                np.frombuffer(body, "<f4").reshape(1, 2), x * 2.0)
+            assert core.replicas["127.0.0.1:1"].healthy is False
+        finally:
+            core.stop()
+            server.stop()
+
+    def test_recovered_replica_reenters_rotation_admin_drain_sticky(
+            self):
+        """Review regression: the poll's draining verdict follows the
+        replica's OWN healthz report (a restarted replica answering
+        'ok' re-enters rotation), while an admin drain stays sticky
+        and can never be clobbered by a racing poll."""
+        server, port = _replica_server(version=1)
+        try:
+            core = router_lib.RouterCore(health_timeout=5)
+            endpoint = f"127.0.0.1:{port}"
+            core.set_backends([endpoint])
+            replica = core.replicas[endpoint]
+            # simulate a replica that reported draining before its
+            # container restarted on the same endpoint
+            replica.reported_draining = True
+            assert core.pick() is None
+            core.check_health_once()       # healthz now answers "ok"
+            assert replica.reported_draining is False
+            assert core.pick() is replica
+            # admin drain: the poll must NOT undo it
+            core.drain(endpoint, propagate=False)
+            core.check_health_once()
+            assert replica.drained is True
+            assert core.pick() is None
+        finally:
+            core.stop()
+            server.stop()
+
+    def test_health_poll_sees_draining_replica(self):
+        server, port = _replica_server(version=1)
+        try:
+            core = router_lib.RouterCore(health_timeout=5)
+            endpoint = f"127.0.0.1:{port}"
+            core.set_backends([endpoint])
+            core.check_health_once()
+            assert core.replicas[endpoint].healthy is True
+            assert core.pick() is not None
+            server.begin_drain()    # healthz flips to "draining"
+            core.check_health_once()
+            assert core.replicas[endpoint].draining is True
+            assert core.pick() is None
+        finally:
+            core.stop()
+            server.stop()
+
+
+class TestRouterApp:
+    def _stack(self):
+        """Two live replicas (different versions for attribution) and
+        the router app in front of them, driven via TestClient."""
+        s1, p1 = _replica_server(version=1)
+        s2, p2 = _replica_server(version=2)
+        core = router_lib.RouterCore(health_interval=600)
+        core.set_backends([f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"])
+        app = router_lib.create_app(core=core)
+        return (s1, p1), (s2, p2), core, TestClient(app)
+
+    def test_proxies_predicts_and_mirrors_tensor_headers(self):
+        (s1, _), (s2, _), core, client = self._stack()
+        try:
+            x = np.ones((1, 2), np.float32)
+            resp = client.post(
+                "/v1/models/m:predict", body=x.tobytes(),
+                headers={"Content-Type": "application/x-tensor",
+                         "X-Tensor-Dtype": "float32",
+                         "X-Tensor-Shape": "1,2"})
+            assert resp.status == 200
+            assert resp.headers["X-Tensor-Shape"] == "1,2"
+            assert resp.headers["X-Served-Version"] in ("1", "2")
+            np.testing.assert_array_equal(
+                np.frombuffer(resp.body, "<f4").reshape(1, 2),
+                x * 2.0)
+            replicas = client.get("/admin/replicas").json["replicas"]
+            assert len(replicas) == 2
+        finally:
+            core.stop()
+            s1.stop()
+            s2.stop()
+
+    def test_drain_routes_all_traffic_to_survivor(self):
+        (s1, p1), (s2, _), core, client = self._stack()
+        try:
+            resp = client.post(f"/admin/drain/127.0.0.1:{p1}")
+            assert resp.status == 200
+            versions = set()
+            x = np.ones((1, 2), np.float32)
+            for _ in range(6):
+                r = client.post(
+                    "/v1/models/m:predict", body=x.tobytes(),
+                    headers={"Content-Type": "application/x-tensor",
+                             "X-Tensor-Dtype": "float32",
+                             "X-Tensor-Shape": "1,2"})
+                assert r.status == 200
+                versions.add(r.headers["X-Served-Version"])
+            assert versions == {"2"}     # the drained replica got none
+            # and the drain PROPAGATED: the replica itself reports
+            # draining to any health poller
+            conn = http.client.HTTPConnection("127.0.0.1", p1,
+                                              timeout=10)
+            conn.request("GET", "/healthz")
+            payload = json.loads(conn.getresponse().read())
+            conn.close()
+            assert payload["status"] == "draining"
+        finally:
+            core.stop()
+            s1.stop()
+            s2.stop()
+
+    def test_no_replicas_is_503(self):
+        core = router_lib.RouterCore(health_interval=600)
+        app = router_lib.create_app(core=core)
+        client = TestClient(app)
+        try:
+            resp = client.post("/v1/models/m:predict",
+                               json_body={"instances": [[1.0]]})
+            assert resp.status == 503
+        finally:
+            core.stop()
+
+    def test_mid_load_drain_zero_5xx(self):
+        """The acceptance shape in-process: concurrent predicts while
+        one replica drains — every request answers 200."""
+        (s1, p1), (s2, _), core, client = self._stack()
+        try:
+            x = np.ones((2, 2), np.float32)
+            errors, statuses = [], []
+            lock = threading.Lock()
+
+            def worker():
+                try:
+                    for _ in range(10):
+                        r = client.post(
+                            "/v1/models/m:predict", body=x.tobytes(),
+                            headers={
+                                "Content-Type": "application/x-tensor",
+                                "X-Tensor-Dtype": "float32",
+                                "X-Tensor-Shape": "2,2"})
+                        with lock:
+                            statuses.append(r.status)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = [threading.Thread(target=worker)
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.02)
+            core.drain(f"127.0.0.1:{p1}")
+            for t in threads:
+                t.join(timeout=30)
+            assert not errors, errors
+            assert len(statuses) == 40
+            assert all(s == 200 for s in statuses), statuses
+        finally:
+            core.stop()
+            s1.stop()
+            s2.stop()
+
+
+class TestDeploymentCrdShapes:
+    def test_new_deployment_defaults(self):
+        md = mdapi.new_deployment("d", "ns")
+        assert md["spec"]["transport"] == "async"
+        assert md["spec"]["template"]["spec"]["containers"]
+        assert md["status"]["phase"] == "Pending"
+
+    def test_autoscale_defaults_headroom(self):
+        """Review regression: autoscale without maxReplicas would be
+        clamped to spec.replicas — a silent no-op — so the
+        constructor defaults headroom."""
+        md = mdapi.new_deployment("d", "ns", replicas=2,
+                                  autoscale=True)
+        assert md["spec"]["maxReplicas"] == 4
+        md = mdapi.new_deployment("d", "ns", replicas=1,
+                                  autoscale=True)
+        assert md["spec"]["maxReplicas"] == 2
+
+    def test_replica_port_contract(self):
+        assert mdapi.replica_port({"basePort": 9000}, 2) == 9002
+        assert mdapi.replica_port({}, 2) == mdapi.DEFAULT_PORT
+
+    @pytest.mark.parametrize("kwargs,key,value", [
+        (dict(min_replicas=2), "minReplicas", 2),
+        (dict(max_replicas=5), "maxReplicas", 5),
+        (dict(base_port=9000), "basePort", 9000),
+        (dict(autoscale=True), "autoscale", True),
+    ])
+    def test_optional_spec_fields(self, kwargs, key, value):
+        md = mdapi.new_deployment("d", "ns", **kwargs)
+        assert md["spec"][key] == value

@@ -16,7 +16,9 @@ thread surfaces immediately (no liveness poll).
 
 import http.client
 import json
+import socket
 import threading
+import time
 import urllib.request
 
 import jax
@@ -28,14 +30,22 @@ from kubeflow_tpu.compute.models import mlp
 from kubeflow_tpu.obs import metrics as obs_metrics
 
 
-def _mlp_server(name="m"):
+def _mlp_server(name="m", transport="threaded"):
     cfg = mlp.Config(in_dim=16, hidden=8, n_classes=4)
     params = mlp.init_params(cfg, jax.random.PRNGKey(0))
     server = serving.ModelServer()
     server.register(name, lambda x: jax.nn.softmax(
         mlp.apply(params, x, cfg), axis=-1))
-    port = server.start(port=0, host="127.0.0.1")
+    port = server.start(port=0, host="127.0.0.1", transport=transport)
     return server, port
+
+
+@pytest.fixture(params=["threaded", "async"])
+def transport(request):
+    """Both wire engines must satisfy the SAME conformance suite —
+    JSON, b64 and x-tensor responses byte-identical (the contract the
+    async event loop was required to keep, ISSUE 9)."""
+    return request.param
 
 
 class TestTensorCodec:
@@ -119,8 +129,8 @@ class TestOctetStreamRoute:
                 "X-Tensor-Dtype": str(x.dtype),
                 "X-Tensor-Shape": ",".join(str(d) for d in x.shape)}
 
-    def test_matches_json_path_bitwise(self):
-        server, port = _mlp_server()
+    def test_matches_json_path_bitwise(self, transport):
+        server, port = _mlp_server(transport=transport)
         try:
             x = np.random.default_rng(0).standard_normal(
                 (3, 16)).astype(np.float32)
@@ -145,8 +155,8 @@ class TestOctetStreamRoute:
         finally:
             server.stop()
 
-    def test_keepalive_held_across_raw_predicts(self):
-        server, port = _mlp_server()
+    def test_keepalive_held_across_raw_predicts(self, transport):
+        server, port = _mlp_server(transport=transport)
         try:
             x = np.zeros((2, 16), np.float32)
             conn = http.client.HTTPConnection("127.0.0.1", port)
@@ -161,8 +171,8 @@ class TestOctetStreamRoute:
         finally:
             server.stop()
 
-    def test_malformed_is_400_never_500(self):
-        server, port = _mlp_server()
+    def test_malformed_is_400_never_500(self, transport):
+        server, port = _mlp_server(transport=transport)
         try:
             x = np.zeros((2, 16), np.float32)
             good = self._headers(x)
@@ -183,14 +193,15 @@ class TestOctetStreamRoute:
         finally:
             server.stop()
 
-    def test_inference_failure_stays_500(self):
+    def test_inference_failure_stays_500(self, transport):
         server = serving.ModelServer()
 
         def boom(x):
             raise RuntimeError("device fell over")
 
         server.register("b", boom)
-        port = server.start(port=0, host="127.0.0.1")
+        port = server.start(port=0, host="127.0.0.1",
+                            transport=transport)
         try:
             x = np.zeros((1, 2), np.float32)
             resp, data = self._raw_post(port, x.tobytes(),
@@ -227,13 +238,14 @@ class TestJsonConformance:
     JSON responses must be BYTE-identical to the pre-optimization
     serving path (tier-1 gate for every future serving PR)."""
 
-    def _server(self):
+    def _server(self, transport="threaded"):
         server = serving.ModelServer()
         server.register("c", lambda x: x * 2.0)
-        return server, server.start(port=0, host="127.0.0.1")
+        return server, server.start(port=0, host="127.0.0.1",
+                                    transport=transport)
 
-    def test_instances_response_bytes_exact(self):
-        server, port = self._server()
+    def test_instances_response_bytes_exact(self, transport):
+        server, port = self._server(transport)
         try:
             req = urllib.request.Request(
                 f"http://127.0.0.1:{port}/v1/models/c:predict",
@@ -248,9 +260,9 @@ class TestJsonConformance:
         finally:
             server.stop()
 
-    def test_tensor_response_bytes_exact(self):
+    def test_tensor_response_bytes_exact(self, transport):
         import base64
-        server, port = self._server()
+        server, port = self._server(transport)
         try:
             x = np.asarray([[1.0, 2.5]], np.float32)
             req = urllib.request.Request(
@@ -447,6 +459,427 @@ class TestBatcherLifecycle:
         server.stop()
         canary._batcher.thread.join(timeout=5)
         assert not canary._batcher.thread.is_alive()
+
+
+def _raw_predict_bytes(name, x):
+    """One full x-tensor predict request as raw socket bytes."""
+    body = x.tobytes()
+    head = (f"POST /v1/models/{name}:predict HTTP/1.1\r\n"
+            f"Host: t\r\n"
+            f"Content-Type: application/x-tensor\r\n"
+            f"X-Tensor-Dtype: {x.dtype}\r\n"
+            f"X-Tensor-Shape: {','.join(str(d) for d in x.shape)}\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n")
+    return head.encode() + body
+
+
+class _RawHttpReader:
+    """Minimal blocking response reader for raw-socket tests. Keeps a
+    buffer across reads: with pipelined requests both responses can
+    land in ONE recv, and a reader that discards bytes past the first
+    Content-Length would hang waiting for a response it already
+    swallowed."""
+
+    def __init__(self, sock, timeout=30):
+        self.sock = sock
+        self.buf = b""
+        sock.settimeout(timeout)
+
+    def read_response(self):
+        """→ (status, body_bytes, closed)."""
+        while b"\r\n\r\n" not in self.buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                return None, self.buf, True
+            self.buf += chunk
+        head, _, rest = self.buf.partition(b"\r\n\r\n")
+        status = int(head.split(b" ", 2)[1])
+        length = 0
+        for line in head.split(b"\r\n")[1:]:
+            k, _, v = line.partition(b":")
+            if k.lower() == b"content-length":
+                length = int(v.strip())
+        while len(rest) < length:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                return status, rest, True
+            rest += chunk
+        body, self.buf = rest[:length], rest[length:]
+        closed = b"connection: close" in head.lower()
+        return status, body, closed
+
+
+def _read_http_response(sock, timeout=30):
+    """One-shot wrapper for single-response call sites."""
+    return _RawHttpReader(sock, timeout=timeout).read_response()
+
+
+class TestSharedFraming:
+    """Satellite: the body-framing contract (web.http.framed_body_
+    length) is ONE definition for every transport — chunked bodies are
+    411, other transfer encodings 501, POSTs without Content-Length
+    411 — instead of hanging or desyncing the keep-alive parse."""
+
+    def _raw(self, port, request_bytes):
+        s = socket.create_connection(("127.0.0.1", port), timeout=30)
+        try:
+            s.sendall(request_bytes)
+            return _read_http_response(s)
+        finally:
+            s.close()
+
+    def test_chunked_body_is_411(self, transport):
+        server, port = _mlp_server(transport=transport)
+        try:
+            status, body, _ = self._raw(
+                port,
+                b"POST /v1/models/m:predict HTTP/1.1\r\nHost: t\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+                b"2\r\n{}\r\n0\r\n\r\n")
+            assert status == 411, body
+            assert b"chunked" in body
+        finally:
+            server.stop()
+
+    def test_other_transfer_encoding_is_501(self, transport):
+        server, port = _mlp_server(transport=transport)
+        try:
+            status, body, _ = self._raw(
+                port,
+                b"POST /v1/models/m:predict HTTP/1.1\r\nHost: t\r\n"
+                b"Transfer-Encoding: gzip\r\n\r\n")
+            assert status == 501, body
+        finally:
+            server.stop()
+
+    def test_post_without_content_length_is_411(self, transport):
+        server, port = _mlp_server(transport=transport)
+        try:
+            status, body, _ = self._raw(
+                port,
+                b"POST /v1/models/m:predict HTTP/1.1\r\nHost: t\r\n"
+                b"Content-Type: application/json\r\n\r\n")
+            assert status == 411, body
+            assert b"Content-Length" in body
+        finally:
+            server.stop()
+
+    def test_admin_drain_without_length_is_411_on_both(self,
+                                                       transport):
+        """Review regression: the drain endpoint must answer
+        identically per transport — a runbook `curl -X POST` (no
+        Content-Length) gets the same 411 everywhere, and does NOT
+        half-drain one flavor of deployment."""
+        server, port = _mlp_server(transport=transport)
+        try:
+            status, body, _ = self._raw(
+                port, b"POST /admin/drain HTTP/1.1\r\nHost: t\r\n\r\n")
+            assert status == 411, body
+            assert server.draining is False
+        finally:
+            server.stop()
+
+    def test_drain_with_body_keeps_keepalive_parseable(self,
+                                                       transport):
+        """Review regression: the threaded drain must CONSUME its
+        request body — an unread body desyncs the keep-alive socket
+        (the next request would parse '{}' as a request line). Both
+        transports also agree that a query string on the admin path
+        still routes."""
+        server, port = _mlp_server(transport=transport)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=10)
+            conn.request("POST", "/admin/drain?note=rollout", b"{}",
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            payload = json.loads(resp.read())
+            assert resp.status == 200
+            assert payload["status"] == "draining"
+            assert server.draining
+            if not resp.will_close:
+                # the SAME socket must still parse the next request
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                assert resp.status == 200
+                resp.read()
+            conn.close()
+        finally:
+            server.stop()
+
+    def test_oversized_content_length_is_413_not_preallocated(
+            self, transport, monkeypatch):
+        """Review regression: a forged Content-Length must be refused
+        at head-parse time (413) — the async transport sizes its
+        zero-copy landing buffer from this number, so an unchecked
+        value is a zero-byte memory-exhaustion vector."""
+        monkeypatch.setenv("HTTP_MAX_BODY_BYTES", str(1 << 20))
+        server, port = _mlp_server(transport=transport)
+        try:
+            # shape×dtype agrees with Content-Length (16 MiB), so only
+            # the body cap can refuse it
+            status, body, _ = self._raw(
+                port,
+                b"POST /v1/models/m:predict HTTP/1.1\r\nHost: t\r\n"
+                b"Content-Type: application/x-tensor\r\n"
+                b"X-Tensor-Dtype: float32\r\n"
+                b"X-Tensor-Shape: 1048576,4\r\n"
+                b"Content-Length: 16777216\r\n\r\n")
+            assert status == 413, body
+            assert b"HTTP_MAX_BODY_BYTES" in body
+        finally:
+            server.stop()
+
+    def test_get_with_framed_body_keeps_keepalive_parseable(
+            self, transport):
+        """Review regression: a GET carrying a Content-Length body
+        (curl -X GET -d ...) must have its body consumed on both
+        transports, or the keep-alive connection desyncs."""
+        server, port = _mlp_server(transport=transport)
+        try:
+            s = socket.create_connection(("127.0.0.1", port),
+                                         timeout=30)
+            s.sendall(b"GET /healthz HTTP/1.1\r\nHost: t\r\n"
+                      b"Content-Length: 5\r\n\r\nhello"
+                      b"GET /healthz HTTP/1.1\r\nHost: t\r\n\r\n")
+            reader = _RawHttpReader(s)
+            status1, body1, closed = reader.read_response()
+            assert status1 == 200, body1
+            if not closed:
+                status2, body2, _ = reader.read_response()
+                assert status2 == 200, body2
+            s.close()
+        finally:
+            server.stop()
+
+    def test_web_app_serve_shares_the_contract(self):
+        """The web tier's socket server rejects chunked bodies with
+        the same 411 instead of silently misparsing them as empty."""
+        from kubeflow_tpu.web.http import App
+        app = App("framing-test")
+
+        @app.post("/echo")
+        def echo(request):
+            return {"n": len(request.body)}
+
+        httpd = app.serve(port=0, host="127.0.0.1")
+        try:
+            port = httpd.server_address[1]
+            status, body, _ = self._raw(
+                port,
+                b"POST /echo HTTP/1.1\r\nHost: t\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n0\r\n\r\n")
+            assert status == 411, body
+        finally:
+            httpd.shutdown()
+
+
+class TestAsyncTransport:
+    """Event-loop-only semantics: pipelining, slow-loris isolation,
+    mid-flight drain, predictStream refusal."""
+
+    def test_pipelined_requests_one_socket(self):
+        server, port = _mlp_server(name="pipe", transport="async")
+        try:
+            x = np.random.default_rng(0).standard_normal(
+                (2, 16)).astype(np.float32)
+            req = _raw_predict_bytes("pipe", x)
+            s = socket.create_connection(("127.0.0.1", port),
+                                         timeout=30)
+            s.sendall(req + req + req)    # three requests, one write
+            reader = _RawHttpReader(s)
+            bodies = []
+            for _ in range(3):
+                status, body, closed = reader.read_response()
+                assert status == 200
+                assert not closed
+                bodies.append(body)
+            s.close()
+            first = np.frombuffer(bodies[0], "<f4")
+            for body in bodies[1:]:
+                np.testing.assert_array_equal(
+                    np.frombuffer(body, "<f4"), first)
+        finally:
+            server.stop()
+
+    def test_slow_loris_does_not_block_other_connections(self):
+        server, port = _mlp_server(name="loris", transport="async")
+        try:
+            # a client trickling half a request head...
+            slow = socket.create_connection(("127.0.0.1", port),
+                                            timeout=30)
+            slow.sendall(b"POST /v1/models/loris:predict HTTP/1.1\r\n"
+                         b"Host: t\r\nContent-Ty")
+            # ...must not stall anyone else (the threaded transport
+            # parks a whole worker thread on it; the loop parks a
+            # buffer)
+            x = np.zeros((1, 16), np.float32)
+            t0 = time.monotonic()
+            fast = socket.create_connection(("127.0.0.1", port),
+                                            timeout=30)
+            fast.sendall(_raw_predict_bytes("loris", x))
+            status, _body, _ = _read_http_response(fast)
+            fast.close()
+            assert status == 200
+            assert time.monotonic() - t0 < 10
+            # the slow client can still finish its request afterwards
+            body = x.tobytes()
+            slow.sendall(
+                (f"pe: application/x-tensor\r\n"
+                 f"X-Tensor-Dtype: float32\r\n"
+                 f"X-Tensor-Shape: 1,16\r\n"
+                 f"Content-Length: {len(body)}\r\n\r\n").encode()
+                + body)
+            status, _body, _ = _read_http_response(slow)
+            slow.close()
+            assert status == 200
+        finally:
+            server.stop()
+
+    def test_drain_completes_inflight_soft(self):
+        """ISSUE 9 acceptance shape: draining mid-load finishes
+        in-flight requests (zero 5xx from the drain itself), closes
+        their keep-alive connections, and keeps answering health
+        probes with ``draining`` so the router takes it out of
+        rotation."""
+        class SlowModel(serving.ServedModel):
+            def dispatch(self, x):
+                x = np.asarray(x)
+                done = threading.Event()
+                box = {}
+
+                def run():
+                    time.sleep(0.5)
+                    box["y"] = x * 2.0
+                    done.set()
+
+                threading.Thread(target=run, daemon=True).start()
+                return (done, box), x.shape[0]
+
+            @staticmethod
+            def finalize(fut, n):
+                done, box = fut
+                done.wait()
+                return box["y"][:n]
+
+        server = serving.ModelServer()
+        server._models["slow"] = SlowModel("slow", lambda x: x)
+        port = server.start(port=0, host="127.0.0.1",
+                            transport="async")
+        try:
+            x = np.ones((1, 4), np.float32)
+            results = {}
+
+            def inflight():
+                s = socket.create_connection(("127.0.0.1", port),
+                                             timeout=30)
+                s.sendall(_raw_predict_bytes("slow", x))
+                results["resp"] = _read_http_response(s)
+                s.close()
+
+            t = threading.Thread(target=inflight)
+            t.start()
+            time.sleep(0.15)        # request is on the fake device
+            admin = http.client.HTTPConnection("127.0.0.1", port,
+                                               timeout=30)
+            admin.request("POST", "/admin/drain", b"{}",
+                          {"Content-Type": "application/json"})
+            resp = admin.getresponse()
+            assert resp.status == 200
+            assert json.loads(resp.read())["status"] == "draining"
+            t.join(timeout=10)
+            status, body, closed = results["resp"]
+            # the in-flight predict finished 200 — and the connection
+            # closed afterwards (drain reaps keep-alive)
+            assert status == 200, body
+            np.testing.assert_array_equal(
+                np.frombuffer(body, "<f4").reshape(1, 4), x * 2.0)
+            assert closed
+            # health probes still reach the drained server and see
+            # the draining state (the router's stop-routing signal)
+            probe = http.client.HTTPConnection("127.0.0.1", port,
+                                               timeout=5)
+            probe.request("GET", "/healthz")
+            resp = probe.getresponse()
+            payload = json.loads(resp.read())
+            probe.close()
+            assert resp.status == 200
+            assert payload["status"] == "draining"
+        finally:
+            server.stop()
+
+    def test_malformed_target_costs_one_connection_not_the_loop(self):
+        """Review regression: a request line urlsplit chokes on (bad
+        IPv6 bracket) must 400 that connection — the event loop and
+        every other connection keep serving."""
+        server, port = _mlp_server(name="bt", transport="async")
+        try:
+            s = socket.create_connection(("127.0.0.1", port),
+                                         timeout=10)
+            s.sendall(b"GET http://[ HTTP/1.1\r\nHost: t\r\n\r\n")
+            status, _body, _ = _read_http_response(s)
+            s.close()
+            assert status == 400
+            # the loop survived: fresh connections still serve
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=10)
+            conn.request("GET", "/healthz")
+            assert conn.getresponse().status == 200
+            conn.close()
+        finally:
+            server.stop()
+
+    def test_predict_stream_answers_501_with_pointer(self):
+        server, port = _mlp_server(name="st", transport="async")
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30)
+            conn.request("POST", "/v1/models/st:predictStream",
+                         b"{}", {"Content-Type":
+                                 "application/x-ndjson"})
+            resp = conn.getresponse()
+            body = resp.read()
+            assert resp.status == 501
+            assert b"threaded" in body
+            conn.close()
+        finally:
+            server.stop()
+
+    def test_zero_copy_decode_aliases_request_buffer(self):
+        """The x-tensor body must reach the model WITHOUT a copy:
+        np.frombuffer over the transport's preallocated read buffer."""
+        seen = {}
+
+        class Capture(serving.ServedModel):
+            def dispatch(self, x):
+                seen["x"] = x
+                return np.asarray(x), x.shape[0]
+
+        server = serving.ModelServer()
+        server._models["zc"] = Capture("zc", lambda x: x,
+                                       batching=False)
+        port = server.start(port=0, host="127.0.0.1",
+                            transport="async")
+        try:
+            x = np.arange(8, dtype=np.float32).reshape(2, 4)
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30)
+            conn.request("POST", "/v1/models/zc:predict", x.tobytes(),
+                         {"Content-Type": "application/x-tensor",
+                          "X-Tensor-Dtype": "float32",
+                          "X-Tensor-Shape": "2,4"})
+            resp = conn.getresponse()
+            resp.read()
+            conn.close()
+            assert resp.status == 200
+            got = seen["x"]
+            # frombuffer over the transport's bytearray: not writable,
+            # zero-copy (owns no data, base is the read buffer)
+            assert got.base is not None
+            assert not got.flags["OWNDATA"]
+        finally:
+            server.stop()
 
 
 class TestBatcherDeath:
