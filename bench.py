@@ -27,6 +27,7 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # Persistent compilation cache: the study mode is compile-dominated at
 # toy-trial scale (BASELINE.md r2 361-vs-1030 trials/hr note was pure
@@ -607,6 +608,115 @@ def bench_serving(steps, batch):
                            max_prob_delta, 5)}}
 
 
+def bench_generate(steps, batch):
+    """Generation-engine throughput (compute/generate.py): prefill/
+    decode split + token-level continuous batching, measured against
+    the two baselines the design claims to beat.
+
+    Three phases over the SAME mixed-length prompt set (long
+    stragglers deliberately interleaved with short prompts):
+
+    - **sequential**: one prompt at a time through the engine — the
+      no-batching floor for tokens/sec,
+    - **continuous** (headline): all prompts queued at once,
+      token-level admission — finished sequences evict MID-BATCH and
+      queued prompts take their slots on the next step,
+    - **drain-refill**: identical engine geometry with
+      ``admission="drain"`` — a batch must fully drain before new
+      prompts admit (classic static batching), which is what the
+      continuous policy's slot occupancy is judged against.
+
+    Acceptance (ISSUE 10): continuous occupancy >= 1.5x drain-refill
+    AND continuous tokens/sec >= 1.5x sequential; greedy conformance
+    vs the full-context oracle is asserted on a sample in-run."""
+    from kubeflow_tpu.compute import generate as gen_lib
+
+    cfg = transformer.Config(
+        vocab_size=512, d_model=128, n_layers=4, n_heads=4,
+        max_seq=256, dtype="bfloat16", attention="dense", remat=False,
+        scan_layers=True)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    slots = max(2, batch)
+    # mixed lengths across serving.bucket_for buckets; max_tokens
+    # spread so drain-refill strands slots behind its longest member
+    prompt_specs = []
+    rng = np.random.default_rng(0)
+    for i in range(3 * slots):
+        plen = (4, 12, 24, 60)[i % 4]
+        m = (int(steps) + 12, 6, 8, 6)[i % 4]
+        # BENCH_STEPS is a shared knob sized for the train benches: a
+        # big value must lengthen the stragglers, not overflow the
+        # engine's max_context and fail the submit
+        m = min(m, cfg.max_seq - plen)
+        prompt_specs.append(
+            ([int(t) for t in rng.integers(1, cfg.vocab_size, plen)],
+             m))
+
+    def run(engine, concurrent):
+        s0 = dict(engine.stats)
+        t0 = time.perf_counter()
+        if concurrent:
+            handles = [engine.submit(p, max_tokens=m)
+                       for p, m in prompt_specs]
+            outs = [h.result(timeout=600)[0] for h in handles]
+        else:
+            outs = [engine.generate(p, max_tokens=m)[0]
+                    for p, m in prompt_specs]
+        dt = time.perf_counter() - t0
+        tokens = sum(len(o) for o in outs)
+        d_steps = engine.stats["decode_steps"] - s0["decode_steps"]
+        d_slots = engine.stats["decode_token_slots"] \
+            - s0["decode_token_slots"]
+        occupancy = d_slots / d_steps if d_steps else 0.0
+        return outs, tokens / dt, occupancy
+
+    engine = gen_lib.GenerationEngine(
+        params, cfg, max_slots=slots, block_size=16, name="bench")
+    # warm every prefill bucket + the decode program OUTSIDE the timed
+    # runs (the serving bench warms its buckets the same way)
+    for plen in sorted({len(p) for p, _ in prompt_specs}):
+        engine.generate(list(range(1, plen + 1)), max_tokens=2)
+    outs_seq, tps_seq, _ = run(engine, concurrent=False)
+    outs_cont, tps_cont, occ_cont = run(engine, concurrent=True)
+
+    drain_engine = gen_lib.GenerationEngine(
+        params, cfg, max_slots=slots, block_size=16,
+        admission="drain", name="bench-drain")
+    drain_engine.generate([1, 2, 3], max_tokens=2)    # warm
+    outs_drain, tps_drain, occ_drain = run(drain_engine,
+                                           concurrent=True)
+    engine.close()
+    drain_engine.close()
+
+    # conformance spot-check: batched greedy == full-context oracle
+    sample = prompt_specs[1]
+    ref = gen_lib.reference_greedy_decode(params, cfg, sample[0],
+                                          sample[1])
+    conforms = (outs_cont[1] == ref and outs_seq[1] == ref
+                and outs_drain[1] == ref)
+
+    vs_sequential = tps_cont / tps_seq if tps_seq else 0.0
+    vs_drain = occ_cont / occ_drain if occ_drain else 0.0
+    return {"metric": "generate_tokens_per_sec",
+            "value": round(tps_cont, 1), "unit": "tokens/sec",
+            "vs_sequential": round(vs_sequential, 2),
+            "detail": {
+                "slots": slots, "prompts": len(prompt_specs),
+                "sequential_tokens_per_sec": round(tps_seq, 1),
+                "drain_refill_tokens_per_sec": round(tps_drain, 1),
+                "occupancy_continuous": round(occ_cont, 2),
+                "occupancy_drain_refill": round(occ_drain, 2),
+                "occupancy_vs_drain_refill": round(vs_drain, 2),
+                "greedy_matches_full_recompute": conforms,
+                "checks": {
+                    "tokens_per_sec_vs_sequential_ge_1.5":
+                        vs_sequential >= 1.5,
+                    "occupancy_vs_drain_refill_ge_1.5":
+                        vs_drain >= 1.5,
+                    "greedy_matches_full_recompute": conforms,
+                }}}
+
+
 def bench_study(steps, batch):
     """BASELINE config #4: StudyJob trial throughput, one trial per chip
     (this host has one chip; trials/hr scales linearly per chip).
@@ -740,13 +850,14 @@ BENCHES = {
     "lm": (bench_lm, 16),
     "bert": (bench_bert, 16),
     "serving": (bench_serving, 1),
+    "generate": (bench_generate, 4),
     "study": (bench_study, 8),
 }
 
 
 # default-run order: headline resnet50 LAST (single-line consumers
 # read the final line)
-ALL_ORDER = ["lm", "bert", "serving", "study", "resnet50"]
+ALL_ORDER = ["lm", "bert", "serving", "generate", "study", "resnet50"]
 
 
 def main():

@@ -43,6 +43,7 @@ def main():
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     # import side effects register each layer's module-level families
+    import kubeflow_tpu.compute.generate      # noqa: F401
     import kubeflow_tpu.compute.serving       # noqa: F401
     import kubeflow_tpu.compute.serving_async  # noqa: F401
     import kubeflow_tpu.compute.sweep         # noqa: F401
@@ -115,6 +116,18 @@ def main():
         "router_replica_healthy",
         "router_outstanding_requests",
         "router_autoscale_decisions_total",
+        # generation serving surface (ISSUE 10): the KV-cache engine's
+        # token/occupancy/latency families are what bench.py's
+        # generate mode and loadtest/generation_serving.py read, and
+        # what docs/observability.md § Generation serving promises
+        "serving_generate_tokens_total",
+        "serving_generate_prefill_seconds",
+        "serving_generate_decode_step_seconds",
+        "serving_generate_queue_wait_seconds",
+        "serving_generate_slot_occupancy_slots",
+        "serving_generate_evictions_total",
+        # sweep-pod failure re-packing (ROADMAP PR 5 follow-up)
+        "sweep_repack_total",
     }
     registered = {metric.name for metric in obs_metrics.REGISTRY._metrics}
     scratch_names = {metric.name for metric in scratch._metrics}

@@ -248,7 +248,35 @@ def model_server(argv=()):
     name = os.environ.get("MODEL_NAME", "default")
     module = os.environ.get("MODEL_MODULE", "")
     device_ms = float(os.environ.get("MODEL_DEVICE_MS", "0") or 0)
-    if module:
+    if os.environ.get("MODEL_GENERATE", "").lower() in (
+            "1", "true", "yes", "on"):
+        # generation replica: a stock TransformerLM behind the
+        # :generate verb (paged KV-cache engine, token-streaming) —
+        # what loadtest/generation_serving.py drives end to end. The
+        # GEN_* knobs size the model/engine; real deployments use
+        # MODEL_MODULE to register their own engine.
+        import jax
+
+        from ..compute import generate as gen_lib
+        from ..compute.models import transformer
+        cfg = transformer.Config(
+            vocab_size=int(os.environ.get("GEN_VOCAB", "512")),
+            d_model=int(os.environ.get("GEN_D_MODEL", "128")),
+            n_layers=int(os.environ.get("GEN_LAYERS", "2")),
+            n_heads=int(os.environ.get("GEN_HEADS", "4")),
+            max_seq=int(os.environ.get("GEN_MAX_CONTEXT", "256")),
+            dtype=os.environ.get("GEN_DTYPE", "float32"),
+            attention="dense", remat=False, scan_layers=True)
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        engine = gen_lib.GenerationEngine(
+            params, cfg,
+            max_slots=int(os.environ.get("GEN_SLOTS", "4")),
+            block_size=int(os.environ.get("GEN_BLOCK_SIZE", "16")),
+            kv_dtype=os.environ.get("GEN_KV_DTYPE") or None,
+            admission=os.environ.get("GEN_ADMISSION", "continuous"),
+            name=name)
+        server.register_generator(name, engine)
+    elif module:
         importlib.import_module(module).register(server)
     elif device_ms > 0:
         # deterministic fake device for load/scale testing: each

@@ -47,6 +47,31 @@ def dense_attention(q, k, v, causal=True, scale=None, q_offset=0,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def decode_attention(q, k, v, lengths, scale=None):
+    """Single-position attention over a gathered (padded) KV cache —
+    the decode-step read of the generation engine (compute/generate.py).
+
+    ``q`` is the one new token per sequence, [B, 1, H, D]; ``k``/``v``
+    are the cache pages gathered back into logical order and padded to
+    a static length, [B, T, H, D]; ``lengths`` [B] is the number of
+    VALID cache positions per sequence (the query attends to
+    ``k_pos < lengths[b]`` — the just-written own token included).
+
+    Numerics deliberately mirror :func:`dense_attention` op for op
+    (same einsum contractions, fp32 softmax, probs cast to ``v.dtype``)
+    so greedy decode through the cache is token-identical to a
+    full-context recompute: the masked tail pads the contraction with
+    exact zeros, which cannot perturb the valid positions."""
+    q = _scale(q, scale)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    k_pos = jnp.arange(k.shape[1])[None, None, None, :]
+    logits = jnp.where(k_pos < lengths[:, None, None, None],
+                       logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
 def _block(carry, kv, q, q_offset, k_offset, causal, scale):
     """One blockwise-softmax accumulation step (fp32 state)."""
     o, m, l = carry

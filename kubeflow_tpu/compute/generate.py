@@ -1,0 +1,878 @@
+"""KV-cache generation engine: prefill/decode split + token-level
+continuous batching for autoregressive serving.
+
+The serving plane (PRs 3/8/9) answers stateless unary predicts; this
+module is the LLM-inference rung ROADMAP calls "the single biggest
+scenario unlock toward heavy-traffic serving": greedy autoregressive
+decode from the TransformerLM with a persistent, PAGED KV-cache.
+
+Architecture (the Gemma-on-Cloud-TPU serving shape from PAPERS.md,
+built on this repo's own kernels):
+
+- **Paged KV-cache**: one fixed pool of ``num_blocks`` cache blocks of
+  ``block_size`` tokens each, shared by every sequence. A sequence
+  holds a *block table* (logical block index → physical block id);
+  blocks are allocated as the sequence grows and returned to the free
+  list on eviction — no per-sequence max-context reservation of
+  contiguous HBM. Admission reserves (but does not allocate) the
+  worst-case block count so a running sequence can never hit a
+  mid-flight allocation failure.
+- **Prefill/decode split**: a jitted prefill program per prompt-length
+  bucket (``serving.bucket_for`` — the platform's ONE bucketing
+  policy) runs the full causal forward over the padded prompt, writes
+  every layer's K/V into the sequence's cache blocks and emits the
+  first generated token; a single jitted decode program then advances
+  ALL occupied slots one token per call — compute per step is
+  O(occupied · 1 token), not O(context).
+- **Token-level continuous batching**: the decode batch never drains
+  to run one straggler. After every step, finished sequences (EOS,
+  ``max_tokens``, expired deadline, cancel) are evicted MID-BATCH,
+  their blocks return to the pool, and queued prompts are admitted
+  into the freed slots before the next step — the Podracer "one
+  resident program, many logical workers" shape applied to decode.
+- **Optional int8 KV** (``kv_dtype="int8"``): cache blocks store int8
+  + per-(position, head) float32 scales (``quantize.kv_quantize``, the
+  traceable twin of the weight path's ``quantize_array``); the decode
+  step dequantizes INSIDE the attention read
+  (``quantize.kv_dequantize``), so the cache's HBM footprint and
+  read bandwidth drop ~2× vs bf16 at a bounded accuracy cost.
+
+Numerics contract: greedy decode through the cache is token-identical
+to a full-context ``transformer.apply`` recompute of the same prompt
+(fp32 and bf16) — the engine mirrors the model's ops exactly
+(``attention.decode_attention`` documents why the padded cache tail
+cannot perturb valid positions); ``tests/test_compute_generate.py``
+pins it, including across a mid-batch eviction/admission boundary.
+
+The engine surfaces as the ``:generate`` verb on ModelServer (both
+transports — compute/serving.py, compute/serving_async.py), streaming
+tokens incrementally as chunked NDJSON.
+"""
+
+import collections
+import logging
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..obs import metrics as obs_metrics
+from . import attention as attn_lib
+from . import quantize as quantize_lib
+from . import serving as serving_lib
+from . import sharding
+from .models import transformer
+
+log = logging.getLogger("kubeflow_tpu.generate")
+
+# the serving_generate_* obs surface (docs/observability.md;
+# ci/metrics_lint.py requires every family here)
+_TOKENS_TOTAL = obs_metrics.REGISTRY.counter(
+    "serving_generate_tokens_total",
+    "Generated tokens emitted (prefill first-tokens + decode steps) — "
+    "rate() of this is the engine's tokens/sec",
+    ("model",))
+_PREFILL_SECONDS = obs_metrics.REGISTRY.histogram(
+    "serving_generate_prefill_seconds",
+    "One prefill program call (padded prompt forward + cache fill + "
+    "first token), by prompt-length bucket economics",
+    ("model",),
+    buckets=(1e-3, 5e-3, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0))
+_DECODE_STEP_SECONDS = obs_metrics.REGISTRY.histogram(
+    "serving_generate_decode_step_seconds",
+    "One decode step advancing every occupied slot by one token",
+    ("model",),
+    buckets=(1e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 0.01, 0.025, 0.05, 0.1,
+             0.5, 1.0))
+_QUEUE_WAIT_SECONDS = obs_metrics.REGISTRY.histogram(
+    "serving_generate_queue_wait_seconds",
+    "Time a prompt waited in the admission queue before its prefill "
+    "launched (slot or block-pool pressure shows up here)",
+    ("model",),
+    buckets=(1e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0))
+_SLOT_OCCUPANCY = obs_metrics.REGISTRY.histogram(
+    "serving_generate_slot_occupancy_slots",
+    "Occupied decode slots per decode step — the continuous-batching "
+    "win is this distribution's mass near max_slots under mixed-"
+    "length concurrent load (a drain-then-refill policy decays to 1)",
+    ("model",),
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32))
+_EVICTIONS_TOTAL = obs_metrics.REGISTRY.counter(
+    "serving_generate_evictions_total",
+    "Decode-slot evictions by reason (eos | length | deadline | "
+    "draining | cancelled | error) — mid-batch eviction is the "
+    "mechanism of token-level continuous batching, so eos/length here "
+    "are normal completions, not failures",
+    ("model", "reason"))
+
+
+class GenerationHandle:
+    """One submitted prompt's lifecycle: the engine appends generated
+    tokens and fires the callbacks from ITS thread (transports hand
+    off to their own); ``wait()``/``result()`` serve blocking callers
+    (bench, tests, the convenience :meth:`GenerationEngine.generate`).
+    """
+
+    __slots__ = ("prompt", "max_tokens", "eos_id", "deadline",
+                 "on_token", "on_done", "rt", "out_tokens", "reason",
+                 "error", "cancelled", "cancel_reason", "enqueued",
+                 "enqueued_w", "_done")
+
+    def __init__(self, prompt, max_tokens, eos_id, deadline,
+                 on_token, on_done, rt):
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.eos_id = eos_id
+        self.deadline = deadline
+        self.on_token = on_token
+        self.on_done = on_done
+        self.rt = rt
+        self.out_tokens = []
+        self.reason = None        # eos|length|deadline|draining|...
+        self.error = None         # set when the finish is an error the
+        self.cancelled = False    # transport should map to a status
+        self.cancel_reason = "cancelled"
+        self.enqueued = time.perf_counter()
+        self.enqueued_w = time.time()
+        self._done = threading.Event()
+
+    def wait(self, timeout=None):
+        return self._done.wait(timeout)
+
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        """→ ``(generated_tokens, finish_reason)``; raises the finish
+        error when the request failed before emitting any token."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation did not finish in time")
+        if self.error is not None and not self.out_tokens:
+            raise self.error
+        return list(self.out_tokens), self.reason
+
+
+class _Slot:
+    """One occupied decode slot (engine-thread-only state)."""
+
+    __slots__ = ("handle", "blocks", "length", "last_token", "reserve",
+                 "decode_start_w")
+
+    def __init__(self, handle, blocks, length, last_token, reserve):
+        self.handle = handle
+        self.blocks = blocks       # physical block ids, logical order
+        self.length = length       # tokens whose K/V are in cache
+        self.last_token = last_token   # next decode step's input
+        self.reserve = reserve     # worst-case total blocks admitted at
+        self.decode_start_w = time.time()
+
+
+class GenerationEngine:
+    """Autoregressive decode server for one TransformerLM.
+
+    ``params``/``config`` are the model (``transformer.init_params``
+    layout; scan and non-scan layer layouts both accepted — non-scan
+    lists are stacked at init). Knobs:
+
+    - ``max_slots``: decode-batch width (resident sequences),
+    - ``block_size`` / ``num_blocks``: KV-cache paging geometry
+      (default pool = every slot at full ``max_context``),
+    - ``max_context``: prompt + generated ceiling per sequence,
+    - ``kv_dtype``: ``None`` (model compute dtype) or ``"int8"``,
+    - ``eos_id``: default stop token (per-request override),
+    - ``admission``: ``"continuous"`` (token-level continuous
+      batching, the default) or ``"drain"`` (drain-then-refill — only
+      admit into an EMPTY batch; exists as the bench baseline the
+      continuous policy is measured against).
+
+    Threading: ONE engine thread owns every device call and all slot
+    state; ``submit``/``cancel``/``begin_drain`` are thread-safe and
+    cheap. Callbacks (``on_token``/``on_done``) fire on the engine
+    thread and must not block (the transports enqueue and return).
+    """
+
+    def __init__(self, params, config, *, max_slots=4, block_size=16,
+                 max_context=None, num_blocks=None, kv_dtype=None,
+                 name="model", version=1, eos_id=None,
+                 default_max_tokens=64, admission="continuous"):
+        if config.moe_experts or config.pipeline_stages > 1:
+            raise ValueError(
+                "GenerationEngine supports dense TransformerLM configs "
+                "(no MoE, no pipeline parallelism)")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+        if admission not in ("continuous", "drain"):
+            raise ValueError(
+                f"admission must be 'continuous' or 'drain', got "
+                f"{admission!r}")
+        self.config = config
+        self.name = name
+        self.version = version
+        self.eos_id = eos_id
+        self.default_max_tokens = int(default_max_tokens)
+        self.kv_dtype = kv_dtype
+        self.admission = admission
+        self.max_slots = int(max_slots)
+        self.block_size = int(block_size)
+        self.max_context = int(max_context or config.max_seq)
+        self.blocks_per_slot = -(-self.max_context // self.block_size)
+        self.num_blocks = int(num_blocks
+                              or self.max_slots * self.blocks_per_slot)
+        if self.num_blocks < 1:
+            raise ValueError(
+                f"num_blocks must be >= 1, got {self.num_blocks}")
+        layers = params["layers"]
+        if isinstance(layers, (list, tuple)):
+            # non-scan param layout: stack so the engine's own
+            # scan-over-layers works regardless of config.scan_layers
+            layers = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+            params = {**params, "layers": layers}
+        self.params = params
+        shape = (config.n_layers, self.num_blocks, self.block_size,
+                 config.kv_heads, config.head_dim)
+        if kv_dtype == "int8":
+            self._cache = (jnp.zeros(shape, jnp.int8),
+                           jnp.zeros(shape, jnp.int8),
+                           jnp.ones(shape[:-1] + (1,), jnp.float32),
+                           jnp.ones(shape[:-1] + (1,), jnp.float32))
+        else:
+            dt = config.compute_dtype
+            self._cache = (jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+        # donation would make the functional cache update in-place on
+        # TPU, but this toolchain's donation+serialization landmine
+        # (mesh.py notes) makes plain jit the safe default
+        self._prefill_jit = jax.jit(self._prefill_step)
+        self._decode_jit = jax.jit(self._decode_step)
+        self._free = list(range(self.num_blocks))
+        self._slots = [None] * self.max_slots
+        self._queue = collections.deque()
+        self._cond = threading.Condition()
+        self._draining = False
+        self._stop = False
+        self._step_sleep = 0.0    # test/bench knob: fake device time
+        # aggregate counters bench reads without scraping /metrics
+        self.stats = {"prefills": 0, "decode_steps": 0,
+                      "decode_token_slots": 0, "tokens": 0}
+        self.thread = threading.Thread(target=self._loop, daemon=True,
+                                       name=f"generate-{name}")
+        self.thread.start()
+
+    # ------------------------------------------------------ public API
+
+    def submit(self, tokens, max_tokens=None, eos_id=None,
+               deadline=None, on_token=None, on_done=None, rt=None):
+        """Enqueue one prompt → :class:`GenerationHandle`.
+
+        ``tokens`` is the prompt as int token ids (this platform is
+        tokenizer-free: clients tokenize). ``deadline`` is an absolute
+        ``time.monotonic`` instant (``serving.parse_deadline``): an
+        expired deadline evicts the slot mid-generation (the stream
+        gets a ``deadline`` termination frame) or 504s a still-queued
+        prompt. Raises ``serving.DrainingError`` when the engine is
+        draining — a clean 503-classifiable refusal instead of any
+        fallback path (a generation engine's slots are stateful; there
+        is nothing safe to fall back to)."""
+        try:
+            tokens = [int(t) for t in tokens]
+        except (TypeError, ValueError):
+            raise ValueError("tokens must be a list of token ids") \
+                from None
+        if not tokens:
+            raise ValueError("prompt must be a non-empty token list")
+        vocab = self.config.vocab_size
+        if any(t < 0 or t >= vocab for t in tokens):
+            raise ValueError(f"token ids must be in [0, {vocab})")
+        max_tokens = int(max_tokens if max_tokens is not None
+                         else self.default_max_tokens)
+        if max_tokens < 1:
+            raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
+        if len(tokens) + max_tokens > self.max_context:
+            raise ValueError(
+                f"prompt ({len(tokens)} tokens) + max_tokens "
+                f"({max_tokens}) exceeds max_context "
+                f"({self.max_context})")
+        worst = self._worst_case_blocks(len(tokens), max_tokens)
+        if worst > self.num_blocks:
+            raise ValueError(
+                f"request needs up to {worst} cache blocks but the "
+                f"pool holds {self.num_blocks}; lower max_tokens or "
+                f"grow num_blocks")
+        eos = self.eos_id if eos_id is None else int(eos_id)
+        handle = GenerationHandle(tokens, max_tokens, eos, deadline,
+                                  on_token, on_done, rt)
+        with self._cond:
+            if self._draining or self._stop:
+                raise serving_lib.DrainingError(
+                    f"generation engine {self.name!r} is draining; "
+                    f"retry against another replica")
+            self._queue.append(handle)
+            self._cond.notify()
+        return handle
+
+    def generate(self, tokens, **kwargs):
+        """Blocking convenience → ``(generated_tokens, reason)``."""
+        return self.submit(tokens, **kwargs).result()
+
+    def cancel(self, handle, reason="cancelled"):
+        """Evict ``handle``'s slot (or dequeue it) before the next
+        decode step — the transports call this when the client
+        disconnects mid-stream, so an abandoned generation stops
+        burning decode slots."""
+        with self._cond:
+            handle.cancelled = True
+            handle.cancel_reason = reason
+            self._cond.notify()
+
+    def begin_drain(self):
+        """Soft drain: active slots are evicted gracefully (their
+        streams get a ``draining`` termination frame), queued prompts
+        fail with ``DrainingError`` (503 on the wire), and further
+        submits refuse. The engine thread stays alive (the server's
+        health surface keeps answering) until :meth:`close`."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify()
+
+    def close(self, graceful=True):
+        """Stop the engine. ``graceful`` is accepted for symmetry with
+        ``ServedModel.close`` — both paths evict active slots with a
+        termination frame (there is no way to hand a half-generated
+        sequence to a successor engine, so graceful == fast + clean)."""
+        with self._cond:
+            self._draining = True
+            self._stop = True
+            self._cond.notify()
+        self.thread.join(timeout=10)
+
+    def occupancy(self):
+        with self._cond:
+            return sum(1 for s in self._slots if s is not None)
+
+    def snapshot(self):
+        """Operator view for ``/v1/models/<name>`` (handle_get)."""
+        with self._cond:
+            occupied = sum(1 for s in self._slots if s is not None)
+            return {
+                "slots": self.max_slots,
+                "occupied": occupied,
+                "queued": len(self._queue),
+                "blocks": self.num_blocks,
+                "free_blocks": len(self._free),
+                "block_size": self.block_size,
+                "max_context": self.max_context,
+                "kv_dtype": self.kv_dtype or str(
+                    self.config.compute_dtype),
+                "draining": self._draining,
+            }
+
+    # ---------------------------------------------------- engine loop
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while (not self._stop and not self._draining
+                       and not self._queue
+                       and not any(s is not None for s in self._slots)):
+                    self._cond.wait()
+                stop, draining = self._stop, self._draining
+            try:
+                if draining:
+                    self._drain_now()
+                    if stop:
+                        return
+                    with self._cond:
+                        # park until close(); submit refuses while
+                        # draining so the queue can only repopulate
+                        # from a race that _drain_now cleans next pass
+                        while not self._stop and not self._queue:
+                            self._cond.wait()
+                    continue
+                self._sweep_queued()
+                self._admit()
+                self._sweep_active()
+                if any(s is not None for s in self._slots):
+                    self._decode_once()
+            except Exception as e:  # noqa: BLE001 — no caller may hang
+                log.exception("generation engine %s loop iteration "
+                              "crashed; failing in-flight work",
+                              self.name)
+                self._fail_everything(e)
+
+    def _drain_now(self):
+        with self._cond:
+            queued = list(self._queue)
+            self._queue.clear()
+        for handle in queued:
+            self._finish(handle, "draining", serving_lib.DrainingError(
+                f"generation engine {self.name!r} is draining; retry "
+                f"against another replica"))
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._evict(i, "draining")
+
+    def _fail_everything(self, error):
+        with self._cond:
+            queued = list(self._queue)
+            self._queue.clear()
+        for handle in queued:
+            self._finish(handle, "error", error)
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._evict(i, "error", error)
+
+    def _sweep_queued(self):
+        """Fail queued requests that died waiting (deadline, cancel)
+        BEFORE spending a prefill on them."""
+        with self._cond:
+            queued = list(self._queue)
+        now = time.monotonic()
+        for handle in queued:
+            if handle.cancelled:
+                reason, err = handle.cancel_reason, None
+            elif handle.deadline is not None and now >= handle.deadline:
+                waited = time.perf_counter() - handle.enqueued
+                reason = "deadline"
+                err = serving_lib.DeadlineExceededError(
+                    f"deadline expired while queued for a generation "
+                    f"slot (waited {waited * 1000:.0f} ms)")
+            else:
+                continue
+            with self._cond:
+                try:
+                    self._queue.remove(handle)
+                except ValueError:
+                    continue      # admitted by a racing pass
+            self._finish(handle, reason, err)
+
+    def _sweep_active(self):
+        """Mid-batch eviction of slots that should not take another
+        step: expired deadlines and cancelled (disconnected) streams."""
+        now = time.monotonic()
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            handle = slot.handle
+            if handle.cancelled:
+                self._evict(i, handle.cancel_reason)
+            elif handle.deadline is not None and now >= handle.deadline:
+                self._evict(i, "deadline")
+
+    # ------------------------------------------------------- admission
+
+    def _bucket(self, n):
+        """Prompt-length bucket: the platform bucketing policy, capped
+        at the per-slot cache capacity."""
+        return min(serving_lib.bucket_for(n),
+                   self.blocks_per_slot * self.block_size)
+
+    def _worst_case_blocks(self, prompt_len, max_tokens):
+        """Worst-case blocks for a sequence's whole life: the padded
+        prefill write plus one KV write per decode INPUT token (the
+        final emitted token is never fed back, but +max_tokens is the
+        simple safe bound)."""
+        padded = self._bucket(prompt_len)
+        total = max(padded, prompt_len + max_tokens)
+        return -(-total // self.block_size)
+
+    def _blocks_needed(self, handle):
+        return self._worst_case_blocks(len(handle.prompt),
+                                       handle.max_tokens)
+
+    def _available_blocks(self):
+        reserved = sum(s.reserve - len(s.blocks)
+                       for s in self._slots if s is not None)
+        return len(self._free) - reserved
+
+    def _admit(self):
+        """Move queued prompts into free slots while capacity lasts.
+        FIFO head-of-line: a prompt too big for the current free pool
+        blocks later (smaller) prompts — predictable fairness over
+        packing cleverness."""
+        refilling = False    # drain policy: an empty batch REFILLS to
+        #                      capacity in one admission round, then
+        #                      no more admissions until it drains
+        while True:
+            with self._cond:
+                if not self._queue:
+                    return
+                occupied = any(s is not None for s in self._slots)
+                if self.admission == "drain" and occupied \
+                        and not refilling:
+                    return       # drain-then-refill baseline policy
+                free_slot = next((i for i, s in enumerate(self._slots)
+                                  if s is None), None)
+                if free_slot is None:
+                    return
+                handle = self._queue[0]
+                if not handle.cancelled and (
+                        self._available_blocks()
+                        < self._blocks_needed(handle)):
+                    return       # block-pool pressure: wait for evicts
+                self._queue.popleft()
+            refilling = True
+            if handle.cancelled:
+                self._finish(handle, handle.cancel_reason)
+                continue
+            if handle.deadline is not None \
+                    and time.monotonic() >= handle.deadline:
+                waited = time.perf_counter() - handle.enqueued
+                self._finish(handle, "deadline",
+                             serving_lib.DeadlineExceededError(
+                                 f"deadline expired while queued for a "
+                                 f"generation slot (waited "
+                                 f"{waited * 1000:.0f} ms)"))
+                continue
+            self._prefill(free_slot, handle)
+
+    def _prefill(self, slot_idx, handle):
+        prompt_len = len(handle.prompt)
+        padded = self._bucket(prompt_len)
+        n_blocks = -(-padded // self.block_size)
+        with self._cond:
+            blocks = [self._free.pop() for _ in range(n_blocks)]
+        tokens = np.zeros((padded,), np.int32)
+        tokens[:prompt_len] = handle.prompt
+        t0 = time.perf_counter()
+        t0w = time.time()
+        wait_s = t0 - handle.enqueued
+        _QUEUE_WAIT_SECONDS.labels(self.name).observe(wait_s)
+        if handle.rt is not None:
+            handle.rt.phase("generate.queue_wait", handle.enqueued_w,
+                            t0w)
+        try:
+            cache, first = self._prefill_jit(
+                self.params, self._cache, tokens,
+                np.int32(prompt_len), np.asarray(blocks, np.int32))
+            first = int(first)
+        except Exception as e:  # noqa: BLE001 — a failed prefill
+            # (compile OOM, device error) must fail THIS request, not
+            # hang it: the handle is in neither the queue nor a slot
+            # at this point, so the loop-level _fail_everything would
+            # never resolve it — and its popped blocks must return to
+            # the pool or the engine shrinks with every occurrence
+            with self._cond:
+                self._free.extend(blocks)
+                self._cond.notify()
+            log.exception("prefill failed for a %d-token prompt on "
+                          "engine %s", prompt_len, self.name)
+            self._finish(handle, "error", e)
+            return
+        self._cache = cache
+        elapsed = time.perf_counter() - t0
+        _PREFILL_SECONDS.labels(self.name).observe(
+            elapsed, trace_id=handle.rt.exemplar(elapsed)
+            if handle.rt is not None else None)
+        if handle.rt is not None:
+            handle.rt.phase("generate.prefill", t0w,
+                            rows=padded, prompt=prompt_len)
+        self.stats["prefills"] += 1
+        slot = _Slot(handle, blocks, prompt_len, first,
+                     self._blocks_needed(handle))
+        self._slots[slot_idx] = slot
+        self._emit(handle, first)
+        if handle.eos_id is not None and first == handle.eos_id:
+            self._evict(slot_idx, "eos")
+        elif len(handle.out_tokens) >= handle.max_tokens:
+            self._evict(slot_idx, "length")
+
+    # ----------------------------------------------------- decode step
+
+    def _decode_once(self):
+        active = [(i, s) for i, s in enumerate(self._slots)
+                  if s is not None]
+        S, bps, bs = self.max_slots, self.blocks_per_slot, \
+            self.block_size
+        tables = np.zeros((S, bps), np.int32)
+        lengths = np.zeros((S,), np.int32)
+        tokens = np.zeros((S,), np.int32)
+        # inactive slots write to block id num_blocks: out of bounds,
+        # dropped by the scatter's mode="drop"
+        write_phys = np.full((S,), self.num_blocks, np.int32)
+        write_off = np.zeros((S,), np.int32)
+        for i, slot in active:
+            pos = slot.length
+            block_idx = pos // bs
+            if block_idx >= len(slot.blocks):
+                # lazy page allocation: guaranteed by the admission
+                # reservation, so pop() cannot fail here
+                with self._cond:
+                    slot.blocks.append(self._free.pop())
+            tables[i, :len(slot.blocks)] = slot.blocks
+            lengths[i] = pos
+            tokens[i] = slot.last_token
+            write_phys[i] = slot.blocks[block_idx]
+            write_off[i] = pos % bs
+        t0 = time.perf_counter()
+        cache, nxt = self._decode_jit(self.params, self._cache, tables,
+                                      lengths, tokens, write_phys,
+                                      write_off)
+        nxt = np.asarray(nxt)
+        self._cache = cache
+        if self._step_sleep:
+            time.sleep(self._step_sleep)
+        elapsed = time.perf_counter() - t0
+        _DECODE_STEP_SECONDS.labels(self.name).observe(elapsed)
+        _SLOT_OCCUPANCY.labels(self.name).observe(len(active))
+        self.stats["decode_steps"] += 1
+        self.stats["decode_token_slots"] += len(active)
+        for i, slot in active:
+            slot.length += 1
+            token = int(nxt[i])
+            slot.last_token = token
+            handle = slot.handle
+            self._emit(handle, token)
+            if handle.eos_id is not None and token == handle.eos_id:
+                self._evict(i, "eos")
+            elif len(handle.out_tokens) >= handle.max_tokens:
+                self._evict(i, "length")
+
+    # ------------------------------------------------------ resolution
+
+    def _emit(self, handle, token):
+        handle.out_tokens.append(token)
+        _TOKENS_TOTAL.labels(self.name).inc()
+        self.stats["tokens"] += 1
+        if handle.on_token is not None:
+            try:
+                handle.on_token(token, len(handle.out_tokens) - 1)
+            except Exception:  # noqa: BLE001 — a transport callback
+                log.exception("on_token callback failed")   # bug must
+                # not kill the whole decode batch
+
+    def _evict(self, slot_idx, reason, error=None):
+        slot = self._slots[slot_idx]
+        self._slots[slot_idx] = None
+        with self._cond:
+            self._free.extend(slot.blocks)
+            self._cond.notify()
+        _EVICTIONS_TOTAL.labels(self.name, reason).inc()
+        handle = slot.handle
+        if handle.rt is not None and slot.length > len(handle.prompt):
+            handle.rt.phase("generate.decode", slot.decode_start_w,
+                            tokens=len(handle.out_tokens))
+        if reason == "deadline" and error is None:
+            error = serving_lib.DeadlineExceededError(
+                "deadline expired mid-generation; slot evicted")
+        self._finish(handle, reason, error)
+
+    def _finish(self, handle, reason, error=None):
+        handle.reason = reason
+        handle.error = error
+        if handle.on_done is not None:
+            try:
+                handle.on_done(reason, list(handle.out_tokens), error)
+            except Exception:  # noqa: BLE001 — see _emit
+                log.exception("on_done callback failed")
+        handle._done.set()
+
+    # ------------------------------------------------- jitted programs
+
+    def _layer_core(self, x, lp, attend):
+        """The transformer layer with attention abstracted: mirrors
+        ``transformer._layer`` op for op (einsum strings, dtype casts,
+        silu MLP) so the cached paths stay token-identical to
+        ``transformer.apply``; ``attend(q, k, v)`` is prefill's dense
+        causal attention or decode's cache read+write."""
+        c = self.config
+        dt = c.compute_dtype
+        h = transformer._rmsnorm(x, lp["attn_norm"].astype(dt))
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(dt))
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(dt))
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(dt))
+        o, extra = attend(q, k, v)
+        x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(dt))
+        h = transformer._rmsnorm(x, lp["mlp_norm"].astype(dt))
+        gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(dt))
+        up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(dt))
+        down = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                          lp["w_down"].astype(dt))
+        return x + down, extra
+
+    def _head_logits(self, x):
+        """Final-norm hidden → fp32 logits (mirrors
+        ``transformer._logits`` numerics)."""
+        c = self.config
+        x = transformer._rmsnorm(
+            x, self.params["final_norm"].astype(c.compute_dtype))
+        return jnp.einsum("bsd,dv->bsv", x,
+                          self.params["head"].astype(c.compute_dtype),
+                          preferred_element_type=jnp.float32)
+
+    def _write_pages(self, cache, pages, block_ids):
+        """Prefill cache fill: ``pages`` = (k, v) each
+        [L, n_blocks·block_size, kv_heads, head_dim] → scattered into
+        the pool at ``block_ids`` (quantized when kv_dtype=int8)."""
+        L = self.config.n_layers
+        n = block_ids.shape[0]
+        shaped = [p.reshape(L, n, self.block_size,
+                            self.config.kv_heads, self.config.head_dim)
+                  for p in pages]
+        if self.kv_dtype == "int8":
+            kc, vc, ks, vs = cache
+            kq, ksc = quantize_lib.kv_quantize(shaped[0])
+            vq, vsc = quantize_lib.kv_quantize(shaped[1])
+            return (kc.at[:, block_ids].set(kq),
+                    vc.at[:, block_ids].set(vq),
+                    ks.at[:, block_ids].set(ksc),
+                    vs.at[:, block_ids].set(vsc))
+        kc, vc = cache
+        return (kc.at[:, block_ids].set(shaped[0]),
+                vc.at[:, block_ids].set(shaped[1]))
+
+    def _prefill_step(self, params, cache, tokens, true_len, block_ids):
+        """tokens [padded] int32 → (cache', first_token). The padded
+        tail beyond ``true_len`` is causal-masked away from the real
+        rows (pad positions sit AFTER every real position), so the
+        real rows' activations — and the K/V written for them — are
+        exactly what a full-context forward of the bare prompt
+        computes; the garbage K/V written for pad positions is masked
+        by length at every future read."""
+        c = self.config
+        dt = c.compute_dtype
+        n_rep = c.n_heads // c.kv_heads
+        x = sharding.embed_lookup(params["embed"].astype(dt),
+                                  tokens[None])
+        rope = transformer.rope_tables(c, jnp.arange(tokens.shape[0]))
+
+        def attend(q, k, v):
+            q = transformer.apply_rope(q, *rope)
+            k = transformer.apply_rope(k, *rope)
+            o = attn_lib.dense_attention(
+                q, attn_lib.repeat_kv(k, n_rep),
+                attn_lib.repeat_kv(v, n_rep), causal=True)
+            return o, (k[0], v[0])     # pre-repeat K/V, batch squeezed
+
+        def layer_fn(x, lp):
+            return self._layer_core(x, lp, attend)
+
+        x, (ks, vs) = lax.scan(layer_fn, x, params["layers"])
+        logits = self._head_logits(x[:, true_len - 1][:, None])
+        first = jnp.argmax(logits[0, 0]).astype(jnp.int32)
+        pad = block_ids.shape[0] * self.block_size - tokens.shape[0]
+        pages = [jnp.pad(p, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                 for p in (ks, vs)]
+        return self._write_pages(cache, pages, block_ids), first
+
+    def _gather_kv(self, cache_l, tables):
+        """Per-layer cache slice + block tables → K/V in logical order
+        [S, blocks_per_slot·block_size, kv_heads, head_dim], dequantized
+        at the read when the cache is int8."""
+        c = self.config
+        S = tables.shape[0]
+        T = self.blocks_per_slot * self.block_size
+
+        def flat(pages):
+            return pages.reshape(S, T, c.kv_heads, -1)
+
+        if self.kv_dtype == "int8":
+            kc, vc, ks, vs = cache_l
+            dt = c.compute_dtype
+            return (flat(quantize_lib.kv_dequantize(
+                        kc[tables], ks[tables], dt)),
+                    flat(quantize_lib.kv_dequantize(
+                        vc[tables], vs[tables], dt)))
+        kc, vc = cache_l
+        return flat(kc[tables]), flat(vc[tables])
+
+    def _decode_step(self, params, cache, tables, lengths, tokens,
+                     write_phys, write_off):
+        """One token for every occupied slot: write the input token's
+        K/V into its page, read the gathered pages through
+        ``attention.decode_attention``, and emit the argmax next
+        token. Inactive slots ride along masked (their writes drop,
+        their outputs are discarded host-side)."""
+        c = self.config
+        dt = c.compute_dtype
+        n_rep = c.n_heads // c.kv_heads
+        x = sharding.embed_lookup(params["embed"].astype(dt),
+                                  tokens[:, None])
+        cos, sin = transformer.rope_tables(c, lengths)
+
+        def rope_rows(t):
+            # apply_rope with per-ROW positions ([S] new tokens at [S]
+            # different offsets); same pair rotation + stacking order
+            x1, x2 = t[..., 0::2], t[..., 1::2]
+            cc = cos[:, None, None, :].astype(t.dtype)
+            ss = sin[:, None, None, :].astype(t.dtype)
+            return jnp.stack([x1 * cc - x2 * ss, x1 * ss + x2 * cc],
+                             axis=-1).reshape(t.shape)
+
+        def write_one(cache_l, k1, v1):
+            if self.kv_dtype == "int8":
+                kc, vc, ks, vs = cache_l
+                kq, ksc = quantize_lib.kv_quantize(k1)
+                vq, vsc = quantize_lib.kv_quantize(v1)
+                return (
+                    kc.at[write_phys, write_off].set(kq, mode="drop"),
+                    vc.at[write_phys, write_off].set(vq, mode="drop"),
+                    ks.at[write_phys, write_off].set(ksc, mode="drop"),
+                    vs.at[write_phys, write_off].set(vsc, mode="drop"))
+            kc, vc = cache_l
+            return (kc.at[write_phys, write_off].set(k1, mode="drop"),
+                    vc.at[write_phys, write_off].set(v1, mode="drop"))
+
+        def layer_fn(x, layer_in):
+            lp, cache_l = layer_in[0], tuple(layer_in[1:])
+
+            def attend(q, k, v):
+                q, k = rope_rows(q), rope_rows(k)
+                # write THEN gather: the new token's own K/V must be
+                # part of its attention context (lengths+1 below)
+                new_cache_l = write_one(cache_l, k[:, 0], v[:, 0])
+                k_all, v_all = self._gather_kv(new_cache_l, tables)
+                o = attn_lib.decode_attention(
+                    q, attn_lib.repeat_kv(k_all, n_rep),
+                    attn_lib.repeat_kv(v_all, n_rep), lengths + 1)
+                return o, new_cache_l
+
+            return self._layer_core(x, lp, attend)
+
+        x, new_cache = lax.scan(layer_fn, x,
+                                (params["layers"],) + cache)
+        logits = self._head_logits(x)
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        return tuple(new_cache), nxt
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=8)
+def _reference_apply(config):
+    # one compiled full-context program per config: eager
+    # transformer.apply re-traces its lax.scan body EVERY call (~1 s
+    # per decode step on the CPU tier), which would dominate every
+    # conformance run
+    return jax.jit(lambda params, toks: transformer.apply(
+        params, toks, config))
+
+
+def reference_greedy_decode(params, config, prompt, max_tokens,
+                            eos_id=None):
+    """The conformance oracle: greedy decode by FULL-CONTEXT recompute
+    through ``transformer.apply`` at every step — O(n²) and cache-free,
+    which is exactly why it is trustworthy. The engine's output must be
+    token-identical (tests/test_compute_generate.py).
+
+    The recompute runs at one fixed padded length so every step shares
+    a single compiled program; the trailing pad sits causally AFTER
+    every real position, so the real rows' logits are exactly the
+    bare-prompt forward's."""
+    fn = _reference_apply(config)
+    tokens = [int(t) for t in prompt]
+    out = []
+    pad_to = max(config.max_seq, len(tokens) + max_tokens)
+    buf = np.zeros((1, pad_to), np.int32)
+    for _ in range(max_tokens):
+        buf[0, :len(tokens)] = tokens
+        logits = fn(params, jnp.asarray(buf))
+        nxt = int(jnp.argmax(logits[0, len(tokens) - 1]))
+        out.append(nxt)
+        tokens.append(nxt)
+        if eos_id is not None and nxt == eos_id:
+            break
+    return out

@@ -77,6 +77,30 @@ def dequantize_tree(qparams, dtype=jnp.bfloat16):
     return jax.tree.map(one, qparams, is_leaf=_is_qleaf)
 
 
+def kv_quantize(x):
+    """Traceable twin of :func:`quantize_array` for KV-cache blocks:
+    symmetric int8 over the TRAILING (head_dim) axis, one scale per
+    (position, head) — finer grain than the weight path because cache
+    entries are written one token at a time inside a jitted program.
+    Returns ``(int8, float32 scale broadcastable over the last axis)``.
+    The generation engine (compute/generate.py) calls this on the
+    write path and :func:`kv_dequantize` inside the attention read, so
+    the int8 bytes stay resident in HBM and widen in VMEM — the same
+    bandwidth economics as the weight-only path, applied to the cache
+    reads that dominate long-context decode."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def kv_dequantize(q, scale, dtype=jnp.bfloat16):
+    """Trace-time inverse of :func:`kv_quantize` (runs inside the
+    jitted decode step, at the attention read)."""
+    return q.astype(dtype) * scale.astype(dtype)
+
+
 def quantized_bytes(qparams):
     """(quantized_bytes, float_bytes_equivalent) — the HBM win."""
     qb = fb = 0

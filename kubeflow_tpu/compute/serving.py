@@ -491,6 +491,14 @@ class CapacityBusyError(Exception):
     copies — retry after the transition completes (503, not 507)."""
 
 
+class DrainingError(Exception):
+    """The serving target is draining (server drain, or a displaced
+    generation engine whose slots cannot straggle-fallback): the
+    request is retryable against another replica — 503 + Retry-After,
+    never the straggler direct-run path (a generation engine's slots
+    are stateful; there is nothing safe to fall back to)."""
+
+
 class ServedModel:
     """One model. Two construction modes:
 
@@ -824,6 +832,8 @@ def classify_predict_error(e):
         return 507, {"error": str(e)}, ()
     if isinstance(e, CapacityBusyError):
         return 503, {"error": str(e)}, (("Retry-After", "1"),)
+    if isinstance(e, DrainingError):
+        return 503, {"error": str(e)}, (("Retry-After", "1"),)
     if isinstance(e, ValueError):
         return 400, {"error": str(e)}, ()
     return 500, {"error": f"inference failed: {e}"}, ()
@@ -886,6 +896,7 @@ class ModelServer:
 
     def __init__(self, budget_bytes=None, stream_group=32):
         self._models = {}
+        self._generators = {}     # name -> GenerationEngine (:generate)
         self._httpd = None
         self._thread = None
         self._transport = None    # AsyncTransport when transport=async
@@ -925,6 +936,28 @@ class ModelServer:
             # not 500 in-flight work (matters now that batching is the
             # default; register_loadable drains the same way)
             old.close(graceful=True)
+
+    def register_generator(self, name, engine):
+        """Serve ``engine`` (compute/generate.py GenerationEngine) at
+        ``POST /v1/models/<name>:generate`` on every transport —
+        token-streaming autoregressive decode next to the unary
+        predict surface (a name may carry both).
+
+        Replacing a served name drains the DISPLACED engine
+        gracefully: its active slots are evicted with a ``draining``
+        termination frame on their open streams, and submits racing
+        the swap get a clean 503 (``DrainingError``) instead of any
+        straggler fallback — a generation engine's slots are stateful,
+        so unlike the unary batcher there is no direct-run path to
+        fall back to."""
+        old = self._generators.get(name)
+        self._generators[name] = engine
+        if old is not None:
+            old.close(graceful=True)
+        return engine
+
+    def generators(self):
+        return dict(self._generators)
 
     def register_loadable(self, name, make_fn, params, version=1,
                           preload=False, **model_kwargs):
@@ -1214,7 +1247,8 @@ class ModelServer:
         # /v1/models/<name> → model version status
         if len(parts) == 3 and parts[:2] == ["v1", "models"]:
             model = self._models.get(parts[2])
-            if model is None:
+            generator = self._generators.get(parts[2])
+            if model is None and generator is None:
                 return 404, {"error": "model not found"}, (), json_ct
             # state stays AVAILABLE for evicted managed models: a
             # predict lazily reloads them, so they ARE servable —
@@ -1222,11 +1256,18 @@ class ModelServer:
             # not pull the server out of rotation. Residency lives in
             # its own block.
             canary = self._canaries.get(parts[2])
+            version = model.version if model is not None \
+                else generator.version
             payload = {"model_version_status": [{
-                "version": str(model.version),
+                "version": str(version),
                 "state": "AVAILABLE",
                 "status": {"error_code": "OK", "error_message": ""},
-            }], "residency": _residency(model)}
+            }]}
+            if model is not None:
+                payload["residency"] = _residency(model)
+            if generator is not None:
+                # slot-pool/occupancy view for the :generate surface
+                payload["generator"] = generator.snapshot()
             if canary is not None:
                 payload["canary"] = {
                     "version": str(canary["model"].version),
@@ -1427,6 +1468,10 @@ class ModelServer:
                 if target is None:
                     return self._send(404, {"error": "not found"})
                 name, verb = target
+                if verb == "generate":
+                    # autoregressive decode: token-streaming chunked
+                    # NDJSON off the generation engine's slot pool
+                    return self._generate_stream(name, length)
                 model = models.get(name)
                 if model is None:
                     return self._send(404, {"error": "model not found"})
@@ -1540,6 +1585,115 @@ class ModelServer:
                     out, "binary", infer, model.version)
                 self._rt.phase("encode", t_enc, format="binary")
                 self._send(200, parts, extra, content_type=ctype)
+
+            def _generate_stream(self, name, length):
+                """``:generate``: greedy autoregressive decode through
+                the model's GenerationEngine, streaming tokens back
+                incrementally as chunked NDJSON — one
+                ``{"token", "index"}`` frame per generated token the
+                moment the decode step emits it, then a terminal
+                ``{"done": true, "reason", "tokens"}`` frame (the
+                reason distinguishes eos / length / deadline /
+                draining). Request body:
+                ``{"tokens": [ids], "max_tokens"?, "eos_id"?}``.
+
+                ``X-Request-Deadline-Ms`` is honored by EVICTING the
+                decode slot when it expires: mid-stream the client
+                gets a ``deadline`` termination frame (the stream is
+                already 200); a still-queued prompt 504s outright.
+                Queue-side failures before any token (drain, deadline,
+                engine crash) answer with the plain predict error
+                taxonomy — no stream is started for a dead request."""
+                rt = self._rt
+                engine = server._generators.get(name)
+                if engine is None:
+                    return self._send(
+                        404, {"error": f"no generation engine "
+                                       f"registered for {name!r}"})
+                rt.attrs["model"] = name
+                rt.attrs["track"] = "stable"
+                try:
+                    deadline = parse_deadline(
+                        self.headers.get("X-Request-Deadline-Ms"))
+                except ValueError as e:
+                    return self._send(400, {"error": f"bad request: {e}"})
+                try:
+                    t_read = time.time()
+                    raw = self.rfile.read(length) if length else b""
+                    rt.phase("http.read", t_read)
+                    t_dec = time.time()
+                    req = json.loads(raw or b"{}")
+                    if not isinstance(req, dict):
+                        raise ValueError("body must be a JSON object")
+                    tokens = req.get("tokens")
+                    if tokens is None:
+                        raise ValueError('"tokens" is required '
+                                         '(a list of prompt token ids)')
+                    rt.phase("decode", t_dec, format="json")
+                except (ValueError, KeyError, TypeError) as e:
+                    return self._send(400, {"error": f"bad request: {e}"})
+                _WIRE_FORMAT_TOTAL.labels("json").inc()
+                events = queue.Queue()
+                try:
+                    handle = engine.submit(
+                        tokens, max_tokens=req.get("max_tokens"),
+                        eos_id=req.get("eos_id"), deadline=deadline,
+                        rt=rt,
+                        on_token=lambda t, i: events.put(
+                            ("token", t, i)),
+                        on_done=lambda reason, toks, error: events.put(
+                            ("done", reason, toks, error)))
+                except Exception as e:  # noqa: BLE001 — wire boundary
+                    # ValueError → 400, DrainingError → 503 (clean,
+                    # retryable-elsewhere; no fallback path exists for
+                    # stateful decode slots), else 500
+                    code, payload, extra = classify_predict_error(e)
+                    return self._send(code, payload, extra)
+                event = events.get()
+                if event[0] == "done" and not event[2]:
+                    # finished before ANY token: queue-side failure —
+                    # answer plainly instead of a zero-token stream
+                    code, payload, extra = classify_predict_error(
+                        event[3] if event[3] is not None
+                        else RuntimeError(
+                            f"generation ended: {event[1]}"))
+                    return self._send(code, payload, extra)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.send_header("X-Served-Version",
+                                 str(engine.version))
+                if rt is not None:
+                    self.send_header("traceparent",
+                                     tracing.format_traceparent(rt))
+                self.end_headers()
+
+                def chunk(payload):
+                    body = json.dumps(payload).encode() + b"\n"
+                    self.wfile.write(
+                        f"{len(body):X}\r\n".encode() + body + b"\r\n")
+
+                try:
+                    while True:
+                        if event[0] == "token":
+                            chunk({"token": event[1],
+                                   "index": event[2]})
+                        else:
+                            _kind, reason, toks, error = event
+                            done = {"done": True, "reason": reason,
+                                    "tokens": toks}
+                            if error is not None:
+                                done["error"] = str(error)
+                            chunk(done)
+                            self.wfile.write(b"0\r\n\r\n")
+                            return
+                        event = events.get()
+                except OSError:
+                    # the client went away mid-stream: evict the slot
+                    # so an abandoned generation stops burning decode
+                    # batch capacity
+                    engine.cancel(handle, reason="disconnect")
+                    self.close_connection = True
 
             def _predict_stream(self, model, length):
                 """Batched-pipelined predict over one connection: the
@@ -1755,8 +1909,15 @@ class ModelServer:
         async transport reaps idle keep-alive connections + closes
         every further response's connection. Health probes keep
         answering; models stay registered and loaded — a drain is a
-        routing event, not a shutdown."""
+        routing event, not a shutdown. Generation engines are the
+        exception: their in-flight streams can run for minutes, so a
+        drain EVICTS their decode slots gracefully (each open stream
+        gets a ``draining`` termination frame, blocks return to the
+        pool) and further ``:generate`` submits get a clean 503 — the
+        drain would otherwise never converge."""
         self.draining = True
+        for engine in self._generators.values():
+            engine.begin_drain()
         if self._transport is not None:
             self._transport.drain()
 
@@ -1777,3 +1938,5 @@ class ModelServer:
                       *(c["model"] for c in self._canaries.values())]
         for model in models:
             model.close()
+        for engine in self._generators.values():
+            engine.close()

@@ -213,7 +213,10 @@ class AsyncTransport:
                     conn, gen, outcome = self._completions.popleft()
                     if conn.gen == gen and conn.sock.fileno() >= 0:
                         try:
-                            self._complete_predict(conn, outcome)
+                            if outcome[0] in ("gtoken", "gdone"):
+                                self._gen_event(conn, outcome)
+                            else:
+                                self._complete_predict(conn, outcome)
                         except Exception:  # noqa: BLE001 — keep loop
                             log.exception(
                                 "async transport: completion handler "
@@ -274,6 +277,15 @@ class AsyncTransport:
             return
         self._conns.discard(conn)
         conn.gen += 1            # in-flight completions become stale
+        req = conn.req
+        if req is not None and req.get("gen_handle") is not None:
+            # the stream's client went away: evict the decode slot so
+            # an abandoned generation stops burning batch capacity
+            try:
+                req["gen_engine"].cancel(req["gen_handle"],
+                                         reason="disconnect")
+            except Exception:  # noqa: BLE001 — teardown bookkeeping
+                log.exception("generation cancel on close failed")
         self._interest(conn, 0)
         try:
             conn.sock.close()
@@ -296,12 +308,25 @@ class AsyncTransport:
         """A completion whose connection already closed: count the
         would-have-been response into ``serving_requests_total`` and
         finish the request trace."""
+        if outcome[0] == "gtoken":
+            return        # tokens after a dead stream: nothing to do
+        if outcome[0] == "gdone" and conn.req is not None \
+                and conn.req.get("gen_started"):
+            return        # the stream's close-time finish_cb (set at
+            #               _begin_stream) already accounted it
         rt, conn.rt = conn.rt, None
         conn.req = None
         if rt is None:
             return
         if outcome[0] == "ok":
             code = 200
+        elif outcome[0] == "gdone":
+            # never started streaming: account the would-have-been
+            # taxonomy answer (200 is impossible — a token would have
+            # started the stream)
+            code = serving.classify_predict_error(
+                outcome[3] if outcome[3] is not None
+                else RuntimeError("generation ended"))[0]
         else:
             code = serving.classify_predict_error(outcome[1])[0]
         rt.attrs["code"] = code
@@ -340,8 +365,14 @@ class AsyncTransport:
             # that sent a request and never reads the response —
             # without reaping it the queued memoryviews pin the
             # result tensor forever. "wait" is excluded: that time
-            # belongs to our own device, not the peer.
+            # belongs to our own device, not the peer. "stream" is
+            # reaped only with frames QUEUED and no send progress (a
+            # client not consuming its tokens); an idle lull between
+            # tokens belongs to our decode loop, not the peer.
             if conn.state in ("head", "body", "write") \
+                    and now - conn.last_activity > self.idle_timeout:
+                self._close(conn)
+            elif conn.state == "stream" and conn.out \
                     and now - conn.last_activity > self.idle_timeout:
                 self._close(conn)
 
@@ -554,6 +585,11 @@ class AsyncTransport:
             self._error(conn, 404, "not found")
             return
         name, verb = target
+        if verb == "generate":
+            # token-streaming decode: the engine's callbacks feed the
+            # loop through the completion queue, one frame per token
+            self._dispatch_generate(conn, name)
+            return
         model = self.server._models.get(name)
         if model is None:
             self._error(conn, 404, "model not found")
@@ -603,6 +639,148 @@ class AsyncTransport:
         conn.state = "wait"
         self._interest(conn, 0)     # backpressure pipelined requests
         self._submit(conn, model, x, rt, deadline)
+
+    def _dispatch_generate(self, conn, name):
+        """``:generate`` on the event loop: parse the JSON request,
+        submit to the model's GenerationEngine, and stream chunked
+        NDJSON frames as its callbacks land on the completion queue —
+        the same incremental contract as the threaded transport
+        (tests/test_serving_generate.py runs the conformance suite
+        over both)."""
+        req, rt = conn.req, conn.rt
+        engine = self.server._generators.get(name)
+        if engine is None:
+            self._error(conn, 404,
+                        f"no generation engine registered for {name!r}")
+            return
+        rt.attrs["model"] = name
+        rt.attrs["track"] = "stable"
+        if req["binary"]:
+            self._error(conn, 400,
+                        "generate takes a JSON body "
+                        '({"tokens": [...]}), not application/x-tensor')
+            return
+        try:
+            deadline = serving.parse_deadline(
+                req["headers"].get("x-request-deadline-ms"))
+            tw_dec = time.time()
+            body = json.loads(bytes(req["body"]) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            tokens = body.get("tokens")
+            if tokens is None:
+                raise ValueError('"tokens" is required '
+                                 '(a list of prompt token ids)')
+            rt.phase("decode", tw_dec, format="json")
+        except (ValueError, KeyError, TypeError) as e:
+            self._error(conn, 400, f"bad request: {e}")
+            return
+        serving._WIRE_FORMAT_TOTAL.labels("json").inc()
+        gen = conn.gen
+        req["model_name"] = name
+        req["gen_started"] = False
+        conn.state = "wait"
+        self._interest(conn, 0)
+
+        def on_token(token, index):
+            self._completions.append(
+                (conn, gen, ("gtoken", token, index)))
+            self._wake()
+
+        def on_done(reason, toks, error):
+            self._completions.append(
+                (conn, gen, ("gdone", reason, toks, error)))
+            self._wake()
+
+        try:
+            req["gen_engine"] = engine
+            req["gen_handle"] = engine.submit(
+                tokens, max_tokens=body.get("max_tokens"),
+                eos_id=body.get("eos_id"), deadline=deadline, rt=rt,
+                on_token=on_token, on_done=on_done)
+        except Exception as e:  # noqa: BLE001 — wire boundary:
+            # ValueError → 400, DrainingError → clean 503 (no fallback
+            # path exists for stateful decode slots), else 500
+            code, payload, extra = serving.classify_predict_error(e)
+            self._respond(conn, code, payload, extra,
+                          "application/json")
+
+    def _begin_stream(self, conn):
+        """Queue the chunked 200 head for a token stream and install
+        the close-time bookkeeping (SLO count + trace finish) so a
+        client that abandons mid-stream is still accounted."""
+        req, rt = conn.req, conn.rt
+        engine = req["gen_engine"]
+        lines = ["HTTP/1.1 200 OK",
+                 "Content-Type: application/x-ndjson",
+                 "Transfer-Encoding: chunked",
+                 f"X-Served-Version: {engine.version}"]
+        if rt is not None:
+            lines.append(
+                f"traceparent: {tracing.format_traceparent(rt)}")
+            rt.attrs["code"] = 200
+        if conn.close_after or self._draining or self._stop:
+            lines.append("Connection: close")
+            conn.close_after = True
+        conn.out.append(memoryview(
+            ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1")))
+        conn.state = "stream"
+        conn.write_t0 = time.monotonic()
+        req["gen_started"] = True
+        model_name = req.get("model_name")
+
+        def finish():
+            if rt is not None:
+                if model_name is not None:
+                    serving._REQUESTS_TOTAL.labels(
+                        model_name, "200").inc()
+                rt.finish()
+
+        conn.finish_cb = finish
+
+    def _stream_chunk(self, conn, payload):
+        body = json.dumps(payload).encode() + b"\n"
+        conn.out.append(memoryview(
+            f"{len(body):X}\r\n".encode() + body + b"\r\n"))
+
+    def _gen_event(self, conn, outcome):
+        """One engine callback delivered on the loop thread."""
+        req = conn.req
+        if outcome[0] == "gtoken":
+            if not req.get("gen_started"):
+                self._begin_stream(conn)
+            self._stream_chunk(conn, {"token": outcome[1],
+                                      "index": outcome[2]})
+            self._flush(conn)
+            return
+        _kind, reason, toks, error = outcome
+        if not req.get("gen_started"):
+            # finished before ANY token: queue-side failure (drain,
+            # deadline, crash) — answer with the plain predict error
+            # taxonomy instead of a zero-token stream
+            code, payload, extra = serving.classify_predict_error(
+                error if error is not None
+                else RuntimeError(f"generation ended: {reason}"))
+            self._respond(conn, code, payload, extra,
+                          "application/json")
+            return
+        done = {"done": True, "reason": reason, "tokens": toks}
+        if error is not None:
+            done["error"] = str(error)
+        self._stream_chunk(conn, done)
+        conn.out.append(memoryview(b"0\r\n\r\n"))
+        if self._draining or self._stop:
+            conn.close_after = True
+        # hand the tail to the normal write path: when out drains it
+        # runs finish_cb and resets the connection for keep-alive
+        conn.state = "write"
+        conn.write_t0 = time.monotonic()   # stall metric = tail flush
+        self._flush(conn)
+
+    def _flush(self, conn):
+        self._on_writable(conn)      # optimistic write
+        if conn.out and conn in self._conns:
+            self._interest(conn, selectors.EVENT_WRITE)
 
     def _submit(self, conn, model, x, rt, deadline):
         gen = conn.gen
@@ -747,6 +925,13 @@ class AsyncTransport:
                 conn.out[0] = mv[n:]
                 return
             conn.out.popleft()
+        if conn.state == "stream":
+            # mid-stream lull: every queued frame is on the wire, more
+            # may come from the engine — park with no interests (the
+            # completion queue wakes the loop, not the selector) and
+            # keep finish_cb armed for close-time accounting
+            self._interest(conn, 0)
+            return
         # drained: bookkeeping, then next request or close
         _WRITE_STALL.labels("async").observe(
             time.monotonic() - conn.write_t0)

@@ -49,6 +49,16 @@ GANG_RESTARTS = obs_metrics.REGISTRY.counter(
     "Gang restarts performed per TpuSlice",
     ("namespace", "slice"))
 
+#: surviving trials of a FAILED sweep pod re-bucketed and relaunched
+#: (once per trial): the ROADMAP "sweep pod failure fails unreported
+#: members" gap closed with one bounded retry instead of silent loss
+SWEEP_REPACKS = obs_metrics.REGISTRY.counter(
+    "sweep_repack_total",
+    "Trials re-bucketed into fresh sweep pods after their original "
+    "packed sweep pod failed (each trial is repacked at most once; a "
+    "second failure is terminal)",
+    ("study",))
+
 #: pod-template annotation carrying the gang restart generation — bumping
 #: it (plus deleting the gang's pods) is how the controller restarts the
 #: whole gang coherently; runtimes key the coordinator epoch off it
@@ -961,10 +971,11 @@ class StudyJobReconciler(Reconciler):
         return values, {"status": status, "render": render}
 
     def _launch_sweeps(self, req, study, spec, trials, batch,
-                       metric_name):
+                       metric_name, name_suffix=""):
         """Create one packed sweep pod per shape bucket of ``batch``
         (``[(index, values)]``), recording each member trial's routing
-        via its ``sweep`` field.
+        via its ``sweep`` field. ``name_suffix`` distinguishes repack
+        relaunches from the failed pods they replace.
 
         The pod runs the vectorized sweep worker: the trial template is
         rendered with the bucket's SHARED shape parameters (continuous
@@ -975,7 +986,7 @@ class StudyJobReconciler(Reconciler):
         does not name one."""
         from ..compute import sweep as sweep_lib
         for bkey, members in sweep_lib.bucket_trials(batch):
-            pod_name = f"{req.name}-sweep-{members[0][0]}"
+            pod_name = f"{req.name}-sweep-{members[0][0]}{name_suffix}"
             template = render_template(
                 spec.get("trialTemplate")
                 or {"spec": {"containers": [{}]}},
@@ -1082,6 +1093,8 @@ class StudyJobReconciler(Reconciler):
         retry_counts = getattr(self, "_sweep_scrape_retries", None)
         if retry_counts is None:
             retry_counts = self._sweep_scrape_retries = {}
+        repack = []     # surviving members of FAILED sweep pods, to be
+        #                 re-bucketed + relaunched once (ROADMAP gap)
         for i, trial in trials.items():
             if trial.get("state") in ("Succeeded", "Failed",
                                       "EarlyStopped"):
@@ -1133,10 +1146,18 @@ class StudyJobReconciler(Reconciler):
                     trial["state"] = "Succeeded"
                     trial["objectiveValue"] = finals[i]
                 elif phase == "Failed":
-                    # a crash fails every unreported member (its
-                    # partial lines, if any, are untrustworthy —
-                    # same rule as the single-trial path)
-                    trial["state"] = "Failed"
+                    if trial.get("repacked"):
+                        # second pod failure for this trial: terminal.
+                        # One bounded retry, not a crash loop — partial
+                        # lines stay untrustworthy either way.
+                        trial["state"] = "Failed"
+                    else:
+                        # the pod crashed but this member never
+                        # reported: re-bucket the survivors (members
+                        # from DIFFERENT failed pods may pack together)
+                        # and relaunch once under a fresh pod name
+                        trial["repacked"] = True
+                        repack.append((i, trial.get("parameters") or {}))
                 elif phase == "Succeeded":
                     if has_logs or retry_counts.get(pod_key, 0) > 5:
                         # clean exit whose (readable) logs skipped this
@@ -1181,6 +1202,19 @@ class StudyJobReconciler(Reconciler):
             if final is not None:
                 trial["state"] = "Succeeded"
                 trial["objectiveValue"] = final
+
+        if repack:
+            # bucket re-packing: the surviving trials run as fresh
+            # packed pods (same vectorized contract, "-r1" names so
+            # the failed pods' records stay inspectable); their
+            # ``sweep`` routing is rewritten by _launch_sweeps
+            self._launch_sweeps(req, study, spec, trials, repack,
+                                metric_name, name_suffix="-r1")
+            SWEEP_REPACKS.labels(req.name).inc(len(repack))
+            log.warning(
+                "study %s/%s: re-bucketed %d surviving trial(s) of "
+                "failed sweep pod(s) into fresh pods", req.namespace,
+                req.name, len(repack))
 
         # ---- early stopping (hpo.py — Katib's services re-homed):
         # medianstop kills a trial whose best intermediate trails the

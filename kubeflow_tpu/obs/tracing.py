@@ -246,7 +246,13 @@ def span(name, traceparent=None, buffer=None, **attrs):
 #: compute/serving.py emit exactly these; /debug/latency groups by
 #: them). Order is the unary predict pipeline order.
 PHASE_NAMES = ("http.read", "decode", "batch.queue_wait",
-               "batch.dispatch", "device", "encode", "http.write")
+               "batch.dispatch", "device", "encode", "http.write",
+               # the :generate anatomy (compute/generate.py): queue →
+               # prefill → token-streaming decode tail; disjoint legs
+               # of a generation request, so the phase sum stays
+               # meaningful under ?path=:generate
+               "generate.queue_wait", "generate.prefill",
+               "generate.decode")
 
 
 def trace_sample_rate():

@@ -169,10 +169,23 @@ class Request:
 
 
 class Response:
-    def __init__(self, payload=None, status=200, headers=None):
+    def __init__(self, payload=None, status=200, headers=None,
+                 stream=None):
+        """``stream``: an iterator of byte chunks served with chunked
+        transfer encoding INSTEAD of a buffered body — each chunk goes
+        on the wire as it is produced, so a proxy route (the router's
+        ``:generate`` pass-through) relays upstream frames without
+        store-and-forwarding the whole response. The iterator's
+        ``close()`` runs even when the client disconnects mid-stream
+        (generator finallys release upstream connections)."""
         self.status = status
         self.headers = dict(headers or {})
-        if isinstance(payload, (bytes, str)):
+        self.stream = stream
+        if stream is not None:
+            self.body = b""
+            self.headers.setdefault("Content-Type",
+                                    "application/octet-stream")
+        elif isinstance(payload, (bytes, str)):
             self.body = (payload.encode()
                          if isinstance(payload, str) else payload)
             self.headers.setdefault("Content-Type", "text/plain")
@@ -468,6 +481,34 @@ class App:
                 self.send_response(response.status)
                 for k, v in response.headers.items():
                     self.send_header(k, v)
+                if response.stream is not None:
+                    # incremental relay: each produced chunk goes on
+                    # the wire immediately (chunked framing), so a
+                    # token stream's first frame reaches the client
+                    # while the upstream is still generating
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    t_write = time.time()
+                    stream = response.stream
+                    try:
+                        for part in stream:
+                            if not part:
+                                continue
+                            self.wfile.write(
+                                f"{len(part):X}\r\n".encode()
+                                + bytes(part) + b"\r\n")
+                        self.wfile.write(b"0\r\n\r\n")
+                    finally:
+                        # client reset mid-stream: the generator's
+                        # finally must still run (it releases the
+                        # upstream connection / decrements outstanding)
+                        close = getattr(stream, "close", None)
+                        if close is not None:
+                            close()
+                    rt = getattr(response, "trace", None)
+                    if rt is not None:
+                        rt.late_phase("http.write", t_write)
+                    return
                 self.send_header("Content-Length",
                                  str(len(response.body)))
                 self.end_headers()

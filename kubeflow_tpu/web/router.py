@@ -269,6 +269,74 @@ class RouterCore:
             raise HTTPError(502, "every routable replica failed")
         raise HTTPError(503, "no healthy replicas")
 
+    def forward_stream(self, method, path, body, headers):
+        """Proxy one STREAMING request → ``(status, response_headers,
+        chunk_iterator)`` — the ``:generate`` pass-through. Unlike
+        :meth:`forward`, the response body is NOT store-and-forwarded:
+        the iterator yields upstream chunks as they arrive (via
+        ``HTTPResponse.read1``, which returns per-chunk instead of
+        blocking for a full buffer), so tokens reach the client while
+        the replica is still decoding. The documented
+        ``:predictStream`` buffering caveat does not apply here.
+
+        Retry semantics are necessarily narrower than unary forward:
+        a replica failure is only retried BEFORE the response head
+        arrives (once frames have been relayed the stream cannot be
+        transparently replayed). The streaming connection is not
+        pooled — it closes when the stream ends either way."""
+        tried = []
+        for _attempt in range(2):
+            replica = self.pick(exclude=tried)
+            if replica is None:
+                break
+            tried.append(replica.endpoint)
+            with self._lock:
+                replica.outstanding += 1
+            _OUTSTANDING.labels(replica.endpoint).set(
+                replica.outstanding)
+            conn = http.client.HTTPConnection(
+                replica.host, replica.port, timeout=self.timeout)
+            try:
+                conn.request(method, path, body, headers)
+                resp = conn.getresponse()
+                resp_headers = dict(resp.headers.items())
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                with self._lock:
+                    replica.healthy = False
+                    replica.outstanding -= 1
+                _REPLICA_HEALTHY.labels(replica.endpoint).set(0)
+                _OUTSTANDING.labels(replica.endpoint).set(
+                    replica.outstanding)
+                _ROUTED_TOTAL.labels(replica.endpoint, "502").inc()
+                log.warning("replica %s failed before the stream "
+                            "head (%s); retrying on another",
+                            replica.endpoint, e)
+                continue
+            _ROUTED_TOTAL.labels(replica.endpoint,
+                                 str(resp.status)).inc()
+
+            def chunks(resp=resp, conn=conn, replica=replica):
+                try:
+                    while True:
+                        # read1: returns what the current chunk has —
+                        # NO buffering until a full read() completes
+                        data = resp.read1(65536)
+                        if not data:
+                            return
+                        yield data
+                finally:
+                    conn.close()
+                    with self._lock:
+                        replica.outstanding -= 1
+                    _OUTSTANDING.labels(replica.endpoint).set(
+                        replica.outstanding)
+
+            return resp.status, resp_headers, chunks()
+        if tried:
+            raise HTTPError(502, "every routable replica failed")
+        raise HTTPError(503, "no healthy replicas")
+
     # -------------------------------------------------------- health
 
     def check_health_once(self):
@@ -380,6 +448,18 @@ def create_app(store=None, core=None, namespace=None):
             value = request.header(name)
             if value is not None:
                 headers[name] = value
+        if rest.endswith(":generate"):
+            # token streams relay INCREMENTALLY (forward_stream +
+            # Response(stream=...)): each upstream frame goes on the
+            # wire as it arrives — a generation's first token must not
+            # wait for its last (regression-tested: tokens arrive
+            # before the stream closes)
+            status, resp_headers, chunk_iter = core.forward_stream(
+                request.method, path, request.body, headers)
+            mirrored = {k: resp_headers[k] for k in _MIRROR_HEADERS
+                        if k in resp_headers}
+            return Response(stream=chunk_iter, status=status,
+                            headers=mirrored)
         status, resp_headers, data = core.forward(
             request.method, path, request.body, headers)
         mirrored = {k: resp_headers[k] for k in _MIRROR_HEADERS
@@ -387,11 +467,12 @@ def create_app(store=None, core=None, namespace=None):
         return Response(data, status=status, headers=mirrored)
 
     # the predict surface: every /v1/... verb proxies (predict,
-    # predictStream, model status); the router adds routing, not API.
-    # Caveat: the proxy is store-and-forward — a :predictStream
-    # response is buffered whole before relaying, losing the route's
-    # incremental TTFB (bulk throughput is preserved); stream clients
-    # that need first-line latency should hit a replica directly
+    # predictStream, model status, generate); the router adds routing,
+    # not API. Caveat: the proxy is store-and-forward for everything
+    # EXCEPT :generate — a :predictStream response is still buffered
+    # whole before relaying, losing the route's incremental TTFB (bulk
+    # throughput is preserved); stream clients that need first-line
+    # latency should use :generate or hit a replica directly
     app.post("/v1/<rest...>")(proxy)
     app.get("/v1/<rest...>")(
         lambda request, rest: proxy(request, rest))

@@ -1,0 +1,381 @@
+"""``:generate`` wire contract over BOTH serving transports, plus the
+router's streaming pass-through (ISSUE 10).
+
+Contract under test:
+
+- chunked NDJSON token frames arrive INCREMENTALLY (a token is on the
+  wire while the engine is still decoding — pinned against the
+  store-and-forward failure mode on the router too),
+- streamed greedy tokens are identical to the full-context recompute
+  oracle on both transports,
+- ``X-Request-Deadline-Ms`` evicts the slot (mid-stream: ``deadline``
+  termination frame; queued: plain 504),
+- drain (server-level or a displaced engine) terminates open streams
+  with a ``draining`` frame and refuses new submits with a clean 503 —
+  never the straggler fallback (satellite: _Batcher.submit_async racing
+  begin_drain).
+"""
+
+import http.client
+import json
+import socket
+import threading
+import time
+
+import jax
+import pytest
+
+from kubeflow_tpu.compute import generate as gen_lib
+from kubeflow_tpu.compute import serving
+from kubeflow_tpu.compute.models import transformer
+
+CFG = transformer.Config(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, max_seq=64,
+    dtype="float32", attention="dense", remat=False, scan_layers=True)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _engine(params, **kw):
+    kw.setdefault("max_slots", 1)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_context", 64)
+    kw.setdefault("name", "lm")
+    return gen_lib.GenerationEngine(params, CFG, **kw)
+
+
+@pytest.fixture(scope="module", params=["threaded", "async"])
+def served(request, params):
+    """One ModelServer + engine per transport; module-scoped because
+    every engine compiles its own programs."""
+    engine = _engine(params)
+    server = serving.ModelServer()
+    server.register_generator("lm", engine)
+    port = server.start(port=0, host="127.0.0.1",
+                        transport=request.param)
+    yield request.param, server, engine, port
+    server.stop()
+
+
+def _post_generate(port, body, headers=None, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    conn.request("POST", "/v1/models/lm:generate",
+                 json.dumps(body).encode(), hdrs)
+    return conn, conn.getresponse()
+
+
+def _frames(resp):
+    return [json.loads(ln) for ln in resp.read().splitlines()
+            if ln.strip()]
+
+
+class TestGenerateWire:
+    def test_stream_matches_reference_oracle(self, served, params):
+        _transport, _server, _engine_, port = served
+        for prompt in ([1, 2, 3], [5, 6, 7, 8, 9]):
+            conn, resp = _post_generate(
+                port, {"tokens": prompt, "max_tokens": 6})
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == \
+                "application/x-ndjson"
+            assert resp.headers.get("X-Served-Version") == "1"
+            frames = _frames(resp)
+            ref = gen_lib.reference_greedy_decode(params, CFG, prompt,
+                                                  6)
+            assert [f["token"] for f in frames if "token" in f] == ref
+            assert [f["index"] for f in frames if "token" in f] \
+                == list(range(len(ref)))
+            final = frames[-1]
+            assert final["done"] and final["reason"] == "length"
+            assert final["tokens"] == ref
+            conn.close()
+
+    def test_tokens_arrive_before_the_stream_closes(self, served):
+        """The incremental contract itself: with a slowed decode step,
+        the first token frame is readable while the engine still holds
+        the slot — the response is provably not store-and-forward."""
+        _transport, _server, engine, port = served
+        engine._step_sleep = 0.05
+        try:
+            conn, resp = _post_generate(
+                port, {"tokens": [1, 2, 3], "max_tokens": 20})
+            first = b""
+            while b"\n" not in first:
+                first += resp.read1(65536)
+            assert b'"token"' in first
+            # the generation is demonstrably still running
+            assert engine.occupancy() == 1
+            frames = [json.loads(ln)
+                      for ln in (first + resp.read()).splitlines()
+                      if ln.strip()]
+            assert frames[-1]["done"]
+            conn.close()
+        finally:
+            engine._step_sleep = 0.0
+
+    def test_keepalive_survives_a_stream(self, served):
+        _transport, _server, _engine_, port = served
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=60)
+        conn.request("POST", "/v1/models/lm:generate",
+                     json.dumps({"tokens": [4, 5],
+                                 "max_tokens": 3}).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        resp.read()
+        # same socket, next request: the chunked stream self-delimits
+        conn.request("GET", "/v1/models/lm")
+        resp2 = conn.getresponse()
+        payload = json.loads(resp2.read())
+        assert resp2.status == 200
+        snap = payload["generator"]
+        assert snap["slots"] == 1 and snap["occupied"] == 0
+        conn.close()
+
+    def test_bad_requests_are_400(self, served):
+        _transport, _server, _engine_, port = served
+        for body in ({"nope": 1}, {"tokens": []}, {"tokens": [999]},
+                     {"tokens": [1], "max_tokens": 0}, ["not-a-dict"]):
+            conn, resp = _post_generate(port, body)
+            assert resp.status == 400, body
+            assert "error" in json.loads(resp.read())
+            conn.close()
+
+    def test_unknown_engine_is_404(self, served):
+        _transport, _server, _engine_, port = served
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=60)
+        conn.request("POST", "/v1/models/ghost:generate",
+                     json.dumps({"tokens": [1]}).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 404
+        conn.close()
+
+    def test_queued_deadline_is_plain_504(self, served):
+        """A prompt whose deadline dies in the admission queue never
+        streams: it answers with the unary taxonomy (504), exactly
+        like a batcher-shed predict."""
+        _transport, _server, engine, port = served
+        engine._step_sleep = 0.05
+        try:
+            blocker_conn, blocker = _post_generate(
+                port, {"tokens": [1, 2], "max_tokens": 30})
+            time.sleep(0.1)       # the single slot is now occupied
+            conn, resp = _post_generate(
+                port, {"tokens": [3, 4], "max_tokens": 5},
+                headers={"X-Request-Deadline-Ms": "40"})
+            assert resp.status == 504
+            assert "deadline" in json.loads(resp.read())["error"]
+            conn.close()
+            blocker.read()
+            blocker_conn.close()
+        finally:
+            engine._step_sleep = 0.0
+
+    def test_deadline_mid_stream_evicts_with_termination_frame(
+            self, served):
+        _transport, _server, engine, port = served
+        engine._step_sleep = 0.04
+        try:
+            conn, resp = _post_generate(
+                port, {"tokens": [1, 2, 3], "max_tokens": 50},
+                headers={"X-Request-Deadline-Ms": "250"})
+            assert resp.status == 200     # already streaming
+            frames = _frames(resp)
+            final = frames[-1]
+            assert final["done"] and final["reason"] == "deadline"
+            assert 0 < len(final["tokens"]) < 50
+            conn.close()
+        finally:
+            engine._step_sleep = 0.0
+        assert engine.occupancy() == 0    # the slot was freed
+
+
+class TestDrainSemantics:
+    """Satellite: drain must evict generation slots gracefully (a
+    partial-stream termination frame) and racing submits get a clean
+    503 — never the straggler fallback."""
+
+    @pytest.mark.parametrize("transport", ["threaded", "async"])
+    def test_admin_drain_terminates_streams_then_503s(
+            self, params, transport):
+        engine = _engine(params)
+        engine._step_sleep = 0.04
+        server = serving.ModelServer()
+        server.register_generator("lm", engine)
+        port = server.start(port=0, host="127.0.0.1",
+                            transport=transport)
+        try:
+            conn, resp = _post_generate(
+                port, {"tokens": [1, 2], "max_tokens": 60})
+            assert resp.status == 200
+            time.sleep(0.15)          # a few tokens on the wire
+            admin = http.client.HTTPConnection("127.0.0.1", port,
+                                               timeout=30)
+            admin.request("POST", "/admin/drain", b"{}",
+                          {"Content-Type": "application/json"})
+            drain_resp = admin.getresponse()
+            assert drain_resp.status == 200
+            drain_resp.read()
+            frames = _frames(resp)
+            final = frames[-1]
+            assert final["done"] and final["reason"] == "draining"
+            assert final["tokens"]           # partial, not empty
+            conn.close()
+            # racing/subsequent submits: clean 503 + Retry-After
+            c2, r2 = _post_generate(port,
+                                    {"tokens": [5], "max_tokens": 2})
+            assert r2.status == 503
+            assert r2.headers.get("Retry-After") == "1"
+            assert "draining" in json.loads(r2.read())["error"]
+            c2.close()
+            admin.close()
+        finally:
+            server.stop()
+
+    @pytest.mark.parametrize("transport", ["threaded", "async"])
+    def test_displaced_engine_drains_new_engine_serves(
+            self, params, transport):
+        """register_generator over a served name: the OLD engine's
+        open stream gets the draining termination frame; the NEW
+        engine answers subsequent requests."""
+        old = _engine(params)
+        old._step_sleep = 0.04
+        server = serving.ModelServer()
+        server.register_generator("lm", old)
+        port = server.start(port=0, host="127.0.0.1",
+                            transport=transport)
+        try:
+            conn, resp = _post_generate(
+                port, {"tokens": [1, 2], "max_tokens": 60})
+            assert resp.status == 200
+            time.sleep(0.15)
+            new = _engine(params)
+            server.register_generator("lm", new)   # displaces old
+            frames = _frames(resp)
+            assert frames[-1]["done"]
+            assert frames[-1]["reason"] == "draining"
+            conn.close()
+            c2, r2 = _post_generate(port,
+                                    {"tokens": [5, 6],
+                                     "max_tokens": 3})
+            assert r2.status == 200
+            assert len([f for f in _frames(r2) if "token" in f]) == 3
+            c2.close()
+        finally:
+            server.stop()
+
+
+class TestRouterStreamPassThrough:
+    """Satellite: web/router.py must proxy chunked :generate responses
+    WITHOUT store-and-forward buffering (the documented :predictStream
+    caveat must not apply to token streams). A gated fake upstream
+    proves it: the router relays frame 1 while the upstream HOLDS the
+    stream open — a buffering proxy could not."""
+
+    def _gated_upstream(self, release):
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(4)
+
+        def serve():
+            while True:
+                try:
+                    client, _ = lsock.accept()
+                except OSError:
+                    return
+                data = b""
+                try:
+                    while b"\r\n\r\n" not in data:
+                        chunk = client.recv(65536)
+                        if not chunk:
+                            raise OSError
+                        data += chunk
+                    head, _, rest = data.partition(b"\r\n\r\n")
+                    length = 0
+                    for ln in head.split(b"\r\n"):
+                        if ln.lower().startswith(b"content-length:"):
+                            length = int(ln.split(b":")[1])
+                    while len(rest) < length:
+                        rest += client.recv(65536)
+                    if b":generate" not in head.split(b"\r\n")[0]:
+                        client.sendall(
+                            b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n"
+                            b"Content-Type: application/json\r\n\r\n{}")
+                        client.close()
+                        continue
+                    client.sendall(
+                        b"HTTP/1.1 200 OK\r\n"
+                        b"Content-Type: application/x-ndjson\r\n"
+                        b"Transfer-Encoding: chunked\r\n\r\n")
+                    frame = b'{"token": 7, "index": 0}\n'
+                    client.sendall(
+                        f"{len(frame):X}\r\n".encode() + frame
+                        + b"\r\n")
+                    release.wait(timeout=30)
+                    fin = (b'{"done": true, "reason": "length", '
+                           b'"tokens": [7]}\n')
+                    client.sendall(
+                        f"{len(fin):X}\r\n".encode() + fin
+                        + b"\r\n0\r\n\r\n")
+                    client.close()
+                except OSError:
+                    pass
+
+        threading.Thread(target=serve, daemon=True).start()
+        return lsock
+
+    def test_tokens_relay_before_the_stream_closes(self):
+        from kubeflow_tpu.web import router as router_lib
+        release = threading.Event()
+        upstream = self._gated_upstream(release)
+        up_port = upstream.getsockname()[1]
+        core = router_lib.RouterCore(health_interval=999)
+        core.set_backends([f"127.0.0.1:{up_port}"])
+        app = router_lib.create_app(core=core)
+        httpd = app.serve(port=0, host="127.0.0.1")
+        try:
+            port = httpd.server_address[1]
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30)
+            conn.request("POST", "/v1/models/lm:generate",
+                         json.dumps({"tokens": [1]}).encode(),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == \
+                "application/x-ndjson"
+            first = b""
+            while b"\n" not in first:
+                chunk = resp.read1(65536)
+                assert chunk, "stream closed before first frame"
+                first += chunk
+            # frame 1 arrived while the upstream still HOLDS the
+            # stream open: the regression this test exists to pin
+            assert json.loads(first.splitlines()[0]) == {
+                "token": 7, "index": 0}
+            release.set()
+            rest = resp.read()
+            assert b'"done": true' in rest
+            # outstanding accounting drained with the stream
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                snap = core.snapshot()[0]
+                if snap["outstanding"] == 0:
+                    break
+                time.sleep(0.02)
+            assert core.snapshot()[0]["outstanding"] == 0
+            conn.close()
+        finally:
+            release.set()
+            httpd.shutdown()
+            core.stop()
+            upstream.close()

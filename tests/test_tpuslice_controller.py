@@ -1416,30 +1416,107 @@ class TestVectorizedStudy:
                   for t in study["status"]["trials"]}
         assert states[members[0]] == "Running"
 
-    def test_failed_sweep_pod_fails_unreported_members(
+    def _fail_pod(self, store, pod, reported=None):
+        """Crash a sweep pod, optionally after reporting finals for
+        ``reported`` ({index: value})."""
+        import json as _json
+        from kubeflow_tpu.core import meta as m
+        if reported:
+            lines = "\n".join(
+                "trial-metric " + _json.dumps(
+                    {"name": "accuracy", "value": v, "trial": i})
+                for i, v in reported.items())
+            m.set_annotation(pod, "kubeflow.org/pod-logs", lines)
+        pod["status"] = {"phase": "Failed"}
+        store.update(pod)
+
+    def test_failed_sweep_pod_repacks_survivors_once(
             self, store, manager):
+        """ROADMAP follow-up (PR 5 list): a sweep-pod failure no
+        longer silently fails unreported members — survivors are
+        re-bucketed into a fresh "-r1" pod (one bounded retry), with
+        sweep_repack_total counting them."""
+        from kubeflow_tpu.controllers.tpuslice import SWEEP_REPACKS
         self._mgr(store, manager)
         self._study(store)
         manager.run_sync()
         pod = self._sweep_pods(store)[0]
         members = [int(x) for x in pod["metadata"]["annotations"]
                    ["kubeflow.org/sweep-trials"].split(",")]
+        before = SWEEP_REPACKS.value("vec")
         # pod crashes after reporting only its first member
-        import json as _json
-        from kubeflow_tpu.core import meta as m
-        line = "trial-metric " + _json.dumps(
-            {"name": "accuracy", "value": 0.7, "trial": members[0]})
-        m.set_annotation(pod, "kubeflow.org/pod-logs", line)
-        pod["status"] = {"phase": "Failed"}
-        store.update(pod)
+        self._fail_pod(store, pod, {members[0]: 0.7})
         manager.run_sync()
         study = store.get("kubeflow.org/v1alpha1", "StudyJob", "vec",
                           "default")
-        states = {t["index"]: t["state"]
-                  for t in study["status"]["trials"]}
-        assert states[members[0]] == "Succeeded"   # its line was final
+        trials = {t["index"]: t for t in study["status"]["trials"]}
+        assert trials[members[0]]["state"] == "Succeeded"  # final line
+        repack_pods = [p for p in self._sweep_pods(store)
+                       if p["metadata"]["name"].endswith("-r1")]
+        assert len(repack_pods) == 1
+        ann = repack_pods[0]["metadata"]["annotations"][
+            "kubeflow.org/sweep-trials"]
+        assert sorted(int(x) for x in ann.split(",")) == members[1:]
         for i in members[1:]:
-            assert states[i] == "Failed"
+            assert trials[i]["state"] == "Running"     # NOT failed
+            assert trials[i]["repacked"] is True
+            assert trials[i]["sweep"].endswith("-r1")
+        assert SWEEP_REPACKS.value("vec") - before == len(members) - 1
+
+    def test_repacked_survivors_can_still_succeed(self, store, manager):
+        self._mgr(store, manager)
+        self._study(store)
+        manager.run_sync()
+        pod = self._sweep_pods(store)[0]
+        members = [int(x) for x in pod["metadata"]["annotations"]
+                   ["kubeflow.org/sweep-trials"].split(",")]
+        self._fail_pod(store, pod)      # nothing reported at all
+        manager.run_sync()
+        repack_pod = next(p for p in self._sweep_pods(store)
+                          if p["metadata"]["name"].endswith("-r1"))
+        # finish every other pod normally, and the repack pod too
+        study = store.get("kubeflow.org/v1alpha1", "StudyJob", "vec",
+                          "default")
+        by_pod = {}
+        for t in study["status"]["trials"]:
+            by_pod.setdefault(t["sweep"], []).append(t["index"])
+        for p in self._sweep_pods(store):
+            name = p["metadata"]["name"]
+            if name in by_pod:
+                self._finish(store, p,
+                             {i: 0.5 + 0.1 * i for i in by_pod[name]})
+        manager.run_sync()
+        study = store.get("kubeflow.org/v1alpha1", "StudyJob", "vec",
+                          "default")
+        assert study["status"]["phase"] == "Completed"
+        for t in study["status"]["trials"]:
+            assert t["state"] == "Succeeded"
+            assert t["objectiveValue"] == 0.5 + 0.1 * t["index"]
+        assert {i for i in by_pod[repack_pod["metadata"]["name"]]} \
+            == set(members)
+
+    def test_second_sweep_pod_failure_is_terminal(self, store, manager):
+        self._mgr(store, manager)
+        self._study(store)
+        manager.run_sync()
+        pod = self._sweep_pods(store)[0]
+        members = [int(x) for x in pod["metadata"]["annotations"]
+                   ["kubeflow.org/sweep-trials"].split(",")]
+        self._fail_pod(store, pod, {members[0]: 0.7})
+        manager.run_sync()
+        repack_pod = next(p for p in self._sweep_pods(store)
+                          if p["metadata"]["name"].endswith("-r1"))
+        # the relaunched pod fails too: no second repack, members fail
+        self._fail_pod(store, repack_pod)
+        manager.run_sync()
+        study = store.get("kubeflow.org/v1alpha1", "StudyJob", "vec",
+                          "default")
+        trials = {t["index"]: t for t in study["status"]["trials"]}
+        assert trials[members[0]]["state"] == "Succeeded"
+        for i in members[1:]:
+            assert trials[i]["state"] == "Failed"
+        assert not any(p["metadata"]["name"].endswith("-r1-r1")
+                       for p in self._sweep_pods(store))
 
     def test_metrics_configmap_still_wins(self, store, manager):
         self._mgr(store, manager)
