@@ -126,6 +126,15 @@ def main():
         "serving_generate_queue_wait_seconds",
         "serving_generate_slot_occupancy_slots",
         "serving_generate_evictions_total",
+        # prefix KV-cache reuse surface (ISSUE 12): hit/miss/skip
+        # economics plus cache residency/reclaim pressure — what
+        # bench.py generate --shared-prefix and the loadtest's
+        # --shared-prefix verdict read
+        "serving_generate_prefix_hits_total",
+        "serving_generate_prefix_misses_total",
+        "serving_generate_prefix_tokens_skipped_total",
+        "serving_generate_prefix_cached_blocks",
+        "serving_generate_prefix_reclaims_total",
         # sweep-pod failure re-packing (ROADMAP PR 5 follow-up)
         "sweep_repack_total",
     }

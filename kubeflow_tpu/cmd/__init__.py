@@ -274,6 +274,9 @@ def model_server(argv=()):
             block_size=int(os.environ.get("GEN_BLOCK_SIZE", "16")),
             kv_dtype=os.environ.get("GEN_KV_DTYPE") or None,
             admission=os.environ.get("GEN_ADMISSION", "continuous"),
+            prefix_cache=os.environ.get(
+                "GEN_PREFIX_CACHE", "1").lower() not in (
+                "0", "false", "no", "off"),
             name=name)
         server.register_generator(name, engine)
     elif module:

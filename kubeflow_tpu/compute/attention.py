@@ -72,6 +72,40 @@ def decode_attention(q, k, v, lengths, scale=None):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def chunk_attention(q, k, v, prefix_len, scale=None):
+    """Attention for a CONTIGUOUS chunk of new rows appended after a
+    cached prefix — the partial-prefill read of the generation engine's
+    prefix KV-cache reuse (compute/generate.py).
+
+    ``q`` is the chunk, [B, S, H, D], whose rows sit at global
+    positions ``prefix_len + arange(S)``; ``k``/``v`` are the gathered
+    prefix pages padded to a STATIC length P (valid prefix columns are
+    ``col < prefix_len``) concatenated with the chunk's own K/V:
+    [B, P+S, H, D]. The mask is two-part: prefix columns are valid iff
+    they hold real cached positions (``col < prefix_len``); chunk
+    columns are causal within the chunk (row r sees chunk cols
+    ``<= r``). ``prefix_len`` may be a traced scalar, so one compiled
+    program serves every prefix length at a given chunk size.
+
+    Numerics deliberately mirror :func:`dense_attention` /
+    :func:`decode_attention` op for op (same einsum contractions, fp32
+    softmax, probs cast to ``v.dtype``): a masked column contributes an
+    exact zero, so a chunk row's softmax is over exactly the value set
+    a full-context causal forward of the same sequence sees — the
+    foundation of the prefix-cache token-identity contract."""
+    q = _scale(q, scale)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    S = q.shape[1]
+    P = k.shape[1] - S
+    rows = jnp.arange(S)[:, None]                   # chunk-local rows
+    cols = jnp.arange(k.shape[1])[None, :]
+    valid = jnp.where(cols < P, cols < prefix_len, cols - P <= rows)
+    logits = jnp.where(valid[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
 def _block(carry, kv, q, q_offset, k_offset, causal, scale):
     """One blockwise-softmax accumulation step (fp32 state)."""
     o, m, l = carry

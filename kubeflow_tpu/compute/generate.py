@@ -30,6 +30,22 @@ built on this repo's own kernels):
   their blocks return to the pool, and queued prompts are admitted
   into the freed slots before the next step — the Podracer "one
   resident program, many logical workers" shape applied to decode.
+- **Radix-tree prefix KV-cache reuse** (``prefix_cache=True``, the
+  default): a trie keyed on FULL ``block_size``-token blocks of prompt
+  tokens maps every previously-seen full-block prefix to the
+  refcounted physical pages that already hold its K/V. Admission walks
+  the trie, attaches the matched pages to the new sequence's block
+  table (refcount++ — pages are shared, never copied) and runs a
+  **partial prefill** over only the unshared suffix at the right
+  positional offset (``attention.chunk_attention``), so N concurrent
+  requests sharing a system prompt pay its prefill once. Eviction
+  becomes cache-retain: a finished sequence's trie-indexed blocks keep
+  their K/V at refcount zero and are reclaimed LRU-on-demand (leaf
+  first) only under pool pressure. Shared pages are never written —
+  matching stops at full-block boundaries, so a sequence's first
+  self-written page is always a fresh one. Worst-case admission
+  reservation counts only unshared + writable blocks: shared prefixes
+  *increase* effective pool capacity.
 - **Optional int8 KV** (``kv_dtype="int8"``): cache blocks store int8
   + per-(position, head) float32 scales (``quantize.kv_quantize``, the
   traceable twin of the weight path's ``quantize_array``); the decode
@@ -107,6 +123,33 @@ _EVICTIONS_TOTAL = obs_metrics.REGISTRY.counter(
     "mechanism of token-level continuous batching, so eos/length here "
     "are normal completions, not failures",
     ("model", "reason"))
+_PREFIX_HITS_TOTAL = obs_metrics.REGISTRY.counter(
+    "serving_generate_prefix_hits_total",
+    "Admissions whose prompt matched >=1 full cached block in the "
+    "prefix trie (the shared tokens skipped prefill entirely)",
+    ("model",))
+_PREFIX_MISSES_TOTAL = obs_metrics.REGISTRY.counter(
+    "serving_generate_prefix_misses_total",
+    "Admissions with no cached-prefix match (full prefill paid); "
+    "hits/(hits+misses) is the prefix-cache hit ratio",
+    ("model",))
+_PREFIX_TOKENS_SKIPPED_TOTAL = obs_metrics.REGISTRY.counter(
+    "serving_generate_prefix_tokens_skipped_total",
+    "Prompt tokens whose prefill was skipped because their K/V was "
+    "already cached — rate() of this is the prefill compute the "
+    "prefix cache is saving",
+    ("model",))
+_PREFIX_CACHED_BLOCKS = obs_metrics.REGISTRY.gauge(
+    "serving_generate_prefix_cached_blocks",
+    "Physical cache blocks currently indexed by the prefix trie "
+    "(reclaimable-at-zero-ref plus pinned-by-live-sequences)",
+    ("model",))
+_PREFIX_RECLAIMS_TOTAL = obs_metrics.REGISTRY.counter(
+    "serving_generate_prefix_reclaims_total",
+    "Cached zero-ref blocks reclaimed LRU-on-demand to serve a new "
+    "allocation — sustained rate means the pool is too small for the "
+    "working set of shared prefixes",
+    ("model",))
 
 
 class GenerationHandle:
@@ -119,7 +162,8 @@ class GenerationHandle:
     __slots__ = ("prompt", "max_tokens", "eos_id", "deadline",
                  "on_token", "on_done", "rt", "out_tokens", "reason",
                  "error", "cancelled", "cancel_reason", "enqueued",
-                 "enqueued_w", "_done")
+                 "enqueued_w", "prefix_tokens_skipped",
+                 "prefill_seconds", "_engine", "_done")
 
     def __init__(self, prompt, max_tokens, eos_id, deadline,
                  on_token, on_done, rt):
@@ -135,9 +179,15 @@ class GenerationHandle:
         self.error = None         # set when the finish is an error the
         self.cancelled = False    # transport should map to a status
         self.cancel_reason = "cancelled"
+        self.prefix_tokens_skipped = 0   # prompt tokens served from the
+        self.prefill_seconds = None      # prefix cache; prefill wall —
+        #                                  both set when prefill runs,
+        #                                  surfaced per-request in the
+        #                                  stream's done frame
         self.enqueued = time.perf_counter()
         self.enqueued_w = time.time()
-        self._done = threading.Event()
+        self._engine = None       # set by submit(); result(timeout)
+        self._done = threading.Event()   # cancels through it
 
     def wait(self, timeout=None):
         return self._done.wait(timeout)
@@ -147,8 +197,16 @@ class GenerationHandle:
 
     def result(self, timeout=None):
         """→ ``(generated_tokens, finish_reason)``; raises the finish
-        error when the request failed before emitting any token."""
+        error when the request failed before emitting any token.
+
+        A ``timeout`` makes this a CONSUMING call: on expiry the
+        request is cancelled (reason ``abandoned``) before the
+        ``TimeoutError`` raises, so an abandoned blocking caller can
+        never leave the request queued/decoding with no consumer,
+        silently burning a decode slot and its cache blocks."""
         if not self._done.wait(timeout):
+            if self._engine is not None:
+                self._engine.cancel(self, reason="abandoned")
             raise TimeoutError("generation did not finish in time")
         if self.error is not None and not self.out_tokens:
             raise self.error
@@ -170,6 +228,23 @@ class _Slot:
         self.decode_start_w = time.time()
 
 
+class _PrefixNode:
+    """One edge of the prefix trie: ``key`` is the FULL block of prompt
+    token ids this node's physical page holds the K/V for, given the
+    path from the root. Causality makes the mapping sound: position
+    ``i``'s K/V depends only on tokens ``0..i``, so any prompt walking
+    the same block path reads bit-identical pages."""
+
+    __slots__ = ("key", "block", "parent", "children", "last_used")
+
+    def __init__(self, key, block, parent):
+        self.key = key             # tuple of block_size token ids
+        self.block = block         # physical page holding the K/V
+        self.parent = parent
+        self.children = {}
+        self.last_used = time.monotonic()
+
+
 class GenerationEngine:
     """Autoregressive decode server for one TransformerLM.
 
@@ -186,7 +261,11 @@ class GenerationEngine:
     - ``admission``: ``"continuous"`` (token-level continuous
       batching, the default) or ``"drain"`` (drain-then-refill — only
       admit into an EMPTY batch; exists as the bench baseline the
-      continuous policy is measured against).
+      continuous policy is measured against),
+    - ``prefix_cache``: radix-tree prefix KV reuse (default on).
+      ``False`` restores free-immediately eviction and full prefill
+      for every prompt — the cold-cache baseline ``bench.py
+      generate --shared-prefix`` measures against.
 
     Threading: ONE engine thread owns every device call and all slot
     state; ``submit``/``cancel``/``begin_drain`` are thread-safe and
@@ -197,7 +276,8 @@ class GenerationEngine:
     def __init__(self, params, config, *, max_slots=4, block_size=16,
                  max_context=None, num_blocks=None, kv_dtype=None,
                  name="model", version=1, eos_id=None,
-                 default_max_tokens=64, admission="continuous"):
+                 default_max_tokens=64, admission="continuous",
+                 prefix_cache=True):
         if config.moe_experts or config.pipeline_stages > 1:
             raise ValueError(
                 "GenerationEngine supports dense TransformerLM configs "
@@ -246,17 +326,43 @@ class GenerationEngine:
         # TPU, but this toolchain's donation+serialization landmine
         # (mesh.py notes) makes plain jit the safe default
         self._prefill_jit = jax.jit(self._prefill_step)
+        self._prefill_cached_jit = jax.jit(self._prefill_cached_step)
         self._decode_jit = jax.jit(self._decode_step)
         self._free = list(range(self.num_blocks))
         self._slots = [None] * self.max_slots
         self._queue = collections.deque()
         self._cond = threading.Condition()
+        # prefix trie state (every mutation under self._cond so
+        # blocks_view() can take one consistent snapshot):
+        # - _ref[b]: live references = block-table memberships plus
+        #   in-flight prefill holds; a trie-indexed block at ref 0 is
+        #   CACHED (reclaimable LRU-on-demand), unindexed at ref 0 is
+        #   on the free list
+        # - _root/_node_by_block: the radix index over full prompt
+        #   blocks; _inflight: blocks held by the prefill in progress
+        #   (popped from the pool, not yet in a slot's table)
+        self.prefix_cache = bool(prefix_cache)
+        self._ref = [0] * self.num_blocks
+        self._root = _PrefixNode(None, None, None)
+        self._node_by_block = {}
+        self._inflight = []
+        # O(1)-amortized reclaim bookkeeping, maintained at every ref
+        # 0<->1 transition (a warm cache keeps the free list empty by
+        # design, so the decode hot path's lazy allocation must not
+        # scan the trie): _reclaimable is an insertion-ordered set of
+        # zero-ref LEAF nodes (dict keys; order == became-reclaimable
+        # order == LRU), _n_reclaimable counts ALL zero-ref cached
+        # blocks (leaves and interiors) for _available_blocks
+        self._reclaimable = {}
+        self._n_reclaimable = 0
         self._draining = False
         self._stop = False
         self._step_sleep = 0.0    # test/bench knob: fake device time
         # aggregate counters bench reads without scraping /metrics
         self.stats = {"prefills": 0, "decode_steps": 0,
-                      "decode_token_slots": 0, "tokens": 0}
+                      "decode_token_slots": 0, "tokens": 0,
+                      "prefix_hits": 0, "prefix_misses": 0,
+                      "prefix_tokens_skipped": 0, "prefix_reclaims": 0}
         self.thread = threading.Thread(target=self._loop, daemon=True,
                                        name=f"generate-{name}")
         self.thread.start()
@@ -304,6 +410,7 @@ class GenerationEngine:
         eos = self.eos_id if eos_id is None else int(eos_id)
         handle = GenerationHandle(tokens, max_tokens, eos, deadline,
                                   on_token, on_done, rt)
+        handle._engine = self     # result(timeout) cancels through it
         with self._cond:
             if self._draining or self._stop:
                 raise serving_lib.DrainingError(
@@ -353,20 +460,72 @@ class GenerationEngine:
             return sum(1 for s in self._slots if s is not None)
 
     def snapshot(self):
-        """Operator view for ``/v1/models/<name>`` (handle_get)."""
+        """Operator view for ``/v1/models/<name>`` (handle_get).
+
+        ``free_blocks`` means IMMEDIATELY ALLOCATABLE: the free list
+        plus cached zero-ref blocks the LRU reclaimer can hand out on
+        demand. A warm prefix cache keeps the raw free list near zero
+        by design — an operator reading that as pool exhaustion would
+        page on a healthy cache, so the raw figure lives inside the
+        ``prefix_cache`` breakdown (``reclaimable_blocks`` vs
+        ``pinned_blocks``) instead of headlining."""
         with self._cond:
             occupied = sum(1 for s in self._slots if s is not None)
+            reclaimable = self._n_reclaimable
+            hits = self.stats["prefix_hits"]
+            misses = self.stats["prefix_misses"]
             return {
                 "slots": self.max_slots,
                 "occupied": occupied,
                 "queued": len(self._queue),
                 "blocks": self.num_blocks,
-                "free_blocks": len(self._free),
+                "free_blocks": len(self._free) + reclaimable,
                 "block_size": self.block_size,
                 "max_context": self.max_context,
                 "kv_dtype": self.kv_dtype or str(
                     self.config.compute_dtype),
                 "draining": self._draining,
+                "prefix_cache": {
+                    "enabled": self.prefix_cache,
+                    "cached_blocks": len(self._node_by_block),
+                    "reclaimable_blocks": reclaimable,
+                    "pinned_blocks":
+                        len(self._node_by_block) - reclaimable,
+                    "hits": hits,
+                    "misses": misses,
+                    "hit_ratio": round(hits / (hits + misses), 4)
+                        if hits + misses else None,
+                    "tokens_skipped":
+                        self.stats["prefix_tokens_skipped"],
+                    "reclaims": self.stats["prefix_reclaims"],
+                },
+            }
+
+    def blocks_view(self):
+        """One consistent snapshot of the block-pool partition (every
+        block-state mutation happens under ``_cond``, so this is the
+        invariant surface the churn tests assert on): every physical
+        block is in EXACTLY one of ``free`` (free list), ``cached``
+        (trie-indexed, refcount 0, reclaimable) or ``referenced``
+        (refcount > 0: in >=1 slot's block table or held by the
+        in-flight prefill), and ``refcounts[b]`` equals b's live
+        table/in-flight membership count."""
+        with self._cond:
+            table_refs = collections.Counter(self._inflight)
+            for s in self._slots:
+                if s is not None:
+                    table_refs.update(s.blocks)
+            return {
+                "free": sorted(self._free),
+                "cached": sorted(b for b in self._node_by_block
+                                 if self._ref[b] == 0),
+                "referenced": sorted(b for b in range(self.num_blocks)
+                                     if self._ref[b] > 0),
+                "refcounts": list(self._ref),
+                "table_refs": dict(table_refs),
+                # the O(1) bookkeeping the allocator actually uses —
+                # the churn test asserts it agrees with the recount
+                "reclaimable_count": self._n_reclaimable,
             }
 
     # ---------------------------------------------------- engine loop
@@ -463,35 +622,142 @@ class GenerationEngine:
 
     # ------------------------------------------------------- admission
 
-    def _bucket(self, n):
-        """Prompt-length bucket: the platform bucketing policy, capped
-        at the per-slot cache capacity."""
-        return min(serving_lib.bucket_for(n),
-                   self.blocks_per_slot * self.block_size)
+    def _worst_case_blocks(self, prompt_len, max_tokens,
+                           matched_blocks=0):
+        """Worst-case blocks a sequence will OWN-OR-SHARE across its
+        whole life — the padded (partial) prefill write plus one KV
+        write per decode input token — minus the ``matched_blocks``
+        already resident in the prefix cache. At submit time (match
+        unknown: ``matched_blocks=0``) this is the cold ceiling; at
+        admission it counts only unshared + writable blocks, which is
+        how shared prefixes INCREASE effective pool capacity."""
+        offset = matched_blocks * self.block_size
+        padded_suffix = self._suffix_padded(prompt_len, offset)
+        total = max(offset + padded_suffix, prompt_len + max_tokens)
+        return -(-total // self.block_size) - matched_blocks
 
-    def _worst_case_blocks(self, prompt_len, max_tokens):
-        """Worst-case blocks for a sequence's whole life: the padded
-        prefill write plus one KV write per decode INPUT token (the
-        final emitted token is never fed back, but +max_tokens is the
-        simple safe bound)."""
-        padded = self._bucket(prompt_len)
-        total = max(padded, prompt_len + max_tokens)
-        return -(-total // self.block_size)
+    def _suffix_padded(self, prompt_len, offset):
+        """Padded length of the prefill suffix starting at ``offset``:
+        the platform bucket, clamped so the padded tail never runs
+        past the per-slot cache capacity."""
+        cap = self.blocks_per_slot * self.block_size
+        return min(serving_lib.bucket_for(prompt_len - offset),
+                   cap - offset)
 
-    def _blocks_needed(self, handle):
-        return self._worst_case_blocks(len(handle.prompt),
-                                       handle.max_tokens)
+    def _match_prefix_locked(self, prompt):
+        """Walk the trie over FULL blocks of ``prompt`` → the matched
+        node path (lock held). Matching is capped one token short of
+        the prompt so at least one suffix token always goes through
+        prefill — the first generated token's logits come from the
+        forward of the last prompt position, so a full-prompt hit
+        still recomputes its final block (token-identity over
+        cleverness)."""
+        nodes = []
+        if not self.prefix_cache:
+            return nodes
+        bs = self.block_size
+        node = self._root
+        for j in range((len(prompt) - 1) // bs):
+            child = node.children.get(tuple(prompt[j * bs:(j + 1) * bs]))
+            if child is None:
+                break
+            nodes.append(child)
+            node = child
+        return nodes
 
     def _available_blocks(self):
+        """Immediately allocatable blocks (free list + cached
+        zero-ref, which reclaim LRU-on-demand) minus the future lazy
+        allocations already promised to running slots."""
         reserved = sum(s.reserve - len(s.blocks)
                        for s in self._slots if s is not None)
-        return len(self._free) - reserved
+        return len(self._free) + self._n_reclaimable - reserved
+
+    def _alloc_block_locked(self):
+        """One writable physical block (lock held): the free list,
+        else the least-recently-used cached zero-ref LEAF of the trie
+        (leaf-first keeps every cached path rooted; reclaiming a leaf
+        may expose its parent as the next candidate). The admission
+        reservation guarantees this cannot fail for a running
+        sequence. The block comes back referenced (ref 1)."""
+        if self._free:
+            block = self._free.pop()
+        else:
+            if not self._reclaimable:
+                raise RuntimeError(
+                    "block pool exhausted despite admission "
+                    "reservation — refcount accounting bug")
+            victim = next(iter(self._reclaimable))     # LRU = oldest
+            self._detach_node_locked(victim)
+            self.stats["prefix_reclaims"] += 1
+            _PREFIX_RECLAIMS_TOTAL.labels(self.name).inc()
+            block = victim.block
+        self._ref[block] += 1
+        return block
+
+    def _detach_node_locked(self, node):
+        """Drop a ZERO-REF leaf from the trie (reclaim path only).
+        Its parent may thereby become a reclaim candidate itself."""
+        self._reclaimable.pop(node, None)
+        self._n_reclaimable -= 1
+        parent = node.parent
+        del parent.children[node.key]
+        del self._node_by_block[node.block]
+        if parent.block is not None and not parent.children \
+                and self._ref[parent.block] == 0:
+            self._reclaimable[parent] = None
+        _PREFIX_CACHED_BLOCKS.labels(self.name).set(
+            len(self._node_by_block))
+
+    def _release_blocks_locked(self, blocks):
+        """Drop one reference from each block: zero-ref blocks return
+        to the cache (trie-indexed — eviction is cache-RETAIN) or the
+        free list (unindexed: partial tail pages, decode-written
+        pages, failed-prefill pages)."""
+        now = time.monotonic()
+        for b in blocks:
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                node = self._node_by_block.get(b)
+                if node is None:
+                    self._free.append(b)
+                else:
+                    node.last_used = now
+                    self._n_reclaimable += 1
+                    if not node.children:
+                        # (re-)append at the tail: iteration order
+                        # stays became-reclaimable order == LRU
+                        self._reclaimable.pop(node, None)
+                        self._reclaimable[node] = None
+
+    def _index_prompt_locked(self, prompt, blocks, matched):
+        """Insert the prompt's FULL blocks (only those — a partial
+        tail block is written during decode and must never be shared)
+        into the trie under the matched path. An existing child key
+        can only mean the match was capped at the prompt's final full
+        block (see _match_prefix_locked); the duplicate fresh page
+        stays un-indexed and frees on eviction."""
+        bs = self.block_size
+        node = matched[-1] if matched else self._root
+        for j in range(len(matched), len(prompt) // bs):
+            key = tuple(prompt[j * bs:(j + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                child = _PrefixNode(key, blocks[j], node)
+                node.children[key] = child
+                self._node_by_block[blocks[j]] = child
+            node = child
+        _PREFIX_CACHED_BLOCKS.labels(self.name).set(
+            len(self._node_by_block))
 
     def _admit(self):
         """Move queued prompts into free slots while capacity lasts.
         FIFO head-of-line: a prompt too big for the current free pool
         blocks later (smaller) prompts — predictable fairness over
-        packing cleverness."""
+        packing cleverness. The head's prefix-cache match is computed
+        here so the reservation gate charges only its UNSHARED blocks
+        (matched zero-ref blocks leave the reclaimable pool when
+        pinned, so they're debited explicitly)."""
         refilling = False    # drain policy: an empty batch REFILLS to
         #                      capacity in one admission round, then
         #                      no more admissions until it drains
@@ -508,10 +774,16 @@ class GenerationEngine:
                 if free_slot is None:
                     return
                 handle = self._queue[0]
-                if not handle.cancelled and (
-                        self._available_blocks()
-                        < self._blocks_needed(handle)):
-                    return       # block-pool pressure: wait for evicts
+                matched = []
+                if not handle.cancelled:
+                    matched = self._match_prefix_locked(handle.prompt)
+                    needed = self._worst_case_blocks(
+                        len(handle.prompt), handle.max_tokens,
+                        len(matched))
+                    pinning = sum(1 for n in matched
+                                  if self._ref[n.block] == 0)
+                    if self._available_blocks() - pinning < needed:
+                        return   # block-pool pressure: wait for evicts
                 self._queue.popleft()
             refilling = True
             if handle.cancelled:
@@ -526,16 +798,43 @@ class GenerationEngine:
                                  f"generation slot (waited "
                                  f"{waited * 1000:.0f} ms)"))
                 continue
-            self._prefill(free_slot, handle)
+            self._prefill(free_slot, handle, matched)
 
-    def _prefill(self, slot_idx, handle):
+    def _prefill(self, slot_idx, handle, matched=()):
+        """Prefill ``handle`` into ``slot_idx``. With a trie match the
+        matched pages are pinned (ref++) and attached to the block
+        table, and the CACHED prefill program runs over only the
+        unshared suffix at positional offset ``len(matched)·bs`` —
+        the shared tokens' forward is skipped entirely."""
         prompt_len = len(handle.prompt)
-        padded = self._bucket(prompt_len)
+        offset = len(matched) * self.block_size
+        suffix_len = prompt_len - offset
+        padded = self._suffix_padded(prompt_len, offset)
         n_blocks = -(-padded // self.block_size)
+        now = time.monotonic()
         with self._cond:
-            blocks = [self._free.pop() for _ in range(n_blocks)]
+            for node in matched:
+                if self._ref[node.block] == 0:     # leaves the
+                    self._n_reclaimable -= 1       # reclaimable pool
+                    self._reclaimable.pop(node, None)
+                self._ref[node.block] += 1
+                node.last_used = now
+            prefix_blocks = [n.block for n in matched]
+            fresh = [self._alloc_block_locked()
+                     for _ in range(n_blocks)]
+            self._inflight = prefix_blocks + fresh
+        if self.prefix_cache:
+            if matched:
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_tokens_skipped"] += offset
+                _PREFIX_HITS_TOTAL.labels(self.name).inc()
+                _PREFIX_TOKENS_SKIPPED_TOTAL.labels(self.name).inc(
+                    offset)
+            else:
+                self.stats["prefix_misses"] += 1
+                _PREFIX_MISSES_TOTAL.labels(self.name).inc()
         tokens = np.zeros((padded,), np.int32)
-        tokens[:prompt_len] = handle.prompt
+        tokens[:suffix_len] = handle.prompt[offset:]
         t0 = time.perf_counter()
         t0w = time.time()
         wait_s = t0 - handle.enqueued
@@ -544,18 +843,30 @@ class GenerationEngine:
             handle.rt.phase("generate.queue_wait", handle.enqueued_w,
                             t0w)
         try:
-            cache, first = self._prefill_jit(
-                self.params, self._cache, tokens,
-                np.int32(prompt_len), np.asarray(blocks, np.int32))
+            if matched:
+                # prefix table padded to the static per-slot width;
+                # columns >= offset are masked inside the program
+                tables = np.zeros((1, self.blocks_per_slot), np.int32)
+                tables[0, :len(prefix_blocks)] = prefix_blocks
+                cache, first = self._prefill_cached_jit(
+                    self.params, self._cache, tokens,
+                    np.int32(suffix_len), np.int32(offset), tables,
+                    np.asarray(fresh, np.int32))
+            else:
+                cache, first = self._prefill_jit(
+                    self.params, self._cache, tokens,
+                    np.int32(prompt_len), np.asarray(fresh, np.int32))
             first = int(first)
         except Exception as e:  # noqa: BLE001 — a failed prefill
             # (compile OOM, device error) must fail THIS request, not
             # hang it: the handle is in neither the queue nor a slot
             # at this point, so the loop-level _fail_everything would
-            # never resolve it — and its popped blocks must return to
-            # the pool or the engine shrinks with every occurrence
+            # never resolve it — and its held blocks must go back
+            # (pinned prefix pages to the cache, fresh pages to the
+            # free list) or the engine shrinks with every occurrence
             with self._cond:
-                self._free.extend(blocks)
+                self._release_blocks_locked(prefix_blocks + fresh)
+                self._inflight = []
                 self._cond.notify()
             log.exception("prefill failed for a %d-token prompt on "
                           "engine %s", prompt_len, self.name)
@@ -563,16 +874,25 @@ class GenerationEngine:
             return
         self._cache = cache
         elapsed = time.perf_counter() - t0
+        handle.prefix_tokens_skipped = offset
+        handle.prefill_seconds = elapsed
         _PREFILL_SECONDS.labels(self.name).observe(
             elapsed, trace_id=handle.rt.exemplar(elapsed)
             if handle.rt is not None else None)
         if handle.rt is not None:
             handle.rt.phase("generate.prefill", t0w,
-                            rows=padded, prompt=prompt_len)
+                            rows=padded, prompt=prompt_len,
+                            prefix_tokens_skipped=offset)
         self.stats["prefills"] += 1
-        slot = _Slot(handle, blocks, prompt_len, first,
-                     self._blocks_needed(handle))
-        self._slots[slot_idx] = slot
+        slot = _Slot(handle, prefix_blocks + fresh, prompt_len, first,
+                     len(matched) + self._worst_case_blocks(
+                         prompt_len, handle.max_tokens, len(matched)))
+        with self._cond:
+            self._inflight = []
+            self._slots[slot_idx] = slot
+            if self.prefix_cache:
+                self._index_prompt_locked(handle.prompt, slot.blocks,
+                                          matched)
         self._emit(handle, first)
         if handle.eos_id is not None and first == handle.eos_id:
             self._evict(slot_idx, "eos")
@@ -598,9 +918,10 @@ class GenerationEngine:
             block_idx = pos // bs
             if block_idx >= len(slot.blocks):
                 # lazy page allocation: guaranteed by the admission
-                # reservation, so pop() cannot fail here
+                # reservation, so allocation cannot fail here (it may
+                # LRU-reclaim a cached zero-ref page on the way)
                 with self._cond:
-                    slot.blocks.append(self._free.pop())
+                    slot.blocks.append(self._alloc_block_locked())
             tables[i, :len(slot.blocks)] = slot.blocks
             lengths[i] = pos
             tokens[i] = slot.last_token
@@ -645,9 +966,12 @@ class GenerationEngine:
 
     def _evict(self, slot_idx, reason, error=None):
         slot = self._slots[slot_idx]
-        self._slots[slot_idx] = None
         with self._cond:
-            self._free.extend(slot.blocks)
+            self._slots[slot_idx] = None
+            # cache-retain eviction: trie-indexed pages stay resident
+            # at refcount zero (a later prompt sharing the prefix
+            # re-pins them), everything else frees immediately
+            self._release_blocks_locked(slot.blocks)
             self._cond.notify()
         _EVICTIONS_TOTAL.labels(self.name, reason).inc()
         handle = slot.handle
@@ -750,6 +1074,55 @@ class GenerationEngine:
             return self._layer_core(x, lp, attend)
 
         x, (ks, vs) = lax.scan(layer_fn, x, params["layers"])
+        logits = self._head_logits(x[:, true_len - 1][:, None])
+        first = jnp.argmax(logits[0, 0]).astype(jnp.int32)
+        pad = block_ids.shape[0] * self.block_size - tokens.shape[0]
+        pages = [jnp.pad(p, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                 for p in (ks, vs)]
+        return self._write_pages(cache, pages, block_ids), first
+
+    def _prefill_cached_step(self, params, cache, tokens, true_len,
+                             offset, prefix_tables, block_ids):
+        """Partial prefill over the UNSHARED suffix of a prefix-cache
+        hit: ``tokens`` [padded_suffix] sit at global positions
+        ``offset + arange`` (``offset`` cached tokens precede them),
+        ``prefix_tables`` [1, blocks_per_slot] maps the shared pages
+        (columns past ``offset`` masked), ``block_ids`` are the fresh
+        pages the suffix K/V lands in. One compiled program per padded
+        suffix length — ``offset`` is a traced scalar, so every prefix
+        depth shares it. The suffix rows attend to the gathered prefix
+        pages plus themselves causally (``attention.chunk_attention``
+        documents why this is value-identical to the full forward),
+        so the K/V written — and the first token emitted from the last
+        real row — are exactly the cold prefill's."""
+        c = self.config
+        dt = c.compute_dtype
+        n_rep = c.n_heads // c.kv_heads
+        x = sharding.embed_lookup(params["embed"].astype(dt),
+                                  tokens[None])
+        rope = transformer.rope_tables(
+            c, offset + jnp.arange(tokens.shape[0]))
+
+        def layer_fn(x, layer_in):
+            lp, cache_l = layer_in[0], tuple(layer_in[1:])
+
+            def attend(q, k, v):
+                q = transformer.apply_rope(q, *rope)
+                k = transformer.apply_rope(k, *rope)
+                pk, pv = self._gather_kv(cache_l, prefix_tables)
+                o = attn_lib.chunk_attention(
+                    q,
+                    attn_lib.repeat_kv(
+                        jnp.concatenate([pk, k], axis=1), n_rep),
+                    attn_lib.repeat_kv(
+                        jnp.concatenate([pv, v], axis=1), n_rep),
+                    offset)
+                return o, (k[0], v[0])
+
+            return self._layer_core(x, lp, attend)
+
+        x, (ks, vs) = lax.scan(layer_fn, x,
+                               (params["layers"],) + cache)
         logits = self._head_logits(x[:, true_len - 1][:, None])
         first = jnp.argmax(logits[0, 0]).astype(jnp.int32)
         pad = block_ids.shape[0] * self.block_size - tokens.shape[0]

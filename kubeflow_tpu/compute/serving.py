@@ -1282,6 +1282,10 @@ class ModelServer:
             with self._residency_lock:
                 model_items = list(self._models.values())
                 canary_items = list(self._canaries.items())
+            # atomic shallow copy (the generators() helper's idiom):
+            # register_generator's dict insert must not resize this
+            # mid-iteration
+            generator_items = sorted(dict(self._generators).items())
             return 200, {
                 "budget_bytes": self.budget_bytes,
                 "resident_bytes": self.resident_bytes(),
@@ -1300,7 +1304,15 @@ class ModelServer:
                     "state": "RESIDENT" if c["model"].loaded
                     else "EVICTED",
                     **_residency(c["model"]),
-                } for name, c in canary_items]}, (), json_ct
+                } for name, c in canary_items],
+                # the :generate surface next to the unary registry:
+                # slot pool + prefix-cache economics per engine (the
+                # per-name status route carries the same snapshot)
+                "generators": [{
+                    "name": name,
+                    "version": str(engine.version),
+                    **engine.snapshot(),
+                } for name, engine in generator_items]}, (), json_ct
         if parts == ["healthz"]:
             # the router's health poll keys off this: "draining" is
             # alive-but-unroutable (finish in-flight, take no new)
@@ -1663,6 +1675,11 @@ class ModelServer:
                 self.send_header("Transfer-Encoding", "chunked")
                 self.send_header("X-Served-Version",
                                  str(engine.version))
+                # prefill already ran (the first token came from it),
+                # so the per-request prefix-cache savings are known at
+                # head time; the router mirrors this header
+                self.send_header("X-Prefix-Tokens-Skipped",
+                                 str(handle.prefix_tokens_skipped))
                 if rt is not None:
                     self.send_header("traceparent",
                                      tracing.format_traceparent(rt))
@@ -1681,7 +1698,18 @@ class ModelServer:
                         else:
                             _kind, reason, toks, error = event
                             done = {"done": True, "reason": reason,
-                                    "tokens": toks}
+                                    "tokens": toks,
+                                    # per-request prefix-cache view:
+                                    # prompt tokens whose prefill was
+                                    # skipped, and the (partial)
+                                    # prefill wall the request paid
+                                    "prefix_tokens_skipped":
+                                        handle.prefix_tokens_skipped,
+                                    "prefill_s":
+                                        round(handle.prefill_seconds,
+                                              6)
+                                        if handle.prefill_seconds
+                                        is not None else None}
                             if error is not None:
                                 done["error"] = str(error)
                             chunk(done)

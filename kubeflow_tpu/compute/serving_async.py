@@ -711,10 +711,15 @@ class AsyncTransport:
         client that abandons mid-stream is still accounted."""
         req, rt = conn.req, conn.rt
         engine = req["gen_engine"]
+        handle = req.get("gen_handle")
         lines = ["HTTP/1.1 200 OK",
                  "Content-Type: application/x-ndjson",
                  "Transfer-Encoding: chunked",
-                 f"X-Served-Version: {engine.version}"]
+                 f"X-Served-Version: {engine.version}",
+                 # prefill already ran (the first token came from it):
+                 # per-request prefix-cache savings, router-mirrored
+                 f"X-Prefix-Tokens-Skipped: "
+                 f"{handle.prefix_tokens_skipped if handle else 0}"]
         if rt is not None:
             lines.append(
                 f"traceparent: {tracing.format_traceparent(rt)}")
@@ -764,7 +769,15 @@ class AsyncTransport:
             self._respond(conn, code, payload, extra,
                           "application/json")
             return
-        done = {"done": True, "reason": reason, "tokens": toks}
+        handle = req.get("gen_handle")
+        done = {"done": True, "reason": reason, "tokens": toks,
+                # per-request prefix-cache view (same fields as the
+                # threaded transport: byte-identical contracts)
+                "prefix_tokens_skipped":
+                    handle.prefix_tokens_skipped if handle else 0,
+                "prefill_s": round(handle.prefill_seconds, 6)
+                    if handle is not None
+                    and handle.prefill_seconds is not None else None}
         if error is not None:
             done["error"] = str(error)
         self._stream_chunk(conn, done)

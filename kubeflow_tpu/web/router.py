@@ -57,6 +57,10 @@ _FORWARD_HEADERS = ("content-type", "x-tensor-dtype", "x-tensor-shape",
 #: response headers mirrored back to the client
 _MIRROR_HEADERS = ("Content-Type", "X-Tensor-Dtype", "X-Tensor-Shape",
                    "X-Inference-Time-Ms", "X-Served-Version",
+                   # :generate per-request prefix-cache savings
+                   # (loadtest --shared-prefix asserts hits THROUGH
+                   # the router off this header)
+                   "X-Prefix-Tokens-Skipped",
                    "Retry-After")
 
 

@@ -139,6 +139,58 @@ class TestGenerateWire:
         assert snap["slots"] == 1 and snap["occupied"] == 0
         conn.close()
 
+    def test_done_frame_reports_prefix_cache_savings(self, served,
+                                                     params):
+        """ISSUE 12: the terminal frame (and the router-mirrored
+        ``X-Prefix-Tokens-Skipped`` header) carry the per-request
+        prefix-cache view — tokens whose prefill was skipped plus the
+        (partial) prefill seconds the request actually paid."""
+        _transport, _server, _engine_, port = served
+        shared = list(range(10, 26))     # 2 full blocks (block_size 8)
+        cold, warm = shared + [40, 41], shared + [50]
+        conn, resp = _post_generate(port,
+                                    {"tokens": cold, "max_tokens": 4})
+        assert resp.status == 200
+        cold_done = _frames(resp)[-1]
+        conn.close()
+        conn, resp = _post_generate(port,
+                                    {"tokens": warm, "max_tokens": 4})
+        assert resp.status == 200
+        assert resp.headers.get("X-Prefix-Tokens-Skipped") == "16"
+        warm_done = _frames(resp)[-1]
+        conn.close()
+        assert cold_done["prefix_tokens_skipped"] == 0
+        assert warm_done["prefix_tokens_skipped"] == 16
+        assert warm_done["prefill_s"] > 0
+        assert warm_done["tokens"] == gen_lib.reference_greedy_decode(
+            params, CFG, warm, 4)
+
+    def test_models_listing_and_snapshot_carry_prefix_view(self,
+                                                           served):
+        """Satellite: ``/v1/models/<name>`` and the registry listing
+        expose the prefix-cache breakdown, and ``free_blocks`` means
+        immediately allocatable (free + reclaimable)."""
+        _transport, _server, engine, port = served
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=60)
+        conn.request("GET", "/v1/models/lm")
+        payload = json.loads(conn.getresponse().read())
+        snap = payload["generator"]
+        pc = snap["prefix_cache"]
+        assert set(pc) >= {"enabled", "cached_blocks",
+                           "reclaimable_blocks", "pinned_blocks",
+                           "hits", "misses", "hit_ratio",
+                           "tokens_skipped", "reclaims"}
+        view = engine.blocks_view()
+        assert snap["free_blocks"] \
+            == len(view["free"]) + len(view["cached"])
+        conn.request("GET", "/v1/models")
+        listing = json.loads(conn.getresponse().read())
+        gens = {g["name"]: g for g in listing["generators"]}
+        assert "lm" in gens
+        assert gens["lm"]["prefix_cache"]["enabled"] is True
+        conn.close()
+
     def test_bad_requests_are_400(self, served):
         _transport, _server, _engine_, port = served
         for body in ({"nope": 1}, {"tokens": []}, {"tokens": [999]},
