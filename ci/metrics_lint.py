@@ -135,6 +135,14 @@ def main():
         "serving_generate_prefix_tokens_skipped_total",
         "serving_generate_prefix_cached_blocks",
         "serving_generate_prefix_reclaims_total",
+        # tensor-sharded generation surface (ISSUE 13): mesh size,
+        # per-chip share of the head-partitioned block pool, and the
+        # calibrated collective time share — what bench.py
+        # generate-sharded and loadtest --sharded read, and what
+        # docs/observability.md § Generation serving promises
+        "serving_generate_shard_mesh_devices",
+        "serving_generate_shard_cache_blocks_per_chip",
+        "serving_generate_shard_collective_share",
         # sweep-pod failure re-packing (ROADMAP PR 5 follow-up)
         "sweep_repack_total",
     }

@@ -257,7 +257,16 @@ def model_server(argv=()):
         # MODEL_MODULE to register their own engine.
         import jax
 
+        if os.environ.get("JAX_PLATFORMS"):
+            # the axon TPU plugin OVERRIDES the JAX_PLATFORMS env var
+            # at import; re-assert it through the config knob (the
+            # tests/conftest.py idiom) so the generation loadtests can
+            # force a CPU mesh inside this replica on a TPU host
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"])
+
         from ..compute import generate as gen_lib
+        from ..compute import mesh as mesh_lib
         from ..compute.models import transformer
         cfg = transformer.Config(
             vocab_size=int(os.environ.get("GEN_VOCAB", "512")),
@@ -268,16 +277,35 @@ def model_server(argv=()):
             dtype=os.environ.get("GEN_DTYPE", "float32"),
             attention="dense", remat=False, scan_layers=True)
         params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        # GEN_MESH/GEN_TP: tensor-shard the engine over the pod's
+        # chips (GEN_MESH accepts "tensor=N" or a bare N; GEN_TP is
+        # the short spelling — GEN_MESH wins when both are set).
+        # GEN_TP=1 (default) keeps the single-chip engine with no
+        # mesh machinery at all.
+        mesh_env = os.environ.get("GEN_MESH", "")
+        tp = int(mesh_env.rpartition("=")[2] or
+                 os.environ.get("GEN_TP", "1") or 1)
+        mesh = mesh_lib.mesh_for_generation(tensor=tp) if tp > 1 \
+            else None
         engine = gen_lib.GenerationEngine(
             params, cfg,
             max_slots=int(os.environ.get("GEN_SLOTS", "4")),
             block_size=int(os.environ.get("GEN_BLOCK_SIZE", "16")),
+            num_blocks=int(os.environ.get("GEN_BLOCKS", "0"))
+            or None,   # total pool; size it as per-chip budget × tp
             kv_dtype=os.environ.get("GEN_KV_DTYPE") or None,
             admission=os.environ.get("GEN_ADMISSION", "continuous"),
             prefix_cache=os.environ.get(
                 "GEN_PREFIX_CACHE", "1").lower() not in (
                 "0", "false", "no", "off"),
+            mesh=mesh,
             name=name)
+        if os.environ.get("GEN_CALIBRATE", "").lower() in (
+                "1", "true", "yes", "on"):
+            # one-off collective-share calibration (extra compile):
+            # populates serving_generate_shard_collective_share
+            # before traffic arrives — loadtest --sharded sets this
+            engine.measure_collective_share()
         server.register_generator(name, engine)
     elif module:
         importlib.import_module(module).register(server)
@@ -305,6 +333,12 @@ def model_server(argv=()):
         # the stock-MLP branch is the only one that needs jax (the
         # fake-device path exists to skip multi-second jit startup)
         import jax
+
+        if os.environ.get("JAX_PLATFORMS"):
+            # see the MODEL_GENERATE branch: the env var alone does
+            # not survive the axon plugin's import-time override
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"])
 
         from ..compute.models import mlp
         cfg = mlp.Config(

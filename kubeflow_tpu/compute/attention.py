@@ -61,7 +61,14 @@ def decode_attention(q, k, v, lengths, scale=None):
     (same einsum contractions, fp32 softmax, probs cast to ``v.dtype``)
     so greedy decode through the cache is token-identical to a
     full-context recompute: the masked tail pads the contraction with
-    exact zeros, which cannot perturb the valid positions."""
+    exact zeros, which cannot perturb the valid positions.
+
+    Head-parallel by construction: every op here is independent per
+    head (the only contractions are over ``d`` and the masked key
+    axis), so the tensor-sharded engine calls this unchanged inside
+    its full-manual ``shard_map`` with the head axis chip-local — a
+    chip's subset of heads computes bit-identically to the same heads
+    of an unsharded call."""
     q = _scale(q, scale)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32)
@@ -92,7 +99,10 @@ def chunk_attention(q, k, v, prefix_len, scale=None):
     softmax, probs cast to ``v.dtype``): a masked column contributes an
     exact zero, so a chunk row's softmax is over exactly the value set
     a full-context causal forward of the same sequence sees — the
-    foundation of the prefix-cache token-identity contract."""
+    foundation of the prefix-cache token-identity contract. Like
+    :func:`decode_attention` it is per-head independent, so the
+    tensor-sharded engine's partial prefill runs it head-local
+    inside ``shard_map`` unchanged."""
     q = _scale(q, scale)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32)

@@ -52,6 +52,29 @@ built on this repo's own kernels):
   step dequantizes INSIDE the attention read
   (``quantize.kv_dequantize``), so the cache's HBM footprint and
   read bandwidth drop ~2× vs bf16 at a bounded accuracy cost.
+- **Tensor-sharded multi-chip serving** (``mesh=``): the whole
+  generation path — every prefill bucket, the cached partial prefill
+  and the single decode step — runs as ONE full-manual ``shard_map``
+  program over the mesh's ``tensor`` axis (the serving-plane analogue
+  of the training mesh's megatron layout). Weights partition by the
+  platform's ``sharding.spec_for`` rules: attention heads and the MLP
+  hidden dim shard over ``tensor`` (wq/wk/wv and w_gate/w_up
+  column-wise, the whole attention read per-head local); the
+  embedding table, LM head and the row projections (wo, w_down) stay
+  replicated, and the per-layer collectives are two all-gathers of
+  RAW activations — a concatenation, never a sum of partials — so
+  the sharded program computes bit-identically to the single-chip
+  one and greedy decode is token-identical BY CONSTRUCTION, not
+  within tolerance (``_gathered`` documents why the psum-of-partials
+  layout was rejected: it flips bf16 tokens). The paged KV block
+  pool is **head-partitioned per chip**: each chip stores
+  ``kv_heads / tp`` heads of EVERY block, so a mesh of N chips holds
+  N× the cache blocks at the same per-chip HBM budget — model size
+  AND cache capacity become mesh knobs rather than ceilings. All
+  host-side state (prefix trie, refcounts, block tables, mid-batch
+  evict/admit) is sharding-agnostic and unchanged; a 1-device mesh
+  reproduces the unsharded engine byte-for-byte (a 1-shard gather is
+  the identity).
 
 Numerics contract: greedy decode through the cache is token-identical
 to a full-context ``transformer.apply`` recompute of the same prompt
@@ -66,6 +89,7 @@ tokens incrementally as chunked NDJSON.
 """
 
 import collections
+import dataclasses
 import logging
 import threading
 import time
@@ -74,9 +98,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..obs import metrics as obs_metrics
 from . import attention as attn_lib
+from . import mesh as mesh_lib
 from . import quantize as quantize_lib
 from . import serving as serving_lib
 from . import sharding
@@ -150,6 +176,35 @@ _PREFIX_RECLAIMS_TOTAL = obs_metrics.REGISTRY.counter(
     "allocation — sustained rate means the pool is too small for the "
     "working set of shared prefixes",
     ("model",))
+_SHARD_MESH_DEVICES = obs_metrics.REGISTRY.gauge(
+    "serving_generate_shard_mesh_devices",
+    "Tensor-parallel mesh size the generation engine is sharded over "
+    "(1 = unsharded single-chip engine)",
+    ("model",))
+_SHARD_BLOCKS_PER_CHIP = obs_metrics.REGISTRY.gauge(
+    "serving_generate_shard_cache_blocks_per_chip",
+    "Per-chip HBM footprint of the head-partitioned KV block pool in "
+    "single-chip block units (num_blocks / mesh size) — at a fixed "
+    "per-chip budget the POOL grows linearly with the mesh, which is "
+    "the cache-capacity win of sharded serving",
+    ("model",))
+_SHARD_COLLECTIVE_SHARE = obs_metrics.REGISTRY.gauge(
+    "serving_generate_shard_collective_share",
+    "Measured share of the decode step spent in cross-chip "
+    "collectives (the per-layer activation all-gathers), from "
+    "measure_collective_share() calibration — 0.0 until calibrated "
+    "or when the engine is unsharded",
+    ("model",))
+
+
+class MeshShapeError(ValueError):
+    """The generation mesh cannot shard this model: the tensor axis
+    must divide ``n_heads`` AND ``kv_heads`` (heads are partitioned
+    whole — a fractional head has no meaning), and every non-tensor
+    mesh axis must be size 1 (the serving engine expresses exactly one
+    parallelism: megatron tensor sharding). Raised AT CONSTRUCTION so
+    the misconfiguration surfaces as one named error instead of a deep
+    XLA partitioning failure on the first prefill."""
 
 
 class GenerationHandle:
@@ -277,7 +332,7 @@ class GenerationEngine:
                  max_context=None, num_blocks=None, kv_dtype=None,
                  name="model", version=1, eos_id=None,
                  default_max_tokens=64, admission="continuous",
-                 prefix_cache=True):
+                 prefix_cache=True, mesh=None):
         if config.moe_experts or config.pipeline_stages > 1:
             raise ValueError(
                 "GenerationEngine supports dense TransformerLM configs "
@@ -289,6 +344,26 @@ class GenerationEngine:
             raise ValueError(
                 f"admission must be 'continuous' or 'drain', got "
                 f"{admission!r}")
+        self.mesh = mesh
+        self.tp = 1
+        if mesh is not None:
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            self.tp = int(sizes.get(mesh_lib.TENSOR, 1))
+            nontrivial = {a: s for a, s in sizes.items()
+                          if s != 1 and a != mesh_lib.TENSOR}
+            if nontrivial:
+                raise MeshShapeError(
+                    f"generation mesh may only shard the "
+                    f"'{mesh_lib.TENSOR}' axis; got non-trivial axes "
+                    f"{nontrivial} (use mesh_for_generation(tensor=N))")
+            if (config.n_heads % self.tp
+                    or config.kv_heads % self.tp):
+                raise MeshShapeError(
+                    f"mesh tensor axis {self.tp} must divide n_heads="
+                    f"{config.n_heads} and kv_heads={config.kv_heads}:"
+                    f" attention heads are partitioned whole per chip "
+                    f"(pick a tensor size that divides both, or adjust"
+                    f" the model's head counts)")
         self.config = config
         self.name = name
         self.version = version
@@ -311,23 +386,44 @@ class GenerationEngine:
             # scan-over-layers works regardless of config.scan_layers
             layers = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
             params = {**params, "layers": layers}
+        # per-chip block-HBM equivalents: each chip stores kv_heads/tp
+        # heads of every block, so one chip's share of the pool costs
+        # the HBM of num_blocks/tp single-chip blocks — the figure
+        # operators size GEN_BLOCKS against (snapshot + done frame)
+        self.per_chip_blocks = (
+            self.num_blocks // self.tp
+            if self.num_blocks % self.tp == 0
+            else round(self.num_blocks / self.tp, 2))
+        self._cache = self._make_cache()
+        if mesh is not None:
+            params = self._shard_params(params)
         self.params = params
-        shape = (config.n_layers, self.num_blocks, self.block_size,
-                 config.kv_heads, config.head_dim)
-        if kv_dtype == "int8":
-            self._cache = (jnp.zeros(shape, jnp.int8),
-                           jnp.zeros(shape, jnp.int8),
-                           jnp.ones(shape[:-1] + (1,), jnp.float32),
-                           jnp.ones(shape[:-1] + (1,), jnp.float32))
+        # the decode step DONATES the cache (argnum 1): the per-step
+        # functional update aliases the input buffers instead of
+        # double-buffering the pool (tests pin the no-copy via
+        # unsafe_buffer_pointer). Prefill keeps plain jit: its error
+        # path relies on self._cache staying valid when the call
+        # raises (a donated input is dead either way).
+        if mesh is None:
+            self._prefill_jit = jax.jit(self._prefill_step)
+            self._prefill_cached_jit = jax.jit(self._prefill_cached_step)
+            self._decode_jit = jax.jit(self._decode_step,
+                                       donate_argnums=(1,))
         else:
-            dt = config.compute_dtype
-            self._cache = (jnp.zeros(shape, dt), jnp.zeros(shape, dt))
-        # donation would make the functional cache update in-place on
-        # TPU, but this toolchain's donation+serialization landmine
-        # (mesh.py notes) makes plain jit the safe default
-        self._prefill_jit = jax.jit(self._prefill_step)
-        self._prefill_cached_jit = jax.jit(self._prefill_cached_step)
-        self._decode_jit = jax.jit(self._decode_step)
+            # ONE full-manual shard_map per program over every mesh
+            # axis (all size 1 except tensor): partial-auto shard_map
+            # is this toolchain's known-broken corner, full-manual is
+            # its well-trodden one (ring attention, pipeline)
+            self._prefill_jit = jax.jit(self._shard(
+                self._prefill_step, 3))
+            self._prefill_cached_jit = jax.jit(self._shard(
+                self._prefill_cached_step, 5))
+            self._decode_jit = jax.jit(self._shard(self._decode_step, 5),
+                                       donate_argnums=(1,))
+        self._local_decode_jit = None     # measure_collective_share
+        _SHARD_MESH_DEVICES.labels(name).set(self.tp)
+        _SHARD_BLOCKS_PER_CHIP.labels(name).set(
+            self.num_blocks / self.tp)
         self._free = list(range(self.num_blocks))
         self._slots = [None] * self.max_slots
         self._queue = collections.deque()
@@ -361,11 +457,201 @@ class GenerationEngine:
         # aggregate counters bench reads without scraping /metrics
         self.stats = {"prefills": 0, "decode_steps": 0,
                       "decode_token_slots": 0, "tokens": 0,
+                      "peak_occupancy": 0, "prefill_seconds_total": 0.0,
                       "prefix_hits": 0, "prefix_misses": 0,
-                      "prefix_tokens_skipped": 0, "prefix_reclaims": 0}
+                      "prefix_tokens_skipped": 0, "prefix_reclaims": 0,
+                      "collective_share": 0.0}
         self.thread = threading.Thread(target=self._loop, daemon=True,
                                        name=f"generate-{name}")
         self.thread.start()
+
+    # ------------------------------------------------- tensor sharding
+
+    def _param_specs(self):
+        """PartitionSpec tree for the engine's (stacked-layer) param
+        layout, from the platform's ``sharding.spec_for`` rules:
+        attention heads and the MLP hidden dim shard over ``tensor``
+        (wq/wk/wv and w_gate/w_up column-wise — the projections that
+        dominate prefill FLOPs — plus the whole attention read and
+        the head-partitioned KV pool). The row projections (wo,
+        w_down), embedding table and LM head are REPLICATED: see
+        ``_gathered`` for why the sharded path moves raw activations
+        instead of psumming row-sharded partial products — exact
+        token-identity is the contract."""
+        cfg = dataclasses.replace(self.config, scan_layers=True)
+        specs = sharding.tree_specs(transformer.logical_axes(cfg))
+        specs["embed"] = P()
+        specs["head"] = P()
+        specs["layers"] = dict(specs["layers"],
+                               wo=P(), w_down=P())
+        return specs
+
+    def _cache_specs(self):
+        """The block pool is head-partitioned: axis 3 (kv_heads) of
+        every cache component — k, v and the int8 scales — shards over
+        ``tensor``, so each chip holds ``kv_heads/tp`` heads of every
+        block and the pool's per-chip HBM is ``num_blocks/tp`` blocks'
+        worth: N chips hold N× the blocks at one chip's budget."""
+        spec = P(None, None, None, mesh_lib.TENSOR, None)
+        return (spec,) * (4 if self.kv_dtype == "int8" else 2)
+
+    def _make_cache(self):
+        """A fresh zeroed block pool, laid out on the mesh when one is
+        set. Called at init AND from ``_fail_everything``: the decode
+        step DONATES the pool, so a decode call that raises leaves
+        ``self._cache`` pointing at consumed buffers — since a loop
+        crash fails all work and returns every block to the free
+        list, a zeroed pool is exactly the clean state to rebuild
+        (the engine heals instead of erroring on every later
+        prefill)."""
+        c = self.config
+        shape = (c.n_layers, self.num_blocks, self.block_size,
+                 c.kv_heads, c.head_dim)
+        if self.kv_dtype == "int8":
+            cache = (jnp.zeros(shape, jnp.int8),
+                     jnp.zeros(shape, jnp.int8),
+                     jnp.ones(shape[:-1] + (1,), jnp.float32),
+                     jnp.ones(shape[:-1] + (1,), jnp.float32))
+        else:
+            dt = c.compute_dtype
+            cache = (jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+        if self.mesh is not None:
+            cache = tuple(
+                jax.device_put(a, NamedSharding(self.mesh, s))
+                for a, s in zip(cache, self._cache_specs()))
+        return cache
+
+    def _shard_params(self, params):
+        """Lay the params out on the mesh (one device_put — the
+        jitted programs then see their in_specs already satisfied,
+        no per-call resharding)."""
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), self._param_specs(),
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.device_put(params, shardings)
+
+    def _shard(self, fn, n_host_args):
+        """Wrap a jitted program body as ONE full-manual shard_map
+        over every mesh axis: params and cache arrive pre-localized
+        per their specs, the ``n_host_args`` trailing host-side arrays
+        (tokens, tables, lengths, …) replicated; the body's only
+        cross-chip traffic is ``_gathered``'s all-gathers."""
+        rep = P()
+        return jax.shard_map(
+            fn, mesh=self.mesh,
+            in_specs=(self._param_specs(), self._cache_specs())
+            + (rep,) * n_host_args,
+            out_specs=(self._cache_specs(), rep),
+            axis_names=set(self.mesh.axis_names), check_vma=False)
+
+    def _gathered(self, x, axis):
+        """All-gather a head/hidden-sharded activation back to full
+        width along ``axis`` — the sharded path's ONLY collective.
+
+        Design note (conformance over peak sharding): moving raw
+        activations is a CONCATENATION, no arithmetic, so the sharded
+        program computes bit-identically to the single-chip one — the
+        row projections (wo, w_down) then run replicated on every
+        chip from identical inputs. The megatron alternative (shard
+        wo/w_down rows, psum the partial products) was tried first
+        and demonstrably flips greedy bf16 tokens: each chip's
+        partial sum rounds before (or re-rounds after) the psum, and
+        a residual-stream value landing on a bf16 rounding boundary
+        compounds into a different argmax a few tokens later. Exact
+        token-identity is this engine's serving contract, so it
+        trades the row-projection FLOPs (tiny at decode: one token
+        per slot) for collectives that cannot perturb numerics.
+        Identity when unsharded; a 1-device gather of one shard is
+        the identity, which keeps the degenerate mesh byte-for-byte.
+        """
+        if self.mesh is None:
+            return x
+        if getattr(self, "_elide_collectives", False):
+            # calibration twin: a LOCAL copy of the same output shape
+            # (tile) in place of the cross-chip gather
+            reps = [1] * x.ndim
+            reps[axis] = self.tp
+            return jnp.tile(x, reps)
+        return lax.all_gather(x, mesh_lib.TENSOR, axis=axis,
+                              tiled=True)
+
+    def _embed(self, table, tokens):
+        """Token embedding inside the jitted programs: under the
+        full-manual shard_map the (replicated) table is gathered
+        directly — ``sharding.embed_lookup``'s constraint machinery
+        targets auto-SPMD contexts, not manual regions."""
+        if self.mesh is not None:
+            return jnp.take(table, tokens, axis=0)
+        return sharding.embed_lookup(table, tokens)
+
+    def measure_collective_share(self, iters=5):
+        """Calibrate ``serving_generate_shard_collective_share``: time
+        the real sharded decode step against an identical program with
+        the cross-chip all-gathers replaced by local tiles (same
+        shapes, no comm — timing only), over an idle batch whose
+        writes all drop. The gap is
+        the collective share of a decode step on THIS mesh/model.
+        Opt-in (bench ``generate-sharded``, loadtest ``--sharded``):
+        it compiles one extra program. Call while the engine is idle —
+        it shares the engine's cache buffers with the donating decode
+        program. Returns the share (0.0 unsharded)."""
+        if self.mesh is None:
+            _SHARD_COLLECTIVE_SHARE.labels(self.name).set(0.0)
+            return 0.0
+        S, bps = self.max_slots, self.blocks_per_slot
+        idle = (np.zeros((S, bps), np.int32), np.zeros((S,), np.int32),
+                np.zeros((S,), np.int32),
+                np.full((S,), self.num_blocks, np.int32),
+                np.zeros((S,), np.int32))
+        if self._local_decode_jit is None:
+            def nocollective(*args):
+                self._elide_collectives = True
+                try:
+                    return self._decode_step(*args)
+                finally:
+                    self._elide_collectives = False
+            self._local_decode_jit = jax.jit(
+                self._shard(nocollective, 5))
+
+        def timed(fn):
+            jax.block_until_ready(fn(self.params, self._cache, *idle))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                jax.block_until_ready(
+                    fn(self.params, self._cache, *idle)[1])
+            return (time.perf_counter() - t0) / iters
+
+        t_local = timed(self._local_decode_jit)
+        # the real program donates its cache arg: keep self._cache the
+        # live buffer by re-adopting the (unchanged — writes dropped)
+        # returned pool each call
+        def real(params, cache, *rest):
+            new_cache, nxt = self._decode_jit(params, cache, *rest)
+            self._cache = new_cache
+            return new_cache, nxt
+
+        t_sharded = timed(real)
+        share = max(0.0, 1.0 - t_local / t_sharded) if t_sharded else 0.0
+        self.stats["collective_share"] = round(share, 4)
+        _SHARD_COLLECTIVE_SHARE.labels(self.name).set(share)
+        return share
+
+    def mesh_view(self):
+        """The operator-facing sharding summary (snapshot, ``:generate``
+        done frame, ``X-Generate-Mesh`` header): mesh size and the
+        per-chip block count. The pool is head-partitioned — every
+        chip holds a slice of EVERY block — so per-chip exhaustion and
+        pool exhaustion are the same event by construction; a pool
+        that reads exhausted at N× one chip's blocks means the MESH is
+        undersized, not one chip."""
+        return {"tensor": self.tp, "devices": self.tp,
+                "cache_blocks": self.num_blocks,
+                "per_chip_blocks": self.per_chip_blocks}
+
+    def mesh_header(self):
+        """``X-Generate-Mesh`` wire value, mirrored by the router."""
+        return (f"tensor={self.tp};"
+                f"per_chip_blocks={self.per_chip_blocks}")
 
     # ------------------------------------------------------ public API
 
@@ -485,6 +771,12 @@ class GenerationEngine:
                 "kv_dtype": self.kv_dtype or str(
                     self.config.compute_dtype),
                 "draining": self._draining,
+                # sharding view: lets an operator distinguish "the
+                # POOL is exhausted" (grow the mesh or num_blocks)
+                # from "one chip is exhausted" (impossible here by
+                # construction — the pool is head-partitioned, every
+                # chip holds a slice of every block)
+                "mesh": self.mesh_view(),
                 "prefix_cache": {
                     "enabled": self.prefix_cache,
                     "cached_blocks": len(self._node_by_block),
@@ -582,6 +874,31 @@ class GenerationEngine:
         for i, slot in enumerate(self._slots):
             if slot is not None:
                 self._evict(i, "error", error)
+        # the decode step donates the pool: if the crash was a raising
+        # decode call, self._cache points at consumed buffers. Rebuild
+        # a fresh zeroed pool AND reset the pool bookkeeping wholesale
+        # — including the prefix trie, whose retained entries would
+        # otherwise advertise K/V the zeroed pool no longer holds.
+        # Safe: this runs on the engine thread (the only prefill/
+        # decode caller), after every slot was evicted and the queue
+        # drained, so nothing references the old pool.
+        try:
+            cache = self._make_cache()
+        except Exception:  # noqa: BLE001 — allocation itself failing
+            log.exception("could not rebuild the KV cache pool after "
+                          "an engine crash; engine %s stays degraded",
+                          self.name)
+            return
+        with self._cond:
+            self._cache = cache
+            self._free = list(range(self.num_blocks))
+            self._ref = [0] * self.num_blocks
+            self._root = _PrefixNode(None, None, None)
+            self._node_by_block = {}
+            self._inflight = []
+            self._reclaimable = {}
+            self._n_reclaimable = 0
+        _PREFIX_CACHED_BLOCKS.labels(self.name).set(0)
 
     def _sweep_queued(self):
         """Fail queued requests that died waiting (deadline, cancel)
@@ -884,6 +1201,7 @@ class GenerationEngine:
                             rows=padded, prompt=prompt_len,
                             prefix_tokens_skipped=offset)
         self.stats["prefills"] += 1
+        self.stats["prefill_seconds_total"] += elapsed
         slot = _Slot(handle, prefix_blocks + fresh, prompt_len, first,
                      len(matched) + self._worst_case_blocks(
                          prompt_len, handle.max_tokens, len(matched)))
@@ -940,6 +1258,10 @@ class GenerationEngine:
         _SLOT_OCCUPANCY.labels(self.name).observe(len(active))
         self.stats["decode_steps"] += 1
         self.stats["decode_token_slots"] += len(active)
+        # peak concurrency actually reached — the capacity figure the
+        # sharded bench's "N chips admit N× the sequences" proof reads
+        self.stats["peak_occupancy"] = max(
+            self.stats["peak_occupancy"], len(active))
         for i, slot in active:
             slot.length += 1
             token = int(nxt[i])
@@ -1000,7 +1322,11 @@ class GenerationEngine:
         ``transformer._layer`` op for op (einsum strings, dtype casts,
         silu MLP) so the cached paths stay token-identical to
         ``transformer.apply``; ``attend(q, k, v)`` is prefill's dense
-        causal attention or decode's cache read+write."""
+        causal attention or decode's cache read+write. Under a mesh
+        the column projections and attention run head/hidden-LOCAL
+        and ``_gathered`` widens the two sliced activations back to
+        full for the replicated row projections — the layer's only
+        collectives."""
         c = self.config
         dt = c.compute_dtype
         h = transformer._rmsnorm(x, lp["attn_norm"].astype(dt))
@@ -1008,32 +1334,41 @@ class GenerationEngine:
         k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(dt))
         v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(dt))
         o, extra = attend(q, k, v)
-        x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"].astype(dt))
+        x = x + jnp.einsum("bshk,hkd->bsd", self._gathered(o, 2),
+                           lp["wo"].astype(dt))
         h = transformer._rmsnorm(x, lp["mlp_norm"].astype(dt))
         gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(dt))
         up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(dt))
-        down = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
-                          lp["w_down"].astype(dt))
+        down = jnp.einsum(
+            "bsf,fd->bsd",
+            self._gathered(jax.nn.silu(gate) * up, 2),
+            lp["w_down"].astype(dt))
         return x + down, extra
 
-    def _head_logits(self, x):
+    def _head_logits(self, params, x):
         """Final-norm hidden → fp32 logits (mirrors
-        ``transformer._logits`` numerics)."""
+        ``transformer._logits`` numerics). ``final_norm``/``head`` are
+        replicated under a mesh, so every chip computes the full vocab
+        row and the greedy argmax identically — no collective on the
+        sampling path."""
         c = self.config
         x = transformer._rmsnorm(
-            x, self.params["final_norm"].astype(c.compute_dtype))
+            x, params["final_norm"].astype(c.compute_dtype))
         return jnp.einsum("bsd,dv->bsv", x,
-                          self.params["head"].astype(c.compute_dtype),
+                          params["head"].astype(c.compute_dtype),
                           preferred_element_type=jnp.float32)
 
     def _write_pages(self, cache, pages, block_ids):
         """Prefill cache fill: ``pages`` = (k, v) each
         [L, n_blocks·block_size, kv_heads, head_dim] → scattered into
-        the pool at ``block_ids`` (quantized when kv_dtype=int8)."""
+        the pool at ``block_ids`` (quantized when kv_dtype=int8).
+        Head counts here are PER-CHIP: under a mesh the body sees its
+        local ``kv_heads/tp`` slice of pages and pool alike."""
         L = self.config.n_layers
         n = block_ids.shape[0]
+        kv_local = self.config.kv_heads // self.tp
         shaped = [p.reshape(L, n, self.block_size,
-                            self.config.kv_heads, self.config.head_dim)
+                            kv_local, self.config.head_dim)
                   for p in pages]
         if self.kv_dtype == "int8":
             kc, vc, ks, vs = cache
@@ -1058,8 +1393,7 @@ class GenerationEngine:
         c = self.config
         dt = c.compute_dtype
         n_rep = c.n_heads // c.kv_heads
-        x = sharding.embed_lookup(params["embed"].astype(dt),
-                                  tokens[None])
+        x = self._embed(params["embed"].astype(dt), tokens[None])
         rope = transformer.rope_tables(c, jnp.arange(tokens.shape[0]))
 
         def attend(q, k, v):
@@ -1074,7 +1408,7 @@ class GenerationEngine:
             return self._layer_core(x, lp, attend)
 
         x, (ks, vs) = lax.scan(layer_fn, x, params["layers"])
-        logits = self._head_logits(x[:, true_len - 1][:, None])
+        logits = self._head_logits(params, x[:, true_len - 1][:, None])
         first = jnp.argmax(logits[0, 0]).astype(jnp.int32)
         pad = block_ids.shape[0] * self.block_size - tokens.shape[0]
         pages = [jnp.pad(p, ((0, 0), (0, pad), (0, 0), (0, 0)))
@@ -1098,8 +1432,7 @@ class GenerationEngine:
         c = self.config
         dt = c.compute_dtype
         n_rep = c.n_heads // c.kv_heads
-        x = sharding.embed_lookup(params["embed"].astype(dt),
-                                  tokens[None])
+        x = self._embed(params["embed"].astype(dt), tokens[None])
         rope = transformer.rope_tables(
             c, offset + jnp.arange(tokens.shape[0]))
 
@@ -1123,7 +1456,7 @@ class GenerationEngine:
 
         x, (ks, vs) = lax.scan(layer_fn, x,
                                (params["layers"],) + cache)
-        logits = self._head_logits(x[:, true_len - 1][:, None])
+        logits = self._head_logits(params, x[:, true_len - 1][:, None])
         first = jnp.argmax(logits[0, 0]).astype(jnp.int32)
         pad = block_ids.shape[0] * self.block_size - tokens.shape[0]
         pages = [jnp.pad(p, ((0, 0), (0, pad), (0, 0), (0, 0)))
@@ -1137,9 +1470,10 @@ class GenerationEngine:
         c = self.config
         S = tables.shape[0]
         T = self.blocks_per_slot * self.block_size
+        kv_local = c.kv_heads // self.tp     # per-chip heads
 
         def flat(pages):
-            return pages.reshape(S, T, c.kv_heads, -1)
+            return pages.reshape(S, T, kv_local, -1)
 
         if self.kv_dtype == "int8":
             kc, vc, ks, vs = cache_l
@@ -1161,8 +1495,7 @@ class GenerationEngine:
         c = self.config
         dt = c.compute_dtype
         n_rep = c.n_heads // c.kv_heads
-        x = sharding.embed_lookup(params["embed"].astype(dt),
-                                  tokens[:, None])
+        x = self._embed(params["embed"].astype(dt), tokens[:, None])
         cos, sin = transformer.rope_tables(c, lengths)
 
         def rope_rows(t):
@@ -1206,7 +1539,7 @@ class GenerationEngine:
 
         x, new_cache = lax.scan(layer_fn, x,
                                 (params["layers"],) + cache)
-        logits = self._head_logits(x)
+        logits = self._head_logits(params, x)
         nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
         return tuple(new_cache), nxt
 

@@ -178,6 +178,31 @@ def mesh_for_slice(accelerator="", topology="", tensor=1, sequence=1,
         devices=devices)
 
 
+def mesh_for_generation(tensor=1, devices=None):
+    """Serving mesh for the tensor-sharded GenerationEngine
+    (compute/generate.py): exactly ``tensor`` devices on the
+    ``tensor`` axis, every other axis size 1 (the engine expresses
+    one parallelism — megatron tensor sharding — and validates that).
+
+    Uses the FIRST ``tensor`` devices in id order: adjacent device ids
+    share an ICI link, and the engine's per-layer activation
+    all-gathers on the decode critical path must ride neighbor links. ``tensor=1`` still builds a valid
+    (degenerate) mesh — the engine's sharded programs on it reproduce
+    the unsharded engine byte-for-byte, which the conformance tests
+    pin."""
+    if devices is None:
+        devices = jax.devices()
+    tensor = int(tensor)
+    if tensor < 1:
+        raise ValueError(f"tensor must be >= 1, got {tensor}")
+    if tensor > len(devices):
+        raise ValueError(
+            f"tensor={tensor} needs {tensor} devices, have "
+            f"{len(devices)}")
+    devices = sorted(devices, key=lambda d: getattr(d, "id", 0))
+    return make_mesh(MeshSpec(tensor=tensor), devices=devices[:tensor])
+
+
 def device_slice_groups(devices=None):
     """Group devices by TPU slice (``device.slice_index``; devices
     without one — CPU, single-slice TPU — form one group). Groups are

@@ -1680,6 +1680,10 @@ class ModelServer:
                 # head time; the router mirrors this header
                 self.send_header("X-Prefix-Tokens-Skipped",
                                  str(handle.prefix_tokens_skipped))
+                # sharding summary (tensor mesh size + per-chip block
+                # count), router-mirrored like the prefix header
+                self.send_header("X-Generate-Mesh",
+                                 engine.mesh_header())
                 if rt is not None:
                     self.send_header("traceparent",
                                      tracing.format_traceparent(rt))
@@ -1709,7 +1713,12 @@ class ModelServer:
                                         round(handle.prefill_seconds,
                                               6)
                                         if handle.prefill_seconds
-                                        is not None else None}
+                                        is not None else None,
+                                    # mesh shape + per-chip blocks:
+                                    # "pool exhausted" vs "one chip
+                                    # exhausted" is answerable from
+                                    # the frame alone
+                                    "mesh": engine.mesh_view()}
                             if error is not None:
                                 done["error"] = str(error)
                             chunk(done)

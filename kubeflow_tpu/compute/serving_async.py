@@ -719,7 +719,10 @@ class AsyncTransport:
                  # prefill already ran (the first token came from it):
                  # per-request prefix-cache savings, router-mirrored
                  f"X-Prefix-Tokens-Skipped: "
-                 f"{handle.prefix_tokens_skipped if handle else 0}"]
+                 f"{handle.prefix_tokens_skipped if handle else 0}",
+                 # sharding summary (tensor mesh size + per-chip
+                 # block count), router-mirrored like the prefix one
+                 f"X-Generate-Mesh: {engine.mesh_header()}"]
         if rt is not None:
             lines.append(
                 f"traceparent: {tracing.format_traceparent(rt)}")
@@ -777,7 +780,9 @@ class AsyncTransport:
                     handle.prefix_tokens_skipped if handle else 0,
                 "prefill_s": round(handle.prefill_seconds, 6)
                     if handle is not None
-                    and handle.prefill_seconds is not None else None}
+                    and handle.prefill_seconds is not None else None,
+                # mesh shape + per-chip blocks (threaded parity)
+                "mesh": req["gen_engine"].mesh_view()}
         if error is not None:
             done["error"] = str(error)
         self._stream_chunk(conn, done)

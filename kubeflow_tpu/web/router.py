@@ -61,6 +61,10 @@ _MIRROR_HEADERS = ("Content-Type", "X-Tensor-Dtype", "X-Tensor-Shape",
                    # (loadtest --shared-prefix asserts hits THROUGH
                    # the router off this header)
                    "X-Prefix-Tokens-Skipped",
+                   # :generate sharding summary (tensor mesh size +
+                   # per-chip block count; loadtest --sharded asserts
+                   # it survives the router hop)
+                   "X-Generate-Mesh",
                    "Retry-After")
 
 

@@ -293,6 +293,36 @@ class TestLifecycle:
         finally:
             engine.close()
 
+    def test_decode_crash_rebuilds_the_donated_pool(self, params):
+        """The decode step DONATES the cache: a decode call that
+        raises leaves self._cache pointing at consumed buffers. The
+        loop-level crash handler must rebuild the pool (and reset the
+        trie — retained entries would advertise K/V the zeroed pool
+        no longer holds) so the engine heals instead of failing every
+        later prefill on deleted arrays."""
+        engine = _engine(params, max_slots=1)
+        try:
+            real = engine._decode_jit
+
+            def boom(p, cache, *rest):
+                real(p, cache, *rest)     # consumes the donated pool
+                raise RuntimeError("device fell over")
+
+            engine._decode_jit = boom
+            handle = engine.submit([1, 2, 3], max_tokens=6)
+            handle.wait(timeout=60)
+            assert handle.reason == "error"
+            engine._decode_jit = real
+            # healed: fresh pool, empty trie, correct decode again
+            view = engine.blocks_view()
+            assert sorted(view["free"]) == \
+                list(range(engine.num_blocks))
+            assert not view["cached"]
+            out, _ = engine.generate([5, 6, 7], max_tokens=6)
+            assert out == _ref(params, [5, 6, 7], 6)
+        finally:
+            engine.close()
+
     def test_submit_validation(self, engine):
         with pytest.raises(ValueError):
             engine.submit([])
@@ -537,6 +567,32 @@ class TestAbandonedResult:
         finally:
             engine._step_sleep = 0.0
             engine.close()
+
+
+class TestDecodeDonation:
+    """Satellite (ISSUE 13): the jitted decode step DONATES the cache
+    (``donate_argnums``) so the per-step functional update aliases the
+    pool buffers instead of double-buffering them."""
+
+    def test_decode_step_updates_cache_in_place(self, engine):
+        engine.generate([1, 2], max_tokens=2)     # compile + settle
+        S, bps = engine.max_slots, engine.blocks_per_slot
+        idle = (np.zeros((S, bps), np.int32),
+                np.zeros((S,), np.int32), np.zeros((S,), np.int32),
+                np.full((S,), engine.num_blocks, np.int32),
+                np.zeros((S,), np.int32))
+        view0 = engine.blocks_view()
+        ptrs0 = [c.unsafe_buffer_pointer() for c in engine._cache]
+        cache1, _ = engine._decode_jit(engine.params, engine._cache,
+                                       *idle)
+        engine._cache = cache1
+        # no copy: the returned pool lives in the donated buffers
+        assert [c.unsafe_buffer_pointer() for c in cache1] == ptrs0
+        # and the host-side pool accounting saw no delta (idle step:
+        # every write dropped out of bounds)
+        assert engine.blocks_view() == view0
+        # the engine still decodes correctly through the donated pool
+        assert len(engine.generate([5, 6], max_tokens=4)[0]) == 4
 
 
 class TestBlockPoolInvariants:

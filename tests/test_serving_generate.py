@@ -165,6 +165,23 @@ class TestGenerateWire:
         assert warm_done["tokens"] == gen_lib.reference_greedy_decode(
             params, CFG, warm, 4)
 
+    def test_done_frame_and_header_report_mesh(self, served):
+        """ISSUE 13: the terminal frame and the router-mirrored
+        ``X-Generate-Mesh`` header carry the sharding summary (mesh
+        size + per-chip block count) on BOTH transports — tensor=1
+        with the full pool per chip for this unsharded engine."""
+        _transport, _server, engine, port = served
+        conn, resp = _post_generate(
+            port, {"tokens": [8, 9, 10], "max_tokens": 3})
+        assert resp.status == 200
+        assert resp.headers.get("X-Generate-Mesh") == (
+            f"tensor=1;per_chip_blocks={engine.num_blocks}")
+        done = _frames(resp)[-1]
+        conn.close()
+        assert done["mesh"] == {"tensor": 1, "devices": 1,
+                                "cache_blocks": engine.num_blocks,
+                                "per_chip_blocks": engine.num_blocks}
+
     def test_models_listing_and_snapshot_carry_prefix_view(self,
                                                            served):
         """Satellite: ``/v1/models/<name>`` and the registry listing
