@@ -1039,6 +1039,124 @@ def bench_generate_sharded(steps, batch):
                 }}}
 
 
+def bench_generate_spec(steps, batch):
+    """Speculative decoding (ISSUE 14): draft-model propose + k-token
+    verify vs the non-speculative engine on the IDENTICAL request set.
+
+    The draft/target pair is ``generate.truncated_draft`` — the draft
+    is the target's first layers sharing its embed/head (LayerSkip
+    shape), and the target's remaining layers are residual-dampened so
+    the pair has a high-but-honest (<1.0) acceptance ratio without a
+    training run. Both engines decode the same target params, so the
+    in-run identity check (spec == plain == oracle sample) is exact.
+
+    Acceptance (ISSUE 14): spec tokens/sec >= 1.4x the non-spec
+    engine AND measured acceptance_rate >= 0.6, with outputs
+    token-identical. Knobs: BENCH_SPEC_K (default 5),
+    BENCH_DRAFT_LAYERS (default 1), BENCH_DRAFT_DAMPEN (default
+    0.02 — enough upper-layer residual left that acceptance stays
+    honestly below 1.0, small enough that the 1-layer draft keeps
+    earning its verify)."""
+    from kubeflow_tpu.compute import generate as gen_lib
+
+    cfg = transformer.Config(
+        vocab_size=512, d_model=128, n_layers=4, n_heads=4,
+        max_seq=256, dtype="bfloat16", attention="dense", remat=False,
+        scan_layers=True)
+    params0 = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    spec_k = int(os.environ.get("BENCH_SPEC_K", "5"))
+    draft_layers = int(os.environ.get("BENCH_DRAFT_LAYERS", "1"))
+    dampen = float(os.environ.get("BENCH_DRAFT_DAMPEN", "0.02"))
+    target, draft, dcfg = gen_lib.truncated_draft(
+        params0, cfg, draft_layers, dampen=dampen)
+    slots = max(2, batch)
+    # decode-heavy mix (speculation amortizes target forwards over
+    # GENERATED tokens, so budgets skew long); same set for both
+    # engines, prefix_cache off so neither phase measures the cache
+    prompt_specs = []
+    rng = np.random.default_rng(0)
+    for i in range(3 * slots):
+        plen = (4, 12, 24, 60)[i % 4]
+        m = (int(steps) + 24, 16, 24, 16)[i % 4]
+        m = min(m, cfg.max_seq - plen)
+        prompt_specs.append(
+            ([int(t) for t in rng.integers(1, cfg.vocab_size, plen)],
+             m))
+
+    def run(engine):
+        s0 = dict(engine.stats)
+        t0 = time.perf_counter()
+        handles = [engine.submit(p, max_tokens=m)
+                   for p, m in prompt_specs]
+        outs = [h.result(timeout=600)[0] for h in handles]
+        dt = time.perf_counter() - t0
+        return outs, _generate_stats_delta(
+            engine, s0, sum(len(o) for o in outs), dt), s0
+
+    def warm(engine):
+        # max_tokens=8 runs real speculative rounds AND the final
+        # rem==1 fall-through, so the propose/verify programs AND the
+        # 1-wide decode step are all compiled outside the timed run
+        # (a 2-token warm would only ever hit the fall-through)
+        for plen in sorted({len(p) for p, _ in prompt_specs}):
+            engine.generate(list(range(1, plen + 1)), max_tokens=8)
+
+    plain = gen_lib.GenerationEngine(
+        target, cfg, max_slots=slots, block_size=16,
+        prefix_cache=False, name="bench-plain")
+    warm(plain)
+    outs_plain, st_plain, _ = run(plain)
+    plain.close()
+
+    spec = gen_lib.GenerationEngine(
+        target, cfg, max_slots=slots, block_size=16,
+        prefix_cache=False, name="bench-spec", draft_params=draft,
+        draft_config=dcfg, spec_k=spec_k)
+    warm(spec)
+    outs_spec, st_spec, s0 = run(spec)
+    d_prop = spec.stats["spec_proposed"] - s0["spec_proposed"]
+    d_acc = spec.stats["spec_accepted"] - s0["spec_accepted"]
+    d_slot_steps = spec.stats["decode_token_slots"] \
+        - s0["decode_token_slots"]
+    spec.close()
+    acceptance = d_acc / d_prop if d_prop else 0.0
+    # mean tokens a sequence advanced per verify round (1 + accepted
+    # per slot-step) — the serving_generate_tokens_per_step economics
+    tokens_per_step = 1 + d_acc / d_slot_steps if d_slot_steps else 1.0
+
+    # in-run token identity: every request identical engine-vs-engine,
+    # plus a full oracle recompute on a sample
+    identical = outs_spec == outs_plain
+    sample = prompt_specs[1]
+    ref = gen_lib.reference_greedy_decode(target, cfg, sample[0],
+                                          sample[1])
+    conforms = identical and outs_spec[1] == ref
+
+    speedup = st_spec["tps"] / st_plain["tps"] if st_plain["tps"] \
+        else 0.0
+    return {"metric": "generate_spec_tokens_per_sec",
+            "value": round(st_spec["tps"], 1), "unit": "tokens/sec",
+            "vs_non_speculative": round(speedup, 2),
+            "detail": {
+                "slots": slots, "prompts": len(prompt_specs),
+                "spec_k": spec_k, "draft_layers": draft_layers,
+                "draft_dampen": dampen,
+                "acceptance_rate": round(acceptance, 4),
+                "tokens_per_step": round(tokens_per_step, 2),
+                "non_spec_tokens_per_sec": round(st_plain["tps"], 1),
+                "occupancy": round(st_spec["occupancy"], 2),
+                "prefill_ms_per_request": round(
+                    st_spec["prefill_ms"], 2)
+                    if st_spec["prefill_ms"] is not None else None,
+                "greedy_matches_full_recompute": conforms,
+                "checks": {
+                    "tokens_per_sec_vs_non_spec_ge_1.4":
+                        speedup >= 1.4,
+                    "acceptance_rate_ge_0.6": acceptance >= 0.6,
+                    "spec_matches_non_spec_and_oracle": conforms,
+                }}}
+
+
 def _persist_generate_record(mode, result):
     """The generate track's persisted bench trajectory (satellite of
     ISSUE 13): every generate-mode run appends its headline numbers
@@ -1073,6 +1191,7 @@ def _persist_generate_record(mode, result):
         "prefill_ms": d.get("prefill_ms_per_request",
                             d.get("prefill_ms_per_request_warm")),
         "hit_ratio": d.get("hit_ratio"),
+        "acceptance_rate": d.get("acceptance_rate"),
         "checks": d.get("checks"),
     }
     doc["runs"] = (doc["runs"] + [entry])[-60:]
@@ -1224,18 +1343,20 @@ BENCHES = {
     "generate": (bench_generate, 4),
     "generate-prefix": (bench_generate_prefix, 4),
     "generate-sharded": (bench_generate_sharded, 4),
+    "generate-spec": (bench_generate_spec, 4),
     "study": (bench_study, 8),
 }
 
 #: generate-track modes whose headline numbers persist into
 #: BENCH_generate.json (_persist_generate_record)
-_GENERATE_MODES = ("generate", "generate-prefix", "generate-sharded")
+_GENERATE_MODES = ("generate", "generate-prefix", "generate-sharded",
+                   "generate-spec")
 
 
 # default-run order: headline resnet50 LAST (single-line consumers
 # read the final line)
 ALL_ORDER = ["lm", "bert", "serving", "generate", "generate-prefix",
-             "generate-sharded", "study", "resnet50"]
+             "generate-sharded", "generate-spec", "study", "resnet50"]
 
 
 def main():
@@ -1252,6 +1373,8 @@ def main():
         model = "generate-prefix"
     if "--sharded" in args:
         model = "generate-sharded"
+    if "--speculative" in args:
+        model = "generate-spec"
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     if model != "all" and model not in BENCHES:
         raise SystemExit(f"unknown BENCH_MODEL {model!r}; expected 'all' "

@@ -143,6 +143,15 @@ def main():
         "serving_generate_shard_mesh_devices",
         "serving_generate_shard_cache_blocks_per_chip",
         "serving_generate_shard_collective_share",
+        # speculative decoding surface (ISSUE 14): draft propose /
+        # target verify economics — what bench.py generate
+        # --speculative and loadtest --speculative read, plus the
+        # per-step normalizer that keeps decode_step_seconds
+        # interpretable when a step emits 1..k+1 tokens
+        "serving_generate_spec_proposed_tokens_total",
+        "serving_generate_spec_accepted_tokens_total",
+        "serving_generate_spec_acceptance_ratio",
+        "serving_generate_tokens_per_step",
         # sweep-pod failure re-packing (ROADMAP PR 5 follow-up)
         "sweep_repack_total",
     }

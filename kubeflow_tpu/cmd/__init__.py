@@ -287,8 +287,40 @@ def model_server(argv=()):
                  os.environ.get("GEN_TP", "1") or 1)
         mesh = mesh_lib.mesh_for_generation(tensor=tp) if tp > 1 \
             else None
+        # GEN_SPEC_K/GEN_DRAFT: speculative decoding — GEN_DRAFT=N
+        # carves a LayerSkip-style draft from the stock target's
+        # first N layers (gen_lib.truncated_draft); GEN_DRAFT_DAMPEN
+        # scales the target's remaining layers' residual write-backs
+        # so the pair has a measurable (<1.0 but high) acceptance
+        # ratio without a training run — the knob the speculative
+        # loadtest/bench drive. Both unset (the default) keeps the
+        # plain engine byte-for-byte.
+        spec_k = int(os.environ.get("GEN_SPEC_K", "0") or 0)
+        draft_params = draft_cfg = None
+        if spec_k > 0:
+            draft_layers = int(os.environ.get("GEN_DRAFT", "0") or 0)
+            if not draft_layers:
+                raise SystemExit(
+                    "GEN_SPEC_K > 0 needs GEN_DRAFT=<draft layers>")
+            dampen = os.environ.get("GEN_DRAFT_DAMPEN", "")
+            if dampen:
+                # dampen REWRITES the served target's upper layers
+                # (residual write-backs scaled) — it exists so the
+                # speculative bench/loadtest get a measurable
+                # draft/target pair from random weights, NOT for real
+                # checkpoints, whose predictions it would degrade
+                logging.warning(
+                    "GEN_DRAFT_DAMPEN=%s: the SERVED target model's "
+                    "layers >= %d are residual-dampened (test-pair "
+                    "knob; do not set on a real checkpoint)",
+                    dampen, draft_layers)
+            params, draft_params, draft_cfg = gen_lib.truncated_draft(
+                params, cfg, draft_layers,
+                dampen=float(dampen) if dampen else None)
         engine = gen_lib.GenerationEngine(
             params, cfg,
+            draft_params=draft_params, draft_config=draft_cfg,
+            spec_k=spec_k,
             max_slots=int(os.environ.get("GEN_SLOTS", "4")),
             block_size=int(os.environ.get("GEN_BLOCK_SIZE", "16")),
             num_blocks=int(os.environ.get("GEN_BLOCKS", "0"))

@@ -92,7 +92,10 @@ def chunk_attention(q, k, v, prefix_len, scale=None):
     they hold real cached positions (``col < prefix_len``); chunk
     columns are causal within the chunk (row r sees chunk cols
     ``<= r``). ``prefix_len`` may be a traced scalar, so one compiled
-    program serves every prefix length at a given chunk size.
+    program serves every prefix length at a given chunk size — or a
+    per-sequence ``[B]`` array, which is how the generation engine's
+    speculative VERIFY step scores every occupied slot's k+1 proposed
+    positions in one call (each slot sits at its own cache depth).
 
     Numerics deliberately mirror :func:`dense_attention` /
     :func:`decode_attention` op for op (same einsum contractions, fp32
@@ -110,8 +113,16 @@ def chunk_attention(q, k, v, prefix_len, scale=None):
     P = k.shape[1] - S
     rows = jnp.arange(S)[:, None]                   # chunk-local rows
     cols = jnp.arange(k.shape[1])[None, :]
-    valid = jnp.where(cols < P, cols < prefix_len, cols - P <= rows)
-    logits = jnp.where(valid[None, None], logits, NEG_INF)
+    pl = jnp.asarray(prefix_len)
+    if pl.ndim == 0:
+        valid = jnp.where(cols < P, cols < pl, cols - P <= rows)
+        logits = jnp.where(valid[None, None], logits, NEG_INF)
+    else:
+        # per-sequence prefix depth: [B] → mask [B, S, cols]
+        valid = jnp.where(cols[None] < P,
+                          cols[None] < pl[:, None, None],
+                          (cols - P <= rows)[None])
+        logits = jnp.where(valid[:, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 

@@ -1,0 +1,78 @@
+"""Tolerance-based conformance tier for the generation engine.
+
+The engine's first-class contract is TOKEN-identity against
+``generate.reference_greedy_decode`` — exact, but blunt: it cannot
+grade paths that are lossy BY DESIGN (int8 KV cache) or quantify how
+close a reduced-precision path (bf16) runs to the oracle, and ROADMAP
+names a logits-level tolerance tier as the prerequisite for sharding
+the row projections / embed+head (where bit-identity is unattainable
+and "close enough to never flip an argmax in practice" is the real
+requirement).
+
+This module is that tier: :func:`reference_logits` rolls the oracle
+forward collecting the pre-argmax fp32 logits per generated position,
+and :func:`assert_logits_close` grades another path's logits against
+them within ``atol``/``rtol`` — reporting the worst absolute and
+relative divergence (not just pass/fail) so a drifting path shows its
+margin before it starts flipping tokens. The engine side of the
+comparison comes from ``GenerationEngine(debug_logits=True)``, which
+makes the plain prefill/decode programs return each emitted token's
+logits on ``GenerationHandle.logits``.
+
+Applied today (tests/test_compute_generate.py) to the int8-KV and
+bf16 engine paths; the sharded row-projection work inherits it.
+"""
+
+import numpy as np
+
+from . import generate as gen_lib
+
+
+def reference_logits(params, config, prompt, max_tokens, eos_id=None):
+    """Greedy oracle rollout collecting logits — returns ``(tokens,
+    logits)`` where ``logits[i]`` is the fp32 ``[vocab]`` pre-argmax
+    row that produced ``tokens[i]``. Delegates to THE token oracle
+    (``generate.reference_greedy_decode(collect_logits=True)``): one
+    rollout serves both conformance tiers, so the token-identity and
+    logits-tolerance oracles cannot silently drift apart."""
+    return gen_lib.reference_greedy_decode(
+        params, config, prompt, max_tokens, eos_id=eos_id,
+        collect_logits=True)
+
+
+def max_divergence(got, want):
+    """Worst-case divergence report between two logits sequences:
+    ``{"atol": max |got-want|, "rtol": max |got-want| / (|want|+eps),
+    "steps": n}`` over every compared position. Lengths may differ
+    (a path that stopped early is graded on the common prefix)."""
+    n = min(len(got), len(want))
+    atol = rtol = 0.0
+    for g, w in zip(got[:n], want[:n]):
+        g = np.asarray(g, np.float32)
+        w = np.asarray(w, np.float32)
+        diff = np.abs(g - w)
+        atol = max(atol, float(diff.max()))
+        rtol = max(rtol, float(
+            (diff / (np.abs(w) + 1e-9)).max()))
+    return {"atol": atol, "rtol": rtol, "steps": n}
+
+
+def assert_logits_close(got, want, atol, rtol, what="logits"):
+    """Assert every compared position satisfies
+    ``|got - want| <= atol + rtol * |want|`` elementwise (the numpy
+    ``allclose`` contract), with the measured worst-case divergence in
+    the failure message so a drifting path reports its margin."""
+    n = min(len(got), len(want))
+    if n == 0:
+        raise AssertionError(f"{what}: nothing to compare")
+    for i, (g, w) in enumerate(zip(got[:n], want[:n])):
+        g = np.asarray(g, np.float32)
+        w = np.asarray(w, np.float32)
+        if not np.allclose(g, w, atol=atol, rtol=rtol):
+            report = max_divergence(got, want)
+            raise AssertionError(
+                f"{what} diverged at step {i}: worst "
+                f"atol={report['atol']:.6g} rtol={report['rtol']:.6g} "
+                f"over {report['steps']} steps (allowed atol={atol} "
+                f"rtol={rtol})")
+    return max_divergence(got, want)

@@ -76,6 +76,25 @@ built on this repo's own kernels):
   reproduces the unsharded engine byte-for-byte (a 1-shard gather is
   the identity).
 
+- **Speculative decoding** (``draft_params=``/``spec_k=``): a small
+  draft TransformerLM (its own dense per-slot KV-cache, replicated
+  under ``mesh=``) greedily proposes ``k`` tokens per occupied slot in
+  ONE jitted program (a scan of autoregressive micro-steps), and ONE
+  jitted VERIFY step scores all k+1 candidate positions of every slot
+  against the paged target cache — ``attention.chunk_attention``'s
+  offset-masked multi-token read, the same machinery as the cached
+  partial prefill, with a per-slot prefix depth. The host accepts the
+  longest prefix where draft == target argmax, emits the accepted
+  tokens (plus the target's bonus token) frame-per-token, and rolls
+  both caches back to the first rejection: write-then-truncate on the
+  block table (shared prefix pages are never written — verify writes
+  land past the prompt, always on fresh pages), position-pointer
+  truncation on the draft's dense cache. Greedy verification is
+  token-identical to the non-speculative engine BY CONSTRUCTION — the
+  emitted tokens are the target's own argmaxes — for ANY draft; the
+  draft's quality moves only the acceptance ratio (tokens/step).
+  ``spec_k=0`` / no draft leaves the PR-13 engine byte-for-byte.
+
 Numerics contract: greedy decode through the cache is token-identical
 to a full-context ``transformer.apply`` recompute of the same prompt
 (fp32 and bf16) — the engine mirrors the model's ops exactly
@@ -195,6 +214,33 @@ _SHARD_COLLECTIVE_SHARE = obs_metrics.REGISTRY.gauge(
     "measure_collective_share() calibration — 0.0 until calibrated "
     "or when the engine is unsharded",
     ("model",))
+_SPEC_PROPOSED_TOTAL = obs_metrics.REGISTRY.counter(
+    "serving_generate_spec_proposed_tokens_total",
+    "Draft-model tokens proposed to the speculative verify step "
+    "(clamped per slot to the remaining generation budget) — the "
+    "denominator of the acceptance ratio",
+    ("model",))
+_SPEC_ACCEPTED_TOTAL = obs_metrics.REGISTRY.counter(
+    "serving_generate_spec_accepted_tokens_total",
+    "Draft tokens the target's argmax confirmed (the longest "
+    "draft==target prefix per verify step) — rate() over the "
+    "proposed rate is the live acceptance ratio",
+    ("model",))
+_SPEC_ACCEPTANCE_RATIO = obs_metrics.REGISTRY.gauge(
+    "serving_generate_spec_acceptance_ratio",
+    "Cumulative accepted/proposed draft-token ratio — the "
+    "speculative speedup is ~(1 + k*ratio) tokens per target "
+    "forward, so a sustained low ratio means the draft/target pair "
+    "(or k) is mis-sized",
+    ("model",))
+_TOKENS_PER_STEP = obs_metrics.REGISTRY.histogram(
+    "serving_generate_tokens_per_step",
+    "Tokens a sequence emitted per decode/verify step — exactly 1 "
+    "on the plain engine, 1..k+1 under speculative decoding; "
+    "normalize serving_generate_decode_step_seconds by this "
+    "distribution's mean to keep per-token latency interpretable",
+    ("model",),
+    buckets=(1, 2, 3, 4, 5, 6, 8, 12, 16))
 
 
 class MeshShapeError(ValueError):
@@ -218,7 +264,9 @@ class GenerationHandle:
                  "on_token", "on_done", "rt", "out_tokens", "reason",
                  "error", "cancelled", "cancel_reason", "enqueued",
                  "enqueued_w", "prefix_tokens_skipped",
-                 "prefill_seconds", "_engine", "_done")
+                 "prefill_seconds", "spec_rounds", "spec_proposed",
+                 "spec_accepted", "spec_wire", "logits", "_engine",
+                 "_done")
 
     def __init__(self, prompt, max_tokens, eos_id, deadline,
                  on_token, on_done, rt):
@@ -239,6 +287,16 @@ class GenerationHandle:
         #                                  both set when prefill runs,
         #                                  surfaced per-request in the
         #                                  stream's done frame
+        self.spec_rounds = 0      # speculative economics, surfaced in
+        self.spec_proposed = 0    # the done frame's "spec" view: verify
+        self.spec_accepted = 0    # rounds + draft tokens judged/kept
+        self.spec_wire = None     # X-Spec-Acceptance value FROZEN at
+        #                           this request's prefill (the stream
+        #                           head races the engine's own verify
+        #                           rounds otherwise)
+        self.logits = []          # per-emitted-token fp32 logits, filled
+        #                           only on a debug_logits engine (the
+        #                           tolerance-conformance probe)
         self.enqueued = time.perf_counter()
         self.enqueued_w = time.time()
         self._engine = None       # set by submit(); result(timeout)
@@ -320,7 +378,18 @@ class GenerationEngine:
     - ``prefix_cache``: radix-tree prefix KV reuse (default on).
       ``False`` restores free-immediately eviction and full prefill
       for every prompt — the cold-cache baseline ``bench.py
-      generate --shared-prefix`` measures against.
+      generate --shared-prefix`` measures against,
+    - ``draft_params``/``draft_config``/``spec_k``: speculative
+      decoding — the draft greedily proposes up to ``spec_k`` tokens
+      per slot per round, one jitted verify scores them all, and each
+      round emits 1..k+1 tokens. ``spec_k=0`` (the default) or no
+      draft reproduces the plain engine byte-for-byte. The draft must
+      share the target's vocab (ids are compared) and be dense,
+    - ``debug_logits``: tolerance-conformance probe — the plain
+      prefill/decode programs additionally return the emitted token's
+      fp32 logits, collected on ``GenerationHandle.logits``
+      (``compute/conformance.py``; requires ``prefix_cache=False``,
+      no mesh, no draft).
 
     Threading: ONE engine thread owns every device call and all slot
     state; ``submit``/``cancel``/``begin_drain`` are thread-safe and
@@ -332,7 +401,8 @@ class GenerationEngine:
                  max_context=None, num_blocks=None, kv_dtype=None,
                  name="model", version=1, eos_id=None,
                  default_max_tokens=64, admission="continuous",
-                 prefix_cache=True, mesh=None):
+                 prefix_cache=True, mesh=None, draft_params=None,
+                 draft_config=None, spec_k=0, debug_logits=False):
         if config.moe_experts or config.pipeline_stages > 1:
             raise ValueError(
                 "GenerationEngine supports dense TransformerLM configs "
@@ -344,6 +414,30 @@ class GenerationEngine:
             raise ValueError(
                 f"admission must be 'continuous' or 'drain', got "
                 f"{admission!r}")
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if self.spec_k > 0 and draft_params is None:
+            raise ValueError(
+                "spec_k > 0 needs a draft model (draft_params + "
+                "draft_config); spec_k=0 disables speculation")
+        if draft_params is not None and draft_config is None:
+            raise ValueError("draft_params needs its draft_config")
+        # speculation is ON only when both the draft and k are given:
+        # spec_k=0 (or no draft) reproduces the plain engine
+        # byte-for-byte — none of the draft/verify machinery is built
+        self._spec_on = draft_params is not None and self.spec_k > 0
+        if self._spec_on:
+            if draft_config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size {draft_config.vocab_size} must "
+                    f"equal the target's {config.vocab_size}: accepted "
+                    f"tokens are compared by id")
+            if draft_config.moe_experts \
+                    or draft_config.pipeline_stages > 1:
+                raise ValueError(
+                    "the draft must be a dense TransformerLM (no MoE, "
+                    "no pipeline parallelism)")
         self.mesh = mesh
         self.tp = 1
         if mesh is not None:
@@ -398,6 +492,13 @@ class GenerationEngine:
         if mesh is not None:
             params = self._shard_params(params)
         self.params = params
+        self.debug_logits = bool(debug_logits)
+        if self.debug_logits and (prefix_cache or mesh is not None
+                                  or self._spec_on):
+            raise ValueError(
+                "debug_logits is the plain-path tolerance-conformance "
+                "probe (compute/conformance.py): it requires "
+                "prefix_cache=False, no mesh and no draft model")
         # the decode step DONATES the cache (argnum 1): the per-step
         # functional update aliases the input buffers instead of
         # double-buffering the pool (tests pin the no-copy via
@@ -420,6 +521,39 @@ class GenerationEngine:
                 self._prefill_cached_step, 5))
             self._decode_jit = jax.jit(self._shard(self._decode_step, 5),
                                        donate_argnums=(1,))
+        self.draft_config = draft_config if self._spec_on else None
+        self.draft_params = None
+        if self._spec_on:
+            dlayers = draft_params["layers"]
+            if isinstance(dlayers, (list, tuple)):
+                dlayers = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                       *dlayers)
+                draft_params = {**draft_params, "layers": dlayers}
+            # the draft's dense per-slot cache spans the same per-slot
+            # token capacity as the paged target pool
+            self._draft_ctx = self.blocks_per_slot * self.block_size
+            self._draft_cache = self._make_draft_cache()
+            if mesh is not None:
+                # the draft is REPLICATED: every chip runs the whole
+                # (tiny) draft identically, so proposals need no
+                # collectives and the sharded verify step stays the
+                # engine's only cross-chip program
+                rep = NamedSharding(mesh, P())
+                draft_params = jax.tree.map(
+                    lambda a: jax.device_put(a, rep), draft_params)
+            self.draft_params = draft_params
+            # draft prefill stays undonated for the same reason as
+            # the target prefill (its error path needs the old cache
+            # alive); the per-round propose DONATES the draft cache —
+            # no per-round deep copy on the hot path
+            self._draft_prefill_jit = jax.jit(self._draft_prefill_step)
+            self._propose_jit = jax.jit(self._propose_step,
+                                        donate_argnums=(1,))
+            # the verify step writes the paged pool exactly like the
+            # decode step (and donates it for the same no-copy reason)
+            verify = (self._verify_step if mesh is None
+                      else self._shard(self._verify_step, 5))
+            self._verify_jit = jax.jit(verify, donate_argnums=(1,))
         self._local_decode_jit = None     # measure_collective_share
         _SHARD_MESH_DEVICES.labels(name).set(self.tp)
         _SHARD_BLOCKS_PER_CHIP.labels(name).set(
@@ -460,7 +594,8 @@ class GenerationEngine:
                       "peak_occupancy": 0, "prefill_seconds_total": 0.0,
                       "prefix_hits": 0, "prefix_misses": 0,
                       "prefix_tokens_skipped": 0, "prefix_reclaims": 0,
-                      "collective_share": 0.0}
+                      "collective_share": 0.0, "spec_rounds": 0,
+                      "spec_proposed": 0, "spec_accepted": 0}
         self.thread = threading.Thread(target=self._loop, daemon=True,
                                        name=f"generate-{name}")
         self.thread.start()
@@ -519,6 +654,27 @@ class GenerationEngine:
             cache = tuple(
                 jax.device_put(a, NamedSharding(self.mesh, s))
                 for a, s in zip(cache, self._cache_specs()))
+        return cache
+
+    def _make_draft_cache(self):
+        """The draft model's dense per-slot KV cache: [layers, slot,
+        position, kv_heads, head_dim], one row of ``_draft_ctx``
+        positions per decode slot (replicated on the mesh when one is
+        set). Dense (not paged) because the draft's cache is pure
+        scratch — rollback after a verify step is a host-side
+        position-pointer truncation (garbage past the accepted length
+        is masked by the next round's length mask), and nothing in it
+        is ever shared or retained. Called at init AND from
+        ``_fail_everything``: the propose program DONATES this cache,
+        so a raising propose call leaves it consumed."""
+        c = self.draft_config
+        dt = c.compute_dtype
+        shape = (c.n_layers, self.max_slots, self._draft_ctx,
+                 c.kv_heads, c.head_dim)
+        cache = (jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+        if self.mesh is not None:
+            rep = NamedSharding(self.mesh, P())
+            cache = tuple(jax.device_put(a, rep) for a in cache)
         return cache
 
     def _shard_params(self, params):
@@ -653,6 +809,49 @@ class GenerationEngine:
         return (f"tensor={self.tp};"
                 f"per_chip_blocks={self.per_chip_blocks}")
 
+    def spec_view(self, handle=None):
+        """Speculative-decoding economics (snapshot + the ``spec``
+        block of the ``:generate`` done frame); ``None`` when
+        speculation is off, so the non-speculative wire contract
+        stays byte-identical. With a ``handle``, adds the
+        per-request view — ``accepted_per_step`` is the mean draft
+        tokens kept per verify round (each round emits
+        ``accepted + 1`` tokens, so tokens/step = this + 1)."""
+        if not self._spec_on:
+            return None
+        proposed = self.stats["spec_proposed"]
+        accepted = self.stats["spec_accepted"]
+        view = {"k": self.spec_k,
+                "draft_layers": self.draft_config.n_layers,
+                "proposed": proposed, "accepted": accepted,
+                "acceptance_ratio": round(accepted / proposed, 4)
+                    if proposed else None}
+        if handle is not None:
+            view.update({
+                "steps": handle.spec_rounds,
+                "request_proposed": handle.spec_proposed,
+                "request_accepted": handle.spec_accepted,
+                "accepted_per_step": round(
+                    handle.spec_accepted / handle.spec_rounds, 3)
+                    if handle.spec_rounds else 0.0})
+        return view
+
+    def spec_header(self):
+        """``X-Spec-Acceptance`` wire value, mirrored by the router;
+        ``None`` (header omitted) when speculation is off. Exact
+        cumulative counts rather than a rounded ratio, so a driver
+        that has consumed every prior done frame can assert the
+        header AGREES with them (loadtest ``--speculative`` does).
+        The transports send the copy FROZEN on the handle at prefill
+        (``GenerationHandle.spec_wire``) — the live value races the
+        request's own verify rounds by the time the head is
+        written."""
+        if not self._spec_on:
+            return None
+        return (f"k={self.spec_k};"
+                f"proposed={self.stats['spec_proposed']};"
+                f"accepted={self.stats['spec_accepted']}")
+
     # ------------------------------------------------------ public API
 
     def submit(self, tokens, max_tokens=None, eos_id=None,
@@ -777,6 +976,8 @@ class GenerationEngine:
                 # construction — the pool is head-partitioned, every
                 # chip holds a slice of every block)
                 "mesh": self.mesh_view(),
+                # draft/verify economics (None when speculation off)
+                "speculative": self.spec_view(),
                 "prefix_cache": {
                     "enabled": self.prefix_cache,
                     "cached_blocks": len(self._node_by_block),
@@ -846,7 +1047,10 @@ class GenerationEngine:
                 self._admit()
                 self._sweep_active()
                 if any(s is not None for s in self._slots):
-                    self._decode_once()
+                    if self._spec_on:
+                        self._spec_decode_once()
+                    else:
+                        self._decode_once()
             except Exception as e:  # noqa: BLE001 — no caller may hang
                 log.exception("generation engine %s loop iteration "
                               "crashed; failing in-flight work",
@@ -884,6 +1088,11 @@ class GenerationEngine:
         # drained, so nothing references the old pool.
         try:
             cache = self._make_cache()
+            # the propose program donates the draft cache the same
+            # way — rebuild it too so a crashed speculative round
+            # heals alongside the paged pool
+            draft_cache = self._make_draft_cache() if self._spec_on \
+                else None
         except Exception:  # noqa: BLE001 — allocation itself failing
             log.exception("could not rebuild the KV cache pool after "
                           "an engine crash; engine %s stays degraded",
@@ -891,6 +1100,8 @@ class GenerationEngine:
             return
         with self._cond:
             self._cache = cache
+            if draft_cache is not None:
+                self._draft_cache = draft_cache
             self._free = list(range(self.num_blocks))
             self._ref = [0] * self.num_blocks
             self._root = _PrefixNode(None, None, None)
@@ -1170,10 +1381,28 @@ class GenerationEngine:
                     np.int32(suffix_len), np.int32(offset), tables,
                     np.asarray(fresh, np.int32))
             else:
-                cache, first = self._prefill_jit(
+                out = self._prefill_jit(
                     self.params, self._cache, tokens,
                     np.int32(prompt_len), np.asarray(fresh, np.int32))
+                if self.debug_logits:
+                    cache, first, dbg = out
+                    handle.logits.append(np.asarray(dbg, np.float32))
+                else:
+                    cache, first = out
             first = int(first)
+            if self._spec_on:
+                # the draft prefills the FULL prompt into its dense
+                # per-slot cache (it has no paged prefix sharing; it
+                # is tiny, so re-running shared tokens is cheap) —
+                # its padded tail writes garbage K/V past prompt_len
+                # that the next proposal round overwrites before any
+                # read can see it (reads are length-masked)
+                dpad = self._suffix_padded(prompt_len, 0)
+                dtok = np.zeros((dpad,), np.int32)
+                dtok[:prompt_len] = handle.prompt
+                self._draft_cache = self._draft_prefill_jit(
+                    self.draft_params, self._draft_cache, dtok,
+                    np.int32(slot_idx))
         except Exception as e:  # noqa: BLE001 — a failed prefill
             # (compile OOM, device error) must fail THIS request, not
             # hang it: the handle is in neither the queue nor a slot
@@ -1202,6 +1431,11 @@ class GenerationEngine:
                             prefix_tokens_skipped=offset)
         self.stats["prefills"] += 1
         self.stats["prefill_seconds_total"] += elapsed
+        # freeze the wire header NOW: the engine-cumulative counts as
+        # of this request's admission, before any of its own verify
+        # rounds can move them (the transports send the head after
+        # the first token, which races later rounds)
+        handle.spec_wire = self.spec_header()
         slot = _Slot(handle, prefix_blocks + fresh, prompt_len, first,
                      len(matched) + self._worst_case_blocks(
                          prompt_len, handle.max_tokens, len(matched)))
@@ -1246,9 +1480,13 @@ class GenerationEngine:
             write_phys[i] = slot.blocks[block_idx]
             write_off[i] = pos % bs
         t0 = time.perf_counter()
-        cache, nxt = self._decode_jit(self.params, self._cache, tables,
-                                      lengths, tokens, write_phys,
-                                      write_off)
+        out = self._decode_jit(self.params, self._cache, tables,
+                               lengths, tokens, write_phys, write_off)
+        if self.debug_logits:
+            cache, nxt, dbg = out
+            dbg = np.asarray(dbg, np.float32)
+        else:
+            cache, nxt = out
         nxt = np.asarray(nxt)
         self._cache = cache
         if self._step_sleep:
@@ -1267,11 +1505,196 @@ class GenerationEngine:
             token = int(nxt[i])
             slot.last_token = token
             handle = slot.handle
+            _TOKENS_PER_STEP.labels(self.name).observe(1)
+            if self.debug_logits:
+                handle.logits.append(dbg[i])
             self._emit(handle, token)
             if handle.eos_id is not None and token == handle.eos_id:
                 self._evict(i, "eos")
             elif len(handle.out_tokens) >= handle.max_tokens:
                 self._evict(i, "length")
+
+    # ------------------------------------------------ speculative step
+
+    def _spec_decode_once(self):
+        """One speculative round: the draft proposes up to ``spec_k``
+        tokens per occupied slot (ONE jitted program), the target
+        scores all k+1 candidate positions of every slot in ONE
+        jitted verify call against the paged cache, and the host
+        accepts the longest draft==target-argmax prefix per slot —
+        emitting ``accepted + 1`` tokens (the target's bonus token is
+        the argmax at the first rejection, exactly what plain decode
+        would have emitted) and rolling the block table back to the
+        first rejection. Token-identical to :meth:`_decode_once` for
+        ANY draft: every emitted token is the target's own argmax
+        given the (verified) true prefix."""
+        active = [(i, s) for i, s in enumerate(self._slots)
+                  if s is not None]
+        S, bps, bs = self.max_slots, self.blocks_per_slot, \
+            self.block_size
+        k = self.spec_k
+        k_eff = {}
+        last_token_round = True    # every active slot on its final
+        #                            budgeted token
+        with self._cond:
+            free_budget = len(self._free)
+        for i, slot in active:
+            L = slot.length
+            handle = slot.handle
+            # clamp the speculative depth so the verify writes (and
+            # the emitted tokens) can never run past max_tokens or
+            # the slot's reserved block budget: positions L..L+ke are
+            # written, and L+ke <= prompt+max_tokens-1 keeps the
+            # admission reservation exact
+            remaining = handle.max_tokens - len(handle.out_tokens)
+            last_token_round &= remaining == 1
+            ke = max(0, min(k, remaining - 1,
+                            self.max_context - 1 - L))
+            # ...and so SPECULATIVE allocation never LRU-reclaims a
+            # cached prefix page for proposals that may be rejected:
+            # extra blocks beyond the guaranteed next write (position
+            # L, reservation-backed, may reclaim) must come from the
+            # slot's own table or the shared free-list budget — a
+            # warm trie is worth more than a deeper gamble. The
+            # budget is drawn down slot by slot so concurrent slots
+            # cannot each size their gamble against the same free
+            # blocks
+            held = len(slot.blocks)
+            base_need = L // bs + 1
+            free_budget -= max(0, base_need - held)
+            avail = max(held, base_need) + max(0, free_budget)
+            ke = max(0, min(ke, avail * bs - 1 - L))
+            free_budget -= max(0, (L + ke) // bs + 1
+                               - max(held, base_need))
+            k_eff[i] = ke
+        if active and last_token_round:
+            # every slot emits its final token and evicts this round:
+            # the plain decode step does the same work with a 1-wide
+            # program and no draft forwards, and the slots' draft
+            # caches can never be read again so skipping their writes
+            # is safe (a ke==0 slot that will CONTINUE goes through
+            # the wide path instead — its propose micro-step writes
+            # the real token's draft K/V at position L, keeping the
+            # draft flush with the target). Still counts as a verify
+            # round with zero proposals, so the per-request
+            # accounting (emitted == accepted + 1 per round) stays
+            # exact for the done frame's accepted_per_step
+            self.stats["spec_rounds"] += 1
+            for _i, slot in active:
+                slot.handle.spec_rounds += 1
+            self._decode_once()
+            return
+        tables = np.zeros((S, bps), np.int32)
+        lengths = np.zeros((S,), np.int32)
+        tokens = np.zeros((S,), np.int32)
+        # inactive slots: draft writes drop past _draft_ctx, verify
+        # writes drop at block id num_blocks (same sentinel as decode)
+        limits = np.full((S,), -1, np.int32)
+        write_phys = np.full((S, k + 1), self.num_blocks, np.int32)
+        write_off = np.zeros((S, k + 1), np.int32)
+        for i, slot in active:
+            L = slot.length
+            ke = k_eff[i]
+            need = (L + ke) // bs + 1
+            with self._cond:
+                while len(slot.blocks) < need:
+                    slot.blocks.append(self._alloc_block_locked())
+            tables[i, :len(slot.blocks)] = slot.blocks
+            lengths[i] = L
+            tokens[i] = slot.last_token
+            limits[i] = L + ke
+            for j in range(ke + 1):
+                p = L + j
+                write_phys[i, j] = slot.blocks[p // bs]
+                write_off[i, j] = p % bs
+        t0 = time.perf_counter()
+        dcache, props = self._propose_jit(
+            self.draft_params, self._draft_cache, tokens, lengths,
+            limits)
+        self._draft_cache = dcache
+        props = np.asarray(props)                        # [S, k]
+        vtokens = np.concatenate(
+            [tokens[:, None], props], axis=1).astype(np.int32)
+        cache, target = self._verify_jit(
+            self.params, self._cache, tables, lengths, vtokens,
+            write_phys, write_off)
+        self._cache = cache
+        target = np.asarray(target)                      # [S, k+1]
+        if self._step_sleep:
+            time.sleep(self._step_sleep)
+        elapsed = time.perf_counter() - t0
+        _DECODE_STEP_SECONDS.labels(self.name).observe(elapsed)
+        _SLOT_OCCUPANCY.labels(self.name).observe(len(active))
+        self.stats["decode_steps"] += 1
+        self.stats["decode_token_slots"] += len(active)
+        self.stats["spec_rounds"] += 1
+        self.stats["peak_occupancy"] = max(
+            self.stats["peak_occupancy"], len(active))
+        accepts = {}
+        proposed_round = accepted_round = 0
+        for i, slot in active:
+            ke = k_eff[i]
+            a = 0
+            while a < ke and props[i, a] == target[i, a]:
+                a += 1
+            accepts[i] = a
+            proposed_round += ke
+            accepted_round += a
+            handle = slot.handle
+            handle.spec_rounds += 1
+            handle.spec_proposed += ke
+            handle.spec_accepted += a
+        # book the round's engine-level economics BEFORE the emission
+        # loop: an eviction in it resolves the handle, and the
+        # transport thread builds the done frame's spec block from
+        # these counters the moment that happens — updating them
+        # afterwards would ship a frame whose engine view excludes
+        # the request's own final round
+        if proposed_round:
+            self.stats["spec_proposed"] += proposed_round
+            self.stats["spec_accepted"] += accepted_round
+            _SPEC_PROPOSED_TOTAL.labels(self.name).inc(proposed_round)
+            if accepted_round:
+                _SPEC_ACCEPTED_TOTAL.labels(self.name).inc(
+                    accepted_round)
+            _SPEC_ACCEPTANCE_RATIO.labels(self.name).set(
+                self.stats["spec_accepted"]
+                / self.stats["spec_proposed"])
+        for i, slot in active:
+            a = accepts[i]
+            handle = slot.handle
+            L = slot.length
+            # rollback = write-then-truncate: the verified prefix
+            # (inputs x_0..x_a at positions L..L+a) stays, everything
+            # past the first rejection is dead — truncate the block
+            # table back to the last valid position and return the
+            # over-allocated fresh pages (shared prefix pages live
+            # below the prompt boundary and were never written)
+            slot.length = L + a + 1
+            slot.last_token = int(target[i, a])
+            keep = (slot.length - 1) // bs + 1
+            if len(slot.blocks) > keep:
+                with self._cond:
+                    extra = slot.blocks[keep:]
+                    del slot.blocks[keep:]
+                    self._release_blocks_locked(extra)
+            emitted = 0
+            for j in range(a + 1):
+                token = int(target[i, j])
+                self._emit(handle, token)
+                emitted += 1
+                if handle.eos_id is not None \
+                        and token == handle.eos_id:
+                    # nothing PAST the eos may survive: not on the
+                    # stream (the loop breaks) and not in retained
+                    # cache (eviction frees every decode-written
+                    # page; only full PROMPT blocks are trie-indexed)
+                    self._evict(i, "eos")
+                    break
+                if len(handle.out_tokens) >= handle.max_tokens:
+                    self._evict(i, "length")
+                    break
+            _TOKENS_PER_STEP.labels(self.name).observe(emitted)
 
     # ------------------------------------------------------ resolution
 
@@ -1317,7 +1740,7 @@ class GenerationEngine:
 
     # ------------------------------------------------- jitted programs
 
-    def _layer_core(self, x, lp, attend):
+    def _layer_core(self, x, lp, attend, cfg=None, replicated=False):
         """The transformer layer with attention abstracted: mirrors
         ``transformer._layer`` op for op (einsum strings, dtype casts,
         silu MLP) so the cached paths stay token-identical to
@@ -1326,32 +1749,36 @@ class GenerationEngine:
         the column projections and attention run head/hidden-LOCAL
         and ``_gathered`` widens the two sliced activations back to
         full for the replicated row projections — the layer's only
-        collectives."""
-        c = self.config
+        collectives. The DRAFT model's programs pass ``cfg`` (its own
+        config) and ``replicated=True``: the draft runs whole on every
+        chip, so its layer core must not emit gathers."""
+        c = cfg or self.config
+        gathered = ((lambda t, axis: t) if replicated
+                    else self._gathered)
         dt = c.compute_dtype
         h = transformer._rmsnorm(x, lp["attn_norm"].astype(dt))
         q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(dt))
         k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(dt))
         v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(dt))
         o, extra = attend(q, k, v)
-        x = x + jnp.einsum("bshk,hkd->bsd", self._gathered(o, 2),
+        x = x + jnp.einsum("bshk,hkd->bsd", gathered(o, 2),
                            lp["wo"].astype(dt))
         h = transformer._rmsnorm(x, lp["mlp_norm"].astype(dt))
         gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(dt))
         up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(dt))
         down = jnp.einsum(
             "bsf,fd->bsd",
-            self._gathered(jax.nn.silu(gate) * up, 2),
+            gathered(jax.nn.silu(gate) * up, 2),
             lp["w_down"].astype(dt))
         return x + down, extra
 
-    def _head_logits(self, params, x):
+    def _head_logits(self, params, x, cfg=None):
         """Final-norm hidden → fp32 logits (mirrors
         ``transformer._logits`` numerics). ``final_norm``/``head`` are
         replicated under a mesh, so every chip computes the full vocab
         row and the greedy argmax identically — no collective on the
-        sampling path."""
-        c = self.config
+        sampling path. ``cfg`` is the draft's config in its programs."""
+        c = cfg or self.config
         x = transformer._rmsnorm(
             x, params["final_norm"].astype(c.compute_dtype))
         return jnp.einsum("bsd,dv->bsv", x,
@@ -1413,7 +1840,12 @@ class GenerationEngine:
         pad = block_ids.shape[0] * self.block_size - tokens.shape[0]
         pages = [jnp.pad(p, ((0, 0), (0, pad), (0, 0), (0, 0)))
                  for p in (ks, vs)]
-        return self._write_pages(cache, pages, block_ids), first
+        cache = self._write_pages(cache, pages, block_ids)
+        if self.debug_logits:
+            # tolerance-conformance probe: the first token's fp32
+            # logits ride along (compute/conformance.py)
+            return cache, first, logits[0, 0]
+        return cache, first
 
     def _prefill_cached_step(self, params, cache, tokens, true_len,
                              offset, prefix_tables, block_ids):
@@ -1485,6 +1917,48 @@ class GenerationEngine:
         kc, vc = cache_l
         return flat(kc[tables]), flat(vc[tables])
 
+    def _write_kv(self, cache_l, phys, off, k, v):
+        """Scatter K/V rows into one layer's slice of the paged pool
+        at ``(phys, off)``, quantizing when the cache is int8 —
+        shared by the decode step (``[S]`` single positions) and the
+        verify step (``[S, k+1]`` chunks), which must stay
+        op-identical for the speculative token-identity contract.
+        Out-of-bounds positions (inactive slots, clamped speculative
+        writes) drop."""
+        if self.kv_dtype == "int8":
+            kc, vc, ks, vs = cache_l
+            kq, ksc = quantize_lib.kv_quantize(k)
+            vq, vsc = quantize_lib.kv_quantize(v)
+            return (kc.at[phys, off].set(kq, mode="drop"),
+                    vc.at[phys, off].set(vq, mode="drop"),
+                    ks.at[phys, off].set(ksc, mode="drop"),
+                    vs.at[phys, off].set(vsc, mode="drop"))
+        kc, vc = cache_l
+        return (kc.at[phys, off].set(k, mode="drop"),
+                vc.at[phys, off].set(v, mode="drop"))
+
+    @staticmethod
+    def _rope_rows_fn(cos, sin):
+        """apply_rope at per-row positions — same pair rotation +
+        stacking order as ``transformer.apply_rope``. ``cos``/``sin``
+        are ``[S, hd/2]`` for ``[S, 1, H, D]`` single-row inputs (the
+        decode step, the draft's propose micro-steps) or
+        ``[S, K1, hd/2]`` for the verify step's ``[S, K1, H, D]``
+        grid; ONE implementation so the three programs cannot
+        silently diverge on the rotation the token-identity contract
+        depends on."""
+        def rope_rows(t):
+            x1, x2 = t[..., 0::2], t[..., 1::2]
+            cc = jnp.expand_dims(cos, -2)
+            ss = jnp.expand_dims(sin, -2)
+            if cos.ndim == 2:          # [S, hd/2] → align to [S, 1, ...]
+                cc, ss = cc[:, None], ss[:, None]
+            cc, ss = cc.astype(t.dtype), ss.astype(t.dtype)
+            return jnp.stack([x1 * cc - x2 * ss, x1 * ss + x2 * cc],
+                             axis=-1).reshape(t.shape)
+
+        return rope_rows
+
     def _decode_step(self, params, cache, tables, lengths, tokens,
                      write_phys, write_off):
         """One token for every occupied slot: write the input token's
@@ -1497,29 +1971,7 @@ class GenerationEngine:
         n_rep = c.n_heads // c.kv_heads
         x = self._embed(params["embed"].astype(dt), tokens[:, None])
         cos, sin = transformer.rope_tables(c, lengths)
-
-        def rope_rows(t):
-            # apply_rope with per-ROW positions ([S] new tokens at [S]
-            # different offsets); same pair rotation + stacking order
-            x1, x2 = t[..., 0::2], t[..., 1::2]
-            cc = cos[:, None, None, :].astype(t.dtype)
-            ss = sin[:, None, None, :].astype(t.dtype)
-            return jnp.stack([x1 * cc - x2 * ss, x1 * ss + x2 * cc],
-                             axis=-1).reshape(t.shape)
-
-        def write_one(cache_l, k1, v1):
-            if self.kv_dtype == "int8":
-                kc, vc, ks, vs = cache_l
-                kq, ksc = quantize_lib.kv_quantize(k1)
-                vq, vsc = quantize_lib.kv_quantize(v1)
-                return (
-                    kc.at[write_phys, write_off].set(kq, mode="drop"),
-                    vc.at[write_phys, write_off].set(vq, mode="drop"),
-                    ks.at[write_phys, write_off].set(ksc, mode="drop"),
-                    vs.at[write_phys, write_off].set(vsc, mode="drop"))
-            kc, vc = cache_l
-            return (kc.at[write_phys, write_off].set(k1, mode="drop"),
-                    vc.at[write_phys, write_off].set(v1, mode="drop"))
+        rope_rows = self._rope_rows_fn(cos, sin)
 
         def layer_fn(x, layer_in):
             lp, cache_l = layer_in[0], tuple(layer_in[1:])
@@ -1528,7 +1980,9 @@ class GenerationEngine:
                 q, k = rope_rows(q), rope_rows(k)
                 # write THEN gather: the new token's own K/V must be
                 # part of its attention context (lengths+1 below)
-                new_cache_l = write_one(cache_l, k[:, 0], v[:, 0])
+                new_cache_l = self._write_kv(cache_l, write_phys,
+                                             write_off, k[:, 0],
+                                             v[:, 0])
                 k_all, v_all = self._gather_kv(new_cache_l, tables)
                 o = attn_lib.decode_attention(
                     q, attn_lib.repeat_kv(k_all, n_rep),
@@ -1541,7 +1995,209 @@ class GenerationEngine:
                                 (params["layers"],) + cache)
         logits = self._head_logits(params, x)
         nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        if self.debug_logits:
+            # tolerance-conformance probe (compute/conformance.py)
+            return tuple(new_cache), nxt, logits[:, 0]
         return tuple(new_cache), nxt
+
+    def _draft_prefill_step(self, draft_params, draft_cache, tokens,
+                            slot_idx):
+        """Fill the draft's dense cache rows for ``slot_idx`` from the
+        (bucket-padded) prompt: one causal forward through the draft,
+        K/V written at positions ``0..padded-1``. The padded tail's
+        garbage K/V sits past ``prompt_len`` where every later read is
+        length-masked until the proposal rounds overwrite it. No token
+        is emitted — the first generated token is the TARGET
+        prefill's."""
+        c = self.draft_config
+        dt = c.compute_dtype
+        n_rep = c.n_heads // c.kv_heads
+        x = jnp.take(draft_params["embed"].astype(dt), tokens[None],
+                     axis=0)
+        rope = transformer.rope_tables(c, jnp.arange(tokens.shape[0]))
+
+        def attend(q, k, v):
+            q = transformer.apply_rope(q, *rope)
+            k = transformer.apply_rope(k, *rope)
+            o = attn_lib.dense_attention(
+                q, attn_lib.repeat_kv(k, n_rep),
+                attn_lib.repeat_kv(v, n_rep), causal=True)
+            return o, (k[0], v[0])
+
+        def layer_fn(x, lp):
+            return self._layer_core(x, lp, attend, cfg=c,
+                                    replicated=True)
+
+        _x, (ks, vs) = lax.scan(layer_fn, x, draft_params["layers"])
+        kc, vc = draft_cache
+        kc = lax.dynamic_update_slice(
+            kc, ks[:, None].astype(kc.dtype), (0, slot_idx, 0, 0, 0))
+        vc = lax.dynamic_update_slice(
+            vc, vs[:, None].astype(vc.dtype), (0, slot_idx, 0, 0, 0))
+        return (kc, vc)
+
+    def _propose_step(self, draft_params, draft_cache, tokens,
+                      lengths, limits):
+        """Draft proposal: ``spec_k`` greedy tokens per occupied slot
+        in ONE jitted program — a ``lax.scan`` of ``spec_k + 1``
+        autoregressive micro-steps over the draft's dense per-slot
+        cache. The extra micro-step emits nothing the host uses: it
+        exists to WRITE the last proposal's own K/V, so that after any
+        acceptance count the draft cache is valid exactly through the
+        target's new length (rollback is then always a pure position
+        truncation, never a catch-up forward). ``limits[i]`` is the
+        last position slot i may write (its clamped speculative
+        depth); writes past it — and every inactive slot's writes —
+        drop out of bounds."""
+        c = self.draft_config
+        n_rep = c.n_heads // c.kv_heads
+        dt = c.compute_dtype
+        rows = jnp.arange(tokens.shape[0])
+
+        def micro(carry, _):
+            cache, tok, pos = carry
+            x = jnp.take(draft_params["embed"].astype(dt),
+                         tok[:, None], axis=0)
+            cos, sin = transformer.rope_tables(c, pos)
+            rope_rows = self._rope_rows_fn(cos, sin)
+            wp = jnp.where(pos <= limits, pos, self._draft_ctx)
+
+            def layer_fn(x, layer_in):
+                lp, cache_l = layer_in[0], tuple(layer_in[1:])
+
+                def attend(q, k, v):
+                    q, k = rope_rows(q), rope_rows(k)
+                    kc, vc = cache_l
+                    kc = kc.at[rows, wp].set(k[:, 0], mode="drop")
+                    vc = vc.at[rows, wp].set(v[:, 0], mode="drop")
+                    o = attn_lib.decode_attention(
+                        q, attn_lib.repeat_kv(kc, n_rep),
+                        attn_lib.repeat_kv(vc, n_rep), pos + 1)
+                    return o, (kc, vc)
+
+                return self._layer_core(x, lp, attend, cfg=c,
+                                        replicated=True)
+
+            x, new_cache = lax.scan(layer_fn, x,
+                                    (draft_params["layers"],) + cache)
+            logits = self._head_logits(draft_params, x, cfg=c)
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            return (tuple(new_cache), nxt, pos + 1), nxt
+
+        (cache, _, _), props = lax.scan(
+            micro, (draft_cache, tokens, lengths), None,
+            length=self.spec_k + 1)
+        # props [k+1, S]: the first k micro-steps' argmaxes are the
+        # proposals; the last ran only for its cache write
+        return cache, props[:self.spec_k].T
+
+    def _verify_step(self, params, cache, tables, lengths, tokens,
+                     write_phys, write_off):
+        """Score all k+1 candidate positions of every occupied slot in
+        ONE target forward against the paged cache: ``tokens[i]`` =
+        [last_token, d_1..d_k] sit at global positions ``lengths[i] +
+        arange(k+1)``; their K/V scatter into the slot's fresh pages
+        at ``write_phys/write_off`` (clamped writes drop — the host
+        truncates the block table to the accepted prefix afterwards),
+        and the attention read is ``attention.chunk_attention``'s
+        offset-masked multi-token read — the cached-partial-prefill
+        machinery with a PER-SLOT prefix depth. Numerics mirror the
+        decode step op for op, so the returned per-position argmaxes
+        are exactly the tokens plain decode would emit given the same
+        verified prefix — the speculative token-identity contract."""
+        c = self.config
+        dt = c.compute_dtype
+        n_rep = c.n_heads // c.kv_heads
+        K1 = tokens.shape[1]
+        x = self._embed(params["embed"].astype(dt), tokens)
+        pos = lengths[:, None] + jnp.arange(K1)[None, :]
+        cos, sin = transformer.rope_tables(c, pos)   # [S, K1, hd/2]
+        rope_rows = self._rope_rows_fn(cos, sin)
+
+        def layer_fn(x, layer_in):
+            lp, cache_l = layer_in[0], tuple(layer_in[1:])
+
+            def attend(q, k, v):
+                q, k = rope_rows(q), rope_rows(k)
+                pk, pv = self._gather_kv(cache_l, tables)
+                new_cache_l = self._write_kv(cache_l, write_phys,
+                                             write_off, k, v)
+                if self.kv_dtype == "int8":
+                    # the plain decode step reads EVERY position —
+                    # its own token included — back through the int8
+                    # cache (write-then-gather), so the verify must
+                    # attend over the same quantize-dequantize
+                    # round-tripped chunk values, or int8 speculative
+                    # output diverges from int8 plain decode
+                    k = quantize_lib.kv_dequantize(
+                        *quantize_lib.kv_quantize(k), dt)
+                    v = quantize_lib.kv_dequantize(
+                        *quantize_lib.kv_quantize(v), dt)
+                o = attn_lib.chunk_attention(
+                    q,
+                    attn_lib.repeat_kv(
+                        jnp.concatenate([pk, k], axis=1), n_rep),
+                    attn_lib.repeat_kv(
+                        jnp.concatenate([pv, v], axis=1), n_rep),
+                    lengths)
+                return o, new_cache_l
+
+            return self._layer_core(x, lp, attend)
+
+        x, new_cache = lax.scan(layer_fn, x,
+                                (params["layers"],) + cache)
+        logits = self._head_logits(params, x)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, K1]
+        return tuple(new_cache), nxt
+
+
+def truncated_draft(params, config, draft_layers, dampen=None):
+    """Self-speculative draft pair: the draft is the target's first
+    ``draft_layers`` transformer layers sharing its embed, final norm
+    and LM head (the LayerSkip/early-exit shape — a draft that needs
+    no second checkpoint and agrees with the target wherever the
+    upper layers don't flip the argmax). Token-identity never depends
+    on this choice — ANY draft yields the target's greedy output —
+    but a correlated draft is what makes acceptance (and therefore
+    tokens/step) worth the verify.
+
+    ``dampen`` (the bench/loadtest pair knob) additionally returns a
+    MODIFIED target whose layers ``>= draft_layers`` have their
+    residual write-back projections (``wo``, ``w_down``) scaled by
+    that factor: the upper layers still perturb the residual stream —
+    acceptance stays honestly < 1.0 — but weakly enough that the
+    draft's argmax usually survives, giving a measurable
+    draft/target pair without a training run.
+
+    → ``(target_params, draft_params, draft_config)``; the returned
+    target equals ``params`` (same object) when ``dampen`` is None.
+    """
+    layers = params["layers"]
+    if isinstance(layers, (list, tuple)):
+        layers = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+        params = {**params, "layers": layers}
+    if not 1 <= int(draft_layers) < config.n_layers:
+        raise ValueError(
+            f"draft_layers must be in [1, {config.n_layers - 1}] "
+            f"(a strict prefix of the target's {config.n_layers} "
+            f"layers), got {draft_layers}")
+    draft_layers = int(draft_layers)
+    draft_config = dataclasses.replace(config, n_layers=draft_layers)
+    draft_params = {k: v for k, v in params.items() if k != "layers"}
+    draft_params["layers"] = jax.tree.map(
+        lambda a: a[:draft_layers], layers)
+    if dampen is not None:
+        mult = jnp.concatenate([
+            jnp.ones((draft_layers,)),
+            jnp.full((config.n_layers - draft_layers,),
+                     float(dampen))])
+        damped = dict(layers)
+        for key in ("wo", "w_down"):
+            a = layers[key]
+            damped[key] = a * mult.reshape(
+                (-1,) + (1,) * (a.ndim - 1)).astype(a.dtype)
+        params = {**params, "layers": damped}
+    return params, draft_params, draft_config
 
 
 import functools
@@ -1558,7 +2214,7 @@ def _reference_apply(config):
 
 
 def reference_greedy_decode(params, config, prompt, max_tokens,
-                            eos_id=None):
+                            eos_id=None, collect_logits=False):
     """The conformance oracle: greedy decode by FULL-CONTEXT recompute
     through ``transformer.apply`` at every step — O(n²) and cache-free,
     which is exactly why it is trustworthy. The engine's output must be
@@ -1567,18 +2223,26 @@ def reference_greedy_decode(params, config, prompt, max_tokens,
     The recompute runs at one fixed padded length so every step shares
     a single compiled program; the trailing pad sits causally AFTER
     every real position, so the real rows' logits are exactly the
-    bare-prompt forward's."""
+    bare-prompt forward's.
+
+    ``collect_logits=True`` additionally returns each step's fp32
+    pre-argmax ``[vocab]`` row — ``(tokens, rows)`` — for the
+    tolerance tier (``compute/conformance.py``); ONE rollout serves
+    both tiers so the token and logits oracles cannot drift apart."""
     fn = _reference_apply(config)
     tokens = [int(t) for t in prompt]
-    out = []
+    out, rows = [], []
     pad_to = max(config.max_seq, len(tokens) + max_tokens)
     buf = np.zeros((1, pad_to), np.int32)
     for _ in range(max_tokens):
         buf[0, :len(tokens)] = tokens
         logits = fn(params, jnp.asarray(buf))
-        nxt = int(jnp.argmax(logits[0, len(tokens) - 1]))
+        row = np.asarray(logits[0, len(tokens) - 1], np.float32)
+        nxt = int(row.argmax())
+        if collect_logits:
+            rows.append(row)
         out.append(nxt)
         tokens.append(nxt)
         if eos_id is not None and nxt == eos_id:
             break
-    return out
+    return (out, rows) if collect_logits else out

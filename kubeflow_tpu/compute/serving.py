@@ -1684,6 +1684,14 @@ class ModelServer:
                 # count), router-mirrored like the prefix header
                 self.send_header("X-Generate-Mesh",
                                  engine.mesh_header())
+                # speculative economics (engine-cumulative exact
+                # counts FROZEN at this request's prefill; omitted
+                # when speculation is off so the plain wire contract
+                # stays byte-identical) — router-mirrored like the
+                # prefix header
+                if handle.spec_wire is not None:
+                    self.send_header("X-Spec-Acceptance",
+                                     handle.spec_wire)
                 if rt is not None:
                     self.send_header("traceparent",
                                      tracing.format_traceparent(rt))
@@ -1719,6 +1727,13 @@ class ModelServer:
                                     # exhausted" is answerable from
                                     # the frame alone
                                     "mesh": engine.mesh_view()}
+                            # per-request speculative economics
+                            # (accepted_per_step + the counts the
+                            # mirrored header aggregates); key absent
+                            # when speculation is off
+                            spec = engine.spec_view(handle)
+                            if spec is not None:
+                                done["spec"] = spec
                             if error is not None:
                                 done["error"] = str(error)
                             chunk(done)

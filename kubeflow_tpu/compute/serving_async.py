@@ -723,6 +723,12 @@ class AsyncTransport:
                  # sharding summary (tensor mesh size + per-chip
                  # block count), router-mirrored like the prefix one
                  f"X-Generate-Mesh: {engine.mesh_header()}"]
+        # speculative economics (engine-cumulative exact counts
+        # FROZEN at this request's prefill; omitted when speculation
+        # is off — byte-identical plain contract), router-mirrored
+        # like the prefix header
+        if handle is not None and handle.spec_wire is not None:
+            lines.append(f"X-Spec-Acceptance: {handle.spec_wire}")
         if rt is not None:
             lines.append(
                 f"traceparent: {tracing.format_traceparent(rt)}")
@@ -783,6 +789,12 @@ class AsyncTransport:
                     and handle.prefill_seconds is not None else None,
                 # mesh shape + per-chip blocks (threaded parity)
                 "mesh": req["gen_engine"].mesh_view()}
+        # per-request speculative economics (threaded parity: key
+        # absent when speculation is off)
+        spec = req["gen_engine"].spec_view(handle) \
+            if handle is not None else None
+        if spec is not None:
+            done["spec"] = spec
         if error is not None:
             done["error"] = str(error)
         self._stream_chunk(conn, done)

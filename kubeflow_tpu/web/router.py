@@ -65,6 +65,10 @@ _MIRROR_HEADERS = ("Content-Type", "X-Tensor-Dtype", "X-Tensor-Shape",
                    # per-chip block count; loadtest --sharded asserts
                    # it survives the router hop)
                    "X-Generate-Mesh",
+                   # :generate speculative-decoding acceptance counts
+                   # (loadtest --speculative asserts the mirrored
+                   # header agrees with the done frames it consumed)
+                   "X-Spec-Acceptance",
                    "Retry-After")
 
 

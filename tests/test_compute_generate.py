@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from kubeflow_tpu.compute import conformance
 from kubeflow_tpu.compute import generate as gen_lib
 from kubeflow_tpu.compute import quantize, serving
 from kubeflow_tpu.compute.models import transformer
@@ -654,6 +655,414 @@ class TestBlockPoolInvariants:
         finally:
             engine._step_sleep = 0.0
             engine.close()
+
+
+class TestSpeculativeDecoding:
+    """Tentpole (ISSUE 14): draft-model propose + k-token verify on
+    the paged cache. The load-bearing contract is that greedy
+    speculative decode is token-identical to the oracle for ANY draft
+    — every emitted token is the target's own argmax given the
+    verified prefix; the draft's quality moves only the acceptance
+    ratio (tokens/step), never the tokens."""
+
+    @pytest.fixture(scope="class")
+    def spec(self, params):
+        """Draft == target: the machinery at acceptance 1.0."""
+        eng = _engine(params, draft_params=params,
+                      draft_config=_config(), spec_k=3)
+        yield eng
+        eng.close()
+
+    def test_token_identical_mixed_lengths_f32(self, params, spec):
+        for prompt in ([1, 2, 3], [5] * 8, list(range(1, 18))):
+            assert spec.generate(prompt, max_tokens=10)[0] \
+                == _ref(params, prompt, 10), prompt
+        # a perfect draft accepts everything it was allowed to propose
+        assert spec.stats["spec_accepted"] == spec.stats["spec_proposed"]
+        assert spec.stats["spec_proposed"] > 0
+
+    def test_token_identical_across_eviction_admission_boundary(
+            self, params, spec):
+        """Staggered max_tokens across 2 slots + a queue: finished
+        sequences evict MID-round, queued prompts admit into the
+        freed slots — every output still matches the oracle."""
+        specs = [([1, 2, 3], 16), ([5, 6, 7, 8, 9], 4),
+                 ([4] * 11, 9), ([60, 2], 12)]
+        handles = [spec.submit(p, max_tokens=m) for p, m in specs]
+        for (prompt, m), handle in zip(specs, handles):
+            out, reason = handle.result(timeout=120)
+            assert out == _ref(params, prompt, m), prompt
+            assert reason == "length"
+        assert spec.stats["decode_token_slots"] \
+            > spec.stats["decode_steps"]
+
+    def test_bf16_token_identical(self, params):
+        engine = _engine(params, "bfloat16", draft_params=params,
+                         draft_config=_config("bfloat16"), spec_k=3)
+        try:
+            specs = [([1, 2, 3], 12), ([5, 6, 7, 8, 9], 4),
+                     ([4] * 11, 8)]
+            handles = [engine.submit(p, max_tokens=m)
+                       for p, m in specs]
+            for (prompt, m), handle in zip(specs, handles):
+                assert handle.result(timeout=120)[0] \
+                    == _ref(params, prompt, m, "bfloat16"), prompt
+        finally:
+            engine.close()
+
+    def test_any_draft_is_token_identical_even_a_garbage_one(
+            self, params):
+        """The conformance keystone: an unrelated random draft (whose
+        proposals are ~never right) still yields the oracle's tokens
+        — acceptance collapses, correctness cannot."""
+        dcfg = transformer.Config(
+            vocab_size=64, d_model=16, n_layers=1, n_heads=2,
+            max_seq=64, dtype="float32", attention="dense",
+            remat=False, scan_layers=True)
+        dparams = transformer.init_params(dcfg, jax.random.PRNGKey(9))
+        engine = _engine(params, draft_params=dparams,
+                         draft_config=dcfg, spec_k=4)
+        try:
+            for prompt in ([1, 2, 3], [7] * 9):
+                assert engine.generate(prompt, max_tokens=10)[0] \
+                    == _ref(params, prompt, 10), prompt
+            assert engine.stats["spec_proposed"] > 0
+            assert engine.stats["spec_accepted"] \
+                < engine.stats["spec_proposed"]
+        finally:
+            engine.close()
+
+    def test_truncated_draft_pair(self, params):
+        """truncated_draft: the LayerSkip-style pair constructor —
+        dampened target still oracle-checked (against ITS OWN
+        recompute), and the prefix draft earns real acceptance."""
+        cfg4 = _config()
+        cfg4 = gen_lib.dataclasses.replace(cfg4, n_layers=4)
+        p4 = transformer.init_params(cfg4, jax.random.PRNGKey(3))
+        target, draft, dcfg = gen_lib.truncated_draft(
+            p4, cfg4, 2, dampen=0.1)
+        assert dcfg.n_layers == 2
+        engine = gen_lib.GenerationEngine(
+            target, cfg4, max_slots=2, block_size=8, max_context=64,
+            name="td", draft_params=draft, draft_config=dcfg,
+            spec_k=3)
+        try:
+            ref = gen_lib.reference_greedy_decode(
+                target, cfg4, [1, 2, 3], 12)
+            assert engine.generate([1, 2, 3], max_tokens=12)[0] == ref
+            assert engine.stats["spec_accepted"] > 0
+        finally:
+            engine.close()
+        with pytest.raises(ValueError):
+            gen_lib.truncated_draft(p4, cfg4, 4)    # not a strict prefix
+        with pytest.raises(ValueError):
+            gen_lib.truncated_draft(p4, cfg4, 0)
+
+    def test_prefix_cache_hit_token_identical(self, params, spec):
+        """Spec decode over a prefix-cache hit: the partial prefill
+        attaches shared pages, the verify writes only fresh pages
+        past the prompt — outputs stay oracle-identical."""
+        shared = list(range(20, 36))            # 2 full blocks
+        a = shared + [40, 41, 42]
+        b = shared + [50, 51]
+        h0 = spec.stats["prefix_hits"]
+        assert spec.generate(a, max_tokens=8)[0] == _ref(params, a, 8)
+        assert spec.generate(b, max_tokens=8)[0] == _ref(params, b, 8)
+        assert spec.stats["prefix_hits"] == h0 + 1
+
+    def test_eos_truncates_stream_and_cache(self, params, spec):
+        """No token after eos reaches the stream OR retained cache: a
+        verify round that accepts past the eos must clamp emission at
+        the eos, and eviction frees every decode-written page (only
+        full PROMPT blocks may stay trie-indexed)."""
+        prompt = [1, 2, 3]
+        ref = _ref(params, prompt, 12)
+        eos = ref[5]                  # eos mid-round for k=3
+        ref_eos = _ref(params, prompt, 12, eos_id=eos)
+        seen = []
+        handle = spec.submit(prompt, max_tokens=12, eos_id=eos,
+                             on_token=lambda t, i: seen.append((t, i)))
+        out, reason = handle.result(timeout=120)
+        assert reason == "eos"
+        assert out == ref_eos
+        assert out[-1] == eos and eos not in out[:-1]
+        # frame-per-token with contiguous indices, nothing after eos
+        assert seen == [(t, i) for i, t in enumerate(out)]
+        # retained cache holds only prompt-block pages (the generated
+        # region was freed with the slot)
+        view = spec.blocks_view()
+        assert not view["referenced"]
+        assert len(view["cached"]) <= len(
+            spec._node_by_block) and all(
+            b in spec._node_by_block for b in view["cached"])
+
+    def test_int8_kv_speculation_matches_int8_plain_decode(
+            self, params):
+        """int8 is lossy vs the fp32 oracle (tolerance tier), but the
+        speculative int8 engine must reproduce the PLAIN int8 engine
+        token for token: the verify attends over the same quantize-
+        dequantize round-tripped chunk values the decode step reads
+        back from the cache."""
+        plain = _engine(params, kv_dtype="int8", name="i8p")
+        spec = _engine(params, kv_dtype="int8", name="i8s",
+                       draft_params=params, draft_config=_config(),
+                       spec_k=3)
+        try:
+            for prompt in ([1, 2, 3], [5, 6, 7, 8, 9, 10, 11],
+                           [4] * 12):
+                assert plain.generate(prompt, max_tokens=8)[0] \
+                    == spec.generate(prompt, max_tokens=8)[0], prompt
+            assert spec.stats["spec_proposed"] > 0
+        finally:
+            plain.close()
+            spec.close()
+
+    def test_done_time_engine_view_includes_the_final_round(
+            self, params):
+        """The transports build the done frame's spec block the
+        moment on_done fires: the engine-cumulative counters must
+        already include the round that finished the request (a
+        request completing in its FIRST verify round must not ship
+        proposed=0 next to request_proposed=k)."""
+        engine = _engine(params, max_slots=1, draft_params=params,
+                         draft_config=_config(), spec_k=4)
+        captured = {}
+        try:
+            handle = engine.submit(
+                [1, 2, 3], max_tokens=6,
+                on_done=lambda r, t, e: captured.update(
+                    view=engine.spec_view()))
+            handle.result(timeout=120)
+            # one verify round (prefill token + k accepted + bonus)
+            # ended it: remaining was 5, so ke = k = 4
+            assert handle.spec_rounds == 1
+            assert captured["view"]["proposed"] \
+                == handle.spec_proposed == 4
+            assert captured["view"]["accepted"] == 4
+            assert captured["view"]["acceptance_ratio"] == 1.0
+        finally:
+            engine.close()
+
+    def test_verify_crash_rebuilds_both_donated_caches(self, params):
+        """The verify step donates the paged pool and the propose
+        step donates the draft cache: a crashed round must rebuild
+        BOTH so the engine heals (the PR-13 _fail_everything
+        contract, extended to the speculative state)."""
+        engine = _engine(params, max_slots=1, draft_params=params,
+                         draft_config=_config(), spec_k=3)
+        try:
+            real = engine._verify_jit
+
+            def boom(p, cache, *rest):
+                real(p, cache, *rest)     # consumes the donated pool
+                raise RuntimeError("device fell over")
+
+            engine._verify_jit = boom
+            handle = engine.submit([1, 2, 3], max_tokens=6)
+            handle.wait(timeout=60)
+            assert handle.reason == "error"
+            engine._verify_jit = real
+            out, _ = engine.generate([5, 6, 7], max_tokens=6)
+            assert out == _ref(params, [5, 6, 7], 6)
+        finally:
+            engine.close()
+
+    def test_deadline_mid_run_evicts(self, params):
+        engine = _engine(params, max_slots=1, draft_params=params,
+                         draft_config=_config(), spec_k=3)
+        engine._step_sleep = 0.04
+        try:
+            handle = engine.submit([1, 2, 3], max_tokens=50,
+                                   deadline=time.monotonic() + 0.15)
+            handle.wait(timeout=60)
+            assert handle.reason == "deadline"
+            assert 0 < len(handle.out_tokens) < 50
+        finally:
+            engine._step_sleep = 0.0
+            engine.close()
+
+    def test_spec_k0_reproduces_plain_engine_byte_for_byte(
+            self, params):
+        """Acceptance criterion: spec_k=0 (draft present or not) IS
+        the PR-13 engine — same tokens AND same cache bytes after the
+        same request sequence."""
+        plain = _engine(params, name="p0")
+        off = _engine(params, name="p1", draft_params=params,
+                      draft_config=_config(), spec_k=0)
+        try:
+            assert not off._spec_on and off.draft_params is None
+            for prompt, m in (([1, 2, 3], 8), ([5] * 9, 6),
+                              ([2, 60], 5)):
+                assert plain.generate(prompt, max_tokens=m)[0] \
+                    == off.generate(prompt, max_tokens=m)[0]
+            for a, b in zip(plain._cache, off._cache):
+                assert np.asarray(a).tobytes() \
+                    == np.asarray(b).tobytes()
+            assert plain.stats["decode_steps"] \
+                == off.stats["decode_steps"]
+        finally:
+            plain.close()
+            off.close()
+
+    def test_views_header_and_tokens_per_step(self, params, spec):
+        """The economics surface: handle-level spec view (the done
+        frame's ``spec`` block), the exact-count wire header, the
+        snapshot block and the tokens-per-step histogram."""
+        from kubeflow_tpu.compute.generate import _TOKENS_PER_STEP
+        h_before = _TOKENS_PER_STEP.value("t")
+        handle = spec.submit([9, 8, 7], max_tokens=9)
+        handle.result(timeout=120)
+        assert _TOKENS_PER_STEP.value("t") > h_before
+        view = spec.spec_view(handle)
+        assert view["k"] == 3
+        assert view["steps"] == handle.spec_rounds > 0
+        # emitted tokens per round = accepted + 1
+        assert len(handle.out_tokens) \
+            == 1 + handle.spec_accepted + handle.spec_rounds
+        assert view["accepted_per_step"] == round(
+            handle.spec_accepted / handle.spec_rounds, 3)
+        header = spec.spec_header()
+        assert header == (f"k=3;proposed={spec.stats['spec_proposed']};"
+                          f"accepted={spec.stats['spec_accepted']}")
+        snap = spec.snapshot()
+        assert snap["speculative"]["k"] == 3
+        assert snap["speculative"]["acceptance_ratio"] > 0
+        # a plain engine surfaces None and omits the header
+        plain = _engine(params, name="nospec")
+        try:
+            assert plain.spec_view() is None
+            assert plain.spec_header() is None
+            assert plain.snapshot()["speculative"] is None
+        finally:
+            plain.close()
+
+    def test_constructor_validation(self, params):
+        with pytest.raises(ValueError):
+            _engine(params, spec_k=-1)
+        with pytest.raises(ValueError):
+            _engine(params, spec_k=2)             # no draft
+        with pytest.raises(ValueError):
+            _engine(params, draft_params=params, spec_k=2)  # no config
+        wrong_vocab = transformer.Config(
+            vocab_size=32, d_model=16, n_layers=1, n_heads=2,
+            max_seq=64, dtype="float32", attention="dense",
+            remat=False, scan_layers=True)
+        with pytest.raises(ValueError):
+            _engine(params, spec_k=2,
+                    draft_params=transformer.init_params(
+                        wrong_vocab, jax.random.PRNGKey(1)),
+                    draft_config=wrong_vocab)
+
+    def test_block_partition_survives_spec_churn(self, params):
+        """The PR-12 pool invariant under speculative write-then-
+        truncate: every block in exactly one of free/cached/
+        referenced, refcounts == table membership."""
+        engine = _engine(params, max_slots=2, num_blocks=10,
+                         max_context=48, draft_params=params,
+                         draft_config=_config(), spec_k=3)
+        try:
+            specs = [([9] * 16 + [1], 6), ([9] * 16 + [2], 6),
+                     ([11] * 8, 5), ([13] * 19, 4)]
+            handles = [engine.submit(p, max_tokens=m)
+                       for p, m in specs]
+            for h in handles:
+                assert h.wait(timeout=120)
+            view = engine.blocks_view()
+            free = set(view["free"])
+            cached = set(view["cached"])
+            referenced = set(view["referenced"])
+            assert sorted(free | cached | referenced) \
+                == list(range(engine.num_blocks))
+            assert not (free & cached or free & referenced
+                        or cached & referenced)
+            for b in range(engine.num_blocks):
+                assert view["refcounts"][b] \
+                    == view["table_refs"].get(b, 0), b
+            for (prompt, m), h in zip(specs, handles):
+                assert h.out_tokens == _ref(params, prompt, m), prompt
+        finally:
+            engine.close()
+
+
+class TestToleranceConformance:
+    """Satellite (ISSUE 14): the logits-level tolerance tier
+    (compute/conformance.py) — the prerequisite ROADMAP names for
+    sharding the row projections / embed+head — applied to the
+    int8-KV and bf16 engine paths via the ``debug_logits`` probe."""
+
+    def _engine_logits(self, params, prompt, n, dtype="float32",
+                       **kw):
+        engine = _engine(params, dtype, prefix_cache=False,
+                         debug_logits=True, **kw)
+        try:
+            handle = engine.submit(prompt, max_tokens=n)
+            assert handle.wait(timeout=120)
+            return list(handle.out_tokens), list(handle.logits)
+        finally:
+            engine.close()
+
+    def test_fp32_engine_logits_match_oracle_tight(self, params):
+        toks, rows = conformance.reference_logits(
+            params, _config(), [1, 2, 3], 8)
+        etoks, elogits = self._engine_logits(params, [1, 2, 3], 8)
+        assert etoks == toks
+        assert len(elogits) == len(etoks)
+        report = conformance.assert_logits_close(
+            elogits, rows, atol=1e-4, rtol=1e-3,
+            what="fp32 engine vs oracle")
+        assert report["steps"] == 8
+
+    def test_bf16_engine_logits_within_tolerance(self, params):
+        """bf16 engine vs the bf16 oracle is (near-)exact — the
+        engine mirrors the model op for op; vs the fp32 oracle it
+        must stay within the documented precision envelope."""
+        cfg = _config("bfloat16")
+        toks_b, rows_b = conformance.reference_logits(
+            params, cfg, [1, 2, 3], 8)
+        etoks, elogits = self._engine_logits(params, [1, 2, 3], 8,
+                                             "bfloat16")
+        assert etoks == toks_b
+        conformance.assert_logits_close(
+            elogits, rows_b, atol=1e-3, rtol=1e-3,
+            what="bf16 engine vs bf16 oracle")
+        _toks32, rows32 = conformance.reference_logits(
+            params, _config(), [1, 2, 3], 8)
+        conformance.assert_logits_close(
+            elogits, rows32, atol=0.2, rtol=0.1,
+            what="bf16 engine vs fp32 oracle")
+
+    def test_int8_kv_logits_within_tolerance(self, params):
+        """The int8 cache is lossy by design: the tolerance tier
+        grades HOW lossy (bounded logits drift vs the fp32 oracle)
+        instead of the blunt positional-agreement heuristic."""
+        _toks, rows = conformance.reference_logits(
+            params, _config(), [1, 2, 3], 8)
+        _etoks, elogits = self._engine_logits(params, [1, 2, 3], 8,
+                                              kv_dtype="int8")
+        report = conformance.assert_logits_close(
+            elogits, rows, atol=0.08, rtol=0.05,
+            what="int8-KV engine vs fp32 oracle")
+        # and the tier is genuinely measuring something: the int8
+        # path diverges more than fp32 numerical noise
+        assert report["atol"] > 1e-5
+
+    def test_divergence_report_and_validation(self, params):
+        got = [np.zeros(4, np.float32)]
+        want = [np.full(4, 0.5, np.float32)]
+        rep = conformance.max_divergence(got, want)
+        assert rep["atol"] == pytest.approx(0.5)
+        with pytest.raises(AssertionError, match="diverged at step"):
+            conformance.assert_logits_close(got, want, atol=0.1,
+                                            rtol=0.0)
+        with pytest.raises(AssertionError, match="nothing to compare"):
+            conformance.assert_logits_close([], [], atol=1, rtol=1)
+        # the probe refuses the paths it cannot grade
+        with pytest.raises(ValueError):
+            _engine(params, debug_logits=True)    # prefix_cache on
+        with pytest.raises(ValueError):
+            _engine(params, debug_logits=True, prefix_cache=False,
+                    draft_params=params, draft_config=_config(),
+                    spec_k=2)
 
 
 def test_non_scan_param_layout_accepted():

@@ -285,3 +285,43 @@ class TestShardedDonationAndView:
                 eng.close()
         assert peaks[1] == 2      # 4 blocks / 2-block reservations
         assert peaks[4] >= 3 * peaks[1]
+
+
+@needs_devices
+class TestShardedSpeculative:
+    """ISSUE 14: speculative decoding composes with the tensor mesh —
+    the draft runs REPLICATED (no collectives), the verify step is the
+    same full-manual shard_map as decode, and greedy output stays
+    token-identical to the single-chip oracle."""
+
+    def test_token_identical_on_4_device_mesh_incl_boundary(
+            self, params, mesh4):
+        eng = _engine(params, mesh=mesh4, name="tspec",
+                      draft_params=params, draft_config=_config(),
+                      spec_k=3)
+        try:
+            # 4 prompts into 2 slots: evict/admit boundary under spec
+            specs = [([1, 2, 3], 12), ([5, 6, 7, 8, 9], 4),
+                     ([4] * 11, 8), ([60, 2], 10)]
+            handles = [eng.submit(p, max_tokens=m) for p, m in specs]
+            for (prompt, m), h in zip(specs, handles):
+                assert h.result(timeout=240)[0] \
+                    == _ref(params, prompt, m), prompt
+            # the perfect draft accepted everything on the mesh too
+            assert eng.stats["spec_proposed"] > 0
+            assert eng.stats["spec_accepted"] \
+                == eng.stats["spec_proposed"]
+        finally:
+            eng.close()
+
+    def test_bf16_spec_on_mesh_token_identical(self, params, mesh4):
+        cfg_b = _config("bfloat16")
+        eng = _engine(params, "bfloat16", mesh=mesh4, name="tspecb",
+                      draft_params=params, draft_config=cfg_b,
+                      spec_k=2)
+        try:
+            for prompt in ([1, 2, 3], [5] * 9):
+                assert eng.generate(prompt, max_tokens=8)[0] \
+                    == _ref(params, prompt, 8, "bfloat16"), prompt
+        finally:
+            eng.close()

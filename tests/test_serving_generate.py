@@ -268,6 +268,87 @@ class TestGenerateWire:
         assert engine.occupancy() == 0    # the slot was freed
 
 
+@pytest.fixture(scope="module", params=["threaded", "async"])
+def spec_served(request, params):
+    """A SPECULATIVE engine (draft == target, k=3: every verify round
+    accepts k and emits k+1 tokens) behind each transport."""
+    engine = _engine(params, draft_params=params, draft_config=CFG,
+                     spec_k=3)
+    server = serving.ModelServer()
+    server.register_generator("lm", engine)
+    port = server.start(port=0, host="127.0.0.1",
+                        transport=request.param)
+    yield request.param, server, engine, port
+    server.stop()
+
+
+class TestSpeculativeStreamContract:
+    """Satellite (ISSUE 14): a k-accepted verify step emits ONE NDJSON
+    frame per token with contiguous ``index`` values on BOTH
+    transports — no multi-token frames, no index gaps across an
+    acceptance boundary — and the done frame + router-mirrored
+    ``X-Spec-Acceptance`` header carry the speculative economics."""
+
+    def test_one_frame_per_token_contiguous_indices(self, spec_served,
+                                                    params):
+        _transport, _server, engine, port = spec_served
+        r0 = engine.stats["spec_rounds"]
+        conn, resp = _post_generate(
+            port, {"tokens": [1, 2, 3], "max_tokens": 10})
+        assert resp.status == 200
+        raw_lines = [ln for ln in resp.read().splitlines()
+                     if ln.strip()]
+        conn.close()
+        frames = [json.loads(ln) for ln in raw_lines]
+        ref = gen_lib.reference_greedy_decode(params, CFG, [1, 2, 3],
+                                              10)
+        token_frames = [f for f in frames if "token" in f]
+        # one frame per token — a frame never carries more than one
+        for f in token_frames:
+            assert set(f) == {"token", "index"}, f
+        assert len(raw_lines) == len(token_frames) + 1
+        assert [f["token"] for f in token_frames] == ref
+        # contiguous indices ACROSS acceptance boundaries: the engine
+        # genuinely emitted multiple tokens per verify round
+        assert [f["index"] for f in token_frames] \
+            == list(range(len(ref)))
+        assert engine.stats["spec_rounds"] - r0 < len(ref) - 1
+        assert frames[-1]["done"] and frames[-1]["tokens"] == ref
+
+    def test_done_frame_and_header_carry_spec_economics(
+            self, spec_served):
+        _transport, _server, engine, port = spec_served
+        conn, resp = _post_generate(
+            port, {"tokens": [9, 8, 7], "max_tokens": 9})
+        assert resp.status == 200
+        header = resp.headers.get("X-Spec-Acceptance")
+        frames = _frames(resp)
+        conn.close()
+        assert header is not None and header.startswith("k=3;")
+        done = frames[-1]
+        spec = done["spec"]
+        assert spec["k"] == 3
+        assert spec["steps"] > 0
+        # each verify round emits accepted+1 tokens (prefill emits 1)
+        assert len(done["tokens"]) \
+            == 1 + spec["request_accepted"] + spec["steps"]
+        assert spec["accepted_per_step"] == round(
+            spec["request_accepted"] / spec["steps"], 3)
+        assert spec["acceptance_ratio"] > 0
+
+    def test_non_speculative_stream_omits_spec_surface(self, served):
+        """The plain engine's wire contract is byte-compatible with
+        PR 13: no spec header, no spec key in the done frame."""
+        _transport, _server, _engine_, port = served
+        conn, resp = _post_generate(
+            port, {"tokens": [3, 2, 1], "max_tokens": 3})
+        assert resp.status == 200
+        assert resp.headers.get("X-Spec-Acceptance") is None
+        frames = _frames(resp)
+        conn.close()
+        assert "spec" not in frames[-1]
+
+
 class TestDrainSemantics:
     """Satellite: drain must evict generation slots gracefully (a
     partial-stream termination frame) and racing submits get a clean
