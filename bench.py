@@ -21,6 +21,7 @@ Flags via env: BENCH_MODEL=all|resnet50|lm|bert|serving|study,
 BENCH_STEPS, BENCH_BATCH (and BENCH_REMAT for bert).
 """
 
+import dataclasses
 import json
 import os
 import time
@@ -1157,6 +1158,146 @@ def bench_generate_spec(steps, batch):
                 }}}
 
 
+def bench_generate_long(steps, batch):
+    """Long-context decode economics (ISSUE 15): the paged-attention
+    read path vs the gather reference, swept over context length at a
+    FIXED block pool.
+
+    The gather backend materializes the full padded pool width
+    (``T = max_context``) per layer per decode step, so its decode
+    ms/token is set by the POOL regardless of how much context a
+    request actually occupies; the paged backend streams only occupied
+    blocks, so its cost follows the request. The sweep holds the
+    engine geometry constant (pool sized for 2048-token contexts) and
+    runs the identical request shape at three prompt lengths, both
+    backends on the same weights:
+
+    - **decode ms/token** per backend per context (from the engine's
+      ``decode_seconds_total``, device-side wall only),
+    - **estimated KV bytes read per token** from the analytic
+      ``serving_generate_attn_bytes_read_total`` accounting,
+    - in-run conformance: paged == gather == ``reference_greedy_decode``
+      greedy tokens at every swept context (fp32), plus a bf16
+      paged == gather == oracle spot-check at the shortest context.
+
+    Acceptance (ISSUE 15): paged decode tokens/sec >= 1.3x gather at
+    the longest swept context, with the paged path's ms/token growing
+    with occupied context while gather's stays pool-bound. Persists a
+    ``long_context`` row to BENCH_generate.json."""
+    from kubeflow_tpu.compute import generate as gen_lib
+
+    cfg = transformer.Config(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+        max_seq=2048, dtype="float32", attention="dense", remat=False,
+        scan_layers=True)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    contexts = (128, 512, 1024)
+    gen_tokens = 16
+    slots = 2
+    rng = np.random.default_rng(0)
+    prompts = {L: [[int(t) for t in
+                    rng.integers(1, cfg.vocab_size, L)]
+                   for _ in range(slots)] for L in contexts}
+
+    def sweep(backend):
+        eng = gen_lib.GenerationEngine(
+            params, cfg, max_slots=slots, block_size=64,
+            max_context=2048, prefix_cache=False,
+            attn_backend=backend, name=f"bench-long-{backend}")
+        rows, outs = {}, {}
+        try:
+            for L in contexts:     # warm every prefill bucket + the
+                # decode program outside the timed sweep
+                eng.generate([int(t) for t in
+                              rng.integers(1, cfg.vocab_size, L)],
+                             max_tokens=2)
+            for L in contexts:
+                s0 = dict(eng.stats)
+                handles = [eng.submit(p, max_tokens=gen_tokens)
+                           for p in prompts[L]]
+                outs[L] = [h.result(timeout=600)[0] for h in handles]
+                d_tok = (eng.stats["tokens"] - s0["tokens"]
+                         - (eng.stats["prefills"] - s0["prefills"]))
+                d_sec = (eng.stats["decode_seconds_total"]
+                         - s0["decode_seconds_total"])
+                d_bytes = (eng.stats["attn_bytes_read"]
+                           - s0["attn_bytes_read"])
+                rows[L] = {
+                    "decode_ms_per_token":
+                        round(1000 * d_sec / d_tok, 3),
+                    "decode_tokens_per_sec": round(d_tok / d_sec, 1),
+                    "kv_bytes_read_per_token":
+                        int(d_bytes / d_tok),
+                }
+        finally:
+            eng.close()
+        return rows, outs
+
+    rows_g, outs_g = sweep("gather")
+    rows_p, outs_p = sweep("paged")
+
+    # in-run conformance at every swept context: paged == gather ==
+    # the cache-free oracle (fp32)
+    conforms = all(
+        outs_p[L] == outs_g[L]
+        and outs_p[L][0] == gen_lib.reference_greedy_decode(
+            params, cfg, prompts[L][0], gen_tokens)
+        for L in contexts)
+
+    # bf16 spot-check at the shortest context (the acceptance matrix
+    # wants token agreement in BOTH compute dtypes; the full-dtype
+    # engine matrix lives in tests/test_paged_attention.py)
+    cfg_b = dataclasses.replace(cfg, dtype="bfloat16")
+    params_b = transformer.init_params(cfg_b, jax.random.PRNGKey(0))
+    bprompt = prompts[contexts[0]][0]
+    bf16_outs = {}
+    for backend in ("gather", "paged"):
+        eng = gen_lib.GenerationEngine(
+            params_b, cfg_b, max_slots=slots, block_size=64,
+            max_context=2048, prefix_cache=False,
+            attn_backend=backend, name=f"bench-longb-{backend}")
+        try:
+            bf16_outs[backend], _ = eng.generate(
+                bprompt, max_tokens=gen_tokens)
+        finally:
+            eng.close()
+    bf16_conforms = (
+        bf16_outs["paged"] == bf16_outs["gather"]
+        == gen_lib.reference_greedy_decode(
+            params_b, cfg_b, bprompt, gen_tokens))
+
+    top = contexts[-1]
+    speedup_top = (rows_p[top]["decode_tokens_per_sec"]
+                   / rows_g[top]["decode_tokens_per_sec"])
+    paged_grows = (rows_p[contexts[-1]]["decode_ms_per_token"]
+                   > rows_p[contexts[0]]["decode_ms_per_token"])
+    sweep_table = [
+        {"context": L,
+         "gather": rows_g[L], "paged": rows_p[L],
+         "paged_vs_gather_tokens_per_sec": round(
+             rows_p[L]["decode_tokens_per_sec"]
+             / rows_g[L]["decode_tokens_per_sec"], 2)}
+        for L in contexts]
+    return {"metric": "generate_long_context_tokens_per_sec",
+            "value": rows_p[top]["decode_tokens_per_sec"],
+            "unit": "tokens/sec",
+            "vs_gather_at_top_context": round(speedup_top, 2),
+            "detail": {
+                "pool_context": 2048, "block_size": 64,
+                "slots": slots, "gen_tokens": gen_tokens,
+                "long_context": sweep_table,
+                "prefill_ms_per_request": None,
+                "checks": {
+                    "paged_vs_gather_tokens_per_sec_ge_1.3_at_top":
+                        speedup_top >= 1.3,
+                    "paged_ms_per_token_grows_with_context":
+                        paged_grows,
+                    "paged_matches_gather_and_oracle": conforms,
+                    "bf16_paged_matches_gather_and_oracle":
+                        bf16_conforms,
+                }}}
+
+
 def _persist_generate_record(mode, result):
     """The generate track's persisted bench trajectory (satellite of
     ISSUE 13): every generate-mode run appends its headline numbers
@@ -1194,6 +1335,10 @@ def _persist_generate_record(mode, result):
         "acceptance_rate": d.get("acceptance_rate"),
         "checks": d.get("checks"),
     }
+    if d.get("long_context") is not None:
+        # the generate-long sweep: per-context decode ms/token +
+        # analytic KV bytes/token, gather vs paged (ISSUE 15)
+        entry["long_context"] = d["long_context"]
     doc["runs"] = (doc["runs"] + [entry])[-60:]
     tmp = f"{path}.tmp"
     try:
@@ -1344,19 +1489,21 @@ BENCHES = {
     "generate-prefix": (bench_generate_prefix, 4),
     "generate-sharded": (bench_generate_sharded, 4),
     "generate-spec": (bench_generate_spec, 4),
+    "generate-long": (bench_generate_long, 4),
     "study": (bench_study, 8),
 }
 
 #: generate-track modes whose headline numbers persist into
 #: BENCH_generate.json (_persist_generate_record)
 _GENERATE_MODES = ("generate", "generate-prefix", "generate-sharded",
-                   "generate-spec")
+                   "generate-spec", "generate-long")
 
 
 # default-run order: headline resnet50 LAST (single-line consumers
 # read the final line)
 ALL_ORDER = ["lm", "bert", "serving", "generate", "generate-prefix",
-             "generate-sharded", "generate-spec", "study", "resnet50"]
+             "generate-sharded", "generate-spec", "generate-long",
+             "study", "resnet50"]
 
 
 def main():
@@ -1375,6 +1522,8 @@ def main():
         model = "generate-sharded"
     if "--speculative" in args:
         model = "generate-spec"
+    if "--long-context" in args:
+        model = "generate-long"
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     if model != "all" and model not in BENCHES:
         raise SystemExit(f"unknown BENCH_MODEL {model!r}; expected 'all' "

@@ -152,6 +152,12 @@ def main():
         "serving_generate_spec_accepted_tokens_total",
         "serving_generate_spec_acceptance_ratio",
         "serving_generate_tokens_per_step",
+        # paged-attention read path (ISSUE 15): the backend info
+        # gauge + the analytic bytes-touched counter — what bench.py
+        # generate --long-context reports per token and what
+        # loadtest --attn-backend asserts monotonic
+        "serving_generate_attn_backend",
+        "serving_generate_attn_bytes_read_total",
         # sweep-pod failure re-packing (ROADMAP PR 5 follow-up)
         "sweep_repack_total",
     }

@@ -331,6 +331,12 @@ def model_server(argv=()):
                 "GEN_PREFIX_CACHE", "1").lower() not in (
                 "0", "false", "no", "off"),
             mesh=mesh,
+            # GEN_ATTN_BACKEND: the paged-attention read path —
+            # gather (default, the dense-context reference) | paged
+            # (XLA block-streamed) | paged-kernel (Pallas decode
+            # read); loadtest --attn-backend drives this end to end
+            attn_backend=os.environ.get("GEN_ATTN_BACKEND", "gather")
+            or "gather",
             name=name)
         if os.environ.get("GEN_CALIBRATE", "").lower() in (
                 "1", "true", "yes", "on"):

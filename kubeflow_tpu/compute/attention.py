@@ -127,6 +127,189 @@ def chunk_attention(q, k, v, prefix_len, scale=None):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _dequant_block(pages, dtype):
+    """Per-block int8 dequant at the paged read: ``pages`` is either a
+    float ``(k, v)`` pair or an int8 ``(k, v, k_scale, v_scale)``
+    quadruple (``quantize.kv_quantize`` layout — one fp32 scale per
+    (position, head), broadcast over head_dim). Mirrors
+    ``quantize.kv_dequantize`` op for op so the streamed read sees
+    exactly the values the gather path's whole-table dequant sees —
+    the int8 bytes stay resident in HBM and widen per block in
+    registers/VMEM, which is the paged path's bandwidth win."""
+    if len(pages) == 2:
+        return pages
+    k, v, ks, vs = pages
+    return (k.astype(dtype) * ks.astype(dtype),
+            v.astype(dtype) * vs.astype(dtype))
+
+
+def _stream_fold(carry, k, v, valid, q):
+    """One masked online-softmax accumulation step — the ``_block``
+    recipe (fp32 (o, m, l) state) hardened for streamed paged reads
+    where a step's block may be ENTIRELY masked for some rows (a slot
+    past its occupied length, an inactive slot's zero-length prefix):
+    ``p`` is zeroed by the mask explicitly, so an all-masked fold is a
+    no-op even while ``m`` is still NEG_INF (the unguarded
+    ``exp(NEG_INF - NEG_INF) = 1`` would otherwise book phantom
+    probability mass for those rows).
+
+    ``q`` arrives pre-scaled; ``k``/``v`` are one block's keys/values
+    already repeated to the query head count; ``valid`` is
+    ``[B, Sq, T]`` (True = this key column is attendable by this
+    query row)."""
+    o, m, l = carry
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    logits = jnp.where(valid[:, None], logits, NEG_INF)
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    corr = jnp.exp(m - m_new)
+    p = jnp.where(valid[:, None],
+                  jnp.exp(logits - m_new[..., None]), 0.0)
+    l = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return o, m_new, l
+
+
+def _stream_finish(o, l, dtype):
+    """Normalize the streamed accumulator → output dtype. Rows that
+    never saw a valid column (inactive decode slots riding along
+    masked) have ``l == 0``; they divide by 1 instead so garbage stays
+    finite garbage (the host discards those rows) rather than NaN."""
+    safe = jnp.where(l == 0.0, 1.0, l)
+    return (o / safe.transpose(0, 2, 1)[..., None]).astype(dtype)
+
+
+def paged_decode_attention(q, pages, tables, lengths, *, block_size,
+                           n_rep=1, scale=None):
+    """Single-position attention computed DIRECTLY over the paged KV
+    block pool — the block-streamed twin of :func:`decode_attention`
+    that never materializes the gathered ``[S, T, heads, head_dim]``
+    context (compute/generate.py's ``attn_backend="paged"``).
+
+    ``q`` is one new token per sequence, ``[S, 1, H, D]``; ``pages``
+    is ONE layer's slice of the pool — ``(k, v)`` each
+    ``[num_blocks, block_size, kv_heads, D]``, or the int8 quadruple
+    ``(k, v, k_scale, v_scale)`` which is dequantized PER BLOCK inside
+    the loop (:func:`_dequant_block`); ``tables`` ``[S, bps]`` maps
+    logical block j of slot i to its physical page; ``lengths`` ``[S]``
+    counts each slot's VALID positions (the just-written own token
+    included, exactly like :func:`decode_attention`).
+
+    A ``lax.while_loop`` runs the online softmax over block-table
+    entries: each step gathers ONE page per slot and folds it into the
+    running fp32 (o, m, l) accumulator. The trip count is
+    ``ceil(max(lengths) / block_size)`` — a traced scalar — so
+    per-step HBM traffic follows the batch's OCCUPIED context, not the
+    pool width ``T`` the gather path always pays: blocks past the
+    batch's DEEPEST occupied context are never touched. Within the
+    loop every row gathers a page per step (a straggler's deep
+    context costs shallow slots masked zero-mass folds — per-slot
+    block skipping is the Pallas kernel's refinement, not this
+    path's).
+
+    Numerics contract: the per-column probability masses are the same
+    ``exp(logit - m)`` values :func:`decode_attention` computes — the
+    online rescaling reorders the REDUCTIONS (sum of exponentials,
+    probability-weighted value sum, both fp32) but not the per-element
+    math, so outputs agree with the gather path to fp32 reduction
+    rounding. That is a tolerance contract, not a bit-identity one:
+    the generation engine keeps the gather path as the conformance
+    reference and grades this path via paged-vs-gather greedy token
+    agreement plus ``conformance.assert_logits_close``. Per-head
+    independent like every read here, so the tensor-sharded engine
+    calls it head-local inside ``shard_map`` unchanged."""
+    q = _scale(q, scale)
+    bs = int(block_size)
+    bps = tables.shape[1]
+    S, _, H, D = q.shape
+    n_max = jnp.minimum(
+        jnp.int32(bps),
+        (jnp.max(lengths).astype(jnp.int32) + bs - 1) // bs)
+    o = jnp.zeros((S, 1, H, D), jnp.float32)
+    m = jnp.full((S, H, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((S, H, 1), jnp.float32)
+
+    def cond(carry):
+        return carry[0] < n_max
+
+    def body(carry):
+        j, o, m, l = carry
+        ids = lax.dynamic_index_in_dim(tables, j, axis=1,
+                                       keepdims=False)      # [S]
+        k, v = _dequant_block(tuple(p[ids] for p in pages), q.dtype)
+        k = repeat_kv(k, n_rep)
+        v = repeat_kv(v, n_rep)
+        pos = j * bs + jnp.arange(bs)[None, :]               # [1, bs]
+        valid = (pos < lengths[:, None])[:, None, :]     # [S, 1, bs]
+        o, m, l = _stream_fold((o, m, l), k, v, valid, q)
+        return j + 1, o, m, l
+
+    _, o, m, l = lax.while_loop(cond, body, (jnp.int32(0), o, m, l))
+    return _stream_finish(o, l, q.dtype)
+
+
+def paged_chunk_attention(q, pages, tables, prefix_len, k_chunk,
+                          v_chunk, *, block_size, n_rep=1, scale=None):
+    """Chunk-after-cached-prefix attention computed directly over the
+    paged block pool — the block-streamed twin of
+    :func:`chunk_attention` for the generation engine's cached partial
+    prefill (scalar ``prefix_len``) and speculative verify step
+    (per-sequence ``[B]`` ``prefix_len``).
+
+    ``q`` ``[B, S, H, D]`` are the chunk rows at global positions
+    ``prefix_len + arange(S)``; ``pages``/``tables`` map the CACHED
+    prefix exactly as in :func:`paged_decode_attention` (int8 pages
+    dequantized per block inside the loop); ``k_chunk``/``v_chunk``
+    ``[B, S, kv_heads, D]`` are the chunk's own (pre-repeat) K/V. The
+    prefix streams through the online softmax one block per step —
+    trip count ``ceil(max(prefix_len) / block_size)``, so a cache hit's
+    read cost follows the CACHED depth — and the chunk folds in last
+    under the causal within-chunk mask. Masked columns contribute
+    exactly zero mass (:func:`_stream_fold`), so the softmax covers
+    precisely the value set :func:`chunk_attention` sees; the same
+    reduction-reordering tolerance contract as the paged decode read
+    applies."""
+    q = _scale(q, scale)
+    bs = int(block_size)
+    bps = tables.shape[1]
+    B, S, H, D = q.shape
+    pl = jnp.broadcast_to(jnp.asarray(prefix_len), (B,))
+    n_max = jnp.minimum(
+        jnp.int32(bps),
+        (jnp.max(pl).astype(jnp.int32) + bs - 1) // bs)
+    o = jnp.zeros((B, S, H, D), jnp.float32)
+    m = jnp.full((B, H, S), NEG_INF, jnp.float32)
+    l = jnp.zeros((B, H, S), jnp.float32)
+
+    def cond(carry):
+        return carry[0] < n_max
+
+    def body(carry):
+        j, o, m, l = carry
+        ids = lax.dynamic_index_in_dim(tables, j, axis=1,
+                                       keepdims=False)      # [B]
+        k, v = _dequant_block(tuple(p[ids] for p in pages), q.dtype)
+        k = repeat_kv(k, n_rep)
+        v = repeat_kv(v, n_rep)
+        pos = j * bs + jnp.arange(bs)[None, :]               # [1, bs]
+        valid = jnp.broadcast_to(
+            (pos < pl[:, None])[:, None, :], (B, S, bs))
+        o, m, l = _stream_fold((o, m, l), k, v, valid, q)
+        return j + 1, o, m, l
+
+    _, o, m, l = lax.while_loop(cond, body, (jnp.int32(0), o, m, l))
+    # the chunk's own K/V fold: causal within the chunk (row r attends
+    # chunk cols <= r); global positions sit past every prefix column
+    rows = jnp.arange(S)[:, None]
+    cols = jnp.arange(S)[None, :]
+    valid = jnp.broadcast_to((cols <= rows)[None], (B, S, S))
+    o, m, l = _stream_fold(
+        (o, m, l), repeat_kv(k_chunk, n_rep), repeat_kv(v_chunk, n_rep),
+        valid, q)
+    return _stream_finish(o, l, q.dtype)
+
+
 def _block(carry, kv, q, q_offset, k_offset, causal, scale):
     """One blockwise-softmax accumulation step (fp32 state)."""
     o, m, l = carry

@@ -52,6 +52,21 @@ built on this repo's own kernels):
   step dequantizes INSIDE the attention read
   (``quantize.kv_dequantize``), so the cache's HBM footprint and
   read bandwidth drop ~2× vs bf16 at a bounded accuracy cost.
+- **Paged-attention read path** (``attn_backend=``): the decode,
+  speculative-verify and cached-prefix reads can attend DIRECTLY
+  over the paged block pool instead of gathering it into a dense
+  ``[S, T, heads, head_dim]`` context per layer per step —
+  ``attention.paged_decode_attention``/``paged_chunk_attention``
+  run an online-softmax stream over block-table entries (one page
+  per slot per step, int8 pages dequantized per block inside the
+  loop, whole masked-out blocks skipped), and ``"paged-kernel"``
+  drops the decode read to the Pallas kernel in
+  ``ops/paged_attention.py`` (block tables scalar-prefetched,
+  pages DMA'd per grid step). Decode-step HBM traffic then follows
+  the batch's OCCUPIED context rather than the pool width — the
+  long-context lever. The default ``"gather"`` read stays the
+  token-identity reference; the paged tiers are graded by
+  paged-vs-gather greedy agreement plus the tolerance tier.
 - **Tensor-sharded multi-chip serving** (``mesh=``): the whole
   generation path — every prefill bucket, the cached partial prefill
   and the single decode step — runs as ONE full-manual ``shard_map``
@@ -126,6 +141,7 @@ from . import quantize as quantize_lib
 from . import serving as serving_lib
 from . import sharding
 from .models import transformer
+from .ops import paged_attention as paged_ops
 
 log = logging.getLogger("kubeflow_tpu.generate")
 
@@ -233,6 +249,22 @@ _SPEC_ACCEPTANCE_RATIO = obs_metrics.REGISTRY.gauge(
     "forward, so a sustained low ratio means the draft/target pair "
     "(or k) is mis-sized",
     ("model",))
+_ATTN_BACKEND = obs_metrics.REGISTRY.gauge(
+    "serving_generate_attn_backend",
+    "Info-style gauge: 1 for the engine's selected paged-attention "
+    "read backend (gather | paged | paged-kernel), 0 for the others "
+    "— join on the backend label to see which read path a fleet's "
+    "engines run",
+    ("model", "backend"))
+_ATTN_BYTES_TOTAL = obs_metrics.REGISTRY.counter(
+    "serving_generate_attn_bytes_read_total",
+    "Analytic KV-cache bytes touched by the attention reads (decode, "
+    "verify, cached-prefill prefix), derived from block occupancy: "
+    "the gather backend is charged the full padded pool width per "
+    "step while the paged backends are charged only occupied blocks "
+    "— rate() per token is the decode-bandwidth figure the paged "
+    "read path exists to shrink",
+    ("model", "backend"))
 _TOKENS_PER_STEP = obs_metrics.REGISTRY.histogram(
     "serving_generate_tokens_per_step",
     "Tokens a sequence emitted per decode/verify step — exactly 1 "
@@ -389,7 +421,17 @@ class GenerationEngine:
       prefill/decode programs additionally return the emitted token's
       fp32 logits, collected on ``GenerationHandle.logits``
       (``compute/conformance.py``; requires ``prefix_cache=False``,
-      no mesh, no draft).
+      no mesh, no draft),
+    - ``attn_backend``: the paged-attention read path —
+      ``"gather"`` (default: the dense-context reference read),
+      ``"paged"`` (XLA block-streamed online softmax directly over
+      the block pool — decode-read HBM traffic follows OCCUPIED
+      context instead of the pool width) or ``"paged-kernel"``
+      (the decode read additionally drops to the Pallas kernel in
+      ``ops/paged_attention.py``). The paged tiers reorder the
+      softmax reductions, so they are graded by paged-vs-gather
+      greedy token agreement + the tolerance conformance tier
+      rather than bit-identity.
 
     Threading: ONE engine thread owns every device call and all slot
     state; ``submit``/``cancel``/``begin_drain`` are thread-safe and
@@ -402,7 +444,8 @@ class GenerationEngine:
                  name="model", version=1, eos_id=None,
                  default_max_tokens=64, admission="continuous",
                  prefix_cache=True, mesh=None, draft_params=None,
-                 draft_config=None, spec_k=0, debug_logits=False):
+                 draft_config=None, spec_k=0, debug_logits=False,
+                 attn_backend="gather"):
         if config.moe_experts or config.pipeline_stages > 1:
             raise ValueError(
                 "GenerationEngine supports dense TransformerLM configs "
@@ -414,6 +457,23 @@ class GenerationEngine:
             raise ValueError(
                 f"admission must be 'continuous' or 'drain', got "
                 f"{admission!r}")
+        if attn_backend not in ("gather", "paged", "paged-kernel"):
+            raise ValueError(
+                f"attn_backend must be 'gather', 'paged' or "
+                f"'paged-kernel', got {attn_backend!r}")
+        # paged-attention read backend: "gather" (the reference —
+        # dense [S, T] context materialized per layer per step,
+        # token-identity contract), "paged" (XLA block-streamed
+        # online softmax over the block tables — no context
+        # materialization, read cost follows OCCUPIED context) or
+        # "paged-kernel" (the decode read additionally drops to the
+        # Pallas kernel in ops/paged_attention.py; the multi-token
+        # chunk reads stay on the XLA streamed path). The paged tiers
+        # reorder the softmax reductions, so their contract is
+        # paged-vs-gather greedy token agreement plus the tolerance
+        # conformance tier, not bit-identity — gather stays the
+        # default so every existing conformance pin is untouched.
+        self.attn_backend = attn_backend
         self.spec_k = int(spec_k)
         if self.spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
@@ -558,6 +618,19 @@ class GenerationEngine:
         _SHARD_MESH_DEVICES.labels(name).set(self.tp)
         _SHARD_BLOCKS_PER_CHIP.labels(name).set(
             self.num_blocks / self.tp)
+        for b in ("gather", "paged", "paged-kernel"):
+            _ATTN_BACKEND.labels(name, b).set(
+                1 if b == attn_backend else 0)
+        # analytic bytes per cache BLOCK touched by one layer's read
+        # (k + v, plus the int8 scales), × n_layers per program call —
+        # the occupancy-derived figure _ATTN_BYTES_TOTAL accumulates
+        itemsize = 1 if kv_dtype == "int8" else \
+            jnp.dtype(config.compute_dtype).itemsize
+        per_block = (self.block_size * config.kv_heads
+                     * config.head_dim * itemsize * 2)
+        if kv_dtype == "int8":
+            per_block += self.block_size * config.kv_heads * 4 * 2
+        self._block_read_bytes = per_block * config.n_layers
         self._free = list(range(self.num_blocks))
         self._slots = [None] * self.max_slots
         self._queue = collections.deque()
@@ -595,7 +668,9 @@ class GenerationEngine:
                       "prefix_hits": 0, "prefix_misses": 0,
                       "prefix_tokens_skipped": 0, "prefix_reclaims": 0,
                       "collective_share": 0.0, "spec_rounds": 0,
-                      "spec_proposed": 0, "spec_accepted": 0}
+                      "spec_proposed": 0, "spec_accepted": 0,
+                      "decode_seconds_total": 0.0,
+                      "attn_bytes_read": 0}
         self.thread = threading.Thread(target=self._loop, daemon=True,
                                        name=f"generate-{name}")
         self.thread.start()
@@ -809,6 +884,14 @@ class GenerationEngine:
         return (f"tensor={self.tp};"
                 f"per_chip_blocks={self.per_chip_blocks}")
 
+    def attn_view(self):
+        """The ``:generate`` done frame's ``attn_backend`` field:
+        the selected paged-read backend, or ``None`` on the default
+        gather path so the frame stays byte-compatible with engines
+        predating the backend knob (the snapshot always carries it)."""
+        return None if self.attn_backend == "gather" \
+            else self.attn_backend
+
     def spec_view(self, handle=None):
         """Speculative-decoding economics (snapshot + the ``spec``
         block of the ``:generate`` done frame); ``None`` when
@@ -970,6 +1053,12 @@ class GenerationEngine:
                 "kv_dtype": self.kv_dtype or str(
                     self.config.compute_dtype),
                 "draining": self._draining,
+                # paged-attention read path view: which backend the
+                # decode/verify/prefix reads run, and the analytic
+                # bytes those reads have touched (occupancy-derived —
+                # docs/observability.md § Generation serving)
+                "attn_backend": self.attn_backend,
+                "attn_bytes_read": self.stats["attn_bytes_read"],
                 # sharding view: lets an operator distinguish "the
                 # POOL is exhausted" (grow the mesh or num_blocks)
                 # from "one chip is exhausted" (impossible here by
@@ -1431,6 +1520,9 @@ class GenerationEngine:
                             prefix_tokens_skipped=offset)
         self.stats["prefills"] += 1
         self.stats["prefill_seconds_total"] += elapsed
+        if matched:
+            # the cached partial prefill read the shared prefix pages
+            self._account_attn_read(self._blocks_touched(1, [offset]))
         # freeze the wire header NOW: the engine-cumulative counts as
         # of this request's admission, before any of its own verify
         # rounds can move them (the transports send the head after
@@ -1496,6 +1588,11 @@ class GenerationEngine:
         _SLOT_OCCUPANCY.labels(self.name).observe(len(active))
         self.stats["decode_steps"] += 1
         self.stats["decode_token_slots"] += len(active)
+        self.stats["decode_seconds_total"] += elapsed
+        # the step read every active slot's context (+1: the
+        # just-written own token) out of the pool
+        self._account_attn_read(self._blocks_touched(
+            S, [s.length + 1 for _, s in active]))
         # peak concurrency actually reached — the capacity figure the
         # sharded bench's "N chips admit N× the sequences" proof reads
         self.stats["peak_occupancy"] = max(
@@ -1627,6 +1724,11 @@ class GenerationEngine:
         _SLOT_OCCUPANCY.labels(self.name).observe(len(active))
         self.stats["decode_steps"] += 1
         self.stats["decode_token_slots"] += len(active)
+        self.stats["decode_seconds_total"] += elapsed
+        # the verify read every active slot's cached PREFIX (depth L)
+        # out of the pool; the k+1 candidate rows fold from registers
+        self._account_attn_read(self._blocks_touched(
+            S, [s.length for _, s in active]))
         self.stats["spec_rounds"] += 1
         self.stats["peak_occupancy"] = max(
             self.stats["peak_occupancy"], len(active))
@@ -1874,14 +1976,8 @@ class GenerationEngine:
             def attend(q, k, v):
                 q = transformer.apply_rope(q, *rope)
                 k = transformer.apply_rope(k, *rope)
-                pk, pv = self._gather_kv(cache_l, prefix_tables)
-                o = attn_lib.chunk_attention(
-                    q,
-                    attn_lib.repeat_kv(
-                        jnp.concatenate([pk, k], axis=1), n_rep),
-                    attn_lib.repeat_kv(
-                        jnp.concatenate([pv, v], axis=1), n_rep),
-                    offset)
+                o = self._attn_chunk_read(q, cache_l, prefix_tables,
+                                          offset, k, v, n_rep)
                 return o, (k[0], v[0])
 
             return self._layer_core(x, lp, attend)
@@ -1916,6 +2012,79 @@ class GenerationEngine:
                         vc[tables], vs[tables], dt)))
         kc, vc = cache_l
         return flat(kc[tables]), flat(vc[tables])
+
+    def _attn_decode_read(self, q, cache_l, tables, lengths, n_rep):
+        """Backend dispatch for the decode step's cache read: the
+        gather reference materializes the ``[S, T, heads, head_dim]``
+        context (``_gather_kv`` + ``attention.decode_attention``);
+        the paged backends attend DIRECTLY over the block pool — the
+        XLA block-streamed online softmax, or the Pallas kernel
+        (``ops/paged_attention.py``) with scalar-prefetched tables.
+        All three are per-head independent, so the tensor-sharded
+        engine runs them head-local inside ``shard_map`` unchanged
+        (the pool arrives head-partitioned either way)."""
+        if self.attn_backend == "gather":
+            k_all, v_all = self._gather_kv(cache_l, tables)
+            return attn_lib.decode_attention(
+                q, attn_lib.repeat_kv(k_all, n_rep),
+                attn_lib.repeat_kv(v_all, n_rep), lengths)
+        if self.attn_backend == "paged-kernel":
+            return paged_ops.paged_decode_attention(
+                q, cache_l, tables, lengths,
+                block_size=self.block_size, n_rep=n_rep)
+        return attn_lib.paged_decode_attention(
+            q, cache_l, tables, lengths,
+            block_size=self.block_size, n_rep=n_rep)
+
+    def _attn_chunk_read(self, q, cache_l, tables, prefix_len, k, v,
+                         n_rep):
+        """Backend dispatch for the multi-token chunk-after-prefix
+        reads (the cached partial prefill's scalar offset, the verify
+        step's per-slot depths): gather-then-``chunk_attention``, or
+        the XLA block-streamed ``paged_chunk_attention`` for BOTH
+        paged backends — the chunk reads are per-request prefix
+        streams where the decode-optimized Pallas grid does not
+        apply."""
+        if self.attn_backend == "gather":
+            pk, pv = self._gather_kv(cache_l, tables)
+            return attn_lib.chunk_attention(
+                q,
+                attn_lib.repeat_kv(
+                    jnp.concatenate([pk, k], axis=1), n_rep),
+                attn_lib.repeat_kv(
+                    jnp.concatenate([pv, v], axis=1), n_rep),
+                prefix_len)
+        return attn_lib.paged_chunk_attention(
+            q, cache_l, tables, prefix_len, k, v,
+            block_size=self.block_size, n_rep=n_rep)
+
+    def _account_attn_read(self, blocks_read):
+        """Book the analytic bytes one program call's attention read
+        touched (``blocks_read`` physical blocks × per-block k/v
+        bytes × layers) into the counter + stats. Derived from block
+        OCCUPANCY host-side, not measured: for the paged backends
+        this is the occupancy-normalized figure (what an
+        occupancy-exact reader touches), a LOWER bound on real
+        traffic — the XLA stream gathers the batch-max block count
+        for every row (shallow rows ride as masked zero-mass folds)
+        and the kernel DMAs padded grid steps whose compute it skips
+        — while the gather backend's full-pool-width charge is what
+        its dense materialization genuinely reads."""
+        b = int(blocks_read) * self._block_read_bytes
+        self.stats["attn_bytes_read"] += b
+        _ATTN_BYTES_TOTAL.labels(self.name, self.attn_backend).inc(b)
+
+    def _blocks_touched(self, n_rows, lengths_list):
+        """Blocks one program call's attention read touches:
+        ``n_rows`` is the padded row count the program gathers tables
+        for (the gather backend materializes the FULL pool width for
+        every row, occupied or not), ``lengths_list`` the ACTIVE
+        rows' valid lengths (the paged backends touch only their
+        occupied blocks)."""
+        if self.attn_backend == "gather":
+            return n_rows * self.blocks_per_slot
+        return sum(-(-int(n) // self.block_size)
+                   for n in lengths_list)
 
     def _write_kv(self, cache_l, phys, off, k, v):
         """Scatter K/V rows into one layer's slice of the paged pool
@@ -1978,15 +2147,13 @@ class GenerationEngine:
 
             def attend(q, k, v):
                 q, k = rope_rows(q), rope_rows(k)
-                # write THEN gather: the new token's own K/V must be
+                # write THEN read: the new token's own K/V must be
                 # part of its attention context (lengths+1 below)
                 new_cache_l = self._write_kv(cache_l, write_phys,
                                              write_off, k[:, 0],
                                              v[:, 0])
-                k_all, v_all = self._gather_kv(new_cache_l, tables)
-                o = attn_lib.decode_attention(
-                    q, attn_lib.repeat_kv(k_all, n_rep),
-                    attn_lib.repeat_kv(v_all, n_rep), lengths + 1)
+                o = self._attn_decode_read(q, new_cache_l, tables,
+                                           lengths + 1, n_rep)
                 return o, new_cache_l
 
             return self._layer_core(x, lp, attend)
@@ -2119,13 +2286,12 @@ class GenerationEngine:
 
             def attend(q, k, v):
                 q, k = rope_rows(q), rope_rows(k)
-                pk, pv = self._gather_kv(cache_l, tables)
                 new_cache_l = self._write_kv(cache_l, write_phys,
                                              write_off, k, v)
                 if self.kv_dtype == "int8":
                     # the plain decode step reads EVERY position —
                     # its own token included — back through the int8
-                    # cache (write-then-gather), so the verify must
+                    # cache (write-then-read), so the verify must
                     # attend over the same quantize-dequantize
                     # round-tripped chunk values, or int8 speculative
                     # output diverges from int8 plain decode
@@ -2133,13 +2299,8 @@ class GenerationEngine:
                         *quantize_lib.kv_quantize(k), dt)
                     v = quantize_lib.kv_dequantize(
                         *quantize_lib.kv_quantize(v), dt)
-                o = attn_lib.chunk_attention(
-                    q,
-                    attn_lib.repeat_kv(
-                        jnp.concatenate([pk, k], axis=1), n_rep),
-                    attn_lib.repeat_kv(
-                        jnp.concatenate([pv, v], axis=1), n_rep),
-                    lengths)
+                o = self._attn_chunk_read(q, cache_l, tables, lengths,
+                                          k, v, n_rep)
                 return o, new_cache_l
 
             return self._layer_core(x, lp, attend)

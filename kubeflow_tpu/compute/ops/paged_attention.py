@@ -1,0 +1,182 @@
+"""Paged-attention decode read as a Pallas TPU kernel.
+
+The generation engine's decode step reads every occupied slot's K/V
+back out of the paged block pool. The XLA block-streamed path
+(``attention.paged_decode_attention``) already avoids materializing
+the gathered ``[S, T, heads, head_dim]`` context; this kernel goes one
+tier lower (the ``ops/flash_attention.py`` pattern): the block tables
+and lengths are SCALAR-PREFETCHED so the grid's index maps can address
+physical pages before each body runs, one page per grid step is DMA'd
+HBM→VMEM by the Pallas pipeline (auto double-buffered across steps),
+and the online-softmax (o, m, l) state lives in VMEM scratch across
+the sequential block steps. Blocks past a slot's occupied length skip
+their compute entirely (``pl.when``), so per-step read cost follows
+occupancy, not pool width.
+
+Grid: ``(slots, kv_heads, blocks_per_slot)`` — one query row's GQA
+group (``n_rep`` query heads sharing a kv head) per (slot, kv head),
+streaming that slot's pages innermost. int8 pools ride the same grid
+with their per-(position, head) scales and dequantize per block inside
+the kernel body, mirroring ``quantize.kv_dequantize``.
+
+``interpret=None`` resolves to "auto" — interpreted off-TPU — so the
+tier-1 CPU suite exercises the REAL kernel path (the flash-attention
+convention; tests/test_paged_attention.py pins parity against the XLA
+block-streamed path). Decode-only by design: the multi-token chunk
+reads (speculative verify, cached partial prefill) stay on the XLA
+streamed path in every backend, where their two-part masks already
+live.
+
+Written against /opt/skills/guides/pallas_guide.md.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
+                   o_ref, acc_ref, m_ref, l_ref, *, block_size,
+                   int8_pages, ks_ref=None, vs_ref=None):
+    """One (slot, kv head, block) grid step: fold the DMA'd page into
+    the slot's running online softmax; initialize the scratch state on
+    the first block step, write the normalized output on the last.
+    ``q`` arrives pre-scaled (the wrapper folds the softmax scale in
+    exactly once, like the flash kernels)."""
+    del tables_ref       # consumed by the index maps (scalar prefetch)
+    i = pl.program_id(0)
+    j = pl.program_id(2)
+    n_rep, d = q_ref.shape[3], q_ref.shape[4]
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros((n_rep, d), jnp.float32)
+        m_ref[:] = jnp.full((n_rep, 1), NEG_INF, jnp.float32)
+        l_ref[:] = jnp.zeros((n_rep, 1), jnp.float32)
+
+    length = lengths_ref[i]
+
+    @pl.when(j * block_size < length)
+    def _():
+        q = q_ref[0, 0, 0]                             # [n_rep, d]
+        k = k_ref[0, :, 0, :]                          # [bs, d]
+        v = v_ref[0, :, 0, :]
+        if int8_pages:
+            # per-block dequant INSIDE the kernel: the int8 bytes ride
+            # the DMA, widen in VMEM (quantize.kv_dequantize numerics)
+            ks = ks_ref[0, :, 0, :]                    # [bs, 1] fp32
+            vs = vs_ref[0, :, 0, :]
+            k = (k.astype(jnp.float32) * ks).astype(q.dtype)
+            v = (v.astype(jnp.float32) * vs).astype(q.dtype)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        pos = j * block_size + lax.broadcasted_iota(
+            jnp.int32, (n_rep, block_size), 1)
+        s = jnp.where(pos < length, s, NEG_INF)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        # mask p explicitly: a fully-masked fold while m is still
+        # NEG_INF must add zero mass (exp(NEG_INF - NEG_INF) = 1)
+        p = jnp.where(pos < length, jnp.exp(s - m_new), 0.0)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * corr + p.sum(axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _():
+        # rows with zero valid columns (inactive slots) normalize by 1
+        # so garbage stays finite garbage, never NaN
+        l = l_ref[:]
+        o_ref[0, 0, 0] = (acc_ref[:]
+                          / jnp.where(l == 0.0, 1.0, l)).astype(
+                              o_ref.dtype)
+
+
+def paged_decode_attention(q, pages, tables, lengths, *, block_size,
+                           n_rep=1, scale=None, interpret=None):
+    """Kernel-tier twin of ``attention.paged_decode_attention`` — same
+    signature and (reduction-reordered fp32 online-softmax) numerics
+    contract, dispatched as a Pallas kernel with scalar-prefetched
+    block tables. ``q`` is ``[S, 1, H, D]``; ``pages`` one layer's
+    pool slice (float pair or int8 quadruple); returns
+    ``[S, 1, H, D]`` in ``q``'s dtype."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    S, _, H, D = q.shape
+    kv_heads = H // n_rep
+    bps = tables.shape[1]
+    bs = int(block_size)
+    if scale is None:
+        scale = D ** -0.5
+    int8_pages = len(pages) == 4
+    qr = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    qr = qr.reshape(S, 1, kv_heads, n_rep, D).transpose(0, 2, 1, 3, 4)
+    # [S, kv_heads, 1, n_rep, D]: one GQA group per (slot, kv head)
+    tables = tables.astype(jnp.int32)
+    lengths = lengths.astype(jnp.int32)
+
+    grid = (S, kv_heads, bps)
+    in_specs = [
+        pl.BlockSpec((1, 1, 1, n_rep, D),
+                     lambda i, h, j, tables, lengths: (i, h, 0, 0, 0)),
+        # one physical PAGE per grid step, addressed through the
+        # scalar-prefetched table — the Pallas pipeline DMAs it
+        # HBM→VMEM and double-buffers across the j steps
+        pl.BlockSpec((1, bs, 1, D),
+                     lambda i, h, j, tables, lengths:
+                         (tables[i, j], 0, h, 0)),
+        pl.BlockSpec((1, bs, 1, D),
+                     lambda i, h, j, tables, lengths:
+                         (tables[i, j], 0, h, 0)),
+    ]
+    operands = [qr, pages[0], pages[1]]
+    if int8_pages:
+        in_specs += [
+            pl.BlockSpec((1, bs, 1, 1),
+                         lambda i, h, j, tables, lengths:
+                             (tables[i, j], 0, h, 0)),
+            pl.BlockSpec((1, bs, 1, 1),
+                         lambda i, h, j, tables, lengths:
+                             (tables[i, j], 0, h, 0)),
+        ]
+        operands += [pages[2], pages[3]]
+
+    kernel = functools.partial(
+        _decode_kernel, block_size=bs, int8_pages=int8_pages)
+    if int8_pages:
+        def kernel(tr, lr, q_r, k_r, v_r, ks_r, vs_r, o_r, a_r, m_r,
+                   l_r):
+            return _decode_kernel(tr, lr, q_r, k_r, v_r, o_r, a_r,
+                                  m_r, l_r, block_size=bs,
+                                  int8_pages=True, ks_ref=ks_r,
+                                  vs_ref=vs_r)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (1, 1, 1, n_rep, D),
+                lambda i, h, j, tables, lengths: (i, h, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((n_rep, D), jnp.float32),
+                pltpu.VMEM((n_rep, 1), jnp.float32),
+                pltpu.VMEM((n_rep, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, kv_heads, 1, n_rep, D),
+                                       q.dtype),
+        interpret=interpret,
+    )(tables, lengths, *operands)
+    return out.transpose(0, 2, 1, 3, 4).reshape(S, 1, H, D)

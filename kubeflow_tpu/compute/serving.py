@@ -1727,6 +1727,12 @@ class ModelServer:
                                     # exhausted" is answerable from
                                     # the frame alone
                                     "mesh": engine.mesh_view()}
+                            # paged-attention read backend; key
+                            # absent on the default gather path so
+                            # the frame stays byte-compatible
+                            ab = engine.attn_view()
+                            if ab is not None:
+                                done["attn_backend"] = ab
                             # per-request speculative economics
                             # (accepted_per_step + the counts the
                             # mirrored header aggregates); key absent

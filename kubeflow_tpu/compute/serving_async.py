@@ -789,6 +789,11 @@ class AsyncTransport:
                     and handle.prefill_seconds is not None else None,
                 # mesh shape + per-chip blocks (threaded parity)
                 "mesh": req["gen_engine"].mesh_view()}
+        # paged-attention read backend (threaded parity: key absent
+        # on the default gather path — byte-compatible)
+        ab = req["gen_engine"].attn_view()
+        if ab is not None:
+            done["attn_backend"] = ab
         # per-request speculative economics (threaded parity: key
         # absent when speculation is off)
         spec = req["gen_engine"].spec_view(handle) \

@@ -44,12 +44,22 @@ acceptance gauge on /metrics, and a sequential probe whose
 router-mirrored ``X-Spec-Acceptance`` header agrees EXACTLY with the
 done frames the driver already consumed.
 
+``--attn-backend`` (ISSUE 15) spawns the replica with the selected
+paged-attention read path (``GEN_ATTN_BACKEND`` through cmd —
+``gather`` | ``paged`` | ``paged-kernel``), fronts it with a real
+router, and asserts the read-path surfaces end to end: the generator
+snapshot's ``attn_backend`` through the router, strict monotonic
+growth of the analytic ``serving_generate_attn_bytes_read_total``
+counter across phases, the done frames' ``attn_backend`` field
+(absent on gather — byte-compatible), and well-formed streams.
+
     python loadtest/generation_serving.py
     python loadtest/generation_serving.py --clients 8 --slots 4
     python loadtest/generation_serving.py --transport threaded
     python loadtest/generation_serving.py --shared-prefix
     python loadtest/generation_serving.py --sharded [--tp 4]
     python loadtest/generation_serving.py --speculative [--spec-k 4]
+    python loadtest/generation_serving.py --attn-backend paged
 """
 
 import argparse
@@ -96,6 +106,13 @@ def build_argparser():
                          "frame-per-token streams")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft tokens per verify round (GEN_SPEC_K)")
+    ap.add_argument("--attn-backend", default=None,
+                    choices=("gather", "paged", "paged-kernel"),
+                    help="paged-attention read backend "
+                         "(GEN_ATTN_BACKEND via cmd) driven through "
+                         "a real router; asserts the snapshot "
+                         "backend, bytes-counter monotonicity and "
+                         "well-formed streams")
     return ap
 
 
@@ -119,6 +136,8 @@ def spawn_server(args):
         # the pair has real (<1.0) acceptance without a training run
         env.update(GEN_SPEC_K=str(args.spec_k), GEN_DRAFT="1",
                    GEN_DRAFT_DAMPEN="0.02")
+    if args.attn_backend:
+        env["GEN_ATTN_BACKEND"] = args.attn_backend
     proc = subprocess.Popen(
         [sys.executable, "-m", "kubeflow_tpu.cmd", "model-server"],
         stdout=subprocess.PIPE, env=env, text=True)
@@ -502,6 +521,99 @@ def run_speculative(args, port):
         core.stop()
 
 
+def scrape_attn_bytes(port, backend):
+    """→ serving_generate_attn_bytes_read_total{backend=...} value."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", "/metrics")
+    text = conn.getresponse().read().decode()
+    conn.close()
+    mo = re.search(
+        rf'^serving_generate_attn_bytes_read_total'
+        rf'{{[^}}]*backend="{backend}"[^}}]*}} ([0-9.e+-]+)',
+        text, re.M)
+    return float(mo.group(1)) if mo else 0.0
+
+
+def run_attn_backend(args, port):
+    """The --attn-backend verdict (ISSUE 15): a replica whose engine
+    reads the paged pool through GEN_ATTN_BACKEND, driven through a
+    real in-process model-router. Streams must stay byte-well-formed,
+    the generator snapshot read THROUGH the router must report the
+    selected backend, non-default backends must stamp the done
+    frames' ``attn_backend`` field, and the analytic
+    ``serving_generate_attn_bytes_read_total{backend}`` counter must
+    advance monotonically phase over phase (the read-path accounting
+    cannot silently stop)."""
+    from kubeflow_tpu.web import router as router_lib
+
+    backend = args.attn_backend
+    core = router_lib.RouterCore(health_interval=0.3)
+    core.set_backends([f"127.0.0.1:{port}"])
+    app = router_lib.create_app(core=core)
+    httpd = app.serve(port=0, host="127.0.0.1")
+    router_port = httpd.server_address[1]
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            snap = core.snapshot()
+            if snap and snap[0]["healthy"]:
+                break
+            time.sleep(0.05)
+        else:
+            raise SystemExit("replica never turned healthy via the "
+                             "router")
+        specs = prompt_set(args)
+        for plen in sorted({len(p) for p, _ in specs}):
+            run_one(router_port, [(997 * plen + j) % 500 + 1
+                                  for j in range(plen)], 2)
+        b0 = scrape_attn_bytes(port, backend)
+        seq_phase, seq_results = run_phase(router_port, specs,
+                                           concurrent=False,
+                                           metrics_port=port)
+        b1 = scrape_attn_bytes(port, backend)
+        conc_phase, conc_results = run_phase(router_port, specs,
+                                             concurrent=True,
+                                             metrics_port=port)
+        b2 = scrape_attn_bytes(port, backend)
+        results = seq_results + conc_results
+        frames_backend_ok = all(
+            r["final"].get("attn_backend") ==
+            (backend if backend != "gather" else None)
+            for r in results)
+        # the generator snapshot THROUGH the router
+        conn = http.client.HTTPConnection("127.0.0.1", router_port,
+                                          timeout=30)
+        conn.request("GET", "/v1/models/lm")
+        snap = json.loads(conn.getresponse().read())
+        conn.close()
+        gen = snap["generator"]
+        report = {
+            "mode": "attn-backend", "transport": args.transport,
+            "attn_backend": backend, "slots": args.slots,
+            "prompts_per_phase": len(specs),
+            "sequential": seq_phase, "concurrent": conc_phase,
+            "attn_bytes_read": [b0, b1, b2],
+            "snapshot_attn_backend": gen.get("attn_backend"),
+            "checks": {
+                "snapshot_reports_backend":
+                    gen.get("attn_backend") == backend,
+                # warm-up already read the pool, so b0 > 0; each
+                # timed phase must strictly advance the counter
+                "bytes_counter_monotonic":
+                    0 < b0 < b1 < b2,
+                "snapshot_bytes_agree":
+                    gen.get("attn_bytes_read") >= b2,
+                "done_frames_carry_backend": frames_backend_ok,
+                "streams_well_formed": True,    # run_one asserted
+            }}
+        print(json.dumps(report, indent=2))
+        if not all(report["checks"].values()):
+            raise SystemExit("attn-backend generation loadtest FAILED")
+    finally:
+        httpd.shutdown()
+        core.stop()
+
+
 def main(argv=None):
     args = build_argparser().parse_args(argv)
     if args.sharded:
@@ -516,6 +628,9 @@ def main(argv=None):
             return
         if args.speculative:
             run_speculative(args, port)
+            return
+        if args.attn_backend:
+            run_attn_backend(args, port)
             return
         specs = prompt_set(args)
         # warm every prompt-length bucket + the decode program OUTSIDE
