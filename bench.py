@@ -635,6 +635,21 @@ def _generate_stats_delta(engine, s0, tokens, dt):
             "prefill_ms": 1000 * pre_s / n_pref if n_pref else None}
 
 
+def _token_latency_cols(engine):
+    """The ttft/itg columns every generate mode reports and
+    ``_persist_generate_record`` persists (ISSUE 16) — read from the
+    engine's raw sample rings, not histogram buckets, so the
+    percentiles aren't bucket-quantized. ``itg_events`` counts
+    emission EVENTS (one per decode step / speculative verify round):
+    under speculation it is visibly smaller than the token count,
+    which is the per-round gap semantics showing up in the record."""
+    tl = engine.token_latency_stats()
+    return {"ttft_p50_ms": tl["ttft_p50_ms"],
+            "itg_p50_ms": tl["itg_p50_ms"],
+            "itg_p99_ms": tl["itg_p99_ms"],
+            "itg_events": tl["itg_count"]}
+
+
 def bench_generate(steps, batch):
     """Generation-engine throughput (compute/generate.py): prefill/
     decode split + token-level continuous batching, measured against
@@ -706,7 +721,12 @@ def bench_generate(steps, batch):
     for plen in sorted({len(p) for p, _ in prompt_specs}):
         engine.generate(list(range(1, plen + 1)), max_tokens=2)
     outs_seq, st_seq = run(engine, concurrent=False)
+    # latency columns cover the HEADLINE phase only — drop the warm
+    # + sequential samples from the rings first
+    engine._ttft_samples.clear()
+    engine._itg_samples.clear()
     outs_cont, st_cont = run(engine, concurrent=True)
+    tl_cont = _token_latency_cols(engine)
     tps_seq, tps_cont = st_seq["tps"], st_cont["tps"]
     occ_cont = st_cont["occupancy"]
 
@@ -741,6 +761,7 @@ def bench_generate(steps, batch):
                 "occupancy_continuous": round(occ_cont, 2),
                 "occupancy_drain_refill": round(occ_drain, 2),
                 "occupancy_vs_drain_refill": round(vs_drain, 2),
+                **tl_cont,
                 "greedy_matches_full_recompute": conforms,
                 "checks": {
                     "tokens_per_sec_vs_sequential_ge_1.5":
@@ -833,7 +854,10 @@ def bench_generate_prefix(steps, batch):
         params, cfg, max_slots=slots, block_size=16,
         name="bench-prefix")
     warm_programs(warm_engine)
+    warm_engine._ttft_samples.clear()    # headline-phase-only columns
+    warm_engine._itg_samples.clear()
     warm = run(warm_engine)
+    tl_warm = _token_latency_cols(warm_engine)
 
     # conformance spot-check: a shared-prefix hit, the full-prompt
     # re-request (entire prompt cached) and a cold output all match
@@ -871,6 +895,7 @@ def bench_generate_prefix(steps, batch):
                 "prefill_ms_saved_per_request":
                     round(cold["prefill_ms_per_request"]
                           - warm["prefill_ms_per_request"], 2),
+                **tl_warm,
                 "greedy_matches_full_recompute": conforms,
                 "checks": {
                     "tokens_per_sec_vs_cold_ge_2.0": vs_cold >= 2.0,
@@ -977,7 +1002,10 @@ def bench_generate_sharded(steps, batch):
         params, cfg, max_slots=slots, block_size=16,
         prefix_cache=False, name="bench-tp4", mesh=mesh4)
     warm(sharded)
+    sharded._ttft_samples.clear()        # headline-phase-only columns
+    sharded._itg_samples.clear()
     outs_4, tps_4, occ_4, pre_4 = run(sharded)
+    tl_4 = _token_latency_cols(sharded)
     collective_share = sharded.measure_collective_share(iters=3)
     sharded.close()
 
@@ -1028,6 +1056,7 @@ def bench_generate_sharded(steps, batch):
                 "prefill_ms_per_request": round(pre_4, 2),
                 "prefill_ms_per_request_single_chip": round(pre_1, 2),
                 "collective_share": round(collective_share, 4),
+                **tl_4,
                 "capacity_per_chip_block_budget": budget,
                 "capacity_peak_sequences_single_chip": peak_1,
                 "capacity_peak_sequences_sharded": peak_4,
@@ -1114,7 +1143,10 @@ def bench_generate_spec(steps, batch):
         prefix_cache=False, name="bench-spec", draft_params=draft,
         draft_config=dcfg, spec_k=spec_k)
     warm(spec)
+    spec._ttft_samples.clear()           # headline-phase-only columns
+    spec._itg_samples.clear()
     outs_spec, st_spec, s0 = run(spec)
+    tl_spec = _token_latency_cols(spec)
     d_prop = spec.stats["spec_proposed"] - s0["spec_proposed"]
     d_acc = spec.stats["spec_accepted"] - s0["spec_accepted"]
     d_slot_steps = spec.stats["decode_token_slots"] \
@@ -1144,6 +1176,10 @@ def bench_generate_spec(steps, batch):
                 "draft_dampen": dampen,
                 "acceptance_rate": round(acceptance, 4),
                 "tokens_per_step": round(tokens_per_step, 2),
+                # itg_events ≪ generated tokens here: one gap per
+                # verify ROUND, not per token — the burst semantics
+                # visible in the persisted record
+                **tl_spec,
                 "non_spec_tokens_per_sec": round(st_plain["tps"], 1),
                 "occupancy": round(st_spec["occupancy"], 2),
                 "prefill_ms_per_request": round(
@@ -1231,10 +1267,10 @@ def bench_generate_long(steps, batch):
                 }
         finally:
             eng.close()
-        return rows, outs
+        return rows, outs, _token_latency_cols(eng)
 
-    rows_g, outs_g = sweep("gather")
-    rows_p, outs_p = sweep("paged")
+    rows_g, outs_g, _tl_g = sweep("gather")
+    rows_p, outs_p, tl_p = sweep("paged")
 
     # in-run conformance at every swept context: paged == gather ==
     # the cache-free oracle (fp32)
@@ -1287,6 +1323,7 @@ def bench_generate_long(steps, batch):
                 "slots": slots, "gen_tokens": gen_tokens,
                 "long_context": sweep_table,
                 "prefill_ms_per_request": None,
+                **tl_p,
                 "checks": {
                     "paged_vs_gather_tokens_per_sec_ge_1.3_at_top":
                         speedup_top >= 1.3,
@@ -1333,6 +1370,12 @@ def _persist_generate_record(mode, result):
                             d.get("prefill_ms_per_request_warm")),
         "hit_ratio": d.get("hit_ratio"),
         "acceptance_rate": d.get("acceptance_rate"),
+        # token-latency columns (ISSUE 16): itg percentiles are over
+        # emission EVENTS — in the speculative mode's rows itg_events
+        # is visibly below the token count (one gap per verify round)
+        "ttft_p50_ms": d.get("ttft_p50_ms"),
+        "itg_p99_ms": d.get("itg_p99_ms"),
+        "itg_events": d.get("itg_events"),
         "checks": d.get("checks"),
     }
     if d.get("long_context") is not None:

@@ -160,6 +160,13 @@ def main():
         "serving_generate_attn_bytes_read_total",
         # sweep-pod failure re-packing (ROADMAP PR 5 follow-up)
         "sweep_repack_total",
+        # token-level serving telemetry (ISSUE 16): TTFT / inter-token
+        # gap / per-request emitted totals — what the generate-ttft and
+        # generate-itg default SLOs, the hub's /debug/generate view,
+        # bench.py's ttft/itg columns and loadtest --token-latency read
+        "serving_generate_ttft_seconds",
+        "serving_generate_inter_token_seconds",
+        "serving_generate_emitted_tokens",
     }
     registered = {metric.name for metric in obs_metrics.REGISTRY._metrics}
     scratch_names = {metric.name for metric in scratch._metrics}

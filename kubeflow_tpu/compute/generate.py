@@ -125,6 +125,8 @@ tokens incrementally as chunked NDJSON.
 import collections
 import dataclasses
 import logging
+import os
+import statistics
 import threading
 import time
 
@@ -166,9 +168,12 @@ _DECODE_STEP_SECONDS = obs_metrics.REGISTRY.histogram(
              0.5, 1.0))
 _QUEUE_WAIT_SECONDS = obs_metrics.REGISTRY.histogram(
     "serving_generate_queue_wait_seconds",
-    "Time a prompt waited in the admission queue before its prefill "
-    "launched (slot or block-pool pressure shows up here)",
-    ("model",),
+    "Time a prompt waited in the admission queue, by outcome: "
+    "admitted = the wait before its prefill launched, expired = the "
+    "wait of a request whose deadline died in the queue (504 with no "
+    "prefill) — without the expired series, overload queue time is "
+    "survivorship-biased toward the requests that made it",
+    ("model", "outcome"),
     buckets=(1e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0))
 _SLOT_OCCUPANCY = obs_metrics.REGISTRY.histogram(
     "serving_generate_slot_occupancy_slots",
@@ -273,6 +278,39 @@ _TOKENS_PER_STEP = obs_metrics.REGISTRY.histogram(
     "distribution's mean to keep per-token latency interpretable",
     ("model",),
     buckets=(1, 2, 3, 4, 5, 6, 8, 12, 16))
+_TTFT_SECONDS = obs_metrics.REGISTRY.histogram(
+    "serving_generate_ttft_seconds",
+    "Time to first token: request admission (submit) to the first "
+    "emitted token, decomposing as queue wait + prefill (the "
+    "generate.queue_wait / generate.prefill trace phases) — the "
+    "user-felt responsiveness figure of a streamed generation",
+    ("model",),
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+             10.0))
+_INTER_TOKEN_SECONDS = obs_metrics.REGISTRY.histogram(
+    "serving_generate_inter_token_seconds",
+    "Gap between consecutive token EMISSION EVENTS of one sequence "
+    "(first gap starts at the first token): one sample per decode "
+    "step, and one per speculative verify round — the 1..k+1 tokens "
+    "a verify round accepts share one emission event, so a spec "
+    "burst counts its round gap ONCE instead of k+1 zero-gaps",
+    ("model",),
+    buckets=(5e-4, 1e-3, 2.5e-3, 5e-3, 0.01, 0.025, 0.05, 0.1, 0.25,
+             1.0))
+_EMITTED_TOKENS = obs_metrics.REGISTRY.histogram(
+    "serving_generate_emitted_tokens",
+    "Tokens emitted per finished request (0 for queue-side failures "
+    "that never reached prefill) — the per-request totals behind the "
+    "engine's tokens/sec",
+    ("model",),
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 1024))
+
+#: slot lifecycle timeline ring size (snapshot ``timeline``)
+_TIMELINE_EVENTS = int(os.environ.get("GEN_TIMELINE_EVENTS", "256"))
+#: raw TTFT/ITG sample rings for percentile summaries (bench reads
+#: these without scraping; big enough for a bench phase, bounded so a
+#: long-lived server never grows)
+_LATENCY_SAMPLES = 4096
 
 
 class MeshShapeError(ValueError):
@@ -297,8 +335,9 @@ class GenerationHandle:
                  "error", "cancelled", "cancel_reason", "enqueued",
                  "enqueued_w", "prefix_tokens_skipped",
                  "prefill_seconds", "spec_rounds", "spec_proposed",
-                 "spec_accepted", "spec_wire", "logits", "_engine",
-                 "_done")
+                 "spec_accepted", "spec_wire", "logits", "seq",
+                 "ttft_s", "token_times", "itg_gaps", "last_emit",
+                 "admitted_w", "_engine", "_done")
 
     def __init__(self, prompt, max_tokens, eos_id, deadline,
                  on_token, on_done, rt):
@@ -329,6 +368,20 @@ class GenerationHandle:
         self.logits = []          # per-emitted-token fp32 logits, filled
         #                           only on a debug_logits engine (the
         #                           tolerance-conformance probe)
+        self.seq = 0              # engine-assigned request number (the
+        #                           timeline ring's request identity)
+        self.ttft_s = None        # submit -> first token (set at the
+        #                           first emission; X-TTFT-Ms + the
+        #                           done frame's ttft_s read it)
+        self.token_times = []     # wall clock stamped at EVERY emitted
+        #                           token (parallel to out_tokens)
+        self.itg_gaps = []        # seconds between consecutive
+        #                           EMISSION EVENTS (a speculative
+        #                           verify round's burst shares one
+        #                           event, so its gap lands here once)
+        self.last_emit = None     # perf_counter of the last emission
+        #                           event (the running end of the gap)
+        self.admitted_w = None    # wall clock at admission (slot age)
         self.enqueued = time.perf_counter()
         self.enqueued_w = time.time()
         self._engine = None       # set by submit(); result(timeout)
@@ -661,6 +714,17 @@ class GenerationEngine:
         self._draining = False
         self._stop = False
         self._step_sleep = 0.0    # test/bench knob: fake device time
+        self._seq = 0             # request numbering for the timeline
+        # bounded slot-lifecycle ring (admitted / prefill /
+        # first_token / spec_round / evicted{reason}) — the snapshot's
+        # ``timeline`` view; appends are engine-thread-only, the
+        # deque's maxlen bounds memory on a long-lived server
+        self._timeline = collections.deque(maxlen=_TIMELINE_EVENTS)
+        # raw TTFT / inter-token-gap samples for percentile summaries
+        # (token_latency_stats — bench + the done frame read the
+        # per-handle copies; these rings are the engine-wide view)
+        self._ttft_samples = collections.deque(maxlen=_LATENCY_SAMPLES)
+        self._itg_samples = collections.deque(maxlen=_LATENCY_SAMPLES)
         # aggregate counters bench reads without scraping /metrics
         self.stats = {"prefills": 0, "decode_steps": 0,
                       "decode_token_slots": 0, "tokens": 0,
@@ -935,6 +999,109 @@ class GenerationEngine:
                 f"proposed={self.stats['spec_proposed']};"
                 f"accepted={self.stats['spec_accepted']}")
 
+    # -------------------------------------------- token-level telemetry
+
+    def _record_event(self, event, handle, slot=None, **attrs):
+        """One slot-lifecycle event: appended to the bounded engine
+        ring (snapshot ``timeline``) and dropped as a zero-duration
+        marker span on the request's derived trace — named
+        ``generate.slot<i>.<event>`` so ``/debug/traces`` renders a
+        per-slot lane of admissions/rounds/evictions next to the
+        request's phase spans. Engine-thread-only (like all slot
+        state); the marker append is a GIL-atomic tuple append."""
+        now = time.time()
+        entry = {"ts": round(now, 6), "event": event,
+                 "request": handle.seq}
+        if slot is not None:
+            entry["slot"] = slot
+        entry.update(attrs)
+        self._timeline.append(entry)
+        if handle.rt is not None:
+            lane = f"generate.slot{slot}" if slot is not None \
+                else "generate.queue"
+            handle.rt.phase(f"{lane}.{event}", now, end=now, **attrs)
+
+    def _note_emission_event(self, handle):
+        """Book ONE emission event for ``handle`` BEFORE its tokens go
+        out: the first event closes the TTFT clock (admission → first
+        token), every later one books an inter-token gap. A
+        speculative verify round calls this once for its whole
+        1..k+1-token burst — the burst shares one event, so spec
+        bursts count the round gap once instead of k+1 zero-gaps."""
+        now = time.perf_counter()
+        if handle.last_emit is None:
+            handle.ttft_s = now - handle.enqueued
+            self._ttft_samples.append(handle.ttft_s)
+            _TTFT_SECONDS.labels(self.name).observe(
+                handle.ttft_s, trace_id=handle.rt.exemplar(
+                    handle.ttft_s) if handle.rt is not None else None)
+        else:
+            gap = now - handle.last_emit
+            handle.itg_gaps.append(gap)
+            self._itg_samples.append(gap)
+            _INTER_TOKEN_SECONDS.labels(self.name).observe(
+                gap, trace_id=handle.rt.exemplar(gap)
+                if handle.rt is not None else None)
+        handle.last_emit = now
+
+    def timeline_view(self, limit=None):
+        """The slot-lifecycle ring, oldest first (snapshot
+        ``timeline``); ``limit`` keeps only the newest N events."""
+        events = list(self._timeline)
+        if limit is not None:
+            events = events[-int(limit):]
+        return events
+
+    def token_latency_view(self, handle):
+        """Per-request token-latency economics for the ``:generate``
+        done frame: TTFT plus the request's own inter-emission-gap
+        median/max (``None`` before the first token / second emission
+        event — a 1-token request has no gap)."""
+        gaps = list(handle.itg_gaps)
+        return {
+            "ttft_s": round(handle.ttft_s, 6)
+                if handle.ttft_s is not None else None,
+            "itg_p50_s": round(statistics.median(gaps), 6)
+                if gaps else None,
+            "itg_max_s": round(max(gaps), 6) if gaps else None,
+        }
+
+    def ttft_header(self, handle):
+        """``X-TTFT-Ms`` wire value, mirrored by the router: the SAME
+        rounded ttft_s the done frame carries, in milliseconds, so a
+        driver holding both can assert exact agreement. ``None``
+        (header omitted) before the first token — unreachable on the
+        transports, which write the head after the first token."""
+        if handle.ttft_s is None:
+            return None
+        return f"{round(round(handle.ttft_s, 6) * 1000, 3):g}"
+
+    def token_latency_stats(self):
+        """Engine-level TTFT/ITG percentile summary from the bounded
+        raw-sample rings — what ``bench.py`` generate modes persist
+        as the ``ttft_p50_ms`` / ``itg_p99_ms`` columns without
+        scraping /metrics (histogram buckets would quantize the
+        percentiles)."""
+        def pctl(sorted_vals, q):
+            return sorted_vals[min(len(sorted_vals) - 1,
+                                   int(q * len(sorted_vals)))]
+
+        ttft = sorted(self._ttft_samples)
+        itg = sorted(self._itg_samples)
+        return {
+            "ttft_count": len(ttft),
+            "ttft_p50_ms": round(1000 * pctl(ttft, 0.50), 3)
+                if ttft else None,
+            "ttft_p95_ms": round(1000 * pctl(ttft, 0.95), 3)
+                if ttft else None,
+            "itg_count": len(itg),
+            "itg_p50_ms": round(1000 * pctl(itg, 0.50), 3)
+                if itg else None,
+            "itg_p99_ms": round(1000 * pctl(itg, 0.99), 3)
+                if itg else None,
+            "itg_max_ms": round(1000 * max(itg), 3) if itg else None,
+        }
+
     # ------------------------------------------------------ public API
 
     def submit(self, tokens, max_tokens=None, eos_id=None,
@@ -984,6 +1151,8 @@ class GenerationEngine:
                 raise serving_lib.DrainingError(
                     f"generation engine {self.name!r} is draining; "
                     f"retry against another replica")
+            self._seq += 1
+            handle.seq = self._seq
             self._queue.append(handle)
             self._cond.notify()
         return handle
@@ -1042,9 +1211,36 @@ class GenerationEngine:
             reclaimable = self._n_reclaimable
             hits = self.stats["prefix_hits"]
             misses = self.stats["prefix_misses"]
+            now_w, now_pc = time.time(), time.perf_counter()
+            now_mono = time.monotonic()
+            slot_detail = []
+            for i, s in enumerate(self._slots):
+                if s is None:
+                    slot_detail.append(None)
+                    continue
+                h = s.handle
+                slot_detail.append({
+                    "slot": i,
+                    "request": h.seq,
+                    "age_s": round(now_w - h.admitted_w, 3)
+                        if h.admitted_w is not None else None,
+                    "tokens_emitted": len(h.out_tokens),
+                    "deadline_remaining_s":
+                        round(h.deadline - now_mono, 3)
+                        if h.deadline is not None else None,
+                    "last_emit_age_s": round(now_pc - h.last_emit, 3)
+                        if h.last_emit is not None else None,
+                })
             return {
                 "slots": self.max_slots,
                 "occupied": occupied,
+                # per-slot staleness view: a stuck slot shows as a
+                # growing last_emit_age_s with tokens_emitted frozen,
+                # diagnosable from the snapshot alone
+                "slot_detail": slot_detail,
+                # bounded lifecycle ring (newest last) — the same
+                # events land as marker spans on each request's trace
+                "timeline": self.timeline_view(),
                 "queued": len(self._queue),
                 "blocks": self.num_blocks,
                 "free_blocks": len(self._free) + reclaimable,
@@ -1211,6 +1407,11 @@ class GenerationEngine:
                 reason, err = handle.cancel_reason, None
             elif handle.deadline is not None and now >= handle.deadline:
                 waited = time.perf_counter() - handle.enqueued
+                # the 504 still books its queue time — without the
+                # expired outcome the family only ever sees survivors
+                # and under-reports exactly when the queue melts down
+                _QUEUE_WAIT_SECONDS.labels(self.name,
+                                           "expired").observe(waited)
                 reason = "deadline"
                 err = serving_lib.DeadlineExceededError(
                     f"deadline expired while queued for a generation "
@@ -1409,6 +1610,8 @@ class GenerationEngine:
             if handle.deadline is not None \
                     and time.monotonic() >= handle.deadline:
                 waited = time.perf_counter() - handle.enqueued
+                _QUEUE_WAIT_SECONDS.labels(self.name,
+                                           "expired").observe(waited)
                 self._finish(handle, "deadline",
                              serving_lib.DeadlineExceededError(
                                  f"deadline expired while queued for a "
@@ -1454,11 +1657,15 @@ class GenerationEngine:
         tokens[:suffix_len] = handle.prompt[offset:]
         t0 = time.perf_counter()
         t0w = time.time()
+        handle.admitted_w = t0w
         wait_s = t0 - handle.enqueued
-        _QUEUE_WAIT_SECONDS.labels(self.name).observe(wait_s)
+        _QUEUE_WAIT_SECONDS.labels(self.name,
+                                   "admitted").observe(wait_s)
         if handle.rt is not None:
             handle.rt.phase("generate.queue_wait", handle.enqueued_w,
                             t0w)
+        self._record_event("admitted", handle, slot=slot_idx,
+                           wait_s=round(wait_s, 6))
         try:
             if matched:
                 # prefix table padded to the static per-slot width;
@@ -1518,6 +1725,8 @@ class GenerationEngine:
             handle.rt.phase("generate.prefill", t0w,
                             rows=padded, prompt=prompt_len,
                             prefix_tokens_skipped=offset)
+        self._record_event("prefill", handle, slot=slot_idx,
+                           seconds=round(elapsed, 6))
         self.stats["prefills"] += 1
         self.stats["prefill_seconds_total"] += elapsed
         if matched:
@@ -1537,6 +1746,12 @@ class GenerationEngine:
             if self.prefix_cache:
                 self._index_prompt_locked(handle.prompt, slot.blocks,
                                           matched)
+        # TTFT closes BEFORE the emit so handle.ttft_s is set by the
+        # time on_token fires — the transports read it to build the
+        # response head right after the first token arrives
+        self._note_emission_event(handle)
+        self._record_event("first_token", handle, slot=slot_idx,
+                           ttft_s=round(handle.ttft_s, 6))
         self._emit(handle, first)
         if handle.eos_id is not None and first == handle.eos_id:
             self._evict(slot_idx, "eos")
@@ -1605,6 +1820,7 @@ class GenerationEngine:
             _TOKENS_PER_STEP.labels(self.name).observe(1)
             if self.debug_logits:
                 handle.logits.append(dbg[i])
+            self._note_emission_event(handle)
             self._emit(handle, token)
             if handle.eos_id is not None and token == handle.eos_id:
                 self._evict(i, "eos")
@@ -1765,6 +1981,8 @@ class GenerationEngine:
         for i, slot in active:
             a = accepts[i]
             handle = slot.handle
+            self._record_event("spec_round", handle, slot=i,
+                               proposed=k_eff[i], accepted=a)
             L = slot.length
             # rollback = write-then-truncate: the verified prefix
             # (inputs x_0..x_a at positions L..L+a) stays, everything
@@ -1780,6 +1998,10 @@ class GenerationEngine:
                     extra = slot.blocks[keep:]
                     del slot.blocks[keep:]
                     self._release_blocks_locked(extra)
+            # the whole verified burst is ONE emission event: one ITG
+            # sample per round, booked before any of its tokens (so a
+            # mid-burst eos/length eviction still counts the round)
+            self._note_emission_event(handle)
             emitted = 0
             for j in range(a + 1):
                 token = int(target[i, j])
@@ -1802,6 +2024,7 @@ class GenerationEngine:
 
     def _emit(self, handle, token):
         handle.out_tokens.append(token)
+        handle.token_times.append(time.time())
         _TOKENS_TOTAL.labels(self.name).inc()
         self.stats["tokens"] += 1
         if handle.on_token is not None:
@@ -1822,6 +2045,9 @@ class GenerationEngine:
             self._cond.notify()
         _EVICTIONS_TOTAL.labels(self.name, reason).inc()
         handle = slot.handle
+        self._record_event("evicted", handle, slot=slot_idx,
+                           reason=reason,
+                           tokens=len(handle.out_tokens))
         if handle.rt is not None and slot.length > len(handle.prompt):
             handle.rt.phase("generate.decode", slot.decode_start_w,
                             tokens=len(handle.out_tokens))
@@ -1833,6 +2059,11 @@ class GenerationEngine:
     def _finish(self, handle, reason, error=None):
         handle.reason = reason
         handle.error = error
+        # unconditional: a queue-side 504/cancel books 0, so the
+        # distribution keeps overload failures visible instead of
+        # averaging over survivors only
+        _EMITTED_TOKENS.labels(self.name).observe(
+            len(handle.out_tokens))
         if handle.on_done is not None:
             try:
                 handle.on_done(reason, list(handle.out_tokens), error)

@@ -1692,6 +1692,14 @@ class ModelServer:
                 if handle.spec_wire is not None:
                     self.send_header("X-Spec-Acceptance",
                                      handle.spec_wire)
+                # time-to-first-token in ms, known at head time (the
+                # head goes out after the first token) and derived
+                # from the SAME ttft_s the done frame carries —
+                # router-mirrored so clients behind the fleet edge
+                # see it too
+                ttft_ms = engine.ttft_header(handle)
+                if ttft_ms is not None:
+                    self.send_header("X-TTFT-Ms", ttft_ms)
                 if rt is not None:
                     self.send_header("traceparent",
                                      tracing.format_traceparent(rt))
@@ -1727,6 +1735,13 @@ class ModelServer:
                                     # exhausted" is answerable from
                                     # the frame alone
                                     "mesh": engine.mesh_view()}
+                            # token-latency economics: TTFT (matches
+                            # the X-TTFT-Ms head exactly — same
+                            # rounded value) and this request's own
+                            # inter-emission-gap median/max; a spec
+                            # round's burst is ONE emission event
+                            done.update(
+                                engine.token_latency_view(handle))
                             # paged-attention read backend; key
                             # absent on the default gather path so
                             # the frame stays byte-compatible

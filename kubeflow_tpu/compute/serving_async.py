@@ -729,6 +729,13 @@ class AsyncTransport:
         # like the prefix header
         if handle is not None and handle.spec_wire is not None:
             lines.append(f"X-Spec-Acceptance: {handle.spec_wire}")
+        # time-to-first-token in ms (the head goes out after the
+        # first token, so it is known here) — same rounded value the
+        # done frame carries; router-mirrored (threaded parity)
+        ttft_ms = engine.ttft_header(handle) \
+            if handle is not None else None
+        if ttft_ms is not None:
+            lines.append(f"X-TTFT-Ms: {ttft_ms}")
         if rt is not None:
             lines.append(
                 f"traceparent: {tracing.format_traceparent(rt)}")
@@ -789,6 +796,10 @@ class AsyncTransport:
                     and handle.prefill_seconds is not None else None,
                 # mesh shape + per-chip blocks (threaded parity)
                 "mesh": req["gen_engine"].mesh_view()}
+        # token-latency economics (threaded parity): ttft_s matches
+        # the X-TTFT-Ms head exactly — same rounded value
+        if handle is not None:
+            done.update(req["gen_engine"].token_latency_view(handle))
         # paged-attention read backend (threaded parity: key absent
         # on the default gather path — byte-compatible)
         ab = req["gen_engine"].attn_view()

@@ -218,6 +218,70 @@ def _family_of(series):
     return series
 
 
+def histogram_view(samples, family, group_by=("model",),
+                   quantiles=(0.5, 0.95, 0.99)):
+    """Per-group quantile estimates off one histogram family's
+    cumulative buckets — ``promql histogram_quantile`` semantics
+    (linear interpolation inside the winning bucket; a target landing
+    in ``+Inf`` clamps to the largest finite bound, so the estimate
+    never invents a value past what the buckets can support).
+
+    ``samples`` is any iterable of ``(series, labels, value)`` —
+    a ``Shard.samples`` list, or ``Aggregator.merged_samples()``
+    items flattened to triples. Returns
+    ``{group_key: {"count", "sum", "p50", ...}}`` with one ``p<q>``
+    key per requested quantile; groups whose count is 0 map their
+    quantiles to ``None`` (no data is not the same as 0 latency)."""
+    buckets = {}      # group -> {le_float: cumulative}
+    counts = {}
+    sums = {}
+    for series, labels, value in samples:
+        if not series.startswith(family):
+            continue
+        lab = dict(labels)
+        group = tuple(lab.get(k, "") for k in group_by)
+        if series == family + "_bucket":
+            le = lab.get("le", "")
+            bound = float("inf") if le == "+Inf" else float(le)
+            grp = buckets.setdefault(group, {})
+            grp[bound] = grp.get(bound, 0) + value
+        elif series == family + "_count":
+            counts[group] = counts.get(group, 0) + value
+        elif series == family + "_sum":
+            sums[group] = sums.get(group, 0.0) + value
+    out = {}
+    for group, grp in buckets.items():
+        total = counts.get(group, grp.get(float("inf"), 0))
+        view = {"count": int(total),
+                "sum": round(sums.get(group, 0.0), 6)}
+        bounds = sorted(grp)
+        finite = [b for b in bounds if b != float("inf")]
+        for q in quantiles:
+            key = f"p{q * 100:g}".replace(".", "_")
+            if not total or not finite:
+                view[key] = None
+                continue
+            target = q * total
+            prev_bound, prev_cum = 0.0, 0
+            est = finite[-1]        # +Inf winner clamps here
+            for b in bounds:
+                cum = grp[b]
+                if cum >= target:
+                    if b == float("inf"):
+                        est = finite[-1]
+                    else:
+                        width, span = b - prev_bound, cum - prev_cum
+                        est = prev_bound + width \
+                            * ((target - prev_cum) / span) \
+                            if span else b
+                    break
+                prev_bound, prev_cum = (b if b != float("inf")
+                                        else prev_bound), cum
+            view[key] = round(est, 6)
+        out[group] = view
+    return out
+
+
 class Aggregator:
     """Stateful shard merger (one per hub process: restart detection
     needs memory of each pod's previous epoch and totals)."""

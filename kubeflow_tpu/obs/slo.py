@@ -301,6 +301,22 @@ def default_slos():
             kind="latency", threshold_s=60.0,
             description="95% of gangs are admitted within 60 s of "
                         "queuing"),
+        # token-level streaming objectives: what a user of the
+        # :generate surface actually feels. Thresholds sit ON bucket
+        # bounds of the generate.py histograms (1.0 / 0.25) so the
+        # cumulative-bucket ratio is exact, not interpolated.
+        SLO("generate-ttft",
+            "serving_generate_ttft_seconds", objective=0.95,
+            kind="latency", threshold_s=1.0,
+            description="95% of generations stream their first token "
+                        "within 1 s of admission (queue wait + "
+                        "prefill)"),
+        SLO("generate-itg",
+            "serving_generate_inter_token_seconds", objective=0.99,
+            kind="latency", threshold_s=0.25,
+            description="99% of inter-token gaps (one per decode "
+                        "step or speculative verify round) stay "
+                        "under 250 ms"),
     ]
 
 

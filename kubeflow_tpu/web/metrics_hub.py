@@ -51,6 +51,8 @@ _INDEX_HTML = """<!doctype html>
 </li>
 <li><a href="debug/latency">/debug/latency</a> — fleet latency anatomy
  (per-phase p50/p95/p99)</li>
+<li><a href="debug/generate">/debug/generate</a> — token-level serving
+ view (TTFT/ITG percentiles, occupancy, acceptance, per pod)</li>
 <li><a href="api/alerts">/api/alerts</a> — SLO burn-rate verdicts</li>
 <li><a href="api/fleet">/api/fleet</a> — shard inventory</li>
 </ul>
@@ -147,6 +149,94 @@ def create_app(store=None, shard_dir=None):
     app.registry = FleetRegistry(shard_dir, pod, engine=engine)
     app.traces = FleetTraces(shard_dir, pod)
     app.shard_dir = shard_dir
+
+    @app.get("/debug/generate")
+    def debug_generate(request):
+        """Fleet token-level serving view: the merged generate
+        families decomposed into per-model TTFT/ITG percentiles (ms),
+        slot occupancy, speculative acceptance and prefix hit ratio,
+        with the same percentiles per POD so a slow replica stands
+        out of the fleet aggregate."""
+        app.registry.exposition()          # fresh merge
+        merged = app.registry.aggregator.merged_samples()
+        triples = [(series, labels, value)
+                   for (series, labels), value in merged.items()]
+
+        def counters(name):
+            out = {}
+            for (series, labels), value in merged.items():
+                if series == name:
+                    key = dict(labels).get("model", "")
+                    out[key] = out.get(key, 0) + value
+            return out
+
+        def latency_ms(view):
+            return {
+                "count": view["count"],
+                "p50_ms": round(view["p50"] * 1000, 3)
+                    if view["p50"] is not None else None,
+                "p95_ms": round(view["p95"] * 1000, 3)
+                    if view["p95"] is not None else None,
+                "p99_ms": round(view["p99"] * 1000, 3)
+                    if view["p99"] is not None else None,
+            }
+
+        ttft = aggregate.histogram_view(
+            triples, "serving_generate_ttft_seconds")
+        itg = aggregate.histogram_view(
+            triples, "serving_generate_inter_token_seconds")
+        occ = aggregate.histogram_view(
+            triples, "serving_generate_slot_occupancy_slots")
+        emitted = aggregate.histogram_view(
+            triples, "serving_generate_emitted_tokens")
+        tokens = counters("serving_generate_tokens_total")
+        hits = counters("serving_generate_prefix_hits_total")
+        misses = counters("serving_generate_prefix_misses_total")
+        proposed = counters("serving_generate_spec_proposed_tokens_total")
+        accepted = counters("serving_generate_spec_accepted_tokens_total")
+
+        # per-pod breakdown straight off the shard files (the merged
+        # view has no pod dimension by design — counters there are
+        # fleet totals)
+        pods = {}
+        for shard in (aggregate.read_shards(shard_dir)
+                      if shard_dir else []):
+            pod_ttft = aggregate.histogram_view(
+                shard.samples, "serving_generate_ttft_seconds")
+            pod_itg = aggregate.histogram_view(
+                shard.samples, "serving_generate_inter_token_seconds")
+            for (model,) in set(pod_ttft) | set(pod_itg):
+                entry = pods.setdefault(model, {}).setdefault(
+                    shard.pod, {})
+                if (model,) in pod_ttft:
+                    entry["ttft"] = latency_ms(pod_ttft[(model,)])
+                if (model,) in pod_itg:
+                    entry["itg"] = latency_ms(pod_itg[(model,)])
+
+        models = {}
+        for (model,) in set(ttft) | set(itg):
+            h = hits.get(model, 0)
+            m = misses.get(model, 0)
+            p = proposed.get(model, 0)
+            a = accepted.get(model, 0)
+            o = occ.get((model,))
+            e = emitted.get((model,))
+            models[model] = {
+                "ttft": latency_ms(ttft[(model,)])
+                    if (model,) in ttft else None,
+                "itg": latency_ms(itg[(model,)])
+                    if (model,) in itg else None,
+                "tokens_total": int(tokens.get(model, 0)),
+                "requests_finished": e["count"] if e else 0,
+                "slot_occupancy_mean":
+                    round(o["sum"] / o["count"], 4)
+                    if o and o["count"] else None,
+                "spec_acceptance": round(a / p, 4) if p else None,
+                "prefix_hit_ratio": round(h / (h + m), 4)
+                    if h + m else None,
+                "pods": pods.get(model, {}),
+            }
+        return {"shardDir": shard_dir, "models": models}
 
     @app.get("/api/alerts")
     def alerts(request):

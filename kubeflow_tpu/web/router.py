@@ -69,6 +69,10 @@ _MIRROR_HEADERS = ("Content-Type", "X-Tensor-Dtype", "X-Tensor-Shape",
                    # (loadtest --speculative asserts the mirrored
                    # header agrees with the done frames it consumed)
                    "X-Spec-Acceptance",
+                   # :generate time-to-first-token in ms (loadtest
+                   # --token-latency asserts it agrees with the done
+                   # frame's ttft_s through the router hop)
+                   "X-TTFT-Ms",
                    "Retry-After")
 
 

@@ -53,6 +53,15 @@ growth of the analytic ``serving_generate_attn_bytes_read_total``
 counter across phases, the done frames' ``attn_backend`` field
 (absent on gather — byte-compatible), and well-formed streams.
 
+``--token-latency`` (ISSUE 16) spawns the replica with a real shard
+exporter (``OBS_EXPORT_DIR``), drives it through a real router, and
+asserts the token-latency surfaces end to end: the router-mirrored
+``X-TTFT-Ms`` head agreeing exactly with every done frame's
+``ttft_s``, the ITG summary fields in multi-token done frames, and a
+REAL fleet metrics hub over the shard directory serving
+``/debug/generate`` with non-empty TTFT/ITG percentiles attributed to
+the subprocess pod.
+
     python loadtest/generation_serving.py
     python loadtest/generation_serving.py --clients 8 --slots 4
     python loadtest/generation_serving.py --transport threaded
@@ -60,6 +69,7 @@ counter across phases, the done frames' ``attn_backend`` field
     python loadtest/generation_serving.py --sharded [--tp 4]
     python loadtest/generation_serving.py --speculative [--spec-k 4]
     python loadtest/generation_serving.py --attn-backend paged
+    python loadtest/generation_serving.py --token-latency
 """
 
 import argparse
@@ -113,6 +123,14 @@ def build_argparser():
                          "a real router; asserts the snapshot "
                          "backend, bytes-counter monotonicity and "
                          "well-formed streams")
+    ap.add_argument("--token-latency", action="store_true",
+                    help="ISSUE 16 verdict: the replica exports metric "
+                         "shards (OBS_EXPORT_DIR), streams run through "
+                         "a real router, and the router-mirrored "
+                         "X-TTFT-Ms header must agree with every done "
+                         "frame while a fleet metrics hub over the "
+                         "shard dir shows non-empty ITG percentiles "
+                         "from the subprocess pod")
     return ap
 
 
@@ -138,6 +156,13 @@ def spawn_server(args):
                    GEN_DRAFT_DAMPEN="0.02")
     if args.attn_backend:
         env["GEN_ATTN_BACKEND"] = args.attn_backend
+    if getattr(args, "obs_dir", None):
+        # --token-latency: the replica's ModelServer auto-starts a
+        # shard exporter when OBS_EXPORT_DIR resolves — the hub side
+        # of the verdict reads these files
+        env.update(OBS_EXPORT_DIR=args.obs_dir,
+                   OBS_EXPORT_INTERVAL="0.5",
+                   OBS_POD_NAME="gen-pod-0")
     proc = subprocess.Popen(
         [sys.executable, "-m", "kubeflow_tpu.cmd", "model-server"],
         stdout=subprocess.PIPE, env=env, text=True)
@@ -190,6 +215,7 @@ def run_one(port, tokens, max_tokens):
     skip_header = resp.headers.get("X-Prefix-Tokens-Skipped")
     mesh_header = resp.headers.get("X-Generate-Mesh")
     spec_header = resp.headers.get("X-Spec-Acceptance")
+    ttft_header = resp.headers.get("X-TTFT-Ms")
     conn.close()
     toks = [f["token"] for f in frames if "token" in f]
     final = frames[-1]
@@ -203,7 +229,8 @@ def run_one(port, tokens, max_tokens):
                for f in frames if "token" in f), "multi-token frame"
     return {"tokens": toks, "first_s": first_s, "total_s": total_s,
             "final": final, "skip_header": skip_header,
-            "mesh_header": mesh_header, "spec_header": spec_header}
+            "mesh_header": mesh_header, "spec_header": spec_header,
+            "ttft_header": ttft_header}
 
 
 def scrape_occupancy(port):
@@ -521,6 +548,106 @@ def run_speculative(args, port):
         core.stop()
 
 
+def run_token_latency(args, port):
+    """The --token-latency verdict (ISSUE 16): streams driven THROUGH
+    a real in-process model-router must carry a router-mirrored
+    ``X-TTFT-Ms`` head that agrees with each done frame's ``ttft_s``
+    (both render the same rounded value), every multi-token done frame
+    must carry the ITG summary fields, and a REAL fleet metrics hub
+    pointed at the subprocess replica's shard directory must serve a
+    ``/debug/generate`` view with non-empty ITG percentiles attributed
+    to the subprocess pod."""
+    from kubeflow_tpu.web import metrics_hub, router as router_lib
+
+    core = router_lib.RouterCore(health_interval=0.3)
+    core.set_backends([f"127.0.0.1:{port}"])
+    app = router_lib.create_app(core=core)
+    httpd = app.serve(port=0, host="127.0.0.1")
+    router_port = httpd.server_address[1]
+    hub_httpd = None
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            snap = core.snapshot()
+            if snap and snap[0]["healthy"]:
+                break
+            time.sleep(0.05)
+        else:
+            raise SystemExit("replica never turned healthy via the "
+                             "router")
+        specs = prompt_set(args)
+        for plen in sorted({len(p) for p, _ in specs}):
+            run_one(router_port, [(997 * plen + j) % 500 + 1
+                                  for j in range(plen)], 2)
+        phase, results = run_phase(router_port, specs,
+                                   concurrent=True, metrics_port=port)
+        # head <-> done frame agreement, per stream and exact: both
+        # sides render round(ttft_s, 6)
+        header_ok = all(
+            r["ttft_header"] is not None
+            and r["final"].get("ttft_s") is not None
+            and abs(float(r["ttft_header"]) / 1000.0
+                    - r["final"]["ttft_s"]) < 1e-6
+            for r in results)
+        itg_frames_ok = all(
+            r["final"].get("itg_p50_s") is not None
+            and r["final"].get("itg_max_s") is not None
+            and r["final"]["itg_max_s"] >= r["final"]["itg_p50_s"]
+            for r in results if len(r["tokens"]) > 1)
+
+        # the fleet hub over the replica's REAL shard directory: poll
+        # until the exporter's next flush lands the ITG samples
+        hub_app = metrics_hub.create_app(shard_dir=args.obs_dir)
+        hub_httpd = hub_app.serve(port=0, host="127.0.0.1")
+        hub_port = hub_httpd.server_address[1]
+        view = {}
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            conn = http.client.HTTPConnection("127.0.0.1", hub_port,
+                                              timeout=30)
+            conn.request("GET", "/debug/generate")
+            view = json.loads(conn.getresponse().read())
+            conn.close()
+            lm = view.get("models", {}).get("lm", {})
+            if (lm.get("itg") or {}).get("count"):
+                break
+            time.sleep(0.5)
+        lm = view.get("models", {}).get("lm", {})
+        hub_itg = lm.get("itg") or {}
+        hub_ttft = lm.get("ttft") or {}
+        pod_view = (lm.get("pods") or {}).get("gen-pod-0", {})
+        report = {
+            "mode": "token-latency", "transport": args.transport,
+            "slots": args.slots, "prompts": len(specs),
+            "concurrent": phase,
+            "hub_ttft": hub_ttft, "hub_itg": hub_itg,
+            "pod_view": pod_view,
+            "checks": {
+                "router_mirrors_ttft_header_exactly": header_ok,
+                "done_frames_carry_itg_summary": itg_frames_ok,
+                "hub_itg_percentiles_nonempty":
+                    bool(hub_itg.get("count"))
+                    and hub_itg.get("p50_ms") is not None
+                    and hub_itg.get("p99_ms") is not None,
+                "hub_ttft_percentiles_nonempty":
+                    bool(hub_ttft.get("count"))
+                    and hub_ttft.get("p95_ms") is not None,
+                "hub_attributes_subprocess_pod":
+                    (pod_view.get("itg") or {}).get("p50_ms")
+                    is not None,
+                "streams_well_formed": True,    # run_one asserted
+            }}
+        print(json.dumps(report, indent=2))
+        if not all(report["checks"].values()):
+            raise SystemExit("token-latency generation loadtest "
+                             "FAILED")
+    finally:
+        if hub_httpd is not None:
+            hub_httpd.shutdown()
+        httpd.shutdown()
+        core.stop()
+
+
 def scrape_attn_bytes(port, backend):
     """→ serving_generate_attn_bytes_read_total{backend=...} value."""
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
@@ -618,6 +745,10 @@ def main(argv=None):
     args = build_argparser().parse_args(argv)
     if args.sharded:
         os.environ.setdefault("GEN_CALIBRATE", "1")
+    args.obs_dir = None
+    if args.token_latency:
+        import tempfile
+        args.obs_dir = tempfile.mkdtemp(prefix="gen-obs-")
     proc, port = spawn_server(args)
     try:
         if args.sharded:
@@ -631,6 +762,9 @@ def main(argv=None):
             return
         if args.attn_backend:
             run_attn_backend(args, port)
+            return
+        if args.token_latency:
+            run_token_latency(args, port)
             return
         specs = prompt_set(args)
         # warm every prompt-length bucket + the decode program OUTSIDE

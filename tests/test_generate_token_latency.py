@@ -1,0 +1,429 @@
+"""Token-level serving telemetry (ISSUE 16).
+
+Contract under test:
+
+- every emitted token is wall-clock stamped and per-handle timestamps
+  are monotone even across mid-batch evictions and admissions,
+- EMISSION-EVENT semantics: a speculative verify round's burst of
+  1..k+1 tokens shares ONE emission event, so the per-request ITG
+  sample count equals emission events - 1 (== verify rounds), NOT
+  tokens - 1,
+- TTFT decomposes as queue wait + prefill (same phases the trace
+  records) within tolerance, measured over a real HTTP stream on BOTH
+  transports,
+- the done frame's ``ttft_s``, the response head's router-mirrorable
+  ``X-TTFT-Ms`` header and the ``serving_generate_ttft_seconds``
+  histogram agree three ways on one request,
+- queue-side 504s book their wait into
+  ``serving_generate_queue_wait_seconds{outcome="expired"}``,
+- snapshot exposes per-slot ``slot_detail`` (age / tokens /
+  deadline-remaining / last-emit age) and the lifecycle ``timeline``
+  ring; lifecycle events also land as zero-duration marker phases on
+  the request's trace,
+- the generate-itg default SLO flips to ``burning`` on an injected
+  slow-ITG burst through the real BurnRateEngine,
+- the fleet hub's ``/debug/generate`` merges two pods' shard files
+  into fleet percentiles with a per-pod breakdown.
+"""
+
+import http.client
+import json
+import time
+
+import jax
+import pytest
+
+from kubeflow_tpu.compute import generate as gen_lib
+from kubeflow_tpu.compute import serving
+from kubeflow_tpu.compute.models import transformer
+from kubeflow_tpu.obs import export as export_lib
+from kubeflow_tpu.obs import metrics as obs_metrics
+from kubeflow_tpu.obs import slo as slo_lib
+from kubeflow_tpu.obs import tracing
+from kubeflow_tpu.web import http as web_http
+from kubeflow_tpu.web import metrics_hub
+
+CFG = transformer.Config(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, max_seq=64,
+    dtype="float32", attention="dense", remat=False, scan_layers=True)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _engine(params, **kw):
+    kw.setdefault("max_slots", 1)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_context", 64)
+    kw.setdefault("name", "lm")
+    return gen_lib.GenerationEngine(params, CFG, **kw)
+
+
+@pytest.fixture(scope="module", params=["threaded", "async"])
+def served(request, params):
+    engine = _engine(params)
+    server = serving.ModelServer()
+    server.register_generator("lm", engine)
+    port = server.start(port=0, host="127.0.0.1",
+                        transport=request.param)
+    yield request.param, server, engine, port
+    server.stop()
+
+
+def _post_generate(port, body, headers=None, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    conn.request("POST", "/v1/models/lm:generate",
+                 json.dumps(body).encode(), hdrs)
+    return conn, conn.getresponse()
+
+
+def _frames(resp):
+    return [json.loads(ln) for ln in resp.read().splitlines()
+            if ln.strip()]
+
+
+def _hist(metric, *labels):
+    return metric.samples().get(tuple(labels),
+                                {"buckets": [], "sum": 0.0, "count": 0})
+
+
+class TestEmissionBookkeeping:
+    def test_monotone_token_times_across_evict_admit(self, params):
+        """Four prompts through two slots with uneven max_tokens force
+        mid-batch evictions and re-admissions; every handle's per-token
+        wall stamps stay monotone and 1:1 with its tokens, and the
+        lifecycle ring tells the admit -> first_token -> evict story
+        in timestamp order."""
+        engine = _engine(params, max_slots=2)
+        try:
+            specs = [([1, 2, 3], 10), ([4, 5], 3),
+                     ([6, 7, 8, 9], 6), ([10, 11], 4)]
+            handles = [engine.submit(p, max_tokens=m)
+                       for p, m in specs]
+            for h, (_, m) in zip(handles, specs):
+                toks, reason = h.result(timeout=120)
+                assert reason == "length" and len(toks) == m
+            events = engine.timeline_view()
+        finally:
+            engine.close()
+        for h in handles:
+            assert len(h.token_times) == len(h.out_tokens)
+            assert all(b >= a for a, b in
+                       zip(h.token_times, h.token_times[1:]))
+            assert h.ttft_s is not None and h.ttft_s > 0
+            # plain engine: one emission event per token
+            assert len(h.itg_gaps) == len(h.out_tokens) - 1
+
+        assert all(b["ts"] >= a["ts"] for a, b in
+                   zip(events, events[1:]))
+        by_req = {}
+        for e in events:
+            by_req.setdefault(e["request"], {})[e["event"]] = e
+        for h in handles:
+            story = by_req[h.seq]
+            assert {"admitted", "prefill", "first_token",
+                    "evicted"} <= set(story)
+            assert story["admitted"]["ts"] <= \
+                story["first_token"]["ts"] <= story["evicted"]["ts"]
+            assert story["evicted"]["reason"] == "length"
+            assert story["evicted"]["tokens"] == len(h.out_tokens)
+            assert story["first_token"]["ttft_s"] == \
+                pytest.approx(h.ttft_s, abs=1e-5)
+
+    def test_lifecycle_events_land_as_trace_marker_spans(self, params):
+        """A sampled request's trace carries zero-duration
+        ``generate.slot<i>.<event>`` marker phases — the per-slot lane
+        /debug/traces renders."""
+        engine = _engine(params)
+        try:
+            buf = tracing.TraceBuffer(64)
+            rt = tracing.RequestTrace(
+                "http POST /v1/models/lm:generate", sample_rate=1.0)
+            h = engine.submit([1, 2, 3], max_tokens=4, rt=rt)
+            h.result(timeout=120)
+        finally:
+            engine.close()
+        rt.finish(buffer=buf)
+        names = {s["name"] for s in buf.span_dicts()}
+        assert "generate.slot0.admitted" in names
+        assert "generate.slot0.prefill" in names
+        assert "generate.slot0.first_token" in names
+        assert "generate.slot0.evicted" in names
+        markers = [s for s in buf.span_dicts()
+                   if s["name"].startswith("generate.slot0.")]
+        assert all(s["duration_ms"] == 0 for s in markers)
+
+    def test_snapshot_slot_detail_and_timeline(self, params):
+        engine = _engine(params)
+        engine._step_sleep = 0.02
+        try:
+            h = engine.submit([1, 2, 3], max_tokens=40,
+                              deadline=time.monotonic() + 120)
+            deadline = time.time() + 60
+            while len(h.token_times) < 2 and time.time() < deadline:
+                time.sleep(0.005)
+            assert len(h.token_times) >= 2
+            snap = engine.snapshot()
+            detail = [d for d in snap["slot_detail"] if d is not None]
+            assert len(detail) == 1
+            d = detail[0]
+            assert d["request"] == h.seq
+            assert d["tokens_emitted"] >= 2
+            assert d["age_s"] >= 0
+            assert 0 < d["deadline_remaining_s"] <= 120
+            assert d["last_emit_age_s"] >= 0
+            assert snap["slots"] == 1      # stays an int (pinned)
+            assert any(e["event"] == "admitted"
+                       for e in snap["timeline"])
+        finally:
+            engine._step_sleep = 0.0
+        try:
+            h.result(timeout=120)
+            snap = engine.snapshot()
+            assert all(s is None for s in snap["slot_detail"])
+        finally:
+            engine.close()
+
+    def test_expired_queue_wait_books_outcome_label(self, params):
+        """A queue-side 504 still books its wait — with
+        ``outcome="expired"`` so overload queue time is not
+        survivorship-biased toward admitted requests."""
+        engine = _engine(params, max_slots=1)
+        engine._step_sleep = 0.05
+        try:
+            before = _hist(gen_lib._QUEUE_WAIT_SECONDS,
+                           "lm", "expired")["count"]
+            blocker = engine.submit([1, 2, 3], max_tokens=20)
+            doomed = engine.submit(
+                [4, 5, 6], max_tokens=5,
+                deadline=time.monotonic() + 0.05)
+            with pytest.raises(serving.DeadlineExceededError):
+                doomed.result(timeout=60)
+            after = _hist(gen_lib._QUEUE_WAIT_SECONDS,
+                          "lm", "expired")
+            assert after["count"] == before + 1
+            assert doomed.ttft_s is None and doomed.token_times == []
+        finally:
+            engine._step_sleep = 0.0
+        try:
+            blocker.result(timeout=120)
+        finally:
+            engine.close()
+
+
+class TestSpeculativeBurstSemantics:
+    def test_one_gap_per_verify_round(self, params):
+        """draft == target -> every proposal accepted, so each verify
+        round bursts k+1 tokens. The burst is ONE emission event: ITG
+        samples == emission events - 1 == verify rounds, strictly
+        fewer than tokens - 1."""
+        engine = _engine(params, draft_params=params,
+                         draft_config=CFG, spec_k=3)
+        itg_before = _hist(gen_lib._INTER_TOKEN_SECONDS,
+                           "lm")["count"]
+        try:
+            h = engine.submit([1, 2, 3, 4], max_tokens=13)
+            toks, reason = h.result(timeout=120)
+            rounds = [e for e in engine.timeline_view()
+                      if e["event"] == "spec_round"
+                      and e["request"] == h.seq]
+        finally:
+            engine.close()
+        assert reason == "length" and len(toks) == 13
+        assert h.spec_rounds > 0
+        assert len(h.token_times) == len(toks)
+        assert all(b >= a for a, b in
+                   zip(h.token_times, h.token_times[1:]))
+        # the single-gap contract, per handle and in the histogram
+        assert len(h.itg_gaps) == h.spec_rounds
+        assert len(h.itg_gaps) < len(toks) - 1
+        itg_after = _hist(gen_lib._INTER_TOKEN_SECONDS, "lm")["count"]
+        assert itg_after - itg_before == len(h.itg_gaps)
+        # timeline recorded the per-round accept economics
+        assert len(rounds) == h.spec_rounds
+        assert all(0 <= e["accepted"] <= e["proposed"]
+                   for e in rounds)
+
+
+class TestWireAgreement:
+    def test_ttft_decomposes_and_agrees_three_ways(self, served):
+        """Over a real HTTP stream (both transports): the done frame's
+        ttft_s == queue wait + prefill within tolerance, the X-TTFT-Ms
+        head agrees with the frame exactly (same rounded value), and
+        the TTFT histogram took exactly that one sample."""
+        _transport, _server, engine, port = served
+        qw0 = _hist(gen_lib._QUEUE_WAIT_SECONDS,
+                    "lm", "admitted")["sum"]
+        pf0 = _hist(gen_lib._PREFILL_SECONDS, "lm")["sum"]
+        tt0 = _hist(gen_lib._TTFT_SECONDS, "lm")
+        itg0 = _hist(gen_lib._INTER_TOKEN_SECONDS, "lm")["count"]
+
+        conn, resp = _post_generate(
+            port, {"tokens": [1, 2, 3], "max_tokens": 6})
+        assert resp.status == 200
+        header_ms = resp.headers.get("X-TTFT-Ms")
+        frames = _frames(resp)
+        conn.close()
+
+        final = frames[-1]
+        assert final["done"]
+        assert final["ttft_s"] is not None and final["ttft_s"] > 0
+        assert final["itg_p50_s"] is not None
+        assert final["itg_max_s"] >= final["itg_p50_s"]
+
+        # head <-> frame: both render round(ttft, 6)
+        assert header_ms is not None
+        assert float(header_ms) == pytest.approx(
+            final["ttft_s"] * 1000, abs=1e-6)
+
+        # frame <-> histogram: one new sample of the same value
+        tt1 = _hist(gen_lib._TTFT_SECONDS, "lm")
+        assert tt1["count"] - tt0["count"] == 1
+        assert tt1["sum"] - tt0["sum"] == pytest.approx(
+            final["ttft_s"], abs=1e-5)
+
+        # decomposition: ttft == queue wait + prefill (+ epsilon for
+        # the slot bookkeeping between prefill end and first emit)
+        qw1 = _hist(gen_lib._QUEUE_WAIT_SECONDS,
+                    "lm", "admitted")["sum"]
+        pf1 = _hist(gen_lib._PREFILL_SECONDS, "lm")["sum"]
+        parts = (qw1 - qw0) + (pf1 - pf0)
+        assert final["ttft_s"] >= parts - 1e-4
+        assert final["ttft_s"] == pytest.approx(parts, abs=0.25)
+
+        # 6 tokens on a plain engine -> exactly 5 gap samples
+        itg1 = _hist(gen_lib._INTER_TOKEN_SECONDS, "lm")["count"]
+        assert itg1 - itg0 == 5
+
+    def test_single_token_request_has_null_itg(self, served):
+        """One emission event -> no gap: the done frame's ITG fields
+        are null, TTFT is still set."""
+        _transport, _server, _engine_, port = served
+        conn, resp = _post_generate(
+            port, {"tokens": [7, 8, 9], "max_tokens": 1})
+        assert resp.status == 200
+        assert resp.headers.get("X-TTFT-Ms") is not None
+        final = _frames(resp)[-1]
+        conn.close()
+        assert final["done"]
+        assert final["ttft_s"] > 0
+        assert final["itg_p50_s"] is None
+        assert final["itg_max_s"] is None
+
+
+class TestSloBurnFlip:
+    def test_slow_itg_burst_flips_generate_itg_to_burning(self):
+        """The shipped generate-itg SLO through the real burn-rate
+        engine: healthy 2 ms gaps keep it ok; an injected burst of
+        800 ms gaps blows the 1% budget in both windows and flips it
+        to burning; a later healthy window un-gates the fast burn and
+        it recovers."""
+        itg_slo = next(s for s in slo_lib.default_slos()
+                       if s.name == "generate-itg")
+        # the threshold must stay aligned with a real bucket bound or
+        # the cumulative-bucket ratio stops being exact
+        assert itg_slo.threshold_s in gen_lib._INTER_TOKEN_SECONDS.buckets
+        ttft_slo = next(s for s in slo_lib.default_slos()
+                        if s.name == "generate-ttft")
+        assert ttft_slo.threshold_s in gen_lib._TTFT_SECONDS.buckets
+
+        reg = obs_metrics.Registry()
+        hist = reg.histogram(
+            "serving_generate_inter_token_seconds", "probe",
+            ("model",), buckets=gen_lib._INTER_TOKEN_SECONDS.buckets)
+        engine = slo_lib.BurnRateEngine(
+            [itg_slo], fast_window=10, slow_window=60,
+            burn_threshold=14.4)
+        t0 = 1000.0
+
+        for _ in range(200):
+            hist.labels("lm").observe(0.002)
+        engine.observe(slo_lib.samples_from_registry(reg), now=t0)
+        status = engine.observe(slo_lib.samples_from_registry(reg),
+                                now=t0 + 5)
+        assert status[0]["slo"] == "generate-itg"
+        assert status[0]["state"] == "ok"
+
+        for _ in range(100):
+            hist.labels("lm").observe(0.8)   # injected slow burst
+        status = engine.observe(slo_lib.samples_from_registry(reg),
+                                now=t0 + 9)
+        assert status[0]["state"] == "burning"
+        assert status[0]["burn_rate"]["fast"] >= 14.4
+        assert status[0]["burn_rate"]["slow"] >= 14.4
+
+        # recovery: a healthy fast window un-gates the AND
+        for _ in range(500):
+            hist.labels("lm").observe(0.002)
+        engine.observe(slo_lib.samples_from_registry(reg),
+                       now=t0 + 30)
+        status = engine.observe(slo_lib.samples_from_registry(reg),
+                                now=t0 + 45)
+        assert status[0]["state"] == "ok"
+
+
+def _write_shard(tmp_path, pod, ttft_obs, itg_obs):
+    """A minimal shard file with real TYPE lines (untyped series merge
+    as gauges and drop out of merged_samples)."""
+    ttft_b = gen_lib._TTFT_SECONDS.buckets
+    itg_b = gen_lib._INTER_TOKEN_SECONDS.buckets
+    lines = [export_lib.format_header(pod, 1000.0, time.time())]
+
+    def emit(name, bounds, obs):
+        lines.append(f"# TYPE {name} histogram")
+        for le in bounds:
+            n = sum(1 for v in obs if v <= le)
+            lines.append(f'{name}_bucket{{model="lm",le="{le:g}"}} {n}')
+        lines.append(f'{name}_bucket{{model="lm",le="+Inf"}} '
+                     f'{len(obs)}')
+        lines.append(f'{name}_sum{{model="lm"}} {sum(obs):g}')
+        lines.append(f'{name}_count{{model="lm"}} {len(obs)}')
+
+    emit("serving_generate_ttft_seconds", ttft_b, ttft_obs)
+    emit("serving_generate_inter_token_seconds", itg_b, itg_obs)
+    lines.append("# TYPE serving_generate_tokens_total counter")
+    lines.append(f'serving_generate_tokens_total{{model="lm"}} '
+                 f'{len(itg_obs) + len(ttft_obs)}')
+    (tmp_path / f"{pod}.prom").write_text("\n".join(lines) + "\n")
+
+
+class TestFleetDebugGenerate:
+    def test_hub_merges_two_pods(self, tmp_path):
+        _write_shard(tmp_path, "pod-a",
+                     ttft_obs=[0.04] * 5, itg_obs=[0.004] * 50)
+        _write_shard(tmp_path, "pod-b",
+                     ttft_obs=[0.2] * 5, itg_obs=[0.02] * 50)
+        client = web_http.TestClient(
+            metrics_hub.create_app(shard_dir=str(tmp_path)))
+        # the hub's own process registry rides the merge as a synthetic
+        # local shard; earlier tests in this process may have booked
+        # samples there, so assert fleet counts as shard + local
+        local_ttft = _hist(gen_lib._TTFT_SECONDS, "lm")["count"]
+        local_itg = _hist(gen_lib._INTER_TOKEN_SECONDS, "lm")["count"]
+        r = client.get("/debug/generate")
+        assert r.status == 200
+        lm = r.json["models"]["lm"]
+        # fleet aggregate: counts merged across both pods
+        assert lm["ttft"]["count"] == 10 + local_ttft
+        assert lm["itg"]["count"] == 100 + local_itg
+        assert lm["ttft"]["p50_ms"] is not None
+        assert lm["itg"]["p99_ms"] is not None
+        assert lm["tokens_total"] >= 110
+        # per-pod breakdown: the slow replica stands out
+        assert set(lm["pods"]) == {"pod-a", "pod-b"}
+        assert lm["pods"]["pod-a"]["ttft"]["count"] == 5
+        assert lm["pods"]["pod-b"]["ttft"]["count"] == 5
+        assert lm["pods"]["pod-a"]["itg"]["p50_ms"] < \
+            lm["pods"]["pod-b"]["itg"]["p50_ms"]
+
+    def test_index_links_debug_generate(self, tmp_path):
+        client = web_http.TestClient(
+            metrics_hub.create_app(shard_dir=str(tmp_path)))
+        r = client.get("/")
+        assert r.status == 200
+        assert b"debug/generate" in r.body

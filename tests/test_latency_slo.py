@@ -301,7 +301,14 @@ class TestPredictAnatomy:
             from kubeflow_tpu.compute.serving import (
                 _DEADLINE_EXCEEDED, _REQUESTS_TOTAL)
             assert _DEADLINE_EXCEEDED.value("anatomy-dl") == 1
-            # the SLO source counts both outcomes by final status
+            # the SLO source counts both outcomes by final status.
+            # The count lands in the handler's finally AFTER the
+            # response bytes hit the wire, so briefly poll — the
+            # client can observe the 504 first
+            deadline = time.monotonic() + 2
+            while (_REQUESTS_TOTAL.value("anatomy-dl", "504") < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
             assert _REQUESTS_TOTAL.value("anatomy-dl", "200") >= 1
             assert _REQUESTS_TOTAL.value("anatomy-dl", "504") == 1
             # malformed header is the caller's fault
@@ -539,6 +546,7 @@ class TestBurnRateEngine:
 
     def test_default_slos_point_at_registered_families(self):
         # import side effects register the families the defaults read
+        from kubeflow_tpu.compute import generate   # noqa: F401
         from kubeflow_tpu.compute import serving    # noqa: F401
         from kubeflow_tpu.sched import controller   # noqa: F401
         families = {m.name for m in obsm.REGISTRY._metrics}
