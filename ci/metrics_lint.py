@@ -54,6 +54,7 @@ def main():
     import kubeflow_tpu.core.workqueue        # noqa: F401
     import kubeflow_tpu.obs.aggregate         # noqa: F401
     import kubeflow_tpu.obs.slo               # noqa: F401
+    import kubeflow_tpu.qos.buckets           # noqa: F401
     import kubeflow_tpu.sched.controller      # noqa: F401
     import kubeflow_tpu.web.http              # noqa: F401
     import kubeflow_tpu.web.router            # noqa: F401
@@ -167,6 +168,18 @@ def main():
         "serving_generate_ttft_seconds",
         "serving_generate_inter_token_seconds",
         "serving_generate_emitted_tokens",
+        # multi-tenant token economy (ISSUE 17): per-tenant spend /
+        # throttle / latency families plus the engine's preemptible-
+        # decoding counters — what the router's QoS gate, the hub's
+        # per-tenant /debug/generate breakdown, bench.py generate
+        # --qos and loadtest --qos read
+        "serving_qos_tokens_total",
+        "serving_qos_throttled_total",
+        "serving_qos_ttft_seconds",
+        "serving_qos_inter_token_seconds",
+        "serving_qos_preemptions_total",
+        "serving_generate_preemptions_total",
+        "serving_generate_resume_prefill_tokens_total",
     }
     registered = {metric.name for metric in obs_metrics.REGISTRY._metrics}
     scratch_names = {metric.name for metric in scratch._metrics}

@@ -102,10 +102,18 @@ WEB_APPS = {
     # ROUTER_BACKENDS pins a static replica set for environments
     # without the controller; the health interval is the poll cadence
     # for both membership sync and /healthz.
+    # QOS_TENANTS (JSON tenant -> {rate, burst, class, cohort}) is the
+    # multi-tenant token economy's single config surface: the router's
+    # gate (429 + Retry-After) and each replica's engine (priority
+    # admission + preemptible decoding) build their ledgers from the
+    # same spec. ROUTER_ALERTS_URL points at the metrics hub's
+    # /api/alerts so burning token-latency SLOs shed batch-class load.
     "model-router": {"image": PLATFORM_IMAGE,
                      "port": 8500, "prefix": "/serving",
                      "env": {"ROUTER_BACKENDS": "",
-                             "ROUTER_HEALTH_INTERVAL": "2.0"}},
+                             "ROUTER_HEALTH_INTERVAL": "2.0",
+                             "QOS_TENANTS": "",
+                             "ROUTER_ALERTS_URL": ""}},
     "access-management": {"image": PLATFORM_IMAGE,
                           "port": 8081, "prefix": "/kfam"},
     "centraldashboard": {"image": PLATFORM_IMAGE,

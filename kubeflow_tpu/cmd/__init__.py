@@ -234,6 +234,15 @@ def slice_worker(argv=()):
     raise SystemExit(sw.main(list(argv)))
 
 
+def _gen_qos_ledger():
+    """The replica's own token ledger from ``QOS_TENANTS`` — None
+    when unset so the engine skips every ledger branch."""
+    if not (os.environ.get("QOS_TENANTS") or "").strip():
+        return None
+    from ..qos import buckets
+    return buckets.from_env()
+
+
 def model_server(argv=()):
     """One ModelDeployment replica: a ModelServer on the async
     transport (SERVING_TRANSPORT overrides), serving MODEL_NAME. The
@@ -337,6 +346,14 @@ def model_server(argv=()):
             # read); loadtest --attn-backend drives this end to end
             attn_backend=os.environ.get("GEN_ATTN_BACKEND", "gather")
             or "gather",
+            # tenancy: QOS_TENANTS gives the engine its own copy of
+            # the token ledger (the router holds another — same env
+            # spec, different process); GEN_PREEMPTION=0 restores the
+            # strict-FIFO, never-suspend engine
+            qos=_gen_qos_ledger(),
+            preemption=os.environ.get(
+                "GEN_PREEMPTION", "1").lower() not in (
+                "0", "false", "no", "off"),
             name=name)
         if os.environ.get("GEN_CALIBRATE", "").lower() in (
                 "1", "true", "yes", "on"):

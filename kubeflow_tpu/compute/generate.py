@@ -137,6 +137,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..obs import metrics as obs_metrics
+from ..qos import buckets as qos_lib
 from . import attention as attn_lib
 from . import mesh as mesh_lib
 from . import quantize as quantize_lib
@@ -189,6 +190,23 @@ _EVICTIONS_TOTAL = obs_metrics.REGISTRY.counter(
     "mechanism of token-level continuous batching, so eos/length here "
     "are normal completions, not failures",
     ("model", "reason"))
+_PREEMPTIONS_TOTAL = obs_metrics.REGISTRY.counter(
+    "serving_generate_preemptions_total",
+    "Low-QoS slots SUSPENDED mid-decode to make room for a higher-"
+    "class admission, by the resource the suspension freed (slot = "
+    "no free decode slot, blocks = free slot but not enough cache "
+    "blocks) — the suspended stream's pages are cache-retained and "
+    "the request re-queues for a prefix-cached resume, so this is a "
+    "pause, not a failure",
+    ("model", "reason"))
+_RESUME_PREFILL_TOKENS = obs_metrics.REGISTRY.counter(
+    "serving_generate_resume_prefill_tokens_total",
+    "Suffix tokens actually re-prefilled when a preempted request "
+    "resumed — the cache-miss cost of resume. Compare with the "
+    "resumed prompts' full extended length (prompt + tokens emitted "
+    "before suspension): the gap is the prefill the retained pages "
+    "saved",
+    ("model",))
 _PREFIX_HITS_TOTAL = obs_metrics.REGISTRY.counter(
     "serving_generate_prefix_hits_total",
     "Admissions whose prompt matched >=1 full cached block in the "
@@ -337,7 +355,10 @@ class GenerationHandle:
                  "prefill_seconds", "spec_rounds", "spec_proposed",
                  "spec_accepted", "spec_wire", "logits", "seq",
                  "ttft_s", "token_times", "itg_gaps", "last_emit",
-                 "admitted_w", "_engine", "_done")
+                 "admitted_w", "tenant", "qos_class", "preemptible",
+                 "on_event", "suspended", "preemptions",
+                 "resume_prefill_tokens", "_qos_charged",
+                 "_qos_deferred", "_engine", "_done")
 
     def __init__(self, prompt, max_tokens, eos_id, deadline,
                  on_token, on_done, rt):
@@ -382,6 +403,30 @@ class GenerationHandle:
         self.last_emit = None     # perf_counter of the last emission
         #                           event (the running end of the gap)
         self.admitted_w = None    # wall clock at admission (slot age)
+        self.tenant = None        # X-Tenant attribution (qos ledger +
+        #                           serving_qos_* families); None =
+        #                           anonymous, no per-tenant metering
+        self.qos_class = qos_lib.DEFAULT_CLASS   # batch < standard <
+        #                           interactive: admission priority,
+        #                           and preemption rank under pressure
+        self.preemptible = True   # may this request's slot be
+        #                           suspended for a higher class?
+        self.on_event = None      # mid-stream lifecycle callback —
+        #                           (event, attrs) for "suspended" /
+        #                           "resumed"; transports relay these
+        #                           as NDJSON event frames
+        self.suspended = False    # currently preempted: re-queued,
+        #                           pages cache-retained, waiting for
+        #                           a resume admission
+        self.preemptions = 0      # times this request was suspended
+        self.resume_prefill_tokens = 0   # suffix tokens re-prefilled
+        #                           across all resumes (the paid part
+        #                           of the resume cost model)
+        self._qos_charged = False  # engine-ledger prepay latch (a
+        self._qos_deferred = False  # resume must not re-charge); the
+        #                           deferred latch books one throttle
+        #                           sample per queue stint, not one
+        #                           per engine-loop pass
         self.enqueued = time.perf_counter()
         self.enqueued_w = time.time()
         self._engine = None       # set by submit(); result(timeout)
@@ -498,7 +543,7 @@ class GenerationEngine:
                  default_max_tokens=64, admission="continuous",
                  prefix_cache=True, mesh=None, draft_params=None,
                  draft_config=None, spec_k=0, debug_logits=False,
-                 attn_backend="gather"):
+                 attn_backend="gather", qos=None, preemption=True):
         if config.moe_experts or config.pipeline_stages > 1:
             raise ValueError(
                 "GenerationEngine supports dense TransformerLM configs "
@@ -578,6 +623,15 @@ class GenerationEngine:
         self.default_max_tokens = int(default_max_tokens)
         self.kv_dtype = kv_dtype
         self.admission = admission
+        # multi-tenant token economy (qos/): the optional ledger gates
+        # admission on the tenant's token bucket (worst-case prepay,
+        # deferred — not failed — while the bucket refills) and names
+        # each tenant's class; `preemption` enables the QoS admission
+        # order AND preemptible decoding. preemption=False restores
+        # the exact pre-QoS engine: strict FIFO, no suspensions — the
+        # baseline `bench.py generate --qos` measures against.
+        self._qos = qos
+        self.preemption = bool(preemption)
         self.max_slots = int(max_slots)
         self.block_size = int(block_size)
         self.max_context = int(max_context or config.max_seq)
@@ -734,7 +788,9 @@ class GenerationEngine:
                       "collective_share": 0.0, "spec_rounds": 0,
                       "spec_proposed": 0, "spec_accepted": 0,
                       "decode_seconds_total": 0.0,
-                      "attn_bytes_read": 0}
+                      "attn_bytes_read": 0,
+                      "preemptions": 0, "resumes": 0,
+                      "resume_prefill_tokens": 0, "qos_deferrals": 0}
         self.thread = threading.Thread(target=self._loop, daemon=True,
                                        name=f"generate-{name}")
         self.thread.start()
@@ -1035,6 +1091,10 @@ class GenerationEngine:
             _TTFT_SECONDS.labels(self.name).observe(
                 handle.ttft_s, trace_id=handle.rt.exemplar(
                     handle.ttft_s) if handle.rt is not None else None)
+            if handle.tenant is not None:
+                qos_lib.TTFT_SECONDS.labels(
+                    handle.tenant, handle.qos_class).observe(
+                        handle.ttft_s)
         else:
             gap = now - handle.last_emit
             handle.itg_gaps.append(gap)
@@ -1042,6 +1102,9 @@ class GenerationEngine:
             _INTER_TOKEN_SECONDS.labels(self.name).observe(
                 gap, trace_id=handle.rt.exemplar(gap)
                 if handle.rt is not None else None)
+            if handle.tenant is not None:
+                qos_lib.INTER_TOKEN_SECONDS.labels(
+                    handle.tenant, handle.qos_class).observe(gap)
         handle.last_emit = now
 
     def timeline_view(self, limit=None):
@@ -1066,6 +1129,19 @@ class GenerationEngine:
             "itg_max_s": round(max(gaps), 6) if gaps else None,
         }
 
+    def qos_view(self, handle):
+        """Per-request tenancy economics for the ``:generate`` done
+        frame — None for anonymous, never-preempted requests so the
+        default wire contract stays byte-identical."""
+        if handle.tenant is None and not handle.preemptions:
+            return None
+        return {
+            "tenant": handle.tenant,
+            "class": handle.qos_class,
+            "preemptions": handle.preemptions,
+            "resume_prefill_tokens": handle.resume_prefill_tokens,
+        }
+
     def ttft_header(self, handle):
         """``X-TTFT-Ms`` wire value, mirrored by the router: the SAME
         rounded ttft_s the done frame carries, in milliseconds, so a
@@ -1074,7 +1150,10 @@ class GenerationEngine:
         transports, which write the head after the first token."""
         if handle.ttft_s is None:
             return None
-        return f"{round(round(handle.ttft_s, 6) * 1000, 3):g}"
+        # shortest round-trip repr, not %g: a >=1s TTFT has 7
+        # significant digits at ms.3 precision and %g would shave the
+        # last one, breaking exact head<->frame agreement
+        return repr(round(round(handle.ttft_s, 6) * 1000, 3))
 
     def token_latency_stats(self):
         """Engine-level TTFT/ITG percentile summary from the bounded
@@ -1105,7 +1184,9 @@ class GenerationEngine:
     # ------------------------------------------------------ public API
 
     def submit(self, tokens, max_tokens=None, eos_id=None,
-               deadline=None, on_token=None, on_done=None, rt=None):
+               deadline=None, on_token=None, on_done=None, rt=None,
+               tenant=None, qos_class=None, preemptible=None,
+               on_event=None):
         """Enqueue one prompt → :class:`GenerationHandle`.
 
         ``tokens`` is the prompt as int token ids (this platform is
@@ -1143,8 +1224,24 @@ class GenerationEngine:
                 f"pool holds {self.num_blocks}; lower max_tokens or "
                 f"grow num_blocks")
         eos = self.eos_id if eos_id is None else int(eos_id)
+        if qos_class is None:
+            qos_class = (self._qos.class_of(tenant)
+                         if self._qos is not None
+                         else qos_lib.DEFAULT_CLASS)
+        if qos_class not in qos_lib.PRIORITY:
+            raise ValueError(
+                f"unknown qos class {qos_class!r} (expected one of "
+                f"{qos_lib.QOS_CLASSES})")
         handle = GenerationHandle(tokens, max_tokens, eos, deadline,
                                   on_token, on_done, rt)
+        handle.tenant = tenant
+        handle.qos_class = qos_class
+        # interactive never suspends by default — it IS the class the
+        # preemption exists to protect; any request may opt out/in
+        handle.preemptible = (qos_class != "interactive"
+                              if preemptible is None
+                              else bool(preemptible))
+        handle.on_event = on_event
         handle._engine = self     # result(timeout) cancels through it
         with self._cond:
             if self._draining or self._stop:
@@ -1230,6 +1327,9 @@ class GenerationEngine:
                         if h.deadline is not None else None,
                     "last_emit_age_s": round(now_pc - h.last_emit, 3)
                         if h.last_emit is not None else None,
+                    "tenant": h.tenant,
+                    "qos_class": h.qos_class,
+                    "preemptible": h.preemptible,
                 })
             return {
                 "slots": self.max_slots,
@@ -1568,18 +1668,83 @@ class GenerationEngine:
         _PREFIX_CACHED_BLOCKS.labels(self.name).set(
             len(self._node_by_block))
 
+    def _qos_priority(self, handle):
+        return qos_lib.PRIORITY.get(handle.qos_class, 1)
+
+    def _queue_candidate_locked(self):
+        """The next admission candidate (lock held). With
+        ``preemption`` on, the queue is PRIORITY-ordered: cancelled
+        entries first (cheap cleanup), then highest QoS class, FIFO
+        (submit order) within a class — a suspended request keeps its
+        original seq, so a resume outranks later arrivals of its own
+        class. Candidates whose tenant bucket cannot afford their
+        worst case right now are passed over (deferred, not failed),
+        so one over-budget tenant cannot head-of-line block the rest.
+        ``preemption=False`` restores plain FIFO head-of-line:
+        ``self._queue[0]``, full stop."""
+        if not self.preemption:
+            return self._queue[0]
+        best = best_key = None
+        for h in self._queue:
+            if h.cancelled:
+                return h
+            if self._qos is not None and h.tenant is not None \
+                    and not h._qos_charged \
+                    and not self._qos.fits(h.tenant, h.max_tokens):
+                if not h._qos_deferred:
+                    h._qos_deferred = True
+                    self.stats["qos_deferrals"] += 1
+                    qos_lib.THROTTLED_TOTAL.labels(h.tenant,
+                                                   "deferred").inc()
+                continue
+            key = (-self._qos_priority(h), h.seq)
+            if best is None or key < best_key:
+                best, best_key = h, key
+        return best
+
+    def _preempt_victim_locked(self, priority):
+        """The running slot to SUSPEND so a class-``priority``
+        admission can proceed (lock held): preemptible and strictly
+        lower class only — equal class never preempts (that would be
+        thrash, not priority). Lowest class first, youngest admission
+        within it (the least sunk progress is the cheapest pause).
+        None when nothing qualifies; cancelled slots are left for
+        _sweep_active's eviction."""
+        victim = victim_key = None
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            h = slot.handle
+            if not h.preemptible or h.cancelled:
+                continue
+            p = self._qos_priority(h)
+            if p >= priority:
+                continue
+            key = (p, -(h.admitted_w or 0.0))
+            if victim is None or key < victim_key:
+                victim, victim_key = i, key
+        return victim
+
     def _admit(self):
-        """Move queued prompts into free slots while capacity lasts.
-        FIFO head-of-line: a prompt too big for the current free pool
-        blocks later (smaller) prompts — predictable fairness over
-        packing cleverness. The head's prefix-cache match is computed
-        here so the reservation gate charges only its UNSHARED blocks
-        (matched zero-ref blocks leave the reclaimable pool when
-        pinned, so they're debited explicitly)."""
+        """Move queued prompts into slots while capacity lasts —
+        priority-ordered admission (``_queue_candidate_locked``)
+        replacing FIFO. A candidate too big for the current free pool
+        blocks lower-priority entries — predictable fairness over
+        packing cleverness. The candidate's prefix-cache match is
+        computed here so the reservation gate charges only its
+        UNSHARED blocks (matched zero-ref blocks leave the
+        reclaimable pool when pinned, so they're debited explicitly).
+
+        Preemptible decoding's trigger lives here: when the candidate
+        outranks a running preemptible slot and capacity (a slot, or
+        cache blocks) is short, that slot is SUSPENDED — cache-
+        retaining release + re-queue — and admission retries with the
+        freed capacity."""
         refilling = False    # drain policy: an empty batch REFILLS to
         #                      capacity in one admission round, then
         #                      no more admissions until it drains
         while True:
+            suspend = None
             with self._cond:
                 if not self._queue:
                     return
@@ -1587,22 +1752,53 @@ class GenerationEngine:
                 if self.admission == "drain" and occupied \
                         and not refilling:
                     return       # drain-then-refill baseline policy
+                handle = self._queue_candidate_locked()
+                if handle is None:
+                    return       # every candidate budget-deferred
                 free_slot = next((i for i, s in enumerate(self._slots)
                                   if s is None), None)
-                if free_slot is None:
-                    return
-                handle = self._queue[0]
                 matched = []
                 if not handle.cancelled:
-                    matched = self._match_prefix_locked(handle.prompt)
+                    # a resume re-admits the EXTENDED sequence (prompt
+                    # + tokens already emitted) with the REMAINING
+                    # token budget — the retained pages make most of
+                    # it a prefix hit
+                    prompt = handle.prompt + handle.out_tokens \
+                        if handle.suspended else handle.prompt
+                    remaining = handle.max_tokens \
+                        - len(handle.out_tokens)
+                    matched = self._match_prefix_locked(prompt)
                     needed = self._worst_case_blocks(
-                        len(handle.prompt), handle.max_tokens,
-                        len(matched))
+                        len(prompt), remaining, len(matched))
                     pinning = sum(1 for n in matched
                                   if self._ref[n.block] == 0)
-                    if self._available_blocks() - pinning < needed:
-                        return   # block-pool pressure: wait for evicts
-                self._queue.popleft()
+                    if free_slot is None \
+                            or self._available_blocks() - pinning \
+                            < needed:
+                        if self.admission == "continuous" \
+                                and self.preemption:
+                            suspend = self._preempt_victim_locked(
+                                self._qos_priority(handle))
+                        if suspend is None:
+                            return   # pressure: wait for evictions
+                        suspend_why = "slot" if free_slot is None \
+                            else "blocks"
+                    else:
+                        if self._qos is not None \
+                                and handle.tenant is not None \
+                                and not handle._qos_charged:
+                            if not self._qos.try_charge(
+                                    handle.tenant,
+                                    handle.max_tokens):
+                                return   # refill raced; next pass
+                            handle._qos_charged = True
+                        handle._qos_deferred = False
+                        self._queue.remove(handle)
+                else:
+                    self._queue.remove(handle)
+            if suspend is not None:
+                self._suspend(suspend, suspend_why)
+                continue
             refilling = True
             if handle.cancelled:
                 self._finish(handle, handle.cancel_reason)
@@ -1620,13 +1816,90 @@ class GenerationEngine:
                 continue
             self._prefill(free_slot, handle, matched)
 
+    def _suspend(self, slot_idx, reason="slot"):
+        """Preemptible decoding's eviction half: pause ``slot_idx``
+        mid-stream WITHOUT finishing it. The slot's pages release
+        cache-RETAINED: every full block of the written sequence —
+        prompt + emitted tokens whose K/V is in the pool; the final
+        emitted token's K/V is NOT (it is the next decode input) — is
+        indexed into the prefix trie first, so the resume's partial
+        prefill re-pins them and pays only the unshared tail. The
+        handle re-queues with its original seq (a resume outranks
+        later same-class arrivals) and the stream stays open: the
+        transports relay a ``suspended`` event frame carrying the
+        tokens emitted so far, and indices continue when decoding
+        resumes."""
+        slot = self._slots[slot_idx]
+        handle = slot.handle
+        with self._cond:
+            self._slots[slot_idx] = None
+            if self.prefix_cache:
+                # K/V exists for exactly slot.length tokens == prompt
+                # + out_tokens[:-1]; indexing past that would
+                # advertise pages whose K/V was never written
+                written = (handle.prompt
+                           + handle.out_tokens)[:slot.length]
+                self._index_prompt_locked(
+                    written, slot.blocks,
+                    self._match_prefix_locked(written))
+            self._release_blocks_locked(slot.blocks)
+            handle.suspended = True
+            handle.preemptions += 1
+            # restart the queue-wait clock: the resume's "admitted"
+            # sample measures suspension->resume, not submit->resume
+            # (TTFT closed at the FIRST admission and stays closed)
+            handle.enqueued = time.perf_counter()
+            handle.enqueued_w = time.time()
+            self._queue.append(handle)
+            self._cond.notify()
+        self.stats["preemptions"] += 1
+        _EVICTIONS_TOTAL.labels(self.name, "preempted").inc()
+        _PREEMPTIONS_TOTAL.labels(self.name, reason).inc()
+        if handle.tenant is not None:
+            qos_lib.PREEMPTIONS_TOTAL.labels(handle.tenant,
+                                             handle.qos_class).inc()
+        self._record_event("suspended", handle, slot=slot_idx,
+                           reason=reason,
+                           tokens=len(handle.out_tokens))
+        if handle.rt is not None and slot.length > len(handle.prompt):
+            handle.rt.phase("generate.decode", slot.decode_start_w,
+                            tokens=len(handle.out_tokens))
+        self._notify_event(handle, "suspended", reason="preempted",
+                           tokens=len(handle.out_tokens))
+
+    def _notify_event(self, handle, event, **attrs):
+        """Fire the handle's mid-stream lifecycle callback — the
+        transports relay ``suspended``/``resumed`` as NDJSON event
+        frames on the open stream. Engine-thread; guarded like
+        ``_emit`` (a transport bug must not kill the decode batch)."""
+        if handle.on_event is None:
+            return
+        try:
+            handle.on_event(event, dict(attrs))
+        except Exception:  # noqa: BLE001 — see _emit
+            log.exception("on_event callback failed")
+
     def _prefill(self, slot_idx, handle, matched=()):
         """Prefill ``handle`` into ``slot_idx``. With a trie match the
         matched pages are pinned (ref++) and attached to the block
         table, and the CACHED prefill program runs over only the
         unshared suffix at positional offset ``len(matched)·bs`` —
-        the shared tokens' forward is skipped entirely."""
-        prompt_len = len(handle.prompt)
+        the shared tokens' forward is skipped entirely.
+
+        A RESUME (``handle.suspended``) prefills the extended sequence
+        — original prompt + every token already emitted — with the
+        remaining token budget. Suspension indexed the written pages
+        into the trie, so ``matched`` covers all but the last block or
+        two and the partial prefill pays only the unshared tail. The
+        final emitted token never had K/V written (it was the next
+        decode input), so it always rides the prefill, whose
+        last-position argmax IS the next uninterrupted token: the
+        resumed continuation is token-identical by construction."""
+        resuming = handle.suspended
+        prompt = handle.prompt + handle.out_tokens if resuming \
+            else handle.prompt
+        remaining = handle.max_tokens - len(handle.out_tokens)
+        prompt_len = len(prompt)
         offset = len(matched) * self.block_size
         suffix_len = prompt_len - offset
         padded = self._suffix_padded(prompt_len, offset)
@@ -1654,7 +1927,7 @@ class GenerationEngine:
                 self.stats["prefix_misses"] += 1
                 _PREFIX_MISSES_TOTAL.labels(self.name).inc()
         tokens = np.zeros((padded,), np.int32)
-        tokens[:suffix_len] = handle.prompt[offset:]
+        tokens[:suffix_len] = prompt[offset:]
         t0 = time.perf_counter()
         t0w = time.time()
         handle.admitted_w = t0w
@@ -1695,7 +1968,7 @@ class GenerationEngine:
                 # read can see it (reads are length-masked)
                 dpad = self._suffix_padded(prompt_len, 0)
                 dtok = np.zeros((dpad,), np.int32)
-                dtok[:prompt_len] = handle.prompt
+                dtok[:prompt_len] = prompt
                 self._draft_cache = self._draft_prefill_jit(
                     self.draft_params, self._draft_cache, dtok,
                     np.int32(slot_idx))
@@ -1739,19 +2012,35 @@ class GenerationEngine:
         handle.spec_wire = self.spec_header()
         slot = _Slot(handle, prefix_blocks + fresh, prompt_len, first,
                      len(matched) + self._worst_case_blocks(
-                         prompt_len, handle.max_tokens, len(matched)))
+                         prompt_len, remaining, len(matched)))
         with self._cond:
             self._inflight = []
             self._slots[slot_idx] = slot
             if self.prefix_cache:
-                self._index_prompt_locked(handle.prompt, slot.blocks,
+                self._index_prompt_locked(prompt, slot.blocks,
                                           matched)
         # TTFT closes BEFORE the emit so handle.ttft_s is set by the
         # time on_token fires — the transports read it to build the
-        # response head right after the first token arrives
+        # response head right after the first token arrives. A resume
+        # books an inter-token GAP here instead (last_emit is already
+        # set): the suspension's wall time is the stream's price.
         self._note_emission_event(handle)
-        self._record_event("first_token", handle, slot=slot_idx,
-                           ttft_s=round(handle.ttft_s, 6))
+        if resuming:
+            handle.suspended = False
+            handle.resume_prefill_tokens += suffix_len
+            self.stats["resumes"] += 1
+            self.stats["resume_prefill_tokens"] += suffix_len
+            _RESUME_PREFILL_TOKENS.labels(self.name).inc(suffix_len)
+            self._record_event("resumed", handle, slot=slot_idx,
+                               prefix_tokens_skipped=offset,
+                               prefilled=suffix_len)
+            self._notify_event(handle, "resumed",
+                               prefix_tokens_skipped=offset,
+                               prefilled=suffix_len,
+                               tokens=len(handle.out_tokens))
+        else:
+            self._record_event("first_token", handle, slot=slot_idx,
+                               ttft_s=round(handle.ttft_s, 6))
         self._emit(handle, first)
         if handle.eos_id is not None and first == handle.eos_id:
             self._evict(slot_idx, "eos")
@@ -2027,6 +2316,9 @@ class GenerationEngine:
         handle.token_times.append(time.time())
         _TOKENS_TOTAL.labels(self.name).inc()
         self.stats["tokens"] += 1
+        if handle.tenant is not None:
+            qos_lib.TOKENS_TOTAL.labels(handle.tenant,
+                                        handle.qos_class).inc()
         if handle.on_token is not None:
             try:
                 handle.on_token(token, len(handle.out_tokens) - 1)

@@ -1651,8 +1651,12 @@ class ModelServer:
                         tokens, max_tokens=req.get("max_tokens"),
                         eos_id=req.get("eos_id"), deadline=deadline,
                         rt=rt,
+                        tenant=self.headers.get("X-Tenant"),
+                        qos_class=self.headers.get("X-QoS-Class"),
                         on_token=lambda t, i: events.put(
                             ("token", t, i)),
+                        on_event=lambda ev, attrs: events.put(
+                            ("event", ev, attrs)),
                         on_done=lambda reason, toks, error: events.put(
                             ("done", reason, toks, error)))
                 except Exception as e:  # noqa: BLE001 — wire boundary
@@ -1684,6 +1688,10 @@ class ModelServer:
                 # count), router-mirrored like the prefix header
                 self.send_header("X-Generate-Mesh",
                                  engine.mesh_header())
+                # resolved QoS class (header > tenant ledger default)
+                # — the router mirrors this so clients see which
+                # priority the engine actually applied
+                self.send_header("X-QoS-Class", handle.qos_class)
                 # speculative economics (engine-cumulative exact
                 # counts FROZEN at this request's prefill; omitted
                 # when speculation is off so the plain wire contract
@@ -1715,6 +1723,14 @@ class ModelServer:
                         if event[0] == "token":
                             chunk({"token": event[1],
                                    "index": event[2]})
+                        elif event[0] == "event":
+                            # preemptible-decoding lifecycle frame
+                            # (suspended/resumed): no "token" key, so
+                            # token-consuming clients skip it; a
+                            # suspended frame is the resumable
+                            # termination marker carrying the tokens
+                            # emitted so far
+                            chunk({"event": event[1], **event[2]})
                         else:
                             _kind, reason, toks, error = event
                             done = {"done": True, "reason": reason,
@@ -1755,6 +1771,13 @@ class ModelServer:
                             spec = engine.spec_view(handle)
                             if spec is not None:
                                 done["spec"] = spec
+                            # tenancy economics (tenant, class,
+                            # preemptions survived, resume prefill
+                            # paid); key absent for anonymous
+                            # never-preempted requests
+                            qos = engine.qos_view(handle)
+                            if qos is not None:
+                                done["qos"] = qos
                             if error is not None:
                                 done["error"] = str(error)
                             chunk(done)

@@ -213,7 +213,8 @@ class AsyncTransport:
                     conn, gen, outcome = self._completions.popleft()
                     if conn.gen == gen and conn.sock.fileno() >= 0:
                         try:
-                            if outcome[0] in ("gtoken", "gdone"):
+                            if outcome[0] in ("gtoken", "gevent",
+                                              "gdone"):
                                 self._gen_event(conn, outcome)
                             else:
                                 self._complete_predict(conn, outcome)
@@ -308,8 +309,9 @@ class AsyncTransport:
         """A completion whose connection already closed: count the
         would-have-been response into ``serving_requests_total`` and
         finish the request trace."""
-        if outcome[0] == "gtoken":
-            return        # tokens after a dead stream: nothing to do
+        if outcome[0] in ("gtoken", "gevent"):
+            return        # tokens/lifecycle frames after a dead
+            #               stream: nothing to do
         if outcome[0] == "gdone" and conn.req is not None \
                 and conn.req.get("gen_started"):
             return        # the stream's close-time finish_cb (set at
@@ -687,6 +689,13 @@ class AsyncTransport:
                 (conn, gen, ("gtoken", token, index)))
             self._wake()
 
+        def on_event(event, attrs):
+            # preemptible-decoding lifecycle (suspended/resumed) —
+            # ordered behind the tokens that preceded it, like gtoken
+            self._completions.append(
+                (conn, gen, ("gevent", event, attrs)))
+            self._wake()
+
         def on_done(reason, toks, error):
             self._completions.append(
                 (conn, gen, ("gdone", reason, toks, error)))
@@ -697,7 +706,10 @@ class AsyncTransport:
             req["gen_handle"] = engine.submit(
                 tokens, max_tokens=body.get("max_tokens"),
                 eos_id=body.get("eos_id"), deadline=deadline, rt=rt,
-                on_token=on_token, on_done=on_done)
+                tenant=req["headers"].get("x-tenant"),
+                qos_class=req["headers"].get("x-qos-class"),
+                on_token=on_token, on_event=on_event,
+                on_done=on_done)
         except Exception as e:  # noqa: BLE001 — wire boundary:
             # ValueError → 400, DrainingError → clean 503 (no fallback
             # path exists for stateful decode slots), else 500
@@ -723,6 +735,9 @@ class AsyncTransport:
                  # sharding summary (tensor mesh size + per-chip
                  # block count), router-mirrored like the prefix one
                  f"X-Generate-Mesh: {engine.mesh_header()}"]
+        # resolved QoS class (threaded parity), router-mirrored
+        if handle is not None:
+            lines.append(f"X-QoS-Class: {handle.qos_class}")
         # speculative economics (engine-cumulative exact counts
         # FROZEN at this request's prefill; omitted when speculation
         # is off — byte-identical plain contract), router-mirrored
@@ -774,6 +789,16 @@ class AsyncTransport:
                                       "index": outcome[2]})
             self._flush(conn)
             return
+        if outcome[0] == "gevent":
+            # suspended/resumed lifecycle frame (threaded parity: no
+            # "token" key, so token-consuming clients skip it). The
+            # engine only suspends slots that already emitted, so the
+            # stream head is always out; drop the frame otherwise.
+            if req.get("gen_started"):
+                self._stream_chunk(conn, {"event": outcome[1],
+                                          **outcome[2]})
+                self._flush(conn)
+            return
         _kind, reason, toks, error = outcome
         if not req.get("gen_started"):
             # finished before ANY token: queue-side failure (drain,
@@ -811,6 +836,12 @@ class AsyncTransport:
             if handle is not None else None
         if spec is not None:
             done["spec"] = spec
+        # tenancy economics (threaded parity: key absent for
+        # anonymous never-preempted requests)
+        qos = req["gen_engine"].qos_view(handle) \
+            if handle is not None else None
+        if qos is not None:
+            done["qos"] = qos
         if error is not None:
             done["error"] = str(error)
         self._stream_chunk(conn, done)

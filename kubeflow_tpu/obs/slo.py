@@ -322,3 +322,19 @@ def default_slos():
 
 def default_engine(**kwargs):
     return BurnRateEngine(default_slos(), **kwargs)
+
+
+def burning(status, names=None):
+    """Names of SLOs a ``status()`` payload reports as burning,
+    optionally restricted to ``names`` — the judge half of the
+    judge->act loop (qos/gate.py sheds batch-class load off this
+    verdict; any actuator consuming /api/alerts should parse the
+    payload through here rather than reimplement the shape)."""
+    out = set()
+    for row in (status or {}).get("slos", ()):
+        if row.get("state") != "burning":
+            continue
+        name = row.get("slo")
+        if names is None or name in names:
+            out.add(name)
+    return out

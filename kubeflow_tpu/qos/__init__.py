@@ -1,0 +1,17 @@
+"""Multi-tenant token economy for the serving edge (ISSUE 17).
+
+Per-tenant token-bucket budgets and QoS classes (``batch`` <
+``standard`` < ``interactive``), enforced twice: at the router
+(qos/gate.py — 429 + Retry-After on an empty bucket, burn-rate
+shedding of batch-class load) and at the generation engine's admission
+queue (compute/generate.py — priority-ordered admission, preemptible
+decoding with cache-retained suspend/resume). See docs/user-guide.md
+§6d for the header contract and the resume cost model.
+"""
+
+from .buckets import (DEFAULT_CLASS, INTER_TOKEN_SECONDS,  # noqa: F401
+                      PREEMPTIONS_TOTAL, PRIORITY, QOS_CLASSES,
+                      THROTTLED_TOTAL, TOKENS_TOTAL, TTFT_SECONDS,
+                      TokenBucket, TokenLedger, from_env)
+from .gate import QosGate  # noqa: F401
+from .gate import from_env as gate_from_env  # noqa: F401

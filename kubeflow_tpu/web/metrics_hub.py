@@ -236,7 +236,49 @@ def create_app(store=None, shard_dir=None):
                     if h + m else None,
                 "pods": pods.get(model, {}),
             }
-        return {"shardDir": shard_dir, "models": models}
+
+        # per-tenant breakdown off the serving_qos_* families (tenant
+        # + class labeled): who spent the tokens, who paid the
+        # preemptions, and each tenant's own latency percentiles —
+        # the noisy neighbor is visible beside the model aggregate
+        qos_ttft = aggregate.histogram_view(
+            triples, "serving_qos_ttft_seconds",
+            group_by=("tenant", "class"))
+        qos_itg = aggregate.histogram_view(
+            triples, "serving_qos_inter_token_seconds",
+            group_by=("tenant", "class"))
+
+        qos_tokens = {}
+        qos_preempt = {}
+        for (series, labels), value in merged.items():
+            ld = dict(labels)
+            if series == "serving_qos_tokens_total":
+                qos_tokens[(ld.get("tenant", ""),
+                            ld.get("class", ""))] = int(value)
+            elif series == "serving_qos_preemptions_total":
+                qos_preempt[(ld.get("tenant", ""),
+                             ld.get("class", ""))] = int(value)
+        throttled = {}
+        for (series, labels), value in merged.items():
+            if series == "serving_qos_throttled_total":
+                ld = dict(labels)
+                throttled.setdefault(ld.get("tenant", ""), {})[
+                    ld.get("reason", "")] = int(value)
+        tenants = {}
+        for tenant, cls in (set(qos_ttft) | set(qos_itg)
+                            | set(qos_tokens) | set(qos_preempt)):
+            tenants[tenant] = {
+                "class": cls,
+                "ttft": latency_ms(qos_ttft[(tenant, cls)])
+                    if (tenant, cls) in qos_ttft else None,
+                "itg": latency_ms(qos_itg[(tenant, cls)])
+                    if (tenant, cls) in qos_itg else None,
+                "tokens_total": qos_tokens.get((tenant, cls), 0),
+                "preemptions": qos_preempt.get((tenant, cls), 0),
+                "throttled": throttled.get(tenant, {}),
+            }
+        return {"shardDir": shard_dir, "models": models,
+                "tenants": tenants}
 
     @app.get("/api/alerts")
     def alerts(request):

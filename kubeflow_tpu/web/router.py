@@ -27,10 +27,13 @@ in the same latency anatomy as the replicas behind it.
 import http.client
 import json
 import logging
+import math
 import os
 import threading
+import urllib.request
 
 from ..obs import metrics as obs_metrics
+from ..qos import gate as qos_gate
 from .http import App, HTTPError, Response
 
 log = logging.getLogger("kubeflow_tpu.web.router")
@@ -53,7 +56,11 @@ _OUTSTANDING = obs_metrics.REGISTRY.gauge(
 
 #: request headers forwarded to the replica (hop-by-hop headers are not)
 _FORWARD_HEADERS = ("content-type", "x-tensor-dtype", "x-tensor-shape",
-                    "x-request-deadline-ms", "traceparent")
+                    "x-request-deadline-ms", "traceparent",
+                    # tenancy: the engine applies the same QoS ledger
+                    # the router's gate charged (priority admission +
+                    # preemptible decoding key off these)
+                    "x-tenant", "x-qos-class")
 #: response headers mirrored back to the client
 _MIRROR_HEADERS = ("Content-Type", "X-Tensor-Dtype", "X-Tensor-Shape",
                    "X-Inference-Time-Ms", "X-Served-Version",
@@ -73,6 +80,10 @@ _MIRROR_HEADERS = ("Content-Type", "X-Tensor-Dtype", "X-Tensor-Shape",
                    # --token-latency asserts it agrees with the done
                    # frame's ttft_s through the router hop)
                    "X-TTFT-Ms",
+                   # :generate resolved QoS class (the priority the
+                   # engine actually applied; also set on the gate's
+                   # own 429s)
+                   "X-QoS-Class",
                    "Retry-After")
 
 
@@ -442,20 +453,82 @@ class RouterCore:
             } for r in self.replicas.values()]
 
 
-def create_app(store=None, core=None, namespace=None):
+def create_app(store=None, core=None, namespace=None, qos=None):
     """The router web app. With a ``store`` the replica set follows
     ModelDeployment statuses; ``ROUTER_BACKENDS`` (comma-separated
     ``host:port``) seeds/pins a static set. Compatible with
-    ``cmd._web`` (store-first signature)."""
+    ``cmd._web`` (store-first signature).
+
+    Tenancy: every POST ``:generate`` passes the QoS gate first —
+    prepaying the request's ``max_tokens`` against the ``X-Tenant``
+    token bucket (``QOS_TENANTS`` env spec) and shedding batch-class
+    load while the token-latency SLOs burn (``ROUTER_ALERTS_URL``
+    polls the hub's ``/api/alerts``). Over budget or shed is a 429
+    with ``Retry-After`` — refused before any replica slot, prefill,
+    or stream is committed."""
     app = App("model-router")
     core = core or RouterCore(
         health_interval=float(os.environ.get(
             "ROUTER_HEALTH_INTERVAL", "2.0")))
     app.router = core
+    gate = qos if qos is not None else qos_gate.from_env()
+    app.qos = gate
     backends = os.environ.get("ROUTER_BACKENDS", "")
     if backends:
         core.set_backends(backends.split(","))
     core.start(store=store, namespace=namespace)
+    alerts_url = os.environ.get("ROUTER_ALERTS_URL", "")
+    if alerts_url:
+        interval = float(os.environ.get("ROUTER_ALERTS_INTERVAL",
+                                        "5.0"))
+
+        def poll_alerts():
+            # judge→act loop: the hub's burn-rate engine judges, the
+            # gate acts (shed batch before interactive is touched)
+            while not core._stop.wait(interval):
+                try:
+                    with urllib.request.urlopen(alerts_url,
+                                                timeout=5.0) as resp:
+                        gate.observe_alerts(
+                            json.loads(resp.read() or b"{}"))
+                except Exception:  # noqa: BLE001 — an unreachable
+                    # hub must not take the router down; shed state
+                    # simply goes stale until the next good poll
+                    log.debug("alerts poll failed", exc_info=True)
+
+        threading.Thread(target=poll_alerts, name="router-alerts",
+                         daemon=True).start()
+
+    def gate_generate(request):
+        """QoS verdict for one ``:generate`` admission → Response
+        (the refusal) or None (admitted)."""
+        tenant = request.header("x-tenant")
+        try:
+            body = json.loads(request.body or b"{}")
+            tokens = int(body.get("max_tokens") or os.environ.get(
+                "QOS_DEFAULT_MAX_TOKENS", "64"))
+        except (ValueError, TypeError):
+            return None      # malformed body: let the replica 400 it
+        verdict = gate.admit(tenant, request.header("x-qos-class"),
+                             tokens)
+        if verdict:
+            return None
+        if verdict.reason == "unknown-class":
+            raise HTTPError(400, f"unknown QoS class "
+                                 f"{verdict.qos_class!r}")
+        retry = verdict.retry_after
+        retry_s = "3600" if math.isinf(retry) \
+            else str(max(1, int(math.ceil(retry))))
+        return Response(
+            {"error": f"over token budget for tenant {tenant!r}"
+                      if verdict.reason == "budget"
+                      else f"{verdict.qos_class}-class load shed "
+                           f"while latency SLOs burn",
+             "reason": verdict.reason,
+             "retry_after_s": retry_s},
+            status=429,
+            headers={"Retry-After": retry_s,
+                     "X-QoS-Class": verdict.qos_class})
 
     def proxy(request, rest):
         path = "/v1/" + rest
@@ -465,6 +538,10 @@ def create_app(store=None, core=None, namespace=None):
             if value is not None:
                 headers[name] = value
         if rest.endswith(":generate"):
+            if request.method == "POST":
+                refused = gate_generate(request)
+                if refused is not None:
+                    return refused
             # token streams relay INCREMENTALLY (forward_stream +
             # Response(stream=...)): each upstream frame goes on the
             # wire as it arrives — a generation's first token must not
@@ -504,6 +581,10 @@ def create_app(store=None, core=None, namespace=None):
     @app.get("/admin/replicas")
     def replicas(request):
         return {"replicas": core.snapshot()}
+
+    @app.get("/admin/qos")
+    def qos_report(request):
+        return gate.report()
 
     @app.post("/admin/backends")
     def backends_route(request):

@@ -123,6 +123,15 @@ def build_argparser():
                          "a real router; asserts the snapshot "
                          "backend, bytes-counter monotonicity and "
                          "well-formed streams")
+    ap.add_argument("--qos", action="store_true",
+                    help="ISSUE 17 verdict: mixed-tenant overload "
+                         "through a real router with a QoS gate — a "
+                         "1-slot replica must preempt a batch stream "
+                         "for an interactive arrival (suspended/"
+                         "resumed frames, resume prefix skip, done-"
+                         "frame preemption counts), mirror "
+                         "X-QoS-Class, and 429 an over-budget tenant "
+                         "with Retry-After at the router")
     ap.add_argument("--token-latency", action="store_true",
                     help="ISSUE 16 verdict: the replica exports metric "
                          "shards (OBS_EXPORT_DIR), streams run through "
@@ -184,7 +193,8 @@ def prompt_set(args):
     return specs
 
 
-def run_one(port, tokens, max_tokens):
+def run_one(port, tokens, max_tokens, headers=None,
+            on_first_chunk=None):
     """One :generate stream → dict(tokens, first_s, total_s, final,
     skip_header). Raises on any frame-contract violation."""
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
@@ -192,7 +202,8 @@ def run_one(port, tokens, max_tokens):
     conn.request("POST", "/v1/models/lm:generate",
                  json.dumps({"tokens": tokens,
                              "max_tokens": max_tokens}).encode(),
-                 {"Content-Type": "application/json"})
+                 {"Content-Type": "application/json",
+                  **(headers or {})})
     resp = conn.getresponse()
     assert resp.status == 200, (resp.status, resp.read()[:200])
     buf = b""
@@ -202,6 +213,8 @@ def run_one(port, tokens, max_tokens):
         chunk = resp.read1(65536)
         if first_s is None and chunk:
             first_s = time.perf_counter() - t0
+            if on_first_chunk is not None:
+                on_first_chunk()
         if not chunk:
             break
         buf += chunk
@@ -228,9 +241,11 @@ def run_one(port, tokens, max_tokens):
     assert all(set(f) == {"token", "index"}
                for f in frames if "token" in f), "multi-token frame"
     return {"tokens": toks, "first_s": first_s, "total_s": total_s,
-            "final": final, "skip_header": skip_header,
+            "final": final, "frames": frames,
+            "skip_header": skip_header,
             "mesh_header": mesh_header, "spec_header": spec_header,
-            "ttft_header": ttft_header}
+            "ttft_header": ttft_header,
+            "qos_header": resp.headers.get("X-QoS-Class")}
 
 
 def scrape_occupancy(port):
@@ -648,6 +663,134 @@ def run_token_latency(args, port):
         core.stop()
 
 
+def run_qos(args, port):
+    """The --qos verdict (ISSUE 17): mixed-tenant overload driven
+    THROUGH a real in-process model-router with a QoS gate. A single
+    decode slot holds a long batch-class stream; an interactive
+    request arriving mid-stream must preempt it — the batch stream's
+    NDJSON carries ``suspended``/``resumed`` event frames and still
+    reconciles (done frame tokens == streamed tokens across the gap),
+    the interactive request finishes FIRST despite arriving last, the
+    mirrored ``X-QoS-Class`` head names each side's class, and an
+    over-budget tenant gets a clean router 429 with ``Retry-After``
+    before any replica sees the request."""
+    from kubeflow_tpu.qos import buckets as buckets_lib
+    from kubeflow_tpu.qos import gate as gate_lib
+    from kubeflow_tpu.web import router as router_lib
+
+    gate = gate_lib.QosGate(buckets_lib.TokenLedger({
+        "acme": {"rate": 1000, "burst": 10000,
+                 "class": "interactive"},
+        "crawler": {"rate": 1000, "burst": 10000, "class": "batch"},
+        "capped": {"rate": 1, "burst": 8},
+    }))
+    core = router_lib.RouterCore(health_interval=0.3)
+    core.set_backends([f"127.0.0.1:{port}"])
+    app = router_lib.create_app(core=core, qos=gate)
+    httpd = app.serve(port=0, host="127.0.0.1")
+    rport = httpd.server_address[1]
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            snap = core.snapshot()
+            if snap and snap[0]["healthy"]:
+                break
+            time.sleep(0.05)
+        else:
+            raise SystemExit("replica never turned healthy via the "
+                             "router")
+        # warm both prefill buckets + decode outside the measured race
+        run_one(rport, [1] * 32, 2)
+        run_one(rport, [2] * 8, 2)
+
+        batch_prompt = [(11 + 3 * j) % 500 + 2 for j in range(32)]
+        inter_prompt = [(7 + 5 * j) % 500 + 2 for j in range(8)]
+        batch_out = {}
+        streaming = threading.Event()
+
+        def drive_batch():
+            batch_out["r"] = run_one(
+                rport, batch_prompt, 96,
+                headers={"X-Tenant": "crawler",
+                         "X-QoS-Class": "batch"},
+                on_first_chunk=streaming.set)
+            batch_out["done_at"] = time.monotonic()
+
+        t = threading.Thread(target=drive_batch)
+        t.start()
+        assert streaming.wait(30), "batch stream never started"
+        inter = run_one(rport, inter_prompt, 8,
+                        headers={"X-Tenant": "acme",
+                                 "X-QoS-Class": "interactive"})
+        inter_done_at = time.monotonic()
+        t.join(timeout=120)
+        assert "r" in batch_out, "batch stream never finished"
+        b = batch_out["r"]
+        sus = [f for f in b["frames"] if f.get("event") == "suspended"]
+        res = [f for f in b["frames"] if f.get("event") == "resumed"]
+        bqos = b["final"].get("qos") or {}
+
+        # over-budget tenant: first request drains the bucket through
+        # the gate, the second is refused at the ROUTER (429 +
+        # Retry-After) — the replica never sees it
+        run_one(rport, [9] * 8, 8, headers={"X-Tenant": "capped"})
+        conn = http.client.HTTPConnection("127.0.0.1", rport,
+                                          timeout=30)
+        conn.request("POST", "/v1/models/lm:generate",
+                     json.dumps({"tokens": [9] * 8,
+                                 "max_tokens": 8}).encode(),
+                     {"Content-Type": "application/json",
+                      "X-Tenant": "capped"})
+        resp = conn.getresponse()
+        throttle_body = json.loads(resp.read())
+        throttle = {"status": resp.status,
+                    "retry_after": resp.headers.get("Retry-After"),
+                    "reason": throttle_body.get("reason")}
+        conn.close()
+
+        report = {
+            "mode": "qos", "transport": args.transport,
+            "slots": args.slots,
+            "batch": {"tokens": len(b["tokens"]),
+                      "total_s": round(b["total_s"], 3),
+                      "qos": bqos,
+                      "suspended_frames": len(sus),
+                      "resumed_frames": len(res),
+                      "prefix_tokens_skipped":
+                          res[0]["prefix_tokens_skipped"]
+                          if res else 0},
+            "interactive": {"tokens": len(inter["tokens"]),
+                            "ttft_s": round(inter["first_s"], 3),
+                            "total_s": round(inter["total_s"], 3)},
+            "throttle": throttle,
+            "checks": {
+                "batch_stream_suspended_and_resumed":
+                    len(sus) >= 1 and len(res) >= 1
+                    and sus[0].get("reason") == "preempted",
+                "done_frame_counts_preemptions":
+                    bqos.get("preemptions", 0) >= 1
+                    and bqos.get("tenant") == "crawler",
+                "resume_skipped_cached_prefix":
+                    bool(res) and res[0]["prefix_tokens_skipped"] > 0,
+                "qos_class_header_mirrored":
+                    b["qos_header"] == "batch"
+                    and inter["qos_header"] == "interactive",
+                "interactive_finished_first":
+                    inter_done_at < batch_out["done_at"],
+                "over_budget_tenant_gets_429_retry_after":
+                    throttle["status"] == 429
+                    and throttle["reason"] == "budget"
+                    and int(throttle["retry_after"] or 0) >= 1,
+                "streams_well_formed": True,    # run_one asserted
+            }}
+        print(json.dumps(report, indent=2))
+        if not all(report["checks"].values()):
+            raise SystemExit("qos generation loadtest FAILED")
+    finally:
+        httpd.shutdown()
+        core.stop()
+
+
 def scrape_attn_bytes(port, backend):
     """→ serving_generate_attn_bytes_read_total{backend=...} value."""
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
@@ -749,6 +892,10 @@ def main(argv=None):
     if args.token_latency:
         import tempfile
         args.obs_dir = tempfile.mkdtemp(prefix="gen-obs-")
+    if args.qos:
+        # scarcity is the scenario: one decode slot forces the
+        # interactive arrival to preempt the resident batch stream
+        args.slots = 1
     proc, port = spawn_server(args)
     try:
         if args.sharded:
@@ -765,6 +912,9 @@ def main(argv=None):
             return
         if args.token_latency:
             run_token_latency(args, port)
+            return
+        if args.qos:
+            run_qos(args, port)
             return
         specs = prompt_set(args)
         # warm every prompt-length bucket + the decode program OUTSIDE

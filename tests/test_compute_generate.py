@@ -656,6 +656,56 @@ class TestBlockPoolInvariants:
             engine._step_sleep = 0.0
             engine.close()
 
+    def test_randomized_churn_with_preemption_preserves_partition(
+            self, params):
+        """ISSUE 17: the same partition invariant with preemptible
+        decoding in the mix — batch-class streams suspend (pages
+        re-indexed cache-retained, handle re-queued) and resume under
+        interactive pressure, and every mid-flight snapshot still
+        partitions the pool exactly. The seed/timing are tuned so
+        suspensions genuinely happen (asserted), and the resumed
+        streams still complete."""
+        rng = random.Random(11)
+        engine = _engine(params, max_slots=2, num_blocks=12,
+                         max_context=48)
+        engine._step_sleep = 0.004
+        bases = ([9] * 16, [11] * 8, [13] * 24)
+        try:
+            handles = []
+            for round_ in range(10):
+                # long batch-class streams: the preemption victims
+                for _ in range(rng.randint(1, 2)):
+                    prompt = list(rng.choice(bases)) + [
+                        rng.randint(1, 63)
+                        for _ in range(rng.randint(0, 3))]
+                    handles.append(engine.submit(
+                        prompt, max_tokens=rng.randint(6, 12),
+                        qos_class="batch"))
+                self._assert_partition(engine)
+                time.sleep(rng.uniform(0.01, 0.04))
+                # interactive bursts force suspend transitions
+                if round_ % 2:
+                    handles.append(engine.submit(
+                        [rng.randint(1, 63)],
+                        max_tokens=rng.randint(1, 3),
+                        qos_class="interactive"))
+                if handles and rng.random() < 0.25:
+                    engine.cancel(rng.choice(handles))
+                self._assert_partition(engine)
+                time.sleep(rng.uniform(0, 0.02))
+                self._assert_partition(engine)
+            engine._step_sleep = 0.0
+            for h in handles:
+                assert h.wait(timeout=120)
+            self._assert_partition(engine)
+            assert not engine.blocks_view()["referenced"]
+            # the churn genuinely suspended and resumed streams
+            assert engine.stats["preemptions"] > 0
+            assert engine.stats["resumes"] > 0
+        finally:
+            engine._step_sleep = 0.0
+            engine.close()
+
 
 class TestSpeculativeDecoding:
     """Tentpole (ISSUE 14): draft-model propose + k-token verify on

@@ -1,0 +1,333 @@
+"""Preemptible-resumable decoding (ISSUE 17 tentpole, engine half).
+
+The contract under test: under high-QoS admission pressure a low-QoS
+slot SUSPENDS mid-stream (pages cache-retained via the prefix trie,
+handle re-queued, stream notified), later RESUMES as a re-admission of
+prompt + emitted-tokens whose partial prefill pays only the unshared
+tail — and the resumed output is TOKEN-IDENTICAL to an uninterrupted
+run (greedy determinism makes the oracle exact). Plus the admission
+economics around it: priority ordering, engine-side budget deferral,
+and the strict-FIFO escape hatch (``preemption=False``).
+
+Timing here uses ``_step_sleep`` to hold victims in their slots long
+enough to be preempted — the same slow-decode idiom as the serving
+stream tests.
+"""
+
+import time
+
+import jax
+import pytest
+
+from kubeflow_tpu.compute import generate as gen_lib
+from kubeflow_tpu.compute.models import transformer
+from kubeflow_tpu.qos import buckets as qos_lib
+
+
+def _config(dtype="float32"):
+    return transformer.Config(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, max_seq=64,
+        dtype=dtype, attention="dense", remat=False, scan_layers=True)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(_config(), jax.random.PRNGKey(0))
+
+
+def _engine(params, dtype="float32", **kw):
+    kw.setdefault("max_slots", 1)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_context", 64)
+    kw.setdefault("name", "t")
+    return gen_lib.GenerationEngine(params, _config(dtype), **kw)
+
+
+def _ref(params, prompt, max_tokens, dtype="float32"):
+    return gen_lib.reference_greedy_decode(params, _config(dtype),
+                                           prompt, max_tokens)
+
+
+PROMPT = [5, 9, 3, 7, 11, 2]
+
+
+def _preempt_once(engine, prompt=PROMPT, max_tokens=24,
+                  min_tokens=5, events=None):
+    """Run one batch-class stream on a saturated engine, fire an
+    interactive admission mid-stream, return both finished handles."""
+    engine._step_sleep = 0.01
+    try:
+        batch = engine.submit(
+            prompt, max_tokens=max_tokens, tenant="crawler",
+            qos_class="batch",
+            on_event=(lambda ev, at: events.append((ev, at)))
+            if events is not None else None)
+        deadline = time.monotonic() + 60
+        while len(batch.out_tokens) < min_tokens:
+            assert time.monotonic() < deadline, "victim never decoded"
+            time.sleep(0.002)
+        inter = engine.submit([4, 4, 8], max_tokens=4, tenant="acme",
+                              qos_class="interactive")
+        inter.result(timeout=120)
+        batch.result(timeout=120)
+    finally:
+        engine._step_sleep = 0.0
+    return batch, inter
+
+
+class TestPreemptResumeIdentity:
+    def test_fp32_resumed_stream_matches_oracle(self, params):
+        engine = _engine(params)
+        try:
+            events = []
+            batch, inter = _preempt_once(engine, events=events)
+            assert batch.preemptions >= 1
+            assert batch.out_tokens == _ref(params, PROMPT, 24)
+            assert inter.out_tokens == _ref(params, [4, 4, 8], 4)
+            assert inter.preemptions == 0
+            # resume cost model: the retained pages covered at least
+            # the original prompt, and the re-computed tail is small
+            assert batch.prefix_tokens_skipped >= len(PROMPT)
+            assert 0 < batch.resume_prefill_tokens \
+                <= 2 * engine.block_size
+            # the stream saw the full lifecycle, in order
+            names = [ev for ev, _ in events]
+            assert names[0] == "suspended" and "resumed" in names
+            sus = dict(events[0][1])
+            assert sus["reason"] == "preempted"
+            assert 0 < sus["tokens"] < 24
+            res = dict(events[names.index("resumed")][1])
+            assert res["prefix_tokens_skipped"] \
+                == batch.prefix_tokens_skipped
+            assert engine.stats["preemptions"] >= 1
+            assert engine.stats["resumes"] >= 1
+        finally:
+            engine.close()
+
+    def test_bf16_resumed_stream_matches_oracle(self):
+        cfg = _config("bfloat16")
+        params16 = transformer.init_params(_config(),
+                                           jax.random.PRNGKey(0))
+        engine = gen_lib.GenerationEngine(
+            params16, cfg, max_slots=1, block_size=8, max_context=64,
+            name="t16")
+        try:
+            batch, _ = _preempt_once(engine)
+            assert batch.preemptions >= 1
+            assert batch.out_tokens \
+                == _ref(params16, PROMPT, 24, "bfloat16")
+        finally:
+            engine.close()
+
+    def test_resume_across_prefix_cache_hit(self, params):
+        """A DIFFERENT request's cached prefix seeds the victim's
+        admission; suspension then extends that shared lineage — the
+        resume must still match the oracle and still skip at least
+        the original prompt."""
+        engine = _engine(params)
+        try:
+            warm = list(PROMPT) * 3          # 18 tokens: 2 full blocks
+            engine.generate(warm, max_tokens=2)
+            victim_prompt = list(PROMPT) * 2  # 12: hits warm's block
+            batch, _ = _preempt_once(engine, prompt=victim_prompt,
+                                      max_tokens=20)
+            assert batch.preemptions >= 1
+            assert batch.out_tokens == _ref(params, victim_prompt, 20)
+            assert batch.prefix_tokens_skipped >= len(victim_prompt)
+        finally:
+            engine.close()
+
+    def test_resume_with_speculative_decoding_on(self, params):
+        engine = _engine(params, draft_params=params,
+                         draft_config=_config(), spec_k=3)
+        try:
+            batch, inter = _preempt_once(engine)
+            assert batch.preemptions >= 1
+            assert batch.out_tokens == _ref(params, PROMPT, 24)
+            assert inter.out_tokens == _ref(params, [4, 4, 8], 4)
+        finally:
+            engine.close()
+
+    def test_repeated_preemptions_still_identical(self, params):
+        """Three interactive bursts, three suspensions of the same
+        batch stream — every resume re-extends the retained lineage."""
+        engine = _engine(params)
+        engine._step_sleep = 0.01
+        try:
+            batch = engine.submit(PROMPT, max_tokens=30,
+                                  qos_class="batch")
+            for burst in range(3):
+                deadline = time.monotonic() + 60
+                emitted = len(batch.out_tokens)
+                while len(batch.out_tokens) < emitted + 2 \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.002)
+                engine.submit([40 + burst], max_tokens=2,
+                              qos_class="interactive").result(
+                                  timeout=120)
+            engine._step_sleep = 0.0
+            batch.result(timeout=120)
+            assert batch.preemptions >= 2
+            assert batch.out_tokens == _ref(params, PROMPT, 30)
+        finally:
+            engine._step_sleep = 0.0
+            engine.close()
+
+
+class TestPriorityAdmission:
+    def test_higher_class_overtakes_queue(self, params):
+        """1 slot, an un-preemptible batch stream holding it, and a
+        queue of [batch, interactive]: the interactive request admits
+        first even though it arrived last."""
+        engine = _engine(params)
+        engine._step_sleep = 0.005
+        try:
+            head = engine.submit(PROMPT, max_tokens=8,
+                                 qos_class="batch",
+                                 preemptible=False)
+            b2 = engine.submit([1, 2, 3], max_tokens=4,
+                               qos_class="batch")
+            hi = engine.submit([9, 9], max_tokens=2,
+                               qos_class="interactive")
+            engine._step_sleep = 0.0
+            for h in (head, b2, hi):
+                h.result(timeout=120)
+            assert hi.admitted_w < b2.admitted_w
+            assert engine.stats["preemptions"] == 0  # no victim:
+            #   head is un-preemptible, so priority alone reordered
+        finally:
+            engine._step_sleep = 0.0
+            engine.close()
+
+    def test_interactive_not_preemptible_by_default(self, params):
+        engine = _engine(params)
+        try:
+            h = engine.submit([1, 2], max_tokens=1,
+                              qos_class="interactive")
+            assert h.preemptible is False
+            h2 = engine.submit([1, 2], max_tokens=1)
+            assert h2.qos_class == "standard" and h2.preemptible
+            h.result(timeout=120)
+            h2.result(timeout=120)
+        finally:
+            engine.close()
+
+    def test_unknown_class_rejected_at_submit(self, params):
+        engine = _engine(params)
+        try:
+            with pytest.raises(ValueError):
+                engine.submit([1], max_tokens=1, qos_class="platinum")
+        finally:
+            engine.close()
+
+    def test_fifo_mode_never_reorders_or_preempts(self, params):
+        engine = _engine(params, preemption=False)
+        engine._step_sleep = 0.005
+        try:
+            head = engine.submit(PROMPT, max_tokens=8,
+                                 qos_class="batch")
+            while not head.out_tokens:
+                time.sleep(0.002)
+            b2 = engine.submit([1, 2, 3], max_tokens=2,
+                               qos_class="batch")
+            hi = engine.submit([9, 9], max_tokens=2,
+                               qos_class="interactive")
+            engine._step_sleep = 0.0
+            for h in (head, b2, hi):
+                h.result(timeout=120)
+            assert head.preemptions == 0
+            assert engine.stats["preemptions"] == 0
+            assert b2.admitted_w < hi.admitted_w   # strict FIFO
+        finally:
+            engine._step_sleep = 0.0
+            engine.close()
+
+
+class TestEngineBudget:
+    def test_over_budget_tenant_defers_without_blocking_others(
+            self, params):
+        ledger = qos_lib.TokenLedger(
+            {"capped": {"rate": 1, "burst": 8}}, now=None)
+        engine = _engine(params, qos=ledger)
+        try:
+            first, _ = engine.generate([3, 3, 3], max_tokens=8,
+                                       tenant="capped")
+            assert len(first) == 8
+            starved = engine.submit([3, 3, 3], max_tokens=8,
+                                    tenant="capped")
+            other = engine.submit([7, 7], max_tokens=2)
+            # the un-budgeted tenant sails past the deferred one
+            assert other.result(timeout=120)[1] == "length"
+            assert starved.reason is None     # still waiting
+            assert engine.stats["qos_deferrals"] >= 1
+            # refill the bucket by hand -> the deferral resolves
+            ledger.buckets["capped"].credit(8)
+            out, reason = starved.result(timeout=120)
+            assert reason == "length" and len(out) == 8
+        finally:
+            engine.close()
+
+    def test_resume_never_recharges_budget(self, params):
+        """A preempted tenant PREPAID its max_tokens at first
+        admission; the resume must not double-charge (its bucket is
+        empty by then — a re-charge would deadlock the resume)."""
+        ledger = qos_lib.TokenLedger(
+            {"crawler": {"rate": 0.001, "burst": 24,
+                         "class": "batch"}}, now=None)
+        engine = _engine(params, qos=ledger)
+        try:
+            batch, _ = _preempt_once(engine)
+            assert batch.preemptions >= 1
+            assert batch.out_tokens == _ref(params, PROMPT, 24)
+        finally:
+            engine.close()
+
+
+class TestObservability:
+    def test_snapshot_and_timeline_carry_tenancy(self, params):
+        engine = _engine(params)
+        engine._step_sleep = 0.01
+        try:
+            h = engine.submit(PROMPT, max_tokens=20, tenant="crawler",
+                              qos_class="batch")
+            while not h.out_tokens:
+                time.sleep(0.002)
+            row = engine.snapshot()["slot_detail"][0]
+            assert row["tenant"] == "crawler"
+            assert row["qos_class"] == "batch"
+            assert row["preemptible"] is True
+            engine.submit([4, 4], max_tokens=2,
+                          qos_class="interactive").result(timeout=120)
+            engine._step_sleep = 0.0
+            h.result(timeout=120)
+            events = [e["event"] for e in engine.timeline_view()]
+            assert "suspended" in events and "resumed" in events
+            sus = next(e for e in engine.timeline_view()
+                       if e["event"] == "suspended")
+            assert sus["reason"] in ("slot", "blocks")
+            assert sus["tokens"] >= 1
+        finally:
+            engine._step_sleep = 0.0
+            engine.close()
+
+    def test_preemption_metrics_and_done_view(self, params):
+        engine = _engine(params)
+        try:
+            batch, inter = _preempt_once(engine)
+            view = engine.qos_view(batch)
+            assert view == {"tenant": "crawler", "class": "batch",
+                            "preemptions": batch.preemptions,
+                            "resume_prefill_tokens":
+                                batch.resume_prefill_tokens}
+            # anonymous never-preempted requests keep the key absent
+            plain, _ = engine.generate([8, 8], max_tokens=1)
+            assert len(plain) == 1
+        finally:
+            engine.close()
+        anon = _engine(params, name="t-anon")
+        try:
+            h = anon.submit([8, 8], max_tokens=1)
+            h.result(timeout=120)
+            assert anon.qos_view(h) is None
+        finally:
+            anon.close()

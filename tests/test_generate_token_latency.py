@@ -421,6 +421,55 @@ class TestFleetDebugGenerate:
         assert lm["pods"]["pod-a"]["itg"]["p50_ms"] < \
             lm["pods"]["pod-b"]["itg"]["p50_ms"]
 
+    def test_hub_tenant_breakdown(self, tmp_path):
+        """ISSUE 17: /debug/generate attributes TTFT/ITG/tokens/
+        preemptions/throttles to the TENANT from the serving_qos_*
+        shard families. A unique tenant name keeps earlier in-process
+        bookings (the local-registry synthetic shard) out of the
+        arithmetic."""
+        from kubeflow_tpu.qos import buckets as qos_lib
+
+        lines = [export_lib.format_header("pod-q", 1000.0,
+                                          time.time())]
+        lab = 'tenant="hub-crawler",class="batch"'
+
+        def emit(name, bounds, obs):
+            lines.append(f"# TYPE {name} histogram")
+            for le in bounds:
+                n = sum(1 for v in obs if v <= le)
+                lines.append(f'{name}_bucket{{{lab},le="{le:g}"}} {n}')
+            lines.append(f'{name}_bucket{{{lab},le="+Inf"}} '
+                         f'{len(obs)}')
+            lines.append(f'{name}_sum{{{lab}}} {sum(obs):g}')
+            lines.append(f'{name}_count{{{lab}}} {len(obs)}')
+
+        emit("serving_qos_ttft_seconds",
+             qos_lib.TTFT_SECONDS.buckets, [0.3] * 4)
+        emit("serving_qos_inter_token_seconds",
+             qos_lib.INTER_TOKEN_SECONDS.buckets, [0.01] * 40)
+        lines += [
+            "# TYPE serving_qos_tokens_total counter",
+            f"serving_qos_tokens_total{{{lab}}} 44",
+            "# TYPE serving_qos_preemptions_total counter",
+            f"serving_qos_preemptions_total{{{lab}}} 3",
+            "# TYPE serving_qos_throttled_total counter",
+            'serving_qos_throttled_total{tenant="hub-crawler",'
+            'reason="deferred"} 2',
+        ]
+        (tmp_path / "pod-q.prom").write_text("\n".join(lines) + "\n")
+        client = web_http.TestClient(
+            metrics_hub.create_app(shard_dir=str(tmp_path)))
+        view = client.get("/debug/generate").json
+        t = view["tenants"]["hub-crawler"]
+        assert t["class"] == "batch"
+        assert t["ttft"]["count"] == 4
+        assert t["ttft"]["p50_ms"] is not None
+        assert t["itg"]["count"] == 40
+        assert t["itg"]["p99_ms"] is not None
+        assert t["tokens_total"] == 44
+        assert t["preemptions"] == 3
+        assert t["throttled"] == {"deferred": 2}
+
     def test_index_links_debug_generate(self, tmp_path):
         client = web_http.TestClient(
             metrics_hub.create_app(shard_dir=str(tmp_path)))

@@ -1,0 +1,257 @@
+"""Token-economy unit surface: buckets, ledger, gate, router 429s.
+
+Everything here injects its own clock (``now=``) — no sleeps, no
+real-time refill races. The engine-side enforcement (priority
+admission, preemptible decoding) lives in
+tests/test_generate_preemption.py; this file covers the budget math
+and the router edge.
+"""
+
+import json
+import math
+
+import pytest
+
+from kubeflow_tpu.qos import buckets as buckets_lib
+from kubeflow_tpu.qos import gate as gate_lib
+from kubeflow_tpu.web import router as router_lib
+from kubeflow_tpu.web.http import TestClient
+
+
+class TestTokenBucket:
+    def test_starts_full_and_charges_all_or_nothing(self):
+        b = buckets_lib.TokenBucket(rate=10, burst=100, now=0)
+        assert b.available(0) == 100
+        assert b.try_charge(60, now=0)
+        assert not b.try_charge(60, now=0)     # 40 left: no partial
+        assert b.available(0) == 40
+
+    def test_refills_at_rate_up_to_burst(self):
+        b = buckets_lib.TokenBucket(rate=10, burst=100, now=0)
+        assert b.try_charge(100, now=0)
+        assert b.available(5) == 50            # 5s * 10/s
+        assert b.available(1000) == 100        # capped at burst
+
+    def test_charge_above_burst_clamps_to_burst(self):
+        # deliberate deviation: a request bigger than a full burst
+        # admits when the bucket is FULL (and drains it) — otherwise
+        # max_tokens > burst would mean "never"
+        b = buckets_lib.TokenBucket(rate=10, burst=50, now=0)
+        assert b.try_charge(500, now=0)
+        assert b.available(0) == 0
+
+    def test_retry_after_is_deficit_over_rate(self):
+        b = buckets_lib.TokenBucket(rate=10, burst=100, now=0)
+        b.try_charge(100, now=0)
+        assert b.retry_after(70, now=1.5) == pytest.approx(5.5)
+        assert b.retry_after(1, now=1.5) == 0.0  # 15 available
+        zero = buckets_lib.TokenBucket(rate=0, burst=10, now=0)
+        zero.try_charge(10, now=0)
+        assert math.isinf(zero.retry_after(1, now=0))
+
+    def test_credit_refunds_bounded_by_burst(self):
+        b = buckets_lib.TokenBucket(rate=10, burst=100, now=0)
+        b.try_charge(80, now=0)
+        b.credit(500)
+        assert b.available(0) == 100
+
+
+class TestTokenLedger:
+    def _ledger(self):
+        return buckets_lib.TokenLedger({
+            "acme": {"rate": 10, "burst": 100,
+                     "class": "interactive", "cohort": "prod"},
+            "beta": {"rate": 10, "burst": 100, "cohort": "prod"},
+            "crawler": {"rate": 5, "burst": 20, "class": "batch"},
+            "free": {"class": "interactive"},       # unconstrained
+        }, now=0)
+
+    def test_classes_and_defaults(self):
+        led = self._ledger()
+        assert led.class_of("acme") == "interactive"
+        assert led.class_of("beta") == "standard"
+        assert led.class_of("crawler") == "batch"
+        assert led.class_of("unknown") == "standard"
+        assert led.class_of(None) == "standard"
+
+    def test_unknown_class_rejected(self):
+        with pytest.raises(ValueError):
+            buckets_lib.TokenLedger({"x": {"class": "platinum"}})
+
+    def test_unconstrained_tenant_always_charges(self):
+        led = self._ledger()
+        assert led.headroom("free") is None
+        assert led.try_charge("free", 10 ** 9, now=0)
+        assert led.try_charge("unknown", 10 ** 9, now=0)
+
+    def test_cohort_borrowing_draws_idle_peer_tokens(self):
+        led = self._ledger()
+        # acme's own 100 + beta's idle 100 cover a 150 charge
+        assert led.headroom("acme", now=0) == 200
+        assert led.try_charge("acme", 150, now=0)
+        assert led.buckets["acme"].available(0) == 0
+        assert led.buckets["beta"].available(0) == 50
+        # crawler has no cohort: its own 20 is the whole headroom.
+        # A charge above burst clamps to it on a FULL bucket...
+        assert led.headroom("crawler", now=0) == 20
+        assert led.try_charge("crawler", 25, now=0)
+        assert led.buckets["crawler"].available(0) == 0
+        # ...but a non-full bucket refuses even the clamped cost
+        assert not led.try_charge("crawler", 25, now=0)
+
+    def test_retry_after_uses_pooled_rate(self):
+        led = self._ledger()
+        led.try_charge("acme", 200, now=0)      # drain the cohort
+        # deficit 70 over pooled 20/s = 3.5s (cost clamped to bursts)
+        assert led.retry_after("acme", 70, now=0) == pytest.approx(3.5)
+
+    def test_report_shape(self):
+        led = self._ledger()
+        rep = led.report("acme", now=0)
+        assert rep == {"nominal": 10.0, "cohort": "prod",
+                       "class": "interactive", "available": 100.0,
+                       "headroom": 200.0}
+        assert led.report("free", now=0)["headroom"] is None
+
+    def test_from_env_parses_spec_and_default_class(self):
+        env = {buckets_lib.TENANTS_ENV: json.dumps({
+            "a": {"rate": 2, "class": "batch"}}),
+            "QOS_DEFAULT_CLASS": "interactive"}
+        led = buckets_lib.from_env(env)
+        assert led.class_of("a") == "batch"
+        assert led.class_of("anyone-else") == "interactive"
+        assert led.buckets["a"].burst == 20.0   # 10s of refill
+        # empty spec -> inert ledger
+        led2 = buckets_lib.from_env({})
+        assert led2.nominal == {} and led2.try_charge("x", 10 ** 9)
+
+
+class TestQosGate:
+    def _gate(self):
+        return gate_lib.QosGate(buckets_lib.TokenLedger({
+            "capped": {"rate": 1, "burst": 8},
+            "crawler": {"rate": 100, "burst": 1000, "class": "batch"},
+        }, now=0))
+
+    def test_budget_verdict_carries_retry_after(self):
+        g = self._gate()
+        assert g.admit("capped", tokens=8, now=0)
+        v = g.admit("capped", tokens=8, now=0)
+        assert not v and v.reason == "budget"
+        assert v.retry_after == pytest.approx(8.0)
+
+    def test_shed_hits_batch_before_interactive(self):
+        g = self._gate()
+        burning = {"slos": [{"slo": "generate-ttft",
+                             "state": "burning"},
+                            {"slo": "serving-latency",
+                             "state": "burning"}]}
+        assert g.observe_alerts(burning) == {"generate-ttft"}
+        v = g.admit("crawler", tokens=1, now=0)
+        assert not v and v.reason == "shed"
+        assert v.retry_after == gate_lib.SHED_RETRY_AFTER
+        # interactive/standard untouched while batch sheds
+        assert g.admit("capped", tokens=1, now=0)
+        assert g.admit(None, tokens=1, now=0)
+        # SLO recovers -> shedding stops
+        g.observe_alerts({"slos": [{"slo": "generate-ttft",
+                                    "state": "ok"}]})
+        assert g.admit("crawler", tokens=1, now=0)
+
+    def test_unknown_class_refused(self):
+        v = self._gate().admit("capped", qos_class="platinum")
+        assert not v and v.reason == "unknown-class"
+
+    def test_report(self):
+        g = self._gate()
+        g.observe_alerts({"slos": [{"slo": "generate-itg",
+                                    "state": "burning"}]})
+        rep = g.report()
+        assert rep["burning"] == ["generate-itg"]
+        assert rep["shedding"] == ["batch"]
+        assert set(rep["tenants"]) == {"capped", "crawler"}
+
+
+class TestRouterQosGate:
+    """The router refuses BEFORE forwarding: no replicas exist in
+    these stacks, yet over-budget/shed requests get clean 429s (a
+    forwarded request would 503)."""
+
+    def _client(self, gate):
+        core = router_lib.RouterCore(health_interval=600)
+        app = router_lib.create_app(core=core, qos=gate)
+        return core, TestClient(app)
+
+    def test_over_budget_is_429_with_retry_after(self):
+        gate = gate_lib.QosGate(buckets_lib.TokenLedger(
+            {"capped": {"rate": 1, "burst": 8}}, now=0))
+        core, client = self._client(gate)
+        try:
+            gate.ledger.try_charge("capped", 8)    # drain the bucket
+            resp = client.post("/v1/models/m:generate",
+                               json_body={"tokens": [1],
+                                          "max_tokens": 8},
+                               headers={"X-Tenant": "capped"})
+            assert resp.status == 429
+            assert int(resp.headers["Retry-After"]) >= 1
+            assert resp.headers["X-QoS-Class"] == "standard"
+            assert resp.json["reason"] == "budget"
+        finally:
+            core.stop()
+
+    def test_shed_refuses_batch_class_only(self):
+        gate = gate_lib.QosGate(buckets_lib.TokenLedger())
+        gate.observe_alerts({"slos": [{"slo": "generate-ttft",
+                                       "state": "burning"}]})
+        core, client = self._client(gate)
+        try:
+            resp = client.post("/v1/models/m:generate",
+                               json_body={"tokens": [1]},
+                               headers={"X-Tenant": "bg",
+                                        "X-QoS-Class": "batch"})
+            assert resp.status == 429
+            assert resp.json["reason"] == "shed"
+            # non-batch passes the gate (and then 503s: no replicas)
+            resp = client.post("/v1/models/m:generate",
+                               json_body={"tokens": [1]},
+                               headers={"X-Tenant": "bg"})
+            assert resp.status == 503
+        finally:
+            core.stop()
+
+    def test_unknown_class_is_400(self):
+        core, client = self._client(gate_lib.QosGate())
+        try:
+            resp = client.post("/v1/models/m:generate",
+                               json_body={"tokens": [1]},
+                               headers={"X-QoS-Class": "platinum"})
+            assert resp.status == 400
+        finally:
+            core.stop()
+
+    def test_admin_qos_reports_gate_state(self):
+        gate = gate_lib.QosGate(buckets_lib.TokenLedger(
+            {"acme": {"rate": 10, "class": "interactive"}}, now=0))
+        core, client = self._client(gate)
+        try:
+            rep = client.get("/admin/qos").json
+            assert rep["tenants"]["acme"]["class"] == "interactive"
+            assert rep["burning"] == []
+        finally:
+            core.stop()
+
+    def test_within_budget_passes_gate(self):
+        # charged and passed through (503: no replicas) — and the
+        # bucket actually drained
+        gate = gate_lib.QosGate(buckets_lib.TokenLedger(
+            {"capped": {"rate": 1, "burst": 64}}, now=0))
+        core, client = self._client(gate)
+        try:
+            resp = client.post("/v1/models/m:generate",
+                               json_body={"tokens": [1],
+                                          "max_tokens": 64},
+                               headers={"X-Tenant": "capped"})
+            assert resp.status == 503
+            assert gate.ledger.buckets["capped"].level < 1
+        finally:
+            core.stop()

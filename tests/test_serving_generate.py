@@ -424,6 +424,85 @@ class TestDrainSemantics:
             server.stop()
 
 
+class TestQosStreamContract:
+    """ISSUE 17 wire half: a preempted batch stream stays OPEN across
+    its suspension — it carries ``suspended``/``resumed`` event frames
+    (no "token" key: token-consuming clients skip them unchanged), its
+    token indices continue where they left off, its done frame gains
+    the ``qos`` block, and the resolved class rides the mirrored
+    ``X-QoS-Class`` header. Identical over both transports."""
+
+    def test_suspend_resume_stream_lifecycle(self, served, params):
+        _transport, _server, engine, port = served
+        engine._step_sleep = 0.03
+        try:
+            bconn, bresp = _post_generate(
+                port, {"tokens": [1, 2, 3], "max_tokens": 20},
+                headers={"X-Tenant": "crawler",
+                         "X-QoS-Class": "batch"})
+            assert bresp.status == 200
+            assert bresp.headers["X-QoS-Class"] == "batch"
+            # let prompt+emitted fill a whole cache block (8) before
+            # preempting, so the suspension has a full page to retain
+            # and the resume demonstrably skips >= the prompt
+            head = b""
+            while head.count(b"\n") < 6:
+                head += bresp.read1(65536)
+            iconn, iresp = _post_generate(
+                port, {"tokens": [4, 5], "max_tokens": 2},
+                headers={"X-Tenant": "acme",
+                         "X-QoS-Class": "interactive"})
+            assert iresp.status == 200
+            assert iresp.headers["X-QoS-Class"] == "interactive"
+            iframes = _frames(iresp)
+            assert iframes[-1]["done"]
+            assert "qos" not in iframes[-1] or \
+                iframes[-1]["qos"]["preemptions"] == 0
+            iconn.close()
+            engine._step_sleep = 0.0
+            frames = [json.loads(ln)
+                      for ln in (head + bresp.read()).splitlines()
+                      if ln.strip()]
+            bconn.close()
+        finally:
+            engine._step_sleep = 0.0
+        events = [f["event"] for f in frames if "event" in f]
+        assert "suspended" in events and "resumed" in events
+        sus = next(f for f in frames if f.get("event") == "suspended")
+        assert sus["reason"] == "preempted" and sus["tokens"] >= 1
+        res = next(f for f in frames if f.get("event") == "resumed")
+        assert res["prefix_tokens_skipped"] >= 3   # original prompt
+        # event frames carry no "token" key; the token stream itself
+        # is the oracle's, with indices continuing across the gap
+        toks = [f for f in frames if "token" in f]
+        assert "token" not in sus and "token" not in res
+        ref = gen_lib.reference_greedy_decode(params, CFG,
+                                              [1, 2, 3], 20)
+        assert [f["token"] for f in toks] == ref
+        assert [f["index"] for f in toks] == list(range(len(ref)))
+        final = frames[-1]
+        assert final["done"] and final["tokens"] == ref
+        assert final["qos"]["tenant"] == "crawler"
+        assert final["qos"]["class"] == "batch"
+        assert final["qos"]["preemptions"] >= 1
+        assert final["qos"]["resume_prefill_tokens"] >= 1
+        assert final["prefix_tokens_skipped"] >= 3
+        assert engine.occupancy() == 0
+
+    def test_anonymous_stream_unchanged(self, served):
+        """No tenant headers -> byte-identical default contract: no
+        qos block, no event frames."""
+        _transport, _server, _engine_, port = served
+        conn, resp = _post_generate(port, {"tokens": [7, 8],
+                                           "max_tokens": 3})
+        assert resp.status == 200
+        assert resp.headers["X-QoS-Class"] == "standard"
+        frames = _frames(resp)
+        assert all("event" not in f for f in frames)
+        assert "qos" not in frames[-1]
+        conn.close()
+
+
 class TestRouterStreamPassThrough:
     """Satellite: web/router.py must proxy chunked :generate responses
     WITHOUT store-and-forward buffering (the documented :predictStream
