@@ -912,7 +912,7 @@ def bench_generate_sharded(steps, batch):
     re-exec'd with ``--xla_force_host_platform_device_count=4`` so
     the comparison always runs).
 
-    Two phases:
+    Three phases:
 
     - **throughput**: mixed-length prompts through both engines at
       identical geometry; tokens/sec reported for each and every
@@ -930,6 +930,10 @@ def bench_generate_sharded(steps, batch):
       chip blocks). Uniform prompts flood both; the peak concurrent
       occupancy the 4-device engine reaches must be ≥3× the 1-chip
       engine's — cache capacity scales with the mesh.
+    - **row-shard** (ISSUE 18): the ``row_shard=True`` megatron
+      layout on the same mesh — collective time share measured
+      against the all-gather baseline and the psum numerics graded
+      on the tolerance tier (fp32 ``assert_logits_close`` twin).
     """
     import subprocess
     import sys as _sys
@@ -1006,7 +1010,13 @@ def bench_generate_sharded(steps, batch):
     sharded._itg_samples.clear()
     outs_4, tps_4, occ_4, pre_4 = run(sharded)
     tl_4 = _token_latency_cols(sharded)
-    collective_share = sharded.measure_collective_share(iters=3)
+    # best-of-3 calibrations: one host-thread hiccup in the elided
+    # twin left-clamps a single sample to 0.0 on a forced CPU mesh,
+    # so take the max of three honest averages (both layouts get the
+    # identical treatment below)
+    collective_share = max(sharded.measure_collective_share(iters=3)
+                           for _ in range(3))
+    bytes_rep = sharded.collective_bytes_per_step()
     sharded.close()
 
     # in-run conformance: sharded == single == full-recompute oracle
@@ -1014,6 +1024,47 @@ def bench_generate_sharded(steps, batch):
     ref = gen_lib.reference_greedy_decode(params, cfg, sample[0],
                                           sample[1])
     conforms = (outs_4 == outs_1 and outs_4[1] == ref)
+
+    # --- row-shard phase (ISSUE 18): megatron proper on the same
+    # mesh — wo/w_down rows psummed, embed/head over vocab. The win
+    # being measured is the collective bill: the calibrated
+    # collective time share vs the all-gather layout above. The
+    # numeric contract is the tolerance tier, graded here on an fp32
+    # twin through the debug_logits probe (bf16 rows may legally
+    # flip tokens, so token-identity is NOT asserted for bf16).
+    from kubeflow_tpu.compute import conformance
+
+    row = gen_lib.GenerationEngine(
+        params, cfg, max_slots=slots, block_size=16,
+        prefix_cache=False, name="bench-tp4-row", mesh=mesh4,
+        row_shard=True)
+    warm(row)
+    _outs_r, tps_r, _occ_r, _pre_r = run(row)
+    share_row = max(row.measure_collective_share(iters=3)
+                    for _ in range(3))
+    bytes_row = row.collective_bytes_per_step()
+    row.close()
+
+    cfg32 = dataclasses.replace(cfg, dtype="float32")
+    params32 = transformer.init_params(cfg32, jax.random.PRNGKey(0))
+    tol_prompt, tol_m = specs[1]
+    toks32, rows32 = conformance.reference_logits(
+        params32, cfg32, tol_prompt, tol_m)
+    rowdbg = gen_lib.GenerationEngine(
+        params32, cfg32, max_slots=2, block_size=16,
+        prefix_cache=False, debug_logits=True, name="bench-tp4-rowdbg",
+        mesh=mesh4, row_shard=True)
+    try:
+        h = rowdbg.submit(list(tol_prompt), max_tokens=tol_m)
+        assert h.wait(timeout=600)
+        conformance.assert_logits_close(
+            h.logits, rows32, atol=1e-3, rtol=1e-3,
+            what="row-sharded f32 vs oracle")
+        row_tolerance_ok = bool(h.out_tokens == toks32)
+    except AssertionError:
+        row_tolerance_ok = False
+    finally:
+        rowdbg.close()
 
     # --- capacity phase: same PER-CHIP budget, pool scales with mesh.
     # Uniform prompts (24 tokens + 8 generated → 2 blocks reserved
@@ -1056,6 +1107,10 @@ def bench_generate_sharded(steps, batch):
                 "prefill_ms_per_request": round(pre_4, 2),
                 "prefill_ms_per_request_single_chip": round(pre_1, 2),
                 "collective_share": round(collective_share, 4),
+                "collective_share_row_sharded": round(share_row, 4),
+                "collective_bytes_per_step": bytes_rep,
+                "collective_bytes_per_step_row_sharded": bytes_row,
+                "row_sharded_tokens_per_sec": round(tps_r, 1),
                 **tl_4,
                 "capacity_per_chip_block_budget": budget,
                 "capacity_peak_sequences_single_chip": peak_1,
@@ -1066,6 +1121,21 @@ def bench_generate_sharded(steps, batch):
                     "sharded_token_identical_to_single_and_oracle":
                         conforms,
                     "capacity_vs_single_chip_ge_3": cap_ratio >= 3.0,
+                    # honest on a forced CPU mesh: host-thread
+                    # "chips" make the timed calibration noisy, so
+                    # the timed drop is recorded, not gated — the
+                    # structural claim is graded on the analytic
+                    # ring-model bytes (collective_bytes_per_step):
+                    # row-sharding swaps the per-layer
+                    # d_model+ff_dim activation gathers for two
+                    # d_model psums, a deterministic per-layer drop
+                    "row_shard_collective_share_drops":
+                        share_row < collective_share,
+                    "row_shard_per_layer_collective_bytes_drop":
+                        bytes_row["per_layer"]
+                        < bytes_rep["per_layer"],
+                    "row_shard_logits_within_tolerance":
+                        row_tolerance_ok,
                 }}}
 
 
@@ -1497,6 +1567,148 @@ def bench_generate_qos(steps, batch):
                 }}}
 
 
+def bench_generate_chunked(steps, batch):
+    """Chunked-prefill ITG duel (ISSUE 18): one long intruder prompt
+    dropped into a saturated short-stream batch, monolithic vs chunked
+    prefill on identical geometry.
+
+    The failure mode being fixed: a monolithic prefill is ONE jitted
+    program call over the whole (bucketed) prompt, so every in-flight
+    decode stream stalls behind it — the stall shows up as a single
+    giant inter-token gap on each short stream. With
+    ``prefill_chunk=C`` the engine advances the intruder one
+    decode-sized chunk per loop iteration between decode steps, so
+    the short streams' worst gap is one CHUNK's prefill, not the
+    whole prompt's.
+
+    Both engines run the identical schedule: 4 short streams decode,
+    then a 4096-token intruder arrives. Measured per run:
+
+    - **decode ITG p99 of the short streams** (from each handle's raw
+      gap samples — the headline; acceptance ≥3x better chunked),
+    - **tokens/sec** over the whole run (chunked must stay within
+      10%: the interleaving must not tax throughput),
+    - in-run conformance: chunked == monolithic ==
+      ``reference_greedy_decode`` for every stream, intruder
+      included.
+
+    Persists a ``chunked_prefill`` row to BENCH_generate.json."""
+    from kubeflow_tpu.compute import generate as gen_lib
+
+    cfg = transformer.Config(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=2,
+        max_seq=4224, dtype="float32", attention="dense", remat=False,
+        scan_layers=True)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    chunk = 256
+    short_tokens = 60
+    rng = np.random.default_rng(0)
+    shorts = [[int(t) for t in rng.integers(1, cfg.vocab_size, 16)]
+              for _ in range(4)]
+    intruder = [int(t) for t in rng.integers(1, cfg.vocab_size, 4096)]
+
+    def run(prefill_chunk):
+        label = "chunk" if prefill_chunk else "mono"
+        eng = gen_lib.GenerationEngine(
+            params, cfg, max_slots=5, block_size=64,
+            max_context=4224, prefix_cache=False,
+            prefill_chunk=prefill_chunk, name=f"bench-cp-{label}")
+        try:
+            # warm-compile the short bucket, the chunk (or monolithic
+            # 4096) prefill program and decode outside the timed run
+            eng.generate(list(range(1, 17)), max_tokens=2)
+            eng.generate([int(t) for t in
+                          rng.integers(1, cfg.vocab_size, 4096)],
+                         max_tokens=2)
+            s0 = dict(eng.stats)
+            t0 = time.perf_counter()
+            hs = [eng.submit(list(p), max_tokens=short_tokens)
+                  for p in shorts]
+            deadline = time.monotonic() + 120
+            while not all(h.out_tokens for h in hs):
+                assert time.monotonic() < deadline, \
+                    "short streams never started decoding"
+                time.sleep(0.002)
+            hi = eng.submit(list(intruder), max_tokens=4)
+            outs = [h.result(timeout=600)[0] for h in hs]
+            outs.append(hi.result(timeout=600)[0])
+            dt = time.perf_counter() - t0
+            tokens = sum(len(o) for o in outs)
+            # the headline distribution: decode gaps of the SHORT
+            # streams only — the intruder's own gaps are its prefill
+            # economics, not the stall being measured
+            gaps = sorted(g for h in hs for g in h.itg_gaps)
+            p99 = gaps[max(0, -(-99 * len(gaps) // 100) - 1)]
+            return {"outs": outs, "p99": p99,
+                    "delta": _generate_stats_delta(eng, s0, tokens,
+                                                   dt),
+                    "chunks": eng.stats["prefill_chunks"]
+                    - s0["prefill_chunks"],
+                    "tl": _token_latency_cols(eng)}
+        finally:
+            eng.close()
+
+    mono = run(None)
+    chunked = run(chunk)
+
+    refs = [gen_lib.reference_greedy_decode(params, cfg, p,
+                                            short_tokens)
+            for p in shorts]
+    refs.append(gen_lib.reference_greedy_decode(params, cfg,
+                                                intruder, 4))
+    conforms = chunked["outs"] == mono["outs"] == refs
+
+    itg_win = (mono["p99"] / chunked["p99"]
+               if chunked["p99"] else float("inf"))
+    tps_m, tps_c = mono["delta"]["tps"], chunked["delta"]["tps"]
+    tps_ratio = tps_c / tps_m if tps_m else 0.0
+    return {"metric": "generate_chunked_itg_p99_ms",
+            "value": round(1000 * chunked["p99"], 2),
+            "unit": "ms",
+            "vs_monolithic": round(itg_win, 2),
+            "detail": {
+                "prefill_chunk": chunk,
+                "intruder_prompt_tokens": len(intruder),
+                "short_streams": len(shorts),
+                "short_max_tokens": short_tokens,
+                # the chunks delta counts every prefill program call;
+                # the 4 shorts are monolithic (1 each), the rest is
+                # the intruder's chunk ladder
+                "intruder_prefill_chunks":
+                    chunked["chunks"] - len(shorts),
+                "itg_p99_ms_monolithic": round(1000 * mono["p99"],
+                                               2),
+                "itg_p99_improvement": round(itg_win, 2),
+                "tokens_per_sec": round(tps_c, 1),
+                "tokens_per_sec_monolithic": round(tps_m, 1),
+                "tokens_per_sec_ratio": round(tps_ratio, 3),
+                "occupancy": round(chunked["delta"]["occupancy"], 2),
+                "prefill_ms_per_request": round(
+                    chunked["delta"]["prefill_ms"], 2)
+                    if chunked["delta"]["prefill_ms"] else None,
+                **chunked["tl"],
+                "chunked_prefill": {
+                    "itg_p99_ms_chunked": round(
+                        1000 * chunked["p99"], 2),
+                    "itg_p99_ms_monolithic": round(
+                        1000 * mono["p99"], 2),
+                    "itg_p99_improvement": round(itg_win, 2),
+                    "tokens_per_sec_chunked": round(tps_c, 1),
+                    "tokens_per_sec_monolithic": round(tps_m, 1),
+                },
+                "checks": {
+                    "itg_p99_improves_ge_3x": itg_win >= 3.0,
+                    # one-sided: chunking must not COST throughput
+                    # (being faster is fine — each chunk attends
+                    # only to its written prefix, so the chunked
+                    # prefill does about half the monolithic
+                    # causal-matrix FLOPs on top of the ITG win)
+                    "tokens_per_sec_within_10pct": tps_ratio >= 0.90,
+                    "chunked_matches_monolithic_and_oracle":
+                        conforms,
+                }}}
+
+
 def _persist_generate_record(mode, result):
     """The generate track's persisted bench trajectory (satellite of
     ISSUE 13): every generate-mode run appends its headline numbers
@@ -1552,6 +1764,24 @@ def _persist_generate_record(mode, result):
         # TTFT p95 with preemption vs the FIFO baseline, plus the
         # resume-prefill savings the retained pages bought
         entry["qos"] = d["qos"]
+    if d.get("chunked_prefill") is not None:
+        # the chunked-prefill ITG duel (ISSUE 18): short-stream
+        # decode ITG p99 with the long intruder chunked vs
+        # monolithic, both ways, plus the throughput ratio
+        entry["chunked_prefill"] = d["chunked_prefill"]
+    if d.get("collective_share_row_sharded") is not None:
+        # the row-sharded megatron layout (ISSUE 18): calibrated
+        # collective time share vs the all-gather baseline layout,
+        # plus the deterministic ring-model byte accounting (the
+        # per-layer drop is the structural claim; the timed share is
+        # scheduling-noise-bound on a forced CPU mesh)
+        entry["collective_share"] = d.get("collective_share")
+        entry["collective_share_row_sharded"] = \
+            d["collective_share_row_sharded"]
+        entry["collective_bytes_per_step"] = \
+            d.get("collective_bytes_per_step")
+        entry["collective_bytes_per_step_row_sharded"] = \
+            d.get("collective_bytes_per_step_row_sharded")
     doc["runs"] = (doc["runs"] + [entry])[-60:]
     tmp = f"{path}.tmp"
     try:
@@ -1704,20 +1934,22 @@ BENCHES = {
     "generate-spec": (bench_generate_spec, 4),
     "generate-long": (bench_generate_long, 4),
     "generate-qos": (bench_generate_qos, 4),
+    "generate-chunked": (bench_generate_chunked, 4),
     "study": (bench_study, 8),
 }
 
 #: generate-track modes whose headline numbers persist into
 #: BENCH_generate.json (_persist_generate_record)
 _GENERATE_MODES = ("generate", "generate-prefix", "generate-sharded",
-                   "generate-spec", "generate-long", "generate-qos")
+                   "generate-spec", "generate-long", "generate-qos",
+                   "generate-chunked")
 
 
 # default-run order: headline resnet50 LAST (single-line consumers
 # read the final line)
 ALL_ORDER = ["lm", "bert", "serving", "generate", "generate-prefix",
              "generate-sharded", "generate-spec", "generate-long",
-             "generate-qos", "study", "resnet50"]
+             "generate-qos", "generate-chunked", "study", "resnet50"]
 
 
 def main():
@@ -1740,6 +1972,8 @@ def main():
         model = "generate-long"
     if "--qos" in args:
         model = "generate-qos"
+    if "--chunked-prefill" in args:
+        model = "generate-chunked"
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     if model != "all" and model not in BENCHES:
         raise SystemExit(f"unknown BENCH_MODEL {model!r}; expected 'all' "

@@ -180,6 +180,10 @@ def main():
         "serving_qos_preemptions_total",
         "serving_generate_preemptions_total",
         "serving_generate_resume_prefill_tokens_total",
+        # chunked prefill (ISSUE 18): prefill program calls by chunk
+        # economics — what bench.py generate --chunked-prefill and
+        # loadtest --chunked-prefill read alongside the ITG p99 win
+        "serving_generate_prefill_chunks_total",
     }
     registered = {metric.name for metric in obs_metrics.REGISTRY._metrics}
     scratch_names = {metric.name for metric in scratch._metrics}

@@ -341,11 +341,26 @@ def model_server(argv=()):
                 "0", "false", "no", "off"),
             mesh=mesh,
             # GEN_ATTN_BACKEND: the paged-attention read path —
-            # gather (default, the dense-context reference) | paged
-            # (XLA block-streamed) | paged-kernel (Pallas decode
-            # read); loadtest --attn-backend drives this end to end
-            attn_backend=os.environ.get("GEN_ATTN_BACKEND", "gather")
-            or "gather",
+            # paged (the default since the fast-path flip: XLA
+            # block-streamed) | paged-kernel (Pallas kernels on every
+            # pool read) | gather (the dense-context conformance
+            # reference; set it to restore pre-flip behavior);
+            # loadtest --attn-backend drives this end to end
+            attn_backend=os.environ.get("GEN_ATTN_BACKEND", "paged")
+            or "paged",
+            # GEN_PREFILL_CHUNK: tokens per prefill program call
+            # (rounded up to a block multiple; 0 = monolithic) —
+            # chunked prefill interleaves a long prompt's fill with
+            # decode steps; loadtest --chunked-prefill drives this
+            prefill_chunk=int(
+                os.environ.get("GEN_PREFILL_CHUNK", "0") or 0)
+            or None,
+            # GEN_ROW_SHARD: shard wo/w_down/embed/head per the
+            # platform rules (tolerance-tier contract) instead of the
+            # replicated token-identical layout; needs GEN_TP > 1
+            row_shard=os.environ.get(
+                "GEN_ROW_SHARD", "").lower() in (
+                "1", "true", "yes", "on"),
             # tenancy: QOS_TENANTS gives the engine its own copy of
             # the token ledger (the router holds another — same env
             # spec, different process); GEN_PREEMPTION=0 restores the

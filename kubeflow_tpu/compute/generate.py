@@ -53,20 +53,32 @@ built on this repo's own kernels):
   (``quantize.kv_dequantize``), so the cache's HBM footprint and
   read bandwidth drop ~2× vs bf16 at a bounded accuracy cost.
 - **Paged-attention read path** (``attn_backend=``): the decode,
-  speculative-verify and cached-prefix reads can attend DIRECTLY
+  speculative-verify and cached/chunked-prefix reads attend DIRECTLY
   over the paged block pool instead of gathering it into a dense
   ``[S, T, heads, head_dim]`` context per layer per step —
   ``attention.paged_decode_attention``/``paged_chunk_attention``
   run an online-softmax stream over block-table entries (one page
   per slot per step, int8 pages dequantized per block inside the
   loop, whole masked-out blocks skipped), and ``"paged-kernel"``
-  drops the decode read to the Pallas kernel in
-  ``ops/paged_attention.py`` (block tables scalar-prefetched,
-  pages DMA'd per grid step). Decode-step HBM traffic then follows
-  the batch's OCCUPIED context rather than the pool width — the
-  long-context lever. The default ``"gather"`` read stays the
-  token-identity reference; the paged tiers are graded by
-  paged-vs-gather greedy agreement plus the tolerance tier.
+  drops EVERY pool read — decode AND the multi-token chunk reads —
+  to the Pallas kernels in ``ops/paged_attention.py`` (block tables
+  scalar-prefetched, pages DMA'd per grid step). Decode-step HBM
+  traffic then follows the batch's OCCUPIED context rather than the
+  pool width — the long-context lever. ``"paged"`` is the DEFAULT
+  since the fast-path flip; the ``"gather"`` read is the demoted
+  token-identity conformance reference (``GEN_ATTN_BACKEND=gather``
+  restores it), and the paged tiers are graded by paged-vs-gather
+  greedy agreement plus the tolerance tier.
+- **Chunked prefill** (``prefill_chunk=``): a long prompt's prefill
+  splits into ~``prefill_chunk``-token program calls — each a
+  ``_prefill_cached_step`` over the slot's own growing block table —
+  interleaved one chunk per engine-loop iteration with decode steps
+  over the other slots, so an 8k-token intruder becomes N bounded
+  stalls instead of one monolithic one. The win is decode
+  inter-token-gap p99 under long-prompt arrival (``bench.py generate
+  --chunked-prefill`` measures it); token output is UNCHANGED — the
+  chunks write the same K/V the monolithic forward would, and the
+  final chunk's last-position argmax is the same first token.
 - **Tensor-sharded multi-chip serving** (``mesh=``): the whole
   generation path — every prefill bucket, the cached partial prefill
   and the single decode step — runs as ONE full-manual ``shard_map``
@@ -74,14 +86,21 @@ built on this repo's own kernels):
   of the training mesh's megatron layout). Weights partition by the
   platform's ``sharding.spec_for`` rules: attention heads and the MLP
   hidden dim shard over ``tensor`` (wq/wk/wv and w_gate/w_up
-  column-wise, the whole attention read per-head local); the
-  embedding table, LM head and the row projections (wo, w_down) stay
-  replicated, and the per-layer collectives are two all-gathers of
-  RAW activations — a concatenation, never a sum of partials — so
+  column-wise, the whole attention read per-head local); by default
+  the embedding table, LM head and the row projections (wo, w_down)
+  stay replicated, and the per-layer collectives are two all-gathers
+  of RAW activations — a concatenation, never a sum of partials — so
   the sharded program computes bit-identically to the single-chip
   one and greedy decode is token-identical BY CONSTRUCTION, not
   within tolerance (``_gathered`` documents why the psum-of-partials
-  layout was rejected: it flips bf16 tokens). The paged KV block
+  layout was demoted from default: it flips bf16 tokens).
+  ``row_shard=True`` opts into megatron proper — wo/w_down rows
+  sharded and their partial products psummed (``_psummed``), the
+  embedding/LM head partitioned over vocab — cutting the two
+  per-layer raw-activation all-gathers and the replicated HBM
+  copies, under the TOLERANCE-tier contract
+  (``conformance.assert_logits_close``; the documented bf16
+  argmax-flip is exactly what it grades). The paged KV block
   pool is **head-partitioned per chip**: each chip stores
   ``kv_heads / tp`` heads of EVERY block, so a mesh of N chips holds
   N× the cache blocks at the same per-chip HBM budget — model size
@@ -161,6 +180,14 @@ _PREFILL_SECONDS = obs_metrics.REGISTRY.histogram(
     "first token), by prompt-length bucket economics",
     ("model",),
     buckets=(1e-3, 5e-3, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0))
+_PREFILL_CHUNKS_TOTAL = obs_metrics.REGISTRY.counter(
+    "serving_generate_prefill_chunks_total",
+    "Prefill program calls by chunk economics: a monolithic prefill "
+    "counts 1, a chunked long-prompt prefill counts one per chunk — "
+    "rate() of this over prefills is the chunking factor, and a "
+    "sustained high ratio with a low prefill_chunk knob means long "
+    "prompts dominate admission",
+    ("model",))
 _DECODE_STEP_SECONDS = obs_metrics.REGISTRY.histogram(
     "serving_generate_decode_step_seconds",
     "One decode step advancing every occupied slot by one token",
@@ -457,10 +484,18 @@ class GenerationHandle:
 
 
 class _Slot:
-    """One occupied decode slot (engine-thread-only state)."""
+    """One occupied decode slot (engine-thread-only state).
+
+    A slot admitted under chunked prefill starts with
+    ``prefilling=True``: it occupies its decode slot (its reservation
+    is already debited) but is EXCLUDED from decode/verify batches and
+    from preemption until ``_advance_prefills`` has written its whole
+    prompt, one bounded chunk per engine-loop iteration."""
 
     __slots__ = ("handle", "blocks", "length", "last_token", "reserve",
-                 "decode_start_w")
+                 "decode_start_w", "prefilling", "pf_written",
+                 "pf_matched", "pf_remaining", "pf_resuming",
+                 "pf_chunks", "pf_t0", "pf_t0w")
 
     def __init__(self, handle, blocks, length, last_token, reserve):
         self.handle = handle
@@ -469,6 +504,14 @@ class _Slot:
         self.last_token = last_token   # next decode step's input
         self.reserve = reserve     # worst-case total blocks admitted at
         self.decode_start_w = time.time()
+        self.prefilling = False    # chunked prefill still in progress
+        self.pf_written = 0        # prompt tokens whose K/V are cached
+        self.pf_matched = ()       # prefix-trie nodes pinned at admit
+        self.pf_remaining = 0      # max_tokens budget left (resume)
+        self.pf_resuming = False   # this admission is a resume
+        self.pf_chunks = 0         # prefill program calls so far
+        self.pf_t0 = 0.0           # perf_counter at chunked admit
+        self.pf_t0w = 0.0          # wall clock at chunked admit
 
 
 class _PrefixNode:
@@ -543,7 +586,8 @@ class GenerationEngine:
                  default_max_tokens=64, admission="continuous",
                  prefix_cache=True, mesh=None, draft_params=None,
                  draft_config=None, spec_k=0, debug_logits=False,
-                 attn_backend="gather", qos=None, preemption=True):
+                 attn_backend="paged", prefill_chunk=None,
+                 row_shard=False, qos=None, preemption=True):
         if config.moe_experts or config.pipeline_stages > 1:
             raise ValueError(
                 "GenerationEngine supports dense TransformerLM configs "
@@ -559,18 +603,19 @@ class GenerationEngine:
             raise ValueError(
                 f"attn_backend must be 'gather', 'paged' or "
                 f"'paged-kernel', got {attn_backend!r}")
-        # paged-attention read backend: "gather" (the reference —
-        # dense [S, T] context materialized per layer per step,
-        # token-identity contract), "paged" (XLA block-streamed
-        # online softmax over the block tables — no context
-        # materialization, read cost follows OCCUPIED context) or
-        # "paged-kernel" (the decode read additionally drops to the
-        # Pallas kernel in ops/paged_attention.py; the multi-token
-        # chunk reads stay on the XLA streamed path). The paged tiers
-        # reorder the softmax reductions, so their contract is
-        # paged-vs-gather greedy token agreement plus the tolerance
-        # conformance tier, not bit-identity — gather stays the
-        # default so every existing conformance pin is untouched.
+        # paged-attention read backend: "paged" (the DEFAULT since the
+        # fast-path flip — XLA block-streamed online softmax over the
+        # block tables, no context materialization, read cost follows
+        # OCCUPIED context), "paged-kernel" (every pool read — decode,
+        # verify AND the multi-token chunk reads — drops to the Pallas
+        # kernels in ops/paged_attention.py) or "gather" (the dense
+        # [S, T] reference read, demoted to the token-identity
+        # conformance baseline; GEN_ATTN_BACKEND=gather restores it).
+        # The paged tiers reorder the softmax reductions, so their
+        # contract is paged-vs-gather greedy token agreement plus the
+        # tolerance conformance tier, not bit-identity — the flip
+        # shipped only after the engine matrix pinned that agreement
+        # across prefix hits, spec verify, churn and resume.
         self.attn_backend = attn_backend
         self.spec_k = int(spec_k)
         if self.spec_k < 0:
@@ -616,6 +661,25 @@ class GenerationEngine:
                     f" attention heads are partitioned whole per chip "
                     f"(pick a tensor size that divides both, or adjust"
                     f" the model's head counts)")
+        # row-sharded projections (serving ladder rung 4): shard wo /
+        # w_down rows (partial products psummed — graded by the
+        # tolerance tier, not bit-identity) and embed/head over vocab
+        # per the platform's sharding.DEFAULT_RULES, replacing the two
+        # per-layer raw-activation all-gathers and the replicated
+        # embed/head HBM copies. Opt-in: the default sharded engine
+        # keeps the exact token-identity contract of _gathered.
+        self.row_shard = bool(row_shard)
+        if self.row_shard:
+            if mesh is None:
+                raise ValueError(
+                    "row_shard=True needs a mesh (it shards wo/w_down/"
+                    "embed/head over the tensor axis)")
+            if config.vocab_size % self.tp:
+                raise MeshShapeError(
+                    f"row_shard needs the mesh tensor axis {self.tp} "
+                    f"to divide vocab_size={config.vocab_size}: the "
+                    f"embedding table and LM head partition over vocab "
+                    f"rows/columns whole")
         self.config = config
         self.name = name
         self.version = version
@@ -634,6 +698,20 @@ class GenerationEngine:
         self.preemption = bool(preemption)
         self.max_slots = int(max_slots)
         self.block_size = int(block_size)
+        # chunked prefill (serving ladder rung 2): cap every prefill
+        # program call at ~prefill_chunk prompt tokens and interleave
+        # the chunks with decode steps, so a long prompt stops
+        # stalling every in-flight stream for one monolithic forward.
+        # Rounded UP to a block multiple: _write_pages fills whole
+        # fresh blocks, so chunk start offsets must stay block-aligned
+        # for the cached-partial-prefill program to extend them.
+        # 0 / None = monolithic (the pre-chunking engine, exactly).
+        if prefill_chunk:
+            self.prefill_chunk = (
+                -(-int(prefill_chunk) // self.block_size)
+                * self.block_size)
+        else:
+            self.prefill_chunk = 0
         self.max_context = int(max_context or config.max_seq)
         self.blocks_per_slot = -(-self.max_context // self.block_size)
         self.num_blocks = int(num_blocks
@@ -660,12 +738,14 @@ class GenerationEngine:
             params = self._shard_params(params)
         self.params = params
         self.debug_logits = bool(debug_logits)
-        if self.debug_logits and (prefix_cache or mesh is not None
-                                  or self._spec_on):
+        if self.debug_logits and (prefix_cache or self._spec_on
+                                  or self.prefill_chunk):
             raise ValueError(
                 "debug_logits is the plain-path tolerance-conformance "
                 "probe (compute/conformance.py): it requires "
-                "prefix_cache=False, no mesh and no draft model")
+                "prefix_cache=False, no draft model and monolithic "
+                "prefill (a mesh IS allowed — it is how the sharded "
+                "paths are graded under the tolerance tier)")
         # the decode step DONATES the cache (argnum 1): the per-step
         # functional update aliases the input buffers instead of
         # double-buffering the pool (tests pin the no-copy via
@@ -780,7 +860,8 @@ class GenerationEngine:
         self._ttft_samples = collections.deque(maxlen=_LATENCY_SAMPLES)
         self._itg_samples = collections.deque(maxlen=_LATENCY_SAMPLES)
         # aggregate counters bench reads without scraping /metrics
-        self.stats = {"prefills": 0, "decode_steps": 0,
+        self.stats = {"prefills": 0, "prefill_chunks": 0,
+                      "decode_steps": 0,
                       "decode_token_slots": 0, "tokens": 0,
                       "peak_occupancy": 0, "prefill_seconds_total": 0.0,
                       "prefix_hits": 0, "prefix_misses": 0,
@@ -803,17 +884,21 @@ class GenerationEngine:
         attention heads and the MLP hidden dim shard over ``tensor``
         (wq/wk/wv and w_gate/w_up column-wise — the projections that
         dominate prefill FLOPs — plus the whole attention read and
-        the head-partitioned KV pool). The row projections (wo,
-        w_down), embedding table and LM head are REPLICATED: see
-        ``_gathered`` for why the sharded path moves raw activations
-        instead of psumming row-sharded partial products — exact
-        token-identity is the contract."""
+        the head-partitioned KV pool). By default the row projections
+        (wo, w_down), embedding table and LM head are REPLICATED: see
+        ``_gathered`` for why the default sharded path moves raw
+        activations instead of psumming row-sharded partial products —
+        exact token-identity is the contract. ``row_shard=True`` keeps
+        the platform rules as-is instead (wo rows over heads, w_down
+        rows over mlp, embed/head over vocab), trading bit-identity
+        for the tolerance-tier contract (``_psummed``)."""
         cfg = dataclasses.replace(self.config, scan_layers=True)
         specs = sharding.tree_specs(transformer.logical_axes(cfg))
-        specs["embed"] = P()
-        specs["head"] = P()
-        specs["layers"] = dict(specs["layers"],
-                               wo=P(), w_down=P())
+        if not self.row_shard:
+            specs["embed"] = P()
+            specs["head"] = P()
+            specs["layers"] = dict(specs["layers"],
+                                   wo=P(), w_down=P())
         return specs
 
     def _cache_specs(self):
@@ -888,11 +973,16 @@ class GenerationEngine:
         (tokens, tables, lengths, …) replicated; the body's only
         cross-chip traffic is ``_gathered``'s all-gathers."""
         rep = P()
+        # debug_logits programs return a third output (the emitted
+        # row's fp32 logits, replicated once _head_logits gathers)
+        outs = (self._cache_specs(), rep)
+        if self.debug_logits:
+            outs = outs + (rep,)
         return jax.shard_map(
             fn, mesh=self.mesh,
             in_specs=(self._param_specs(), self._cache_specs())
             + (rep,) * n_host_args,
-            out_specs=(self._cache_specs(), rep),
+            out_specs=outs,
             axis_names=set(self.mesh.axis_names), check_vma=False)
 
     def _gathered(self, x, axis):
@@ -926,11 +1016,37 @@ class GenerationEngine:
         return lax.all_gather(x, mesh_lib.TENSOR, axis=axis,
                               tiled=True)
 
+    def _psummed(self, x):
+        """Sum row-sharded partial products across the tensor axis —
+        the ``row_shard=True`` twin of ``_gathered``. Each chip's
+        partial sum rounds before the psum, so this path's contract is
+        the TOLERANCE tier (``assert_logits_close``; the documented
+        bf16 argmax-flip), not bit-identity. Identity when unsharded;
+        under ``_elide_collectives`` the psum is elided (the partial
+        product already has the full output shape, so the calibration
+        twin stays shape-identical with no comm)."""
+        if self.mesh is None \
+                or getattr(self, "_elide_collectives", False):
+            return x
+        return lax.psum(x, mesh_lib.TENSOR)
+
     def _embed(self, table, tokens):
         """Token embedding inside the jitted programs: under the
         full-manual shard_map the (replicated) table is gathered
         directly — ``sharding.embed_lookup``'s constraint machinery
-        targets auto-SPMD contexts, not manual regions."""
+        targets auto-SPMD contexts, not manual regions. Under
+        ``row_shard`` each chip holds a vocab-row slice: look up the
+        rows this chip owns, zero elsewhere, and psum (a one-hot
+        lookup is a sum with exactly one non-zero contributor, so the
+        psum is EXACT — no rounding enters)."""
+        if self.mesh is not None and self.row_shard:
+            vs = table.shape[0]
+            t = lax.axis_index(mesh_lib.TENSOR)
+            idx = tokens - t * vs
+            ok = (idx >= 0) & (idx < vs)
+            rows = jnp.take(table, jnp.clip(idx, 0, vs - 1), axis=0)
+            rows = jnp.where(ok[..., None], rows, 0)
+            return self._psummed(rows)
         if self.mesh is not None:
             return jnp.take(table, tokens, axis=0)
         return sharding.embed_lookup(table, tokens)
@@ -965,12 +1081,18 @@ class GenerationEngine:
                 self._shard(nocollective, 5))
 
         def timed(fn):
+            # min-of-iters, not mean: host-scheduling hiccups only
+            # ever inflate a sample, so the minimum is the honest
+            # step cost (a hiccup in the mean can dwarf the
+            # collective delta being calibrated)
             jax.block_until_ready(fn(self.params, self._cache, *idle))
-            t0 = time.perf_counter()
+            best = float("inf")
             for _ in range(iters):
+                t0 = time.perf_counter()
                 jax.block_until_ready(
                     fn(self.params, self._cache, *idle)[1])
-            return (time.perf_counter() - t0) / iters
+                best = min(best, time.perf_counter() - t0)
+            return best
 
         t_local = timed(self._local_decode_jit)
         # the real program donates its cache arg: keep self._cache the
@@ -986,6 +1108,39 @@ class GenerationEngine:
         self.stats["collective_share"] = round(share, 4)
         _SHARD_COLLECTIVE_SHARE.labels(self.name).set(share)
         return share
+
+    def collective_bytes_per_step(self):
+        """Analytic ring-model collective traffic of ONE decode step
+        for this engine's layout, per chip — the same derived-not-
+        measured idiom as ``serving_generate_attn_bytes_read_total``:
+        an all-gather of an N-byte array delivers ``(tp-1)/tp × N``
+        to each chip, a psum (ring all-reduce) ``2(tp-1)/tp × N``.
+        ``per_layer`` is the default layout's two raw-activation
+        gathers (d_model + ff_dim wide) vs the row layout's two
+        d_model-wide partial-product psums — the per-layer drop
+        row-sharding buys; ``per_step`` is the row layout's fixed
+        surcharge (embed psum + fp32 vocab-sharded head gather, paid
+        once per step, amortized by depth — shallow test configs can
+        legally total higher row-sharded); ``total`` =
+        ``n_layers × per_layer + per_step``. Deterministic where the
+        timed ``measure_collective_share`` is scheduling-noise-bound
+        on a forced host-device mesh; zeros unsharded."""
+        if self.mesh is None or self.tp == 1:
+            return {"per_layer": 0, "per_step": 0, "total": 0}
+        c = self.config
+        rows = self.max_slots          # decode: one token per slot
+        act = jnp.dtype(c.compute_dtype).itemsize
+        ring = (self.tp - 1) / self.tp
+        if self.row_shard:
+            per_layer = 2 * (2 * ring * rows * c.d_model * act)
+            per_step = (2 * ring * rows * c.d_model * act
+                        + ring * rows * c.vocab_size * 4)
+        else:
+            per_layer = ring * rows * (c.d_model + c.ff_dim) * act
+            per_step = 0
+        return {"per_layer": round(per_layer),
+                "per_step": round(per_step),
+                "total": round(c.n_layers * per_layer + per_step)}
 
     def mesh_view(self):
         """The operator-facing sharding summary (snapshot, ``:generate``
@@ -1006,11 +1161,12 @@ class GenerationEngine:
 
     def attn_view(self):
         """The ``:generate`` done frame's ``attn_backend`` field:
-        the selected paged-read backend, or ``None`` on the default
-        gather path so the frame stays byte-compatible with engines
-        predating the backend knob (the snapshot always carries it)."""
-        return None if self.attn_backend == "gather" \
-            else self.attn_backend
+        UNCONDITIONALLY the selected paged-read backend. (Before the
+        paged default flip this returned ``None`` on gather for
+        byte-compatibility with engines predating the knob; with
+        gather demoted to the conformance reference, an explicit
+        ``"gather"`` on the wire is signal, not noise.)"""
+        return self.attn_backend
 
     def spec_view(self, handle=None):
         """Speculative-decoding economics (snapshot + the ``spec``
@@ -1355,6 +1511,12 @@ class GenerationEngine:
                 # docs/observability.md § Generation serving)
                 "attn_backend": self.attn_backend,
                 "attn_bytes_read": self.stats["attn_bytes_read"],
+                # chunked-prefill knob (tokens per prefill program
+                # call, block-multiple; None = monolithic) plus the
+                # cumulative program-call counter behind
+                # serving_generate_prefill_chunks_total
+                "prefill_chunk": self.prefill_chunk or None,
+                "prefill_chunks": self.stats["prefill_chunks"],
                 # sharding view: lets an operator distinguish "the
                 # POOL is exhausted" (grow the mesh or num_blocks)
                 # from "one chip is exhausted" (impossible here by
@@ -1431,7 +1593,13 @@ class GenerationEngine:
                 self._sweep_queued()
                 self._admit()
                 self._sweep_active()
-                if any(s is not None for s in self._slots):
+                # one bounded prefill chunk, then one decode step over
+                # the slots that are PAST prefill — the interleaving
+                # that keeps decode inter-token gaps bounded while a
+                # long prompt fills in
+                self._advance_prefills()
+                if any(s is not None and not s.prefilling
+                       for s in self._slots):
                     if self._spec_on:
                         self._spec_decode_once()
                     else:
@@ -1548,9 +1716,20 @@ class GenerationEngine:
         already resident in the prefix cache. At submit time (match
         unknown: ``matched_blocks=0``) this is the cold ceiling; at
         admission it counts only unshared + writable blocks, which is
-        how shared prefixes INCREASE effective pool capacity."""
+        how shared prefixes INCREASE effective pool capacity. Under
+        chunked prefill only the LAST chunk is bucket-padded (the
+        full chunks are written exactly), so the padded ceiling
+        tightens to k full chunks + the padded remainder."""
         offset = matched_blocks * self.block_size
-        padded_suffix = self._suffix_padded(prompt_len, offset)
+        C = self.prefill_chunk
+        if C and prompt_len - offset > C:
+            k = (prompt_len - offset - 1) // C
+            rem = prompt_len - offset - k * C
+            cap = self.blocks_per_slot * self.block_size
+            padded_suffix = k * C + min(serving_lib.bucket_for(rem),
+                                        C, cap - offset - k * C)
+        else:
+            padded_suffix = self._suffix_padded(prompt_len, offset)
         total = max(offset + padded_suffix, prompt_len + max_tokens)
         return -(-total // self.block_size) - matched_blocks
 
@@ -1716,6 +1895,11 @@ class GenerationEngine:
                 continue
             h = slot.handle
             if not h.preemptible or h.cancelled:
+                continue
+            # a mid-chunked-prefill slot has no resumable decode state
+            # yet (nothing emitted, partial K/V only) — suspending it
+            # would discard its chunks for no freed decode capacity
+            if slot.prefilling:
                 continue
             p = self._qos_priority(h)
             if p >= priority:
@@ -1902,6 +2086,13 @@ class GenerationEngine:
         prompt_len = len(prompt)
         offset = len(matched) * self.block_size
         suffix_len = prompt_len - offset
+        if self.prefill_chunk and suffix_len > self.prefill_chunk:
+            # long-prompt admission: install the slot in PREFILLING
+            # state and let _advance_prefills write one bounded chunk
+            # per engine-loop iteration, interleaved with decode steps
+            self._begin_chunked_prefill(slot_idx, handle, matched,
+                                        resuming, prompt, remaining)
+            return
         padded = self._suffix_padded(prompt_len, offset)
         n_blocks = -(-padded // self.block_size)
         now = time.monotonic()
@@ -2001,6 +2192,9 @@ class GenerationEngine:
         self._record_event("prefill", handle, slot=slot_idx,
                            seconds=round(elapsed, 6))
         self.stats["prefills"] += 1
+        # a monolithic (or short-enough) prefill is one program call
+        self.stats["prefill_chunks"] += 1
+        _PREFILL_CHUNKS_TOTAL.labels(self.name).inc()
         self.stats["prefill_seconds_total"] += elapsed
         if matched:
             # the cached partial prefill read the shared prefix pages
@@ -2047,11 +2241,207 @@ class GenerationEngine:
         elif len(handle.out_tokens) >= handle.max_tokens:
             self._evict(slot_idx, "length")
 
+    # ------------------------------------------------- chunked prefill
+
+    def _begin_chunked_prefill(self, slot_idx, handle, matched,
+                               resuming, prompt, remaining):
+        """Admission half of a chunked prefill: pin the prefix-cache
+        match, book the admission exactly like the monolithic path,
+        and install the slot with ``prefilling=True`` — its block
+        table starts as the pinned prefix pages and grows one chunk's
+        worth of fresh blocks per ``_advance_prefills`` call. The
+        slot's reservation is debited in full here (the chunk-aware
+        ``_worst_case_blocks``), so every later chunk's allocation is
+        guaranteed to succeed — no mid-prefill deadlock against other
+        admissions is possible."""
+        prompt_len = len(prompt)
+        offset = len(matched) * self.block_size
+        now = time.monotonic()
+        with self._cond:
+            for node in matched:
+                if self._ref[node.block] == 0:     # leaves the
+                    self._n_reclaimable -= 1       # reclaimable pool
+                    self._reclaimable.pop(node, None)
+                self._ref[node.block] += 1
+                node.last_used = now
+            prefix_blocks = [n.block for n in matched]
+        if self.prefix_cache:
+            if matched:
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_tokens_skipped"] += offset
+                _PREFIX_HITS_TOTAL.labels(self.name).inc()
+                _PREFIX_TOKENS_SKIPPED_TOTAL.labels(self.name).inc(
+                    offset)
+            else:
+                self.stats["prefix_misses"] += 1
+                _PREFIX_MISSES_TOTAL.labels(self.name).inc()
+        t0 = time.perf_counter()
+        t0w = time.time()
+        handle.admitted_w = t0w
+        wait_s = t0 - handle.enqueued
+        _QUEUE_WAIT_SECONDS.labels(self.name,
+                                   "admitted").observe(wait_s)
+        if handle.rt is not None:
+            handle.rt.phase("generate.queue_wait", handle.enqueued_w,
+                            t0w)
+        self._record_event("admitted", handle, slot=slot_idx,
+                           wait_s=round(wait_s, 6),
+                           chunked_prefill=True)
+        slot = _Slot(handle, prefix_blocks, offset, None,
+                     len(matched) + self._worst_case_blocks(
+                         prompt_len, remaining, len(matched)))
+        slot.prefilling = True
+        slot.pf_written = offset
+        slot.pf_matched = list(matched)
+        slot.pf_remaining = remaining
+        slot.pf_resuming = resuming
+        slot.pf_t0 = t0
+        slot.pf_t0w = t0w
+        with self._cond:
+            self._slots[slot_idx] = slot
+            self._cond.notify()
+
+    def _advance_prefills(self):
+        """Advance AT MOST ONE prefilling slot by ONE chunk, then
+        return — the engine loop runs a decode step over the other
+        slots right after, which is the interleaving that bounds how
+        long a long prompt can stall in-flight streams (the win
+        ``bench.py generate --chunked-prefill`` measures as decode
+        ITG p99). Every chunk is a ``_prefill_cached_step`` call over
+        the slot's OWN block table: full chunks run at exactly
+        ``prefill_chunk`` tokens (one compiled program regardless of
+        prompt length), the final chunk is bucket-padded and returns
+        the first generated token, at which point the slot flips to
+        decoding."""
+        idx = next((i for i, s in enumerate(self._slots)
+                    if s is not None and s.prefilling), None)
+        if idx is None:
+            return
+        slot = self._slots[idx]
+        handle = slot.handle
+        prompt = handle.prompt + handle.out_tokens \
+            if slot.pf_resuming else handle.prompt
+        prompt_len = len(prompt)
+        C = self.prefill_chunk
+        written = slot.pf_written
+        rem = prompt_len - written
+        cap = self.blocks_per_slot * self.block_size
+        is_final = rem <= C
+        chunk_len = rem if is_final else C
+        padded = min(serving_lib.bucket_for(rem), C,
+                     cap - written) if is_final else C
+        n_blocks = -(-padded // self.block_size)
+        prefix_blocks = list(slot.blocks)
+        with self._cond:
+            # guaranteed by the admission-time reservation: the
+            # slot's reserve covers every chunk's padded write
+            fresh = [self._alloc_block_locked()
+                     for _ in range(n_blocks)]
+            slot.blocks.extend(fresh)
+        tokens = np.zeros((padded,), np.int32)
+        tokens[:chunk_len] = prompt[written:written + chunk_len]
+        tables = np.zeros((1, self.blocks_per_slot), np.int32)
+        tables[0, :len(prefix_blocks)] = prefix_blocks
+        t0 = time.perf_counter()
+        t0w = time.time()
+        try:
+            cache, first = self._prefill_cached_jit(
+                self.params, self._cache, tokens,
+                np.int32(chunk_len), np.int32(written), tables,
+                np.asarray(fresh, np.int32))
+        except Exception as e:  # noqa: BLE001 — like _prefill's error
+            # path, but the slot is installed: evicting it releases
+            # every held block (pinned prefix pages cache-retained,
+            # fresh pages freed) and finishes the handle
+            log.exception("chunked prefill failed at offset %d of a "
+                          "%d-token prompt on engine %s", written,
+                          prompt_len, self.name)
+            self._evict(idx, "error", e)
+            return
+        self._cache = cache
+        elapsed = time.perf_counter() - t0
+        slot.pf_chunks += 1
+        self.stats["prefill_chunks"] += 1
+        _PREFILL_CHUNKS_TOTAL.labels(self.name).inc()
+        _PREFILL_SECONDS.labels(self.name).observe(
+            elapsed, trace_id=handle.rt.exemplar(elapsed)
+            if handle.rt is not None else None)
+        self.stats["prefill_seconds_total"] += elapsed
+        if handle.rt is not None:
+            handle.rt.phase("generate.prefill", t0w, rows=padded,
+                            prompt=prompt_len, chunk=slot.pf_chunks,
+                            offset=written)
+        if written:
+            # this chunk's attention read the whole written prefix
+            self._account_attn_read(
+                self._blocks_touched(1, [written]))
+        slot.pf_written = written + chunk_len
+        slot.length = slot.pf_written
+        if not is_final:
+            return
+        # final chunk: the program's last-position argmax is the
+        # first generated token — flip the slot to decoding and run
+        # the same completion bookkeeping as the monolithic path
+        first = int(first)
+        slot.prefilling = False
+        slot.last_token = first
+        slot.decode_start_w = time.time()   # decode starts NOW, not
+        #                                     at chunked admission
+        matched = slot.pf_matched
+        offset = len(matched) * self.block_size
+        suffix_len = prompt_len - offset
+        total_s = time.perf_counter() - slot.pf_t0
+        handle.prefix_tokens_skipped = offset
+        handle.prefill_seconds = total_s
+        self._record_event("prefill", handle, slot=idx,
+                           seconds=round(total_s, 6),
+                           chunks=slot.pf_chunks)
+        self.stats["prefills"] += 1
+        if self._spec_on:
+            # draft prefills the FULL prompt monolithically: it is
+            # tiny (see _prefill) and its dense cache has no chunk
+            # machinery to reuse
+            dpad = self._suffix_padded(prompt_len, 0)
+            dtok = np.zeros((dpad,), np.int32)
+            dtok[:prompt_len] = prompt
+            self._draft_cache = self._draft_prefill_jit(
+                self.draft_params, self._draft_cache, dtok,
+                np.int32(idx))
+        handle.spec_wire = self.spec_header()
+        with self._cond:
+            if self.prefix_cache:
+                self._index_prompt_locked(prompt, slot.blocks,
+                                          matched)
+        self._note_emission_event(handle)
+        if slot.pf_resuming:
+            handle.suspended = False
+            handle.resume_prefill_tokens += suffix_len
+            self.stats["resumes"] += 1
+            self.stats["resume_prefill_tokens"] += suffix_len
+            _RESUME_PREFILL_TOKENS.labels(self.name).inc(suffix_len)
+            self._record_event("resumed", handle, slot=idx,
+                               prefix_tokens_skipped=offset,
+                               prefilled=suffix_len)
+            self._notify_event(handle, "resumed",
+                               prefix_tokens_skipped=offset,
+                               prefilled=suffix_len,
+                               tokens=len(handle.out_tokens))
+        else:
+            self._record_event("first_token", handle, slot=idx,
+                               ttft_s=round(handle.ttft_s, 6))
+        self._emit(handle, first)
+        if handle.eos_id is not None and first == handle.eos_id:
+            self._evict(idx, "eos")
+        elif len(handle.out_tokens) >= handle.max_tokens:
+            self._evict(idx, "length")
+
     # ----------------------------------------------------- decode step
 
     def _decode_once(self):
+        # prefilling slots hold a slot + blocks but have no decode
+        # state yet: they ride as inactive rows (sentinel writes drop)
         active = [(i, s) for i, s in enumerate(self._slots)
-                  if s is not None]
+                  if s is not None and not s.prefilling]
         S, bps, bs = self.max_slots, self.blocks_per_slot, \
             self.block_size
         tables = np.zeros((S, bps), np.int32)
@@ -2131,7 +2521,7 @@ class GenerationEngine:
         ANY draft: every emitted token is the target's own argmax
         given the (verified) true prefix."""
         active = [(i, s) for i, s in enumerate(self._slots)
-                  if s is not None]
+                  if s is not None and not s.prefilling]
         S, bps, bs = self.max_slots, self.blocks_per_slot, \
             self.block_size
         k = self.spec_k
@@ -2374,41 +2764,69 @@ class GenerationEngine:
         the column projections and attention run head/hidden-LOCAL
         and ``_gathered`` widens the two sliced activations back to
         full for the replicated row projections — the layer's only
-        collectives. The DRAFT model's programs pass ``cfg`` (its own
-        config) and ``replicated=True``: the draft runs whole on every
-        chip, so its layer core must not emit gathers."""
+        collectives. Under ``row_shard=True`` the row projections are
+        sharded instead (wo rows over heads, w_down rows over mlp):
+        each chip matmuls its LOCAL slice and ``_psummed`` sums the
+        partial products — megatron proper, tolerance-tier contract.
+        The DRAFT model's programs pass ``cfg`` (its own config) and
+        ``replicated=True``: the draft runs whole on every chip, so
+        its layer core must not emit gathers."""
         c = cfg or self.config
         gathered = ((lambda t, axis: t) if replicated
                     else self._gathered)
+        row_shard = self.row_shard and not replicated
         dt = c.compute_dtype
         h = transformer._rmsnorm(x, lp["attn_norm"].astype(dt))
         q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(dt))
         k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(dt))
         v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(dt))
         o, extra = attend(q, k, v)
-        x = x + jnp.einsum("bshk,hkd->bsd", gathered(o, 2),
-                           lp["wo"].astype(dt))
+        if row_shard:
+            # wo's rows shard over heads — exactly the heads this
+            # chip's attention already produced, so no gather: local
+            # partial product, then one psum of the [b, s, d] output
+            x = x + self._psummed(jnp.einsum(
+                "bshk,hkd->bsd", o, lp["wo"].astype(dt)))
+        else:
+            x = x + jnp.einsum("bshk,hkd->bsd", gathered(o, 2),
+                               lp["wo"].astype(dt))
         h = transformer._rmsnorm(x, lp["mlp_norm"].astype(dt))
         gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"].astype(dt))
         up = jnp.einsum("bsd,df->bsf", h, lp["w_up"].astype(dt))
-        down = jnp.einsum(
-            "bsf,fd->bsd",
-            gathered(jax.nn.silu(gate) * up, 2),
-            lp["w_down"].astype(dt))
+        if row_shard:
+            # w_down's rows shard over mlp — the hidden slice this
+            # chip's w_gate/w_up columns produced
+            down = self._psummed(jnp.einsum(
+                "bsf,fd->bsd", jax.nn.silu(gate) * up,
+                lp["w_down"].astype(dt)))
+        else:
+            down = jnp.einsum(
+                "bsf,fd->bsd",
+                gathered(jax.nn.silu(gate) * up, 2),
+                lp["w_down"].astype(dt))
         return x + down, extra
 
     def _head_logits(self, params, x, cfg=None):
         """Final-norm hidden → fp32 logits (mirrors
         ``transformer._logits`` numerics). ``final_norm``/``head`` are
-        replicated under a mesh, so every chip computes the full vocab
-        row and the greedy argmax identically — no collective on the
-        sampling path. ``cfg`` is the draft's config in its programs."""
+        replicated under a mesh by default, so every chip computes the
+        full vocab row and the greedy argmax identically — no
+        collective on the sampling path. Under ``row_shard`` the head
+        columns shard over vocab: each chip computes its vocab slice
+        and an all-gather rebuilds the full row (a CONCATENATION — the
+        per-slice matmuls are the single-chip ones, so the gathered
+        logits round identically; only wo/w_down's psums are
+        tolerance-graded). ``cfg`` is the draft's config in its
+        programs — the draft stays replicated, so its head is dense."""
         c = cfg or self.config
         x = transformer._rmsnorm(
             x, params["final_norm"].astype(c.compute_dtype))
-        return jnp.einsum("bsd,dv->bsv", x,
-                          params["head"].astype(c.compute_dtype),
-                          preferred_element_type=jnp.float32)
+        logits = jnp.einsum("bsd,dv->bsv", x,
+                            params["head"].astype(c.compute_dtype),
+                            preferred_element_type=jnp.float32)
+        if self.row_shard and cfg is None:
+            logits = self._gathered(logits, 2)
+        return logits
 
     def _write_pages(self, cache, pages, block_ids):
         """Prefill cache fill: ``pages`` = (k, v) each
@@ -2562,12 +2980,15 @@ class GenerationEngine:
     def _attn_chunk_read(self, q, cache_l, tables, prefix_len, k, v,
                          n_rep):
         """Backend dispatch for the multi-token chunk-after-prefix
-        reads (the cached partial prefill's scalar offset, the verify
-        step's per-slot depths): gather-then-``chunk_attention``, or
-        the XLA block-streamed ``paged_chunk_attention`` for BOTH
-        paged backends — the chunk reads are per-request prefix
-        streams where the decode-optimized Pallas grid does not
-        apply."""
+        reads (the cached/chunked partial prefill's scalar offset, the
+        verify step's per-slot depths): gather-then-
+        ``chunk_attention``, the XLA block-streamed
+        ``paged_chunk_attention``, or — on ``paged-kernel`` — the
+        Pallas chunk kernel (``ops.paged_chunk_attention``), which
+        streams the prefix pages through the same scalar-prefetched
+        grid as the decode kernel and folds the chunk itself in the
+        final grid step. With this branch the kernel tier covers all
+        three pool-read sites."""
         if self.attn_backend == "gather":
             pk, pv = self._gather_kv(cache_l, tables)
             return attn_lib.chunk_attention(
@@ -2577,6 +2998,10 @@ class GenerationEngine:
                 attn_lib.repeat_kv(
                     jnp.concatenate([pv, v], axis=1), n_rep),
                 prefix_len)
+        if self.attn_backend == "paged-kernel":
+            return paged_ops.paged_chunk_attention(
+                q, cache_l, tables, prefix_len, k, v,
+                block_size=self.block_size, n_rep=n_rep)
         return attn_lib.paged_chunk_attention(
             q, cache_l, tables, prefix_len, k, v,
             block_size=self.block_size, n_rep=n_rep)

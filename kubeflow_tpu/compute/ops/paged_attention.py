@@ -22,10 +22,15 @@ the kernel body, mirroring ``quantize.kv_dequantize``.
 ``interpret=None`` resolves to "auto" — interpreted off-TPU — so the
 tier-1 CPU suite exercises the REAL kernel path (the flash-attention
 convention; tests/test_paged_attention.py pins parity against the XLA
-block-streamed path). Decode-only by design: the multi-token chunk
-reads (speculative verify, cached partial prefill) stay on the XLA
-streamed path in every backend, where their two-part masks already
-live.
+block-streamed path).
+
+:func:`paged_chunk_attention` extends the same machinery to the
+multi-token chunk reads (speculative verify, cached/chunked partial
+prefill): grid ``(slots, kv_heads, blocks_per_slot + 1)`` streams the
+prefix pages exactly like decode, then the LAST grid step folds the
+in-flight chunk itself with the causal within-chunk mask. With it,
+``attn_backend="paged-kernel"`` covers every pool read the engine
+issues — decode, verify, and partial prefill.
 
 Written against /opt/skills/guides/pallas_guide.md.
 """
@@ -180,3 +185,174 @@ def paged_decode_attention(q, pages, tables, lengths, *, block_size,
         interpret=interpret,
     )(tables, lengths, *operands)
     return out.transpose(0, 2, 1, 3, 4).reshape(S, 1, H, D)
+
+
+def _chunk_kernel(tables_ref, plens_ref, q_ref, k_ref, v_ref, kc_ref,
+                  vc_ref, o_ref, acc_ref, m_ref, l_ref, *, block_size,
+                  n_rep, int8_pages, ks_ref=None, vs_ref=None):
+    """One (slot, kv head, block) grid step of the chunk read: the
+    first ``bps`` steps fold the slot's prefix pages with the
+    row-independent ``col < prefix_len`` mask, the final step folds
+    the in-flight chunk itself under the causal within-chunk mask and
+    writes the normalized output. Query rows arrive flattened to
+    ``[S * n_rep, d]`` (row ``f`` is query position ``f // n_rep`` of
+    the kv head's GQA group), so both folds are single dots."""
+    del tables_ref       # consumed by the index maps (scalar prefetch)
+    i = pl.program_id(0)
+    j = pl.program_id(2)
+    bps = pl.num_programs(2) - 1
+    rows, d = q_ref.shape[2], q_ref.shape[3]
+    chunk = kc_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros((rows, d), jnp.float32)
+        m_ref[:] = jnp.full((rows, 1), NEG_INF, jnp.float32)
+        l_ref[:] = jnp.zeros((rows, 1), jnp.float32)
+
+    plen = plens_ref[i]
+
+    def fold(k, v, valid):
+        q = q_ref[0, 0]                                # [rows, d]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        # mask p explicitly: a fully-masked fold while m is still
+        # NEG_INF must add zero mass (exp(NEG_INF - NEG_INF) = 1)
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * corr + p.sum(axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when((j < bps) & (j * block_size < plen))
+    def _():
+        k = k_ref[0, :, 0, :]                          # [bs, d]
+        v = v_ref[0, :, 0, :]
+        if int8_pages:
+            ks = ks_ref[0, :, 0, :]                    # [bs, 1] fp32
+            vs = vs_ref[0, :, 0, :]
+            k = (k.astype(jnp.float32) * ks).astype(q_ref.dtype)
+            v = (v.astype(jnp.float32) * vs).astype(q_ref.dtype)
+        pos = j * block_size + lax.broadcasted_iota(
+            jnp.int32, (rows, block_size), 1)
+        fold(k, v, pos < plen)
+
+    @pl.when(j == bps)
+    def _():
+        # the in-flight chunk: causal within the chunk (query row f is
+        # position f // n_rep; chunk column c is visible iff c <= pos)
+        kc = kc_ref[0, :, 0, :]                        # [chunk, d]
+        vc = vc_ref[0, :, 0, :]
+        qpos = lax.broadcasted_iota(
+            jnp.int32, (rows, chunk), 0) // n_rep
+        cols = lax.broadcasted_iota(jnp.int32, (rows, chunk), 1)
+        fold(kc, vc, cols <= qpos)
+        # chunk diagonal guarantees l > 0 for every real row; divide
+        # by 1 anyway so a pathological row stays finite, never NaN
+        l = l_ref[:]
+        o_ref[0, 0] = (acc_ref[:]
+                       / jnp.where(l == 0.0, 1.0, l)).astype(
+                           o_ref.dtype)
+
+
+def paged_chunk_attention(q, pages, tables, prefix_len, k_chunk,
+                          v_chunk, *, block_size, n_rep=1, scale=None,
+                          interpret=None):
+    """Kernel-tier twin of ``attention.paged_chunk_attention`` — same
+    signature and (reduction-reordered fp32 online-softmax) numerics
+    contract. ``q`` is the chunk's queries ``[B, S, H, D]``,
+    ``k_chunk``/``v_chunk`` its own K/V ``[B, S, kv_heads, D]``,
+    ``prefix_len`` the per-slot cached-context depth (scalar or
+    ``[B]``); returns ``[B, S, H, D]`` in ``q``'s dtype. The prefix
+    pages stream one per grid step exactly like decode; the chunk
+    itself folds in the final step."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, S, H, D = q.shape
+    kv_heads = H // n_rep
+    bps = tables.shape[1]
+    bs = int(block_size)
+    if scale is None:
+        scale = D ** -0.5
+    int8_pages = len(pages) == 4
+    rows = S * n_rep
+    qr = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    qr = qr.reshape(B, S, kv_heads, n_rep, D).transpose(0, 2, 1, 3, 4)
+    qr = qr.reshape(B, kv_heads, rows, D)
+    tables = tables.astype(jnp.int32)
+    plens = jnp.broadcast_to(
+        jnp.asarray(prefix_len, jnp.int32), (B,))
+
+    grid = (B, kv_heads, bps + 1)
+    # the page index map must stay in-range on the final (chunk) step,
+    # where no page is consumed: clamp j to the last table column
+    in_specs = [
+        pl.BlockSpec((1, 1, rows, D),
+                     lambda i, h, j, tables, plens: (i, h, 0, 0)),
+        pl.BlockSpec((1, bs, 1, D),
+                     lambda i, h, j, tables, plens:
+                         (tables[i, jnp.minimum(j, bps - 1)], 0, h,
+                          0)),
+        pl.BlockSpec((1, bs, 1, D),
+                     lambda i, h, j, tables, plens:
+                         (tables[i, jnp.minimum(j, bps - 1)], 0, h,
+                          0)),
+    ]
+    operands = [qr, pages[0], pages[1]]
+    if int8_pages:
+        in_specs += [
+            pl.BlockSpec((1, bs, 1, 1),
+                         lambda i, h, j, tables, plens:
+                             (tables[i, jnp.minimum(j, bps - 1)], 0,
+                              h, 0)),
+            pl.BlockSpec((1, bs, 1, 1),
+                         lambda i, h, j, tables, plens:
+                             (tables[i, jnp.minimum(j, bps - 1)], 0,
+                              h, 0)),
+        ]
+        operands += [pages[2], pages[3]]
+    in_specs += [
+        pl.BlockSpec((1, S, 1, D),
+                     lambda i, h, j, tables, plens: (i, 0, h, 0)),
+        pl.BlockSpec((1, S, 1, D),
+                     lambda i, h, j, tables, plens: (i, 0, h, 0)),
+    ]
+    operands += [k_chunk, v_chunk]
+
+    kernel = functools.partial(
+        _chunk_kernel, block_size=bs, n_rep=n_rep,
+        int8_pages=int8_pages)
+    if int8_pages:
+        def kernel(tr, plr, q_r, k_r, v_r, ks_r, vs_r, kc_r, vc_r,
+                   o_r, a_r, m_r, l_r):
+            return _chunk_kernel(tr, plr, q_r, k_r, v_r, kc_r, vc_r,
+                                 o_r, a_r, m_r, l_r, block_size=bs,
+                                 n_rep=n_rep, int8_pages=True,
+                                 ks_ref=ks_r, vs_ref=vs_r)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (1, 1, rows, D),
+                lambda i, h, j, tables, plens: (i, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rows, D), jnp.float32),
+                pltpu.VMEM((rows, 1), jnp.float32),
+                pltpu.VMEM((rows, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, kv_heads, rows, D),
+                                       q.dtype),
+        interpret=interpret,
+    )(tables, plens, *operands)
+    return out.reshape(B, kv_heads, S, n_rep, D).transpose(
+        0, 2, 1, 3, 4).reshape(B, S, H, D)

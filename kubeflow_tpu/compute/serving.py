@@ -1758,12 +1758,11 @@ class ModelServer:
                             # round's burst is ONE emission event
                             done.update(
                                 engine.token_latency_view(handle))
-                            # paged-attention read backend; key
-                            # absent on the default gather path so
-                            # the frame stays byte-compatible
-                            ab = engine.attn_view()
-                            if ab is not None:
-                                done["attn_backend"] = ab
+                            # paged-attention read backend —
+                            # UNCONDITIONAL since the paged default
+                            # flip (an explicit "gather" marks the
+                            # conformance-reference path)
+                            done["attn_backend"] = engine.attn_view()
                             # per-request speculative economics
                             # (accepted_per_step + the counts the
                             # mirrored header aggregates); key absent

@@ -825,11 +825,9 @@ class AsyncTransport:
         # the X-TTFT-Ms head exactly — same rounded value
         if handle is not None:
             done.update(req["gen_engine"].token_latency_view(handle))
-        # paged-attention read backend (threaded parity: key absent
-        # on the default gather path — byte-compatible)
-        ab = req["gen_engine"].attn_view()
-        if ab is not None:
-            done["attn_backend"] = ab
+        # paged-attention read backend (threaded parity:
+        # UNCONDITIONAL since the paged default flip)
+        done["attn_backend"] = req["gen_engine"].attn_view()
         # per-request speculative economics (threaded parity: key
         # absent when speculation is off)
         spec = req["gen_engine"].spec_view(handle) \

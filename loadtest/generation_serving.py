@@ -51,7 +51,19 @@ router, and asserts the read-path surfaces end to end: the generator
 snapshot's ``attn_backend`` through the router, strict monotonic
 growth of the analytic ``serving_generate_attn_bytes_read_total``
 counter across phases, the done frames' ``attn_backend`` field
-(absent on gather — byte-compatible), and well-formed streams.
+(carried unconditionally since ISSUE 18 — ``paged`` is the default,
+``gather`` the demoted conformance reference), and well-formed
+streams.
+
+``--chunked-prefill`` (ISSUE 18) spawns TWO replicas — one monolithic,
+one with ``GEN_PREFILL_CHUNK`` — each exporting metric shards, fronts
+both with a real router, and replays the same schedule: short streams
+decode while a long intruder prompt arrives. The short streams' decode
+ITG p99 read off a REAL fleet metrics hub's ``/debug/generate`` must
+improve with chunking (the monolithic run's stall is one giant
+inter-token gap), the snapshot must carry the chunk-size knob, the
+``serving_generate_prefill_chunks_total`` counter must show the
+intruder's chunk ladder, and tokens must be identical both ways.
 
 ``--token-latency`` (ISSUE 16) spawns the replica with a real shard
 exporter (``OBS_EXPORT_DIR``), drives it through a real router, and
@@ -70,6 +82,7 @@ the subprocess pod.
     python loadtest/generation_serving.py --speculative [--spec-k 4]
     python loadtest/generation_serving.py --attn-backend paged
     python loadtest/generation_serving.py --token-latency
+    python loadtest/generation_serving.py --chunked-prefill
 """
 
 import argparse
@@ -132,6 +145,17 @@ def build_argparser():
                          "frame preemption counts), mirror "
                          "X-QoS-Class, and 429 an over-budget tenant "
                          "with Retry-After at the router")
+    ap.add_argument("--chunked-prefill", action="store_true",
+                    help="ISSUE 18 verdict: a long intruder prompt "
+                         "dropped into saturated short streams, "
+                         "replicas spawned with and without "
+                         "GEN_PREFILL_CHUNK and driven through a "
+                         "real router — short-stream decode ITG p99 "
+                         "read off the fleet hub's /debug/generate "
+                         "must improve with chunking, the snapshot "
+                         "must carry the chunk-size knob, and every "
+                         "stream must stay well-formed with "
+                         "identical tokens both ways")
     ap.add_argument("--token-latency", action="store_true",
                     help="ISSUE 16 verdict: the replica exports metric "
                          "shards (OBS_EXPORT_DIR), streams run through "
@@ -166,12 +190,14 @@ def spawn_server(args):
     if args.attn_backend:
         env["GEN_ATTN_BACKEND"] = args.attn_backend
     if getattr(args, "obs_dir", None):
-        # --token-latency: the replica's ModelServer auto-starts a
-        # shard exporter when OBS_EXPORT_DIR resolves — the hub side
-        # of the verdict reads these files
+        # --token-latency / --chunked-prefill: the replica's
+        # ModelServer auto-starts a shard exporter when
+        # OBS_EXPORT_DIR resolves — the hub side of the verdict
+        # reads these files
         env.update(OBS_EXPORT_DIR=args.obs_dir,
                    OBS_EXPORT_INTERVAL="0.5",
                    OBS_POD_NAME="gen-pod-0")
+    env.update(getattr(args, "extra_env", None) or {})
     proc = subprocess.Popen(
         [sys.executable, "-m", "kubeflow_tpu.cmd", "model-server"],
         stdout=subprocess.PIPE, env=env, text=True)
@@ -809,8 +835,9 @@ def run_attn_backend(args, port):
     reads the paged pool through GEN_ATTN_BACKEND, driven through a
     real in-process model-router. Streams must stay byte-well-formed,
     the generator snapshot read THROUGH the router must report the
-    selected backend, non-default backends must stamp the done
-    frames' ``attn_backend`` field, and the analytic
+    selected backend, every done frame must stamp the
+    ``attn_backend`` field (unconditional since ISSUE 18), and the
+    analytic
     ``serving_generate_attn_bytes_read_total{backend}`` counter must
     advance monotonically phase over phase (the read-path accounting
     cannot silently stop)."""
@@ -846,9 +873,11 @@ def run_attn_backend(args, port):
                                              metrics_port=port)
         b2 = scrape_attn_bytes(port, backend)
         results = seq_results + conc_results
+        # ISSUE 18: the done frame names the backend UNCONDITIONALLY
+        # (gather included — it is no longer the default, so silence
+        # would be ambiguous, not byte-compatible)
         frames_backend_ok = all(
-            r["final"].get("attn_backend") ==
-            (backend if backend != "gather" else None)
+            r["final"].get("attn_backend") == backend
             for r in results)
         # the generator snapshot THROUGH the router
         conn = http.client.HTTPConnection("127.0.0.1", router_port,
@@ -884,6 +913,195 @@ def run_attn_backend(args, port):
         core.stop()
 
 
+def scrape_prefill_chunks(port):
+    """→ serving_generate_prefill_chunks_total{model="lm"} value."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", "/metrics")
+    text = conn.getresponse().read().decode()
+    conn.close()
+    mo = re.search(
+        r'^serving_generate_prefill_chunks_total'
+        r'{[^}]*model="lm"[^}]*} ([0-9.e+-]+)', text, re.M)
+    return float(mo.group(1)) if mo else 0.0
+
+
+_INTRUDER_LEN = 2048
+_CHUNK = 64
+
+
+def _chunked_prefill_side(args, chunk):
+    """One verdict side: spawn a fresh replica (chunk=None →
+    monolithic prefill), put 3 short streams in flight through a real
+    router, drop a long intruder prompt mid-decode, and read the
+    decode ITG distribution off a fleet hub over the replica's REAL
+    shard directory."""
+    import tempfile
+
+    from kubeflow_tpu.web import metrics_hub, router as router_lib
+
+    args.obs_dir = tempfile.mkdtemp(prefix="gen-chunk-obs-")
+    # the intruder needs context headroom; the prefix cache is OFF so
+    # the chunk-counter arithmetic below is exact (no skipped fills)
+    args.extra_env = {"GEN_MAX_CONTEXT": str(_INTRUDER_LEN + 64),
+                      "GEN_PREFIX_CACHE": "0"}
+    if chunk:
+        args.extra_env["GEN_PREFILL_CHUNK"] = str(chunk)
+    proc, port = spawn_server(args)
+    core = router_lib.RouterCore(health_interval=0.3)
+    core.set_backends([f"127.0.0.1:{port}"])
+    app = router_lib.create_app(core=core)
+    httpd = app.serve(port=0, host="127.0.0.1")
+    rport = httpd.server_address[1]
+    hub_httpd = None
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            snap = core.snapshot()
+            if snap and snap[0]["healthy"]:
+                break
+            time.sleep(0.05)
+        else:
+            raise SystemExit("replica never turned healthy via the "
+                             "router")
+        # warm every program outside the measured race: the short
+        # bucket + decode, and the intruder-length prefill (monolithic
+        # bucket on one side, the full chunk ladder on the other).
+        # Warm prompts are token-disjoint from the timed set.
+        warm = [run_one(rport, [(7 * j) % 499 + 2
+                                for j in range(9)], 2),
+                run_one(rport, [(11 * j) % 499 + 2
+                                for j in range(_INTRUDER_LEN)], 2)]
+        shorts = [[(13 * i + 17 * j) % 400 + 100 for j in range(9)]
+                  for i in range(3)]
+        intruder = [(j % 499) + 1 for j in range(_INTRUDER_LEN)]
+        events = [threading.Event() for _ in shorts]
+        out = {}
+        lock = threading.Lock()
+        errors = []
+
+        def client(i, prompt):
+            try:
+                r = run_one(rport, prompt, 40,
+                            on_first_chunk=events[i].set)
+                with lock:
+                    out[i] = r
+            except Exception as e:  # noqa: BLE001 — report below
+                with lock:
+                    errors.append(repr(e))
+                events[i].set()     # never deadlock the waiter
+
+        threads = [threading.Thread(target=client, args=(i, p))
+                   for i, p in enumerate(shorts)]
+        for t in threads:
+            t.start()
+        for ev in events:
+            assert ev.wait(60), "short stream never started"
+        assert not errors, errors[:3]
+        # every short stream is mid-decode NOW — drop the intruder
+        intruder_r = run_one(rport, intruder, 4)
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors[:3]
+        results = warm + [out[i] for i in range(len(shorts))] \
+            + [intruder_r]
+        chunks_total = scrape_prefill_chunks(port)
+        # the generator snapshot THROUGH the router carries the knob
+        conn = http.client.HTTPConnection("127.0.0.1", rport,
+                                          timeout=30)
+        conn.request("GET", "/v1/models/lm")
+        snap = json.loads(conn.getresponse().read())
+        conn.close()
+        gen = snap["generator"]
+        # the fleet hub over the replica's REAL shard directory: poll
+        # until the exporter's next flush lands every decode gap
+        expected_gaps = sum(max(0, len(r["tokens"]) - 1)
+                            for r in results)
+        hub_app = metrics_hub.create_app(shard_dir=args.obs_dir)
+        hub_httpd = hub_app.serve(port=0, host="127.0.0.1")
+        hub_port = hub_httpd.server_address[1]
+        itg = {}
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            conn = http.client.HTTPConnection("127.0.0.1", hub_port,
+                                              timeout=30)
+            conn.request("GET", "/debug/generate")
+            view = json.loads(conn.getresponse().read())
+            conn.close()
+            itg = (view.get("models", {}).get("lm", {})
+                   .get("itg") or {})
+            if (itg.get("count") or 0) >= expected_gaps:
+                break
+            time.sleep(0.5)
+        return {
+            "prefill_chunk": chunk,
+            "itg_p99_ms": itg.get("p99_ms"),
+            "itg_count": itg.get("count"),
+            "prefill_chunks_total": chunks_total,
+            "requests": len(results),
+            "snapshot_prefill_chunk": gen.get("prefill_chunk"),
+            "snapshot_attn_backend": gen.get("attn_backend"),
+            "tokens": [r["tokens"] for r in results],
+            "backends": sorted({r["final"].get("attn_backend")
+                                for r in results}),
+        }
+    finally:
+        if hub_httpd is not None:
+            hub_httpd.shutdown()
+        httpd.shutdown()
+        core.stop()
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def run_chunked_prefill(args):
+    """The --chunked-prefill verdict (ISSUE 18): the same intruder
+    scenario against two replicas — GEN_PREFILL_CHUNK unset vs 64 —
+    each driven through a real router with a fleet hub over its shard
+    directory. Chunking must cut the short streams' decode ITG p99
+    (the hub's /debug/generate view), the snapshot must carry the
+    chunk-size knob, the serving_generate_prefill_chunks_total counter
+    must count the intruder's chunk ladder, and both sides must stream
+    the exact same tokens (chunked prefill is an interleaving change,
+    not a numerics change)."""
+    mono = _chunked_prefill_side(args, None)
+    chunked = _chunked_prefill_side(args, _CHUNK)
+    ratio = ((mono["itg_p99_ms"] or 0.0)
+             / max(chunked["itg_p99_ms"] or 0.0, 1e-9))
+    ladder = _INTRUDER_LEN // _CHUNK
+    report = {
+        "mode": "chunked-prefill", "transport": args.transport,
+        "slots": args.slots, "intruder_tokens": _INTRUDER_LEN,
+        "prefill_chunk": _CHUNK,
+        "monolithic": {k: v for k, v in mono.items()
+                       if k != "tokens"},
+        "chunked": {k: v for k, v in chunked.items()
+                    if k != "tokens"},
+        "itg_p99_ratio": round(ratio, 2),
+        "checks": {
+            "itg_p99_improves_with_chunking": ratio >= 1.5,
+            "snapshot_carries_chunk_knob":
+                chunked["snapshot_prefill_chunk"] == _CHUNK
+                and mono["snapshot_prefill_chunk"] is None,
+            # warm long + intruder each fill ladder chunks on the
+            # chunked side vs 1 program call each on the monolithic
+            # side; shorts count 1 either way
+            "chunk_counter_counts_intruder_ladder":
+                chunked["prefill_chunks_total"]
+                >= mono["prefill_chunks_total"] + ladder,
+            "monolithic_counter_one_per_prefill":
+                mono["prefill_chunks_total"] == mono["requests"],
+            "tokens_identical_both_ways":
+                mono["tokens"] == chunked["tokens"],
+            "done_frames_carry_default_backend":
+                mono["backends"] == ["paged"]
+                and chunked["backends"] == ["paged"],
+            "streams_well_formed": True,    # run_one asserted
+        }}
+    print(json.dumps(report, indent=2))
+    if not all(report["checks"].values()):
+        raise SystemExit("chunked-prefill generation loadtest FAILED")
+
+
 def main(argv=None):
     args = build_argparser().parse_args(argv)
     if args.sharded:
@@ -896,6 +1114,10 @@ def main(argv=None):
         # scarcity is the scenario: one decode slot forces the
         # interactive arrival to preempt the resident batch stream
         args.slots = 1
+    if args.chunked_prefill:
+        # spawns its own replicas (one per side) — no shared server
+        run_chunked_prefill(args)
+        return
     proc, port = spawn_server(args)
     try:
         if args.sharded:

@@ -19,6 +19,12 @@ host devices):
 - the decode step donates the sharded cache in place too (per-shard
   buffer pointers stable across a step).
 
+ISSUE 18 adds the ``row_shard=True`` tier: wo/w_down rows sharded with
+their partial products psummed and embed/head partitioned over vocab —
+graded on the TOLERANCE tier (``conformance.assert_logits_close``)
+rather than bit-identity, exactly the contract the psum-of-partials
+layout carries (bf16 partials round before summing).
+
 Engines are module-scoped where possible: every instance compiles its
 own prefill/decode programs, which dominates wall time on CPU.
 """
@@ -28,6 +34,7 @@ import pytest
 
 import jax
 
+from kubeflow_tpu.compute import conformance
 from kubeflow_tpu.compute import generate as gen_lib
 from kubeflow_tpu.compute import mesh as mesh_lib
 from kubeflow_tpu.compute.models import transformer
@@ -325,3 +332,157 @@ class TestShardedSpeculative:
                     == _ref(params, prompt, 8, "bfloat16"), prompt
         finally:
             eng.close()
+
+
+@needs_devices
+class TestRowSharded:
+    """ISSUE 18: megatron-proper row sharding. fp32 stays
+    token-identical in practice on this tiny config (and is asserted
+    against the replicated-weight sharded engine), but the CONTRACT is
+    the tolerance tier — the logits-graded tests are the load-bearing
+    ones."""
+
+    def test_f32_matches_replicated_sharded_engine(self, params,
+                                                   mesh4):
+        specs = [([1, 2, 3], 10), ([5] * 8, 6),
+                 (list(range(1, 18)), 8), ([60, 2], 12)]
+        outs = {}
+        for label, kw in (("row", {"row_shard": True}), ("rep", {})):
+            eng = _engine(params, mesh=mesh4, name=f"row-{label}",
+                          **kw)
+            try:
+                handles = [eng.submit(p, max_tokens=m)
+                           for p, m in specs]
+                outs[label] = [h.result(timeout=120)[0]
+                               for h in handles]
+            finally:
+                eng.close()
+        assert outs["row"] == outs["rep"]
+        for (prompt, m), out in zip(specs, outs["row"]):
+            assert out == _ref(params, prompt, m), prompt
+
+    def test_f32_logits_within_tolerance_of_oracle(self, params,
+                                                   mesh4):
+        """The graded contract: per-token logits from the row-sharded
+        engine vs the cache-free oracle, through the debug_logits
+        probe (allowed WITH a mesh since ISSUE 18 exactly for this)."""
+        prompt, m = [3, 9, 1, 22, 7, 15, 2], 10
+        toks, rows = conformance.reference_logits(
+            params, _config(), prompt, m)
+        eng = _engine(params, mesh=mesh4, row_shard=True,
+                      prefix_cache=False, debug_logits=True,
+                      name="row-tol")
+        try:
+            h = eng.submit(prompt, max_tokens=m)
+            assert h.wait(timeout=120)
+        finally:
+            eng.close()
+        assert h.out_tokens == toks
+        report = conformance.assert_logits_close(
+            h.logits, rows, atol=1e-3, rtol=1e-3,
+            what="row-sharded f32 vs oracle")
+        assert report["steps"] == m
+
+    def test_bf16_logits_within_documented_envelope(self, params,
+                                                    mesh4):
+        """bf16 partials round before the psum — tokens MAY flip, the
+        logits must stay inside the same envelope the unsharded bf16
+        engine documents vs the fp32 oracle."""
+        _toks, rows32 = conformance.reference_logits(
+            params, _config(), [1, 2, 3], 8)
+        eng = _engine(params, "bfloat16", mesh=mesh4, row_shard=True,
+                      prefix_cache=False, debug_logits=True,
+                      name="row-bf16")
+        try:
+            h = eng.submit([1, 2, 3], max_tokens=8)
+            assert h.wait(timeout=120)
+        finally:
+            eng.close()
+        conformance.assert_logits_close(
+            h.logits, rows32, atol=0.2, rtol=0.1,
+            what="row-sharded bf16 vs fp32 oracle")
+
+    def test_prefix_hit_and_paged_kernel_compose(self, params, mesh4):
+        """Row sharding composes with the prefix cache and the Pallas
+        kernel read: the chunked suffix read runs per head-partition
+        while the projections psum."""
+        eng = _engine(params, mesh=mesh4, row_shard=True,
+                      attn_backend="paged-kernel", name="row-px")
+        shared = list(range(1, 17))
+        try:
+            a = shared + [40, 41, 42]
+            assert eng.generate(a, max_tokens=6)[0] \
+                == _ref(params, a, 6)
+            b = shared + [50, 51]
+            assert eng.generate(b, max_tokens=6)[0] \
+                == _ref(params, b, 6)
+            assert eng.stats["prefix_hits"] >= 1
+        finally:
+            eng.close()
+
+    def test_gqa_row_shard_on_2_device_mesh(self):
+        cfg = _config(n_kv_heads=2)
+        pg = transformer.init_params(cfg, jax.random.PRNGKey(3))
+        eng = gen_lib.GenerationEngine(
+            pg, cfg, max_slots=2, block_size=8, max_context=64,
+            name="row-gqa", row_shard=True,
+            mesh=mesh_lib.mesh_for_generation(tensor=2))
+        try:
+            for prompt in ([1, 2, 3], [9] * 10):
+                assert eng.generate(prompt, max_tokens=8)[0] \
+                    == gen_lib.reference_greedy_decode(
+                        pg, cfg, prompt, 8), prompt
+        finally:
+            eng.close()
+
+    def test_collective_share_measurable(self, params, mesh4):
+        """measure_collective_share() still calibrates on the
+        row-sharded engine (the elide-collectives twin skips the
+        psums the same way it skips the gathers)."""
+        eng = _engine(params, mesh=mesh4, row_shard=True,
+                      prefix_cache=False, name="row-share")
+        try:
+            share = eng.measure_collective_share(iters=2)
+        finally:
+            eng.close()
+        assert 0.0 <= share < 1.0
+
+    def test_collective_bytes_per_layer_drop(self, params, sharded,
+                                             mesh4):
+        """The analytic ring-model accounting states the structural
+        claim the CPU-noisy timed share cannot: row-sharding swaps
+        the per-layer d_model+ff_dim activation gathers for two
+        d_model psums (per-layer bytes drop), at a fixed per-step
+        embed/head surcharge the default layout does not pay."""
+        rep = sharded.collective_bytes_per_step()
+        eng = _engine(params, mesh=mesh4, row_shard=True,
+                      prefix_cache=False, name="row-bytes")
+        try:
+            row = eng.collective_bytes_per_step()
+        finally:
+            eng.close()
+        assert row["per_layer"] < rep["per_layer"]
+        assert rep["per_step"] == 0 and row["per_step"] > 0
+        assert rep["total"] > 0 and row["total"] > 0
+        unsharded = _engine(params, name="nomesh-bytes")
+        try:
+            assert unsharded.collective_bytes_per_step() \
+                == {"per_layer": 0, "per_step": 0, "total": 0}
+        finally:
+            unsharded.close()
+
+    def test_row_shard_requires_mesh(self, params):
+        with pytest.raises(ValueError, match="row_shard"):
+            _engine(params, row_shard=True)
+
+    def test_vocab_indivisible_raises_named_error(self, mesh4):
+        cfg = transformer.Config(
+            vocab_size=66, d_model=32, n_layers=2, n_heads=4,
+            max_seq=64, dtype="float32", attention="dense",
+            remat=False, scan_layers=True)
+        pv = transformer.init_params(cfg, jax.random.PRNGKey(5))
+        with pytest.raises(gen_lib.MeshShapeError,
+                           match="vocab_size"):
+            gen_lib.GenerationEngine(
+                pv, cfg, max_slots=2, block_size=8, name="row-bad",
+                mesh=mesh4, row_shard=True)

@@ -1,14 +1,16 @@
-"""Paged-attention read-path conformance matrix (ISSUE 15).
+"""Paged-attention read-path conformance matrix (ISSUE 15 + 18).
 
 The generation engine's ``attn_backend`` knob selects how the decode /
 speculative-verify / cached-prefix reads touch the paged KV block
-pool: ``"gather"`` (the dense-context reference), ``"paged"`` (XLA
-block-streamed online softmax — ``attention.paged_decode_attention`` /
-``paged_chunk_attention``, no ``[S, T]`` context ever materialized) or
-``"paged-kernel"`` (the decode read drops to the Pallas kernel in
+pool: ``"paged"`` (the DEFAULT since ISSUE 18 — XLA block-streamed
+online softmax via ``attention.paged_decode_attention`` /
+``paged_chunk_attention``, no ``[S, T]`` context ever materialized),
+``"paged-kernel"`` (every pool read — decode, speculative verify AND
+the multi-token chunk reads — drops to the Pallas kernels in
 ``ops/paged_attention.py``, block tables scalar-prefetched, pages
 DMA'd per grid step, interpret-mode on CPU so THIS suite runs the real
-kernel path).
+kernel path) or ``"gather"`` (the dense-context conformance
+reference, no longer the default).
 
 The paged tiers reorder the softmax reductions (fp32 online
 accumulation), so their contract is two-part and both parts are pinned
@@ -143,6 +145,76 @@ class TestPagedReadOps:
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(ref), atol=1e-5, rtol=1e-5)
 
+    @pytest.mark.parametrize("dtype,tol", [
+        (jnp.float32, 1e-5), (jnp.bfloat16, 0.02)])
+    @pytest.mark.parametrize("n_rep", [1, 2])
+    @pytest.mark.parametrize("prefix_len", [
+        0, 9, np.asarray([0, 9, 25], np.int32)])
+    def test_chunk_kernel_matrix(self, dtype, tol, n_rep, prefix_len):
+        """ISSUE 18 kernel chunk read: the Pallas multi-token kernel
+        (speculative verify + cached/chunked prefill read) against the
+        gather-semantics ``chunk_attention`` across fp32/bf16 × GQA
+        grouping × empty / scalar / per-slot prefix lengths."""
+        pages, tables, _, k_all, v_all = _pool(dtype, n_rep=n_rep)
+        S, d, kv, Sq = 3, 16, 2, 3
+        rng = np.random.default_rng(13)
+        q = jnp.asarray(rng.normal(size=(S, Sq, kv * n_rep, d)), dtype)
+        kch = jnp.asarray(rng.normal(size=(S, Sq, kv, d)), dtype)
+        vch = jnp.asarray(rng.normal(size=(S, Sq, kv, d)), dtype)
+        ref = attn_lib.chunk_attention(
+            q,
+            attn_lib.repeat_kv(jnp.concatenate([k_all, kch], 1),
+                               n_rep),
+            attn_lib.repeat_kv(jnp.concatenate([v_all, vch], 1),
+                               n_rep),
+            prefix_len)
+        got = paged_ops.paged_chunk_attention(
+            q, pages, tables, prefix_len, kch, vch, block_size=8,
+            n_rep=n_rep)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref, np.float32),
+            atol=tol, rtol=tol)
+
+    def test_chunk_kernel_int8_pages(self):
+        """int8 pool pages dequantize per block inside the chunk
+        kernel; the in-flight chunk stays full precision."""
+        pages, tables, _, k_all, v_all = _pool(int8=True)
+        S, d, kv, n_rep, Sq = 3, 16, 2, 2, 4
+        rng = np.random.default_rng(17)
+        q = jnp.asarray(rng.normal(size=(S, Sq, kv * n_rep, d)),
+                        jnp.float32)
+        kch = jnp.asarray(rng.normal(size=(S, Sq, kv, d)), jnp.float32)
+        vch = jnp.asarray(rng.normal(size=(S, Sq, kv, d)), jnp.float32)
+        prefix_len = np.asarray([0, 9, 25], np.int32)
+        ref = attn_lib.chunk_attention(
+            q,
+            attn_lib.repeat_kv(jnp.concatenate([k_all, kch], 1),
+                               n_rep),
+            attn_lib.repeat_kv(jnp.concatenate([v_all, vch], 1),
+                               n_rep),
+            prefix_len)
+        got = paged_ops.paged_chunk_attention(
+            q, pages, tables, prefix_len, kch, vch, block_size=8,
+            n_rep=n_rep)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+    def test_chunk_kernel_parity_vs_streamed_path(self):
+        """Kernel chunk read vs the XLA streamed chunk read — same
+        parity contract as the decode pair."""
+        pages, tables, _, _, _ = _pool()
+        rng = np.random.default_rng(19)
+        q = jnp.asarray(rng.normal(size=(3, 3, 4, 16)), jnp.float32)
+        kch = jnp.asarray(rng.normal(size=(3, 3, 2, 16)), jnp.float32)
+        vch = jnp.asarray(rng.normal(size=(3, 3, 2, 16)), jnp.float32)
+        plen = np.asarray([8, 17, 25], np.int32)
+        a = attn_lib.paged_chunk_attention(
+            q, pages, tables, plen, kch, vch, block_size=8, n_rep=2)
+        b = paged_ops.paged_chunk_attention(
+            q, pages, tables, plen, kch, vch, block_size=8, n_rep=2)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-6)
+
     def test_kernel_parity_vs_streamed_path(self):
         """Pallas interpret-mode parity against the XLA streamed path
         — the two paged tiers must agree with each other, not just
@@ -202,7 +274,8 @@ class TestPagedEngineConformance:
     @pytest.mark.parametrize("backend", ["paged", "paged-kernel"])
     def test_tokens_match_gather_and_oracle_f32_with_churn(
             self, params, backend):
-        g = _engine(params, name=f"g-{backend}")
+        g = _engine(params, attn_backend="gather",
+                    name=f"g-{backend}")
         p = _engine(params, attn_backend=backend, name=f"p-{backend}")
         try:
             outs_g = _churn(g)
@@ -217,7 +290,8 @@ class TestPagedEngineConformance:
     def test_tokens_match_bf16(self):
         cfg = _config("bfloat16")
         pb = transformer.init_params(cfg, jax.random.PRNGKey(0))
-        g = _engine(pb, dtype="bfloat16", name="g-bf16")
+        g = _engine(pb, dtype="bfloat16", attn_backend="gather",
+                    name="g-bf16")
         p = _engine(pb, dtype="bfloat16", attn_backend="paged",
                     name="p-bf16")
         try:
@@ -297,6 +371,23 @@ class TestPagedEngineConformance:
             eng.close()
         assert out == _ref(params, prompt, m)
 
+    @needs_devices
+    def test_forced_4_device_mesh_kernel_chunked_prefill(self, params):
+        """ISSUE 18 matrix corner: chunked prefill drives the Pallas
+        chunk kernel per head-partition under the forced-4-device
+        tensor shard_map — tokens still equal the oracle."""
+        mesh = mesh_lib.mesh_for_generation(tensor=4)
+        eng = _engine(params, mesh=mesh, attn_backend="paged-kernel",
+                      prefill_chunk=16, name="m4-chunk")
+        prompt, m = [9] * 17, 9
+        try:
+            out, _ = eng.generate(prompt, max_tokens=m)
+            chunks = eng.stats["prefill_chunks"]
+        finally:
+            eng.close()
+        assert out == _ref(params, prompt, m)
+        assert chunks >= 2
+
 
 class TestPagedTolerance:
     """The ``assert_logits_close`` grading for the reduction-reordered
@@ -367,11 +458,103 @@ class TestPagedSurfaces:
         assert byt["paged"] < byt["gather"] / 2
 
     def test_attn_view_wire_compat(self, params):
-        g = _engine(params, name="av-g")
+        """ISSUE 18: the done frame / snapshot carry the backend
+        unconditionally on every engine — gather included."""
+        g = _engine(params, attn_backend="gather", name="av-g")
         p = _engine(params, attn_backend="paged", name="av-p")
         try:
-            assert g.attn_view() is None       # done frame stays
-            assert p.attn_view() == "paged"    # byte-compatible
+            assert g.attn_view() == "gather"
+            assert p.attn_view() == "paged"
         finally:
             g.close()
             p.close()
+
+
+class TestDefaultFlip:
+    """ISSUE 18 default-flip guard: a knob-free engine runs the paged
+    backend, and the default is token-for-token equal to the gather
+    reference across prefix hits, speculative verify, mid-batch churn,
+    and preemption/resume."""
+
+    def test_default_backend_is_paged(self, params):
+        eng = _engine(params, name="flip-def")
+        try:
+            assert eng.attn_backend == "paged"
+            assert eng.attn_view() == "paged"
+            assert eng.snapshot()["attn_backend"] == "paged"
+        finally:
+            eng.close()
+
+    def test_default_matches_gather_with_churn_and_prefix(self,
+                                                          params):
+        shared = list(range(1, 20))
+        extra = [(shared + [21, 22], 6), (shared + [23, 24], 8)]
+        outs = {}
+        for label, kw in (("default", {}),
+                          ("gather", {"attn_backend": "gather"})):
+            eng = _engine(params, prefix_cache=True,
+                          name=f"flip-{label}", **kw)
+            try:
+                outs[label] = _churn(eng) + [
+                    eng.generate(p, max_tokens=m)[0]
+                    for p, m in extra]
+                assert eng.stats["prefix_hits"] >= 1
+            finally:
+                eng.close()
+        assert outs["default"] == outs["gather"]
+
+    def test_default_matches_gather_speculative(self, params):
+        cfg = _config()
+        tp, dp, dc = gen_lib.truncated_draft(params, cfg, 1,
+                                             dampen=0.05)
+        outs = {}
+        for label, kw in (("default", {}),
+                          ("gather", {"attn_backend": "gather"})):
+            eng = gen_lib.GenerationEngine(
+                tp, cfg, max_slots=2, block_size=8, max_context=64,
+                prefix_cache=False, draft_params=dp, draft_config=dc,
+                spec_k=3, name=f"flip-sp-{label}", **kw)
+            try:
+                outs[label] = _churn(eng)
+                assert eng.stats["spec_rounds"] > 0
+            finally:
+                eng.close()
+        assert outs["default"] == outs["gather"]
+
+    def test_default_matches_oracle_under_preemption_resume(self,
+                                                            params):
+        """Preempted-then-resumed streams re-prefill their context
+        through the default paged chunk read; greedy decode stays
+        deterministic, so every stream must still equal the cache-free
+        oracle regardless of when it was suspended."""
+        import random
+        import time
+        rng = random.Random(11)
+        eng = _engine(params, prefix_cache=True, num_blocks=12,
+                      max_context=48, name="flip-preempt")
+        eng._step_sleep = 0.004
+        try:
+            jobs = []
+            for round_ in range(8):
+                prompt = [rng.randint(1, 63)
+                          for _ in range(rng.randint(6, 20))]
+                m = rng.randint(6, 12)
+                jobs.append((prompt, m, eng.submit(
+                    prompt, max_tokens=m, qos_class="batch")))
+                time.sleep(rng.uniform(0.01, 0.04))
+                if round_ % 2:
+                    short = [rng.randint(1, 63)]
+                    sm = rng.randint(1, 3)
+                    jobs.append((short, sm, eng.submit(
+                        short, max_tokens=sm,
+                        qos_class="interactive")))
+            eng._step_sleep = 0.0
+            for _, _, h in jobs:
+                assert h.wait(timeout=120)
+            assert eng.stats["preemptions"] > 0
+            assert eng.stats["resumes"] > 0
+            for prompt, m, h in jobs:
+                assert h.out_tokens == _ref(params, prompt, m)
+        finally:
+            eng._step_sleep = 0.0
+            eng.close()

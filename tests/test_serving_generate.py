@@ -182,6 +182,28 @@ class TestGenerateWire:
                                 "cache_blocks": engine.num_blocks,
                                 "per_chip_blocks": engine.num_blocks}
 
+    def test_done_frame_carries_attn_backend_unconditionally(
+            self, served, params):
+        """ISSUE 18: ``attn_backend`` is no longer elided for the
+        default backend — the terminal frame names the backend on
+        BOTH transports (``"paged"`` since the default flip), and the
+        snapshot mirrors it next to the chunked-prefill knob."""
+        _transport, _server, engine, port = served
+        conn, resp = _post_generate(
+            port, {"tokens": [11, 12], "max_tokens": 2})
+        assert resp.status == 200
+        done = _frames(resp)[-1]
+        conn.close()
+        assert done["attn_backend"] == "paged"
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=60)
+        conn.request("GET", "/v1/models/lm")
+        snap = json.loads(conn.getresponse().read())["generator"]
+        conn.close()
+        assert snap["attn_backend"] == "paged"
+        assert snap["prefill_chunk"] is None    # knob off → explicit
+        assert snap["prefill_chunks"] >= 1      # monolithic counts 1
+
     def test_models_listing_and_snapshot_carry_prefix_view(self,
                                                            served):
         """Satellite: ``/v1/models/<name>`` and the registry listing
