@@ -1709,6 +1709,185 @@ def bench_generate_chunked(steps, batch):
                 }}}
 
 
+def bench_generate_fleet(steps, batch):
+    """Cache-topology-aware fleet routing (ISSUE 19): prefix-affinity
+    consistent-hash routing vs topology-blind scatter across a
+    4-replica fleet, on the 80%-shared chat mix.
+
+    The fleet version of the ``generate-prefix`` story: each replica
+    holds its OWN radix-tree prefix cache, and the router decides
+    which cache a request's prefix lands in. Eight distinct 96-token
+    system prompts (cohorts) fan out ~6 requests each; every replica's
+    block pool is deliberately sized to hold its 1/N affinity share of
+    the cohorts comfortably but NOT all eight, so routing policy — not
+    raw cache capacity — is the variable under test:
+
+    - **affinity** (headline): the real ``HashRing`` +
+      ``RouterCore.affinity_key`` digest (sha1 over the first
+      block_size-multiple of tokens) pins each cohort to one replica.
+      Each shared prefix is filled once fleet-wide and stays hot in
+      its home replica's LRU.
+    - **scatter**: round-robin (the least-outstanding proxy under a
+      uniform load) sprays every cohort across all replicas — each
+      replica's pool sees all eight working sets, thrashes, and
+      re-prefills prefixes the fleet already paid for.
+    - **single-replica warm baseline**: one engine with the fleet's
+      COMBINED pool runs the same set — the hit-ratio oracle the
+      affinity fleet must match (acceptance: within 0.1), proving
+      partitioned caches lose ~nothing to one giant cache.
+
+    Acceptance (ISSUE 19): affinity tokens/sec >= 1.5x scatter, fleet
+    hit ratio within 0.1 of the single-replica warm ratio, and every
+    output token-identical across all three topologies AND the
+    cache-free oracle."""
+    from kubeflow_tpu.compute import generate as gen_lib
+    from kubeflow_tpu.web import router as router_lib
+
+    cfg = transformer.Config(
+        vocab_size=512, d_model=128, n_layers=4, n_heads=4,
+        max_seq=256, dtype="bfloat16", attention="dense", remat=False,
+        scan_layers=True)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    n_replicas = 4
+    n_cohorts = 8
+    per_cohort = 6
+    slots = 2
+    block_size = 16
+    max_tokens = 4
+    # per-replica pool: 1/N of the cohorts (2 systems = 12 blocks) +
+    # their tails + slots' in-flight working set fit; all 8 systems
+    # (48 blocks) do NOT — scatter must reclaim, affinity must not
+    blocks_per_replica = 48
+    rng = np.random.default_rng(0)
+    systems = [[int(t) for t in rng.integers(1, cfg.vocab_size, 96)]
+               for _ in range(n_cohorts)]
+    specs = []
+    for c, system in enumerate(systems):
+        for i in range(per_cohort):
+            if i == per_cohort - 1:     # ~20% fully unique prompts
+                prompt = [int(t) for t in rng.integers(
+                    1, cfg.vocab_size, 96 + (c + i) % 7)]
+            else:                       # ~80% share a cohort system
+                prompt = system + [int(t) for t in rng.integers(
+                    1, cfg.vocab_size, 4 + (7 * c + i) % 9)]
+            specs.append((prompt, max_tokens))
+    order = [int(i) for i in rng.permutation(len(specs))]
+
+    # the REAL router primitives decide affinity placement: the same
+    # ring and digest the live RouterCore uses for :generate
+    core = router_lib.RouterCore(poll_models=False,
+                                 prefix_block=block_size)
+    ring = router_lib.HashRing()
+    ring.rebuild([f"replica-{i}" for i in range(n_replicas)])
+
+    def affinity_assign(i):
+        prompt, _ = specs[i]
+        body = json.dumps({"tokens": prompt}).encode()
+        key, kind = core.affinity_key(
+            "/v1/models/lm:generate", body, {})
+        assert kind == "affinity"
+        return int(ring.node_for(key).split("-")[1])
+
+    def warm_programs(engine):
+        wsys = [int(t) for t in rng.integers(1, cfg.vocab_size, 96)]
+        for tail in ([1, 2, 3], [4, 5, 6, 7], list(range(1, 11))):
+            engine.generate(wsys + tail, max_tokens=2)
+
+    def make_fleet(tag, num_blocks):
+        engines = []
+        for r in range(n_replicas):
+            e = gen_lib.GenerationEngine(
+                params, cfg, max_slots=slots, block_size=block_size,
+                num_blocks=num_blocks,
+                name=f"bench-fleet-{tag}-{r}")
+            warm_programs(e)
+            engines.append(e)
+        return engines
+
+    def run_fleet(engines, assign):
+        s0 = [dict(e.stats) for e in engines]
+        t0 = time.perf_counter()
+        handles = []
+        for i in order:
+            prompt, m = specs[i]
+            handles.append(
+                (i, engines[assign(i)].submit(prompt, max_tokens=m)))
+        outs = [None] * len(specs)
+        for i, h in handles:
+            outs[i] = h.result(timeout=600)[0]
+        dt = time.perf_counter() - t0
+        tokens = sum(len(o) for o in outs)
+        def dsum(k):
+            return sum(e.stats[k] - s[k] for e, s in zip(engines, s0))
+        return {"outs": outs,
+                "tps": tokens / dt if dt else 0.0,
+                "wall_s": dt,
+                "hits": dsum("prefix_hits"),
+                "misses": dsum("prefix_misses"),
+                "tokens_skipped": dsum("prefix_tokens_skipped"),
+                "reclaims": dsum("prefix_reclaims")}
+
+    aff_engines = make_fleet("aff", blocks_per_replica)
+    aff = run_fleet(aff_engines, affinity_assign)
+    for e in aff_engines:
+        e.close()
+
+    sc_engines = make_fleet("sc", blocks_per_replica)
+    sc = run_fleet(sc_engines, lambda i: order.index(i) % n_replicas)
+    for e in sc_engines:
+        e.close()
+
+    base_engine = gen_lib.GenerationEngine(
+        params, cfg, max_slots=slots, block_size=block_size,
+        num_blocks=n_replicas * blocks_per_replica,
+        name="bench-fleet-base")
+    warm_programs(base_engine)
+    base = run_fleet([base_engine], lambda i: 0)
+
+    # conformance: routing topology must never change tokens — all
+    # three fleets agree with each other and the cache-free oracle
+    sample = specs[1][0]
+    ref = gen_lib.reference_greedy_decode(params, cfg, sample,
+                                          max_tokens)
+    conforms = (aff["outs"] == sc["outs"]
+                and aff["outs"] == base["outs"]
+                and aff["outs"][1] == ref)
+    base_engine.close()
+
+    def ratio(r):
+        n = r["hits"] + r["misses"]
+        return r["hits"] / n if n else 0.0
+
+    vs_scatter = aff["tps"] / sc["tps"] if sc["tps"] else 0.0
+    hit_gap = abs(ratio(aff) - ratio(base))
+    return {"metric": "generate_fleet_tokens_per_sec",
+            "value": round(aff["tps"], 1), "unit": "tokens/sec",
+            "vs_scatter": round(vs_scatter, 2),
+            "detail": {
+                "replicas": n_replicas, "slots_per_replica": slots,
+                "blocks_per_replica": blocks_per_replica,
+                "cohorts": n_cohorts, "prompts": len(specs),
+                "hit_ratio": round(ratio(aff), 3),
+                "scatter_tokens_per_sec": round(sc["tps"], 1),
+                "single_replica_tokens_per_sec": round(base["tps"], 1),
+                "hit_ratio_affinity": round(ratio(aff), 3),
+                "hit_ratio_scatter": round(ratio(sc), 3),
+                "hit_ratio_single_replica": round(ratio(base), 3),
+                "prefix_tokens_skipped_affinity":
+                    aff["tokens_skipped"],
+                "prefix_tokens_skipped_scatter": sc["tokens_skipped"],
+                "reclaims_affinity": aff["reclaims"],
+                "reclaims_scatter": sc["reclaims"],
+                "greedy_matches_full_recompute": conforms,
+                "checks": {
+                    "tokens_per_sec_vs_scatter_ge_1.5":
+                        vs_scatter >= 1.5,
+                    "hit_ratio_within_0.1_of_single_replica":
+                        hit_gap <= 0.1,
+                    "greedy_matches_full_recompute": conforms,
+                }}}
+
+
 def _persist_generate_record(mode, result):
     """The generate track's persisted bench trajectory (satellite of
     ISSUE 13): every generate-mode run appends its headline numbers
@@ -1764,6 +1943,18 @@ def _persist_generate_record(mode, result):
         # TTFT p95 with preemption vs the FIFO baseline, plus the
         # resume-prefill savings the retained pages bought
         entry["qos"] = d["qos"]
+    if d.get("scatter_tokens_per_sec") is not None:
+        # the fleet routing duel (ISSUE 19): prefix-affinity vs
+        # scatter tokens/sec and the partitioned-vs-combined cache
+        # hit-ratio gap
+        entry["fleet"] = {
+            "vs_scatter": result.get("vs_scatter"),
+            "scatter_tokens_per_sec": d["scatter_tokens_per_sec"],
+            "hit_ratio_scatter": d.get("hit_ratio_scatter"),
+            "hit_ratio_single_replica":
+                d.get("hit_ratio_single_replica"),
+            "replicas": d.get("replicas"),
+        }
     if d.get("chunked_prefill") is not None:
         # the chunked-prefill ITG duel (ISSUE 18): short-stream
         # decode ITG p99 with the long intruder chunked vs
@@ -1935,6 +2126,7 @@ BENCHES = {
     "generate-long": (bench_generate_long, 4),
     "generate-qos": (bench_generate_qos, 4),
     "generate-chunked": (bench_generate_chunked, 4),
+    "generate-fleet": (bench_generate_fleet, 4),
     "study": (bench_study, 8),
 }
 
@@ -1942,14 +2134,15 @@ BENCHES = {
 #: BENCH_generate.json (_persist_generate_record)
 _GENERATE_MODES = ("generate", "generate-prefix", "generate-sharded",
                    "generate-spec", "generate-long", "generate-qos",
-                   "generate-chunked")
+                   "generate-chunked", "generate-fleet")
 
 
 # default-run order: headline resnet50 LAST (single-line consumers
 # read the final line)
 ALL_ORDER = ["lm", "bert", "serving", "generate", "generate-prefix",
              "generate-sharded", "generate-spec", "generate-long",
-             "generate-qos", "generate-chunked", "study", "resnet50"]
+             "generate-qos", "generate-chunked", "generate-fleet",
+             "study", "resnet50"]
 
 
 def main():
@@ -1974,6 +2167,8 @@ def main():
         model = "generate-qos"
     if "--chunked-prefill" in args:
         model = "generate-chunked"
+    if "--fleet" in args:
+        model = "generate-fleet"
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     if model != "all" and model not in BENCHES:
         raise SystemExit(f"unknown BENCH_MODEL {model!r}; expected 'all' "

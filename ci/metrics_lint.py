@@ -184,6 +184,13 @@ def main():
         # economics — what bench.py generate --chunked-prefill and
         # loadtest --chunked-prefill read alongside the ITG p99 win
         "serving_generate_prefill_chunks_total",
+        # cache-topology-aware fleet routing (ISSUE 19): the token-
+        # aware autoscaling signal + the router's per-policy routing
+        # outcomes — what the ModelDeployment autoscaler, the hub's
+        # /debug/generate routing view, bench.py generate --fleet and
+        # loadtest --shared-prefix --replicas N read
+        "serving_generate_queued_prompt_tokens",
+        "router_route_decisions_total",
     }
     registered = {metric.name for metric in obs_metrics.REGISTRY._metrics}
     scratch_names = {metric.name for metric in scratch._metrics}

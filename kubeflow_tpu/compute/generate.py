@@ -255,6 +255,13 @@ _PREFIX_CACHED_BLOCKS = obs_metrics.REGISTRY.gauge(
     "Physical cache blocks currently indexed by the prefix trie "
     "(reclaimable-at-zero-ref plus pinned-by-live-sequences)",
     ("model",))
+_QUEUED_PROMPT_TOKENS = obs_metrics.REGISTRY.gauge(
+    "serving_generate_queued_prompt_tokens",
+    "Prompt tokens (plus already-generated context of preempted "
+    "resumes) waiting in the admission queue — the token-aware "
+    "autoscaling signal: request counts hide that one queued 4k "
+    "prompt is more backlog than ten queued chat turns",
+    ("model",))
 _PREFIX_RECLAIMS_TOTAL = obs_metrics.REGISTRY.counter(
     "serving_generate_prefix_reclaims_total",
     "Cached zero-ref blocks reclaimed LRU-on-demand to serve a new "
@@ -821,6 +828,7 @@ class GenerationEngine:
         self._free = list(range(self.num_blocks))
         self._slots = [None] * self.max_slots
         self._queue = collections.deque()
+        _QUEUED_PROMPT_TOKENS.labels(self.name).set(0)
         self._cond = threading.Condition()
         # prefix trie state (every mutation under self._cond so
         # blocks_view() can take one consistent snapshot):
@@ -1407,8 +1415,19 @@ class GenerationEngine:
             self._seq += 1
             handle.seq = self._seq
             self._queue.append(handle)
+            self._book_queued_tokens_locked()
             self._cond.notify()
         return handle
+
+    def _book_queued_tokens_locked(self):
+        """Refresh ``serving_generate_queued_prompt_tokens`` (caller
+        holds ``self._cond``). A preempted resume re-queues its prompt
+        PLUS the context already generated — that is the prefill-
+        shaped backlog a scale-up would actually absorb, which is why
+        the autoscaler reads tokens here instead of request counts."""
+        _QUEUED_PROMPT_TOKENS.labels(self.name).set(
+            sum(len(h.prompt) + len(h.out_tokens)
+                for h in self._queue))
 
     def generate(self, tokens, **kwargs):
         """Blocking convenience → ``(generated_tokens, reason)``."""
@@ -1614,6 +1633,7 @@ class GenerationEngine:
         with self._cond:
             queued = list(self._queue)
             self._queue.clear()
+            self._book_queued_tokens_locked()
         for handle in queued:
             self._finish(handle, "draining", serving_lib.DrainingError(
                 f"generation engine {self.name!r} is draining; retry "
@@ -1626,6 +1646,7 @@ class GenerationEngine:
         with self._cond:
             queued = list(self._queue)
             self._queue.clear()
+            self._book_queued_tokens_locked()
         for handle in queued:
             self._finish(handle, "error", error)
         for i, slot in enumerate(self._slots):
@@ -1691,6 +1712,7 @@ class GenerationEngine:
                     self._queue.remove(handle)
                 except ValueError:
                     continue      # admitted by a racing pass
+                self._book_queued_tokens_locked()
             self._finish(handle, reason, err)
 
     def _sweep_active(self):
@@ -1978,8 +2000,10 @@ class GenerationEngine:
                             handle._qos_charged = True
                         handle._qos_deferred = False
                         self._queue.remove(handle)
+                        self._book_queued_tokens_locked()
                 else:
                     self._queue.remove(handle)
+                    self._book_queued_tokens_locked()
             if suspend is not None:
                 self._suspend(suspend, suspend_why)
                 continue
@@ -2035,6 +2059,7 @@ class GenerationEngine:
             handle.enqueued = time.perf_counter()
             handle.enqueued_w = time.time()
             self._queue.append(handle)
+            self._book_queued_tokens_locked()
             self._cond.notify()
         self.stats["preemptions"] += 1
         _EVICTIONS_TOTAL.labels(self.name, "preempted").inc()

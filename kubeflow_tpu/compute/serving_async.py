@@ -349,9 +349,17 @@ class AsyncTransport:
         if self._drain_applied:
             return
         self._drain_applied = True
+        now = time.monotonic()
         for conn in list(self._conns):
+            # the grace window tells an idle keep-alive apart from a
+            # client that CONNECTED while the drain wake was in
+            # flight (state is "head" with no bytes either way):
+            # resetting the latter RSTs a health probe racing the
+            # drain. A reprieved true idler still closes with its
+            # next response (close_after) or the periodic reap.
             if conn.state == "head" and not conn.out and not conn.buf \
-                    and conn.req is None:
+                    and conn.req is None \
+                    and now - conn.last_activity > 0.25:
                 self._close(conn)
 
     def _reap_idle(self):

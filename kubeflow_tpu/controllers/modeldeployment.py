@@ -19,6 +19,7 @@ ago). The decision itself is a pure function (``autoscale_decision``)
 so its hysteresis is unit-testable without a fleet.
 """
 
+import collections
 import logging
 import math
 import os
@@ -45,8 +46,12 @@ _AUTOSCALE_TOTAL = obs_metrics.REGISTRY.counter(
 def autoscale_decision(queue_wait_p50_s, occupancy_mean, current,
                        min_replicas, max_replicas,
                        up_wait_s=0.02, down_wait_s=0.005,
-                       down_occupancy=1.5):
+                       down_occupancy=1.5, *,
+                       queued_prompt_tokens=None, slot_occupancy=None,
+                       up_queued_tokens=64, down_slot_occupancy=1.0):
     """Pure scaling policy → target replica count.
+
+    Predict plane (positional args, unchanged semantics):
 
     - no signal (``queue_wait_p50_s`` is None: no predict traffic this
       window) → hold;
@@ -57,20 +62,73 @@ def autoscale_decision(queue_wait_p50_s, occupancy_mean, current,
       near-empty batches; fewer replicas re-densify them);
     - anything between is the hysteresis band → hold.
 
+    Generation plane (keyword-only — None means no ``:generate``
+    signal this window, policy unchanged). TOKEN-aware, not
+    request-aware: one queued 4k-token prompt is more backlog than ten
+    queued chat turns, and request counts can't see the difference.
+
+    - ``queued_prompt_tokens`` (fleet-summed
+      ``serving_generate_queued_prompt_tokens``) at or above
+      ``up_queued_tokens`` → +1: prompts are parked behind full slot
+      pools and a new replica absorbs whole prefills immediately;
+    - an EMPTY token queue with mean ``slot_occupancy`` (occupied
+      decode slots per step) at or under ``down_slot_occupancy`` →
+      −1, unless the predict plane objects;
+    - a non-empty token queue or busy slots VETO a predict-plane
+      scale-down — cheap unary traffic must not shed a replica whose
+      KV pages are doing work.
+
     One step per evaluation, clamped to [min, max] — the reconcile
     cadence is the ramp limiter."""
     lo = max(1, int(min_replicas))
     hi = max(lo, int(max_replicas))
     current = min(max(int(current), lo), hi)
+    if queued_prompt_tokens is not None \
+            and queued_prompt_tokens >= up_queued_tokens \
+            and current < hi:
+        return current + 1
+    generate_busy = bool(queued_prompt_tokens) or \
+        (slot_occupancy or 0.0) > down_slot_occupancy
     if queue_wait_p50_s is None:
+        if queued_prompt_tokens is not None \
+                and queued_prompt_tokens == 0 \
+                and slot_occupancy is not None \
+                and slot_occupancy <= down_slot_occupancy \
+                and current > lo:
+            return current - 1
         return current
     if queue_wait_p50_s > up_wait_s and current < hi:
         return current + 1
     if queue_wait_p50_s < down_wait_s \
             and (occupancy_mean or 1.0) <= down_occupancy \
+            and not generate_busy \
             and current > lo:
         return current - 1
     return current
+
+
+#: one autoscale observation window; a plain ``(p50, occ)`` 2-tuple
+#: from an injected signals_fn still works (the reconciler indexes the
+#: first two fields and getattr's the rest)
+Signals = collections.namedtuple(
+    "Signals",
+    ("queue_wait_p50_s", "occupancy_mean", "queued_prompt_tokens",
+     "slot_occupancy", "cached_blocks_by_pod"))
+
+
+def scale_down_victims(indices, count, cached_by_index=None):
+    """Which replica indices to retire → list of length ``count``.
+
+    Prefers the ring node whose departure moves the fewest cached
+    prefixes (smallest ``serving_generate_prefix_cached_blocks``):
+    the router's consistent hash remaps the departed node's cohorts
+    to its successor, which re-pays one prefill per moved prefix —
+    so retire the replica holding the least. Ties, and the no-signal
+    default, retire from the top (the pre-existing behavior)."""
+    cached = cached_by_index or {}
+    order = sorted(indices,
+                   key=lambda i: (cached.get(i, 0.0), -int(i)))
+    return order[:max(0, int(count))]
 
 
 def _histogram_quantile(cumulative, q):
@@ -101,17 +159,26 @@ class ShardSignalReader:
     def __call__(self, model):
         shard_dir = self.shard_dir or os.environ.get("OBS_EXPORT_DIR")
         if not shard_dir or not os.path.isdir(shard_dir):
-            return None, None
+            return Signals(None, None, None, None, {})
         from ..obs import aggregate
         primed = model in self._prev
         buckets = {}      # le -> summed cumulative count (delta)
         occ = {"sum": 0.0, "count": 0.0}
+        slots = {"sum": 0.0, "count": 0.0}
+        queued_tokens = None   # gauge: fleet sum, no priming needed
+        cached_by_pod = {}     # gauge: per-pod, last write wins
         cur = {}
         for shard in aggregate.read_shards(shard_dir,
                                            cache=self._cache):
             for name, labels, value in shard.samples:
                 ld = dict(labels)
                 if ld.get("model") != model:
+                    continue
+                if name == "serving_generate_queued_prompt_tokens":
+                    queued_tokens = (queued_tokens or 0.0) + value
+                    continue
+                if name == "serving_generate_prefix_cached_blocks":
+                    cached_by_pod[shard.pod] = value
                     continue
                 key = (shard.pod, name, labels)
                 cur[key] = value
@@ -127,16 +194,28 @@ class ShardSignalReader:
                 elif name == ("serving_batch_occupancy_requests"
                               "_count"):
                     occ["count"] += delta
+                elif name == ("serving_generate_slot_occupancy_slots"
+                              "_sum"):
+                    slots["sum"] += delta
+                elif name == ("serving_generate_slot_occupancy_slots"
+                              "_count"):
+                    slots["count"] += delta
         self._prev[model] = cur
         if not primed:
             # first observation (controller start/restart): the
             # cumulative counters carry the fleet's ENTIRE history —
             # judging them as a delta would scale on traffic from an
-            # hour ago. Prime the baseline and report no signal.
-            return None, None
+            # hour ago. Prime the baseline and report no RATE signal.
+            # The GAUGES stay live: queued prompt tokens are backlog
+            # that exists right now, not history.
+            return Signals(None, None, queued_tokens, None,
+                           cached_by_pod)
         p50 = _histogram_quantile(buckets, 0.5)
         occ_mean = occ["sum"] / occ["count"] if occ["count"] else None
-        return p50, occ_mean
+        slot_occ = slots["sum"] / slots["count"] \
+            if slots["count"] else None
+        return Signals(p50, occ_mean, queued_tokens, slot_occ,
+                       cached_by_pod)
 
 
 class ModelDeploymentReconciler(Reconciler):
@@ -144,10 +223,16 @@ class ModelDeploymentReconciler(Reconciler):
     API = f"{mdapi.GROUP}/{mdapi.VERSION}"
 
     def __init__(self, signals_fn=None, autoscale_interval=5.0):
-        #: ``signals_fn(model) -> (queue_wait_p50_s, occupancy_mean)``
-        #: — injectable for tests; default reads the telemetry shards
+        #: ``signals_fn(model) -> Signals`` (or a plain ``(p50, occ)``
+        #: 2-tuple) — injectable for tests; default reads the
+        #: telemetry shards
         self.signals = signals_fn or ShardSignalReader()
         self.autoscale_interval = autoscale_interval
+        #: last cached-prefix-footprint view per deployment (pod ->
+        #: serving_generate_prefix_cached_blocks), remembered from the
+        #: signals read that DECIDED a scale-down so the deletion pass
+        #: one reconcile later picks the same victim
+        self._cached_by_pod = {}
 
     def setup(self, builder):
         builder.watch_for(self.API, mdapi.KIND)
@@ -187,6 +272,23 @@ class ModelDeploymentReconciler(Reconciler):
         m.set_controller_reference(pod, md)
         return pod
 
+    def _cached_by_index(self, name):
+        """Per-replica-index prefix-cache footprint for deployment
+        ``name``, from the view remembered at decision time (pod
+        shard identities are ``<name>-replica-<i>``) → {index:
+        cached_blocks}. Empty when no generate telemetry — the
+        victim choice then defaults to retiring from the top."""
+        out = {}
+        prefix = f"{name}-replica-"
+        for pod, value in (self._cached_by_pod.get(name)
+                           or {}).items():
+            if pod.startswith(prefix):
+                try:
+                    out[int(pod[len(prefix):])] = value
+                except ValueError:
+                    pass
+        return out
+
     def reconcile(self, req):
         md = self.store.try_get(self.API, mdapi.KIND, req.name,
                                 req.namespace)
@@ -208,32 +310,47 @@ class ModelDeploymentReconciler(Reconciler):
         pods = {m.name_of(p): p for p in self.store.list(
             "v1", "Pod", req.namespace,
             label_selector={LABEL: req.name})}
-        for i in range(desired):
-            pod_name = f"{req.name}-replica-{i}"
-            if pod_name not in pods:
-                try:
-                    self.store.create(self._replica_pod(md, i))
-                except AlreadyExistsError:
-                    pass
+        # index -> pod name, holes allowed: a victim-preference scale
+        # -down may retire a MIDDLE index, and the survivors must keep
+        # their indices (ports, shard identities, ring positions)
+        index_of = {}
         for pod_name, p in pods.items():
             idx = m.labels_of(p).get("model-deployment-index")
-            if idx is not None and int(idx) >= desired \
-                    and not m.deep_get(p, "metadata",
-                                       "deletionTimestamp"):
-                # scale down from the top: the router's health poll
-                # drops the endpoint; in-flight requests on it finish
-                # (the pod's server drains on SIGTERM)
+            if idx is not None and not m.deep_get(
+                    p, "metadata", "deletionTimestamp"):
+                index_of[int(idx)] = pod_name
+        missing = desired - len(index_of)
+        if missing > 0:
+            # fill at the lowest free indices (holes are re-used)
+            i = 0
+            while missing > 0:
+                if i not in index_of:
+                    try:
+                        self.store.create(self._replica_pod(md, i))
+                    except AlreadyExistsError:
+                        pass
+                    index_of[i] = f"{req.name}-replica-{i}"
+                    missing -= 1
+                i += 1
+        elif missing < 0:
+            # the router's health poll drops the victim's endpoint;
+            # in-flight requests on it finish (the pod's server
+            # drains on SIGTERM)
+            cached = self._cached_by_index(req.name)
+            for idx in scale_down_victims(sorted(index_of),
+                                          -missing, cached):
                 try:
-                    self.store.delete("v1", "Pod", pod_name,
+                    self.store.delete("v1", "Pod",
+                                      index_of.pop(idx),
                                       req.namespace)
                 except NotFoundError:
                     pass
 
         ready, endpoints = 0, []
-        for i in range(desired):
-            p = pods.get(f"{req.name}-replica-{i}")
+        for i in sorted(index_of):
+            p = pods.get(index_of[i])
             if p is None:
-                continue
+                continue    # created this pass; not Running yet
             if m.deep_get(p, "status", "phase") == "Running":
                 ready += 1
                 ip = m.deep_get(p, "status", "podIP",
@@ -254,18 +371,30 @@ class ModelDeploymentReconciler(Reconciler):
         if autoscaling and ready >= desired:
             # only judge a stable fleet: mid-rollout queue waits are
             # startup artifacts, not capacity signals
-            p50, occ = self.signals(spec.get("model", "default"))
-            target = autoscale_decision(p50, occ, desired, lo, hi)
+            sig = self.signals(spec.get("model", "default"))
+            p50, occ = sig[0], sig[1]
+            queued_tokens = getattr(sig, "queued_prompt_tokens", None)
+            slot_occ = getattr(sig, "slot_occupancy", None)
+            self._cached_by_pod[req.name] = dict(
+                getattr(sig, "cached_blocks_by_pod", None) or {})
+            target = autoscale_decision(
+                p50, occ, desired, lo, hi,
+                queued_prompt_tokens=queued_tokens,
+                slot_occupancy=slot_occ)
             if target != desired:
                 direction = "up" if target > desired else "down"
                 _AUTOSCALE_TOTAL.labels(req.name, direction).inc()
                 log.info("autoscale %s/%s: %d -> %d (queue_wait_p50="
-                         "%s occupancy=%s)", req.namespace, req.name,
-                         desired, target, p50, occ)
+                         "%s occupancy=%s queued_prompt_tokens=%s "
+                         "slot_occupancy=%s)", req.namespace,
+                         req.name, desired, target, p50, occ,
+                         queued_tokens, slot_occ)
                 new_status["targetReplicas"] = target
                 new_status["lastScale"] = {
                     "from": desired, "to": target,
                     "queueWaitP50S": p50, "occupancyMean": occ,
+                    "queuedPromptTokens": queued_tokens,
+                    "slotOccupancy": slot_occ,
                     "at": m.now_iso()}
         if status.get("lastScale") and "lastScale" not in new_status:
             new_status["lastScale"] = status["lastScale"]

@@ -199,6 +199,8 @@ def create_app(store=None, shard_dir=None):
         # view has no pod dimension by design — counters there are
         # fleet totals)
         pods = {}
+        queued_tokens = {}     # model -> fleet-summed backlog gauge
+        routing = {"decisions": {}, "pods": {}}
         for shard in (aggregate.read_shards(shard_dir)
                       if shard_dir else []):
             pod_ttft = aggregate.histogram_view(
@@ -212,6 +214,25 @@ def create_app(store=None, shard_dir=None):
                     entry["ttft"] = latency_ms(pod_ttft[(model,)])
                 if (model,) in pod_itg:
                     entry["itg"] = latency_ms(pod_itg[(model,)])
+            for name, labels, value in shard.samples:
+                ld = dict(labels)
+                if name == "serving_generate_queued_prompt_tokens":
+                    model = ld.get("model", "")
+                    queued_tokens[model] = \
+                        queued_tokens.get(model, 0) + int(value)
+                elif name == "router_route_decisions_total":
+                    # route-policy context: how :generate traffic was
+                    # PLACED on those pods (affinity | session |
+                    # spill | scatter), fleet-wide and per router pod
+                    policy = ld.get("policy", "")
+                    outcome = ld.get("outcome", "")
+                    fleet = routing["decisions"].setdefault(
+                        policy, {})
+                    fleet[outcome] = fleet.get(outcome, 0) \
+                        + int(value)
+                    routing["pods"].setdefault(
+                        shard.pod, {}).setdefault(policy, {})[
+                        outcome] = int(value)
 
         models = {}
         for (model,) in set(ttft) | set(itg):
@@ -234,6 +255,7 @@ def create_app(store=None, shard_dir=None):
                 "spec_acceptance": round(a / p, 4) if p else None,
                 "prefix_hit_ratio": round(h / (h + m), 4)
                     if h + m else None,
+                "queued_prompt_tokens": queued_tokens.get(model, 0),
                 "pods": pods.get(model, {}),
             }
 
@@ -278,7 +300,7 @@ def create_app(store=None, shard_dir=None):
                 "throttled": throttled.get(tenant, {}),
             }
         return {"shardDir": shard_dir, "models": models,
-                "tenants": tenants}
+                "tenants": tenants, "routing": routing}
 
     @app.get("/api/alerts")
     def alerts(request):

@@ -24,6 +24,8 @@ The router is itself a ``web.http.App``: it inherits ``/metrics``,
 in the same latency anatomy as the replicas behind it.
 """
 
+import bisect
+import hashlib
 import http.client
 import json
 import logging
@@ -53,6 +55,13 @@ _OUTSTANDING = obs_metrics.REGISTRY.gauge(
     "Predict requests currently in flight through the router per "
     "replica — the least-outstanding routing signal",
     ("replica",))
+_ROUTE_DECISIONS = obs_metrics.REGISTRY.counter(
+    "router_route_decisions_total",
+    "``:generate`` routing decisions by active policy and outcome: "
+    "affinity (prefix-digest ring hit), session (X-Session-Id ring "
+    "hit), spill (affinity target saturated, deterministic successor "
+    "took it), scatter (no ring key — least-outstanding fallback)",
+    ("policy", "outcome"))
 
 #: request headers forwarded to the replica (hop-by-hop headers are not)
 _FORWARD_HEADERS = ("content-type", "x-tensor-dtype", "x-tensor-shape",
@@ -60,7 +69,11 @@ _FORWARD_HEADERS = ("content-type", "x-tensor-dtype", "x-tensor-shape",
                     # tenancy: the engine applies the same QoS ledger
                     # the router's gate charged (priority admission +
                     # preemptible decoding key off these)
-                    "x-tenant", "x-qos-class")
+                    "x-tenant", "x-qos-class",
+                    # session affinity: multi-turn chat keys the ring
+                    # ahead of the prefix digest, so turn N+1 lands on
+                    # the replica retaining turn N's KV pages
+                    "x-session-id")
 #: response headers mirrored back to the client
 _MIRROR_HEADERS = ("Content-Type", "X-Tensor-Dtype", "X-Tensor-Shape",
                    "X-Inference-Time-Ms", "X-Served-Version",
@@ -87,6 +100,52 @@ _MIRROR_HEADERS = ("Content-Type", "X-Tensor-Dtype", "X-Tensor-Shape",
                    "Retry-After")
 
 
+def _ring_point(s):
+    """Stable 64-bit ring position for ``s`` — hashlib, never
+    ``hash()``, whose per-process salt would scramble the ring between
+    router restarts (and between the router and any test oracle)."""
+    return int.from_bytes(hashlib.sha1(s.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    """Replica-count-stable consistent-hash ring over endpoints.
+
+    Each endpoint owns ``vnodes`` points on a 64-bit circle; a key
+    routes to the first point at-or-after its own position. Because a
+    join/leave only inserts/removes that ONE endpoint's points, only
+    the keys in the arcs it owned move (~1/N of the keyspace) — every
+    other shared-prefix cohort stays where its KV pages already live.
+    """
+
+    def __init__(self, vnodes=128):
+        self.vnodes = vnodes
+        self._points = []        # sorted [(point, endpoint), ...]
+
+    def rebuild(self, endpoints):
+        points = [(_ring_point(f"{ep}#{v}"), ep)
+                  for ep in endpoints for v in range(self.vnodes)]
+        points.sort()
+        self._points = points    # atomic swap: walkers keep old list
+
+    def walk(self, key):
+        """Yield distinct endpoints in deterministic successor order
+        starting at ``key``'s ring position — element 0 is the
+        affinity target, the rest is the spill order."""
+        points = self._points
+        if not points:
+            return
+        start = bisect.bisect_left(points, (_ring_point(key), ""))
+        seen = set()
+        for i in range(len(points)):
+            ep = points[(start + i) % len(points)][1]
+            if ep not in seen:
+                seen.add(ep)
+                yield ep
+
+    def node_for(self, key):
+        return next(self.walk(key), None)
+
+
 class Replica:
     """One backend endpoint + its keep-alive connection pool."""
 
@@ -107,6 +166,11 @@ class Replica:
         self.drained = False             # set by RouterCore.drain()
         self.reported_draining = False   # last healthz verdict
         self.outstanding = 0
+        # generator snapshots from the health poll's /v1/models fetch:
+        # model name -> {slots, occupied, queued, free_blocks,
+        # block_size, hit_ratio} — the spill threshold and the prefix
+        # digest's block quantum read from here
+        self.gen_view = {}
         self._pool = []
         self._lock = threading.Lock()
 
@@ -148,12 +212,26 @@ class RouterCore:
     concerns so tests drive it directly."""
 
     def __init__(self, health_interval=2.0, timeout=300.0,
-                 health_timeout=2.0):
+                 health_timeout=2.0, route_policy="affinity",
+                 spill_outstanding=8, prefix_block=16,
+                 poll_models=True):
         self.health_interval = health_interval
         self.timeout = timeout
         self.health_timeout = health_timeout
+        #: ``:generate`` policy: "affinity" rides the prefix/session
+        #: hash ring; "least-outstanding" scatters like unary predict
+        self.route_policy = route_policy
+        #: outstanding requests at the affinity target beyond which a
+        #: ``:generate`` spills to the next ring node
+        self.spill_outstanding = spill_outstanding
+        #: digest quantum before any replica reports its real
+        #: ``block_size`` — prompts shorter than one block scatter
+        self.prefix_block = prefix_block
+        #: fetch /v1/models generator snapshots in the health poll
+        self.poll_models = poll_models
         self._lock = threading.Lock()
         self.replicas = {}       # endpoint -> Replica
+        self._ring = HashRing()
         self._rr = 0             # tie-break rotation
         self._stop = threading.Event()
         self._thread = None
@@ -179,6 +257,11 @@ class RouterCore:
                     self.replicas.pop(ep).close()
                     _REPLICA_HEALTHY.labels(ep).set(0)
                     _OUTSTANDING.labels(ep).set(0)
+            # ring follows MEMBERSHIP only (health flaps filter at
+            # pick time instead of moving keys): a single join/leave
+            # remaps ≤ ~1/N of the keyspace, everything else keeps
+            # its warm replica
+            self._ring.rebuild(sorted(self.replicas))
 
     def drain(self, endpoint, propagate=True):
         """Stop routing NEW requests to ``endpoint``; in-flight
@@ -224,6 +307,110 @@ class RouterCore:
             self._rr += 1
             return ties[self._rr % len(ties)]
 
+    def block_size_for(self, model):
+        """The digest quantum: the block_size any replica reports for
+        ``model`` (they all run the same spec), else the configured
+        fallback before the first snapshot poll lands."""
+        with self._lock:
+            for replica in self.replicas.values():
+                view = replica.gen_view.get(model)
+                if view and view.get("block_size"):
+                    return int(view["block_size"])
+        return self.prefix_block
+
+    def affinity_key(self, path, body, headers):
+        """Ring key for one ``:generate`` → ``(key, kind)`` where kind
+        is ``"session"`` (X-Session-Id — multi-turn chat pins to the
+        replica holding the conversation's pages) or ``"affinity"``
+        (digest of the first block_size-multiple of prompt tokens — a
+        shared-system-prompt cohort collapses to one key). ``(None,
+        None)`` means no stable key: prompt shorter than one KV block
+        (nothing cacheable to aim for) or an unparseable body."""
+        model = path.rsplit("/", 1)[-1].rsplit(":", 1)[0]
+        session = headers.get("x-session-id")
+        if session:
+            return f"s:{model}:{session}", "session"
+        try:
+            tokens = json.loads(body or b"{}").get("tokens")
+        except (ValueError, TypeError, AttributeError):
+            return None, None    # malformed: let the replica 400 it
+        if not isinstance(tokens, list):
+            return None, None
+        block = self.block_size_for(model)
+        n = (len(tokens) // block) * block
+        if n <= 0:
+            return None, None
+        digest = hashlib.sha1(
+            (model + ":" + ",".join(str(t) for t in tokens[:n]))
+            .encode()).hexdigest()
+        return "p:" + digest, "affinity"
+
+    def _saturated(self, replica, model):
+        """Spill verdict for the affinity target (callers hold no
+        lock; reads are of atomically-swapped values). Outstanding
+        counts requests in flight THROUGH THIS ROUTER; the generator
+        snapshot adds what the replica knows and the router can't see
+        (slots occupied by other routers' streams, queued admissions).
+        """
+        if replica.outstanding >= self.spill_outstanding:
+            return True
+        view = replica.gen_view.get(model)
+        if view:
+            slots = view.get("slots") or 0
+            if slots and view.get("occupied", 0) >= slots \
+                    and view.get("queued", 0) > 0:
+                return True
+        return False
+
+    def pick_ring(self, key, model, exclude=()):
+        """Ring pick with deterministic load spill → ``(Replica,
+        spilled)`` | None. Walks the ring from ``key``: the first
+        routable node is the affinity target; if it is saturated the
+        request spills to the NEXT ring node (same successor for the
+        whole cohort, so spilled requests still share a warm replica)
+        — and when every routable node is hot, queue on the affinity
+        target rather than scatter the cohort's pages everywhere."""
+        with self._lock:
+            ring_walk = list(self._ring.walk(key))
+        primary = None
+        for ep in ring_walk:
+            with self._lock:
+                replica = self.replicas.get(ep)
+                if replica is None or not replica.routable \
+                        or ep in exclude:
+                    continue
+            if primary is None:
+                primary = replica
+            if not self._saturated(replica, model):
+                return replica, replica is not primary
+        if primary is not None:
+            return primary, False
+        return None
+
+    def pick_for(self, method, path, body, headers, exclude=()):
+        """Per-path policy dispatch: POST ``:generate`` under the
+        affinity policy rides the prefix/session hash ring; everything
+        else — unary predict, predictStream, model status — keeps
+        least-outstanding (pinned: affinity must not regress predict
+        batching throughput)."""
+        is_generate = method == "POST" and path.endswith(":generate")
+        if is_generate and self.route_policy == "affinity":
+            model = path.rsplit("/", 1)[-1].rsplit(":", 1)[0]
+            key, kind = self.affinity_key(path, body, headers or {})
+            if key is not None:
+                picked = self.pick_ring(key, model, exclude=exclude)
+                if picked is not None:
+                    replica, spilled = picked
+                    _ROUTE_DECISIONS.labels(
+                        self.route_policy,
+                        "spill" if spilled else kind).inc()
+                    return replica
+        replica = self.pick(exclude=exclude)
+        if is_generate and replica is not None:
+            _ROUTE_DECISIONS.labels(self.route_policy,
+                                    "scatter").inc()
+        return replica
+
     def _request_once(self, replica, method, path, body, headers,
                       reuse):
         """One upstream round trip; OSError propagates (the conn is
@@ -260,7 +447,8 @@ class RouterCore:
         replica left the caller gets 503."""
         tried = []
         for _attempt in range(2):
-            replica = self.pick(exclude=tried)
+            replica = self.pick_for(method, path, body, headers,
+                                    exclude=tried)
             if replica is None:
                 break
             tried.append(replica.endpoint)
@@ -313,7 +501,8 @@ class RouterCore:
         pooled — it closes when the stream ends either way."""
         tried = []
         for _attempt in range(2):
-            replica = self.pick(exclude=tried)
+            replica = self.pick_for(method, path, body, headers,
+                                    exclude=tried)
             if replica is None:
                 break
             tried.append(replica.endpoint)
@@ -393,6 +582,43 @@ class RouterCore:
                 replica.reported_draining = reported
             _REPLICA_HEALTHY.labels(replica.endpoint).set(
                 1.0 if healthy and not replica.draining else 0.0)
+            if healthy and self.poll_models:
+                self.poll_models_once(replica)
+
+    def poll_models_once(self, replica):
+        """Refresh ``replica.gen_view`` from its ``/v1/models``
+        generator snapshots — the prefix-cache topology the spill
+        threshold and digest quantum read. A failed fetch keeps the
+        previous view (stale capacity beats no capacity signal)."""
+        try:
+            conn = http.client.HTTPConnection(
+                replica.host, replica.port,
+                timeout=self.health_timeout)
+            conn.request("GET", "/v1/models")
+            resp = conn.getresponse()
+            payload = json.loads(resp.read() or b"{}")
+            conn.close()
+            if resp.status != 200:
+                return
+        except (OSError, ValueError, http.client.HTTPException):
+            return
+        view = {}
+        for gen in payload.get("generators") or []:
+            name = gen.get("name")
+            if not name:
+                continue
+            cache = gen.get("prefix_cache") or {}
+            view[name] = {
+                "slots": gen.get("slots"),
+                "occupied": gen.get("occupied"),
+                "queued": gen.get("queued"),
+                "free_blocks": gen.get("free_blocks"),
+                "block_size": gen.get("block_size"),
+                "hit_ratio": cache.get("hit_ratio"),
+                "cached_blocks": cache.get("cached_blocks"),
+            }
+        with self._lock:
+            replica.gen_view = view
 
     def sync_from_store(self, store, namespace=None):
         """Follow ModelDeployment.status.endpoints: the controller
@@ -450,6 +676,7 @@ class RouterCore:
                 "healthy": r.healthy,
                 "draining": r.draining,
                 "outstanding": r.outstanding,
+                "gen": r.gen_view,
             } for r in self.replicas.values()]
 
 
@@ -469,7 +696,13 @@ def create_app(store=None, core=None, namespace=None, qos=None):
     app = App("model-router")
     core = core or RouterCore(
         health_interval=float(os.environ.get(
-            "ROUTER_HEALTH_INTERVAL", "2.0")))
+            "ROUTER_HEALTH_INTERVAL", "2.0")),
+        route_policy=os.environ.get("ROUTER_ROUTE_POLICY",
+                                    "affinity"),
+        spill_outstanding=int(os.environ.get(
+            "ROUTER_SPILL_OUTSTANDING", "8")),
+        prefix_block=int(os.environ.get("ROUTER_PREFIX_BLOCK",
+                                        "16")))
     app.router = core
     gate = qos if qos is not None else qos_gate.from_env()
     app.qos = gate
@@ -576,11 +809,13 @@ def create_app(store=None, core=None, namespace=None, qos=None):
                        if r["healthy"] is not False
                        and not r["draining"])
         return {"status": "ok" if routable else "degraded",
-                "routable_replicas": routable}
+                "routable_replicas": routable,
+                "route_policy": core.route_policy}
 
     @app.get("/admin/replicas")
     def replicas(request):
-        return {"replicas": core.snapshot()}
+        return {"route_policy": core.route_policy,
+                "replicas": core.snapshot()}
 
     @app.get("/admin/qos")
     def qos_report(request):

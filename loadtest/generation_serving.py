@@ -27,6 +27,17 @@ prefix-cache hit ratio > 0 (read off the generator snapshot THROUGH
 the router) with byte-well-formed streams and the router-mirrored
 ``X-Prefix-Tokens-Skipped`` header agreeing with the done frames.
 
+``--shared-prefix --replicas N`` (ISSUE 19) spawns N REAL subprocess
+replicas behind the router's prefix-affinity ring: shared-prefix
+cohorts must each concentrate on one replica (fleet cold fills stay
+bounded by the cohort count instead of scaling with the request
+count), the fleet-aggregate hit ratio must stay above one half, a
+replica JOINS mid-load (consistent hashing moves ~1/N of the cohorts,
+zero 5xx through the churn), every prompt long enough to key must
+ride the ring (no scatter decisions), and the router-mirrored
+``X-Prefix-Tokens-Skipped`` headers must agree with the done frames
+fleet-wide.
+
 ``--sharded`` (ISSUE 13) spawns the replica on a forced multi-device
 CPU mesh (``GEN_TP`` devices, ``--xla_force_host_platform_device_
 count``) so its engine tensor-shards for real, fronts it with a real
@@ -78,6 +89,7 @@ the subprocess pod.
     python loadtest/generation_serving.py --clients 8 --slots 4
     python loadtest/generation_serving.py --transport threaded
     python loadtest/generation_serving.py --shared-prefix
+    python loadtest/generation_serving.py --shared-prefix --replicas 2
     python loadtest/generation_serving.py --sharded [--tp 4]
     python loadtest/generation_serving.py --speculative [--spec-k 4]
     python loadtest/generation_serving.py --attn-backend paged
@@ -114,6 +126,12 @@ def build_argparser():
     ap.add_argument("--shared-prefix", action="store_true",
                     help="shared-system-prompt chat mix through a "
                          "real router; asserts prefix-cache hits")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="with --shared-prefix: spawn N subprocess "
+                         "replicas behind the router's prefix-"
+                         "affinity ring, join one MID-LOAD, and "
+                         "assert cohort concentration + fleet hit "
+                         "ratio with zero 5xx (ISSUE 19)")
     ap.add_argument("--sharded", action="store_true",
                     help="tensor-shard the replica's engine over a "
                          "forced 4-device CPU mesh (GEN_TP=4) and "
@@ -412,6 +430,192 @@ def run_shared_prefix(args, port):
         print(json.dumps(report, indent=2))
         if not all(report["checks"].values()):
             raise SystemExit("shared-prefix generation loadtest "
+                             "FAILED")
+    finally:
+        httpd.shutdown()
+        core.stop()
+
+
+def fleet_prompt_set(args, n_cohorts):
+    """ISSUE 19 fleet chat mix: ``n_cohorts`` DISTINCT 48-token system
+    prompts (80% of requests, round-robin across cohorts, each with a
+    short unique user tail); 20% fully unique prompts."""
+    cohorts = [[(3 * j + 17 * c) % 499 + 1 for j in range(48)]
+               for c in range(n_cohorts)]
+    specs = []
+    for i in range(args.clients * args.rounds):
+        if i % 5 == 4:
+            plen = 40 + i % 9
+            specs.append(([(7 * i + j) % 499 + 1
+                           for j in range(plen)], 6))
+        else:
+            specs.append((cohorts[i % n_cohorts]
+                          + [(11 * i + j) % 499 + 1
+                             for j in range(2 + i % 6)], 6))
+    return cohorts, specs
+
+
+def _replica_prefix_stats(port):
+    """→ (hits, misses, cached_blocks) off one replica's snapshot."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", "/v1/models/lm")
+    snap = json.loads(conn.getresponse().read())
+    conn.close()
+    pc = snap["generator"]["prefix_cache"]
+    return pc["hits"], pc["misses"], pc["cached_blocks"]
+
+
+def run_fleet_shared_prefix(args, ports):
+    """The --shared-prefix --replicas N verdict (ISSUE 19): N real
+    subprocess replicas behind the router's prefix-affinity ring. The
+    fleet starts at N-1 replicas and the Nth JOINS mid-load; every
+    stream must stay well-formed with zero 5xx through the churn, the
+    fleet-aggregate hit ratio must beat one half, cohort cold fills
+    must stay bounded by the cohort count (concentration — scatter
+    would pay one per request), no keyed prompt may fall back to
+    scatter routing, and the router-mirrored skip headers must agree
+    with the done frames fleet-wide."""
+    from kubeflow_tpu.web import router as router_lib
+
+    core = router_lib.RouterCore(health_interval=0.3)
+    core.set_backends([f"127.0.0.1:{p}" for p in ports[:-1]])
+    app = router_lib.create_app(core=core)
+    httpd = app.serve(port=0, host="127.0.0.1")
+    rport = httpd.server_address[1]
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            snap = core.snapshot()
+            if snap and all(r["healthy"] for r in snap) \
+                    and all(r["gen"].get("lm") for r in snap):
+                break
+            time.sleep(0.05)
+        else:
+            raise SystemExit("fleet never turned healthy (with "
+                             "topology) via the router")
+        # warm EVERY replica directly — the mid-load joiner included
+        # (warming a pod before it enters rotation is the production
+        # move): the bucket-64 prefill + decode, then the partial
+        # suffix prefill against the warm prefix
+        wsys = [(5 * j) % 499 + 1 for j in range(48)]
+        for port in ports:
+            run_one(port, wsys + [1, 2, 3], 2)
+            run_one(port, wsys + [4, 5, 6, 7, 8], 2)
+        cohorts, specs = fleet_prompt_set(
+            args, n_cohorts=max(2, len(ports)))
+        # prime each cohort THROUGH the router (one sequential turn):
+        # the cohort's prefix cold-fills on its affinity replica the
+        # way real chat sessions start — one at a time — so the timed
+        # concurrent phase measures steady-state placement, not a
+        # simultaneous-arrival miss race
+        for cohort in cohorts:
+            run_one(rport, cohort + [498], 2)
+        base = {p: _replica_prefix_stats(p)[:2] for p in ports}
+        dec0 = {o: router_lib._ROUTE_DECISIONS.value("affinity", o)
+                for o in ("affinity", "session", "spill", "scatter")}
+
+        lock = threading.Lock()
+        results, errors = [], []
+        join_info = {}
+
+        def client(spec):
+            try:
+                out = run_one(rport, *spec)
+                with lock:
+                    results.append(out)
+            except Exception as e:  # noqa: BLE001 — report below
+                with lock:
+                    errors.append(repr(e))
+
+        # two overlapping waves: wave 2 launches right after the Nth
+        # replica joins, while wave-1 streams are still decoding — the
+        # ring rebuild happens under live load, and wave-2 cohorts
+        # exercise the post-join placement
+        wave1 = specs[:3 * len(specs) // 4]
+        wave2 = specs[len(wave1):]
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in wave1]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            with lock:
+                done = len(results) + len(errors)
+            if done >= 1:
+                break
+            time.sleep(0.005)
+        with lock:
+            join_info["wave1_done_at_join"] = \
+                len(results) + len(errors)
+        core.set_backends([f"127.0.0.1:{p}" for p in ports])
+        wave2_threads = [threading.Thread(target=client, args=(s,))
+                         for s in wave2]
+        for t in wave2_threads:
+            t.start()
+        for t in threads + wave2_threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert not errors, errors[:3]
+
+        deltas = {}
+        for p in ports:
+            h, miss = _replica_prefix_stats(p)[:2]
+            deltas[p] = (h - base[p][0], miss - base[p][1])
+        fleet_hits = sum(d[0] for d in deltas.values())
+        fleet_misses = sum(d[1] for d in deltas.values())
+        fleet_ratio = fleet_hits / max(1, fleet_hits + fleet_misses)
+        skipped_frames = sum(
+            r["final"].get("prefix_tokens_skipped", 0)
+            for r in results)
+        skipped_headers = sum(int(r["skip_header"] or 0)
+                              for r in results)
+        dec = {o: round(router_lib._ROUTE_DECISIONS.value(
+                   "affinity", o) - dec0[o])
+               for o in dec0}
+        n_unique = sum(1 for i in range(len(specs)) if i % 5 == 4)
+        # concentration economics: cohorts were primed on their
+        # pre-join primary, so timed cohort misses only come from the
+        # replicas a cohort moves to — the post-join primary and at
+        # most one spill successor (concurrent arrivals on a moved
+        # cohort can pay the fill more than once before the first
+        # prefill publishes its blocks). Scatter would pay ~one miss
+        # per request instead.
+        cohort_misses = fleet_misses - n_unique
+        tokens = sum(len(r["tokens"]) for r in results)
+        report = {
+            "mode": "fleet-shared-prefix",
+            "transport": args.transport, "slots": args.slots,
+            "replicas": len(ports), "cohorts": len(cohorts),
+            "prompts": len(specs),
+            "tokens_per_sec": round(tokens / wall, 1),
+            "wall_s": round(wall, 2),
+            "fleet_hits": fleet_hits,
+            "fleet_misses": fleet_misses,
+            "fleet_hit_ratio": round(fleet_ratio, 4),
+            "cohort_cold_fills": cohort_misses,
+            "per_replica": {
+                str(p): {"hits": d[0], "misses": d[1]}
+                for p, d in deltas.items()},
+            "route_decisions": dec,
+            "wave1_done_at_join": join_info["wave1_done_at_join"],
+            "checks": {
+                "zero_5xx": not errors,       # run_one asserts 200
+                "join_happened_mid_load":
+                    join_info["wave1_done_at_join"] < len(wave1),
+                "fleet_hit_ratio_above_half": fleet_ratio > 0.5,
+                "cohort_cold_fills_bounded_by_cohorts":
+                    0 <= cohort_misses <= 3 * len(cohorts),
+                "keyed_prompts_never_scatter":
+                    dec["scatter"] == 0
+                    and dec["affinity"] + dec["spill"] == len(specs),
+                "router_mirrors_skip_header":
+                    skipped_headers == skipped_frames,
+                "streams_well_formed": True,    # run_one asserted
+            }}
+        print(json.dumps(report, indent=2))
+        if not all(report["checks"].values()):
+            raise SystemExit("fleet shared-prefix generation loadtest "
                              "FAILED")
     finally:
         httpd.shutdown()
@@ -1117,6 +1321,16 @@ def main(argv=None):
     if args.chunked_prefill:
         # spawns its own replicas (one per side) — no shared server
         run_chunked_prefill(args)
+        return
+    if args.shared_prefix and args.replicas > 1:
+        fleet = [spawn_server(args) for _ in range(args.replicas)]
+        try:
+            run_fleet_shared_prefix(args, [p for _, p in fleet])
+        finally:
+            for proc, _ in fleet:
+                proc.terminate()
+            for proc, _ in fleet:
+                proc.wait(timeout=10)
         return
     proc, port = spawn_server(args)
     try:
