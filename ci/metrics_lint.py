@@ -191,6 +191,12 @@ def main():
         # loadtest --shared-prefix --replicas N read
         "serving_generate_queued_prompt_tokens",
         "router_route_decisions_total",
+        # ISSUE 20: prefill/decode disaggregation — KV-page
+        # migration bytes/latency must stay observable (the int8
+        # transfer proof and the migration-tax guidance in the
+        # user guide both key off them)
+        "serving_kv_migrated_bytes_total",
+        "serving_kv_migration_seconds",
     }
     registered = {metric.name for metric in obs_metrics.REGISTRY._metrics}
     scratch_names = {metric.name for metric in scratch._metrics}

@@ -22,6 +22,22 @@ VERSION = "v1alpha1"
 #: instead and replica i listens on basePort+i.
 DEFAULT_PORT = 8500
 
+#: serving roles a disaggregated deployment splits into
+#: (``spec.roles``); the order fixes each track's replica-index
+#: stride so basePort arithmetic stays collision-free
+ROLES = ("prefill", "decode")
+
+#: index stride between role tracks: prefill replica i gets global
+#: index i, decode replica i gets 100+i — disjoint ports under
+#: ``basePort + index`` for any sane track size
+ROLE_INDEX_STRIDE = 100
+
+
+def role_replica_index(role, i):
+    """Global replica index (→ port slot) for replica ``i`` of a role
+    track."""
+    return ROLES.index(role) * ROLE_INDEX_STRIDE + int(i)
+
 
 def default_template():
     """Pod template running the stock model-server entrypoint; the
@@ -35,14 +51,19 @@ def default_template():
 
 def new_deployment(name, namespace, model="default", replicas=1,
                    min_replicas=None, max_replicas=None, template=None,
-                   base_port=None, autoscale=False, transport="async"):
+                   base_port=None, autoscale=False, transport="async",
+                   roles=None):
     """``model`` is the served-model name predicts route to;
     ``replicas`` the desired ModelServer pod count (clamped to
     [minReplicas, maxReplicas] when autoscaling); ``base_port`` makes
     replica ``i`` listen on ``base_port + i`` for single-host runs;
     ``transport`` picks the wire engine per replica (async | threaded);
     ``autoscale`` lets the controller drive the replica count from the
-    serving queue-wait/occupancy histograms."""
+    serving queue-wait/occupancy histograms; ``roles`` switches the
+    deployment to disaggregated prefill/decode tracks — a dict like
+    ``{"prefill": {"replicas": 1}, "decode": {"replicas": 2}}`` (each
+    entry may also carry minReplicas/maxReplicas for per-role
+    autoscaling), replacing the flat replica set entirely."""
     if autoscale and max_replicas is None:
         # the controller clamps to maxReplicas (default: replicas),
         # so autoscale without headroom would be a silent no-op —
@@ -62,6 +83,21 @@ def new_deployment(name, namespace, model="default", replicas=1,
         spec["basePort"] = int(base_port)
     if autoscale:
         spec["autoscale"] = True
+    if roles:
+        norm = {}
+        for role, cfg in roles.items():
+            if role not in ROLES:
+                raise ValueError(
+                    f"unknown serving role {role!r}; expected one of "
+                    f"{ROLES}")
+            cfg = dict(cfg or {})
+            entry = {"replicas": int(cfg.get("replicas", 1))}
+            if cfg.get("minReplicas") is not None:
+                entry["minReplicas"] = int(cfg["minReplicas"])
+            if cfg.get("maxReplicas") is not None:
+                entry["maxReplicas"] = int(cfg["maxReplicas"])
+            norm[role] = entry
+        spec["roles"] = norm
     return {
         "apiVersion": f"{GROUP}/{VERSION}", "kind": KIND,
         "metadata": {"name": name, "namespace": namespace},

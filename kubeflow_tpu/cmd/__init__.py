@@ -369,6 +369,11 @@ def model_server(argv=()):
             preemption=os.environ.get(
                 "GEN_PREEMPTION", "1").lower() not in (
                 "0", "false", "no", "off"),
+            # GEN_ROLE: prefill | decode | both (the default — byte-
+            # for-byte the single-replica engine). Role-split fleets
+            # set it per ModelDeployment track; the router two-hops
+            # prompts prefill → :attach → decode
+            role=os.environ.get("GEN_ROLE") or "both",
             name=name)
         if os.environ.get("GEN_CALIBRATE", "").lower() in (
                 "1", "true", "yes", "on"):

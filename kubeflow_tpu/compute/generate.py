@@ -356,6 +356,38 @@ _EMITTED_TOKENS = obs_metrics.REGISTRY.histogram(
     "engine's tokens/sec",
     ("model",),
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 1024))
+_KV_MIGRATED_BYTES = obs_metrics.REGISTRY.counter(
+    "serving_kv_migrated_bytes_total",
+    "KV-cache page bytes EXPORTED as prefill/decode migration bundles "
+    "(counted at export, in the pool's native dtype — int8 pages ship "
+    "with their float32 scales, both included here), by pool dtype. "
+    "A prefill-role replica's rate() of this is the bytes/sec the "
+    "x-tensor wire carries into the decode pool",
+    ("model", "dtype"))
+_KV_MIGRATION_SECONDS = obs_metrics.REGISTRY.histogram(
+    "serving_kv_migration_seconds",
+    "Bundle received -> imported slot live in decode (block "
+    "allocation + native-dtype page memcpy + trie seed + admission), "
+    "observed on the IMPORTING engine — the decode-side half of the "
+    "two-hop migration latency (the export half rides "
+    "serving_generate_prefill_seconds on the prefill replica)",
+    ("model",),
+    buckets=(1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0))
+_KV_IMPORT_REJECTIONS = obs_metrics.REGISTRY.counter(
+    "serving_kv_import_rejections_total",
+    "Page bundles REFUSED by the importing engine, by reason "
+    "(block_size | geometry | dtype | vocab | capacity | role | mesh) "
+    "— the router treats any rejection as a transfer failure and "
+    "falls back to colocated serving, so a nonzero rate here with "
+    "zero 5xx is the fallback path working as designed",
+    ("model", "reason"))
+_GEN_ROLE = obs_metrics.REGISTRY.gauge(
+    "serving_generate_role",
+    "Disaggregation role of this engine, one-hot over (prefill | "
+    "decode | both) — joins the serving_generate_* families to a "
+    "role track so the hub's /debug/generate can split prefill-queue "
+    "pressure from decode-slot occupancy per role",
+    ("model", "role"))
 
 #: slot lifecycle timeline ring size (snapshot ``timeline``)
 _TIMELINE_EVENTS = int(os.environ.get("GEN_TIMELINE_EVENTS", "256"))
@@ -375,6 +407,20 @@ class MeshShapeError(ValueError):
     XLA partitioning failure on the first prefill."""
 
 
+class KVImportError(ValueError):
+    """A KV-page bundle the importing engine cannot admit. ``reason``
+    is the rejection class (``block_size`` | ``geometry`` | ``dtype``
+    | ``vocab`` | ``capacity`` | ``role`` | ``mesh``) — booked on
+    ``serving_kv_import_rejections_total`` before raising, and mapped
+    to a 4xx by the transports (a ValueError on the wire): the router
+    treats it as a failed transfer and falls back to colocated
+    serving instead of surfacing a 5xx."""
+
+    def __init__(self, reason, message):
+        super().__init__(message)
+        self.reason = reason
+
+
 class GenerationHandle:
     """One submitted prompt's lifecycle: the engine appends generated
     tokens and fires the callbacks from ITS thread (transports hand
@@ -391,8 +437,8 @@ class GenerationHandle:
                  "ttft_s", "token_times", "itg_gaps", "last_emit",
                  "admitted_w", "tenant", "qos_class", "preemptible",
                  "on_event", "suspended", "preemptions",
-                 "resume_prefill_tokens", "_qos_charged",
-                 "_qos_deferred", "_engine", "_done")
+                 "resume_prefill_tokens", "export_kv", "kv_bundle",
+                 "_qos_charged", "_qos_deferred", "_engine", "_done")
 
     def __init__(self, prompt, max_tokens, eos_id, deadline,
                  on_token, on_done, rt):
@@ -456,6 +502,14 @@ class GenerationHandle:
         self.resume_prefill_tokens = 0   # suffix tokens re-prefilled
         #                           across all resumes (the paid part
         #                           of the resume cost model)
+        self.export_kv = False    # prefill-only request: the prefill's
+        #                           pages export as a migration bundle
+        #                           (reason "exported") instead of
+        #                           entering decode
+        self.kv_bundle = None     # the exported page bundle (export
+        #                           side), or the bundle being
+        #                           imported (attach side) until the
+        #                           slot is admitted
         self._qos_charged = False  # engine-ledger prepay latch (a
         self._qos_deferred = False  # resume must not re-charge); the
         #                           deferred latch books one throttle
@@ -594,7 +648,8 @@ class GenerationEngine:
                  prefix_cache=True, mesh=None, draft_params=None,
                  draft_config=None, spec_k=0, debug_logits=False,
                  attn_backend="paged", prefill_chunk=None,
-                 row_shard=False, qos=None, preemption=True):
+                 row_shard=False, qos=None, preemption=True,
+                 role="both"):
         if config.moe_experts or config.pipeline_stages > 1:
             raise ValueError(
                 "GenerationEngine supports dense TransformerLM configs "
@@ -602,6 +657,10 @@ class GenerationEngine:
         if kv_dtype not in (None, "int8"):
             raise ValueError(
                 f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or 'both', got "
+                f"{role!r}")
         if admission not in ("continuous", "drain"):
             raise ValueError(
                 f"admission must be 'continuous' or 'drain', got "
@@ -690,6 +749,19 @@ class GenerationEngine:
         self.config = config
         self.name = name
         self.version = version
+        # disaggregation role: steers the ROUTER (prefill replicas get
+        # :prefill, decode replicas get :attach) and the control
+        # plane's per-role autoscaling tracks. The engine itself stays
+        # capability-complete in every role — a prefill replica still
+        # answers a plain :generate and a decode replica still runs a
+        # (resume/fallback) prefill — because the router's graceful
+        # fallback to colocated serving depends on it. The one hard
+        # rule: a prefill-role engine refuses :attach imports (reason
+        # "role") — importing into the pool the router drains FROM is
+        # a topology error, never a fallback.
+        self.role = role
+        for r in ("prefill", "decode", "both"):
+            _GEN_ROLE.labels(name, r).set(1 if r == role else 0)
         self.eos_id = eos_id
         self.default_max_tokens = int(default_max_tokens)
         self.kv_dtype = kv_dtype
@@ -879,7 +951,10 @@ class GenerationEngine:
                       "decode_seconds_total": 0.0,
                       "attn_bytes_read": 0,
                       "preemptions": 0, "resumes": 0,
-                      "resume_prefill_tokens": 0, "qos_deferrals": 0}
+                      "resume_prefill_tokens": 0, "qos_deferrals": 0,
+                      "kv_exports": 0, "kv_imports": 0,
+                      "kv_bytes_migrated": 0,
+                      "kv_import_rejections": 0}
         self.thread = threading.Thread(target=self._loop, daemon=True,
                                        name=f"generate-{name}")
         self.thread.start()
@@ -1350,7 +1425,7 @@ class GenerationEngine:
     def submit(self, tokens, max_tokens=None, eos_id=None,
                deadline=None, on_token=None, on_done=None, rt=None,
                tenant=None, qos_class=None, preemptible=None,
-               on_event=None):
+               on_event=None, export_kv=False):
         """Enqueue one prompt → :class:`GenerationHandle`.
 
         ``tokens`` is the prompt as int token ids (this platform is
@@ -1376,12 +1451,17 @@ class GenerationEngine:
                          else self.default_max_tokens)
         if max_tokens < 1:
             raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
-        if len(tokens) + max_tokens > self.max_context:
+        # an export (prefill-only) request never decodes HERE: its
+        # max_tokens is the DECODE side's budget, carried in the
+        # bundle — this engine only needs the prompt to fit
+        if len(tokens) + (0 if export_kv else max_tokens) \
+                > self.max_context:
             raise ValueError(
                 f"prompt ({len(tokens)} tokens) + max_tokens "
                 f"({max_tokens}) exceeds max_context "
                 f"({self.max_context})")
-        worst = self._worst_case_blocks(len(tokens), max_tokens)
+        worst = self._worst_case_blocks(
+            len(tokens), 0 if export_kv else max_tokens)
         if worst > self.num_blocks:
             raise ValueError(
                 f"request needs up to {worst} cache blocks but the "
@@ -1406,6 +1486,7 @@ class GenerationEngine:
                               if preemptible is None
                               else bool(preemptible))
         handle.on_event = on_event
+        handle.export_kv = bool(export_kv)
         handle._engine = self     # result(timeout) cancels through it
         with self._cond:
             if self._draining or self._stop:
@@ -1432,6 +1513,156 @@ class GenerationEngine:
     def generate(self, tokens, **kwargs):
         """Blocking convenience → ``(generated_tokens, reason)``."""
         return self.submit(tokens, **kwargs).result()
+
+    # ------------------------------------------- KV-page migration API
+
+    def prefill_export(self, tokens, max_tokens=None, timeout=None,
+                       **kwargs):
+        """Blocking convenience: run prefill ONLY (chunked or
+        monolithic, prefix-cache hits honored) and return the page
+        bundle — ``submit(export_kv=True)`` + wait. ``max_tokens`` is
+        the DECODE budget the bundle carries to the importing engine;
+        this engine never decodes the request."""
+        handle = self.submit(tokens, max_tokens=max_tokens,
+                             export_kv=True, **kwargs)
+        if not handle.wait(timeout):
+            self.cancel(handle, reason="abandoned")
+            raise TimeoutError("prefill export did not finish in time")
+        if handle.error is not None:
+            raise handle.error
+        if handle.kv_bundle is None:
+            raise RuntimeError(
+                f"prefill export finished with reason "
+                f"{handle.reason!r} and no bundle")
+        return handle.kv_bundle
+
+    def import_bundle(self, bundle, *, max_tokens=None, eos_id=None,
+                      deadline=None, on_token=None, on_done=None,
+                      on_event=None, rt=None, tenant=None,
+                      qos_class=None, preemptible=None):
+        """Admit an exported page bundle directly into decode →
+        :class:`GenerationHandle` (the normal ``:generate`` stream
+        contract drains it: first token = the prefill's argmax from
+        the bundle, then decode steps over the imported pages).
+
+        The import is a memcpy plus a block-table rewrite — pages
+        land in the pool's NATIVE dtype (int8 ships with its float32
+        scales, no requantize round-trip), so the continuation is
+        token-identical to the colocated engine by construction.
+        Geometry/dtype/capacity mismatches raise
+        :class:`KVImportError` (booked by reason on
+        ``serving_kv_import_rejections_total``); the router maps any
+        rejection to its colocated fallback."""
+        meta = bundle["meta"]
+        pages = tuple(np.ascontiguousarray(p)
+                      for p in bundle["pages"])
+        c = self.config
+
+        def reject(reason, msg):
+            self.stats["kv_import_rejections"] += 1
+            _KV_IMPORT_REJECTIONS.labels(self.name, reason).inc()
+            raise KVImportError(reason, msg)
+
+        if self.role == "prefill":
+            reject("role",
+                   f"engine {self.name!r} has role='prefill': it "
+                   f"exports bundles, it does not import them")
+        if self.mesh is not None:
+            reject("mesh",
+                   "page import into a tensor-sharded pool is not "
+                   "supported (the bundle is a single-chip layout); "
+                   "route this prompt to an unsharded decode replica")
+        if int(meta.get("block_size", -1)) != self.block_size:
+            reject("block_size",
+                   f"bundle block_size {meta.get('block_size')} != "
+                   f"pool block_size {self.block_size}")
+        if (int(meta.get("n_layers", -1)) != c.n_layers
+                or int(meta.get("kv_heads", -1)) != c.kv_heads
+                or int(meta.get("head_dim", -1)) != c.head_dim):
+            reject("geometry",
+                   f"bundle geometry (layers={meta.get('n_layers')}, "
+                   f"kv_heads={meta.get('kv_heads')}, head_dim="
+                   f"{meta.get('head_dim')}) does not match the pool "
+                   f"({c.n_layers}, {c.kv_heads}, {c.head_dim})")
+        want = tuple(x.dtype.name for x in self._cache)
+        got = tuple(p.dtype.name for p in pages)
+        if got != want:
+            reject("dtype",
+                   f"bundle component dtypes {got} != pool {want} "
+                   f"(pages must ship in the pool's native dtype)")
+        try:
+            prompt = [int(t) for t in meta["prompt"]]
+            first = int(meta["first_token"])
+            n_import = int(meta["n_blocks"])
+        except (KeyError, TypeError, ValueError):
+            reject("geometry", "bundle meta is missing prompt/"
+                   "first_token/n_blocks")
+        if not prompt or any(t < 0 or t >= c.vocab_size
+                             for t in prompt) \
+                or not 0 <= first < c.vocab_size:
+            reject("vocab",
+                   f"bundle tokens must be ids in [0, {c.vocab_size})")
+        if n_import != -(-len(prompt) // self.block_size):
+            reject("geometry",
+                   f"bundle ships {n_import} blocks for a "
+                   f"{len(prompt)}-token prompt at block_size "
+                   f"{self.block_size}")
+        for p, comp in zip(pages, self._cache):
+            if tuple(p.shape) != (comp.shape[0], n_import) \
+                    + tuple(comp.shape[2:]):
+                reject("geometry",
+                       f"bundle page shape {tuple(p.shape)} does not "
+                       f"match pool block layout")
+        max_tokens = int(max_tokens if max_tokens is not None
+                         else meta.get("max_tokens")
+                         or self.default_max_tokens)
+        if max_tokens < 1:
+            raise ValueError(
+                f"max_tokens must be >= 1, got {max_tokens}")
+        if len(prompt) + max_tokens > self.max_context:
+            reject("capacity",
+                   f"prompt ({len(prompt)}) + max_tokens "
+                   f"({max_tokens}) exceeds max_context "
+                   f"({self.max_context})")
+        needed = max(n_import,
+                     -(-(len(prompt) + max_tokens)
+                       // self.block_size))
+        if needed > self.num_blocks:
+            reject("capacity",
+                   f"import needs up to {needed} cache blocks but "
+                   f"the pool holds {self.num_blocks}")
+        eos = self.eos_id if eos_id is None else int(eos_id)
+        if qos_class is None:
+            qos_class = (self._qos.class_of(tenant)
+                         if self._qos is not None
+                         else qos_lib.DEFAULT_CLASS)
+        if qos_class not in qos_lib.PRIORITY:
+            raise ValueError(
+                f"unknown qos class {qos_class!r} (expected one of "
+                f"{qos_lib.QOS_CLASSES})")
+        handle = GenerationHandle(prompt, max_tokens, eos, deadline,
+                                  on_token, on_done, rt)
+        handle.tenant = tenant
+        handle.qos_class = qos_class
+        handle.preemptible = (qos_class != "interactive"
+                              if preemptible is None
+                              else bool(preemptible))
+        handle.on_event = on_event
+        handle.kv_bundle = {"meta": meta, "pages": pages,
+                            "_t_recv": bundle.get(
+                                "_t_recv", time.perf_counter())}
+        handle._engine = self
+        with self._cond:
+            if self._draining or self._stop:
+                raise serving_lib.DrainingError(
+                    f"generation engine {self.name!r} is draining; "
+                    f"retry against another replica")
+            self._seq += 1
+            handle.seq = self._seq
+            self._queue.append(handle)
+            self._book_queued_tokens_locked()
+            self._cond.notify()
+        return handle
 
     def cancel(self, handle, reason="cancelled"):
         """Evict ``handle``'s slot (or dequeue it) before the next
@@ -1509,6 +1740,22 @@ class GenerationEngine:
             return {
                 "slots": self.max_slots,
                 "occupied": occupied,
+                # disaggregation role + prompt-token backlog: the
+                # router's poller reads these to steer :prefill at
+                # prefill replicas (and to NOT judge a prefill
+                # replica's transient slots as decode saturation),
+                # and the per-role autoscaler reads the backlog
+                "role": self.role,
+                "queued_tokens": sum(len(h.prompt) + len(h.out_tokens)
+                                     for h in self._queue),
+                # page-migration economics (export side books bytes,
+                # import side books latency/rejections)
+                "migration": {
+                    "exports": self.stats["kv_exports"],
+                    "imports": self.stats["kv_imports"],
+                    "bytes": self.stats["kv_bytes_migrated"],
+                    "rejections": self.stats["kv_import_rejections"],
+                },
                 # per-slot staleness view: a stuck slot shows as a
                 # growing last_emit_age_s with tokens_emitted frozen,
                 # diagnosable from the snapshot alone
@@ -1973,11 +2220,26 @@ class GenerationEngine:
                         if handle.suspended else handle.prompt
                     remaining = handle.max_tokens \
                         - len(handle.out_tokens)
-                    matched = self._match_prefix_locked(prompt)
-                    needed = self._worst_case_blocks(
-                        len(prompt), remaining, len(matched))
-                    pinning = sum(1 for n in matched
-                                  if self._ref[n.block] == 0)
+                    if handle.kv_bundle is not None \
+                            and not handle.export_kv:
+                        # page import: no prefill, no prefix pinning —
+                        # the bundle's blocks are written fresh, plus
+                        # the decode growth the budget promises
+                        needed = max(
+                            int(handle.kv_bundle["meta"]["n_blocks"]),
+                            -(-(len(prompt) + remaining)
+                              // self.block_size))
+                        pinning = 0
+                    else:
+                        matched = self._match_prefix_locked(prompt)
+                        # an export request never decodes here — its
+                        # reservation covers only the padded prefill
+                        needed = self._worst_case_blocks(
+                            len(prompt),
+                            0 if handle.export_kv else remaining,
+                            len(matched))
+                        pinning = sum(1 for n in matched
+                                      if self._ref[n.block] == 0)
                     if free_slot is None \
                             or self._available_blocks() - pinning \
                             < needed:
@@ -2022,7 +2284,10 @@ class GenerationEngine:
                                  f"generation slot (waited "
                                  f"{waited * 1000:.0f} ms)"))
                 continue
-            self._prefill(free_slot, handle, matched)
+            if handle.kv_bundle is not None and not handle.export_kv:
+                self._import_admit(free_slot, handle)
+            else:
+                self._prefill(free_slot, handle, matched)
 
     def _suspend(self, slot_idx, reason="slot"):
         """Preemptible decoding's eviction half: pause ``slot_idx``
@@ -2087,6 +2352,153 @@ class GenerationEngine:
             handle.on_event(event, dict(attrs))
         except Exception:  # noqa: BLE001 — see _emit
             log.exception("on_event callback failed")
+
+    # ------------------------------------------- KV-page export/import
+
+    def _build_kv_bundle(self, handle, prompt, blocks, first, offset,
+                         prefill_s):
+        """Copy the prompt's occupied pages device→host in the pool's
+        NATIVE dtype → the migration bundle (engine thread, BEFORE the
+        blocks release). Only the ``ceil(prompt_len/block_size)``
+        blocks that hold prompt K/V ship — bucket-padding blocks past
+        the prompt hold garbage the decode side would never read. The
+        tail block may be partial; its pad positions are garbage too,
+        which is exactly the state a colocated slot is in (reads are
+        length-masked), so the import stays a pure memcpy."""
+        c = self.config
+        n_keep = -(-len(prompt) // self.block_size)
+        idx = np.asarray(blocks[:n_keep], np.int32)
+        pages = tuple(np.asarray(comp[:, idx])
+                      for comp in self._cache)
+        # k + v pages vs the int8 scales, split for the wire-byte
+        # accounting (int8 halves the PAGE bytes; the per-(position,
+        # head) float32 scales ride on top at 4/head_dim per element)
+        page_bytes = sum(int(p.nbytes) for p in pages[:2])
+        scale_bytes = sum(int(p.nbytes) for p in pages[2:])
+        meta = {
+            "model": self.name, "version": self.version,
+            "prompt": list(prompt), "first_token": int(first),
+            "max_tokens": int(handle.max_tokens),
+            "eos_id": handle.eos_id,
+            "block_size": self.block_size, "n_blocks": n_keep,
+            "kv_dtype": self.kv_dtype
+                or jnp.dtype(c.compute_dtype).name,
+            "n_layers": c.n_layers, "kv_heads": c.kv_heads,
+            "head_dim": c.head_dim,
+            "prefix_tokens_skipped": int(offset),
+            "prefill_seconds": prefill_s,
+            "page_bytes": page_bytes, "scale_bytes": scale_bytes,
+        }
+        return {"meta": meta, "pages": pages}
+
+    def _book_export(self, handle, bundle, slot=None):
+        """Finish an export request: the bundle IS the result (reason
+        ``exported``, no tokens emitted here — the first token ships
+        inside the bundle and the IMPORTING engine's stream emits
+        it)."""
+        meta = bundle["meta"]
+        nbytes = meta["page_bytes"] + meta["scale_bytes"]
+        self.stats["kv_exports"] += 1
+        self.stats["kv_bytes_migrated"] += nbytes
+        _KV_MIGRATED_BYTES.labels(self.name,
+                                  meta["kv_dtype"]).inc(nbytes)
+        handle.kv_bundle = bundle
+        self._record_event("exported", handle, slot=slot,
+                           blocks=meta["n_blocks"], bytes=nbytes)
+        self._finish(handle, "exported")
+
+    def _import_admit(self, slot_idx, handle):
+        """Admission of an imported bundle: allocate free blocks,
+        memcpy the pages in (native dtype — no requantize), rewrite
+        the block table, seed the radix trie with the imported prefix,
+        and install the slot DIRECTLY in decode state (``length`` =
+        prompt length, ``last_token`` = the prefill's argmax from the
+        bundle). The emitted stream starts with that first token, so
+        the continuation is token-identical to the colocated engine's
+        by construction — no forward pass ran here."""
+        bundle = handle.kv_bundle
+        meta, pages = bundle["meta"], bundle["pages"]
+        prompt = handle.prompt
+        prompt_len = len(prompt)
+        n_import = int(meta["n_blocks"])
+        remaining = handle.max_tokens
+        with self._cond:
+            blocks = [self._alloc_block_locked()
+                      for _ in range(n_import)]
+            self._inflight = list(blocks)
+        t0 = time.perf_counter()
+        t0w = time.time()
+        handle.admitted_w = t0w
+        wait_s = t0 - handle.enqueued
+        _QUEUE_WAIT_SECONDS.labels(self.name,
+                                   "admitted").observe(wait_s)
+        if handle.rt is not None:
+            handle.rt.phase("generate.queue_wait", handle.enqueued_w,
+                            t0w)
+        self._record_event("admitted", handle, slot=slot_idx,
+                           wait_s=round(wait_s, 6), imported=True)
+        idx = np.asarray(blocks, np.int32)
+        try:
+            cache = list(self._cache)
+            for i, p in enumerate(pages):
+                cache[i] = cache[i].at[:, idx].set(p)
+            self._cache = tuple(cache)
+            if self._spec_on:
+                # the draft has no pages to import (dense per-slot
+                # cache, different model) — prefill it from the
+                # prompt so proposals start aligned; the TARGET
+                # verify alone guarantees token identity either way
+                dpad = self._suffix_padded(prompt_len, 0)
+                dtok = np.zeros((dpad,), np.int32)
+                dtok[:prompt_len] = prompt
+                self._draft_cache = self._draft_prefill_jit(
+                    self.draft_params, self._draft_cache, dtok,
+                    np.int32(slot_idx))
+        except Exception as e:  # noqa: BLE001 — like _prefill's error
+            # path: fail THIS request and return its blocks, or the
+            # pool shrinks with every bad bundle
+            with self._cond:
+                self._release_blocks_locked(blocks)
+                self._inflight = []
+                self._cond.notify()
+            log.exception("page import failed for a %d-block bundle "
+                          "on engine %s", n_import, self.name)
+            self._finish(handle, "error", e)
+            return
+        first = int(meta["first_token"])
+        handle.prefix_tokens_skipped = int(
+            meta.get("prefix_tokens_skipped") or 0)
+        handle.prefill_seconds = float(
+            meta.get("prefill_seconds") or 0.0)
+        handle.kv_bundle = None    # pages are in the pool now
+        handle.spec_wire = self.spec_header()
+        slot = _Slot(handle, blocks, prompt_len, first,
+                     max(n_import,
+                         -(-(prompt_len + remaining)
+                           // self.block_size)))
+        with self._cond:
+            self._inflight = []
+            self._slots[slot_idx] = slot
+            if self.prefix_cache:
+                self._index_prompt_locked(
+                    prompt, slot.blocks,
+                    self._match_prefix_locked(prompt))
+        slot.decode_start_w = time.time()
+        elapsed = time.perf_counter() \
+            - bundle.get("_t_recv", handle.enqueued)
+        self.stats["kv_imports"] += 1
+        _KV_MIGRATION_SECONDS.labels(self.name).observe(elapsed)
+        self._record_event("imported", handle, slot=slot_idx,
+                           blocks=n_import,
+                           seconds=round(elapsed, 6))
+        self._note_emission_event(handle)
+        self._record_event("first_token", handle, slot=slot_idx,
+                           ttft_s=round(handle.ttft_s, 6))
+        self._emit(handle, first)
+        if handle.eos_id is not None and first == handle.eos_id:
+            self._evict(slot_idx, "eos")
+        elif len(handle.out_tokens) >= handle.max_tokens:
+            self._evict(slot_idx, "length")
 
     def _prefill(self, slot_idx, handle, matched=()):
         """Prefill ``handle`` into ``slot_idx``. With a trie match the
@@ -2175,7 +2587,7 @@ class GenerationEngine:
                 else:
                     cache, first = out
             first = int(first)
-            if self._spec_on:
+            if self._spec_on and not handle.export_kv:
                 # the draft prefills the FULL prompt into its dense
                 # per-slot cache (it has no paged prefix sharing; it
                 # is tiny, so re-running shared tokens is cheap) —
@@ -2229,6 +2641,22 @@ class GenerationEngine:
         # rounds can move them (the transports send the head after
         # the first token, which races later rounds)
         handle.spec_wire = self.spec_header()
+        if handle.export_kv:
+            # prefill-only: copy the pages out, seed the trie so the
+            # next cohort prompt still hits, release cache-RETAINED
+            # (à la _suspend) — the slot never enters decode
+            bundle = self._build_kv_bundle(
+                handle, prompt, prefix_blocks + fresh, first, offset,
+                elapsed)
+            with self._cond:
+                if self.prefix_cache:
+                    self._index_prompt_locked(
+                        prompt, prefix_blocks + fresh, matched)
+                self._release_blocks_locked(prefix_blocks + fresh)
+                self._inflight = []
+                self._cond.notify()
+            self._book_export(handle, bundle, slot=slot_idx)
+            return
         slot = _Slot(handle, prefix_blocks + fresh, prompt_len, first,
                      len(matched) + self._worst_case_blocks(
                          prompt_len, remaining, len(matched)))
@@ -2314,7 +2742,9 @@ class GenerationEngine:
                            chunked_prefill=True)
         slot = _Slot(handle, prefix_blocks, offset, None,
                      len(matched) + self._worst_case_blocks(
-                         prompt_len, remaining, len(matched)))
+                         prompt_len,
+                         0 if handle.export_kv else remaining,
+                         len(matched)))
         slot.prefilling = True
         slot.pf_written = offset
         slot.pf_matched = list(matched)
@@ -2422,6 +2852,23 @@ class GenerationEngine:
                            seconds=round(total_s, 6),
                            chunks=slot.pf_chunks)
         self.stats["prefills"] += 1
+        if handle.export_kv:
+            # chunked prefill-only: same export as the monolithic
+            # path, but the slot exists — free it without the decode
+            # it will never run (eviction reason "exported")
+            bundle = self._build_kv_bundle(handle, prompt,
+                                           slot.blocks, first,
+                                           offset, total_s)
+            with self._cond:
+                self._slots[idx] = None
+                if self.prefix_cache:
+                    self._index_prompt_locked(prompt, slot.blocks,
+                                              matched)
+                self._release_blocks_locked(slot.blocks)
+                self._cond.notify()
+            _EVICTIONS_TOTAL.labels(self.name, "exported").inc()
+            self._book_export(handle, bundle, slot=idx)
+            return
         if self._spec_on:
             # draft prefills the FULL prompt monolithically: it is
             # tiny (see _prefill) and its dense cache has no chunk

@@ -839,6 +839,121 @@ def classify_predict_error(e):
     return 500, {"error": f"inference failed: {e}"}, ()
 
 
+#: dtypes a KV-page bundle may ship — the paged pool's native storage
+#: dtypes (compute-dtype pages, or int8 pages + their float32 scales).
+#: Distinct from TENSOR_DTYPES because bundles must carry bfloat16
+#: RAW (upcasting to float32 would double the bytes and break the
+#: "import is a memcpy" contract).
+KV_BUNDLE_DTYPES = {"float32", "float16", "bfloat16", "int8"}
+
+
+def _kv_bundle_np_dtype(name):
+    if name == "bfloat16":
+        # custom dtype (ml_dtypes ships with jax); storage is 2-byte
+        # little-endian on every supported host
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name).newbyteorder("<")
+
+
+def encode_kv_bundle(bundle):
+    """Engine page bundle (``{"meta", "pages"}``) → ``(parts,
+    extra_headers, content_type)`` for a ``:prefill`` response over
+    the zero-copy ``application/x-tensor`` path, multi-tensor framing:
+    part 0 is the JSON meta (its byte length rides
+    ``X-KV-Meta-Bytes``), then one raw little-endian tensor part per
+    cache component (int8 pools ship 4: k, v, k_scales, v_scales);
+    ``X-Tensor-Dtype`` is comma-joined and ``X-Tensor-Shape``
+    semicolon-joined, one entry per part. The tensor parts ALIAS the
+    page arrays (no serialization copy) — the transport writes each
+    part separately, same as the unary binary predict path."""
+    meta_b = json.dumps(bundle["meta"]).encode()
+    parts = [meta_b]
+    dtypes, shapes = [], []
+    for p in bundle["pages"]:
+        p = np.ascontiguousarray(p)
+        if p.dtype.byteorder == ">" or (
+                p.dtype.byteorder == "=" and sys.byteorder == "big"):
+            p = p.astype(p.dtype.newbyteorder("<"))
+        dtypes.append(p.dtype.name)
+        shapes.append(",".join(str(d) for d in p.shape))
+        # reinterpret as raw bytes BEFORE taking the memoryview:
+        # custom dtypes (bfloat16) have no buffer-protocol format
+        # character, a uint8 view always does — still zero-copy
+        parts.append(memoryview(p.reshape(-1).view(np.uint8))
+                     if p.size else memoryview(b""))
+    extra = (("X-KV-Meta-Bytes", str(len(meta_b))),
+             ("X-Tensor-Dtype", ",".join(dtypes)),
+             ("X-Tensor-Shape", ";".join(shapes)))
+    return parts, extra, "application/x-tensor"
+
+
+def decode_kv_bundle(headers, body):
+    """``X-KV-Meta-Bytes``/``X-Tensor-*`` headers + the raw
+    ``:attach`` request body → ``{"meta", "pages", "_t_recv"}`` ready
+    for :meth:`GenerationEngine.import_bundle`. Pages alias the body
+    buffer (``np.frombuffer`` — no copy). Malformed → ValueError
+    (→ HTTP 400: every defect here is the caller's); geometry/dtype
+    mismatches against the POOL are the engine's import taxonomy, not
+    this codec's."""
+    t_recv = time.perf_counter()
+    try:
+        meta_len = int(str(headers.get("X-KV-Meta-Bytes") or "")
+                       .strip())
+    except ValueError:
+        raise ValueError(
+            "X-KV-Meta-Bytes header required (byte length of the "
+            "JSON meta part)") from None
+    if not 0 < meta_len <= len(body):
+        raise ValueError(
+            f"X-KV-Meta-Bytes says {meta_len} but the body is "
+            f"{len(body)} bytes")
+    try:
+        meta = json.loads(bytes(body[:meta_len]))
+    except ValueError:
+        raise ValueError("bundle meta part is not valid JSON") \
+            from None
+    if not isinstance(meta, dict):
+        raise ValueError("bundle meta must be a JSON object")
+    dtypes = [d.strip()
+              for d in (headers.get("X-Tensor-Dtype") or "").split(",")]
+    shapes_raw = (headers.get("X-Tensor-Shape") or "").split(";")
+    if not dtypes[0] or len(dtypes) != len(shapes_raw):
+        raise ValueError(
+            "X-Tensor-Dtype (comma-joined) and X-Tensor-Shape "
+            "(semicolon-joined) must list one entry per tensor part")
+    mv = memoryview(body)
+    pages, off = [], meta_len
+    for dname, sraw in zip(dtypes, shapes_raw):
+        if dname not in KV_BUNDLE_DTYPES:
+            raise ValueError(
+                f"bundle dtype must be one of "
+                f"{sorted(KV_BUNDLE_DTYPES)}, got {dname!r}")
+        dt = _kv_bundle_np_dtype(dname)
+        try:
+            shape = [int(d) for d in sraw.split(",")]
+        except ValueError:
+            raise ValueError(
+                f"X-Tensor-Shape entries must be comma-separated "
+                f"ints, got {sraw!r}") from None
+        if any(d < 0 for d in shape):
+            raise ValueError(
+                f"X-Tensor-Shape dims must be >= 0, got {sraw!r}")
+        want = int(np.prod(shape)) * dt.itemsize
+        if off + want > len(body):
+            raise ValueError(
+                "bundle body is shorter than its declared tensor "
+                "parts")
+        pages.append(np.frombuffer(mv[off:off + want], dtype=dt)
+                     .reshape(shape))
+        off += want
+    if off != len(body):
+        raise ValueError(
+            f"{len(body) - off} trailing bytes after the last "
+            f"tensor part")
+    return {"meta": meta, "pages": tuple(pages), "_t_recv": t_recv}
+
+
 def decode_json_predict(raw):
     """JSON predict body (the ``instances`` and b64 ``tensor``
     contracts) → ``(ndarray, fmt)`` with the list→ndarray
@@ -1484,6 +1599,16 @@ class ModelServer:
                     # autoregressive decode: token-streaming chunked
                     # NDJSON off the generation engine's slot pool
                     return self._generate_stream(name, length)
+                if verb == "prefill":
+                    # disaggregation hop 1: prefill ONLY, answer with
+                    # the KV-page bundle over application/x-tensor
+                    return self._prefill_export(name, length)
+                if verb == "attach":
+                    # disaggregation hop 2: import the bundle into
+                    # free blocks, then stream the continuation under
+                    # the normal :generate NDJSON contract
+                    return self._generate_stream(name, length,
+                                                 attach=True)
                 model = models.get(name)
                 if model is None:
                     return self._send(404, {"error": "model not found"})
@@ -1598,7 +1723,7 @@ class ModelServer:
                 self._rt.phase("encode", t_enc, format="binary")
                 self._send(200, parts, extra, content_type=ctype)
 
-            def _generate_stream(self, name, length):
+            def _generate_stream(self, name, length, attach=False):
                 """``:generate``: greedy autoregressive decode through
                 the model's GenerationEngine, streaming tokens back
                 incrementally as chunked NDJSON — one
@@ -1608,6 +1733,13 @@ class ModelServer:
                 reason distinguishes eos / length / deadline /
                 draining). Request body:
                 ``{"tokens": [ids], "max_tokens"?, "eos_id"?}``.
+
+                ``attach=True`` is the ``:attach`` verb — the body is
+                an exported KV-page bundle (decode_kv_bundle framing)
+                instead of JSON; the engine imports the pages and the
+                SAME streaming contract drains the continuation, plus
+                an ``X-KV-Bytes-Migrated`` head so the router can
+                mirror migration economics to the client.
 
                 ``X-Request-Deadline-Ms`` is honored by EVICTING the
                 decode slot when it expires: mid-stream the client
@@ -1629,38 +1761,67 @@ class ModelServer:
                         self.headers.get("X-Request-Deadline-Ms"))
                 except ValueError as e:
                     return self._send(400, {"error": f"bad request: {e}"})
+                fmt = "binary" if attach else "json"
                 try:
                     t_read = time.time()
                     raw = self.rfile.read(length) if length else b""
                     rt.phase("http.read", t_read)
                     t_dec = time.time()
-                    req = json.loads(raw or b"{}")
-                    if not isinstance(req, dict):
-                        raise ValueError("body must be a JSON object")
-                    tokens = req.get("tokens")
-                    if tokens is None:
-                        raise ValueError('"tokens" is required '
-                                         '(a list of prompt token ids)')
-                    rt.phase("decode", t_dec, format="json")
+                    if attach:
+                        bundle = decode_kv_bundle(self.headers, raw)
+                    else:
+                        req = json.loads(raw or b"{}")
+                        if not isinstance(req, dict):
+                            raise ValueError(
+                                "body must be a JSON object")
+                        tokens = req.get("tokens")
+                        if tokens is None:
+                            raise ValueError(
+                                '"tokens" is required '
+                                '(a list of prompt token ids)')
+                    rt.phase("decode", t_dec, format=fmt)
                 except (ValueError, KeyError, TypeError) as e:
                     return self._send(400, {"error": f"bad request: {e}"})
-                _WIRE_FORMAT_TOTAL.labels("json").inc()
+                _WIRE_FORMAT_TOTAL.labels(fmt).inc()
                 events = queue.Queue()
+                kv_bytes = None
                 try:
-                    handle = engine.submit(
-                        tokens, max_tokens=req.get("max_tokens"),
-                        eos_id=req.get("eos_id"), deadline=deadline,
-                        rt=rt,
-                        tenant=self.headers.get("X-Tenant"),
-                        qos_class=self.headers.get("X-QoS-Class"),
-                        on_token=lambda t, i: events.put(
-                            ("token", t, i)),
-                        on_event=lambda ev, attrs: events.put(
-                            ("event", ev, attrs)),
-                        on_done=lambda reason, toks, error: events.put(
-                            ("done", reason, toks, error)))
+                    if attach:
+                        meta = bundle["meta"]
+                        kv_bytes = (
+                            int(meta.get("page_bytes") or 0)
+                            + int(meta.get("scale_bytes") or 0)) \
+                            or sum(p.nbytes for p in bundle["pages"])
+                        handle = engine.import_bundle(
+                            bundle, deadline=deadline, rt=rt,
+                            tenant=self.headers.get("X-Tenant"),
+                            qos_class=self.headers.get("X-QoS-Class"),
+                            on_token=lambda t, i: events.put(
+                                ("token", t, i)),
+                            on_event=lambda ev, attrs: events.put(
+                                ("event", ev, attrs)),
+                            on_done=lambda reason, toks, error:
+                                events.put(
+                                    ("done", reason, toks, error)))
+                    else:
+                        handle = engine.submit(
+                            tokens, max_tokens=req.get("max_tokens"),
+                            eos_id=req.get("eos_id"),
+                            deadline=deadline,
+                            rt=rt,
+                            tenant=self.headers.get("X-Tenant"),
+                            qos_class=self.headers.get("X-QoS-Class"),
+                            on_token=lambda t, i: events.put(
+                                ("token", t, i)),
+                            on_event=lambda ev, attrs: events.put(
+                                ("event", ev, attrs)),
+                            on_done=lambda reason, toks, error:
+                                events.put(
+                                    ("done", reason, toks, error)))
                 except Exception as e:  # noqa: BLE001 — wire boundary
-                    # ValueError → 400, DrainingError → 503 (clean,
+                    # ValueError → 400 (KVImportError included: the
+                    # router maps any import rejection to its
+                    # colocated fallback), DrainingError → 503 (clean,
                     # retryable-elsewhere; no fallback path exists for
                     # stateful decode slots), else 500
                     code, payload, extra = classify_predict_error(e)
@@ -1692,6 +1853,12 @@ class ModelServer:
                 # — the router mirrors this so clients see which
                 # priority the engine actually applied
                 self.send_header("X-QoS-Class", handle.qos_class)
+                # migration economics for the two-hop flow: bundle
+                # bytes this slot imported (pages + scales), router-
+                # mirrored so the client sees the transfer cost
+                if kv_bytes is not None:
+                    self.send_header("X-KV-Bytes-Migrated",
+                                     str(kv_bytes))
                 # speculative economics (engine-cumulative exact
                 # counts FROZEN at this request's prefill; omitted
                 # when speculation is off so the plain wire contract
@@ -1789,6 +1956,66 @@ class ModelServer:
                     # batch capacity
                     engine.cancel(handle, reason="disconnect")
                     self.close_connection = True
+
+            def _prefill_export(self, name, length):
+                """``:prefill``: disaggregation hop 1 — run prefill
+                ONLY (chunked or monolithic, prefix-cache hits still
+                honored) and answer with the slot's occupied KV pages
+                + last-position state as one ``application/x-tensor``
+                multi-tensor response (encode_kv_bundle framing). A
+                decode-pool replica imports it via ``:attach`` and
+                drains the continuation. Request body matches
+                ``:generate`` — ``max_tokens``/``eos_id`` ride the
+                bundle meta as the importing engine's defaults."""
+                rt = self._rt
+                engine = server._generators.get(name)
+                if engine is None:
+                    return self._send(
+                        404, {"error": f"no generation engine "
+                                       f"registered for {name!r}"})
+                rt.attrs["model"] = name
+                rt.attrs["track"] = "stable"
+                try:
+                    deadline = parse_deadline(
+                        self.headers.get("X-Request-Deadline-Ms"))
+                except ValueError as e:
+                    return self._send(400, {"error": f"bad request: {e}"})
+                try:
+                    t_read = time.time()
+                    raw = self.rfile.read(length) if length else b""
+                    rt.phase("http.read", t_read)
+                    t_dec = time.time()
+                    req = json.loads(raw or b"{}")
+                    if not isinstance(req, dict):
+                        raise ValueError("body must be a JSON object")
+                    tokens = req.get("tokens")
+                    if tokens is None:
+                        raise ValueError('"tokens" is required '
+                                         '(a list of prompt token ids)')
+                    rt.phase("decode", t_dec, format="json")
+                except (ValueError, KeyError, TypeError) as e:
+                    return self._send(400, {"error": f"bad request: {e}"})
+                _WIRE_FORMAT_TOTAL.labels("json").inc()
+                try:
+                    bundle = engine.prefill_export(
+                        tokens, max_tokens=req.get("max_tokens"),
+                        eos_id=req.get("eos_id"), deadline=deadline,
+                        rt=rt, tenant=self.headers.get("X-Tenant"),
+                        qos_class=self.headers.get("X-QoS-Class"))
+                except Exception as e:  # noqa: BLE001 — wire boundary
+                    code, payload, extra = classify_predict_error(e)
+                    return self._send(code, payload, extra)
+                t_enc = time.time()
+                parts, extra, ctype = encode_kv_bundle(bundle)
+                rt.phase("encode", t_enc, format="binary")
+                self._send(
+                    200, parts,
+                    extra + (
+                        ("X-Served-Version", str(engine.version)),
+                        ("X-Prefix-Tokens-Skipped",
+                         str(bundle["meta"].get(
+                             "prefix_tokens_skipped", 0)))),
+                    content_type=ctype)
 
             def _predict_stream(self, model, length):
                 """Batched-pipelined predict over one connection: the

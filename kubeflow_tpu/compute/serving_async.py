@@ -216,6 +216,8 @@ class AsyncTransport:
                             if outcome[0] in ("gtoken", "gevent",
                                               "gdone"):
                                 self._gen_event(conn, outcome)
+                            elif outcome[0] == "gexport":
+                                self._complete_prefill(conn, outcome)
                             else:
                                 self._complete_predict(conn, outcome)
                         except Exception:  # noqa: BLE001 — keep loop
@@ -322,6 +324,9 @@ class AsyncTransport:
             return
         if outcome[0] == "ok":
             code = 200
+        elif outcome[0] == "gexport":
+            code = 200 if outcome[2] is None else \
+                serving.classify_predict_error(outcome[2])[0]
         elif outcome[0] == "gdone":
             # never started streaming: account the would-have-been
             # taxonomy answer (200 is impossible — a token would have
@@ -484,7 +489,20 @@ class AsyncTransport:
         ctype = (headers.get("content-type") or "") \
             .split(";")[0].strip().lower()
         req["binary"] = ctype == "application/x-tensor"
-        if req["binary"] and method == "POST":
+        tgt = serving.parse_predict_path(split.path)
+        if req["binary"] and method == "POST" \
+                and tgt is not None and tgt[1] == "attach":
+            # KV-page bundle (:attach): multi-tensor framing — the
+            # comma-joined dtype / semicolon-joined shape headers are
+            # validated at dispatch by decode_kv_bundle, not by the
+            # single-tensor predict parser; land the raw body in the
+            # same zero-copy buffer recv_into fills
+            buf = bytearray(length)
+            req["tbuf"] = buf
+            req["tview"] = memoryview(buf)
+            req["filled"] = 0
+            req["kv_attach"] = True
+        elif req["binary"] and method == "POST":
             try:
                 dtype, shape = serving._parse_tensor_headers(
                     {"X-Tensor-Dtype": headers.get("x-tensor-dtype"),
@@ -599,6 +617,16 @@ class AsyncTransport:
             # token-streaming decode: the engine's callbacks feed the
             # loop through the completion queue, one frame per token
             self._dispatch_generate(conn, name)
+            return
+        if verb == "prefill":
+            # disaggregation hop 1: prefill ONLY, answer with the
+            # KV-page bundle over application/x-tensor
+            self._dispatch_prefill(conn, name)
+            return
+        if verb == "attach":
+            # disaggregation hop 2: import the bundle, then stream
+            # the continuation under the :generate NDJSON contract
+            self._dispatch_attach(conn, name)
             return
         model = self.server._models.get(name)
         if model is None:
@@ -725,6 +753,170 @@ class AsyncTransport:
             self._respond(conn, code, payload, extra,
                           "application/json")
 
+    def _dispatch_prefill(self, conn, name):
+        """``:prefill`` on the event loop: submit with
+        ``export_kv=True`` — the engine thread runs prefill (chunked
+        or monolithic, prefix hits honored) and finishes the handle
+        with the page bundle attached; the done callback hands it
+        back to the loop, which answers with the encode_kv_bundle
+        multi-tensor response."""
+        req, rt = conn.req, conn.rt
+        engine = self.server._generators.get(name)
+        if engine is None:
+            self._error(conn, 404,
+                        f"no generation engine registered for {name!r}")
+            return
+        rt.attrs["model"] = name
+        rt.attrs["track"] = "stable"
+        if req["binary"]:
+            self._error(conn, 400,
+                        "prefill takes a JSON body "
+                        '({"tokens": [...]}), not application/x-tensor')
+            return
+        try:
+            deadline = serving.parse_deadline(
+                req["headers"].get("x-request-deadline-ms"))
+            tw_dec = time.time()
+            body = json.loads(bytes(req["body"]) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            tokens = body.get("tokens")
+            if tokens is None:
+                raise ValueError('"tokens" is required '
+                                 '(a list of prompt token ids)')
+            rt.phase("decode", tw_dec, format="json")
+        except (ValueError, KeyError, TypeError) as e:
+            self._error(conn, 400, f"bad request: {e}")
+            return
+        serving._WIRE_FORMAT_TOTAL.labels("json").inc()
+        gen = conn.gen
+        req["model_name"] = name
+        conn.state = "wait"
+        self._interest(conn, 0)
+
+        def on_done(reason, toks, error):
+            self._completions.append(
+                (conn, gen, ("gexport", reason, error)))
+            self._wake()
+
+        try:
+            req["gen_engine"] = engine
+            req["gen_handle"] = engine.submit(
+                tokens, max_tokens=body.get("max_tokens"),
+                eos_id=body.get("eos_id"), deadline=deadline, rt=rt,
+                tenant=req["headers"].get("x-tenant"),
+                qos_class=req["headers"].get("x-qos-class"),
+                export_kv=True, on_done=on_done)
+        except Exception as e:  # noqa: BLE001 — wire boundary
+            code, payload, extra = serving.classify_predict_error(e)
+            self._respond(conn, code, payload, extra,
+                          "application/json")
+
+    def _complete_prefill(self, conn, outcome):
+        """The export handle finished on the engine thread — answer
+        with the bundle (or the predict error taxonomy)."""
+        _kind, reason, error = outcome
+        req, rt = conn.req, conn.rt
+        handle = req.get("gen_handle")
+        bundle = handle.kv_bundle if handle is not None else None
+        if error is not None or bundle is None:
+            code, payload, extra = serving.classify_predict_error(
+                error if error is not None
+                else RuntimeError(
+                    f"prefill export finished with reason "
+                    f"{reason!r} and no bundle"))
+            self._respond(conn, code, payload, extra,
+                          "application/json")
+            return
+        t_enc = time.time()
+        parts, extra, ctype = serving.encode_kv_bundle(bundle)
+        rt.phase("encode", t_enc, format="binary")
+        engine = req["gen_engine"]
+        self._respond(
+            conn, 200, parts,
+            extra + (("X-Served-Version", str(engine.version)),
+                     ("X-Prefix-Tokens-Skipped",
+                      str(bundle["meta"].get(
+                          "prefix_tokens_skipped", 0)))),
+            ctype)
+
+    def _dispatch_attach(self, conn, name):
+        """``:attach`` on the event loop: decode the bundle framing
+        (zero-copy over the landed body buffer), import into free
+        blocks, and stream the continuation through the SAME gtoken/
+        gdone machinery as ``:generate``."""
+        req, rt = conn.req, conn.rt
+        engine = self.server._generators.get(name)
+        if engine is None:
+            self._error(conn, 404,
+                        f"no generation engine registered for {name!r}")
+            return
+        rt.attrs["model"] = name
+        rt.attrs["track"] = "stable"
+        headers = req["headers"]
+        if not req.get("kv_attach"):
+            self._error(conn, 400,
+                        "attach takes an application/x-tensor KV-page "
+                        "bundle body (encode_kv_bundle framing)")
+            return
+        try:
+            deadline = serving.parse_deadline(
+                headers.get("x-request-deadline-ms"))
+            tw_dec = time.time()
+            # parse_request_head lowercased the names; the shared
+            # codec asks in canonical case
+            bundle = serving.decode_kv_bundle(
+                {"X-KV-Meta-Bytes": headers.get("x-kv-meta-bytes"),
+                 "X-Tensor-Dtype": headers.get("x-tensor-dtype"),
+                 "X-Tensor-Shape": headers.get("x-tensor-shape")},
+                req["tbuf"])
+            rt.phase("decode", tw_dec, format="binary")
+        except (ValueError, KeyError, TypeError) as e:
+            self._error(conn, 400, f"bad request: {e}")
+            return
+        serving._WIRE_FORMAT_TOTAL.labels("binary").inc()
+        meta = bundle["meta"]
+        req["kv_bytes"] = (
+            int(meta.get("page_bytes") or 0)
+            + int(meta.get("scale_bytes") or 0)) \
+            or sum(p.nbytes for p in bundle["pages"])
+        gen = conn.gen
+        req["model_name"] = name
+        req["gen_started"] = False
+        conn.state = "wait"
+        self._interest(conn, 0)
+
+        def on_token(token, index):
+            self._completions.append(
+                (conn, gen, ("gtoken", token, index)))
+            self._wake()
+
+        def on_event(event, attrs):
+            self._completions.append(
+                (conn, gen, ("gevent", event, attrs)))
+            self._wake()
+
+        def on_done(reason, toks, error):
+            self._completions.append(
+                (conn, gen, ("gdone", reason, toks, error)))
+            self._wake()
+
+        try:
+            req["gen_engine"] = engine
+            req["gen_handle"] = engine.import_bundle(
+                bundle, deadline=deadline, rt=rt,
+                tenant=headers.get("x-tenant"),
+                qos_class=headers.get("x-qos-class"),
+                on_token=on_token, on_event=on_event,
+                on_done=on_done)
+        except Exception as e:  # noqa: BLE001 — wire boundary:
+            # KVImportError → 400 (the router maps any import
+            # rejection to its colocated fallback), DrainingError →
+            # clean 503, else 500
+            code, payload, extra = serving.classify_predict_error(e)
+            self._respond(conn, code, payload, extra,
+                          "application/json")
+
     def _begin_stream(self, conn):
         """Queue the chunked 200 head for a token stream and install
         the close-time bookkeeping (SLO count + trace finish) so a
@@ -746,6 +938,10 @@ class AsyncTransport:
         # resolved QoS class (threaded parity), router-mirrored
         if handle is not None:
             lines.append(f"X-QoS-Class: {handle.qos_class}")
+        # migration economics for the two-hop flow (threaded parity):
+        # bundle bytes this slot imported, router-mirrored
+        if req.get("kv_bytes") is not None:
+            lines.append(f"X-KV-Bytes-Migrated: {req['kv_bytes']}")
         # speculative economics (engine-cumulative exact counts
         # FROZEN at this request's prefill; omitted when speculation
         # is off — byte-identical plain contract), router-mirrored

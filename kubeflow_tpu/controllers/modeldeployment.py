@@ -107,6 +107,60 @@ def autoscale_decision(queue_wait_p50_s, occupancy_mean, current,
     return current
 
 
+def role_autoscale_decision(role, current, min_replicas, max_replicas,
+                            *, queued_prompt_tokens=None,
+                            slot_occupancy=None, up_queued_tokens=64,
+                            up_slot_occupancy=3.0,
+                            down_slot_occupancy=1.0):
+    """Pure per-role scaling policy for disaggregated deployments.
+
+    Each role track scales on the signal IT owns. The telemetry is
+    fleet-summed, but the roles naturally partition it: queued prompt
+    tokens only accumulate on prefill replicas (a decode replica never
+    queues a prompt — it admits migrated pages straight into slots),
+    and decode slot occupancy only lives on decode replicas (a
+    prefill-role engine finishes at export and holds no decode slots).
+
+    - prefill: ``queued_prompt_tokens`` at or above
+      ``up_queued_tokens`` → +1 (prompts are parked behind busy
+      prefill replicas; a new one absorbs whole prefills
+      immediately); an exactly-empty token queue → −1 (prefill
+      capacity is ahead of arrivals, and losing a prefill replica
+      costs only re-warmed prefix caches, not live decodes);
+    - decode: mean ``slot_occupancy`` at or above
+      ``up_slot_occupancy`` → +1 (slot pools are filling and imports
+      will soon bounce with reason=capacity); occupancy at or under
+      ``down_slot_occupancy`` with an empty/absent prompt queue → −1
+      (idle slots decode nothing — but never while prompts are queued
+      upstream, since those become imports here within one
+      migration);
+    - no signal this window (None) → hold.
+
+    One step per evaluation, clamped to [min, max] — the reconcile
+    cadence is the ramp limiter, same as ``autoscale_decision``."""
+    lo = max(1, int(min_replicas))
+    hi = max(lo, int(max_replicas))
+    current = min(max(int(current), lo), hi)
+    if role == "prefill":
+        if queued_prompt_tokens is None:
+            return current
+        if queued_prompt_tokens >= up_queued_tokens and current < hi:
+            return current + 1
+        if queued_prompt_tokens == 0 and current > lo:
+            return current - 1
+        return current
+    if role == "decode":
+        if slot_occupancy is None:
+            return current
+        if slot_occupancy >= up_slot_occupancy and current < hi:
+            return current + 1
+        if slot_occupancy <= down_slot_occupancy \
+                and not queued_prompt_tokens and current > lo:
+            return current - 1
+        return current
+    return current
+
+
 #: one autoscale observation window; a plain ``(p50, occ)`` 2-tuple
 #: from an injected signals_fn still works (the reconciler indexes the
 #: first two fields and getattr's the rest)
@@ -245,10 +299,13 @@ class ModelDeploymentReconciler(Reconciler):
 
     # ------------------------------------------------------- replicas
 
-    def _replica_pod(self, md, index):
+    def _replica_pod(self, md, index, role=None):
         """One model-server pod: the deployment template with the
         per-replica serving contract injected (PORT, MODEL_NAME,
-        SERVING_TRANSPORT — template-set values win)."""
+        SERVING_TRANSPORT, and GEN_ROLE for role tracks —
+        template-set values win). ``index`` is track-local for role
+        tracks; the port slot uses the role-strided GLOBAL index so
+        prefill and decode pods never collide under basePort+i."""
         spec = md.get("spec", {})
         template = m.deep_copy(spec.get("template")
                                or mdapi.default_template())
@@ -256,30 +313,38 @@ class ModelDeploymentReconciler(Reconciler):
         containers = pod_spec.setdefault("containers", [{}])
         env = containers[0].setdefault("env", [])
         have = {e.get("name") for e in env}
+        port_index = mdapi.role_replica_index(role, index) \
+            if role else index
         inject = {
             "MODEL_NAME": spec.get("model", "default"),
-            "PORT": str(mdapi.replica_port(spec, index)),
+            "PORT": str(mdapi.replica_port(spec, port_index)),
             "SERVING_TRANSPORT": spec.get("transport", "async"),
         }
+        if role:
+            inject["GEN_ROLE"] = role
         for key, value in inject.items():
             if key not in have:
                 env.append({"name": key, "value": value})
-        pod = new_pod(
-            f"{m.name_of(md)}-replica-{index}", m.namespace_of(md),
-            pod_spec,
-            labels={LABEL: m.name_of(md),
-                    "model-deployment-index": str(index)})
+        stem = f"{m.name_of(md)}-{role}-{index}" if role \
+            else f"{m.name_of(md)}-replica-{index}"
+        labels = {LABEL: m.name_of(md),
+                  "model-deployment-index": str(index)}
+        if role:
+            labels["model-deployment-role"] = role
+        pod = new_pod(stem, m.namespace_of(md), pod_spec,
+                      labels=labels)
         m.set_controller_reference(pod, md)
         return pod
 
-    def _cached_by_index(self, name):
+    def _cached_by_index(self, name, role=None):
         """Per-replica-index prefix-cache footprint for deployment
         ``name``, from the view remembered at decision time (pod
-        shard identities are ``<name>-replica-<i>``) → {index:
+        shard identities are ``<name>-replica-<i>``, or
+        ``<name>-<role>-<i>`` on a role track) → {index:
         cached_blocks}. Empty when no generate telemetry — the
         victim choice then defaults to retiring from the top."""
         out = {}
-        prefix = f"{name}-replica-"
+        prefix = f"{name}-{role}-" if role else f"{name}-replica-"
         for pod, value in (self._cached_by_pod.get(name)
                            or {}).items():
             if pod.startswith(prefix):
@@ -296,6 +361,8 @@ class ModelDeploymentReconciler(Reconciler):
             return Result()
         spec = md.get("spec", {})
         status = dict(md.get("status") or {})
+        if spec.get("roles"):
+            return self._reconcile_roles(req, md, spec, status)
         lo = int(spec.get("minReplicas", 1))
         hi = int(spec.get("maxReplicas", spec.get("replicas", 1)))
         autoscaling = bool(spec.get("autoscale"))
@@ -408,6 +475,148 @@ class ModelDeploymentReconciler(Reconciler):
             if stale_target:
                 merged.pop("targetReplicas", None)
             md["status"] = merged
+            self.store.update_status(md)
+        return Result(requeue_after=self.autoscale_interval
+                      if autoscaling else 0.0)
+
+    # ---------------------------------------------- role-split tracks
+
+    def _reconcile_roles(self, req, md, spec, status):
+        """Disaggregated prefill/decode: one independent pod track per
+        role in ``spec.roles``, replacing the flat replica set.
+
+        Pods are ``<name>-<role>-<i>`` (labels carry the role + the
+        track-local index; the PORT env uses the role-strided global
+        index so tracks never collide under basePort). Each track
+        autoscales on its OWN token-aware signal —
+        ``role_autoscale_decision`` — because the fleet telemetry
+        partitions by role: queued prompt tokens accumulate only on
+        prefill replicas, decode slot occupancy only on decode
+        replicas. Status grows ``status.roles[role]`` per-track blocks
+        while the combined ``status.endpoints`` keeps feeding the
+        router's poller unchanged (the replicas' own snapshots tell it
+        which endpoint plays which role)."""
+        roles = spec["roles"]
+        autoscaling = bool(spec.get("autoscale"))
+        pods = {m.name_of(p): p for p in self.store.list(
+            "v1", "Pod", req.namespace,
+            label_selector={LABEL: req.name})}
+        prev_roles = dict(status.get("roles") or {})
+        sig = None
+        if autoscaling:
+            sig = self.signals(spec.get("model", "default"))
+            self._cached_by_pod[req.name] = dict(
+                getattr(sig, "cached_blocks_by_pod", None) or {})
+        role_status, all_endpoints = {}, []
+        total_desired = total_ready = 0
+        for role in mdapi.ROLES:
+            cfg = roles.get(role)
+            if cfg is None:
+                continue
+            lo = max(1, int(cfg.get("minReplicas", 1)))
+            hi = max(lo, int(cfg.get("maxReplicas",
+                                     cfg.get("replicas", 1))))
+            prev = dict(prev_roles.get(role) or {})
+            desired = int(cfg.get("replicas", 1))
+            if autoscaling and prev.get("targetReplicas"):
+                desired = int(prev["targetReplicas"])
+            desired = min(max(desired, lo), hi)
+
+            index_of = {}
+            for pod_name, p in pods.items():
+                labels = m.labels_of(p)
+                if labels.get("model-deployment-role") != role:
+                    continue
+                idx = labels.get("model-deployment-index")
+                if idx is not None and not m.deep_get(
+                        p, "metadata", "deletionTimestamp"):
+                    index_of[int(idx)] = pod_name
+            missing = desired - len(index_of)
+            if missing > 0:
+                i = 0
+                while missing > 0:
+                    if i not in index_of:
+                        try:
+                            self.store.create(self._replica_pod(
+                                md, i, role=role))
+                        except AlreadyExistsError:
+                            pass
+                        index_of[i] = f"{req.name}-{role}-{i}"
+                        missing -= 1
+                    i += 1
+            elif missing < 0:
+                # prefix caches only matter on the prefill track —
+                # decode replicas hold imported pages for LIVE slots,
+                # which drain on SIGTERM either way
+                cached = self._cached_by_index(req.name, role=role) \
+                    if role == "prefill" else {}
+                for idx in scale_down_victims(sorted(index_of),
+                                              -missing, cached):
+                    try:
+                        self.store.delete("v1", "Pod",
+                                          index_of.pop(idx),
+                                          req.namespace)
+                    except NotFoundError:
+                        pass
+
+            ready, endpoints = 0, []
+            for i in sorted(index_of):
+                p = pods.get(index_of[i])
+                if p is None:
+                    continue    # created this pass; not Running yet
+                if m.deep_get(p, "status", "phase") == "Running":
+                    ready += 1
+                    ip = m.deep_get(p, "status", "podIP",
+                                    default="127.0.0.1")
+                    port = mdapi.replica_port(
+                        spec, mdapi.role_replica_index(role, i))
+                    endpoints.append(f"{ip}:{port}")
+
+            entry = {"replicas": desired, "readyReplicas": ready,
+                     "endpoints": endpoints}
+            if autoscaling and prev.get("targetReplicas"):
+                entry["targetReplicas"] = prev["targetReplicas"]
+            if autoscaling and ready >= desired and sig is not None:
+                queued_tokens = getattr(sig, "queued_prompt_tokens",
+                                        None)
+                slot_occ = getattr(sig, "slot_occupancy", None)
+                target = role_autoscale_decision(
+                    role, desired, lo, hi,
+                    queued_prompt_tokens=queued_tokens,
+                    slot_occupancy=slot_occ)
+                if target != desired:
+                    direction = "up" if target > desired else "down"
+                    _AUTOSCALE_TOTAL.labels(
+                        f"{req.name}/{role}", direction).inc()
+                    log.info(
+                        "autoscale %s/%s[%s]: %d -> %d "
+                        "(queued_prompt_tokens=%s slot_occupancy=%s)",
+                        req.namespace, req.name, role, desired,
+                        target, queued_tokens, slot_occ)
+                    entry["targetReplicas"] = target
+                    entry["lastScale"] = {
+                        "from": desired, "to": target,
+                        "queuedPromptTokens": queued_tokens,
+                        "slotOccupancy": slot_occ,
+                        "at": m.now_iso()}
+            if prev.get("lastScale") and "lastScale" not in entry:
+                entry["lastScale"] = prev["lastScale"]
+            role_status[role] = entry
+            all_endpoints.extend(endpoints)
+            total_desired += desired
+            total_ready += ready
+
+        new_status = {
+            "replicas": total_desired,
+            "readyReplicas": total_ready,
+            "endpoints": all_endpoints,
+            "roles": role_status,
+            "phase": "Ready"
+            if total_ready >= total_desired and total_desired > 0
+            else "Progressing",
+        }
+        if any(status.get(k) != v for k, v in new_status.items()):
+            md["status"] = {**status, **new_status}
             self.store.update_status(md)
         return Result(requeue_after=self.autoscale_interval
                       if autoscaling else 0.0)

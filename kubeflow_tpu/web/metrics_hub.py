@@ -201,6 +201,9 @@ def create_app(store=None, shard_dir=None):
         pods = {}
         queued_tokens = {}     # model -> fleet-summed backlog gauge
         routing = {"decisions": {}, "pods": {}}
+        role_of = {}           # model -> {pod: serving role}
+        pod_queued = {}        # model -> {pod: queued prompt tokens}
+        pod_slots = {}         # model -> {pod: [occ_sum, occ_count]}
         for shard in (aggregate.read_shards(shard_dir)
                       if shard_dir else []):
             pod_ttft = aggregate.histogram_view(
@@ -220,6 +223,23 @@ def create_app(store=None, shard_dir=None):
                     model = ld.get("model", "")
                     queued_tokens[model] = \
                         queued_tokens.get(model, 0) + int(value)
+                    pod_queued.setdefault(model, {})[shard.pod] = \
+                        int(value)
+                elif name == "serving_generate_role":
+                    # one-hot gauge: the pod's advisory serving role
+                    if value:
+                        role_of.setdefault(ld.get("model", ""), {})[
+                            shard.pod] = ld.get("role", "both")
+                elif name == ("serving_generate_slot_occupancy_slots"
+                              "_sum"):
+                    pod_slots.setdefault(
+                        ld.get("model", ""), {}).setdefault(
+                        shard.pod, [0.0, 0.0])[0] += value
+                elif name == ("serving_generate_slot_occupancy_slots"
+                              "_count"):
+                    pod_slots.setdefault(
+                        ld.get("model", ""), {}).setdefault(
+                        shard.pod, [0.0, 0.0])[1] += value
                 elif name == "router_route_decisions_total":
                     # route-policy context: how :generate traffic was
                     # PLACED on those pods (affinity | session |
@@ -257,6 +277,56 @@ def create_app(store=None, shard_dir=None):
                     if h + m else None,
                 "queued_prompt_tokens": queued_tokens.get(model, 0),
                 "pods": pods.get(model, {}),
+            }
+
+        # disaggregated prefill/decode breakdown: which pods play
+        # which role, the prefill tracks' queued-prompt-token depth,
+        # the decode tracks' slot occupancy, and KV migration
+        # latency/bytes over the wire — only for models that actually
+        # run role-split (role gauges or migration counters present)
+        migration = aggregate.histogram_view(
+            triples, "serving_kv_migration_seconds")
+        kv_bytes = {}          # model -> {pool dtype: bytes shipped}
+        for (series, labels), value in merged.items():
+            if series == "serving_kv_migrated_bytes_total":
+                ld = dict(labels)
+                kv_bytes.setdefault(ld.get("model", ""), {})[
+                    ld.get("dtype", "")] = int(value)
+        for model, entry in models.items():
+            by_role = role_of.get(model, {})
+            split = any(r in ("prefill", "decode")
+                        for r in by_role.values())
+            if not split and model not in kv_bytes \
+                    and (model,) not in migration:
+                continue
+            pre = sorted(p for p, r in by_role.items()
+                         if r == "prefill")
+            dec = sorted(p for p, r in by_role.items()
+                         if r == "decode")
+            slots = pod_slots.get(model, {})
+            occ_sum = sum(slots.get(p, (0.0, 0.0))[0] for p in dec)
+            occ_count = sum(slots.get(p, (0.0, 0.0))[1] for p in dec)
+            mig = latency_ms(migration[(model,)]) \
+                if (model,) in migration else {
+                    "count": 0, "p50_ms": None, "p95_ms": None,
+                    "p99_ms": None}
+            mig["bytes_by_dtype"] = kv_bytes.get(model, {})
+            entry["disagg"] = {
+                "roles": {
+                    "prefill": {
+                        "pods": pre,
+                        "queued_prompt_tokens": sum(
+                            pod_queued.get(model, {}).get(p, 0)
+                            for p in pre),
+                    },
+                    "decode": {
+                        "pods": dec,
+                        "slot_occupancy_mean":
+                            round(occ_sum / occ_count, 4)
+                            if occ_count else None,
+                    },
+                },
+                "migration": mig,
             }
 
         # per-tenant breakdown off the serving_qos_* families (tenant

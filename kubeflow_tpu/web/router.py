@@ -60,7 +60,10 @@ _ROUTE_DECISIONS = obs_metrics.REGISTRY.counter(
     "``:generate`` routing decisions by active policy and outcome: "
     "affinity (prefix-digest ring hit), session (X-Session-Id ring "
     "hit), spill (affinity target saturated, deterministic successor "
-    "took it), scatter (no ring key — least-outstanding fallback)",
+    "took it), scatter (no ring key — least-outstanding fallback), "
+    "disagg (two-hop prefill→decode migration), fallback (role pools "
+    "present but the two-hop flow could not complete — served "
+    "colocated instead, never 5xx)",
     ("policy", "outcome"))
 
 #: request headers forwarded to the replica (hop-by-hop headers are not)
@@ -97,7 +100,27 @@ _MIRROR_HEADERS = ("Content-Type", "X-Tensor-Dtype", "X-Tensor-Shape",
                    # engine actually applied; also set on the gate's
                    # own 429s)
                    "X-QoS-Class",
+                   # disaggregated two-hop flow: which prefill replica
+                   # filled the pages (router-stamped) and how many
+                   # bundle bytes migrated into the decode slot
+                   # (loadtest --disagg asserts both survive the hop)
+                   "X-Prefill-Replica",
+                   "X-KV-Bytes-Migrated",
                    "Retry-After")
+
+
+def _header_ci(headers, name):
+    """Case-insensitive header fetch from a plain dict (upstream
+    responses materialize ``dict(resp.headers.items())`` — the case is
+    whatever the replica sent)."""
+    value = headers.get(name)
+    if value is not None:
+        return value
+    lower = name.lower()
+    for k, v in headers.items():
+        if k.lower() == lower:
+            return v
+    return None
 
 
 def _ring_point(s):
@@ -356,6 +379,15 @@ class RouterCore:
             return True
         view = replica.gen_view.get(model)
         if view:
+            if view.get("role") == "prefill":
+                # role-split tolerance: a prefill replica holds no
+                # decode slots worth judging — an export never decodes
+                # and (monolithic) never even occupies a slot, so the
+                # occupancy check below would read a deep hop-1 queue
+                # as permanent saturation and spill every key off its
+                # ring home. Router-side outstanding (above) is the
+                # only meaningful pressure signal here.
+                return False
             slots = view.get("slots") or 0
             if slots and view.get("occupied", 0) >= slots \
                     and view.get("queued", 0) > 0:
@@ -410,6 +442,217 @@ class RouterCore:
             _ROUTE_DECISIONS.labels(self.route_policy,
                                     "scatter").inc()
         return replica
+
+    def role_pools(self, model):
+        """Routable replicas by polled serving role for ``model`` →
+        ``(prefill_pool, decode_pool)``. Replicas reporting role
+        ``both`` (the single-replica default) belong to NEITHER pool —
+        with no pure-role replica in sight the two-hop flow never
+        engages and the colocated path is byte-for-byte unchanged."""
+        pre, dec = [], []
+        with self._lock:
+            for r in self.replicas.values():
+                if not r.routable:
+                    continue
+                role = (r.gen_view.get(model) or {}).get("role")
+                if role == "prefill":
+                    pre.append(r)
+                elif role == "decode":
+                    dec.append(r)
+        return pre, dec
+
+    def pick_prefill(self, key, model, pool):
+        """Hop-1 pick: the prefix/session-affinity ring walk FILTERED
+        to the prefill pool, so cohort prefix hits survive the role
+        split (the cohort's pages live in the prefill replica's radix
+        trie); spill/scatter semantics mirror :meth:`pick_ring`."""
+        endpoints = {r.endpoint: r for r in pool}
+        if key is not None:
+            with self._lock:
+                ring_walk = list(self._ring.walk(key))
+            primary = None
+            for ep in ring_walk:
+                replica = endpoints.get(ep)
+                if replica is None:
+                    continue
+                if primary is None:
+                    primary = replica
+                if not self._saturated(replica, model):
+                    return replica
+            if primary is not None:
+                return primary
+        # no stable key (or no prefill replica on the ring): least
+        # outstanding within the pool, deterministic tie-break
+        if not pool:
+            return None
+        least = min(r.outstanding for r in pool)
+        ties = sorted((r for r in pool if r.outstanding == least),
+                      key=lambda r: r.endpoint)
+        with self._lock:
+            self._rr += 1
+            return ties[self._rr % len(ties)]
+
+    def pick_decode(self, model, pool, exclude=()):
+        """Hop-2 pick: least slot pressure (occupied/slots from the
+        polled snapshot, router-side outstanding as the tie-break) —
+        the decode pool's scarce resource is slots, not connections."""
+        best, best_key = None, None
+        for r in pool:
+            if r.endpoint in exclude:
+                continue
+            view = r.gen_view.get(model) or {}
+            slots = view.get("slots") or 0
+            occupied = view.get("occupied") or 0
+            pressure = occupied / slots if slots else 1.0
+            key = (pressure, r.outstanding, r.endpoint)
+            if best is None or key < best_key:
+                best, best_key = r, key
+        return best
+
+    def forward_disagg(self, path, body, headers):
+        """The two-hop disaggregated ``:generate`` → ``(status,
+        resp_headers, chunk_iterator)`` like :meth:`forward_stream`,
+        or None when the caller must serve colocated instead.
+
+        Hop 1 POSTs the prompt to a prefill-pool replica as
+        ``:prefill`` (prefix-affinity-keyed, so cohort hits survive
+        the split) and store-and-forwards the page bundle — it is one
+        bounded buffer, not a token stream. Hop 2 streams ``:attach``
+        from the decode replica with the least slot pressure; the
+        relay is incremental from the first token on. Every failure
+        path returns None and books ``outcome="fallback"`` — the
+        client never sees a 5xx for a migration the colocated path
+        can absorb. Returns None WITHOUT booking when no pure-role
+        replica exists (plain colocated operation, not a fallback)."""
+        model = path.rsplit("/", 1)[-1].rsplit(":", 1)[0]
+        pre_pool, dec_pool = self.role_pools(model)
+        if not pre_pool and not dec_pool:
+            return None      # no role split anywhere: not a fallback
+
+        def fallback(why):
+            log.warning("disagg fallback for %s: %s", model, why)
+            _ROUTE_DECISIONS.labels(self.route_policy,
+                                    "fallback").inc()
+            return None
+
+        if not pre_pool:
+            return fallback("prefill pool is empty")
+        if not dec_pool:
+            return fallback("decode pool is empty")
+        key, _kind = self.affinity_key(path, body, headers or {})
+        pre = self.pick_prefill(key, model, pre_pool)
+        if pre is None:
+            return fallback("no routable prefill replica")
+        prefill_path = path[:-len(":generate")] + ":prefill"
+        with self._lock:
+            pre.outstanding += 1
+        _OUTSTANDING.labels(pre.endpoint).set(pre.outstanding)
+        try:
+            try:
+                status, h1, bundle = self._request_once(
+                    pre, "POST", prefill_path, body, headers,
+                    reuse=True)
+            except (OSError, http.client.HTTPException):
+                status, h1, bundle = self._request_once(
+                    pre, "POST", prefill_path, body, headers,
+                    reuse=False)
+            _ROUTED_TOTAL.labels(pre.endpoint, str(status)).inc()
+        except (OSError, http.client.HTTPException) as e:
+            with self._lock:
+                pre.healthy = False
+            _REPLICA_HEALTHY.labels(pre.endpoint).set(0)
+            _ROUTED_TOTAL.labels(pre.endpoint, "502").inc()
+            return fallback(f"prefill replica {pre.endpoint} "
+                            f"unreachable ({e})")
+        finally:
+            with self._lock:
+                pre.outstanding -= 1
+            _OUTSTANDING.labels(pre.endpoint).set(pre.outstanding)
+        if status != 200:
+            return fallback(f"prefill hop answered {status}")
+        attach_headers = {"Content-Type": "application/x-tensor"}
+        for name in ("X-KV-Meta-Bytes", "X-Tensor-Dtype",
+                     "X-Tensor-Shape"):
+            value = _header_ci(h1, name)
+            if value is None:
+                return fallback(f"prefill response missing {name}")
+            attach_headers[name] = value
+        for name in ("x-request-deadline-ms", "traceparent",
+                     "x-tenant", "x-qos-class"):
+            value = (headers or {}).get(name)
+            if value is not None:
+                attach_headers[name] = value
+        attach_path = path[:-len(":generate")] + ":attach"
+        tried = []
+        for _attempt in range(2):
+            dec = self.pick_decode(model, dec_pool, exclude=tried)
+            if dec is None:
+                return fallback("every decode replica failed the "
+                                "attach")
+            tried.append(dec.endpoint)
+            with self._lock:
+                dec.outstanding += 1
+            _OUTSTANDING.labels(dec.endpoint).set(dec.outstanding)
+            conn = http.client.HTTPConnection(
+                dec.host, dec.port, timeout=self.timeout)
+            try:
+                conn.request("POST", attach_path, bundle,
+                             attach_headers)
+                resp = conn.getresponse()
+                resp_headers = dict(resp.headers.items())
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                with self._lock:
+                    dec.healthy = False
+                    dec.outstanding -= 1
+                _REPLICA_HEALTHY.labels(dec.endpoint).set(0)
+                _OUTSTANDING.labels(dec.endpoint).set(dec.outstanding)
+                _ROUTED_TOTAL.labels(dec.endpoint, "502").inc()
+                log.warning("decode replica %s failed before the "
+                            "attach head (%s); retrying on another",
+                            dec.endpoint, e)
+                continue
+            _ROUTED_TOTAL.labels(dec.endpoint,
+                                 str(resp.status)).inc()
+            if resp.status != 200:
+                # import rejected (geometry/dtype/capacity/role):
+                # drain the taxonomy answer and serve colocated —
+                # the prompt is still in hand
+                try:
+                    resp.read()
+                finally:
+                    conn.close()
+                    with self._lock:
+                        dec.outstanding -= 1
+                    _OUTSTANDING.labels(dec.endpoint).set(
+                        dec.outstanding)
+                return fallback(
+                    f"attach hop answered {resp.status}")
+            # success: stamp the prefill replica + cohort savings so
+            # the client sees the full two-hop picture in one place
+            resp_headers["X-Prefill-Replica"] = pre.endpoint
+            skipped = _header_ci(h1, "X-Prefix-Tokens-Skipped")
+            if skipped is not None:
+                resp_headers["X-Prefix-Tokens-Skipped"] = skipped
+            _ROUTE_DECISIONS.labels(self.route_policy,
+                                    "disagg").inc()
+
+            def chunks(resp=resp, conn=conn, replica=dec):
+                try:
+                    while True:
+                        data = resp.read1(65536)
+                        if not data:
+                            return
+                        yield data
+                finally:
+                    conn.close()
+                    with self._lock:
+                        replica.outstanding -= 1
+                    _OUTSTANDING.labels(replica.endpoint).set(
+                        replica.outstanding)
+
+            return resp.status, resp_headers, chunks()
+        return fallback("every decode replica failed the attach")
 
     def _request_once(self, replica, method, path, body, headers,
                       reuse):
@@ -616,6 +859,13 @@ class RouterCore:
                 "block_size": gen.get("block_size"),
                 "hit_ratio": cache.get("hit_ratio"),
                 "cached_blocks": cache.get("cached_blocks"),
+                # disaggregation: the replica's serving role (prefill
+                # | decode | both) keys the two-hop pools, and the
+                # queued prompt-token backlog is the prefill-track
+                # autoscaling signal
+                "role": gen.get("role") or "both",
+                "queued_tokens": gen.get("queued_tokens"),
+                "migration": gen.get("migration"),
             }
         with self._lock:
             replica.gen_view = view
@@ -775,6 +1025,21 @@ def create_app(store=None, core=None, namespace=None, qos=None):
                 refused = gate_generate(request)
                 if refused is not None:
                     return refused
+            # disaggregated two-hop first: when pure-role replicas
+            # exist, prefill on the prefill pool, migrate the pages,
+            # stream decode from the decode pool; ANY failure falls
+            # back to the colocated path below (never 5xx for a
+            # migration the colocated path can absorb)
+            if request.method == "POST":
+                disagg = core.forward_disagg(path, request.body,
+                                             headers)
+                if disagg is not None:
+                    status, resp_headers, chunk_iter = disagg
+                    mirrored = {k: resp_headers[k]
+                                for k in _MIRROR_HEADERS
+                                if k in resp_headers}
+                    return Response(stream=chunk_iter, status=status,
+                                    headers=mirrored)
             # token streams relay INCREMENTALLY (forward_stream +
             # Response(stream=...)): each upstream frame goes on the
             # wire as it arrives — a generation's first token must not

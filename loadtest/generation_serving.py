@@ -66,6 +66,15 @@ counter across phases, the done frames' ``attn_backend`` field
 ``gather`` the demoted conformance reference), and well-formed
 streams.
 
+``--disagg`` (ISSUE 20) spawns a PREFILL-role and a DECODE-role
+replica (``GEN_ROLE`` through cmd) behind the router's two-hop
+disaggregated flow: every stream prefills on the prefill replica,
+migrates its KV pages over the x-tensor wire, and decodes on the
+decode replica — zero 5xx, router-mirrored ``X-Prefill-Replica`` /
+``X-KV-Bytes-Migrated`` heads, tokens identical to a colocated
+single-replica reference, and a graceful colocated fallback (booked
+``outcome="fallback"``) when the prefill replica is killed mid-wave.
+
 ``--chunked-prefill`` (ISSUE 18) spawns TWO replicas — one monolithic,
 one with ``GEN_PREFILL_CHUNK`` — each exporting metric shards, fronts
 both with a real router, and replays the same schedule: short streams
@@ -95,6 +104,7 @@ the subprocess pod.
     python loadtest/generation_serving.py --attn-backend paged
     python loadtest/generation_serving.py --token-latency
     python loadtest/generation_serving.py --chunked-prefill
+    python loadtest/generation_serving.py --disagg
 """
 
 import argparse
@@ -174,6 +184,15 @@ def build_argparser():
                          "must carry the chunk-size knob, and every "
                          "stream must stay well-formed with "
                          "identical tokens both ways")
+    ap.add_argument("--disagg", action="store_true",
+                    help="ISSUE 20 verdict: a prefill-role and a "
+                         "decode-role replica behind the router's "
+                         "two-hop KV-migration flow — zero 5xx, "
+                         "mirrored X-Prefill-Replica/"
+                         "X-KV-Bytes-Migrated, tokens identical to a "
+                         "colocated reference, and graceful colocated "
+                         "fallback when the prefill replica is "
+                         "killed mid-wave")
     ap.add_argument("--token-latency", action="store_true",
                     help="ISSUE 16 verdict: the replica exports metric "
                          "shards (OBS_EXPORT_DIR), streams run through "
@@ -289,7 +308,9 @@ def run_one(port, tokens, max_tokens, headers=None,
             "skip_header": skip_header,
             "mesh_header": mesh_header, "spec_header": spec_header,
             "ttft_header": ttft_header,
-            "qos_header": resp.headers.get("X-QoS-Class")}
+            "qos_header": resp.headers.get("X-QoS-Class"),
+            "prefill_header": resp.headers.get("X-Prefill-Replica"),
+            "kv_header": resp.headers.get("X-KV-Bytes-Migrated")}
 
 
 def scrape_occupancy(port):
@@ -1257,6 +1278,163 @@ def _chunked_prefill_side(args, chunk):
         proc.wait(timeout=10)
 
 
+def run_disagg(args):
+    """The --disagg verdict (ISSUE 20): one PREFILL-role and one
+    DECODE-role subprocess replica behind a real router's two-hop
+    disaggregated flow, against a colocated single-replica reference.
+
+    - every routed stream must be 200 (zero 5xx, ever);
+    - wave 1 (both roles healthy): every response carries the
+      router-mirrored ``X-Prefill-Replica`` (the prefill endpoint)
+      and a positive ``X-KV-Bytes-Migrated``, the router books
+      outcome="disagg", and the tokens are IDENTICAL to the colocated
+      reference (page migration is a placement change, not a numerics
+      change);
+    - wave 2: the prefill replica is KILLED mid-wave — the router
+      must degrade to colocated serving on the surviving decode-role
+      replica with zero 5xx, booking outcome="fallback", and the
+      fallback tokens must still match the reference."""
+    from kubeflow_tpu.web import router as router_lib
+
+    specs = [([(7 * i + j) % 500 + 1 for j in range(24)], 12)
+             for i in range(6)]
+
+    # --- colocated reference: one role-less replica, driven direct
+    args.extra_env = {}
+    proc, port = spawn_server(args)
+    try:
+        run_one(port, [(997 * 24 + j) % 500 + 1 for j in range(24)],
+                2)     # warm the bucket + decode
+        reference = [run_one(port, list(p), mt)["tokens"]
+                     for p, mt in specs]
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+    # --- role-split fleet behind a real router
+    args.extra_env = {"GEN_ROLE": "prefill"}
+    pre_proc, pre_port = spawn_server(args)
+    args.extra_env = {"GEN_ROLE": "decode"}
+    dec_proc, dec_port = spawn_server(args)
+    core = router_lib.RouterCore(health_interval=0.3)
+    core.set_backends([f"127.0.0.1:{pre_port}",
+                       f"127.0.0.1:{dec_port}"])
+    app = router_lib.create_app(core=core)
+    httpd = app.serve(port=0, host="127.0.0.1")
+    rport = httpd.server_address[1]
+
+    def decisions():
+        conn = http.client.HTTPConnection("127.0.0.1", rport,
+                                          timeout=30)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        out = {}
+        for mo in re.finditer(
+                r'^router_route_decisions_total{[^}]*outcome='
+                r'"([^"]+)"[^}]*} ([0-9.e+-]+)', text, re.M):
+            out[mo.group(1)] = out.get(mo.group(1), 0.0) \
+                + float(mo.group(2))
+        return out
+
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            pre_pool, dec_pool = core.role_pools("lm")
+            if pre_pool and dec_pool:
+                break
+            time.sleep(0.05)
+        else:
+            raise SystemExit("role pools never formed at the router")
+        # warm both sides' programs through the disagg path itself
+        run_one(rport, [(997 * 24 + j) % 500 + 1 for j in range(24)],
+                2)
+
+        lock = threading.Lock()
+        errors = []
+
+        def wave(tag, out):
+            def client(i, spec):
+                try:
+                    r = run_one(rport, list(spec[0]), spec[1])
+                    with lock:
+                        out[i] = r
+                except Exception as e:  # noqa: BLE001 — report below
+                    with lock:
+                        errors.append((tag, repr(e)))
+
+            threads = [threading.Thread(target=client, args=(i, s))
+                       for i, s in enumerate(specs)]
+            for t in threads:
+                t.start()
+            return threads
+
+        wave1 = {}
+        for t in wave("disagg", wave1):
+            t.join(timeout=120)
+        assert not errors, errors[:3]
+        d1 = decisions()
+        migrated = [wave1[i] for i in range(len(specs))]
+        assert all(r["prefill_header"] == f"127.0.0.1:{pre_port}"
+                   for r in migrated), \
+            "X-Prefill-Replica not mirrored from the prefill hop"
+        assert all(int(r["kv_header"] or 0) > 0 for r in migrated), \
+            "X-KV-Bytes-Migrated missing or zero on a disagg stream"
+        disagg_tokens = [r["tokens"] for r in migrated]
+        assert disagg_tokens == reference, \
+            "disagg continuation diverged from the colocated engine"
+
+        # wave 2: kill the prefill replica while clients are in
+        # flight — the router must absorb the loss colocated
+        wave2 = {}
+        threads = wave("fallback", wave2)
+        pre_proc.kill()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors[:3]
+        d2 = decisions()
+        fallback_tokens = [wave2[i]["tokens"]
+                           for i in range(len(specs))]
+        assert fallback_tokens == reference, \
+            "fallback continuation diverged from the colocated engine"
+
+        report = {
+            "mode": "disagg", "transport": args.transport,
+            "slots": args.slots, "streams_per_wave": len(specs),
+            "prefill_replica": f"127.0.0.1:{pre_port}",
+            "decode_replica": f"127.0.0.1:{dec_port}",
+            "kv_bytes_migrated_per_stream":
+                [int(r["kv_header"]) for r in migrated],
+            "route_decisions_after_wave1": d1,
+            "route_decisions_after_wave2": d2,
+            "checks": {
+                "zero_5xx": True,            # run_one asserted 200s
+                "prefill_replica_header_mirrored": True,
+                "kv_bytes_header_positive": True,
+                "disagg_decisions_booked":
+                    d1.get("disagg", 0) >= len(specs),
+                "fallback_decisions_booked":
+                    d2.get("fallback", 0) > 0,
+                "tokens_identical_vs_colocated":
+                    disagg_tokens == reference,
+                "fallback_tokens_identical_vs_colocated":
+                    fallback_tokens == reference,
+            }}
+        print(json.dumps(report, indent=2))
+        if not all(report["checks"].values()):
+            raise SystemExit("disagg generation loadtest FAILED")
+    finally:
+        httpd.shutdown()
+        core.stop()
+        for proc in (pre_proc, dec_proc):
+            proc.terminate()
+        for proc in (pre_proc, dec_proc):
+            try:
+                proc.wait(timeout=10)
+            except Exception:   # noqa: BLE001 — already killed
+                pass
+
+
 def run_chunked_prefill(args):
     """The --chunked-prefill verdict (ISSUE 18): the same intruder
     scenario against two replicas — GEN_PREFILL_CHUNK unset vs 64 —
@@ -1321,6 +1499,10 @@ def main(argv=None):
     if args.chunked_prefill:
         # spawns its own replicas (one per side) — no shared server
         run_chunked_prefill(args)
+        return
+    if args.disagg:
+        # spawns its own replicas (reference + one per role)
+        run_disagg(args)
         return
     if args.shared_prefix and args.replicas > 1:
         fleet = [spawn_server(args) for _ in range(args.replicas)]

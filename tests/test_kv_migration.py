@@ -1,0 +1,451 @@
+"""KV-page migration (ISSUE 20): prefill/decode disaggregation.
+
+The contract under test: a PREFILL-role engine runs prefill only and
+exports the request's occupied pages + block table + last-position
+state in the pool's NATIVE dtype; a DECODE-role engine imports the
+bundle into free blocks, rewrites its block table, seeds its radix
+trie, and decodes — and the continuation is TOKEN-IDENTICAL to a
+colocated engine in every cell of the matrix:
+
+    {fp32, bf16, int8} x {plain, prefix-cache hit, chunked prefill,
+                          speculative decode on the importer,
+                          preempt-resume of the imported slot}
+
+Every migration in these tests rides the REAL wire codec
+(``encode_kv_bundle`` -> bytes -> ``decode_kv_bundle``), so the
+bfloat16 framing and the byte accounting are exercised alongside the
+engine semantics. The float pools' oracle is
+``reference_greedy_decode``; the int8 pool's oracle is a COLOCATED
+int8 engine (quantized decode legitimately diverges from the
+full-precision reference — migration must not add to it).
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.compute import generate as gen_lib
+from kubeflow_tpu.compute import serving
+from kubeflow_tpu.compute.models import transformer
+from kubeflow_tpu.web import router as router_lib
+
+
+def _config(dtype="float32"):
+    return transformer.Config(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, max_seq=128,
+        dtype=dtype, attention="dense", remat=False, scan_layers=True)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(_config(), jax.random.PRNGKey(0))
+
+
+def _engine(params, dtype="float32", **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_context", 128)
+    kw.setdefault("name", kw.get("role", "both"))
+    return gen_lib.GenerationEngine(params, _config(dtype), **kw)
+
+
+def _wire(bundle):
+    """Round-trip a bundle through the real x-tensor framing — what
+    the router ships between replicas."""
+    parts, headers, ctype = serving.encode_kv_bundle(bundle)
+    assert ctype == "application/x-tensor"
+    return serving.decode_kv_bundle(
+        dict(headers), b"".join(bytes(p) for p in parts))
+
+
+#: the three KV pools of the matrix: (label, model dtype, kv_dtype)
+POOLS = [("fp32", "float32", None),
+         ("bf16", "bfloat16", None),
+         ("int8", "float32", "int8")]
+
+PROMPT = [5, 9, 3, 7, 11, 2, 44, 17, 8, 23, 30, 6]   # 12 = 1.5 blocks
+
+
+class TestMigrationTokenIdentity:
+    """Every cell: export on a prefill-role engine, wire round-trip,
+    import into a decode-role engine, compare the continuation
+    against the pool's oracle."""
+
+    def _oracle(self, params, dtype, kv_dtype, prompt, max_tokens,
+                **eng_kw):
+        if kv_dtype is None:
+            return gen_lib.reference_greedy_decode(
+                params, _config(dtype), prompt, max_tokens)
+        col = _engine(params, dtype, kv_dtype=kv_dtype, name="oracle",
+                      **eng_kw)
+        try:
+            return col.generate(list(prompt), max_tokens=max_tokens)[0]
+        finally:
+            col.close()
+
+    @pytest.mark.parametrize("label,dtype,kv_dtype", POOLS)
+    def test_plain_migration_matches_oracle(self, params, label,
+                                            dtype, kv_dtype):
+        pre = _engine(params, dtype, kv_dtype=kv_dtype,
+                      role="prefill")
+        dec = _engine(params, dtype, kv_dtype=kv_dtype, role="decode")
+        try:
+            bundle = _wire(pre.prefill_export(list(PROMPT),
+                                              max_tokens=16))
+            meta = bundle["meta"]
+            assert meta["n_blocks"] == 2           # ceil(12 / 8)
+            assert int(meta["page_bytes"]) > 0
+            if kv_dtype == "int8":
+                # int8 pages ship WITH their fp32 scales, split out
+                # in the accounting (the satellite byte proof keys
+                # off this split)
+                assert int(meta["scale_bytes"]) > 0
+            toks, reason = dec.import_bundle(bundle).result(
+                timeout=120)
+            assert reason == "length"
+            assert toks == self._oracle(params, dtype, kv_dtype,
+                                        PROMPT, 16)
+            assert pre.stats["kv_exports"] == 1
+            assert pre.stats["kv_bytes_migrated"] \
+                == int(meta["page_bytes"]) + int(meta["scale_bytes"])
+            assert dec.stats["kv_imports"] == 1
+        finally:
+            pre.close()
+            dec.close()
+
+    @pytest.mark.parametrize("label,dtype,kv_dtype", POOLS)
+    def test_prefix_cache_hit_export(self, params, label, dtype,
+                                     kv_dtype):
+        """The exporter's radix trie serves the second export's
+        prefill; the shipped pages must still be complete and the
+        continuation identical."""
+        pre = _engine(params, dtype, kv_dtype=kv_dtype,
+                      role="prefill")
+        dec = _engine(params, dtype, kv_dtype=kv_dtype, role="decode")
+        try:
+            first = pre.prefill_export(list(PROMPT), max_tokens=16)
+            again = pre.prefill_export(list(PROMPT), max_tokens=16)
+            assert again["meta"]["prefix_tokens_skipped"] > 0
+            assert first["meta"]["prefix_tokens_skipped"] == 0
+            toks, _ = dec.import_bundle(_wire(again)).result(
+                timeout=120)
+            assert toks == self._oracle(params, dtype, kv_dtype,
+                                        PROMPT, 16)
+        finally:
+            pre.close()
+            dec.close()
+
+    @pytest.mark.parametrize("label,dtype,kv_dtype", POOLS)
+    def test_chunked_prefill_export(self, params, label, dtype,
+                                    kv_dtype):
+        """A chunked exporter fills the pages one decode-sized chunk
+        per loop iteration — the bundle must be indistinguishable
+        from the monolithic one."""
+        prompt = [(3 * j) % 63 + 1 for j in range(33)]  # 33: ragged
+        pre = _engine(params, dtype, kv_dtype=kv_dtype,
+                      role="prefill", prefill_chunk=8,
+                      prefix_cache=False)
+        dec = _engine(params, dtype, kv_dtype=kv_dtype, role="decode")
+        try:
+            s0 = pre.stats["prefill_chunks"]
+            bundle = _wire(pre.prefill_export(list(prompt),
+                                              max_tokens=12))
+            assert pre.stats["prefill_chunks"] - s0 >= 4   # 33/8
+            assert bundle["meta"]["n_blocks"] == 5         # ceil 33/8
+            toks, _ = dec.import_bundle(bundle).result(timeout=120)
+            assert toks == self._oracle(params, dtype, kv_dtype,
+                                        prompt, 12)
+        finally:
+            pre.close()
+            dec.close()
+
+    @pytest.mark.parametrize("label,dtype,kv_dtype", POOLS)
+    def test_speculative_decode_on_importer(self, params, label,
+                                            dtype, kv_dtype):
+        """The importer drafts + verifies over the MIGRATED pages;
+        greedy verification keeps the continuation exact."""
+        pre = _engine(params, dtype, kv_dtype=kv_dtype,
+                      role="prefill")
+        dec = _engine(params, dtype, kv_dtype=kv_dtype, role="decode",
+                      draft_params=params, draft_config=_config(dtype),
+                      spec_k=3)
+        try:
+            bundle = _wire(pre.prefill_export(list(PROMPT),
+                                              max_tokens=16))
+            toks, _ = dec.import_bundle(bundle).result(timeout=120)
+            assert toks == self._oracle(params, dtype, kv_dtype,
+                                        PROMPT, 16)
+            assert dec.stats["spec_rounds"] > 0
+        finally:
+            pre.close()
+            dec.close()
+
+    @pytest.mark.parametrize("label,dtype,kv_dtype", POOLS)
+    def test_preempt_resume_of_imported_slot(self, params, label,
+                                             dtype, kv_dtype):
+        """An imported batch-class slot suspends for an interactive
+        arrival and resumes off the trie the import seeded — the
+        resumed stream must still match an UNINTERRUPTED oracle."""
+        pre = _engine(params, dtype, kv_dtype=kv_dtype,
+                      role="prefill")
+        dec = _engine(params, dtype, kv_dtype=kv_dtype, role="decode",
+                      max_slots=1)
+        try:
+            bundle = _wire(pre.prefill_export(list(PROMPT),
+                                              max_tokens=20))
+            dec._step_sleep = 0.01
+            try:
+                batch = dec.import_bundle(bundle, qos_class="batch")
+                deadline = time.monotonic() + 60
+                while len(batch.out_tokens) < 5:
+                    assert time.monotonic() < deadline, \
+                        "imported stream never decoded"
+                    time.sleep(0.002)
+                inter = dec.submit([4, 4, 8], max_tokens=4,
+                                   qos_class="interactive")
+                inter.result(timeout=120)
+                batch.result(timeout=120)
+            finally:
+                dec._step_sleep = 0.0
+            assert batch.preemptions >= 1
+            assert batch.out_tokens == self._oracle(
+                params, dtype, kv_dtype, PROMPT, 20, max_slots=1)
+            assert inter.out_tokens == self._oracle(
+                params, dtype, kv_dtype, [4, 4, 8], 4, max_slots=1)
+            # the import seeded the trie: the resume skipped at
+            # least the migrated prompt
+            assert batch.prefix_tokens_skipped >= len(PROMPT)
+        finally:
+            pre.close()
+            dec.close()
+
+
+class TestWireCodec:
+    def _bundle(self, arrs, **meta):
+        base = {"block_size": 8, "n_layers": 2, "kv_heads": 4,
+                "head_dim": 8, "n_blocks": 1, "prompt": [1, 2],
+                "first_token": 3, "page_bytes": 0, "scale_bytes": 0}
+        base.update(meta)
+        return {"meta": base, "pages": tuple(arrs)}
+
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
+    def test_roundtrip_preserves_bytes_and_dtype(self, dtype):
+        if dtype == "bfloat16":
+            import ml_dtypes
+            np_dt = np.dtype(ml_dtypes.bfloat16)
+        else:
+            np_dt = np.dtype(dtype)
+        rng = np.random.default_rng(0)
+        arrs = [rng.integers(-100, 100, (2, 1, 8, 4, 8)).astype(np_dt)
+                for _ in range(2)]
+        out = _wire(self._bundle(arrs))
+        assert out["meta"]["first_token"] == 3
+        for a, b in zip(arrs, out["pages"]):
+            assert b.dtype == a.dtype and b.shape == a.shape
+            assert a.tobytes() == b.tobytes()
+
+    def test_truncated_body_rejected(self):
+        parts, headers, _ = serving.encode_kv_bundle(
+            self._bundle([np.zeros((1, 1, 8, 4, 8), np.float32)]))
+        body = b"".join(bytes(p) for p in parts)
+        with pytest.raises(ValueError):
+            serving.decode_kv_bundle(dict(headers), body[:-4])
+
+    def test_trailing_bytes_rejected(self):
+        parts, headers, _ = serving.encode_kv_bundle(
+            self._bundle([np.zeros((1, 1, 8, 4, 8), np.float32)]))
+        body = b"".join(bytes(p) for p in parts) + b"\x00\x00"
+        with pytest.raises(ValueError):
+            serving.decode_kv_bundle(dict(headers), body)
+
+    def test_unlisted_dtype_rejected(self):
+        parts, headers, _ = serving.encode_kv_bundle(
+            self._bundle([np.zeros((1, 1, 8, 4, 8), np.float32)]))
+        headers = dict(headers)
+        headers["X-Tensor-Dtype"] = "float64"
+        with pytest.raises(ValueError):
+            serving.decode_kv_bundle(
+                headers, b"".join(bytes(p) for p in parts))
+
+
+class TestImportRejections:
+    """Every rejection reason lands as KVImportError + a booked
+    ``serving_kv_import_rejections_total`` stat — the router maps any
+    of them to its colocated fallback."""
+
+    def _bundle(self, params):
+        pre = _engine(params, role="prefill", name="rej-pre")
+        try:
+            return pre.prefill_export(list(PROMPT), max_tokens=8)
+        finally:
+            pre.close()
+
+    def _reject(self, engine, bundle, reason):
+        before = engine.stats["kv_import_rejections"]
+        with pytest.raises(gen_lib.KVImportError) as ei:
+            engine.import_bundle(bundle)
+        assert ei.value.reason == reason
+        assert engine.stats["kv_import_rejections"] == before + 1
+
+    def test_block_size_mismatch(self, params):
+        bundle = self._bundle(params)
+        dec = _engine(params, role="decode", block_size=16)
+        try:
+            self._reject(dec, bundle, "block_size")
+        finally:
+            dec.close()
+
+    def test_geometry_mismatch(self, params):
+        bundle = self._bundle(params)
+        bundle["meta"] = dict(bundle["meta"], n_layers=7)
+        dec = _engine(params, role="decode")
+        try:
+            self._reject(dec, bundle, "geometry")
+        finally:
+            dec.close()
+
+    def test_dtype_mismatch(self, params):
+        bundle = self._bundle(params)
+        dec = _engine(params, role="decode", kv_dtype="int8")
+        try:
+            self._reject(dec, bundle, "dtype")
+        finally:
+            dec.close()
+
+    def test_vocab_mismatch(self, params):
+        bundle = self._bundle(params)
+        bundle["meta"] = dict(bundle["meta"],
+                              prompt=[1, 2, 9999] * 4)
+        dec = _engine(params, role="decode")
+        try:
+            self._reject(dec, bundle, "vocab")
+        finally:
+            dec.close()
+
+    def test_capacity_exhausted(self, params):
+        bundle = self._bundle(params)
+        # bundle ships 2 pages and its decode budget reserves a
+        # third; a 2-block pool can never host it, no matter how
+        # idle — admission must reject, not wedge the queue
+        dec = _engine(params, role="decode", num_blocks=2,
+                      prefix_cache=False)
+        try:
+            self._reject(dec, bundle, "capacity")
+        finally:
+            dec.close()
+
+    def test_prefill_role_refuses_imports(self, params):
+        bundle = self._bundle(params)
+        pre = _engine(params, role="prefill", name="rej-pre2")
+        try:
+            self._reject(pre, bundle, "role")
+        finally:
+            pre.close()
+
+
+class TestRoleKnob:
+    def test_invalid_role_rejected(self, params):
+        with pytest.raises(ValueError, match="role"):
+            _engine(params, role="decoder")
+
+    def test_default_role_is_both_and_capability_complete(self,
+                                                          params):
+        eng = _engine(params)
+        try:
+            assert eng.role == "both"
+            assert eng.snapshot()["role"] == "both"
+            bundle = eng.prefill_export(list(PROMPT), max_tokens=6)
+            toks, _ = eng.import_bundle(_wire(bundle)).result(
+                timeout=120)
+            assert toks == gen_lib.reference_greedy_decode(
+                params, _config(), PROMPT, 6)
+        finally:
+            eng.close()
+
+    def test_prefill_snapshot_reports_role_and_queue(self, params):
+        pre = _engine(params, role="prefill")
+        try:
+            snap = pre.snapshot()
+            assert snap["role"] == "prefill"
+            assert "queued_tokens" in snap
+        finally:
+            pre.close()
+
+
+class TestRouterRoleSplit:
+    """Router policy units: role pools off polled snapshots, the
+    prefill-view saturation fix, and the two-hop picks."""
+
+    def _core(self, views):
+        core = router_lib.RouterCore(health_interval=600,
+                                     poll_models=False)
+        core.set_backends(sorted(views))
+        with core._lock:
+            for ep, view in views.items():
+                core.replicas[ep].gen_view = {"lm": view}
+                core.replicas[ep].healthy = True
+        return core
+
+    def test_saturated_tolerates_prefill_view_without_slots(self):
+        """The satellite bugfix: a prefill replica reports no decode
+        slots — the occupancy heuristic must not read that as
+        permanent saturation."""
+        core = self._core({
+            "127.0.0.1:9001": {"role": "prefill", "slots": 0,
+                               "occupied": 0, "queued": 7},
+        })
+        try:
+            replica = core.replicas["127.0.0.1:9001"]
+            assert core._saturated(replica, "lm") is False
+            # a BOTH-role view with the same numbers would also hold
+            # (slots=0 never saturates), but a full decode view does
+            replica.gen_view = {"lm": {"role": "decode", "slots": 2,
+                                       "occupied": 2, "queued": 1}}
+            assert core._saturated(replica, "lm") is True
+        finally:
+            core.stop()
+
+    def test_role_pools_partition_and_ignore_both(self):
+        core = self._core({
+            "127.0.0.1:9001": {"role": "prefill"},
+            "127.0.0.1:9002": {"role": "decode"},
+            "127.0.0.1:9003": {"role": "both"},
+        })
+        try:
+            pre, dec = core.role_pools("lm")
+            assert [r.endpoint for r in pre] == ["127.0.0.1:9001"]
+            assert [r.endpoint for r in dec] == ["127.0.0.1:9002"]
+        finally:
+            core.stop()
+
+    def test_pick_decode_prefers_least_slot_pressure(self):
+        core = self._core({
+            "127.0.0.1:9001": {"role": "decode", "slots": 4,
+                               "occupied": 3},
+            "127.0.0.1:9002": {"role": "decode", "slots": 4,
+                               "occupied": 1},
+        })
+        try:
+            _, dec = core.role_pools("lm")
+            pick = core.pick_decode("lm", dec)
+            assert pick.endpoint == "127.0.0.1:9002"
+            pick = core.pick_decode("lm", dec,
+                                    exclude=("127.0.0.1:9002",))
+            assert pick.endpoint == "127.0.0.1:9001"
+        finally:
+            core.stop()
+
+    def test_forward_disagg_declines_without_role_pools(self):
+        """A legacy fleet (all role=both) never engages the two-hop
+        flow — forward_disagg returns None without booking."""
+        core = self._core({
+            "127.0.0.1:9001": {"role": "both"},
+            "127.0.0.1:9002": {"role": "both"},
+        })
+        try:
+            assert core.forward_disagg(
+                "/v1/models/lm:generate", b"{}", {}) is None
+        finally:
+            core.stop()

@@ -19,8 +19,8 @@ import pytest
 from kubeflow_tpu.api import modeldeployment as mdapi
 from kubeflow_tpu.compute import serving
 from kubeflow_tpu.controllers.modeldeployment import (
-    LABEL, ModelDeploymentReconciler, autoscale_decision,
-    _histogram_quantile)
+    LABEL, ModelDeploymentReconciler, Signals, autoscale_decision,
+    role_autoscale_decision, _histogram_quantile)
 from kubeflow_tpu.core import meta as m
 from kubeflow_tpu.web import router as router_lib
 from kubeflow_tpu.web.http import TestClient
@@ -181,6 +181,151 @@ class TestModelDeploymentReconciler:
         assert md["status"]["replicas"] == 3
         assert store.try_get("v1", "Pod", "pin-replica-2",
                              "default") is not None
+
+
+class TestRoleAutoscaleDecision:
+    """ISSUE 20: per-role scaling is a pure function over the signal
+    that actually accumulates on that role's replicas."""
+
+    def test_prefill_scales_up_on_queued_tokens(self):
+        assert role_autoscale_decision(
+            "prefill", 1, 1, 4, queued_prompt_tokens=100) == 2
+
+    def test_prefill_holds_in_band(self):
+        assert role_autoscale_decision(
+            "prefill", 2, 1, 4, queued_prompt_tokens=10) == 2
+
+    def test_prefill_scales_down_when_queue_empty(self):
+        assert role_autoscale_decision(
+            "prefill", 3, 1, 4, queued_prompt_tokens=0) == 2
+
+    def test_no_signal_holds(self):
+        assert role_autoscale_decision("prefill", 2, 1, 4) == 2
+        assert role_autoscale_decision("decode", 2, 1, 4) == 2
+
+    def test_decode_scales_up_on_slot_occupancy(self):
+        assert role_autoscale_decision(
+            "decode", 2, 1, 4, slot_occupancy=3.5) == 3
+
+    def test_decode_down_only_when_prompt_queue_drained(self):
+        # decode slots empty but prompts still queued upstream:
+        # shrinking decode now would stall the migrations about to
+        # land — hold until the prefill backlog clears
+        assert role_autoscale_decision(
+            "decode", 3, 1, 4, slot_occupancy=0.5,
+            queued_prompt_tokens=50) == 3
+        assert role_autoscale_decision(
+            "decode", 3, 1, 4, slot_occupancy=0.5,
+            queued_prompt_tokens=0) == 2
+
+    def test_clamped_to_bounds(self):
+        assert role_autoscale_decision(
+            "prefill", 4, 1, 4, queued_prompt_tokens=9999) == 4
+        assert role_autoscale_decision(
+            "decode", 1, 1, 4, slot_occupancy=0.0) == 1
+
+
+class TestRoleSplitReconciler:
+    """spec.roles replaces the flat replica set with one pod track
+    per role: strided ports, GEN_ROLE env, per-role status, and
+    independent token-aware autoscaling (ISSUE 20)."""
+
+    def test_materializes_role_tracks_with_strided_ports(
+            self, store, manager):
+        _deploy_manager(store, manager)
+        store.create(mdapi.new_deployment(
+            "dis", "default", model="lm", base_port=9500,
+            roles={"prefill": {"replicas": 1},
+                   "decode": {"replicas": 2}}))
+        manager.run_sync()
+
+        pre = store.get("v1", "Pod", "dis-prefill-0", "default")
+        labels = m.labels_of(pre)
+        assert labels[LABEL] == "dis"
+        assert labels["model-deployment-role"] == "prefill"
+        env = {e["name"]: e.get("value") for e in
+               pre["spec"]["containers"][0]["env"]}
+        assert env["GEN_ROLE"] == "prefill"
+        assert env["PORT"] == "9500"
+
+        dec = store.get("v1", "Pod", "dis-decode-1", "default")
+        env = {e["name"]: e.get("value") for e in
+               dec["spec"]["containers"][0]["env"]}
+        assert env["GEN_ROLE"] == "decode"
+        # decode track rides the role stride: index 100 + i under
+        # basePort, so the tracks never collide
+        assert env["PORT"] == str(9500 + 101)
+
+        md = store.get(API, "ModelDeployment", "dis", "default")
+        assert md["status"]["replicas"] == 3
+        assert md["status"]["phase"] == "Progressing"
+
+    def test_role_tracks_publish_split_and_combined_endpoints(
+            self, store, manager):
+        _deploy_manager(store, manager)
+        store.create(mdapi.new_deployment(
+            "diseps", "default", base_port=9550,
+            roles={"prefill": {"replicas": 1},
+                   "decode": {"replicas": 2}}))
+        manager.run_sync()
+        for name in ("diseps-prefill-0", "diseps-decode-0",
+                     "diseps-decode-1"):
+            pod = store.get("v1", "Pod", name, "default")
+            pod["status"] = {"phase": "Running",
+                            "podIP": "127.0.0.1"}
+            store.update_status(pod)
+        manager.run_sync()
+        md = store.get(API, "ModelDeployment", "diseps", "default")
+        assert md["status"]["phase"] == "Ready"
+        roles = md["status"]["roles"]
+        assert roles["prefill"]["endpoints"] == ["127.0.0.1:9550"]
+        assert roles["decode"]["endpoints"] == [
+            "127.0.0.1:9650", "127.0.0.1:9651"]
+        # combined list keeps feeding the router poller unchanged —
+        # the replicas' own snapshots say who plays which role
+        assert md["status"]["endpoints"] == [
+            "127.0.0.1:9550", "127.0.0.1:9650", "127.0.0.1:9651"]
+
+    def test_role_tracks_autoscale_independently(self, store,
+                                                 manager):
+        sig = {"queued": 100, "occ": 0.5}
+        _deploy_manager(
+            store, manager,
+            signals_fn=lambda model: Signals(
+                None, None, sig["queued"], sig["occ"], {}))
+        store.create(mdapi.new_deployment(
+            "rauto", "default", base_port=9700, autoscale=True,
+            roles={"prefill": {"replicas": 1, "maxReplicas": 3},
+                   "decode": {"replicas": 1, "maxReplicas": 3}}))
+        manager.run_sync()
+        for name in ("rauto-prefill-0", "rauto-decode-0"):
+            pod = store.get("v1", "Pod", name, "default")
+            pod["status"] = {"phase": "Running",
+                            "podIP": "127.0.0.1"}
+            store.update_status(pod)
+        manager.run_sync()
+        md = store.get(API, "ModelDeployment", "rauto", "default")
+        roles = md["status"]["roles"]
+        # prompt backlog scales PREFILL only; decode holds even at
+        # low occupancy because the backlog will land on it next
+        assert roles["prefill"]["targetReplicas"] == 2
+        assert roles["prefill"]["lastScale"]["to"] == 2
+        assert "targetReplicas" not in roles["decode"]
+        manager.run_sync()     # target is acted on
+        assert store.try_get("v1", "Pod", "rauto-prefill-1",
+                             "default") is not None
+        pod = store.get("v1", "Pod", "rauto-prefill-1", "default")
+        pod["status"] = {"phase": "Running", "podIP": "127.0.0.1"}
+        store.update_status(pod)
+        # backlog drains into decode slots: prefill gives back the
+        # replica, decode grows
+        sig["queued"] = 0
+        sig["occ"] = 4.0
+        manager.run_sync()
+        md = store.get(API, "ModelDeployment", "rauto", "default")
+        roles = md["status"]["roles"]
+        assert roles["prefill"]["targetReplicas"] == 1
+        assert roles["decode"]["targetReplicas"] == 2
 
 
 def _replica_server(version):
@@ -421,6 +566,21 @@ class TestDeploymentCrdShapes:
     def test_replica_port_contract(self):
         assert mdapi.replica_port({"basePort": 9000}, 2) == 9002
         assert mdapi.replica_port({}, 2) == mdapi.DEFAULT_PORT
+
+    def test_roles_spec_normalization(self):
+        md = mdapi.new_deployment(
+            "d", "ns",
+            roles={"prefill": {"replicas": 2, "minReplicas": 1},
+                   "decode": {}})
+        assert md["spec"]["roles"]["prefill"]["replicas"] == 2
+        assert md["spec"]["roles"]["prefill"]["minReplicas"] == 1
+        assert md["spec"]["roles"]["decode"]["replicas"] == 1
+        with pytest.raises(ValueError, match="role"):
+            mdapi.new_deployment("d", "ns", roles={"draft": {}})
+
+    def test_role_replica_index_stride(self):
+        assert mdapi.role_replica_index("prefill", 0) == 0
+        assert mdapi.role_replica_index("decode", 1) == 101
 
     @pytest.mark.parametrize("kwargs,key,value", [
         (dict(min_replicas=2), "minReplicas", 2),
